@@ -1,0 +1,92 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's API.
+
+Built from scratch on JAX/XLA/Pallas: eager mode runs on a vjp tape, the
+performance path stages whole train steps through jax.jit, and distribution
+rides jax.sharding over TPU meshes.  API mirrors the reference
+(python/paddle/__init__.py) so Paddle users can switch directly.
+"""
+__version__ = "0.1.0"
+
+import jax.numpy as jnp
+
+from .framework import core as _core
+from .framework import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+                        get_default_dtype, set_default_dtype, seed,
+                        set_device, get_device, is_compiled_with_tpu,
+                        is_compiled_with_cuda, is_compiled_with_xpu,
+                        in_dynamic_mode, in_dygraph_mode)
+
+# dtypes as module attributes (paddle.float32 etc.)
+float16 = jnp.dtype("float16")
+bfloat16 = jnp.dtype("bfloat16")
+float32 = jnp.dtype("float32")
+float64 = jnp.dtype("float64")
+int8 = jnp.dtype("int8")
+int16 = jnp.dtype("int16")
+int32 = jnp.dtype("int32")
+int64 = jnp.dtype("int64")
+uint8 = jnp.dtype("uint8")
+bool = jnp.dtype("bool")
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+
+from .tensor import Tensor, to_tensor  # noqa: E402
+from .tensor.tensor import Parameter  # noqa: E402
+from .tensor import *  # noqa: F401,F403,E402
+from .tensor.logic import is_tensor  # noqa: E402
+from .tensor.attribute import shape as shape  # noqa: E402,F811
+
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: E402
+from .framework.core import Generator  # noqa: E402
+
+from . import autograd  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import metric  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from . import vision  # noqa: E402
+from . import distributed  # noqa: E402
+from . import device  # noqa: E402
+from . import text  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import version  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import inference  # noqa: E402
+from . import profiler  # noqa: E402
+from . import incubate  # noqa: E402
+
+from .hapi.model import Model  # noqa: E402
+from .hapi import callbacks  # noqa: E402
+from .hapi.model_summary import summary  # noqa: E402
+from .io.serialization import save, load  # noqa: E402
+from .jit.api import disable_static, enable_static  # noqa: E402
+from .framework.param_attr import ParamAttr  # noqa: E402
+from .nn.clip import clip_grad_norm_, clip_grad_value_  # noqa: E402
+
+from .tensor import linalg  # noqa: E402
+from .utils.lazy import flops  # noqa: E402
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: python/paddle/batch.py — legacy reader batching."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def get_cudnn_version():
+    return None
+
+
+def is_grad_enabled():
+    return _core.grad_enabled()

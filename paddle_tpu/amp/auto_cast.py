@@ -1,0 +1,80 @@
+"""auto_cast (ref: python/paddle/amp/auto_cast.py, fluid/dygraph/amp/auto_cast.py)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework import core
+
+# ops cast to low precision under O1 (mirrors ref white list: matmul/conv)
+white_list = {"matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+              "flash_attention", "sdpa", "einsum"}
+# ops kept in fp32 (softmax/norm/loss reductions)
+black_list = {"softmax", "log_softmax", "layer_norm", "batch_norm",
+              "cross_entropy", "mean", "sum", "norm"}
+
+
+class _AmpState:
+    def __init__(self, enable, dtype, level):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = core._state.amp_state
+    wl = set(white_list)
+    bl = set(black_list)
+    if custom_white_list:
+        wl |= set(custom_white_list)
+    if custom_black_list:
+        bl |= set(custom_black_list)
+    state = _AmpState(enable, core.convert_dtype(dtype), level)
+    state.white_list = wl
+    state.black_list = bl
+    core._state.amp_state = state if enable else None
+    try:
+        yield
+    finally:
+        core._state.amp_state = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_state():
+    return core._state.amp_state
+
+
+def _maybe_cast_inputs(opname, vals):
+    """Called from dispatch for white-listed ops under auto_cast."""
+    st = core._state.amp_state
+    if st is None or not st.enable:
+        return vals
+    if opname in getattr(st, "black_list", black_list):
+        return [v.astype(jnp.float32)
+                if hasattr(v, "dtype") and v.dtype == st.dtype else v
+                for v in vals]
+    if opname in getattr(st, "white_list", white_list):
+        return [v.astype(st.dtype)
+                if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+                else v for v in vals]
+    return vals
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to low precision (master weights kept
+    fp32 inside optimizers that support it)."""
+    dt = core.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for m in ms:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models if single else ms
+    return (models if single else ms), optimizers

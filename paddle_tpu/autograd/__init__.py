@@ -1,0 +1,7 @@
+"""paddle_tpu.autograd — eager tape engine + functional grad API."""
+from . import tape
+from .tape import (no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+                   backward, grad)
+
+# paddle.autograd exposes PyLayer; provide a jax.custom_vjp-backed analogue
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

@@ -1,0 +1,66 @@
+"""PyLayer: user-defined autograd ops (ref: python/paddle/autograd/py_layer.py).
+
+The reference routes custom forward/backward through the C++ imperative
+engine; here the user's backward is attached as the vjp of a tape node
+directly.
+"""
+from __future__ import annotations
+
+from ..framework import core
+from ..autograd.tape import Node
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass and define ``forward(ctx, *args)`` / ``backward(ctx, *grads)``."""
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensors = [a for a in args if isinstance(a, Tensor)]
+        record = core.grad_enabled() and any(
+            not t.stop_gradient for t in tensors)
+
+        with_no_grad = [a.detach() if isinstance(a, Tensor) else a for a in args]
+        outs = cls.forward(ctx, *with_no_grad, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+
+        if record:
+            diff_parents = [t for t in tensors
+                            if not t.stop_gradient]
+
+            def vjp_fn(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                from ..tensor import Tensor as T
+                grads = cls.backward(ctx, *[T(c) for c in cts])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                vals = [g.value if isinstance(g, T) else g for g in grads]
+                # map returned grads positionally onto diff parents
+                return tuple(vals[:len(diff_parents)])
+
+            node = Node(vjp_fn=vjp_fn, parents=diff_parents,
+                        n_outputs=len(outs_t),
+                        out_shapes=[tuple(o.shape) for o in outs_t],
+                        out_dtypes=[o.dtype for o in outs_t],
+                        name=cls.__name__)
+            for i, o in enumerate(outs_t):
+                o._node = node
+                o._node_index = i
+                o.stop_gradient = False
+        return outs

@@ -1,0 +1,238 @@
+"""Eager autograd engine: a define-by-run tape over ``jax.vjp``.
+
+TPU-native replacement for the reference's C++ imperative engine
+(ref: paddle/fluid/imperative/tracer.cc, basic_engine.cc).  The reference
+records OpBase nodes with per-op GradOpMaker kernels; we record one tape node
+per dispatched primitive holding the ``jax.vjp`` closure, so every op's
+gradient comes from XLA-differentiated code instead of hand-written grad
+kernels.  Under ``jit.to_static`` the tape is bypassed entirely and
+``jax.grad`` differentiates the whole step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..framework import core
+
+
+class Node:
+    """One recorded primitive application."""
+
+    __slots__ = ("vjp_fn", "parents", "n_outputs", "out_shapes", "out_dtypes",
+                 "_accum", "name")
+
+    def __init__(self, vjp_fn, parents, n_outputs, out_shapes, out_dtypes,
+                 name=""):
+        self.vjp_fn = vjp_fn
+        self.parents = parents        # list[Tensor] — diff inputs only
+        self.n_outputs = n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self._accum: Optional[list] = None
+        self.name = name
+
+    def seed(self, index: int, grad):
+        if self._accum is None:
+            self._accum = [None] * self.n_outputs
+        if self._accum[index] is None:
+            self._accum[index] = grad
+        else:
+            self._accum[index] = self._accum[index] + grad
+
+    def cotangents(self):
+        import numpy as np
+        import jax
+        out = []
+        for i in range(self.n_outputs):
+            g = self._accum[i] if self._accum else None
+            if g is None:
+                dt = self.out_dtypes[i]
+                if jnp.issubdtype(dt, jnp.inexact):
+                    g = jnp.zeros(self.out_shapes[i], dt)
+                else:
+                    # non-differentiable outputs take float0 cotangents
+                    g = np.zeros(self.out_shapes[i], jax.dtypes.float0)
+            out.append(g)
+        return tuple(out)
+
+
+class NoGrad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __init__(self):
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = core.grad_enabled()
+        core.set_grad_enabled_flag(False)
+        return self
+
+    def __exit__(self, *exc):
+        core.set_grad_enabled_flag(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with NoGrad():
+                return fn(*a, **k)
+        return wrapper
+
+
+no_grad = NoGrad
+
+
+class enable_grad:
+    def __init__(self):
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = core.grad_enabled()
+        core.set_grad_enabled_flag(True)
+        return self
+
+    def __exit__(self, *exc):
+        core.set_grad_enabled_flag(self._prev)
+        return False
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+        self._prev = core.grad_enabled()
+        core.set_grad_enabled_flag(self._mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        core.set_grad_enabled_flag(self._prev)
+        return False
+
+
+def is_grad_enabled() -> bool:
+    return core.grad_enabled()
+
+
+def _topo_order(root_node) -> List[Node]:
+    """Post-order DFS over the node DAG (iterative; graphs can be deep)."""
+    order: List[Node] = []
+    visited = set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            pn = p._node
+            if pn is not None and id(pn) not in visited:
+                stack.append((pn, False))
+    return order  # post-order: parents before children; reverse for backward
+
+
+def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
+    """Run reverse-mode accumulation from ``tensor`` into leaf ``.grad``s.
+
+    ``watch``: ids of non-leaf tensors that should ALSO accumulate ``.grad``
+    (used by paddle.grad to differentiate w.r.t. intermediates)."""
+    from ..tensor import Tensor
+
+    if tensor._node is None:
+        if tensor.stop_gradient:
+            raise RuntimeError(
+                "Tensor.backward() called on a tensor with stop_gradient=True "
+                "and no graph")
+        return
+    if grad is None:
+        grad = jnp.ones(tensor.shape, tensor.dtype)
+    elif isinstance(grad, Tensor):
+        grad = grad.value
+
+    if watch and id(tensor) in watch:
+        tensor._accumulate_grad(grad)
+
+    root = tensor._node
+    root.seed(tensor._node_index, grad)
+
+    order = _topo_order(root)
+    for node in reversed(order):
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time. "
+                "Pass retain_graph=True to the first .backward() if you "
+                "need to backward twice.")
+        cts = node.cotangents()
+        if node.n_outputs == 1:
+            in_grads = node.vjp_fn(cts[0])
+        else:
+            in_grads = node.vjp_fn(cts)
+        for parent, g in zip(node.parents, in_grads):
+            if g is None:
+                continue
+            if watch:
+                # paddle.grad mode: accumulate ONLY into requested tensors
+                if id(parent) in watch:
+                    parent._accumulate_grad(g)
+                if parent._node is not None:
+                    parent._node.seed(parent._node_index, g)
+            elif parent._node is not None:
+                parent._node.seed(parent._node_index, g)
+            else:
+                parent._accumulate_grad(g)
+        node._accum = None
+        if not retain_graph:
+            node.vjp_fn = None
+    if not retain_graph:
+        # break links so the graph is freed and cannot be reused
+        for node in order:
+            node.parents = ()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad: functional gradient of outputs wrt inputs (eager tape).
+
+    ref: python/paddle/fluid/dygraph/base.py::grad.  create_graph (double
+    backward) is supported under jit via jax.grad composition, not on the
+    eager tape.
+    """
+    from ..tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    # save/restore existing leaf grads: paddle.grad must not touch .grad
+    saved = [t._grad for t in inputs]
+    for t in inputs:
+        t._grad = None
+    retain = True if retain_graph is None else retain_graph
+    watch = {id(t) for t in inputs}
+    try:
+        for o, go in zip(outputs, grad_outputs):
+            backward(o, go, retain_graph=retain, watch=watch)
+        results = []
+        for t, s in zip(inputs, saved):
+            g = t._grad
+            if g is None and not allow_unused:
+                g = jnp.zeros(t.shape, t.dtype)
+            results.append(Tensor(g) if g is not None else None)
+    finally:
+        for t, s in zip(inputs, saved):
+            t._grad = s
+    return results

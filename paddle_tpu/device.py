@@ -1,0 +1,67 @@
+"""paddle.device (ref: python/paddle/device.py)."""
+from __future__ import annotations
+
+import jax
+
+from .framework import core
+from .framework.core import (set_device, get_device, is_compiled_with_tpu,
+                             is_compiled_with_cuda, is_compiled_with_xpu,
+                             TPUPlace, CPUPlace)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return len(jax.devices())
+
+
+class cuda:
+    """Compat namespace; maps to the accelerator (TPU)."""
+
+    @staticmethod
+    def device_count():
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+
+    @staticmethod
+    def synchronize(device=None):
+        # XLA dispatch is async; block on a trivial computation
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+
+class tpu(cuda):
+    pass

@@ -1,0 +1,15 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/__init__.py)."""
+from .parallel import (ParallelEnv, init_parallel_env, get_rank,
+                       get_world_size, spawn, is_initialized)
+from .collective import (ReduceOp, Group, new_group, get_group, barrier, wait,
+                         all_reduce, reduce, all_gather, all_gather_object,
+                         broadcast, scatter, alltoall, send, recv,
+                         reduce_scatter, split, collective_axis)
+from . import fleet
+from .data_parallel import DataParallel
+from . import sharding
+
+
+def launch():
+    from .launch import main
+    main()

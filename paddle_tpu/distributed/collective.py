@@ -1,0 +1,287 @@
+"""Collective ops (ref: python/paddle/distributed/collective.py →
+paddle/fluid/operators/collective/c_allreduce_op.h etc.).
+
+TPU-native: inside a mapped region (shard_map / fleet parallel step) each op
+lowers to the XLA collective (psum / all_gather / ppermute / all_to_all)
+over the named mesh axis, riding ICI.  Outside a mapped region (pure eager,
+world size 1) they are identities — matching single-process semantics.
+
+The active axis name is provided by the surrounding parallel context
+(fleet sets it when entering tensor/data-parallel regions).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from ..tensor.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    def __init__(self, rank, nranks, id=0, ranks=None, axis_name=None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name  # mesh axis this group reduces over
+
+    def is_member(self):
+        return self.rank >= 0
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(rank={self.rank}, nranks={self.nranks}, axis={self.axis_name})"
+
+
+_group_map = {}
+_default_group = None
+
+# axis-name stack installed by parallel contexts (shard_map bodies)
+_axis_stack = []
+
+
+@contextlib.contextmanager
+def collective_axis(axis_name):
+    """Install the mesh axis that collectives should reduce over; used by
+    fleet/shard_map wrappers around parallel step functions."""
+    _axis_stack.append(axis_name)
+    try:
+        yield
+    finally:
+        _axis_stack.pop()
+
+
+def _current_axis(group=None):
+    if group is not None and group.axis_name:
+        return group.axis_name
+    return _axis_stack[-1] if _axis_stack else None
+
+
+def _get_global_group():
+    global _default_group
+    if _default_group is None:
+        from .parallel import get_rank, get_world_size
+        _default_group = Group(get_rank(), max(get_world_size(), 1), 0)
+    return _default_group
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _get_global_group()
+    return _group_map.get(gid)
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    from .parallel import get_rank
+    gid = len(_group_map) + 1
+    ranks = ranks or []
+    me = get_rank()
+    rank = ranks.index(me) if me in ranks else (0 if not ranks else -1)
+    g = Group(rank, max(len(ranks), 1), gid, ranks, axis_name)
+    _group_map[gid] = g
+    return g
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor.value,
+                                              "block_until_ready"):
+        tensor.value.block_until_ready()
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        return tensor  # world of one: identity
+
+    def _ar(x):
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(x, ax)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(x, ax)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(x, ax)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(x, ax)
+        if op == ReduceOp.PROD:
+            return jnp.exp(jax.lax.psum(jnp.log(x), ax))
+        raise ValueError(op)
+    out = call(_ar, tensor, _name="c_allreduce")
+    tensor._rebind(out)
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # XLA collectives are symmetric; reduce == all_reduce with only dst using
+    # the value (the compiler DCEs unused outputs elsewhere)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        tensor_list.append(tensor.clone())
+        return tensor_list
+
+    def _ag(x):
+        return jax.lax.all_gather(x, ax)
+    gathered = call(_ag, tensor, _name="c_allgather")
+    n = gathered.shape[0]
+    from ..tensor.manipulation import unstack
+    tensor_list.extend(unstack(gathered, axis=0, num=n))
+    return tensor_list
+
+
+def all_gather_object(obj_list, obj, group=None):
+    obj_list.append(obj)
+    return obj_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        return tensor
+
+    def _bc(x):
+        # take src's value on every member: gather then index
+        return jax.lax.all_gather(x, ax)[src]
+    out = call(_bc, tensor, _name="c_broadcast")
+    tensor._rebind(out)
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        if tensor_list:
+            tensor._rebind(tensor_list[0].clone())
+        return tensor
+    from ..tensor.manipulation import stack
+
+    def _sc(stacked):
+        idx = jax.lax.axis_index(ax)
+        return jnp.take(jax.lax.all_gather(stacked, ax)[src], idx, axis=0)
+    out = call(_sc, stack(tensor_list, 0), _name="c_scatter")
+    tensor._rebind(out)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        out_tensor_list.extend(t.clone() for t in in_tensor_list)
+        return out_tensor_list
+    from ..tensor.manipulation import stack, unstack
+
+    def _a2a(x):
+        return jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                  tiled=False)
+    stacked = stack(in_tensor_list, 0)
+    out = call(_a2a, stacked, _name="c_alltoall")
+    out_tensor_list.extend(unstack(out, axis=0, num=len(in_tensor_list)))
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        _p2p_buf.append(tensor.clone())
+        return
+
+    def _send(x):
+        # point-to-point over ICI: ppermute to dst
+        n = jax.lax.axis_size(ax)
+        return jax.lax.ppermute(x, ax, [(i, dst) for i in range(n)])
+    call(_send, tensor, _name="send")
+
+
+_p2p_buf = []
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        if _p2p_buf:
+            tensor._rebind(_p2p_buf.pop(0))
+        return tensor
+
+    def _recv(x):
+        n = jax.lax.axis_size(ax)
+        return jax.lax.ppermute(x, ax, [(src, i) for i in range(n)])
+    out = call(_recv, tensor, _name="recv")
+    tensor._rebind(out)
+    return tensor
+
+
+def _c_identity(tensor, group=None):
+    return tensor
+
+
+def _c_concat(tensor, group=None):
+    ax = _current_axis(group)
+    if ax is None:
+        return tensor
+
+    def _cc(x):
+        return jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)
+    return call(_cc, tensor, _name="c_concat")
+
+
+def _c_split(tensor, group=None):
+    ax = _current_axis(group)
+    if ax is None:
+        return tensor
+
+    def _cs(x):
+        idx = jax.lax.axis_index(ax)
+        n = jax.lax.axis_size(ax)
+        sz = x.shape[-1] // n
+        return jax.lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=x.ndim - 1)
+    return call(_cs, tensor, _name="c_split")
+
+
+def _mp_allreduce(tensor, group=None):
+    return all_reduce(tensor, ReduceOp.SUM, group)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _current_axis(group)
+    if ax is None:
+        return tensor
+
+    def _rs(x):
+        return jax.lax.psum_scatter(x, ax, tiled=True)
+    out = call(_rs, tensor, _name="c_reduce_scatter")
+    tensor._rebind(out)
+    return tensor
+
+
+def split(x, num_or_sections, axis=0):
+    from ..tensor.manipulation import split as _split
+    return _split(x, num_or_sections, axis)

@@ -1,0 +1,42 @@
+"""DataParallel wrapper (ref: python/paddle/fluid/dygraph/parallel.py).
+
+The reference hooks NCCL allreduce onto gradient buckets.  Under the SPMD
+model gradients are synced by the compiler: when the train step runs under
+pjit with batch sharded over 'dp', grads of replicated params ARE the summed
+grads.  Eager single-process training needs no sync at all, so this wrapper
+is semantically transparent while keeping the reference API (scale_loss,
+no_sync, state_dict passthrough).
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,17 @@
+"""Fleet distributed API (ref: python/paddle/distributed/fleet/__init__.py).
+
+The reference's fleet orchestrates NCCL rings + meta-optimizers that rewrite
+the program (AMP/recompute/sharding/pipeline passes).  TPU-native fleet
+instead owns a jax.sharding.Mesh with axes (dp, pp, tp, sp); models built
+from meta_parallel layers carry PartitionSpec hints, and
+distributed_model/distributed_optimizer stage training through pjit so XLA
+GSPMD places every collective on ICI.
+"""
+from .base import (DistributedStrategy, Fleet, fleet, init, is_first_worker,
+                   worker_index, worker_num, get_hybrid_communicate_group,
+                   HybridCommunicateGroup, distributed_model,
+                   distributed_optimizer, UserDefinedRoleMaker,
+                   PaddleCloudRoleMaker)
+from . import meta_parallel
+from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding, get_rng_state_tracker)

@@ -1,0 +1,203 @@
+"""Fleet core (ref: python/paddle/distributed/fleet/base/fleet_base.py,
+distributed_strategy.py, topology.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ...parallel import mesh as mesh_mod
+
+
+class DistributedStrategy:
+    """ref: fleet/base/distributed_strategy.py (protobuf-backed there)."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sp_degree": 1,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fuse_all_reduce_ops = True
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class HybridCommunicateGroup:
+    """Mesh topology (ref: fleet/base/topology.py::HybridCommunicateGroup)."""
+
+    def __init__(self, strategy):
+        h = strategy.hybrid_configs
+        self.dp_degree = h.get("dp_degree", 1)
+        self.mp_degree = h.get("mp_degree", 1)
+        self.pp_degree = h.get("pp_degree", 1)
+        self.sp_degree = h.get("sp_degree", 1)
+        n_need = self.dp_degree * self.mp_degree * self.pp_degree * self.sp_degree
+        devices = jax.devices()
+        if n_need > len(devices):
+            raise ValueError(
+                f"hybrid config needs {n_need} devices, have {len(devices)}")
+        self.mesh = mesh_mod.create_mesh(self.dp_degree, self.mp_degree,
+                                         self.pp_degree, self.sp_degree,
+                                         devices)
+        mesh_mod.set_mesh(self.mesh)
+        self.global_rank = jax.process_index()
+
+    # rank/world queries (single-controller: ranks are mesh coordinates)
+    def get_data_parallel_world_size(self):
+        return self.dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self.mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self.pp_degree
+
+    def get_sequence_parallel_world_size(self):
+        return self.sp_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self.mp_degree, 1, axis_name="tp")
+
+    def get_data_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self.dp_degree, 2, axis_name="dp")
+
+    def get_pipe_parallel_group(self):
+        from ..collective import Group
+        return Group(0, self.pp_degree, 3, axis_name="pp")
+
+    def topology(self):
+        return {"dp": self.dp_degree, "mp": self.mp_degree,
+                "pp": self.pp_degree, "sp": self.sp_degree}
+
+
+_hcg = None
+
+
+def get_hybrid_communicate_group():
+    return _hcg
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    pass
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        global _hcg
+        from ..parallel import init_parallel_env
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        self._hcg = HybridCommunicateGroup(self._strategy)
+        _hcg = self._hcg
+        self._is_initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def barrier_worker(self):
+        pass
+
+    def distributed_model(self, model):
+        """Shard the model's parameters on the fleet mesh per their
+        _sharding_axes hints (set by meta_parallel layers); replicated
+        otherwise.  The returned model is the same object — GSPMD handles
+        gradient sync when the step runs under pjit."""
+        mesh_mod.shard_params(model)
+        model._is_fleet_distributed = True
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        optimizer._is_fleet_distributed = True
+        return optimizer
+
+    def state_dict(self):
+        return {}
+
+    # parameter-server style entry points (sparse path) — SURVEY §2.6
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args):
+        pass
+
+    def run_server(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)

@@ -1,0 +1,149 @@
+"""Meta-parallel layers (ref: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py).
+
+Megatron-style TP layers.  Instead of explicit c_allreduce ops, each layer
+(1) stores PartitionSpec hints on its Parameters and (2) applies
+with_sharding_constraint on activations — XLA GSPMD then materializes the
+identity/allreduce pairs of the Megatron recipe on ICI.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform
+from ...parallel import mesh as mesh_mod
+
+
+class ColumnParallelLinear(Layer):
+    """W:[in, out] sharded on out over 'tp' (ref: mp_layers.py).
+    gather_output=False keeps the activation tp-sharded for the next
+    RowParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight._sharding_axes = (None, "tp")
+        self.bias = self.create_parameter(
+            shape=[out_features], is_bias=True) if has_bias else None
+        if self.bias is not None:
+            self.bias._sharding_axes = ("tp",)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = mesh_mod.shard_constraint(out, None)  # replicate (gather)
+        else:
+            out = mesh_mod.shard_constraint(
+                out, *([None] * (len(out.shape) - 1) + ["tp"]))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W:[in, out] sharded on in over 'tp'; partial outputs summed by the
+    GSPMD-inserted allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight._sharding_axes = ("tp", None)
+        self.bias = self.create_parameter(
+            shape=[out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = mesh_mod.shard_constraint(
+                x, *([None] * (len(x.shape) - 1) + ["tp"]))
+        out = F.linear(x, self.weight, self.bias)
+        return mesh_mod.shard_constraint(out, None)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on vocab over 'tp' (ref: mp_layers.py)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight._sharding_axes = ("tp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return mesh_mod.shard_constraint(out, None)
+
+
+class ParallelCrossEntropy(Layer):
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="mean")
+
+
+class _RNGStateTracker:
+    """ref: fleet/meta_parallel/parallel_layers/random.py — named RNG streams
+    so dropout differs (or matches) across model-parallel ranks."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        from ...framework import core
+        self._states[name] = core.Generator(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        from ...framework import core
+        if name not in self._states:
+            self.add(name, np.random.randint(0, 2**31 - 1))
+        saved = core._generator
+        core._generator = self._states[name]
+        try:
+            yield
+        finally:
+            core._generator = saved
+
+
+_tracker = _RNGStateTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+class PipelineLayer(Layer):
+    """Layer-list descriptor for pipeline stages (ref: pp_layers.py).
+    Holds the full stack; the pipelined runner (parallel/pipeline.py)
+    partitions parameters across the 'pp' mesh axis at step-build time."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", **kwargs):
+        super().__init__()
+        from ...nn.layer.container import LayerList
+        self.descs = LayerList(list(layers))
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+
+    def forward(self, x):
+        for l in self.descs:
+            x = l(x)
+        return x

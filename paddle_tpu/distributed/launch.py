@@ -1,0 +1,40 @@
+"""paddle.distributed.launch (ref: python/paddle/distributed/launch.py).
+
+Single-controller SPMD: on TPU pods each HOST runs one process of the same
+script; this launcher sets the coordinator env and execs the training script
+once per host (the per-device process fan-out of the reference does not
+apply — XLA drives all local chips from one process).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--master", default=None,
+                        help="coordinator address host:port")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--gpus", default=None, help="ignored on TPU")
+    parser.add_argument("--devices", default=None)
+    parser.add_argument("script", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    if args.master:
+        os.environ["PADDLE_MASTER"] = args.master
+    os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    os.environ["PADDLE_TRAINER_ID"] = str(args.rank)
+
+    if not args.script:
+        parser.error("no training script given")
+    script = args.script[0]
+    sys.argv = args.script
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

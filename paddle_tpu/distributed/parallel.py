@@ -1,0 +1,92 @@
+"""Parallel environment (ref: python/paddle/distributed/parallel.py).
+
+The reference is multi-process NCCL (one proc per GPU).  TPU-native model is
+single-controller SPMD: one python process drives all chips through a
+jax.sharding.Mesh, and "rank"/"world size" describe positions in that mesh.
+Multi-host uses jax.distributed.initialize (one controller per host, ICI/DCN
+underneath) — see launch.py.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                       jax.process_index()))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                             jax.process_count()))
+        self.device_id = 0
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                               "127.0.0.1:6170")
+        self.trainer_endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                                self.current_endpoint
+                                                ).split(",")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_env = None
+_initialized = False
+
+
+def init_parallel_env():
+    """Initialize SPMD environment.  For multi-host pods set
+    PADDLE_MASTER/PADDLE_TRAINERS_NUM and this calls
+    jax.distributed.initialize; single host is a no-op beyond env setup."""
+    global _env, _initialized
+    if _initialized:
+        return _env
+    master = os.environ.get("PADDLE_MASTER")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if master and nprocs > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=master,
+            num_processes=nprocs,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _env = ParallelEnv()
+    _initialized = True
+    return _env
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized():
+    return _initialized
+
+
+def parallel_helper_env():
+    return _env
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """ref: python/paddle/distributed/spawn.py.  Under the SPMD model the
+    single controller already drives every chip, so spawn degenerates to one
+    invocation (parity shim for scripts written against the proc-per-GPU
+    model)."""
+    init_parallel_env()
+    result = func(*args)
+    return result

@@ -1,0 +1,41 @@
+"""Sharded (ZeRO) training (ref: python/paddle/distributed/sharding/ +
+fleet sharding meta-optimizer).
+
+TPU-native: optimizer-state sharding is a sharding-spec decision, not a
+communication rewrite.  group_sharded_parallel marks params so that the
+jitted train step places optimizer moments with a 'dp'-sharded
+NamedSharding (stage 1/2); stage 3 also shards the params themselves and
+XLA inserts the gather before use (fully-sharded data parallel).
+"""
+from __future__ import annotations
+
+from ..parallel import mesh as mesh_mod
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2**23, segment_size=2**20,
+                           sync_comm=False):
+    """level: 'os' (stage1: optimizer states), 'os_g' (stage2: +grads),
+    'p_g_os' (stage3: +params)."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    optimizer._zero_stage = stage
+    if stage >= 3:
+        for p in model.parameters():
+            # shard params along their largest axis over dp
+            shape = p.shape
+            if not shape:
+                continue
+            axis = max(range(len(shape)), key=lambda i: shape[i])
+            spec = [None] * len(shape)
+            spec[axis] = "dp"
+            p._sharding_axes = tuple(spec)
+        mesh_mod.shard_params(model)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..io.serialization import save
+    save(model.state_dict(), output + ".pdmodel.params")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

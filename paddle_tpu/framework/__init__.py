@@ -1,0 +1,15 @@
+"""paddle_tpu.framework — global state, dtypes, places, RNG."""
+from . import core
+from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, Place,
+                   get_default_dtype, set_default_dtype, seed,
+                   set_device, get_device, convert_dtype, dtype_name,
+                   is_compiled_with_tpu, is_compiled_with_cuda,
+                   is_compiled_with_xpu, Generator, default_generator)
+
+
+def in_dygraph_mode():
+    return not core.in_tracing()
+
+
+def in_dynamic_mode():
+    return not core.in_tracing()

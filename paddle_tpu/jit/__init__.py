@@ -1,0 +1,3 @@
+from .api import (to_static, not_to_static, save, load, TracedLayer,
+                  InputSpec, enable_static, disable_static)
+from . import functional

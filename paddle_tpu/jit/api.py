@@ -1,0 +1,156 @@
+"""paddle.jit API: to_static / save / load
+(ref: python/paddle/jit/__init__.py + fluid/dygraph/jit.py).
+
+to_static(layer_or_fn) returns a wrapper that stages execution through
+jax.jit: stateful Layers are functionalized (params/buffers become traced
+args), the python body traces once per input signature, then every later
+call is one XLA executable launch.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..tensor.tensor import Tensor, Parameter
+from ..nn.layer.layers import Layer
+from . import functional as fx
+
+
+class InputSpec:
+    """ref: python/paddle/static/input.py::InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = core.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _to_vals(args):
+    def strip(x):
+        return x.value if isinstance(x, Tensor) else x
+    return jax.tree_util.tree_map(strip, args,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _to_tensors(vals):
+    def wrap(x):
+        return Tensor(x) if isinstance(x, jax.Array) else x
+    return jax.tree_util.tree_map(wrap, vals)
+
+
+class TracedLayer:
+    """jit-compiled callable around a Layer or plain function."""
+
+    def __init__(self, fn, layer=None, input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jitted = {}
+
+    def _get_jitted(self, training):
+        if training not in self._jitted:
+            layer = self._layer
+
+            if layer is not None:
+                def staged(param_vals, buffer_vals, rng, arg_vals):
+                    out, new_buf = fx.functional_call(
+                        layer, param_vals, buffer_vals, arg_vals,
+                        rng_key=rng)
+                    return out, new_buf
+                self._jitted[training] = jax.jit(staged)
+            else:
+                def staged(rng, arg_vals):
+                    with fx.trace_mode(rng):
+                        args = _to_tensors(arg_vals)
+                        out = self._fn(*args)
+                    return _to_vals(out)
+                self._jitted[training] = jax.jit(staged)
+        return self._jitted[training]
+
+    def __call__(self, *args, **kwargs):
+        arg_vals = _to_vals(args)
+        rng = core.next_rng_key()
+        if self._layer is not None:
+            pv, bv = fx.param_arrays(self._layer)
+            jfn = self._get_jitted(self._layer.training)
+            out, new_buf = jfn(pv, bv, rng, arg_vals)
+            fx.write_back(self._layer, buffer_vals=new_buf)
+        else:
+            jfn = self._get_jitted(True)
+            out = jfn(rng, arg_vals)
+        return _to_tensors(out)
+
+    # pass-throughs so a wrapped layer still acts like one
+    def __getattr__(self, name):
+        if self._layer is not None:
+            return getattr(self._layer, name)
+        return getattr(self._fn, name)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            return TracedLayer(fn.forward, layer=fn, input_spec=input_spec)
+        return TracedLayer(fn, layer=None, input_spec=input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+_JIT_SUFFIX = ".pdmodel"
+_PARAM_SUFFIX = ".pdiparams"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer (or TracedLayer): params + buffers + architecture
+    pickle (ref: paddle.jit.save producing __model__ + params).  The XLA
+    executable itself is rebuilt at load (compile cache makes this fast)."""
+    target = layer._layer if isinstance(layer, TracedLayer) else layer
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    params, buffers = fx.collect_state(target)
+    state = {k: np.asarray(jax.device_get(v.value))
+             for k, v in {**params, **buffers}.items()}
+    with open(path + _PARAM_SUFFIX, "wb") as f:
+        pickle.dump(state, f)
+    meta = {"class": type(target).__name__,
+            "input_spec": [(s.shape, str(s.dtype)) for s in (input_spec or [])],
+            "param_names": list(params.keys()),
+            "buffer_names": list(buffers.keys())}
+    with open(path + _JIT_SUFFIX, "wb") as f:
+        pickle.dump({"meta": meta, "layer": target}, f)
+
+
+def load(path, **configs):
+    with open(path + _JIT_SUFFIX, "rb") as f:
+        blob = pickle.load(f)
+    layer = blob["layer"]
+    with open(path + _PARAM_SUFFIX, "rb") as f:
+        state = pickle.load(f)
+    layer.set_state_dict({k: Tensor(v) for k, v in state.items()})
+    return TracedLayer(layer.forward, layer=layer)
+
+
+def enable_static():
+    from ..static import _set_static_mode
+    _set_static_mode(True)
+
+
+def disable_static():
+    from ..static import _set_static_mode
+    _set_static_mode(False)

@@ -1,0 +1,99 @@
+"""Functionalization bridge: run a stateful Layer under a jax trace.
+
+This is the TPU-native replacement for the reference's dygraph→static
+ProgramTranslator (ref: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py).  The reference AST-rewrites python into a
+ProgramDesc; we instead swap each Parameter/buffer payload for a tracer and
+let jax trace the ordinary python forward — no source rewriting, and the
+result is XLA HLO directly.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+
+import jax
+
+from ..framework import core
+from ..tensor.tensor import Tensor
+
+
+def collect_state(layer):
+    """(param_name->Tensor, buffer_name->Tensor) in deterministic order."""
+    params = OrderedDict(layer.named_parameters())
+    buffers = OrderedDict(layer.named_buffers())
+    return params, buffers
+
+
+@contextlib.contextmanager
+def trace_mode(rng_key=None):
+    """Disable the eager tape + install a traced RNG key for the duration."""
+    prev_grad = core.grad_enabled()
+    core.set_grad_enabled_flag(False)
+    core.set_tracing(True)
+    prev_key = core.get_trace_key()
+    if rng_key is not None:
+        core.set_trace_key(rng_key)
+    try:
+        yield
+    finally:
+        core.set_grad_enabled_flag(prev_grad)
+        core.set_tracing(False)
+        core.set_trace_key(prev_key)
+
+
+@contextlib.contextmanager
+def swapped_state(layer, param_vals, buffer_vals):
+    """Temporarily replace parameter/buffer payloads with given jax values
+    (typically tracers).  On exit, restores originals; the possibly-mutated
+    buffer values are readable via read_buffers() inside the block."""
+    params, buffers = collect_state(layer)
+    saved_p = {k: p.value for k, p in params.items()}
+    saved_b = {k: b.value for k, b in buffers.items()}
+    try:
+        for k, p in params.items():
+            if k in param_vals:
+                p.value = param_vals[k]
+        for k, b in buffers.items():
+            if k in buffer_vals:
+                b.value = buffer_vals[k]
+        yield params, buffers
+    finally:
+        for k, p in params.items():
+            p.value = saved_p[k]
+        for k, b in buffers.items():
+            b.value = saved_b[k]
+
+
+def functional_call(layer, param_vals, buffer_vals, args, kwargs=None,
+                    rng_key=None):
+    """Run layer(*args) with state swapped in; returns (output_values,
+    new_buffer_values).  Buffer mutation (e.g. BN running stats) is captured
+    functionally by reading back the swapped tensors."""
+    kwargs = kwargs or {}
+    with trace_mode(rng_key):
+        with swapped_state(layer, param_vals, buffer_vals) as (params, buffers):
+            out = layer(*args, **kwargs)
+            new_buffers = {k: b.value for k, b in buffers.items()}
+
+    def strip(x):
+        return x.value if isinstance(x, Tensor) else x
+    out_vals = jax.tree_util.tree_map(
+        strip, out, is_leaf=lambda x: isinstance(x, Tensor))
+    return out_vals, new_buffers
+
+
+def param_arrays(layer):
+    params, buffers = collect_state(layer)
+    return ({k: p.value for k, p in params.items()},
+            {k: b.value for k, b in buffers.items()})
+
+
+def write_back(layer, param_vals=None, buffer_vals=None):
+    params, buffers = collect_state(layer)
+    if param_vals:
+        for k, v in param_vals.items():
+            params[k].value = v
+    if buffer_vals:
+        for k, v in buffer_vals.items():
+            buffers[k].value = v

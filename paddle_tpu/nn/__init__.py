@@ -1,0 +1,12 @@
+"""paddle_tpu.nn (ref: python/paddle/nn/__init__.py)."""
+from . import functional
+from . import initializer
+from . import utils
+from .layer import *  # noqa: F401,F403
+from .layer import Layer
+from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+                   GradientClipByValue, GradientClipByNorm,
+                   GradientClipByGlobalNorm)
+from .decode import BeamSearchDecoder, dynamic_decode
+from .utils import weight_norm, remove_weight_norm, spectral_norm
+from ..tensor.creation import diag_embed  # paddle.nn exposes diag_embed

@@ -1,0 +1,145 @@
+"""Decoding helpers: BeamSearchDecoder + dynamic_decode
+(ref: python/paddle/nn/decode.py).
+
+Eager greedy/beam loop; data-dependent termination runs on host (the
+reference's while_op does the same through the executor).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..tensor import manipulation as manip
+from ..tensor import creation
+from . import functional as F
+
+
+class Decoder:
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        shape = x.shape
+        expanded = manip.unsqueeze(x, [1])
+        tiled = manip.tile(expanded, [1, beam_size] + [1] * (len(shape) - 1))
+        return manip.reshape(tiled, [-1] + shape[1:])
+
+    def _merge_batch_beams(self, x):
+        return manip.reshape(x, [-1] + x.shape[2:])
+
+    def _split_batch_beams(self, x):
+        return manip.reshape(x, [-1, self.beam_size] + x.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        sample = states[0] if isinstance(states, (tuple, list)) else states
+        batch = sample.shape[0]
+        self.batch_size = batch
+        start = creation.full([batch, self.beam_size], self.start_token,
+                              "int64")
+        log_probs = creation.full([batch, self.beam_size], -1e9, "float32")
+        log_probs = Tensor(log_probs.value.at[:, 0].set(0.0))
+        finished = creation.zeros([batch, self.beam_size], "bool")
+
+        def tile(s):
+            return self.tile_beam_merge_with_batch(s, self.beam_size)
+        if isinstance(states, (tuple, list)):
+            states = tuple(tile(s) for s in states)
+        else:
+            states = tile(states)
+        init_inputs = start
+        return init_inputs, (states, log_probs, finished), finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states, log_probs, finished = states
+        inp = inputs
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        inp = self._merge_batch_beams(inp)
+        cell_out, next_cell_states = self.cell(inp, cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        V = cell_out.shape[-1]
+        logits = manip.reshape(cell_out, [-1, self.beam_size, V])
+        step_lp = F.log_softmax(logits, axis=-1)
+
+        lv = step_lp.value + log_probs.value[..., None]
+        fin = finished.value
+        # finished beams only extend with end_token at prob 0
+        mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        lv = jnp.where(fin[..., None], log_probs.value[..., None] + mask, lv)
+        flat = lv.reshape(self.batch_size, -1)
+        import jax
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)
+        beam_idx = top_idx // V
+        token_idx = top_idx % V
+        new_finished = jnp.take_along_axis(fin, beam_idx, axis=1) | \
+            (token_idx == self.end_token)
+
+        def gather_state(s):
+            sv = s.value if isinstance(s, Tensor) else s
+            sv = sv.reshape(self.batch_size, self.beam_size, *sv.shape[1:])
+            g = jnp.take_along_axis(
+                sv, beam_idx.reshape(self.batch_size, self.beam_size,
+                                     *([1] * (sv.ndim - 2))), axis=1)
+            return Tensor(g.reshape(-1, *sv.shape[2:]))
+        if isinstance(next_cell_states, (tuple, list)):
+            next_cell_states = tuple(gather_state(s) for s in next_cell_states)
+        else:
+            next_cell_states = gather_state(next_cell_states)
+
+        outputs = Tensor(token_idx.astype(jnp.int32))
+        next_states = (next_cell_states, Tensor(top_lp),
+                       Tensor(new_finished))
+        return outputs, next_states, outputs, Tensor(new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    inputs, states, finished = decoder.initialize(inits)
+    outputs_list = []
+    seq_len = None
+    for t in range(int(max_step_num)):
+        out, states, next_inputs, finished = decoder.step(t, inputs, states,
+                                                          **kwargs)
+        outputs_list.append(out)
+        inputs = next_inputs
+        if bool(np.all(finished.numpy())):
+            break
+    outputs = manip.stack(outputs_list, axis=0 if output_time_major else 1)
+    outputs, final_states = decoder.finalize(outputs, states, seq_len)
+    if return_length:
+        lengths = Tensor(np.full(outputs.shape[0], len(outputs_list)))
+        return outputs, final_states, lengths
+    return outputs, final_states

@@ -1,0 +1,30 @@
+"""paddle_tpu.nn.functional (ref: python/paddle/nn/functional/__init__.py)."""
+from .activation import (relu, relu_, relu6, sigmoid, tanh, silu, log_sigmoid,
+                         tanhshrink, softsign, gelu, elu, elu_, selu,
+                         leaky_relu, prelu, rrelu, hardshrink, hardtanh,
+                         hardsigmoid, hardswish, swish, mish, softplus,
+                         softshrink, thresholded_relu, maxout, softmax,
+                         softmax_, log_softmax, gumbel_softmax, glu)
+from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,
+                     pad, zeropad2d, interpolate, upsample, bilinear,
+                     cosine_similarity, pairwise_distance, one_hot, embedding,
+                     label_smooth, unfold, fold, pixel_shuffle,
+                     pixel_unshuffle, channel_shuffle)
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+                   conv3d_transpose)
+from .norm import (normalize, batch_norm, layer_norm, group_norm,
+                   instance_norm, local_response_norm, rms_norm)
+from .pooling import (max_pool1d, max_pool2d, max_pool3d, avg_pool1d,
+                      avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
+                      adaptive_avg_pool2d, adaptive_avg_pool3d,
+                      adaptive_max_pool1d, adaptive_max_pool2d,
+                      adaptive_max_pool3d)
+from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
+                   mse_loss, l1_loss, smooth_l1_loss, binary_cross_entropy,
+                   binary_cross_entropy_with_logits, kl_div,
+                   margin_ranking_loss, hinge_embedding_loss,
+                   cosine_embedding_loss, triplet_margin_loss, ctc_loss,
+                   square_error_cost, log_loss, sigmoid_focal_loss,
+                   npair_loss, dice_loss)
+from .attention import scaled_dot_product_attention, flash_attention
+from .extension import diag_embed, sequence_mask, temporal_shift

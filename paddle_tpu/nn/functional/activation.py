@@ -1,0 +1,175 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py).
+
+All map to jax.nn / jnp primitives; XLA fuses them into surrounding matmuls
+(the reference needs hand-fused CUDA kernels for that).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+
+
+def _u(jfn, opname):
+    def op(x, name=None):
+        return call(jfn, x, _name=opname)
+    op.__name__ = opname
+    return op
+
+
+relu = _u(jax.nn.relu, "relu")
+relu6 = _u(jax.nn.relu6, "relu6")
+sigmoid = _u(jax.nn.sigmoid, "sigmoid")
+tanh = _u(jnp.tanh, "tanh")
+silu = _u(jax.nn.silu, "silu")
+log_sigmoid = _u(jax.nn.log_sigmoid, "log_sigmoid")
+tanhshrink = _u(lambda x: x - jnp.tanh(x), "tanhshrink")
+softsign = _u(jax.nn.soft_sign, "softsign")
+
+
+def relu_(x):
+    return x._rebind(relu(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return call(lambda a: jax.nn.gelu(a, approximate=approximate), x, _name="gelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return call(lambda a: jax.nn.elu(a, alpha=alpha), x, _name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    # clamp the expm1 operand so the untaken branch can't overflow to inf
+    # (0 * inf = NaN would poison the vjp for large positive inputs)
+    return call(lambda a: scale * jnp.where(
+        a > 0, a, alpha * jnp.expm1(jnp.minimum(a, 0.0))), x, _name="selu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return call(lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope),
+                x, _name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _p(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return call(_p, x, weight, _name="prelu")
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=False, name=None):
+    from ...framework import core
+    def _r(a):
+        if training:
+            noise = jax.random.uniform(core.next_rng_key(), a.shape, a.dtype,
+                                       lower, upper)
+        else:
+            noise = (lower + upper) / 2.0
+        return jnp.where(a >= 0, a, noise * a)
+    return call(_r, x, _name="rrelu")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return call(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                _name="hardshrink")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return call(lambda a: jnp.clip(a, min, max), x, _name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return call(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x,
+                _name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return call(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
+                _name="hardswish")
+
+
+def swish(x, name=None):
+    return call(jax.nn.silu, x, _name="swish")
+
+
+def mish(x, name=None):
+    return call(jax.nn.mish, x, _name="mish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return call(lambda a: jnp.where(beta * a > threshold, a,
+                                    jnp.logaddexp(beta * a, 0.0) / beta),
+                x, _name="softplus")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return call(lambda a: jnp.where(a > threshold, a - threshold,
+                                    jnp.where(a < -threshold, a + threshold, 0.0)),
+                x, _name="softshrink")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return call(lambda a: jnp.where(a > threshold, a, 0.0), x,
+                _name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _m(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = (a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:])
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return call(_m, x, _name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import core
+    dt = core.convert_dtype(dtype) if dtype else None
+    def _s(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=int(axis))
+    return call(_s, x, _name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import core
+    dt = core.convert_dtype(dtype) if dtype else None
+    def _ls(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return call(_ls, x, _name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import core
+    def _gs(a):
+        g = jax.random.gumbel(core.next_rng_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return call(_gs, x, _name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    return call(lambda a: jax.nn.glu(a, axis=axis), x, _name="glu")

@@ -1,0 +1,57 @@
+"""Attention functionals — the TPU replacement for the reference's
+fused_attention CUDA kernels (ref: fluid/operators/fused/fused_attention_op.cu).
+
+``flash_attention`` routes to the Pallas TPU kernel (ops/pallas/flash_attn.py)
+when running on TPU with suitable shapes, else to a fused XLA softmax path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+
+
+def _sdpa_ref(q, k, v, mask=None, scale=None, causal=False, dropout_p=0.0):
+    # q,k,v: [B, N, H, D] (paddle convention: batch, seq, heads, head_dim)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # [B,H,N,D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if causal:
+        n, m = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((n, m), bool), k=m - n)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    if attn_mask is not None:
+        return call(lambda q, k, v, m: _sdpa_ref(q, k, v, m, causal=is_causal),
+                    query, key, value, attn_mask, _name="sdpa")
+    return call(lambda q, k, v: _sdpa_ref(q, k, v, None, causal=is_causal),
+                query, key, value, _name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    """Pallas flash attention on TPU; XLA-fused reference path elsewhere."""
+    from ...ops.pallas import flash_attn
+
+    def _fa(q, k, v):
+        return flash_attn.flash_attention(q, k, v, causal=causal)
+
+    out = call(_fa, query, key, value, _name="flash_attention")
+    if return_softmax:
+        return out, None
+    return out

@@ -1,0 +1,303 @@
+"""Common functionals: linear, dropout, pad, interpolate, embedding...
+(ref: python/paddle/nn/functional/common.py, input.py)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework import core
+from ...ops.dispatch import call
+from ...tensor.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """x @ W + b with W stored [in, out] (ref matmul_v2 + elementwise_add;
+    single MXU matmul on TPU, bias add fused by XLA)."""
+    if bias is None:
+        return call(lambda a, w: a @ w, x, weight, _name="linear")
+    return call(lambda a, w, b: a @ w + b, x, weight, bias, _name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training:
+        if mode == "downscale_in_infer" and p > 0.0:
+            return call(lambda a: a * (1.0 - p), x, _name="dropout_infer")
+        return call(lambda a: a, x, _name="dropout_noop")
+    if p == 0.0:
+        return call(lambda a: a, x, _name="dropout_noop")
+    def _d(a):
+        if axis is None:
+            mask_shape = a.shape
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            mask_shape = tuple(s if i in axes else 1
+                               for i, s in enumerate(a.shape))
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(core.next_rng_key(), keep, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(mask, a / keep, 0.0).astype(a.dtype)
+        return jnp.where(mask, a, 0.0).astype(a.dtype)
+    return call(_d, x, _name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return call(lambda a: a, x, _name="alpha_dropout_noop")
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    def _ad(a):
+        keep = 1.0 - p
+        q = 1.0 - keep
+        A = (keep + alpha_p ** 2 * keep * q) ** -0.5
+        B = -A * alpha_p * q
+        mask = jax.random.bernoulli(core.next_rng_key(), keep, a.shape)
+        return (A * jnp.where(mask, a, alpha_p) + B).astype(a.dtype)
+    return call(_ad, x, _name="alpha_dropout")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    def _pad(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # paddle full-form: [before0, after0, before1, after1, ...] is NOT
+            # the layout — full form is per-dim pairs in dim order
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial form applies to trailing spatial dims, last-dim-first
+            widths = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            k = len(pad) // 2
+            dims = spatial[-k:][::-1]
+            for i, d in enumerate(dims):
+                widths[d] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return call(_pad, x, _name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    mode = mode.lower()
+    def _interp(a):
+        cf = data_format.startswith("NC")
+        spatial_in = a.shape[2:] if cf else a.shape[1:-1]
+        if size is not None:
+            sz = size.tolist() if isinstance(size, Tensor) else size
+            sz = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in
+                  (sz if isinstance(sz, (list, tuple)) else [sz])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial_in)
+            sz = [int(s * f) for s, f in zip(spatial_in, sf)]
+        if cf:
+            out_shape = a.shape[:2] + tuple(sz)
+        else:
+            out_shape = (a.shape[0],) + tuple(sz) + (a.shape[-1],)
+        jmode = {"nearest": "nearest", "bilinear": "linear",
+                 "trilinear": "linear", "linear": "linear",
+                 "bicubic": "cubic", "area": "linear"}[mode]
+        if jmode == "nearest" or not align_corners:
+            return jax.image.resize(a, out_shape, method=jmode).astype(a.dtype)
+        # align_corners=True linear: gather-based implementation
+        out = a
+        sp_axes = list(range(2, a.ndim)) if cf else list(range(1, a.ndim - 1))
+        for ax, s_out in zip(sp_axes, sz):
+            s_in = out.shape[ax]
+            if s_out == s_in:
+                continue
+            if s_out == 1 or s_in == 1:
+                idx = jnp.zeros(s_out)
+            else:
+                idx = jnp.linspace(0.0, s_in - 1, s_out)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, s_in - 1)
+            w = (idx - lo).astype(a.dtype)
+            shape = [1] * out.ndim
+            shape[ax] = s_out
+            w = w.reshape(shape)
+            out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                   + jnp.take(out, hi, axis=ax) * w)
+        return out.astype(a.dtype)
+    return call(_interp, x, _name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bl(a, b, w, *bs):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bs:
+            out = out + bs[0]
+        return out
+    if bias is not None:
+        return call(_bl, x1, x2, weight, bias, _name="bilinear")
+    return call(_bl, x1, x2, weight, _name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cs(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return call(_cs, x1, x2, _name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def _pd(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1,
+                                 keepdims=keepdim), 1.0 / p)
+    return call(_pd, x, y, _name="pairwise_distance")
+
+
+def one_hot(x, num_classes, name=None):
+    return call(lambda i: jax.nn.one_hot(i, num_classes,
+                                         dtype=core.get_default_dtype()),
+                x, _name="one_hot")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of the table (ref: fluid/operators/lookup_table_v2_op).
+    padding_idx rows get zero gradient via a mask on the table."""
+    def _emb(i, w):
+        if padding_idx is not None:
+            pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (jnp.arange(w.shape[0]) != pid)[:, None].astype(w.dtype)
+            w = w * mask
+        return jnp.take(w, i, axis=0)
+    return call(_emb, x, weight, _name="embedding")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return call(_ls, label, prior_dist, _name="label_smooth")
+    return call(_ls, label, _name="label_smooth")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: fluid/operators/unfold_op)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    if isinstance(paddings, int):
+        p = (paddings,) * 4
+    elif len(paddings) == 2:
+        p = (paddings[0], paddings[1], paddings[0], paddings[1])
+    else:
+        p = tuple(paddings)
+
+    def _uf(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding="VALID",
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * k[0] * k[1], oh * ow)
+    return call(_uf, x, _name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    out_sz = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = (paddings, paddings) if isinstance(paddings, int) else tuple(paddings)[:2]
+
+    def _fold(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        H = out_sz[0] + 2 * p[0]
+        W = out_sz[1] + 2 * p[1]
+        oh = (H - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (W - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, H, W), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a[:, :, i, j]
+                rows = jnp.arange(oh) * s[0] + i * d[0]
+                cols = jnp.arange(ow) * s[1] + j * d[1]
+                out = out.at[:, :, rows[:, None], cols[None, :]].add(patch)
+        return out[:, :, p[0]:H - p[0], p[1]:W - p[1]]
+    return call(_fold, x, _name="fold")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def _ps(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return call(_ps, x, _name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def _pu(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+            return a.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+    return call(_pu, x, _name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _csh(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(n, c, h, w)
+    return call(_csh, x, _name="channel_shuffle")

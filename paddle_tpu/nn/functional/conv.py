@@ -1,0 +1,165 @@
+"""Convolutions via lax.conv_general_dilated (ref: fluid/operators/conv_op.cc,
+conv_cudnn_op.cu).  One XLA primitive covers 1/2/3-D, groups, dilation and
+transpose — the MXU does the work; no cuDNN-style algo search needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n, strides=None, dilations=None):
+    """Normalize paddle padding spec to lax padding list or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dn(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return (("NHWC", "HWIO", "NHWC") if channel_last
+                else ("NCHW", "OIHW", "NCHW"))
+    return (("NDHWC", "DHWIO", "NDHWC") if channel_last
+            else ("NCDHW", "OIDHW", "NCDHW"))
+
+
+def _conv_nd(nd, x, weight, bias, stride, padding, dilation, groups,
+             data_format, opname):
+    channel_last = not data_format.startswith("NC")
+    s = _tup(stride, nd)
+    d = _tup(dilation, nd)
+    pad = _padding(padding, nd)
+    dn = _dn(nd, channel_last)
+
+    def _conv(a, w, *b):
+        # paddle weights are [out_c, in_c/groups, *k] (OIHW family); for
+        # channel-last lax specs transpose to match
+        if channel_last:
+            perm = list(range(2, 2 + nd)) + [1, 0]
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=s, padding=pad, rhs_dilation=d,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+    if bias is not None:
+        return call(_conv, x, weight, bias, _name=opname)
+    return call(_conv, x, weight, _name=opname)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups,
+                    fmt, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, "conv3d")
+
+
+def _conv_transpose_nd(nd, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, output_size, data_format, opname):
+    channel_last = not data_format.startswith("NC")
+    s = _tup(stride, nd)
+    d = _tup(dilation, nd)
+    op_pad = _tup(output_padding, nd) if output_padding else (0,) * nd
+    pad = _padding(padding, nd) if not isinstance(padding, str) else padding
+
+    def _convt(a, w, *b):
+        # weight layout [in_c, out_c/groups, *k] (paddle transpose-conv)
+        # implement as gradient of forward conv: lax.conv_transpose
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            k = w.shape[2:]
+            pads = [(d[i] * (k[i] - 1) - pad[i][0],
+                     d[i] * (k[i] - 1) - pad[i][1] + op_pad[i])
+                    for i in range(nd)]
+        # grouped transpose conv: split along channel groups
+        if channel_last:
+            a_ncx = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ncx = a
+        in_c = a_ncx.shape[1]
+        outs = []
+        gsize = in_c // groups
+        w_g = jnp.reshape(w, (groups, gsize) + w.shape[1:])
+        for g in range(groups):
+            ag = a_ncx[:, g * gsize:(g + 1) * gsize]
+            wg = w_g[g]  # [gsize, out_c/groups, *k]
+            # lhs dilation implements the stride of transpose conv
+            out = jax.lax.conv_general_dilated(
+                ag, jnp.flip(wg, axis=tuple(range(2, 2 + nd))).swapaxes(0, 1),
+                window_strides=(1,) * nd, padding=pads, lhs_dilation=s,
+                rhs_dilation=d, dimension_numbers=_dn(nd, False))
+            outs.append(out)
+        out = jnp.concatenate(outs, axis=1) if groups > 1 else outs[0]
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * nd)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    if bias is not None:
+        return call(_convt, x, weight, bias, _name=opname)
+    return call(_convt, x, weight, _name=opname)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose_nd(1, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, output_size,
+                              fmt, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(2, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, output_size,
+                              data_format, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(3, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, output_size,
+                              data_format, "conv3d_transpose")

@@ -1,0 +1,40 @@
+"""Extension functionals (ref: python/paddle/nn/functional/extension.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+from ...tensor.creation import diag_embed  # re-export
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...framework import core
+    dt = core.convert_dtype(dtype)
+    def _sm(lengths):
+        m = maxlen if maxlen is not None else int(lengths.max())
+        return (jnp.arange(m)[None, :] < lengths[..., None]).astype(dt)
+    if maxlen is None:
+        # data-dependent length: evaluate eagerly
+        from ...tensor.tensor import Tensor
+        lengths = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        m = int(lengths.max())
+        return Tensor((jnp.arange(m)[None, :]
+                       < lengths[..., None]).astype(dt))
+    return call(_sm, x, _name="sequence_mask")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def _ts(a):
+        n, c, h, w = a.shape
+        b = n // seg_num
+        a = a.reshape(b, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold],
+                                jnp.zeros_like(a[:, :1, :fold])], axis=1)
+        mid = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]),
+                               a[:, :-1, fold:2 * fold]], axis=1)
+        rest = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, mid, rest], axis=2)
+        return out.reshape(n, c, h, w)
+    return call(_ts, x, _name="temporal_shift")

@@ -1,0 +1,305 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py,
+fluid/operators/softmax_with_cross_entropy_op).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+from ...tensor.tensor import Tensor
+
+
+def _reduce(out, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(out) / jnp.maximum(weight_sum, 1e-12)
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    """Fused log-softmax + NLL (ref: softmax_with_cross_entropy CUDA kernel —
+    here one jnp expression XLA fuses on-chip)."""
+    def _ce(logits, lbl, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+            if w:
+                cw = jnp.sum(w[0] * lbl, axis=axis)
+                loss = loss * cw
+            return _reduce(loss, reduction)
+        lbl_idx = lbl
+        if lbl_idx.ndim == logp.ndim:
+            lbl_idx = jnp.squeeze(lbl_idx, axis=axis)
+        lbl_idx = lbl_idx.astype(jnp.int32)
+        valid = lbl_idx != ignore_index
+        safe = jnp.where(valid, lbl_idx, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis % logp.ndim), axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis % logp.ndim)
+        if w:
+            cw = jnp.take(w[0], safe)
+            loss = loss * cw
+            wsum = jnp.sum(jnp.where(valid, cw, 0.0))
+        else:
+            wsum = jnp.sum(valid.astype(loss.dtype))
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(wsum, 1e-12)
+        return _reduce(loss, reduction)
+    args = [weight] if weight is not None else []
+    return call(_ce, input, label, *args, _name="cross_entropy")
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    def _f(logp, lbl, *w):
+        ax = 1 if logp.ndim > 1 else 0
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
+        loss = -jnp.squeeze(picked, ax)
+        if w:
+            cw = jnp.take(w[0], safe)
+            loss = loss * cw
+            wsum = jnp.sum(jnp.where(valid, cw, 0.0))
+        else:
+            wsum = jnp.sum(valid.astype(loss.dtype))
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(wsum, 1e-12)
+        return _reduce(loss, reduction)
+    args = [weight] if weight is not None else []
+    return call(_f, input, label, *args, _name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return call(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                input, label, _name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return call(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                input, label, _name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return call(_sl1, input, label, _name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def _bce(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [weight] if weight is not None else []
+    return call(_bce, input, label, *args, _name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def _bcel(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)) with pos_weight support
+        log_sig_pos = -jax.nn.softplus(-z)
+        log_sig_neg = -z - jax.nn.softplus(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig_pos + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig_pos + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [a for a in (weight, pos_weight) if a is not None]
+    return call(_bcel, logit, label, *args,
+                _name="binary_cross_entropy_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _kl(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return call(_kl, input, label, _name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def _mr(a, b, y):
+        loss = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+    return call(_mr, input, other, label, _name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def _he(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(loss, reduction)
+    return call(_he, input, label, _name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def _cel(a, b, y):
+        cos = (jnp.sum(a * b, -1)
+               / jnp.maximum(jnp.linalg.norm(a, axis=-1)
+                             * jnp.linalg.norm(b, axis=-1), 1e-12))
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return call(_cel, input1, input2, label, _name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _tm(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     -1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(d_pos - d_neg + margin, 0.0), reduction)
+    return call(_tm, input, positive, negative, _name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha-recursion in log space, vectorized with
+    lax.scan over time (ref: fluid/operators/warpctc_op — no warp-ctc dep)."""
+    def _ctc(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] log-softmax already applied by caller per paddle API?
+        # paddle expects raw logits then log_softmax internally
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended label sequence with blanks
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lbl)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), a[:, :-1]], 1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), a[:, :-2]], 1)
+            a2 = jnp.where(same_as_prev2, neg_inf, a2)
+            merged = jnp.logaddexp(jnp.logaddexp(a, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def masked_step(carry, inp):
+            alpha, t = carry
+            lp_t = inp
+            new_alpha, _ = step(alpha, lp_t)
+            keep = (t + 1) < in_len  # [B]
+            alpha = jnp.where(keep[:, None], new_alpha, alpha)
+            return (alpha, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.zeros((), jnp.int32)),
+                                     lp[1:])
+        S_end = 2 * lbl_len.astype(jnp.int32)  # index of last blank
+        last1 = jnp.take_along_axis(alpha, S_end[:, None], axis=1)[:, 0]
+        last2 = jnp.take_along_axis(alpha, jnp.maximum(S_end - 1, 0)[:, None],
+                                    axis=1)[:, 0]
+        ll = jnp.logaddexp(last1, last2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1))
+        return _reduce(loss, reduction)
+    return call(_ctc, log_probs, labels, input_lengths, label_lengths,
+                _name="ctc_loss")
+
+
+def square_error_cost(input, label):
+    return call(lambda a, b: jnp.square(a - b), input, label,
+                _name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def _ll(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1 - y) * jnp.log(1 - p + epsilon))
+    return call(_ll, input, label, _name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _fl(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [normalizer] if normalizer is not None else []
+    return call(_fl, logit, label, *args, _name="sigmoid_focal_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _np(a, p, y):
+        B = a.shape[0]
+        sim = a @ p.T
+        y = y.reshape(-1)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.sum(same * logp, axis=1).mean()
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / (2 * B)
+        return xent + reg
+    return call(_np, anchor, positive, labels, _name="npair_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def _dice(p, y):
+        y1 = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return call(_dice, input, label, _name="dice_loss")
+
+
+def mbce_loss(*a, **k):
+    raise NotImplementedError

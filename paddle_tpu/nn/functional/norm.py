@@ -1,0 +1,167 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py,
+fluid/operators/{batch_norm,layer_norm,group_norm,instance_norm}_op).
+XLA fuses the reduce + scale + shift; no hand-written Welford kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+from ...tensor.tensor import Tensor
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _n(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return call(_n, x, _name="normalize")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def _bn(a, rm, rv, *wb):
+        axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
+        if use_batch_stats:
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        if wb:
+            w, b = wb
+            out = out * w.reshape(shape) + b.reshape(shape)
+        if use_batch_stats:
+            # expose batch stats so the running-stat update reuses them
+            # instead of re-reducing the activation
+            return out, mean, var
+        return out
+
+    args = ([weight, bias] if weight is not None else [])
+    if use_batch_stats:
+        out, mean_t, var_t = call(_bn, x, running_mean, running_var, *args,
+                                  _name="batch_norm")
+        if isinstance(running_mean, Tensor):
+            n = 1
+            for i, s in enumerate(x.shape):
+                if i != (ch_axis % x.ndim):
+                    n *= s
+            unbiased = var_t.value * n / max(n - 1, 1)
+            running_mean.value = (momentum * running_mean.value
+                                  + (1 - momentum) * mean_t.value)
+            running_var.value = (momentum * running_var.value
+                                 + (1 - momentum) * unbiased)
+    else:
+        out = call(_bn, x, running_mean, running_var, *args,
+                   _name="batch_norm")
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def _ln(a, *wb):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            w = wb[0].reshape(a.shape[a.ndim - nd:])
+            out = out * w
+            if len(wb) > 1:
+                out = out + wb[1].reshape(a.shape[a.ndim - nd:])
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return call(_ln, x, *args, _name="layer_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def _gn(a, *wb):
+        if data_format.startswith("NC"):
+            n, c = a.shape[:2]
+            g = a.reshape(n, num_groups, c // num_groups, *a.shape[2:])
+            axes = tuple(range(2, g.ndim))
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            if wb:
+                shape = [1] * a.ndim
+                shape[1] = c
+                out = out * wb[0].reshape(shape)
+                if len(wb) > 1:
+                    out = out + wb[1].reshape(shape)
+            return out
+        n, c = a.shape[0], a.shape[-1]
+        g = a.reshape(n, *a.shape[1:-1], num_groups, c // num_groups)
+        axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        if wb:
+            out = out * wb[0]
+            if len(wb) > 1:
+                out = out + wb[1]
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return call(_gn, x, *args, _name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def _in(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        if wb:
+            shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return call(_in, x, *args, _name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _lrn(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        # sum over a window along channel axis
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (pad_lo, pad_hi)
+        sq = jnp.pad(sq, pads)
+        windows = [jax.lax.slice_in_dim(sq, i, i + a.shape[ch_axis],
+                                        axis=ch_axis) for i in range(size)]
+        s = sum(windows)
+        return a / jnp.power(k + alpha * s, beta)
+    return call(_lrn, x, _name="local_response_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (modern LLM staple; used by the flagship GPT model)."""
+    def _rms(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = [weight] if weight is not None else []
+    return call(_rms, x, *args, _name="rms_norm")

@@ -1,0 +1,190 @@
+"""Pooling via lax.reduce_window (ref: fluid/operators/pool_op).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+from .conv import _tup, _padding
+
+
+def _pool_nd(nd, x, kernel, stride, padding, mode, ceil_mode, exclusive,
+             data_format, opname):
+    channel_last = not data_format.startswith("NC")
+    k = _tup(kernel, nd)
+    s = _tup(stride if stride is not None else kernel, nd)
+    pad = _padding(padding, nd)
+
+    def _pool(a):
+        if channel_last:
+            dims = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+        else:
+            dims = (1, 1) + k
+            strides = (1, 1) + s
+            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+        if isinstance(pads, str):
+            pads = jax.lax.padtype_to_pads(a.shape, dims, strides, pads)
+        if ceil_mode:
+            # extend padding on the high side so the last partial window counts
+            pads = list(pads)
+            sp_off = 2 if not channel_last else 1
+            for i in range(nd):
+                ax = sp_off + i
+                eff = a.shape[ax] + pads[ax][0] + pads[ax][1]
+                rem = (eff - dims[ax]) % strides[ax]
+                if rem != 0:
+                    pads[ax] = (pads[ax][0], pads[ax][1] + strides[ax] - rem)
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, dims, strides,
+                                         pads)
+        ones = jnp.ones_like(a)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
+        if exclusive:
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                           strides, pads)
+        else:
+            counts = float(np.prod(k))
+        return summed / counts
+    return call(_pool, x, _name=opname)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool_nd(1, x, kernel_size, stride, padding, "max", ceil_mode, True,
+                   "NCW", "max_pool1d")
+    if return_mask:
+        return out, _pool_mask(1, x, kernel_size, stride, padding, ceil_mode,
+                               "NCW")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(2, x, kernel_size, stride, padding, "max", ceil_mode, True,
+                   data_format, "max_pool2d")
+    if return_mask:
+        return out, _pool_mask(2, x, kernel_size, stride, padding, ceil_mode,
+                               data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(3, x, kernel_size, stride, padding, "max", ceil_mode, True,
+                   data_format, "max_pool3d")
+    if return_mask:
+        return out, _pool_mask(3, x, kernel_size, stride, padding, ceil_mode,
+                               data_format)
+    return out
+
+
+def _pool_mask(nd, x, kernel, stride, padding, ceil_mode, data_format):
+    """argmax indices within each window (flattened spatial index)."""
+    k = _tup(kernel, nd)
+    s = _tup(stride if stride is not None else kernel, nd)
+    pad = _padding(padding, nd)
+
+    def _mask(a):
+        spatial = a.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        flat_idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.float32)
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + (list(pad) if not isinstance(pad, str) else
+                                   jax.lax.padtype_to_pads(a.shape, dims,
+                                                           strides, pad)[2:])
+
+        def reducer(l, r):
+            lv, li = l
+            rv, ri = r
+            take_r = rv > lv
+            return (jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li))
+
+        init = (jnp.asarray(-jnp.inf, a.dtype), jnp.asarray(-1.0))
+        _, idx = jax.lax.reduce_window((a, flat_idx), init, reducer, dims,
+                                       strides, pads)
+        return idx.astype(jnp.int32)
+    return call(_mask, x, _name="max_pool_mask")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(1, x, kernel_size, stride, padding, "avg", ceil_mode,
+                    exclusive, "NCW", "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(2, x, kernel_size, stride, padding, "avg", ceil_mode,
+                    exclusive, data_format, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(3, x, kernel_size, stride, padding, "avg", ceil_mode,
+                    exclusive, data_format, "avg_pool3d")
+
+
+def _adaptive_pool_nd(nd, x, output_size, mode, opname, return_mask=False):
+    out_sz = _tup(output_size, nd)
+
+    def _ap(a):
+        out = a
+        for i in range(nd):
+            ax = 2 + i
+            osz = out_sz[i] if out_sz[i] is not None else out.shape[ax]
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                shape = (out.shape[:ax] + (osz, k) + out.shape[ax + 1:])
+                r = out.reshape(shape)
+                out = (jnp.max(r, axis=ax + 1) if mode == "max"
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                # general adaptive: per-output-bin start/end (torch formula)
+                starts = (np.arange(osz) * isz) // osz
+                ends = -(-((np.arange(osz) + 1) * isz) // osz)
+                slices = [
+                    (jnp.max(jax.lax.slice_in_dim(out, int(st), int(en), axis=ax),
+                             axis=ax, keepdims=True) if mode == "max" else
+                     jnp.mean(jax.lax.slice_in_dim(out, int(st), int(en), axis=ax),
+                              axis=ax, keepdims=True))
+                    for st, en in zip(starts, ends)]
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+    return call(_ap, x, _name=opname)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(1, x, output_size, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool_nd(2, x, output_size, "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(3, x, output_size, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(1, x, output_size, "max", "adaptive_max_pool1d")
+    return (out, out) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(2, x, output_size, "max", "adaptive_max_pool2d")
+    return (out, out) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(3, x, output_size, "max", "adaptive_max_pool3d")
+    return (out, out) if return_mask else out

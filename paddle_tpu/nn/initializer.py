@@ -1,0 +1,187 @@
+"""Initializers (ref: python/paddle/nn/initializer/, fluid/initializer.py).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` consuming the
+global PRNG; the reference instead appends init ops into the startup program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import core
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        core.convert_dtype(dtype) or core.get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        return (jax.random.normal(core.next_rng_key(), tuple(shape), dt)
+                * self.std + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        return (jax.random.truncated_normal(core.next_rng_key(), -2.0, 2.0,
+                                            tuple(shape), dt)
+                * self.std + self.mean)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        return jax.random.uniform(core.next_rng_key(), tuple(shape), dt,
+                                  minval=self.low, maxval=self.high)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle layout [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(core.next_rng_key(), tuple(shape), dt) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(core.next_rng_key(), tuple(shape), dt,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        return jax.random.normal(core.next_rng_key(), tuple(shape), dt) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(core.next_rng_key(), tuple(shape), dt,
+                                  minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ..tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.value
+        arr = jnp.asarray(np.asarray(v))
+        dt = core.convert_dtype(dtype) or arr.dtype
+        return arr.reshape(tuple(shape)).astype(dt)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        arr = np.zeros(tuple(shape), np.float32)
+        out_c, in_c = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                arr[(g * per + i, i, *centers)] = 1.0
+        return jnp.asarray(arr, dt)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        return jax.nn.initializers.orthogonal(self.gain)(
+            core.next_rng_key(), tuple(shape), dt)
+
+
+# fluid-style aliases (ref: fluid/initializer.py)
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingUniform
+NumpyArrayInitializer = Assign
+
+
+def calculate_gain(nonlinearity, param=None):
+    recipes = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+               "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+               "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+               "selu": 3.0 / 4.0}
+    return recipes[nonlinearity]

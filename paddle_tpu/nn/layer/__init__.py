@@ -1,0 +1,32 @@
+from .layers import Layer
+from .container import Sequential, LayerList, ParameterList, LayerDict
+from .common import (Identity, Linear, Flatten, Embedding, Dropout, Dropout2D,
+                     Dropout3D, AlphaDropout, Upsample, UpsamplingNearest2D,
+                     UpsamplingBilinear2D, Bilinear, CosineSimilarity,
+                     PairwiseDistance, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+                     PixelShuffle, Unfold, Fold)
+from .activation import (ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish,
+                         Hardswish, LogSigmoid, Softsign, Tanhshrink, GLU,
+                         ELU, SELU, GELU, LeakyReLU, PReLU, RReLU, Hardshrink,
+                         Hardsigmoid, Hardtanh, Softplus, Softshrink,
+                         ThresholdedReLU, Maxout, Softmax, LogSoftmax)
+from .conv import (Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+                   Conv3DTranspose)
+from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                   SyncBatchNorm, LayerNorm, RMSNorm, GroupNorm,
+                   InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+                   LocalResponseNorm, SpectralNorm)
+from .pooling import (MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D,
+                      AvgPool3D, AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                      AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+                      AdaptiveMaxPool3D)
+from .loss import (CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
+                   BCEWithLogitsLoss, KLDivLoss, SmoothL1Loss,
+                   MarginRankingLoss, CTCLoss, HSigmoidLoss,
+                   TripletMarginLoss, CosineEmbeddingLoss,
+                   HingeEmbeddingLoss)
+from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+                  SimpleRNN, LSTM, GRU)
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)

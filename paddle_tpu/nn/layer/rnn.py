@@ -1,0 +1,375 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+TPU-native: sequence iteration is ``lax.scan`` — one compiled loop body, no
+per-step kernel launches (contrast ref's cudnn RNN descriptors).  Eager mode
+uses the same scan through the dispatch layer so gradients flow on the tape.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .container import LayerList
+from ..initializer import Uniform
+from ...ops.dispatch import call
+from ...tensor import manipulation as manip
+from ...tensor.tensor import Tensor
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0],
+                                                           (list, tuple)):
+            return tuple(full([B] + list(s), init_value) for s in shape)
+        return full([B] + list(shape), init_value)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _cell(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = call(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                 self.bias_ih, self.bias_hh, _name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _cell(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = call(_cell, inputs, h, c, self.weight_ih,
+                            self.weight_hh, self.bias_ih, self.bias_hh,
+                            _name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = call(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                 self.bias_ih, self.bias_hh, _name="gru_cell")
+        return h, h
+
+
+def _scan_cell(cell, inputs, initial_states, time_major, reverse=False):
+    """Run a cell over time with lax.scan as ONE dispatched primitive."""
+    params = {k: v for k, v in cell.named_parameters()}
+    names = list(params.keys())
+    is_lstm = isinstance(cell, LSTMCell)
+
+    def _run(x, states, *pvals):
+        pd = dict(zip(names, pvals))
+        wi, wh = pd["weight_ih"], pd["weight_hh"]
+        bi, bh = pd["bias_ih"], pd["bias_hh"]
+        if not time_major:
+            x = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+        if reverse:
+            x = jnp.flip(x, 0)
+
+        if is_lstm:
+            def step(carry, xt):
+                h, c = carry
+                gates = xt @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i = jax.nn.sigmoid(i); f = jax.nn.sigmoid(f)
+                g = jnp.tanh(g); o = jax.nn.sigmoid(o)
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+            carry, ys = jax.lax.scan(step, states, x)
+        elif isinstance(cell, GRUCell):
+            def step(h, xt):
+                xg = xt @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h2 = (1 - z) * n + z * h
+                return h2, h2
+            carry, ys = jax.lax.scan(step, states, x)
+        else:
+            act = jnp.tanh if cell.activation == "tanh" else jax.nn.relu
+
+            def step(h, xt):
+                h2 = act(xt @ wi.T + bi + h @ wh.T + bh)
+                return h2, h2
+            carry, ys = jax.lax.scan(step, states, x)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        if not time_major:
+            ys = jnp.swapaxes(ys, 0, 1)
+        return (ys,) + (tuple(carry) if isinstance(carry, tuple) else (carry,))
+
+    pvals = [params[n] for n in names]
+    outs = call(_run, inputs, initial_states, *pvals, _name="rnn_scan")
+    ys = outs[0]
+    final = outs[1:] if len(outs) > 2 else outs[1]
+    return ys, final
+
+
+class RNN(Layer):
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        return _scan_cell(self.cell, inputs, initial_states, self.time_major,
+                          self.is_reverse)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            s_fw = self.cell_fw.get_initial_states(inputs,
+                                                   batch_dim_idx=batch_idx)
+            s_bw = self.cell_bw.get_initial_states(inputs,
+                                                   batch_dim_idx=batch_idx)
+        else:
+            s_fw, s_bw = initial_states
+        y_fw, f_fw = _scan_cell(self.cell_fw, inputs, s_fw, self.time_major)
+        y_bw, f_bw = _scan_cell(self.cell_bw, inputs, s_bw, self.time_major,
+                                reverse=True)
+        out = manip.concat([y_fw, y_bw], axis=-1)
+        return out, (f_fw, f_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.direction = direction
+
+        def make_cell(isz):
+            if mode == "LSTM":
+                return LSTMCell(isz, hidden_size, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            if mode == "GRU":
+                return GRUCell(isz, hidden_size, weight_ih_attr,
+                               weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(isz, hidden_size, activation, weight_ih_attr,
+                                 weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+        layers = []
+        for i in range(num_layers):
+            isz = input_size if i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                layers.append(BiRNN(make_cell(isz), make_cell(isz),
+                                    time_major))
+            else:
+                layers.append(RNN(make_cell(isz),
+                                  is_reverse=(direction == "backward"),
+                                  time_major=time_major))
+        self.layer_list = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.layer_list):
+            init = None
+            if initial_states is not None:
+                init = self._layer_state(initial_states, i)
+            out, fin = rnn(out, init, sequence_length)
+            finals.append(fin)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        final = self._stack_finals(finals)
+        return out, final
+
+    def _layer_state(self, states, i):
+        d = self.num_directions
+        if self.mode == "LSTM":
+            h, c = states
+            if d == 1:
+                return (h[i * d], c[i * d])
+            return ((h[i * d], c[i * d]), (h[i * d + 1], c[i * d + 1]))
+        h = states
+        if d == 1:
+            return h[i * d]
+        return (h[i * d], h[i * d + 1])
+
+    def _stack_finals(self, finals):
+        d = self.num_directions
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for fin in finals:
+                if d == 2:
+                    (h1, c1), (h2, c2) = fin
+                    hs += [h1, h2]
+                    cs += [c1, c2]
+                else:
+                    h1, c1 = fin
+                    hs.append(h1)
+                    cs.append(c1)
+            return manip.stack(hs, 0), manip.stack(cs, 0)
+        hs = []
+        for fin in finals:
+            if d == 2:
+                f1, f2 = fin
+                hs += [f1 if not isinstance(f1, tuple) else f1[0],
+                       f2 if not isinstance(f2, tuple) else f2[0]]
+            else:
+                hs.append(fin if not isinstance(fin, tuple) else fin[0])
+        return manip.stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

@@ -1,0 +1,3 @@
+from .weight_norm_hook import weight_norm, remove_weight_norm
+from .spectral_norm_hook import spectral_norm
+from .clip_grad import clip_grad_norm_, clip_grad_value_

@@ -1,0 +1,67 @@
+"""spectral_norm hook (ref: python/paddle/nn/utils/spectral_norm_hook.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Parameter
+from ...framework import core
+from ...ops.dispatch import call
+
+
+class SpectralNormHook:
+    def __init__(self, name, n_power_iterations, dim, eps):
+        self.name = name
+        self.n_power_iterations = n_power_iterations
+        self.dim = dim
+        self.eps = eps
+
+    def compute_weight(self, layer):
+        from ...framework import core
+        w = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        dim, iters, eps = self.dim, self.n_power_iterations, self.eps
+
+        if not core.in_tracing():
+            # persist the power iteration (ref: spectral_norm_op updates the
+            # stored U/V buffers every forward) — done eagerly outside the tape
+            wm = jnp.moveaxis(w.value, dim, 0).reshape(w.value.shape[dim], -1)
+            uv = u.value
+            for _ in range(max(iters, 1)):
+                v = wm.T @ uv
+                v = v / (jnp.linalg.norm(v) + eps)
+                uv = wm @ v
+                uv = uv / (jnp.linalg.norm(uv) + eps)
+            u.value = uv
+
+        def _sn(wv, uv):
+            wm = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            v = wm.T @ uv
+            v = v / (jnp.linalg.norm(v) + eps)
+            sigma = uv @ wm @ v
+            return wv / sigma
+        return call(_sn, w, u, _name="spectral_norm")
+
+    @staticmethod
+    def apply(layer, name, n_power_iterations, dim, eps):
+        fn = SpectralNormHook(name, n_power_iterations, dim, eps)
+        w = getattr(layer, name)
+        del layer._parameters[name]
+        import jax
+        h = w.value.shape[dim]
+        u0 = jax.random.normal(core.next_rng_key(), (h,), w.value.dtype)
+        u0 = u0 / (jnp.linalg.norm(u0) + eps)
+        layer.add_parameter(name + "_orig", Parameter(w.value))
+        u = Parameter(u0, trainable=False)
+        layer.add_parameter(name + "_u", u)
+        object.__setattr__(layer, name, fn.compute_weight(layer))
+        layer.register_forward_pre_hook(
+            lambda l, inp: object.__setattr__(l, name, fn.compute_weight(l)))
+        return fn
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    if dim is None:
+        dim = 0
+    SpectralNormHook.apply(layer, name, n_power_iterations, dim, eps)
+    return layer

@@ -1,0 +1,73 @@
+"""weight_norm via forward-pre-hook (ref: python/paddle/nn/utils/weight_norm_hook.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Parameter, Tensor
+from ...ops.dispatch import call
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+class WeightNorm:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute_weight(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        dim = self.dim
+
+        def _wn(gv, vv):
+            n = _norm_except(vv, dim)
+            if dim is None:
+                return vv * (gv / n)
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv * (gv.reshape(shape) / n)
+        return call(_wn, g, v, _name="weight_norm")
+
+    @staticmethod
+    def apply(layer, name, dim):
+        fn = WeightNorm(name, dim)
+        w = getattr(layer, name)
+        del layer._parameters[name]
+        v = Parameter(w.value)
+        if dim is None:
+            g0 = jnp.sqrt(jnp.sum(jnp.square(w.value)))
+        else:
+            axes = tuple(i for i in range(w.value.ndim) if i != dim)
+            g0 = jnp.sqrt(jnp.sum(jnp.square(w.value), axis=axes))
+        g = Parameter(g0)
+        layer.add_parameter(name + "_v", v)
+        layer.add_parameter(name + "_g", g)
+        object.__setattr__(layer, name, fn.compute_weight(layer))
+        layer.register_forward_pre_hook(
+            lambda l, inp: object.__setattr__(l, name, fn.compute_weight(l)))
+        layer._weight_norm_fn = fn
+        return fn
+
+
+def weight_norm(layer, name="weight", dim=0):
+    WeightNorm.apply(layer, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    fn = getattr(layer, "_weight_norm_fn", None)
+    if fn is None:
+        return layer
+    w = fn.compute_weight(layer)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer._forward_pre_hooks.clear()
+    layer.add_parameter(name, Parameter(w.value))
+    del layer._weight_norm_fn
+    return layer

@@ -1,0 +1,2 @@
+from . import dispatch
+from .dispatch import call, unwrap

@@ -1,0 +1,125 @@
+"""Primitive dispatch: every eager op flows through ``call``.
+
+TPU-native replacement for the reference's op registry + kernel dispatch
+(ref: paddle/fluid/framework/operator.cc, imperative/tracer.cc).  The
+reference looks up a per-device kernel per OpDesc; here every primitive is a
+pure jax function — XLA is the kernel library — and differentiation is
+``jax.vjp`` recorded on the eager tape (see autograd/tape.py).  Under a
+functional trace (jit.to_static / hapi) the tape is bypassed and tracers flow
+straight through, so the whole step compiles to one fused HLO.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from ..framework import core
+from ..autograd.tape import Node
+
+_float0 = jax.dtypes.float0
+
+
+def _is_tensor(x):
+    from ..tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+_static_graph_mod = None
+
+
+def _static_mode():
+    global _static_graph_mod
+    if _static_graph_mod is None:
+        from ..static import graph as static_graph
+        _static_graph_mod = static_graph
+    return _static_graph_mod.in_static_mode()
+
+
+def _wrap(val, stop_gradient=True, node=None, index=0):
+    from ..tensor import Tensor
+    t = Tensor(val, stop_gradient=stop_gradient)
+    t._node = node
+    t._node_index = index
+    return t
+
+
+def call(fn, *args, _nondiff=(), _name=None, **kwargs):
+    """Apply primitive ``fn`` to args that may contain Tensors (incl. nested
+    in lists/tuples/dicts).  Returns Tensor or tuple of Tensors mirroring
+    fn's output structure (flat tuple outputs only).
+
+    ``_nondiff``: indices of positional args never differentiated even if
+    they are Tensors requiring grad (e.g. integer index operands).
+    """
+    from ..tensor import Tensor
+
+    leaves, treedef = tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+
+    tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    record = (core.grad_enabled() and not core.in_tracing()
+              and not _static_mode()
+              and any(not leaves[i].stop_gradient for i in tensor_pos))
+
+    if record:
+        # positions of differentiable operands: require grad + inexact dtype
+        diff_pos = [i for i in tensor_pos
+                    if not leaves[i].stop_gradient
+                    and jnp.issubdtype(leaves[i].dtype, jnp.inexact)]
+    if not record or not diff_pos:
+        vals = [l.value if isinstance(l, Tensor) else l for l in leaves]
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        out = fn(*a, **k)
+        multi = isinstance(out, (tuple, list))
+        wrapped = (tuple(_wrap(o) for o in out) if multi
+                   else (_wrap(out),))
+        from ..static import graph as static_graph
+        if static_graph.in_static_mode():
+            static_graph.record_call(fn, leaves, treedef, wrapped,
+                                     _name or getattr(fn, "__name__", "op"))
+        return wrapped if multi else wrapped[0]
+
+    diff_tensors = [leaves[i] for i in diff_pos]
+    diff_vals = [t.value for t in diff_tensors]
+
+    base_vals = [l.value if isinstance(l, Tensor) else l for l in leaves]
+
+    def closure(*dv):
+        vals = list(base_vals)
+        for p, v in zip(diff_pos, dv):
+            vals[p] = v
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        return fn(*a, **k)
+
+    out_vals, vjp_fn = jax.vjp(closure, *diff_vals)
+
+    multi = isinstance(out_vals, (tuple, list))
+    outs = tuple(out_vals) if multi else (out_vals,)
+    node = Node(
+        vjp_fn=vjp_fn,
+        parents=diff_tensors,
+        n_outputs=len(outs),
+        out_shapes=[o.shape for o in outs],
+        out_dtypes=[o.dtype for o in outs],
+        name=_name or getattr(fn, "__name__", "op"),
+    )
+    wrapped = tuple(
+        _wrap(o, stop_gradient=not jnp.issubdtype(o.dtype, jnp.inexact),
+              node=node, index=i)
+        for i, o in enumerate(outs))
+    return wrapped if multi else wrapped[0]
+
+
+def unwrap(x):
+    """Tensor -> jax value; passthrough otherwise (recurses into containers)."""
+    from ..tensor import Tensor
+    if isinstance(x, Tensor):
+        return x.value
+    if isinstance(x, (list, tuple)):
+        return type(x)(unwrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: unwrap(v) for k, v in x.items()}
+    return x

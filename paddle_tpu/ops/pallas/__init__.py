@@ -1,0 +1,4 @@
+"""Pallas TPU kernels — replacements for the reference's fused CUDA kernels
+(paddle/fluid/operators/fused/*).
+"""
+from . import flash_attn

@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
+from .optimizer import Optimizer
+from .optimizers import (SGD, Momentum, Adagrad, Adadelta, Adam, AdamW,
+                         Adamax, RMSProp, Lamb)
+from . import lr

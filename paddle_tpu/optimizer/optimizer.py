@@ -1,0 +1,185 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py).
+
+Each concrete optimizer defines a PURE update rule
+``_update(p, g, state, lr) -> (new_p, new_state)`` over jax arrays.  Eager
+``step()`` applies it per-parameter; the jitted train-step path (hapi/jit)
+reuses the same rule inside one compiled function so the whole update fuses
+into the step's HLO — the reference instead launches one CUDA kernel per op.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import core
+from ..tensor.tensor import Tensor, Parameter
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _accum_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._accumulators = collections.defaultdict(dict)  # name -> {pid: arr}
+        self._step_count = 0
+        self._param_groups = None
+        if (self._parameters and isinstance(self._parameters[0], dict)):
+            self._param_groups = self._parameters
+            self._parameters = []
+            for g in self._param_groups:
+                self._parameters.extend(g["params"])
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler instance")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ---------------------------------------------------------------- state
+    def _state_for(self, p):
+        key = id(p)
+        states = {}
+        for nm in self._accum_names:
+            if key not in self._accumulators[nm]:
+                self._accumulators[nm][key] = self._init_accumulator(nm, p)
+            states[nm] = self._accumulators[nm][key]
+        return states
+
+    def _init_accumulator(self, name, p):
+        return jnp.zeros_like(p.value)
+
+    def _update(self, p, g, state, lr, t=1):
+        """Pure update rule.  ``t`` is the 1-based step count (python int
+        eagerly, traced scalar under jit so bias correction doesn't force
+        retraces)."""
+        raise NotImplementedError
+
+    # ---------------------------------------------------------------- step
+    def _apply_decay(self, p, g):
+        wd = self._weight_decay
+        if wd is None:
+            return g
+        from ..regularizer import L1Decay, L2Decay
+        reg = p.regularizer if getattr(p, "regularizer", None) is not None \
+            else wd
+        if isinstance(reg, float):
+            reg = L2Decay(reg)
+        if isinstance(reg, (L1Decay, L2Decay)):
+            # decoupled optimizers (AdamW) override this
+            return g + reg.grad_term(p.value)
+        return g
+
+    def step(self):
+        params_grads = []
+        for p in self._parameters:
+            if p is None or p.stop_gradient or p._grad is None:
+                continue
+            params_grads.append((p, p._grad))
+        self._apply_gradients(params_grads)
+
+    def _apply_gradients(self, params_grads):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr_global = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g = self._apply_decay(p, g)
+            lr = lr_global * p.optimize_attr.get("learning_rate", 1.0) \
+                if isinstance(p, Parameter) else lr_global
+            state = self._state_for(p)
+            new_val, new_state = self._update(p.value, g, state, lr,
+                                              self._step_count)
+            p.value = new_val
+            for nm, sv in new_state.items():
+                self._accumulators[nm][id(p)] = sv
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..static import graph as static_graph
+        if static_graph.in_static_mode():
+            # static build: register the train spec on the default program;
+            # Executor.run differentiates the replayed graph with jax.grad
+            prog = static_graph.default_main_program()
+            loss_id = static_graph._ensure_var_id(loss, prog)
+            prog.train_spec = (loss_id, self)
+            if not self._parameters:
+                self._parameters = prog.all_parameters()
+            return None, None
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            if p is not None:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ----------------------------------------------------------- serialization
+    def state_dict(self):
+        sd = {}
+        name_of = {}
+        for p in self._parameters:
+            name_of[id(p)] = p.name
+        for nm, d in self._accumulators.items():
+            for pid, arr in d.items():
+                pname = name_of.get(pid, str(pid))
+                sd[f"{pname}_{nm}"] = Tensor(arr)
+        sd["@step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(dict(state_dict["LR_Scheduler"]))
+        for p in self._parameters:
+            for nm in self._accum_names:
+                key = f"{p.name}_{nm}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    self._accumulators[nm][id(p)] = (
+                        v.value if isinstance(v, Tensor) else jnp.asarray(v))
+
+    set_dict = set_state_dict
+
+    # --------------------------------------------------- functional interface
+    def init_state_pytree(self, params):
+        """Pure-state init for the jitted train-step path: returns a pytree of
+        accumulator dicts matching ``params`` (list of Tensors)."""
+        return [
+            {nm: self._init_accumulator(nm, p) for nm in self._accum_names}
+            for p in params
+        ]
+
+    def apply_updates_pytree(self, param_vals, grads, states, lr, step=1):
+        """Pure function: apply the update rule across lists of arrays.
+        Used inside jax.jit train steps (see hapi/model.py, jit/api.py)."""
+        new_ps, new_ss = [], []
+        for pv, g, st in zip(param_vals, grads, states):
+            np_, ns_ = self._update(pv, g, st, lr, step)
+            new_ps.append(np_)
+            new_ss.append(ns_)
+        return new_ps, new_ss

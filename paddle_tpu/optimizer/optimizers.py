@@ -1,0 +1,221 @@
+"""Concrete optimizers (ref: python/paddle/optimizer/{sgd,momentum,adam,...}.py
+and the corresponding fluid/operators/optimizers/*_op kernels — here each is a
+pure jax update rule; XLA fuses the whole step).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    _accum_names = ()
+
+    def _update(self, p, g, state, lr, t=1):
+        return p - lr * g.astype(p.dtype), {}
+
+
+class Momentum(Optimizer):
+    _accum_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    _accum_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_accumulator(self, name, p):
+        return jnp.full_like(p.value, self._init_val)
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        m = state["moment"] + g * g
+        new_p = p - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    _accum_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        eg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        dx = (jnp.sqrt(state["avg_squared_update"] + self._epsilon)
+              / jnp.sqrt(eg + self._epsilon)) * g
+        eu = self._rho * state["avg_squared_update"] + (1 - self._rho) * dx * dx
+        return p - lr * dx, {"avg_squared_grad": eg, "avg_squared_update": eu}
+
+
+class Adam(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update(self, p, g, state, lr, t=1):
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * gf * gf
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        new_p = pf - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    def _init_accumulator(self, name, p):
+        return jnp.zeros(p.value.shape, jnp.float32)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decay(self, p, g):
+        return g  # decoupled: applied inside _update
+
+    def _update(self, p, g, state, lr, t=1):
+        decay = self._coeff
+        pf = p.astype(jnp.float32)
+        new_p, new_state = super()._update(p, g, state, lr, t)
+        if decay:
+            new_p = new_p.astype(jnp.float32) - lr * decay * pf
+        return new_p.astype(p.dtype), new_state
+
+    def _apply_gradients(self, params_grads):
+        if self._apply_decay_param_fun is not None:
+            # temporarily zero the coeff for excluded params
+            coeff = self._coeff
+            out = []
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+                clip, self._grad_clip = self._grad_clip, None
+            else:
+                clip = None
+            for p, g in params_grads:
+                self._coeff = coeff if self._apply_decay_param_fun(p.name) \
+                    else 0.0
+                super()._apply_gradients([(p, g)])
+                self._step_count -= 1
+            self._step_count += 1
+            self._coeff = coeff
+            if clip is not None:
+                self._grad_clip = clip
+            return
+        super()._apply_gradients(params_grads)
+
+
+class Adamax(Optimizer):
+    _accum_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        new_p = p - (lr / (1 - self._beta1 ** t)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    _accum_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum_acc"] + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg,
+                         "momentum_acc": mom}
+
+
+class Lamb(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_accumulator(self, name, p):
+        return jnp.zeros(p.value.shape, jnp.float32)
+
+    def _update(self, p, g, state, lr, t=1):
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * gf * gf
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        update = r + self._lamb_wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        u_norm = jnp.linalg.norm(update)
+        ratio = jnp.where(w_norm > 0,
+                          jnp.where(u_norm > 0, w_norm / u_norm, 1.0), 1.0)
+        new_p = pf - lr * ratio * update
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}

@@ -1,0 +1,5 @@
+"""TPU parallelism primitives: mesh management, ring attention, pipelining."""
+from .mesh import (create_mesh, set_mesh, get_mesh, mesh_scope, sharding,
+                   shard_constraint, shard_params, P)
+from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import pipeline_forward, make_pipelined

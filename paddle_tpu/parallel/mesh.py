@@ -1,0 +1,88 @@
+"""Device mesh management — the spine of all parallelism.
+
+Replaces the reference's ring-id/communicator plumbing
+(ref: paddle/fluid/platform/collective_helper.cc): one global
+jax.sharding.Mesh with named axes ('dp','pp','tp','sp'); layers annotate
+PartitionSpecs and XLA GSPMD inserts the ICI collectives.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_current_mesh = [None]
+
+P = PartitionSpec
+
+
+def create_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    n = dp * tp * pp * sp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, pp, tp, sp)
+    mesh = Mesh(arr, axis_names=("dp", "pp", "tp", "sp"))
+    return mesh
+
+
+def set_mesh(mesh):
+    _current_mesh[0] = mesh
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh[0]
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    prev = _current_mesh[0]
+    _current_mesh[0] = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _current_mesh[0] = prev
+
+
+def sharding(*spec):
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_constraint(x, *spec):
+    """with_sharding_constraint when a mesh is active; identity otherwise.
+    Accepts Tensor or raw array (used inside traced layer forwards)."""
+    from ..tensor.tensor import Tensor
+    from ..ops.dispatch import call
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    ns = NamedSharding(mesh, P(*spec))
+
+    def _c(v):
+        return jax.lax.with_sharding_constraint(v, ns)
+    if isinstance(x, Tensor):
+        return call(_c, x, _name="sharding_constraint")
+    return _c(x)
+
+
+def shard_params(layer):
+    """Materialize parameter shardings: device_put each param according to
+    its _sharding_axes hint (set by meta-parallel layers)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return layer
+    for _, p in layer.named_parameters():
+        spec = getattr(p, "_sharding_axes", None) or ()
+        ns = NamedSharding(mesh, P(*spec))
+        try:
+            p.value = jax.device_put(p.value, ns)
+        except ValueError:
+            pass  # unshardable shape on this mesh: keep replicated
+    return layer

@@ -1,0 +1,75 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context replacement for the reference's fused attention at scale: Q
+stays resident per shard while K/V blocks rotate around the 'sp' ring via
+ppermute, overlapping compute with ICI transfers.  Online-softmax running
+stats merge partial results exactly (same math as flash attention).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, causal, q_off, k_off):
+    """Attention over one (q_shard, k_block) pair with running-stat outputs.
+    q: [B,H,Nq,D]; returns (out_unnorm, row_max, row_sumexp)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        nq, nk = s.shape[-2], s.shape[-1]
+        rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (nq, nk), 0)
+        cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (nq, nk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                 # [B,H,Nq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False):
+    """q,k,v: LOCAL shards [B, H, N_local, D] inside a shard_map over
+    ``axis_name``.  Returns the local output shard."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    n_local = q.shape[2]
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    q_off = idx * n_local
+
+    o, m, l = _block_attn(q, k, v, scale, causal, q_off, idx * n_local)
+
+    def body(i, carry):
+        o, m, l, k, v = carry
+        # rotate K/V one step around the ring (overlaps with next compute)
+        perm = [(j, (j + 1) % size) for j in range(size)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        src = (idx - i - 1) % size  # shard the K/V block originated from
+        k_off = src * n_local
+        o2, m2, l2 = _block_attn(q, k, v, scale, causal, q_off, k_off)
+        m_new = jnp.maximum(m, m2)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(m2 - m_new)
+        o = o * a1 + o2 * a2
+        l = l * a1 + l2 * a2
+        return o, m_new, l, k, v
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, size - 1, body, (o, m, l, k, v))
+    return o / jnp.maximum(l, 1e-30)
+
+
+def ring_attention_sharded(mesh, q, k, v, causal=False, axis_name="sp"):
+    """Entry point on GLOBAL arrays [B,H,N,D]: shard N over ``axis_name``."""
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, None, axis_name, None),) * 3,
+        out_specs=P(None, None, axis_name, None))
+    return fn(q, k, v)

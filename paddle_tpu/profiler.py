@@ -1,0 +1,86 @@
+"""Profiler/tracing (ref: python/paddle/fluid/profiler.py +
+paddle/fluid/platform/profiler.cc).
+
+TPU-native: wraps jax.profiler for device traces (viewable in TensorBoard /
+xprof) plus a lightweight host-side op timer for eager mode.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_op_times = defaultdict(float)
+_op_counts = defaultdict(int)
+_enabled = False
+
+
+def start_profiler(state="All", tracer_option="Default", log_dir=None):
+    global _enabled
+    _enabled = True
+    if log_dir:
+        jax.profiler.start_trace(log_dir)
+    _op_times.clear()
+    _op_counts.clear()
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    global _enabled
+    _enabled = False
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError:
+        pass
+    return summary()
+
+
+def summary():
+    rows = sorted(_op_times.items(), key=lambda kv: -kv[1])
+    out = [("op", "count", "total_s")]
+    for name, t in rows:
+        out.append((name, _op_counts[name], round(t, 6)))
+    return out
+
+
+def record_op(name, seconds):
+    if _enabled:
+        _op_times[name] += seconds
+        _op_counts[name] += 1
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_op(name, time.perf_counter() - t0)
+
+
+class RecordEvent:
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        record_op(self.name, time.perf_counter() - self._t0)
+
+
+def trace(log_dir):
+    """Device-level trace context via jax.profiler (xprof format)."""
+    return jax.profiler.trace(log_dir)

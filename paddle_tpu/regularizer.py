@@ -1,0 +1,24 @@
+"""Regularizers (ref: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+    def grad_term(self, p):
+        return self._coeff * jnp.sign(p)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+    def grad_term(self, p):
+        return self._coeff * p
+
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

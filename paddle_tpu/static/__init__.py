@@ -1,0 +1,38 @@
+"""paddle_tpu.static (ref: python/paddle/static/__init__.py)."""
+from .graph import (Program, Executor, CompiledProgram, BuildStrategy,
+                    ExecutionStrategy, default_main_program,
+                    default_startup_program, program_guard, data,
+                    global_scope, scope_guard, Scope, in_static_mode,
+                    _set_static_mode)
+from . import nn
+from ..jit.api import InputSpec
+
+
+class ParallelExecutor(Executor):
+    """ref: fluid/parallel_executor.py — data-parallel execution is expressed
+    with shardings under XLA; API kept for compatibility."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None, num_trainers=1, trainer_id=0):
+        super().__init__()
+        self._main_program = main_program
+
+    def run(self, fetch_list=None, feed=None, program=None, **kwargs):
+        return super().run(program or self._main_program, feed, fetch_list,
+                           **kwargs)
+
+
+def save(program, model_path, **kwargs):
+    from ..io.serialization import save as _save
+    state = {f"param_{i}": p for i, p in enumerate(program.all_parameters())}
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..io.serialization import load as _load
+    state = _load(model_path + ".pdparams")
+    for i, p in enumerate(program.all_parameters()):
+        key = f"param_{i}"
+        if key in state:
+            p.set_value(state[key])

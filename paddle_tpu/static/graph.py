@@ -1,0 +1,340 @@
+"""Static graph: Program as a recorded op trace, Executor as its XLA runner.
+
+TPU-native re-design of the reference's static pipeline
+(ref: python/paddle/fluid/framework.py::Program,
+ python/paddle/fluid/executor.py, paddle/fluid/framework/parallel_executor.cc):
+the reference builds a protobuf ProgramDesc, runs IR passes, and schedules
+per-op kernels; here building a program RECORDS every dispatched primitive
+(they still execute on dummy data so shapes/python control flow resolve), and
+Executor.run REPLAYS the recording as one pure jax function compiled by XLA —
+fusion, scheduling and memory planning all happen in the compiler.
+
+Training programs (built via optimizer.minimize) store (loss, optimizer);
+Executor.run then computes grads with jax.grad over the replay function and
+applies the optimizer's pure update rule, all inside the same jitted step —
+the moral equivalent of ParallelExecutor's fused train loop.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..tensor.tensor import Tensor, Parameter
+
+_static_mode = [False]
+_var_counter = itertools.count()
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+def _set_static_mode(flag):
+    _static_mode[0] = bool(flag)
+
+
+class OpRecord:
+    __slots__ = ("fn", "treedef", "leaf_specs", "out_ids", "name")
+
+    def __init__(self, fn, treedef, leaf_specs, out_ids, name):
+        self.fn = fn
+        self.treedef = treedef
+        self.leaf_specs = leaf_specs  # list of ('var', id) | ('const', value)
+        self.out_ids = out_ids
+        self.name = name
+
+
+class Program:
+    def __init__(self):
+        self.ops: list[OpRecord] = []
+        self.feed_ids = {}      # name -> var_id
+        self.params = {}        # var_id -> Parameter
+        self.var_meta = {}      # var_id -> (shape, dtype)
+        self.train_spec = None  # (loss_var_id, optimizer)
+        self.fetch_cache = {}
+        self.random_seed = None
+
+    def record(self, fn, treedef, leaf_specs, out_ids, name):
+        self.ops.append(OpRecord(fn, treedef, leaf_specs, out_ids, name))
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.ops = list(self.ops)
+        p.feed_ids = dict(self.feed_ids)
+        p.params = dict(self.params)
+        p.var_meta = dict(self.var_meta)
+        if not for_test:
+            p.train_spec = self.train_spec
+        return p
+
+    def global_block(self):
+        return self
+
+    # block-compat helpers
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def list_vars(self):
+        return list(self.var_meta.keys())
+
+    def replay(self, env):
+        """env: var_id -> concrete/traced value.  Mutates env with outputs."""
+        for op in self.ops:
+            leaves = []
+            for kind, ref in op.leaf_specs:
+                if kind == "var":
+                    leaves.append(env[ref])
+                else:
+                    leaves.append(ref)
+            args, kwargs = jax.tree_util.tree_unflatten(op.treedef, leaves)
+            out = op.fn(*args, **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for oid, o in zip(op.out_ids, outs):
+                env[oid] = o
+        return env
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+def set_program_state(main=None, startup=None):
+    if main is not None:
+        _default_main[0] = main
+    if startup is not None:
+        _default_startup[0] = startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_default_main[0], _default_startup[0])
+        _default_main[0] = self._main
+        if self._startup is not None:
+            _default_startup[0] = self._startup
+        return self
+
+    def __exit__(self, *a):
+        _default_main[0], _default_startup[0] = self._saved
+        return False
+
+
+def _ensure_var_id(t: Tensor, program: Program):
+    vid = getattr(t, "_weakref_slot", None)
+    if vid is None:
+        vid = next(_var_counter)
+        t._weakref_slot = vid  # reuse spare slot as var-id store
+        program.var_meta[vid] = (tuple(t.shape), t.dtype)
+        if isinstance(t, Parameter):
+            program.params[vid] = t
+    elif vid not in program.var_meta:
+        program.var_meta[vid] = (tuple(t.shape), t.dtype)
+        if isinstance(t, Parameter):
+            program.params[vid] = t
+    return vid
+
+
+def record_call(fn, leaves, treedef, out_tensors, name):
+    """Hook invoked from ops.dispatch.call when static mode is on."""
+    prog = default_main_program()
+    specs = []
+    for l in leaves:
+        if isinstance(l, Tensor):
+            specs.append(("var", _ensure_var_id(l, prog)))
+        else:
+            specs.append(("const", l))
+    out_ids = [_ensure_var_id(t, prog) for t in out_tensors]
+    prog.record(fn, treedef, specs, out_ids, name)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (ref: python/paddle/fluid/data.py).  Dummy batch dim 1
+    for unknown dims during build; real shapes come from the feed."""
+    shape = [1 if (s is None or s < 0) else int(s) for s in shape]
+    t = Tensor(np.zeros(shape, np.dtype(core.convert_dtype(dtype))))
+    t.stop_gradient = True
+    prog = default_main_program()
+    vid = _ensure_var_id(t, prog)
+    prog.feed_ids[name] = vid
+    t.name = name
+    return t
+
+
+class Executor:
+    """ref: python/paddle/fluid/executor.py::Executor — here one jitted
+    replay per (program, feed-signature)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        program = program or default_main_program()
+        if getattr(program, "_is_startup", False) or not program.ops:
+            return []  # startup: params already initialized eagerly
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                fetch_ids.append(_ensure_var_id(f, program))
+            else:
+                fetch_ids.append(f)
+
+        feed_names = sorted(feed.keys())
+        feed_vals = []
+        for n in feed_names:
+            v = feed[n]
+            if isinstance(v, Tensor):
+                v = v.value
+            else:
+                v = jnp.asarray(np.asarray(v))
+            feed_vals.append(v)
+
+        param_ids = sorted(program.params.keys())
+        params = [program.params[i] for i in param_ids]
+        param_vals = [p.value for p in params]
+
+        key = (id(program), tuple(feed_names),
+               tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
+               tuple(fetch_ids), program.train_spec is not None)
+        if key not in self._cache:
+            self._cache[key] = self._compile(program, feed_names, fetch_ids,
+                                             param_ids)
+        step_fn = self._cache[key]
+
+        if program.train_spec is not None:
+            loss_id, opt = program.train_spec
+            states = [
+                {nm: opt._accumulators[nm].get(
+                    id(p), opt._init_accumulator(nm, p))
+                 for nm in opt._accum_names} for p in params]
+            opt._step_count += 1
+            fetches, new_params, new_states = step_fn(
+                tuple(feed_vals), tuple(param_vals), states,
+                opt.get_lr(), opt._step_count)
+            for p, nv in zip(params, new_params):
+                p.value = nv
+            for p, ns in zip(params, new_states):
+                for nm, sv in ns.items():
+                    opt._accumulators[nm][id(p)] = sv
+        else:
+            fetches = step_fn(tuple(feed_vals), tuple(param_vals))
+
+        if return_numpy:
+            return [np.asarray(jax.device_get(f)) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def _compile(self, program, feed_names, fetch_ids, param_ids):
+        feed_var_ids = [program.feed_ids[n] for n in feed_names]
+
+        def forward(feed_vals, param_vals):
+            env = dict(zip(feed_var_ids, feed_vals))
+            env.update(dict(zip(param_ids, param_vals)))
+            program.replay(env)
+            return env
+
+        if program.train_spec is not None:
+            loss_id, opt = program.train_spec
+
+            def train_step(feed_vals, param_vals, states, lr, t):
+                def loss_of(pv):
+                    env = forward(feed_vals, pv)
+                    return env[loss_id], env
+                grads, env = jax.grad(
+                    lambda pv: loss_of(pv), has_aux=True)(list(param_vals))
+                new_params, new_states = opt.apply_updates_pytree(
+                    list(param_vals), grads, states, lr, t)
+                fetches = tuple(env[i] for i in fetch_ids)
+                return fetches, new_params, new_states
+
+            return jax.jit(train_step)
+
+        def infer(feed_vals, param_vals):
+            env = forward(feed_vals, param_vals)
+            return tuple(env[i] for i in fetch_ids)
+        return jax.jit(infer)
+
+    def close(self):
+        self._cache.clear()
+
+
+class CompiledProgram:
+    """ref: fluid/compiler.py::CompiledProgram — with XLA there is nothing
+    extra to build; with_data_parallel maps to sharded feeds (fleet)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_elewise_add_act_ops = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor(np.zeros(())))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old, _global_scope = _global_scope, scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+    return guard()

@@ -1,0 +1,86 @@
+"""static.nn layer builders (ref: python/paddle/static/nn/__init__.py →
+fluid/layers/nn.py).  Each call instantiates the dygraph layer and invokes it
+so parameters register on the default program during the build pass.
+"""
+from __future__ import annotations
+
+from .. import nn as _nn
+from ..nn import functional as F
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..tensor.manipulation import reshape
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= s
+    if num_flatten_dims != 1 or len(x.shape) > 2:
+        flat = reshape(x, list(x.shape[:num_flatten_dims]) + [-1])
+    else:
+        flat = x
+    layer = _nn.Linear(in_features, size, weight_attr, bias_attr)
+    out = layer(flat)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    in_ch = input.shape[1 if data_format.startswith("NC") else -1]
+    layer = _nn.Conv2D(in_ch, num_filters, filter_size, stride, padding,
+                       dilation, groups, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None,
+               **kwargs):
+    ch = input.shape[1 if data_layout.startswith("NC") else -1]
+    layer = _nn.BatchNorm(ch, act=act, momentum=momentum, epsilon=epsilon,
+                          param_attr=param_attr, bias_attr=bias_attr,
+                          data_layout=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          sparse=is_sparse, weight_attr=param_attr)
+    return layer(input)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, **kwargs):
+    return F.dropout(x, dropout_prob, training=not is_test)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           data_format="NCHW", **kwargs):
+    if global_pooling:
+        return (F.adaptive_max_pool2d(input, 1) if pool_type == "max"
+                else F.adaptive_avg_pool2d(input, 1))
+    if pool_type == "max":
+        return F.max_pool2d(input, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode, data_format=data_format)
+    return F.avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, data_format=data_format)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = input.shape[begin_norm_axis:]
+    layer = _nn.LayerNorm(shape, epsilon, param_attr if scale else False,
+                          bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out

@@ -1,0 +1,15 @@
+"""paddle_tpu.tensor — Tensor class + op namespaces."""
+from .tensor import Tensor, Parameter, to_tensor
+from . import creation, math, manipulation, logic, search, stat, linalg, random, attribute
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import std, var, median, nanmedian, quantile, numel  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .random import (rand, randn, normal, uniform, randint, randint_like,  # noqa: F401
+                     randperm, bernoulli, poisson, multinomial, shuffle,
+                     standard_normal)
+from .attribute import shape as shape_op, rank as rank_op  # noqa: F401
+from .attribute import is_complex, is_floating_point, is_integer  # noqa: F401

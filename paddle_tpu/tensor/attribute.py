@@ -1,0 +1,35 @@
+"""Attribute ops (ref: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+def shape(input):
+    return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim, jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def _install():
+    Tensor.is_complex = is_complex
+    Tensor.is_floating_point = is_floating_point
+    Tensor.is_integer = is_integer
+
+
+_install()

@@ -1,0 +1,184 @@
+"""Creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import core
+from ..ops.dispatch import call
+from .tensor import Tensor, to_tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default or core.get_default_dtype()
+    return core.convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = core.get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype) if dtype else None))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return call(lambda a: jnp.zeros_like(a, dtype=_dt(dtype, a.dtype)), x.detach()
+                if isinstance(x, Tensor) else Tensor(x), _name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None):
+    return call(lambda a: jnp.ones_like(a, dtype=_dt(dtype, a.dtype)), x.detach()
+                if isinstance(x, Tensor) else Tensor(x), _name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    t = x.detach() if isinstance(x, Tensor) else Tensor(x)
+    return call(lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, a.dtype)),
+                t, _name="full_like")
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _val(start), _val(end), _val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step)) else None)
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype) if dtype else None))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_val(start), _val(stop), int(_val(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return call(_diag, x, _name="diag")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def _de(a):
+        n = a.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + (builtins_abs(offset) if offset < 0 else 0)
+        c = idx + (offset if offset > 0 else 0)
+        out = out.at[..., r, c].set(a)
+        src = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+            perm = [d for d in src if d not in (out.ndim - 2, out.ndim - 1)]
+            full_perm = [None] * out.ndim
+            full_perm[d1] = out.ndim - 2
+            full_perm[d2] = out.ndim - 1
+            it = iter(perm)
+            for i in range(out.ndim):
+                if full_perm[i] is None:
+                    full_perm[i] = next(it)
+            out = jnp.transpose(out, full_perm)
+        return out
+    return call(_de, x, _name="diag_embed")
+
+
+import builtins
+builtins_abs = builtins.abs
+
+
+def tril(x, diagonal=0, name=None):
+    return call(lambda a: jnp.tril(a, k=diagonal), x, _name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return call(lambda a: jnp.triu(a, k=diagonal), x, _name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = call(lambda xs: tuple(jnp.meshgrid(*xs, indexing="ij")), list(args),
+                _name="meshgrid")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def assign(x, output=None):
+    src = x.value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(src)
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, "int32"))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def complex(real, imag, name=None):
+    return call(lambda r, i: r + 1j * i, real, imag, _name="complex")
+
+
+def _install():
+    Tensor.tril = tril
+    Tensor.triu = triu
+    Tensor.diag = diag
+    Tensor.diag_embed = diag_embed
+    Tensor.numel = lambda s: numel(s)
+
+
+_install()

@@ -1,0 +1,195 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .tensor import Tensor
+from .math import matmul, dot, bmm, mv, multi_dot  # re-export
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p in ("fro", 2):
+                return jnp.sqrt(jnp.sum(flat * flat)).reshape(())
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax,
+                                 keepdims=keepdim), 1.0 / p)
+    return call(_norm, x, _name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def _d(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return call(_d, x, y, _name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    def _c(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return call(_c, x, y, _name="cross")
+
+
+def t(x, name=None):
+    from .manipulation import t as _t
+    return _t(x)
+
+
+def cholesky(x, upper=False, name=None):
+    def _ch(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return call(_ch, x, _name="cholesky")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def _h(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi))
+        return h.astype(_i64())
+    return call(_h, input, _name="histogram")
+
+
+def matrix_power(x, n, name=None):
+    return call(lambda a: jnp.linalg.matrix_power(a, n), x, _name="matrix_power")
+
+
+def svd(x, full_matrices=False, name=None):
+    return call(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                x, _name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    return call(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, _name="qr")
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return call(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, _name="eigh")
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(np.linalg.eigvals(x.numpy()))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return call(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, _name="eigvalsh")
+
+
+def inv(x, name=None):
+    return call(jnp.linalg.inv, x, _name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return call(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                x, _name="pinv")
+
+
+def det(x, name=None):
+    return call(jnp.linalg.det, x, _name="det")
+
+
+def slogdet(x, name=None):
+    def _sl(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return call(_sl, x, _name="slogdet")
+
+
+def solve(x, y, name=None):
+    return call(jnp.linalg.solve, x, y, _name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax
+    def _ts(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            aa, b, lower=not upper if not transpose else upper,
+            unit_diagonal=unitriangular)
+    return call(_ts, x, y, _name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax
+    def _cs(b, l):
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=not upper)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(l, -1, -2), z, lower=upper)
+    return call(_cs, x, y, _name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _ls(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return call(_ls, x, y, _name="lstsq")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return call(lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(_i64()),
+                x, _name="matrix_rank")
+
+
+def cond(x, p=None, name=None):
+    return call(lambda a: jnp.linalg.cond(a, p=p), x, _name="cond")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights.value if isinstance(fweights, Tensor) else fweights
+    aw = aweights.value if isinstance(aweights, Tensor) else aweights
+    return call(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                  fweights=fw, aweights=aw), x, _name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return call(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, _name="corrcoef")
+
+
+def _install():
+    for nm in ("norm dist cross cholesky histogram matrix_power svd qr eigh "
+               "eigvalsh inv pinv det slogdet solve triangular_solve "
+               "cholesky_solve lstsq matrix_rank cond").split():
+        setattr(Tensor, nm, globals()[nm])
+
+
+_install()
+
+
+def _i64():
+    from ..framework import core as _c
+    return _c.convert_dtype("int64")

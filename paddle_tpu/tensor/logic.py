@@ -1,0 +1,85 @@
+"""Logic/compare ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .tensor import Tensor
+
+
+def _cmp(jfn, opname):
+    def op(x, y, name=None):
+        return call(jfn, x, y, _name=opname)
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return call(jnp.logical_not, x, _name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return call(jnp.bitwise_not, x, _name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return call(lambda a, b: jnp.array_equal(a, b), x, y, _name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return call(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), x, y,
+                _name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return call(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                         equal_nan=equal_nan), x, y,
+                _name="isclose")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _install():
+    T = Tensor
+    T.__eq__ = lambda s, o: equal(s, o)
+    T.__ne__ = lambda s, o: not_equal(s, o)
+    T.__lt__ = lambda s, o: less_than(s, o)
+    T.__le__ = lambda s, o: less_equal(s, o)
+    T.__gt__ = lambda s, o: greater_than(s, o)
+    T.__ge__ = lambda s, o: greater_equal(s, o)
+    T.__invert__ = lambda s: (bitwise_not(s) if not jnp.issubdtype(s.dtype, jnp.bool_)
+                              else logical_not(s))
+    T.__and__ = lambda s, o: (logical_and(s, o) if jnp.issubdtype(s.dtype, jnp.bool_)
+                              else bitwise_and(s, o))
+    T.__or__ = lambda s, o: (logical_or(s, o) if jnp.issubdtype(s.dtype, jnp.bool_)
+                             else bitwise_or(s, o))
+    T.__xor__ = lambda s, o: (logical_xor(s, o) if jnp.issubdtype(s.dtype, jnp.bool_)
+                              else bitwise_xor(s, o))
+    for nm in ("equal not_equal greater_than greater_equal less_than less_equal "
+               "logical_and logical_or logical_xor logical_not bitwise_and "
+               "bitwise_or bitwise_xor bitwise_not equal_all allclose isclose").split():
+        setattr(T, nm, globals()[nm])
+
+
+_install()

@@ -1,0 +1,378 @@
+"""Shape/manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .tensor import Tensor
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(s) for s in seq.tolist())
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in seq)
+
+
+def reshape(x, shape, name=None):
+    shp = _ints(shape)
+    return call(lambda a: jnp.reshape(a, shp), x, _name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def transpose(x, perm=None, name=None):
+    p = _ints(perm) if perm is not None else None
+    return call(lambda a: jnp.transpose(a, p), x, _name="transpose")
+
+
+def t(x, name=None):
+    def _t(a):
+        if a.ndim <= 1:
+            return a
+        return a.T
+    return call(_t, x, _name="t")
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return call(lambda xs: jnp.concatenate(xs, axis=ax), list(x), _name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return call(lambda xs: jnp.stack(xs, axis=int(axis)), list(x), _name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        outs = call(lambda a: tuple(jnp.split(a, n, axis=ax)), x, _name="split")
+    else:
+        secs = _ints(num_or_sections)
+        dim = x.shape[ax]
+        secs = list(secs)
+        if -1 in secs:
+            known = builtins_sum(s for s in secs if s != -1)
+            secs[secs.index(-1)] = dim - known
+        idx = np.cumsum(secs)[:-1].tolist()
+        outs = call(lambda a: tuple(jnp.split(a, idx, axis=ax)), x, _name="split")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+import builtins
+builtins_sum = builtins.sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    outs = call(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+                x, _name="unstack")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def _sq(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        axes = tuple(ax % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return call(_sq, x, _name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    def _usq(a):
+        out = a
+        nd = a.ndim + len(axes)
+        for ax in sorted(ax % nd for ax in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return call(_usq, x, _name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _fl(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return call(_fl, x, _name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    def _g(a, i):
+        i = i.reshape(-1) if i.ndim > 1 else i
+        return jnp.take(a, i, axis=ax)
+    return call(_g, x, index, _name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def _gnd(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return call(_gnd, x, index, _name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _sc(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        z = a.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return call(_sc, x, index, updates, _name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return call(_snd, x, index, updates, _name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    return scatter_nd_add(zeros(shape, dtype=updates.dtype), index, updates)
+
+
+def slice(input, axes, starts, ends):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    def _sl(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+    return call(_sl, input, _name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+    def _ss(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+    return call(_ss, x, _name="strided_slice")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return call(lambda a: jnp.tile(a, reps), x, _name="tile")
+
+
+def expand(x, shape, name=None):
+    shp = list(_ints(shape))
+    def _ex(a):
+        tgt = list(shp)
+        off = len(tgt) - a.ndim
+        for i in range(a.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = a.shape[i]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return call(_ex, x, _name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None):
+    outs = call(lambda xs: tuple(jnp.broadcast_arrays(*xs)), list(input),
+                _name="broadcast_tensors")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return call(lambda a: jnp.flip(a, axis=axes), x, _name="flip")
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = _ints(axis) if axis is not None and not isinstance(axis, int) else axis
+    return call(lambda a: jnp.roll(a, sh, axis=ax), x, _name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return call(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, _name="rot90")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # data-dependent output shape: host round-trip (same as reference CPU path)
+    arr = np.asarray(x.numpy())
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    outs = [Tensor(r.astype(_i64()) if i > 0 else r) for i, r in enumerate(res)]
+    if return_index is False and len(outs) > 1:
+        pass
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x.numpy())
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], dtype=bool)
+    keep[1:] = np.any(arr[1:] != arr[:-1],
+                      axis=tuple(range(1, arr.ndim))) if arr.ndim > 1 else arr[1:] != arr[:-1]
+    out = [Tensor(arr[keep])]
+    if return_inverse:
+        out.append(Tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        out.append(Tensor(counts.astype(_i64())))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def masked_select(x, mask, name=None):
+    arr = x.numpy()
+    m = mask.numpy().astype(bool)
+    return Tensor(arr[m])
+
+
+def index_select(x, index, axis=0, name=None):
+    return call(lambda a, i: jnp.take(a, i, axis=int(axis)), x, index,
+                _name="index_select")
+
+
+def index_sample(x, index):
+    def _is(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i]
+    return call(_is, x, index, _name="index_sample")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """ref: python/paddle/tensor/manipulation.py::shard_index — maps global
+    ids to per-shard local ids (sparse table sharding)."""
+    def _si(i):
+        size = (index_num + nshards - 1) // nshards
+        shard = i // size
+        local = i % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return call(_si, input, _name="shard_index")
+
+
+def moveaxis(x, source, destination, name=None):
+    return call(lambda a: jnp.moveaxis(a, source, destination), x, _name="moveaxis")
+
+
+def take_along_axis(arr, indices, axis):
+    return call(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices,
+                _name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    def _pa(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if jnp.ndim(v) else jnp.full(i.shape, v, a.dtype)
+        if reduce == "assign":
+            # build full index grids
+            idx = list(jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij"))
+            idx[axis] = i
+            return a.at[tuple(idx)].set(v)
+        idx = list(jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij"))
+        idx[axis] = i
+        if reduce == "add":
+            return a.at[tuple(idx)].add(v)
+        if reduce == "multiply":
+            return a.at[tuple(idx)].multiply(v)
+        raise ValueError(reduce)
+    return call(_pa, arr, indices, values, _name="put_along_axis")
+
+
+def as_complex(x, name=None):
+    return call(lambda a: a[..., 0] + 1j * a[..., 1], x, _name="as_complex")
+
+
+def as_real(x, name=None):
+    return call(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                _name="as_real")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.value if isinstance(repeats, Tensor) else repeats
+    return call(lambda a: jnp.repeat(a, r, axis=axis), x, _name="repeat_interleave")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else (0,) * len(shp)
+    def _crop(a):
+        idx = tuple(builtins.slice(o, o + s if s != -1 else None)
+                    for o, s in zip(offs, shp))
+        return a[idx]
+    return call(_crop, x, _name="crop")
+
+
+import builtins
+
+
+def _install():
+    T = Tensor
+    for nm in ("reshape reshape_ transpose t concat split chunk unbind squeeze "
+               "squeeze_ unsqueeze unsqueeze_ flatten flatten_ gather gather_nd "
+               "scatter scatter_ scatter_nd_add tile expand expand_as broadcast_to "
+               "flip roll rot90 unique unique_consecutive masked_select index_select "
+               "index_sample moveaxis take_along_axis put_along_axis "
+               "repeat_interleave unstack as_complex as_real").split():
+        setattr(T, nm, globals()[nm])
+
+
+_install()
+
+
+def _i64():
+    from ..framework import core as _c
+    return _c.convert_dtype("int64")

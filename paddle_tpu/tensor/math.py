@@ -1,0 +1,409 @@
+"""Math ops (ref: python/paddle/tensor/math.py).
+
+Every op is a thin jax/jnp primitive dispatched through ops.dispatch.call so
+it is eager-differentiable (tape) and trace-transparent (jit).  No per-op
+grad kernels: XLA differentiates (contrast ref paddle/fluid/operators/*_grad
+kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .tensor import Tensor
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------- factories
+def _unary(jfn, opname):
+    def op(x, name=None):
+        return call(jfn, x, _name=opname)
+    op.__name__ = opname
+    return op
+
+
+def _binary(jfn, opname):
+    def op(x, y, name=None):
+        return call(jfn, x, y, _name=opname)
+    op.__name__ = opname
+    return op
+
+
+# ---------------------------------------------------------------- basic
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+mod = remainder = floor_mod = _binary(jnp.remainder, "remainder")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+
+
+def divide(x, y, name=None):
+    def _div(a, b):
+        if (jnp.issubdtype(jnp.result_type(a), jnp.integer)
+                and jnp.issubdtype(jnp.result_type(b), jnp.integer)):
+            # paddle: int/int -> int truncated toward zero (C semantics),
+            # unlike jnp.floor_divide which floors toward -inf
+            dt = jnp.result_type(a, b)
+            a2, b2 = jnp.broadcast_arrays(jnp.asarray(a, dt),
+                                          jnp.asarray(b, dt))
+            return jax.lax.div(a2, b2)
+        return jnp.true_divide(a, b)
+    return call(_div, x, y, _name="divide")
+
+
+def pow(x, y, name=None):
+    return call(jnp.power, x, y, _name="pow")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _scale(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    out = call(lambda a: _scale(a, _v(scale), _v(bias)), x, _name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+abs = _unary(jnp.abs, "abs")
+ceil = _unary(jnp.ceil, "ceil")
+floor = _unary(jnp.floor, "floor")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(lambda x: jax.lax.rsqrt(x), "rsqrt")
+square = _unary(jnp.square, "square")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.lax.erf, "erf")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+sign = _unary(jnp.sign, "sign")
+neg = _unary(jnp.negative, "neg")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return call(lambda a: scale_b * jnp.tanh(scale_a * a), x, _name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    def _mpx(ins, idx):
+        stacked = jnp.stack(ins, axis=0)            # [n, batch, ...]
+        idx = idx.reshape(-1)
+        return jnp.take_along_axis(
+            stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+    return call(_mpx, list(inputs), index, _name="multiplex")
+
+
+# ---------------------------------------------------------------- reductions
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework import core
+    ax = _axis(axis)
+    dt = core.convert_dtype(dtype) if dtype else None
+    def _sum(a):
+        out = jnp.sum(a, axis=ax, keepdims=keepdim)
+        # paddle promotes bool/int sums to int64
+        if dt is not None:
+            out = out.astype(dt)
+        elif jnp.issubdtype(a.dtype, jnp.bool_) or a.dtype in (jnp.int32,):
+            out = out.astype(_i64())
+        return out
+    return call(_sum, x, _name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x, _name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework import core
+    ax = _axis(axis)
+    dt = core.convert_dtype(dtype) if dtype else None
+    return call(lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=dt),
+                x, _name="prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, _name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, _name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                x, _name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..framework import core
+    dt = core.convert_dtype(dtype) if dtype else None
+    def _cs(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+    return call(_cs, x, _name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..framework import core
+    dt = core.convert_dtype(dtype) if dtype else None
+    return call(lambda a: jnp.cumprod(a, axis=int(dim), dtype=dt), x, _name="cumprod")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(_i64()),
+                x, _name="count_nonzero")
+
+
+# ---------------------------------------------------------------- clip & tests
+def clip(x, min=None, max=None, name=None):
+    lo = _v(min) if min is not None else None
+    hi = _v(max) if max is not None else None
+    return call(lambda a: jnp.clip(a, lo, hi), x, _name="clip")
+
+
+isfinite = _unary(jnp.isfinite, "isfinite")
+isinf = _unary(jnp.isinf, "isinf")
+isnan = _unary(jnp.isnan, "isnan")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return call(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                x, _name="nan_to_num")
+
+
+# ---------------------------------------------------------------- linalg-ish
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return call(_mm, x, y, _name="matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    return call(lambda a, b: jnp.sum(a * b, axis=-1), x, y, _name="dot")
+
+
+def bmm(x, y, name=None):
+    return call(jnp.matmul, x, y, _name="bmm")
+
+
+def mv(x, vec, name=None):
+    return call(jnp.matmul, x, vec, _name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return call(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, _name="addmm")
+
+
+def inner(x, y, name=None):
+    return call(jnp.inner, x, y, _name="inner")
+
+
+def outer(x, y, name=None):
+    return call(lambda a, b: jnp.outer(a, b), x, y, _name="outer")
+
+
+def kron(x, y, name=None):
+    return call(jnp.kron, x, y, _name="kron")
+
+
+def multi_dot(x, name=None):
+    return call(lambda xs: jnp.linalg.multi_dot(xs), list(x), _name="multi_dot")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return call(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                x, _name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return call(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                x, _name="diagonal")
+
+
+# ---------------------------------------------------------------- misc
+def increment(x, value=1.0, name=None):
+    out = call(lambda a: a + value, x, _name="increment")
+    x._rebind(out)
+    return x
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x, _name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x, _name="any")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return call(lambda a, b: a + weight * (b - a), x, y, _name="lerp")
+    return call(lambda a, b, w: a + w * (b - a), x, y, weight, _name="lerp")
+
+
+def deg2rad(x, name=None):
+    return call(jnp.deg2rad, x, _name="deg2rad")
+
+
+def rad2deg(x, name=None):
+    return call(jnp.rad2deg, x, _name="rad2deg")
+
+
+def gcd(x, y, name=None):
+    return call(jnp.gcd, x, y, _name="gcd")
+
+
+def lcm(x, y, name=None):
+    return call(jnp.lcm, x, y, _name="lcm")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = _v(prepend) if prepend is not None else None
+    app = _v(append) if append is not None else None
+    return call(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                x, _name="diff")
+
+
+def angle(x, name=None):
+    return call(jnp.angle, x, _name="angle")
+
+
+def conj(x, name=None):
+    return call(jnp.conj, x, _name="conj")
+
+
+def real(x, name=None):
+    return call(jnp.real, x, _name="real")
+
+
+def imag(x, name=None):
+    return call(jnp.imag, x, _name="imag")
+
+
+# ---------------------------------------------------------------- operator overloads
+def _swap(fn):
+    def rop(self, other):
+        return fn(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other)), self)
+    return rop
+
+
+def _install():
+    T = Tensor
+    T.__add__ = lambda s, o: add(s, o)
+    T.__radd__ = lambda s, o: add(s, o)
+    T.__sub__ = lambda s, o: subtract(s, o)
+    T.__rsub__ = _swap(subtract)
+    T.__mul__ = lambda s, o: multiply(s, o)
+    T.__rmul__ = lambda s, o: multiply(s, o)
+    T.__truediv__ = lambda s, o: divide(s, o)
+    T.__rtruediv__ = _swap(divide)
+    T.__floordiv__ = lambda s, o: floor_divide(s, o)
+    T.__rfloordiv__ = _swap(floor_divide)
+    T.__mod__ = lambda s, o: mod(s, o)
+    T.__rmod__ = _swap(mod)
+    T.__pow__ = lambda s, o: pow(s, o)
+    T.__rpow__ = _swap(pow)
+    T.__matmul__ = lambda s, o: matmul(s, o)
+    T.__rmatmul__ = _swap(matmul)
+    T.__neg__ = lambda s: neg(s)
+    T.__abs__ = lambda s: abs(s)
+    T.__iadd__ = lambda s, o: s._rebind(add(s, o))
+    T.__isub__ = lambda s, o: s._rebind(subtract(s, o))
+    T.__imul__ = lambda s, o: s._rebind(multiply(s, o))
+    T.__itruediv__ = lambda s, o: s._rebind(divide(s, o))
+
+    for nm in ("add subtract multiply divide pow matmul mm bmm mv dot inner outer "
+               "kron addmm floor_divide mod remainder maximum minimum fmax fmin "
+               "atan2 abs ceil floor round trunc exp expm1 log log2 log10 log1p "
+               "sqrt rsqrt square sin cos tan asin acos atan sinh cosh tanh asinh "
+               "acosh atanh erf reciprocal sign neg sigmoid stanh digamma lgamma "
+               "sum mean prod max min amax amin logsumexp cumsum cumprod clip "
+               "isfinite isinf isnan nan_to_num all any scale increment trace "
+               "diagonal lerp multiplex count_nonzero deg2rad rad2deg gcd lcm diff "
+               "angle conj real imag").split():
+        setattr(T, nm, globals()[nm])
+    T.multiply_ = lambda s, o: s._rebind(multiply(s, o))
+    T.add_ = lambda s, o: s._rebind(add(s, o))
+    T.subtract_ = lambda s, o: s._rebind(subtract(s, o))
+    T.clip_ = lambda s, lo=None, hi=None: s._rebind(clip(s, lo, hi))
+    T.scale_ = lambda s, *a, **k: s._rebind(scale(s, *a, **k))
+    T.tanh_ = lambda s: s._rebind(tanh(s))
+    T.exp_ = lambda s: s._rebind(exp(s))
+    T.sqrt_ = lambda s: s._rebind(sqrt(s))
+    T.reciprocal_ = lambda s: s._rebind(reciprocal(s))
+
+
+_install()
+
+
+def _i64():
+    from ..framework import core as _c
+    return _c.convert_dtype("int64")

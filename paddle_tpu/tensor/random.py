@@ -1,0 +1,142 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+Functional JAX PRNG under the hood: each eager call consumes a fresh subkey
+from the global Generator (framework/core.py), so the API looks stateful like
+the reference's Philox generator but stays reproducible via paddle.seed().
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from .tensor import Tensor
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default or core.get_default_dtype()
+    return core.convert_dtype(dtype)
+
+
+def _shape(shape):
+    from .creation import _shape as s
+    return s(shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(core.next_rng_key(), _shape(shape),
+                                     dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(core.next_rng_key(), _shape(shape),
+                                    dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.value if isinstance(mean, Tensor) else mean
+        s = std.value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(core.next_rng_key(), shp,
+                                        core.get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(core.next_rng_key(), shp,
+                                    core.get_default_dtype()) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(core.next_rng_key(), _shape(shape),
+                                     low, high, dtype=_dt(dtype or "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(core.next_rng_key(), tuple(x.shape), low,
+                                     high, dtype=_dt(dtype, x.dtype)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(core.next_rng_key(),
+                                         jnp.arange(n, dtype=_dt(dtype or "int64"))))
+
+
+def bernoulli(x, name=None):
+    p = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(core.next_rng_key(), p).astype(
+        p.dtype if jnp.issubdtype(p.dtype, jnp.floating) else jnp.float32))
+
+
+def poisson(x, name=None):
+    lam = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(core.next_rng_key(), lam).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(core.next_rng_key(), logits,
+                                     shape=(*p.shape[:-1], num_samples)
+                                     if p.ndim > 1 else (num_samples,),
+                                     axis=-1)
+        return Tensor(out.astype(_i64()))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(core.next_rng_key(), p.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(_i64()))
+
+
+def shuffle(x, axis=0, name=None):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.permutation(core.next_rng_key(), v, axis=axis,
+                                         independent=False))
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = jax.random.exponential(core.next_rng_key(), tuple(x.shape), x.dtype) / lam
+    x.set_value(v)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x.set_value(jax.random.uniform(core.next_rng_key(), tuple(x.shape),
+                                   x.dtype, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.set_value(jax.random.normal(core.next_rng_key(), tuple(x.shape),
+                                  x.dtype) * std + mean)
+    return x
+
+
+def _install():
+    Tensor.uniform_ = uniform_
+    Tensor.normal_ = normal_
+    Tensor.exponential_ = exponential_
+    Tensor.bernoulli = bernoulli
+    Tensor.multinomial = multinomial
+
+
+_install()
+
+
+def _i64():
+    from ..framework import core as _c
+    return _c.convert_dtype("int64")

@@ -1,0 +1,145 @@
+"""Search/sort ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework import core
+    dt = core.convert_dtype(dtype)
+    def _am(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return call(_am, x, _name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework import core
+    dt = core.convert_dtype(dtype)
+    def _am(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return call(_am, x, _name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _as(a):
+        idx = jnp.argsort(a, axis=int(axis), descending=descending)
+        return idx.astype(_i64())
+    return call(_as, x, _name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return call(lambda a: jnp.sort(a, axis=int(axis), descending=descending),
+                x, _name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    def _tk(a):
+        ax = -1 if axis is None else int(axis)
+        src = a if largest else -a
+        src_m = jnp.moveaxis(src, ax, -1)
+        vals, idx = jax.lax.top_k(src_m, kk)
+        if not largest:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(_i64())
+    return call(_tk, x, _name="topk")
+
+
+import jax
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return call(lambda c, a, b: jnp.where(c, a, b), condition, x, y, _name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x.numpy())
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(_i64()).reshape(-1, 1)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(_i64()))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+    return _is(x, index, axis)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return call(lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
+                sorted_sequence, values, _name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kv(a):
+        ax = int(axis)
+        vals = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax)
+        v = jnp.take(vals, k - 1, axis=ax)
+        i = jnp.take(idxs, k - 1, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i.astype(_i64())
+    return call(_kv, x, _name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x.numpy())
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.nonzero(row == best)[0][-1]
+    shp = moved.shape[:-1]
+    v = vals.reshape(shp)
+    i = idxs.reshape(shp)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        i = np.expand_dims(i, ax)
+    return Tensor(v), Tensor(i)
+
+
+def _install():
+    T = Tensor
+    for nm in ("argmax argmin argsort sort topk where nonzero searchsorted "
+               "bucketize kthvalue mode").split():
+        setattr(T, nm, globals()[nm])
+
+
+_install()
+
+
+def _i64():
+    from ..framework import core as _c
+    return _c.convert_dtype("int64")

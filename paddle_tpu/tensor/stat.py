@@ -1,0 +1,58 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .tensor import Tensor
+from .math import _axis
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    from .math import mean as _m
+    return _m(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return call(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                x, _name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return call(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                x, _name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = None if axis is None else int(axis)
+    return call(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x,
+                _name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return call(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x,
+                _name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = None if axis is None else int(axis)
+    return call(lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax,
+                                       keepdims=keepdim), x, _name="quantile")
+
+
+def numel(x, name=None):
+    from .creation import numel as _n
+    return _n(x)
+
+
+def _install():
+    for nm in ("std var median nanmedian quantile").split():
+        setattr(Tensor, nm, globals()[nm])
+
+
+_install()

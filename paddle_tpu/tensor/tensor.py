@@ -1,0 +1,335 @@
+"""The Tensor: a Paddle-API wrapper over ``jax.Array``.
+
+TPU-native replacement of the reference's VarBase/LoDTensor
+(ref: paddle/fluid/imperative/layer.h, paddle/fluid/framework/tensor.h).
+The reference owns raw device buffers and per-device kernels; here the
+payload is a ``jax.Array`` (or a tracer inside a functional trace), so XLA
+owns layout/memory and the same Tensor code runs eagerly or staged under jit.
+
+Most math/manipulation methods are monkey-patched onto this class by the
+sibling modules (creation/math/manipulation/...) at import time, mirroring
+how the reference binds ``python/paddle/tensor/*`` onto VarBase.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..autograd import tape
+from ..ops import dispatch
+
+
+def _to_jax_value(data, dtype=None, place=None):
+    if isinstance(data, Tensor):
+        val = data.value
+    elif isinstance(data, (jax.Array, jax.core.Tracer)):
+        val = data
+    elif isinstance(data, np.ndarray):
+        val = jnp.asarray(data)
+    elif isinstance(data, (bool, int, float, complex)):
+        if dtype is None and isinstance(data, float):
+            dtype = core.get_default_dtype()
+        val = jnp.asarray(data, dtype=dtype)
+    elif isinstance(data, (list, tuple, range)):
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            dtype = core.get_default_dtype()
+        val = jnp.asarray(arr, dtype=dtype)
+    else:
+        val = jnp.asarray(np.asarray(data))
+    if dtype is not None:
+        dtype = core.convert_dtype(dtype)
+        if val.dtype != dtype:
+            val = val.astype(dtype)
+    return val
+
+
+class Tensor:
+    __slots__ = ("value", "stop_gradient", "_node", "_node_index", "_grad",
+                 "name", "persistable", "_weakref_slot", "__weakref__")
+
+    _next_id = [0]
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if data is None:
+            data = jnp.zeros((), core.get_default_dtype())
+        self.value = _to_jax_value(data, dtype, place)
+        self.stop_gradient = bool(stop_gradient)
+        self._node = None
+        self._node_index = 0
+        self._grad = None
+        if name is None:
+            Tensor._next_id[0] += 1
+            name = f"tensor_{Tensor._next_id[0]}"
+        self.name = name
+        self.persistable = False
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    ndimension = ndim
+    rank = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.value.shape)) if self.value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = list(self.value.devices())[0]
+            if dev.platform == "cpu":
+                return core.CPUPlace()
+            return core.TPUPlace(dev.id)
+        except Exception:
+            return core.get_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # -- grad --------------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        g = Tensor(self._grad)
+        g.stop_gradient = True
+        return g
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = None if g is None else (g.value if isinstance(g, Tensor) else jnp.asarray(g))
+
+    def _accumulate_grad(self, g):
+        if self._grad is None:
+            self._grad = g
+        else:
+            self._grad = self._grad + g
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        tape.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self.value))
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        dtype = core.convert_dtype(dtype)
+        return dispatch.call(lambda x: x.astype(dtype), self, _name="astype")
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def detach(self):
+        t = Tensor(self.value)
+        t.stop_gradient = True
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return dispatch.call(lambda x: x + jnp.zeros((), x.dtype)
+                             if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x),
+                             self, _name="clone")
+
+    def cpu(self):
+        t = Tensor(jax.device_put(self.value, jax.devices("cpu")[0]))
+        t.stop_gradient = self.stop_gradient
+        return t
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in core._DTYPE_ALIASES:
+                out = out.astype(a)
+            elif isinstance(a, (str, core.Place)):
+                dev = (a.jax_device() if isinstance(a, core.Place)
+                       else core._parse_device(a).jax_device())
+                t = Tensor(jax.device_put(out.value, dev))
+                t.stop_gradient = out.stop_gradient
+                out = t
+            else:
+                out = out.astype(a)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    # -- mutation ----------------------------------------------------------
+    def set_value(self, value):
+        """In-place payload replacement (param updates, checkpoint load)."""
+        new = _to_jax_value(value)
+        if tuple(new.shape) != tuple(self.value.shape):
+            new = jnp.broadcast_to(new, self.value.shape)
+        if new.dtype != self.value.dtype:
+            new = new.astype(self.value.dtype)
+        self.value = new
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self.value = jnp.full_like(self.value, v)
+        return self
+
+    def zero_(self):
+        self.value = jnp.zeros_like(self.value)
+        return self
+
+    def _rebind(self, other: "Tensor"):
+        """Adopt another tensor's value and autograd linkage (for in-place
+        style APIs implemented out-of-place)."""
+        self.value = other.value
+        self._node = other._node
+        self._node_index = other._node_index
+        self.stop_gradient = other.stop_gradient
+        ov = getattr(other, "_weakref_slot", None)
+        if ov is not None:  # static-graph var identity follows the rebind
+            self._weakref_slot = ov
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    def _index(self, item):
+        if isinstance(item, Tensor):
+            return item.value
+        if isinstance(item, tuple):
+            return tuple(self._index(i) for i in item)
+        if isinstance(item, list):
+            return jnp.asarray(np.asarray(item))
+        return item
+
+    def __getitem__(self, item):
+        idx = self._index(item)
+        return dispatch.call(lambda x: x[idx], self, _name="getitem")
+
+    def __setitem__(self, item, val):
+        idx = self._index(item)
+        v = val.value if isinstance(val, Tensor) else val
+        out = dispatch.call(lambda x, vv: x.at[idx].set(vv), self,
+                            val if isinstance(val, Tensor) else Tensor(jnp.asarray(v)),
+                            _name="setitem")
+        self._rebind(out)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- python scalar protocol -------------------------------------------
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __reduce__(self):
+        # picklable via numpy payload; graph linkage is not serialized
+        return (_rebuild_tensor, (type(self), self.numpy(),
+                                  self.stop_gradient, self.name))
+
+    def __repr__(self):
+        prefix = "Parameter" if isinstance(self, Parameter) else "Tensor"
+        try:
+            data = np.array2string(self.numpy(), separator=", ", prefix="       ")
+        except Exception:
+            data = f"<traced {self.value}>"
+        return (f"{prefix}(shape={self.shape}, dtype={core.dtype_name(self.dtype)}, "
+                f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+                f"       {data})")
+
+    __str__ = __repr__
+
+    # -- operators (implementations patched in math.py/logic.py) ----------
+    @property
+    def T(self):
+        return dispatch.call(lambda x: x.T, self, _name="T")
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: python/paddle/fluid/framework.py::Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "_sharding_axes")
+
+    def __init__(self, data=None, dtype=None, stop_gradient=False, name=None,
+                 trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+        self._sharding_axes = None  # PartitionSpec hint for fleet/GSPMD
+
+    def __deepcopy__(self, memo):
+        p = Parameter(self.value, trainable=self.trainable, name=self.name + "_copy")
+        return p
+
+
+def _rebuild_tensor(cls, arr, stop_gradient, name):
+    if cls is Parameter:
+        t = Parameter(arr, name=name, trainable=not stop_gradient)
+    else:
+        t = Tensor(arr, name=name)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (ref: python/paddle/tensor/creation.py::to_tensor)."""
+    t = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    return t

@@ -1,0 +1,113 @@
+"""Synthetic text datasets with reference-matching schemas
+(ref: python/paddle/text/datasets/*)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _Synthetic(Dataset):
+    n = 1024
+    seed = 0
+
+    def __init__(self, mode="train", **kwargs):
+        self.mode = mode
+        self.rng = np.random.RandomState(self.seed + (0 if mode == "train"
+                                                      else 1))
+        self._build()
+
+    def _build(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class UCIHousing(_Synthetic):
+    """13 features -> price (ref schema: uci_housing)."""
+
+    def _build(self):
+        x = self.rng.randn(self.n, 13).astype(np.float32)
+        w = self.rng.randn(13).astype(np.float32)
+        y = (x @ w + 0.1 * self.rng.randn(self.n)).astype(np.float32)
+        self.data = [(x[i], y[i:i + 1]) for i in range(self.n)]
+
+
+class Imdb(_Synthetic):
+    """token ids + binary sentiment label."""
+    vocab_size = 5147
+
+    def _build(self):
+        self.word_idx = {f"w{i}": i for i in range(self.vocab_size)}
+        self.data = []
+        for i in range(self.n):
+            L = self.rng.randint(10, 120)
+            doc = self.rng.randint(0, self.vocab_size, L).astype(np.int64)
+            label = np.int64(self.rng.randint(0, 2))
+            self.data.append((doc, label))
+
+
+class Imikolov(_Synthetic):
+    """n-gram LM tuples."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 **kwargs):
+        self.window_size = window_size
+        super().__init__(mode)
+
+    def _build(self):
+        V = 2000
+        self.data = []
+        for i in range(self.n):
+            ctx = self.rng.randint(0, V, self.window_size).astype(np.int64)
+            self.data.append(tuple(ctx))
+
+
+class Movielens(_Synthetic):
+    def _build(self):
+        self.data = []
+        for i in range(self.n):
+            uid = np.int64(self.rng.randint(1, 6041))
+            gender = np.int64(self.rng.randint(0, 2))
+            age = np.int64(self.rng.randint(0, 7))
+            job = np.int64(self.rng.randint(0, 21))
+            mid = np.int64(self.rng.randint(1, 3953))
+            rating = np.float32(self.rng.randint(1, 6))
+            self.data.append((uid, gender, age, job, mid, rating))
+
+
+class Conll05st(_Synthetic):
+    def _build(self):
+        V, L = 5000, 30
+        self.data = []
+        for i in range(self.n):
+            words = self.rng.randint(0, V, L).astype(np.int64)
+            preds = self.rng.randint(0, V, L).astype(np.int64)
+            labels = self.rng.randint(0, 67, L).astype(np.int64)
+            self.data.append((words, preds, labels))
+
+
+class _WMT(_Synthetic):
+    src_vocab = 30000
+    tgt_vocab = 30000
+
+    def _build(self):
+        self.data = []
+        for i in range(self.n):
+            ls = self.rng.randint(5, 50)
+            lt = self.rng.randint(5, 50)
+            src = self.rng.randint(0, self.src_vocab, ls).astype(np.int64)
+            tgt = self.rng.randint(0, self.tgt_vocab, lt).astype(np.int64)
+            self.data.append((src, tgt[:-1], tgt[1:]))
+
+
+class WMT14(_WMT):
+    pass
+
+
+class WMT16(_WMT):
+    pass

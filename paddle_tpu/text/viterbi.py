@@ -1,0 +1,48 @@
+"""Viterbi decode (ref: paddle.text.viterbi_decode in later paddle; CRF
+decoding from fluid linear_chain_crf_op) — lax.scan dynamic program."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from ..tensor.tensor import Tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    def _vit(emissions, trans):
+        # emissions: [B, T, N], trans: [N, N]
+        B, T, N = emissions.shape
+
+        def step(carry, emit_t):
+            score = carry  # [B, N]
+            # score[b, i] + trans[i, j] + emit[b, j]
+            total = score[:, :, None] + trans[None, :, :]
+            best = jnp.max(total, axis=1)
+            idx = jnp.argmax(total, axis=1)
+            return best + emit_t, idx
+
+        init = emissions[:, 0]
+        scores, backptrs = jax.lax.scan(
+            step, init, jnp.moveaxis(emissions[:, 1:], 1, 0))
+        last = jnp.argmax(scores, axis=-1)  # [B]
+
+        def backtrack(carry, bp_t):
+            tag = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(backtrack, last, backptrs, reverse=True)
+        path = jnp.concatenate([path_rev, last[None]], axis=0)
+        return jnp.max(scores, -1), jnp.moveaxis(path, 0, 1).astype(jnp.int32)
+
+    return call(_vit, potentials, transition_params, _name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

@@ -1,0 +1,3 @@
+from . import lazy
+from .lazy import flops, try_import
+from .download import get_weights_path_from_url

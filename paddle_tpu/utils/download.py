@@ -1,0 +1,21 @@
+"""Download shim (ref: python/paddle/utils/download.py).
+
+Zero-egress environment: URLs are not fetched; pretrained weights resolve to
+freshly initialized parameters and a local cache path is returned.
+"""
+from __future__ import annotations
+
+import os
+
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    fname = os.path.join(WEIGHTS_HOME, os.path.basename(url))
+    # no network: create an empty marker; model loaders treat missing/empty
+    # weight files as "use fresh initialization"
+    if not os.path.exists(fname):
+        open(fname, "wb").close()
+    return fname

@@ -1,0 +1,17 @@
+"""Version info (ref: python/paddle/version.py generated at build)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit})")
+
+
+def mkl():
+    return with_mkl

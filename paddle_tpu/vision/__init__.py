@@ -1,0 +1,14 @@
+"""paddle_tpu.vision (ref: python/paddle/vision/__init__.py)."""
+from . import datasets
+from . import models
+from . import transforms
+from .models import *  # noqa: F401,F403
+from .datasets import MNIST, FashionMNIST, Cifar10, Cifar100, Flowers  # noqa
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,5 @@
+from .mnist import MNIST, FashionMNIST
+from .cifar import Cifar10, Cifar100
+from .flowers import Flowers
+from .folder import DatasetFolder, ImageFolder
+from .voc2012 import VOC2012

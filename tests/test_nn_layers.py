@@ -1,0 +1,369 @@
+"""Layer tests: shapes, values vs golden, state_dict round-trips
+(SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def randt(*shape, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32))
+
+
+class TestLinearEmbedding:
+    def test_linear(self):
+        l = nn.Linear(4, 3)
+        x = randt(2, 4)
+        out = l(x)
+        assert out.shape == [2, 3]
+        ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_bilinear(self):
+        b = nn.Bilinear(3, 4, 5)
+        out = b(randt(2, 3), randt(2, 4, seed=1))
+        assert out.shape == [2, 5]
+
+    def test_flatten_identity(self):
+        assert nn.Flatten()(randt(2, 3, 4)).shape == [2, 12]
+        x = randt(2, 2)
+        assert (nn.Identity()(x) is x)
+
+
+class TestConv:
+    def test_conv2d_shape_value(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b), stride=2, padding=1)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=2, padding=1).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_groups_dilation(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.randn(1, 4, 9, 9).astype(np.float32)
+        w = np.random.randn(8, 2, 3, 3).astype(np.float32)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), groups=2,
+                       dilation=2)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), groups=2,
+                        dilation=2).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_3d(self):
+        out = F.conv1d(randt(2, 3, 10), randt(4, 3, 3, seed=1), padding=1)
+        assert out.shape == [2, 4, 10]
+        out = F.conv3d(randt(1, 2, 5, 5, 5), randt(3, 2, 2, 2, 2, seed=1))
+        assert out.shape == [1, 3, 4, 4, 4]
+
+    def test_conv2d_transpose(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.randn(1, 3, 5, 5).astype(np.float32)
+        w = np.random.randn(3, 4, 3, 3).astype(np.float32)
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, padding=1)
+        ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                                  padding=1).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_layer(self):
+        c = nn.Conv2D(3, 8, 3, padding=1)
+        assert c(randt(2, 3, 6, 6)).shape == [2, 8, 6, 6]
+
+
+class TestPooling:
+    def test_max_avg_pool2d(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = TF.max_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(out.numpy(), ref)
+        out = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1)
+        ref = TF.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                            count_include_pad=False).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_adaptive(self):
+        x = randt(2, 3, 8, 8)
+        assert F.adaptive_avg_pool2d(x, 1).shape == [2, 3, 1, 1]
+        assert F.adaptive_avg_pool2d(x, (2, 4)).shape == [2, 3, 2, 4]
+        assert F.adaptive_max_pool2d(x, 3).shape == [2, 3, 3, 3]
+        # non-divisible
+        assert F.adaptive_avg_pool2d(randt(1, 2, 7, 7), 3).shape == [1, 2, 3, 3]
+
+    def test_pool1d_3d(self):
+        assert F.max_pool1d(randt(2, 3, 8), 2).shape == [2, 3, 4]
+        assert F.avg_pool3d(randt(1, 2, 4, 4, 4), 2).shape == [1, 2, 2, 2, 2]
+
+
+class TestNorm:
+    def test_layer_norm_value(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        ln = nn.LayerNorm(4)
+        out = ln(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sig = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(sig + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = randt(4, 3, 5, 5)
+        bn.train()
+        out = bn(x)
+        xn = x.numpy()
+        ref = (xn - xn.mean((0, 2, 3), keepdims=True)) / np.sqrt(
+            xn.var((0, 2, 3), keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+        # running stats moved
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_group_instance_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(randt(2, 4, 3, 3)).shape == [2, 4, 3, 3]
+        inorm = nn.InstanceNorm2D(3)
+        x = randt(2, 3, 4, 4)
+        out = inorm(x).numpy()
+        np.testing.assert_allclose(out.mean((2, 3)), np.zeros((2, 3)),
+                                   atol=1e-5)
+
+    def test_rms_norm(self):
+        rn = nn.RMSNorm(8)
+        x = randt(2, 8)
+        out = rn(x).numpy()
+        ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True)
+                                  + 1e-6)
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_spectral_weight_norm_utils(self):
+        l = nn.Linear(4, 4)
+        nn.utils.weight_norm(l, "weight")
+        assert "weight_g" in l._parameters and "weight_v" in l._parameters
+        out = l(randt(2, 4))
+        assert out.shape == [2, 4]
+        nn.utils.remove_weight_norm(l)
+        assert "weight" in l._parameters
+
+        l2 = nn.Linear(4, 4)
+        nn.utils.spectral_norm(l2, "weight")
+        assert l2(randt(2, 4)).shape == [2, 4]
+
+
+class TestActivationsDropout:
+    def test_activation_values(self):
+        x = np.linspace(-3, 3, 13).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(F.hardswish(t).numpy(),
+                                   x * np.clip(x + 3, 0, 6) / 6, rtol=1e-5)
+        np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        sm = F.softmax(paddle.to_tensor(np.random.randn(2, 5).astype(np.float32)))
+        np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(2), rtol=1e-5)
+
+    def test_all_activation_layers_run(self):
+        x = randt(2, 6)
+        for cls in [nn.ReLU, nn.ReLU6, nn.Sigmoid, nn.Tanh, nn.Silu,
+                    nn.Swish, nn.Mish, nn.Hardswish, nn.LogSigmoid,
+                    nn.Softsign, nn.Tanhshrink, nn.ELU, nn.SELU, nn.GELU,
+                    nn.LeakyReLU, nn.Hardshrink, nn.Hardsigmoid, nn.Hardtanh,
+                    nn.Softplus, nn.Softshrink, nn.ThresholdedReLU,
+                    nn.Softmax, nn.LogSoftmax]:
+            assert cls()(x).shape == [2, 6]
+        assert nn.Maxout(3, axis=1)(x).shape == [2, 2]
+        assert nn.PReLU()(x).shape == [2, 6]
+
+    def test_dropout(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.train()
+        out = d(x).numpy()
+        frac = (out == 0).mean()
+        assert 0.3 < frac < 0.7
+        # upscale preserves expectation
+        assert abs(out.mean() - 1.0) < 0.1
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([1, 0, 4, 2])
+        loss = nn.CrossEntropyLoss()(paddle.to_tensor(logits),
+                                     paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_weight(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        valid = [0, 2, 3]
+        ref = -np.log(p[valid, labels[valid]]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_mse_l1_smooth(self):
+        a, b = randt(3, 4), randt(3, 4, seed=1)
+        np.testing.assert_allclose(
+            nn.MSELoss()(a, b).numpy(), ((a.numpy() - b.numpy()) ** 2).mean(),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            nn.L1Loss()(a, b).numpy(),
+            np.abs(a.numpy() - b.numpy()).mean(), rtol=1e-5)
+        assert nn.SmoothL1Loss()(a, b).numpy() > 0
+
+    def test_bce(self):
+        p = paddle.to_tensor(np.random.uniform(0.1, 0.9, (4,)).astype(np.float32))
+        y = paddle.to_tensor(np.array([1.0, 0.0, 1.0, 0.0], np.float32))
+        ref = -(y.numpy() * np.log(p.numpy())
+                + (1 - y.numpy()) * np.log(1 - p.numpy())).mean()
+        np.testing.assert_allclose(nn.BCELoss()(p, y).numpy(), ref, rtol=1e-5)
+        logits = paddle.to_tensor(np.random.randn(4).astype(np.float32))
+        l1 = nn.BCEWithLogitsLoss()(logits, y)
+        l2 = nn.BCELoss()(F.sigmoid(logits), y)
+        np.testing.assert_allclose(l1.numpy(), l2.numpy(), rtol=1e-4)
+
+    def test_kl_nll(self):
+        logp = F.log_softmax(randt(3, 5))
+        y = F.softmax(randt(3, 5, seed=1))
+        assert nn.KLDivLoss()(logp, y).numpy() is not None
+        labels = paddle.to_tensor(np.array([1, 2, 0]))
+        nll = F.nll_loss(logp, labels)
+        ce = F.cross_entropy(randt(3, 5), labels)
+        assert np.isfinite(nll.numpy())
+
+    def test_ctc_loss(self):
+        T, B, C, L = 12, 2, 6, 4
+        logits = randt(T, B, C)
+        labels = paddle.to_tensor(np.random.randint(1, C, (B, L)))
+        in_len = paddle.to_tensor(np.array([T, T]))
+        lab_len = paddle.to_tensor(np.array([L, 3]))
+        loss = F.ctc_loss(logits, labels, in_len, lab_len)
+        assert np.isfinite(loss.numpy()) and loss.numpy() > 0
+
+    def test_hsigmoid(self):
+        hs = nn.HSigmoidLoss(8, 10)
+        loss = hs(randt(4, 8), paddle.to_tensor(np.array([1, 5, 3, 9])))
+        assert np.isfinite(loss.numpy())
+
+
+class TestContainersStateDict:
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert seq(randt(3, 4)).shape == [3, 2]
+        assert len(seq) == 3
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        m2.set_state_dict(m1.state_dict())
+        x = randt(3, 4)
+        m1.eval(), m2.eval()
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_named_parameters_hooks(self):
+        l = nn.Linear(2, 3)
+        names = [n for n, _ in l.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        calls = []
+        h = l.register_forward_post_hook(lambda lay, i, o: calls.append(1))
+        l(randt(1, 2))
+        assert calls == [1]
+        h.remove()
+        l(randt(1, 2))
+        assert calls == [1]
+
+    def test_train_eval_propagate(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_save_load(self, tmp_path):
+        m = nn.Linear(3, 3)
+        paddle.save(m.state_dict(), str(tmp_path / "model.pdparams"))
+        state = paddle.load(str(tmp_path / "model.pdparams"))
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict(state)
+        np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+class TestPadUpsample:
+    def test_pad(self):
+        x = randt(1, 2, 3, 3)
+        assert F.pad(x, [1, 1, 2, 2]).shape == [1, 2, 7, 5]
+        assert nn.Pad2D(1)(x).shape == [1, 2, 5, 5]
+        assert nn.Pad1D(2)(randt(1, 2, 5)).shape == [1, 2, 9]
+
+    def test_interpolate(self):
+        x = randt(1, 2, 4, 4)
+        assert F.interpolate(x, size=[8, 8]).shape == [1, 2, 8, 8]
+        assert F.interpolate(x, scale_factor=2, mode="bilinear").shape \
+            == [1, 2, 8, 8]
+        assert nn.UpsamplingNearest2D(scale_factor=2)(x).shape == [1, 2, 8, 8]
+
+    def test_pixel_shuffle_unfold(self):
+        x = randt(1, 8, 3, 3)
+        assert F.pixel_shuffle(x, 2).shape == [1, 2, 6, 6]
+        out = F.unfold(randt(1, 2, 5, 5), 3)
+        assert out.shape == [1, 18, 9]
+
+
+def test_dropout_downscale_in_infer():
+    # regression: inference must scale by (1-p) in downscale mode
+    x = paddle.ones([4, 4])
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), np.full((4, 4), 0.5))
+    out = F.dropout(x, p=0.5, training=False, mode="upscale_in_train")
+    np.testing.assert_allclose(out.numpy(), np.ones((4, 4)))
+
+
+def test_divide_int_truncates_toward_zero():
+    a = paddle.to_tensor([-7, 7], dtype="int32")
+    b = paddle.to_tensor([2, 2], dtype="int32")
+    np.testing.assert_allclose((a / b).numpy(), [-3, 3])
+
+
+def test_spectral_norm_u_persists():
+    l = nn.Linear(6, 6)
+    nn.utils.spectral_norm(l, "weight")
+    u0 = l.weight_u.numpy().copy()
+    x = randt(2, 6)
+    l(x)
+    u1 = l.weight_u.numpy().copy()
+    assert not np.allclose(u0, u1), "power iteration state must persist"
+    # after many forwards sigma(normalized weight) -> 1
+    for _ in range(30):
+        l(x)
+    w = l.weight.numpy() if hasattr(l.weight, 'numpy') else None

@@ -1,0 +1,196 @@
+"""Optimizer + LR scheduler tests (SURVEY.md §4): closed-form step math and
+convergence on a quadratic bowl."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as optim
+
+
+def _quadratic_converges(opt_factory, steps=300, tol=1e-2):
+    paddle.seed(3)
+    w = paddle.to_tensor(np.array([5.0, -3.0], np.float32),
+                         stop_gradient=False)
+    w = paddle.Parameter(w.numpy()) if False else w
+    from paddle_tpu.tensor.tensor import Parameter
+    p = Parameter(np.array([5.0, -3.0], np.float32))
+    opt = opt_factory([p])
+    for _ in range(steps):
+        loss = ((p - paddle.to_tensor([1.0, 2.0])) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(p.numpy(), [1.0, 2.0], atol=tol * 10)
+    return float(loss)
+
+
+def test_sgd_step_math():
+    from paddle_tpu.tensor.tensor import Parameter
+    p = Parameter(np.array([1.0, 2.0], np.float32))
+    opt = optim.SGD(learning_rate=0.1, parameters=[p])
+    ((p * p).sum()).backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1 - 0.1 * 2, 2 - 0.1 * 4],
+                               rtol=1e-6)
+
+
+def test_momentum_math():
+    from paddle_tpu.tensor.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32))
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    (p * 3.0).sum().backward()
+    opt.step()  # v = 3; p = 1 - 0.3
+    opt.clear_grad()
+    np.testing.assert_allclose(p.numpy(), [0.7], rtol=1e-6)
+    (p * 3.0).sum().backward()
+    opt.step()  # v = 0.9*3 + 3 = 5.7; p = 0.7 - 0.57
+    np.testing.assert_allclose(p.numpy(), [0.13], rtol=1e-5)
+
+
+def test_adam_bias_correction_first_step():
+    from paddle_tpu.tensor.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32))
+    opt = optim.Adam(learning_rate=0.1, parameters=[p])
+    (p * 0.5).sum().backward()
+    opt.step()
+    # first Adam step ≈ -lr * sign(g)
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-4)
+
+
+@pytest.mark.parametrize("factory", [
+    lambda ps: optim.SGD(0.1, parameters=ps),
+    lambda ps: optim.Momentum(0.05, parameters=ps),
+    lambda ps: optim.Adam(0.2, parameters=ps),
+    lambda ps: optim.AdamW(0.2, parameters=ps, weight_decay=0.0),
+    lambda ps: optim.Adamax(0.3, parameters=ps),
+    lambda ps: optim.RMSProp(0.05, parameters=ps),
+    lambda ps: optim.Adagrad(0.5, parameters=ps),
+    lambda ps: optim.Adadelta(20.0, rho=0.9, parameters=ps),
+    # LAMB's trust ratio keeps |update| ∝ |w|, so on a toy bowl it orbits the
+    # optimum — accept a loose tolerance
+    lambda ps: optim.Lamb(0.05, parameters=ps, lamb_weight_decay=0.0),
+], ids=["sgd", "momentum", "adam", "adamw", "adamax", "rmsprop", "adagrad",
+        "adadelta", "lamb"])
+def test_quadratic_convergence(factory, request):
+    tol = 5e-2 if request.node.callspec.id == "lamb" else 1e-2
+    _quadratic_converges(factory, tol=tol)
+
+
+def test_weight_decay_and_clip():
+    from paddle_tpu.tensor.tensor import Parameter
+    import paddle_tpu.nn as nn
+    p = Parameter(np.array([10.0], np.float32))
+    opt = optim.SGD(0.1, parameters=[p], weight_decay=0.1)
+    (p * 0.0).sum().backward()
+    opt.step()
+    # g = 0 + 0.1*10 = 1 → p = 10 - 0.1
+    np.testing.assert_allclose(p.numpy(), [9.9], rtol=1e-5)
+
+    p2 = Parameter(np.array([1.0], np.float32))
+    opt2 = optim.SGD(1.0, parameters=[p2],
+                     grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    (p2 * 10.0).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(p2.numpy(), [0.5], rtol=1e-4)
+
+
+def test_state_dict_roundtrip():
+    from paddle_tpu.tensor.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32), name="p0")
+    opt = optim.Adam(0.1, parameters=[p])
+    (p * 2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    p2 = Parameter(np.array([1.0], np.float32), name="p0")
+    opt2 = optim.Adam(0.1, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        opt2._accumulators["moment1"][id(p2)],
+        opt._accumulators["moment1"][id(p)])
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = optim.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_multistep_exponential(self):
+        s = optim.lr.MultiStepDecay(1.0, [2, 4], gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(round(s(), 6))
+            s.step()
+        assert vals == [1.0, 1.0, 0.1, 0.1, 0.01]
+        e = optim.lr.ExponentialDecay(1.0, 0.5)
+        e.step()
+        np.testing.assert_allclose(e(), 0.5)
+
+    def test_warmup_cosine_noam(self):
+        w = optim.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                  end_lr=0.1)
+        first = w()
+        for _ in range(10):
+            w.step()
+        assert first < 0.02 and abs(w() - 0.1) < 1e-6
+        c = optim.lr.CosineAnnealingDecay(0.1, T_max=10)
+        assert abs(c() - 0.1) < 1e-9
+        for _ in range(10):
+            c.step()
+        assert c() < 1e-8
+        n = optim.lr.NoamDecay(64, warmup_steps=100)
+        lrs = [n()]
+        for _ in range(200):
+            n.step()
+            lrs.append(n())
+        assert max(lrs) == lrs[100]  # peak at warmup boundary
+
+    def test_reduce_on_plateau(self):
+        s = optim.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s() == pytest.approx(0.05)
+
+    def test_piecewise_lambda_poly(self):
+        pw = optim.lr.PiecewiseDecay([3, 6], [0.1, 0.05, 0.01])
+        assert pw() == 0.1
+        lam = optim.lr.LambdaDecay(0.5, lambda e: 1.0 / (e + 1))
+        assert lam() == 0.5
+        poly = optim.lr.PolynomialDecay(0.1, decay_steps=10, end_lr=0.0)
+        for _ in range(10):
+            poly.step()
+        assert poly() == pytest.approx(0.0, abs=1e-8)
+
+    def test_optimizer_uses_scheduler(self):
+        from paddle_tpu.tensor.tensor import Parameter
+        p = Parameter(np.array([1.0], np.float32))
+        sched = optim.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = optim.SGD(sched, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+
+def test_amp_grad_scaler():
+    from paddle_tpu.tensor.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32))
+    opt = optim.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    loss = (p * 3.0).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [0.7], rtol=1e-5)
+
+
+def test_auto_cast_context():
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        from paddle_tpu.amp.auto_cast import amp_state
+        assert amp_state() is not None
+    from paddle_tpu.amp.auto_cast import amp_state
+    assert amp_state() is None

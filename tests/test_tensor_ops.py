@@ -1,0 +1,294 @@
+"""Op-level numeric tests vs numpy golden (SURVEY.md §4; modeled on the
+reference's OpTest pattern in python/paddle/fluid/tests/unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def np_t(shape, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(dtype)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.arange(1, 10, 2).numpy(),
+                                   np.arange(1, 10, 2))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_eye_diag_tril_triu(self):
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        x = np_t((3, 3))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.tril(t).numpy(), np.tril(x))
+        np.testing.assert_allclose(paddle.triu(t).numpy(), np.triu(x))
+        np.testing.assert_allclose(paddle.diag(paddle.to_tensor([1., 2.])).numpy(),
+                                   np.diag([1., 2.]))
+
+    def test_like_ops(self):
+        x = paddle.to_tensor(np_t((2, 3)))
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.ones_like(x).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full_like(x, 3.0).numpy(),
+                                   np.full((2, 3), 3.0))
+
+    def test_meshgrid(self):
+        a, b = paddle.meshgrid(paddle.arange(3), paddle.arange(4))
+        assert a.shape == [3, 4] and b.shape == [3, 4]
+
+
+class TestMath:
+    def test_binary_elementwise(self):
+        x, y = np_t((3, 4)), np_t((3, 4), 1)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        np.testing.assert_allclose((tx + ty).numpy(), x + y, rtol=1e-6)
+        np.testing.assert_allclose((tx - ty).numpy(), x - y, rtol=1e-6)
+        np.testing.assert_allclose((tx * ty).numpy(), x * y, rtol=1e-6)
+        np.testing.assert_allclose((tx / ty).numpy(), x / y, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(tx, ty).numpy(),
+                                   np.maximum(x, y))
+        np.testing.assert_allclose(paddle.pow(tx, 2).numpy(), x ** 2,
+                                   rtol=1e-5)
+
+    def test_unary(self):
+        x = np.abs(np_t((3, 4))) + 0.5
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.sqrt(t).numpy(), np.sqrt(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.exp(t).numpy(), np.exp(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.log(t).numpy(), np.log(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.tanh(t).numpy(), np.tanh(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.rsqrt(t).numpy(), 1 / np.sqrt(x),
+                                   rtol=1e-5)
+
+    def test_reductions(self):
+        x = np_t((3, 4, 5))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.sum(t).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), x.sum(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(t, axis=[0, 2], keepdim=True).numpy(),
+            x.mean((0, 2), keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t, axis=0).numpy(), x.max(0))
+        np.testing.assert_allclose(paddle.prod(t, axis=2).numpy(), x.prod(2),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(paddle.logsumexp(t).numpy(),
+                                   np.log(np.exp(x).sum()), rtol=1e-5)
+
+    def test_matmul(self):
+        a, b = np_t((3, 4)), np_t((4, 5))
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                          transpose_y=True).numpy(), a @ b, rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        x = np_t((3, 4))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.clip(t, -0.5, 0.5).numpy(),
+                                   np.clip(x, -0.5, 0.5))
+
+    def test_divide_int(self):
+        a = paddle.to_tensor([7, 8], dtype="int32")
+        b = paddle.to_tensor([2, 3], dtype="int32")
+        np.testing.assert_allclose((a / b).numpy(), [3, 2])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np_t((2, 3, 4))
+        t = paddle.to_tensor(x)
+        assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+        assert paddle.reshape(t, [-1]).shape == [24]
+        np.testing.assert_allclose(
+            paddle.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_split_stack(self):
+        x, y = np_t((2, 3)), np_t((2, 3), 1)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        np.testing.assert_allclose(paddle.concat([tx, ty], 0).numpy(),
+                                   np.concatenate([x, y], 0))
+        parts = paddle.split(paddle.to_tensor(np_t((6, 2))), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(paddle.to_tensor(np_t((6, 2))), [1, 2, 3], axis=0)
+        assert [p.shape[0] for p in parts] == [1, 2, 3]
+        np.testing.assert_allclose(paddle.stack([tx, ty], 1).numpy(),
+                                   np.stack([x, y], 1))
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = np_t((2, 1, 3))
+        t = paddle.to_tensor(x)
+        assert paddle.squeeze(t, 1).shape == [2, 3]
+        assert paddle.unsqueeze(t, [0, 3]).shape == [1, 2, 1, 1, 3]
+        assert paddle.flatten(t).shape == [6]
+        assert paddle.flatten(paddle.to_tensor(np_t((2, 3, 4))), 1, 2).shape \
+            == [2, 12]
+
+    def test_gather_scatter(self):
+        x = np_t((5, 3))
+        idx = np.array([0, 2, 4])
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(
+            paddle.gather(t, paddle.to_tensor(idx)).numpy(), x[idx])
+        upd = np_t((3, 3), 2)
+        out = paddle.scatter(t, paddle.to_tensor(idx), paddle.to_tensor(upd))
+        ref = x.copy()
+        ref[idx] = upd
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_gather_nd(self):
+        x = np_t((3, 4, 5))
+        idx = np.array([[0, 1], [2, 3]])
+        out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+
+    def test_tile_expand_flip_roll(self):
+        x = np_t((2, 3))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.tile(t, [2, 1]).numpy(),
+                                   np.tile(x, (2, 1)))
+        assert paddle.expand(paddle.to_tensor(np_t((1, 3))), [4, 3]).shape \
+            == [4, 3]
+        np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), x[::-1])
+        np.testing.assert_allclose(paddle.roll(t, 1, 0).numpy(),
+                                   np.roll(x, 1, 0))
+
+    def test_unique_masked_select(self):
+        x = np.array([3, 1, 2, 3, 1])
+        out = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), [1, 2, 3])
+        m = np.array([True, False, True, False, False])
+        np.testing.assert_allclose(
+            paddle.masked_select(paddle.to_tensor(x),
+                                 paddle.to_tensor(m)).numpy(), x[m])
+
+    def test_getitem_setitem(self):
+        x = np_t((4, 5))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        t[0, 0] = 99.0
+        assert t.numpy()[0, 0] == 99.0
+
+    def test_shard_index(self):
+        x = paddle.to_tensor(np.array([1, 5, 9]))
+        out = paddle.shard_index(x, 10, 2, 0)
+        # shard size 5: ids 1->1 (shard0), 5->-1, 9->-1
+        np.testing.assert_allclose(out.numpy(), [1, -1, -1])
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        x, y = np_t((3,)), np_t((3,), 1)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        np.testing.assert_array_equal((tx > ty).numpy(), x > y)
+        np.testing.assert_array_equal(paddle.equal_all(tx, tx).numpy(), True)
+        assert paddle.allclose(tx, tx).numpy()
+
+    def test_logical(self):
+        a = paddle.to_tensor([True, False])
+        b = paddle.to_tensor([True, True])
+        np.testing.assert_array_equal(paddle.logical_and(a, b).numpy(),
+                                      [True, False])
+        np.testing.assert_array_equal(paddle.logical_not(a).numpy(),
+                                      [False, True])
+
+    def test_argmax_sort_topk(self):
+        x = np_t((4, 5))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.argmax(t, axis=1).numpy(),
+                                   x.argmax(1))
+        np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(),
+                                   np.sort(x, 1))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :2],
+                                   rtol=1e-6)
+
+    def test_where_nonzero(self):
+        x = np.array([1.0, -1.0, 2.0])
+        out = paddle.where(paddle.to_tensor(x > 0), paddle.to_tensor(x),
+                           paddle.to_tensor(np.zeros(3, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [1, 0, 2])
+        nz = paddle.nonzero(paddle.to_tensor(x > 0))
+        np.testing.assert_allclose(nz.numpy(), [[0], [2]])
+
+
+class TestLinalgStat:
+    def test_norm_dist(self):
+        x = np_t((3, 4))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.norm(t).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        y = np_t((3, 4), 1)
+        np.testing.assert_allclose(
+            paddle.dist(t, paddle.to_tensor(y)).numpy(),
+            np.linalg.norm((x - y).ravel()), rtol=1e-5)
+
+    def test_std_var_median(self):
+        x = np_t((100,))
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.std(t).numpy(), x.std(ddof=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(t).numpy(), x.var(ddof=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.median(t).numpy(), np.median(x),
+                                   rtol=1e-5)
+
+    def test_cholesky_inv_det(self):
+        a = np_t((3, 3))
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        t = paddle.to_tensor(spd)
+        L = paddle.cholesky(t).numpy()
+        np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(paddle.inv(t).numpy(),
+                                   np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(paddle.det(t).numpy(),
+                                   np.linalg.det(spd), rtol=1e-4)
+
+    def test_bmm_histogram(self):
+        a, b = np_t((2, 3, 4)), np_t((2, 4, 5))
+        np.testing.assert_allclose(
+            paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.rand([3, 4])
+        paddle.seed(7)
+        b = paddle.rand([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert paddle.randn([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_bernoulli_multinomial(self):
+        p = paddle.full([1000], 0.3)
+        s = paddle.bernoulli(p).numpy()
+        assert 0.15 < s.mean() < 0.45
+        probs = paddle.to_tensor([0.1, 0.2, 0.7])
+        idx = paddle.multinomial(probs, 2).numpy()
+        assert len(set(idx.tolist())) == 2  # without replacement
