@@ -1,0 +1,86 @@
+"""Flagship benchmark: GPT train-step throughput on one chip.
+
+Measures tokens/sec/chip for a fully fused jitted train step (bf16 compute on
+the MXU, Pallas flash attention, remat, fused AdamW) and reports MFU against
+the reference's 35%-MFU north star (BASELINE.json).  Prints ONE JSON line.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak FLOP/s per CHIP by TPU generation (public spec sheets).
+# libtpu device_kind strings look like "TPU v4", "TPU v5 lite", "TPU v5p",
+# "TPU v6 lite" — match most-specific first.
+PEAK_FLOPS = [
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
+TARGET_MFU = 0.35   # BASELINE.json north star
+
+
+def _peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS:
+        if key in kind:
+            return val
+    return 197e12   # assume v5e
+
+
+def main():
+    from paddle_tpu.parallel.mesh import create_mesh
+    from paddle_tpu.models import gpt, gpt_hybrid
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform not in ("cpu",)
+    if on_tpu:
+        cfg = gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, num_heads=16, max_seq_len=1024)
+        batch, steps = 8, 10
+    else:   # dev-mode smoke on CPU
+        cfg = gpt.gpt_tiny()
+        batch, steps = 4, 2
+
+    mesh = create_mesh(dp=1, tp=1, pp=1, sp=1, devices=[dev])
+    params, m, v = gpt_hybrid.init_sharded(cfg, mesh, jax.random.PRNGKey(0))
+    step = gpt_hybrid.make_train_step(cfg, mesh, n_microbatch=1)
+
+    N = cfg.max_seq_len
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, N)),
+        jnp.int32)
+    lr = jnp.float32(1e-4)
+
+    # compile + warmup
+    params, m, v, loss = step(params, m, v, jnp.int32(1), toks, toks, lr)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, m, v, loss = step(params, m, v, jnp.int32(i + 2), toks,
+                                  toks, lr)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * N * steps / dt
+    mfu = tokens_per_sec * cfg.flops_per_token() / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "gpt_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / TARGET_MFU, 4),
+    }))
+    print(f"# model=GPT-{cfg.num_params()/1e6:.0f}M seq={N} batch={batch} "
+          f"loss={float(loss):.4f} mfu={mfu:.3f} device={dev.device_kind}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Model zoo (ref: the reference ships models via python/paddle/vision/models
+and the fleet examples; transformer LMs are the benchmark configs in
+BASELINE.json).  TPU-native: each model has a pure-functional core (param
+pytree + apply fn) that jits/shards cleanly, plus an eager ``Layer`` wrapper
+for the dygraph API."""
+from . import gpt  # noqa: F401
+from .gpt import GPTConfig, GPT, gpt_tiny, gpt_345m, gpt3_1p3b  # noqa: F401

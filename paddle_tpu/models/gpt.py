@@ -1,0 +1,243 @@
+"""GPT decoder-only LM — the flagship benchmark model.
+
+Reference trains GPT-3-style models through fleet hybrid parallel with fused
+CUDA attention (ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu,
+python/paddle/distributed/fleet/meta_parallel/).  Here the model is a pure
+functional core over a parameter pytree:
+
+  * params live in fp32 (master weights), compute casts to ``cfg.dtype``
+    (bf16 on TPU so matmuls hit the MXU at full rate);
+  * blocks are stacked on a leading layer axis and applied with ``lax.scan``
+    (constant compile time in depth, and the natural layout for sharding the
+    layer axis over a pipeline mesh axis — see models/gpt_hybrid.py);
+  * attention goes through the Pallas flash kernel (ops/pallas/flash_attn.py);
+  * ``jax.checkpoint`` on each block trades FLOPs for HBM when ``remat``.
+
+The eager ``GPT``/``GPTForPretraining`` Layers wrap the same core for the
+dygraph API (tape autograd, state_dict, hapi.Model).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops.pallas.flash_attn import flash_attention
+from ..ops import dispatch
+from ..tensor.tensor import Tensor
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # multiple of 128: pads to MXU lanes
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 0                # 0 -> 4*hidden
+    max_seq_len: int = 1024
+    dtype: str = "bfloat16"          # compute dtype
+    param_dtype: str = "float32"     # master weights
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-5
+    use_flash: bool = True
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.ffn_size == 0:
+            self.ffn_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    def num_params(self):
+        H, L, F, V, S = (self.hidden_size, self.num_layers, self.ffn_size,
+                         self.vocab_size, self.max_seq_len)
+        per_block = 4 * H + 3 * H * H + 3 * H + H * H + H + H * F + F + F * H + H
+        return V * H + S * H + L * per_block + 2 * H
+
+    def flops_per_token(self):
+        """Training FLOPs/token (fwd+bwd ~ 6*N + attention term)."""
+        H, L, S = self.hidden_size, self.num_layers, self.max_seq_len
+        return 6 * self.num_params() + 12 * L * H * S
+
+
+def gpt_tiny():
+    return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dtype="float32",
+                     use_flash=False, remat=False)
+
+
+def gpt_345m():
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                     max_seq_len=1024)
+
+
+def gpt3_1p3b():
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=32,
+                     max_seq_len=2048)
+
+
+# --------------------------------------------------------------------------
+# functional core
+# --------------------------------------------------------------------------
+
+def init_params(cfg: GPTConfig, key):
+    """Parameter pytree.  Block params are stacked on a leading [L] axis."""
+    H, L, F = cfg.hidden_size, cfg.num_layers, cfg.ffn_size
+    pd = jnp.dtype(cfg.param_dtype)
+    std = cfg.initializer_range
+    ks = jax.random.split(key, 8)
+
+    def nrm(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(pd)
+
+    # residual-path projections scaled by 1/sqrt(2L) (GPT-2 init)
+    res_std = std / math.sqrt(2.0 * L)
+    return {
+        "wte": nrm(ks[0], (cfg.vocab_size, H)),
+        "wpe": nrm(ks[1], (cfg.max_seq_len, H)),
+        "blocks": {
+            "ln1_g": jnp.ones((L, H), pd), "ln1_b": jnp.zeros((L, H), pd),
+            "qkv_w": nrm(ks[2], (L, H, 3, H)),
+            "qkv_b": jnp.zeros((L, 3, H), pd),
+            "proj_w": nrm(ks[3], (L, H, H), res_std),
+            "proj_b": jnp.zeros((L, H), pd),
+            "ln2_g": jnp.ones((L, H), pd), "ln2_b": jnp.zeros((L, H), pd),
+            "fc1_w": nrm(ks[4], (L, H, F)),
+            "fc1_b": jnp.zeros((L, F), pd),
+            "fc2_w": nrm(ks[5], (L, F, H), res_std),
+            "fc2_b": jnp.zeros((L, H), pd),
+        },
+        "lnf_g": jnp.ones((H,), pd), "lnf_b": jnp.zeros((H,), pd),
+    }
+
+
+def _layer_norm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg):
+    # q,k,v: [B, N, nh, hd]
+    if cfg.use_flash:
+        return flash_attention(q, k, v, True)
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    n = logits.shape[-1]
+    mask = jnp.tril(jnp.ones((n, n), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def block_apply(cfg: GPTConfig, x, blk):
+    """One transformer block.  x: [B, N, H]; blk: per-layer param dict
+    (no leading L axis).  The hybrid-parallel path has its own tp-sharded
+    block (models/gpt_hybrid.py::_sharded_block) — keep the math in sync."""
+    cd = jnp.dtype(cfg.dtype)
+    B, N, H = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"], cfg.layer_norm_eps)
+    qkv = jnp.einsum("bnh,hcd->bncd", h, blk["qkv_w"].astype(cd))
+    qkv = qkv + blk["qkv_b"].astype(cd)
+    q, k, v = [qkv[:, :, i].reshape(B, N, nh, hd) for i in range(3)]
+    a = _attention(q, k, v, cfg).reshape(B, N, -1)
+    a = a @ blk["proj_w"].astype(cd) + blk["proj_b"].astype(cd)
+    x = x + a
+
+    h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(h @ blk["fc1_w"].astype(cd) + blk["fc1_b"].astype(cd),
+                    approximate=True)
+    h = h @ blk["fc2_w"].astype(cd) + blk["fc2_b"].astype(cd)
+    return x + h
+
+
+def embed(cfg: GPTConfig, params, tokens, pos_offset=0):
+    cd = jnp.dtype(cfg.dtype)
+    N = tokens.shape[-1]
+    pos = pos_offset + jnp.arange(N)
+    x = jnp.take(params["wte"], tokens, axis=0) + jnp.take(
+        params["wpe"], pos, axis=0)
+    return x.astype(cd)
+
+
+def forward(params, tokens, cfg: GPTConfig):
+    """tokens [B, N] int32 -> logits [B, N, V] in fp32."""
+    x = embed(cfg, params, tokens)
+    blk_fn = functools.partial(block_apply, cfg)
+    if cfg.remat:
+        blk_fn = jax.checkpoint(blk_fn)
+
+    def scan_body(carry, blk):
+        return blk_fn(carry, blk), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    # tied embeddings: logits = x @ wte^T
+    return (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, labels, cfg: GPTConfig):
+    """Mean next-token cross entropy.  labels [B, N] int32 (-100 = ignore)."""
+    logits = forward(params, tokens, cfg)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    ce = jnp.where(valid, lse - tgt, 0.0)
+    return jnp.sum(ce) / jnp.maximum(jnp.sum(valid), 1)
+
+
+# --------------------------------------------------------------------------
+# eager Layer wrappers (dygraph API)
+# --------------------------------------------------------------------------
+
+class GPT(Layer):
+    """Eager wrapper: holds the pytree leaves as Parameters so state_dict /
+    optimizers / hapi work; forward routes the whole functional core through
+    one tape node (dispatch.call records jax.vjp of the full model)."""
+
+    def __init__(self, cfg: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.cfg = cfg or GPTConfig(**kwargs)
+        from ..framework import core
+        tree = init_params(self.cfg, core.next_rng_key())
+        flat, self._treedef = jax.tree_util.tree_flatten(tree)
+        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        self._leaf_names = []
+        for (path, _), leaf in zip(paths, flat):
+            name = "_".join(str(getattr(p, "key", p)) for p in path)
+            self._leaf_names.append(name)
+            self.add_parameter(name, Tensor(leaf, stop_gradient=False))
+
+    def _tree(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef,
+            [self._parameters[n] for n in self._leaf_names])
+
+    def forward(self, tokens):
+        fn = functools.partial(
+            lambda p, t: forward(p, t, self.cfg))
+        return dispatch.call(fn, self._tree(), tokens, _name="gpt")
+
+    def loss(self, tokens, labels):
+        fn = lambda p, t, l: loss_fn(p, t, l, self.cfg)  # noqa: E731
+        return dispatch.call(fn, self._tree(), tokens, labels,
+                             _name="gpt_loss")
+
+
+class GPTForPretraining(GPT):
+    def forward(self, tokens, labels=None):
+        if labels is None:
+            return super().forward(tokens)
+        return self.loss(tokens, labels)

@@ -16,18 +16,20 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 
-def pipeline_forward(stage_fn, params_local, x_global, n_microbatch,
-                     axis_name="pp"):
+def pipeline_forward(stage_fn, x_global, n_microbatch, axis_name="pp"):
     """Run inside shard_map over ``axis_name``.
 
-    stage_fn(params, x) -> y  applies THIS stage's chunk of layers.
-    params_local: this stage's parameters (leading stage axis already split).
+    stage_fn(x) -> y  applies THIS stage's chunk of layers (close over the
+    stage's parameters; the leading stage axis is already split by shard_map).
     x_global: [B, ...] microbatchable input (replicated across pp).
     Returns final-stage output broadcast to all stages ([B, ...]).
     """
     idx = jax.lax.axis_index(axis_name)
     size = jax.lax.axis_size(axis_name)
     B = x_global.shape[0]
+    if B % n_microbatch:
+        raise ValueError(
+            f"batch {B} must divide by n_microbatch {n_microbatch}")
     mb = B // n_microbatch
     micro = x_global.reshape(n_microbatch, mb, *x_global.shape[1:])
 
@@ -55,10 +57,11 @@ def pipeline_forward(stage_fn, params_local, x_global, n_microbatch,
         return state, outputs
 
     state, outputs = jax.lax.fori_loop(0, n_ticks, tick, (state, outputs))
-    # bring final outputs (resident on last stage) to every stage
-    outputs = jax.lax.ppermute(
-        outputs, axis_name,
-        [(size - 1, j) for j in range(size)]) if size > 1 else outputs
+    # broadcast final outputs (resident on last stage) to every stage:
+    # mask+psum, since ppermute is one-to-one and can't fan out
+    outputs = jax.lax.psum(
+        jnp.where(idx == size - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name) if size > 1 else outputs
     return outputs.reshape(B, *outputs.shape[2:])
 
 
@@ -73,7 +76,7 @@ def make_pipelined(mesh, stage_fn, n_stages, n_microbatch, axis_name="pp"):
     def run(params_stacked, x):
         def body(p_local, xg):
             f = functools.partial(stage_fn, p_local)
-            return pipeline_forward(f, p_local, xg, n_microbatch, axis_name)
+            return pipeline_forward(f, xg, n_microbatch, axis_name)
         return shard_map(
             body, mesh=mesh,
             in_specs=(P(axis_name), P()),
