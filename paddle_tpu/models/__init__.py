@@ -5,3 +5,7 @@ pytree + apply fn) that jits/shards cleanly, plus an eager ``Layer`` wrapper
 for the dygraph API."""
 from . import gpt  # noqa: F401
 from .gpt import GPTConfig, GPT, gpt_tiny, gpt_345m, gpt3_1p3b  # noqa: F401
+from . import bert  # noqa: F401
+from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
+                   ErnieModel, ErnieForPretraining, bert_tiny, bert_base,
+                   bert_large, ernie_3_base)

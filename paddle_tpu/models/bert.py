@@ -1,0 +1,368 @@
+"""BERT / ERNIE masked-LM encoder family.
+
+The reference pretrains BERT/ERNIE-style encoders through fleet data/tensor
+parallel with fused CUDA encoder kernels (ref: paddle/fluid/operators/math/
+bert_encoder_functor.cu, python/paddle/fluid/tests/unittests/
+dygraph_to_static/bert_dygraph_model.py for the model shape).  ERNIE-3.0-Base
+is the BASELINE.json pretrain benchmark.
+
+TPU-native design, matching models/gpt.py conventions:
+
+  * pure functional core over a parameter pytree; fp32 master weights,
+    compute in ``cfg.dtype`` (bf16) so the encoder matmuls run on the MXU;
+  * post-LN blocks (BERT layout: sublayer -> residual add -> LayerNorm),
+    stacked on a leading [L] axis and applied with ``lax.scan``;
+  * bidirectional Pallas flash attention when there is no padding mask,
+    masked XLA attention otherwise (mask makes softmax rows data-dependent,
+    so the dense fused path is the right trade until the kernel grows
+    mask support);
+  * MLM head (transform + tied decoder) and NSP head; joint pretrain loss;
+  * ``make_train_step`` compiles loss+grad+fused-AdamW as ONE XLA program,
+    batch sharded over the mesh 'dp' axis — GSPMD inserts the grad
+    allreduce (the reference inserts c_allreduce_sum ops by graph rewrite).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer.layers import Layer
+from ..ops import dispatch
+from ..ops.pallas.flash_attn import flash_attention
+from ..optimizer.functional import adamw_update
+from ..tensor.tensor import Tensor
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30592          # BERT vocab 30522 padded to 128 lanes
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 0                # 0 -> 4*hidden
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    use_flash: bool = True
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.ffn_size == 0:
+            self.ffn_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    def num_params(self):
+        H, L, F = self.hidden_size, self.num_layers, self.ffn_size
+        emb = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * H
+        per_block = 4 * H * H + 4 * H + 2 * H * F + H + F + 4 * H
+        heads = H * H + H + H * H + H + H + H + 2 * H + 2 + self.vocab_size
+        return emb + 2 * H + L * per_block + heads
+
+    def flops_per_token(self):
+        H, L, S = self.hidden_size, self.num_layers, self.max_seq_len
+        return 6 * self.num_params() + 12 * L * H * S
+
+
+def bert_tiny():
+    return BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                      num_heads=4, max_seq_len=128, type_vocab_size=2,
+                      dtype="float32", use_flash=False, remat=False)
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_large():
+    return BertConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+
+def ernie_3_base():
+    """ERNIE-3.0-Base geometry (BASELINE.json pretrain benchmark): BERT-base
+    size with the ERNIE vocab, padded to the MXU lane width."""
+    return BertConfig(vocab_size=40064, hidden_size=768, num_layers=12,
+                      num_heads=12, type_vocab_size=4)
+
+
+# --------------------------------------------------------------------------
+# functional core
+# --------------------------------------------------------------------------
+
+def init_params(cfg: BertConfig, key):
+    """Parameter pytree; block params stacked on a leading [L] axis."""
+    H, L, F = cfg.hidden_size, cfg.num_layers, cfg.ffn_size
+    pd = jnp.dtype(cfg.param_dtype)
+    std = cfg.initializer_range
+    ks = jax.random.split(key, 12)
+
+    def nrm(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(pd)
+
+    return {
+        "wte": nrm(ks[0], (cfg.vocab_size, H)),
+        "wpe": nrm(ks[1], (cfg.max_seq_len, H)),
+        "wtt": nrm(ks[2], (cfg.type_vocab_size, H)),
+        "emb_ln_g": jnp.ones((H,), pd), "emb_ln_b": jnp.zeros((H,), pd),
+        "blocks": {
+            "qkv_w": nrm(ks[3], (L, H, 3, H)),
+            "qkv_b": jnp.zeros((L, 3, H), pd),
+            "proj_w": nrm(ks[4], (L, H, H)),
+            "proj_b": jnp.zeros((L, H), pd),
+            "ln1_g": jnp.ones((L, H), pd), "ln1_b": jnp.zeros((L, H), pd),
+            "fc1_w": nrm(ks[5], (L, H, F)),
+            "fc1_b": jnp.zeros((L, F), pd),
+            "fc2_w": nrm(ks[6], (L, F, H)),
+            "fc2_b": jnp.zeros((L, H), pd),
+            "ln2_g": jnp.ones((L, H), pd), "ln2_b": jnp.zeros((L, H), pd),
+        },
+        "pool_w": nrm(ks[7], (H, H)), "pool_b": jnp.zeros((H,), pd),
+        # MLM transform + tied decoder bias, NSP classifier
+        "mlm_w": nrm(ks[8], (H, H)), "mlm_b": jnp.zeros((H,), pd),
+        "mlm_ln_g": jnp.ones((H,), pd), "mlm_ln_b": jnp.zeros((H,), pd),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), pd),
+        "nsp_w": nrm(ks[9], (H, 2)), "nsp_b": jnp.zeros((2,), pd),
+    }
+
+
+def _layer_norm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(cfg, q, k, v, pad_mask):
+    """q,k,v: [B, N, nh, hd]; pad_mask: [B, N] float/bool of valid tokens or
+    None.  No mask -> bidirectional flash kernel; mask -> dense XLA path."""
+    if pad_mask is None and cfg.use_flash:
+        return flash_attention(q, k, v, False)
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if pad_mask is not None:
+        bias = jnp.where(pad_mask.astype(bool), 0.0, -1e30)
+        logits = logits + bias[:, None, None, :]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def block_apply(cfg: BertConfig, x, pad_mask, blk):
+    """One post-LN encoder block.  x: [B, N, H]."""
+    cd = jnp.dtype(cfg.dtype)
+    B, N, H = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    qkv = jnp.einsum("bnh,hcd->bncd", x, blk["qkv_w"].astype(cd))
+    qkv = qkv + blk["qkv_b"].astype(cd)
+    q, k, v = [qkv[:, :, i].reshape(B, N, nh, hd) for i in range(3)]
+    a = _attention(cfg, q, k, v, pad_mask).reshape(B, N, -1)
+    a = a @ blk["proj_w"].astype(cd) + blk["proj_b"].astype(cd)
+    x = _layer_norm(x + a, blk["ln1_g"], blk["ln1_b"], cfg.layer_norm_eps)
+
+    h = jax.nn.gelu(x @ blk["fc1_w"].astype(cd) + blk["fc1_b"].astype(cd),
+                    approximate=True)
+    h = h @ blk["fc2_w"].astype(cd) + blk["fc2_b"].astype(cd)
+    return _layer_norm(x + h, blk["ln2_g"], blk["ln2_b"], cfg.layer_norm_eps)
+
+
+def encode(params, tokens, cfg: BertConfig, token_type_ids=None,
+           pad_mask=None):
+    """tokens [B, N] int32 -> sequence output [B, N, H] (compute dtype)."""
+    cd = jnp.dtype(cfg.dtype)
+    N = tokens.shape[-1]
+    x = jnp.take(params["wte"], tokens, axis=0)
+    x = x + jnp.take(params["wpe"], jnp.arange(N), axis=0)
+    tt = (jnp.zeros_like(tokens) if token_type_ids is None
+          else token_type_ids)
+    x = x + jnp.take(params["wtt"], tt, axis=0)
+    x = _layer_norm(x.astype(cd), params["emb_ln_g"], params["emb_ln_b"],
+                    cfg.layer_norm_eps)
+
+    blk_fn = functools.partial(block_apply, cfg)
+    if cfg.remat:
+        blk_fn = jax.checkpoint(blk_fn)
+
+    def scan_body(carry, blk):
+        return blk_fn(carry, pad_mask, blk), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    return x
+
+
+def pool(params, seq_out, cfg: BertConfig):
+    """tanh projection of the [CLS] (position 0) hidden state."""
+    cd = jnp.dtype(cfg.dtype)
+    cls = seq_out[:, 0]
+    return jnp.tanh(cls @ params["pool_w"].astype(cd)
+                    + params["pool_b"].astype(cd))
+
+
+def forward(params, tokens, cfg: BertConfig, token_type_ids=None,
+            pad_mask=None):
+    """-> (sequence_output [B,N,H], pooled_output [B,H])."""
+    seq = encode(params, tokens, cfg, token_type_ids, pad_mask)
+    return seq, pool(params, seq, cfg)
+
+
+def mlm_logits(params, seq_out, cfg: BertConfig):
+    """MLM head: transform -> LN -> tied decoder.  fp32 logits [B,N,V]."""
+    cd = jnp.dtype(cfg.dtype)
+    h = jax.nn.gelu(seq_out @ params["mlm_w"].astype(cd)
+                    + params["mlm_b"].astype(cd), approximate=True)
+    h = _layer_norm(h, params["mlm_ln_g"], params["mlm_ln_b"],
+                    cfg.layer_norm_eps)
+    logits = h @ params["wte"].astype(cd).T
+    return logits.astype(jnp.float32) + params["mlm_bias"].astype(jnp.float32)
+
+
+def _xent(logits, labels, ignore=-100):
+    valid = labels != ignore
+    safe = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    ce = jnp.where(valid, lse - tgt, 0.0)
+    return jnp.sum(ce) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def pretrain_loss(params, tokens, mlm_labels, cfg: BertConfig,
+                  token_type_ids=None, pad_mask=None, nsp_labels=None):
+    """Joint MLM (+ NSP when labels given) loss.  mlm_labels: [B, N] int32
+    with -100 at unmasked positions."""
+    seq, pooled = forward(params, tokens, cfg, token_type_ids, pad_mask)
+    loss = _xent(mlm_logits(params, seq, cfg), mlm_labels)
+    if nsp_labels is not None:
+        nsp = (pooled @ params["nsp_w"].astype(pooled.dtype)
+               + params["nsp_b"].astype(pooled.dtype)).astype(jnp.float32)
+        loss = loss + _xent(nsp, nsp_labels)
+    return loss
+
+
+# --------------------------------------------------------------------------
+# data-parallel pretrain step (GSPMD: batch over 'dp', params replicated)
+# --------------------------------------------------------------------------
+
+_NO_DECAY = ("_b", "_g", "ln_g", "ln_b", "mlm_bias", "wpe")
+
+
+def _decays(path):
+    leaf = str(getattr(path[-1], "key", path[-1]))
+    return not any(leaf.endswith(s) or leaf == s for s in _NO_DECAY)
+
+
+def init_pretrain_state(cfg: BertConfig, key, mesh=None):
+    """(params, m, v) — replicated over the mesh when one is given (DP:
+    params whole on every device, only the batch is sharded)."""
+    params = init_params(cfg, key)
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    m, v = zeros, jax.tree_util.tree_map(jnp.copy, zeros)
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        params, m, v = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), (params, m, v))
+    return params, m, v
+
+
+def make_train_step(cfg: BertConfig, mesh=None, beta1=0.9, beta2=0.999,
+                    eps=1e-8, weight_decay=0.01, clip_norm=1.0):
+    """Jitted ``step(params, m, v, t, tokens, mlm_labels, nsp_labels, lr)``
+    -> (params, m, v, loss).  With a mesh, inputs are sharded [B] over 'dp'
+    and XLA emits the gradient allreduce (ref's c_allreduce_sum rewrite)."""
+
+    def step(params, m, v, t, tokens, mlm_labels, nsp_labels, lr):
+        loss, grads = jax.value_and_grad(
+            lambda p: pretrain_loss(p, tokens, mlm_labels, cfg,
+                                    nsp_labels=nsp_labels))(params)
+        if clip_norm:
+            gn = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads)))
+            scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        tf = t.astype(jnp.float32)
+
+        def upd(path, p, g, mm, vv):
+            return adamw_update(p, g, mm, vv, lr, tf, beta1, beta2, eps,
+                                weight_decay, _decays(path))
+        out = jax.tree_util.tree_map_with_path(upd, params, grads, m, v)
+        tup = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda o: o[i], out, is_leaf=lambda o: isinstance(o, tuple))
+        return tup(0), tup(1), tup(2), loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+    rep = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    return jax.jit(
+        step, donate_argnums=(0, 1, 2),
+        in_shardings=(rep, rep, rep, rep, data, data, data, rep),
+        out_shardings=(rep, rep, rep, rep))
+
+
+# --------------------------------------------------------------------------
+# eager Layer wrappers (dygraph API)
+# --------------------------------------------------------------------------
+
+class _PytreeLayer(Layer):
+    """Holds a functional core's pytree leaves as named Parameters."""
+
+    def _adopt_tree(self, tree):
+        flat, self._treedef = jax.tree_util.tree_flatten(tree)
+        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        self._leaf_names = []
+        for (path, _), leaf in zip(paths, flat):
+            name = "_".join(str(getattr(p, "key", p)) for p in path)
+            self._leaf_names.append(name)
+            self.add_parameter(name, Tensor(leaf, stop_gradient=False))
+
+    def _tree(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef,
+            [self._parameters[n] for n in self._leaf_names])
+
+
+class BertModel(_PytreeLayer):
+    """Eager encoder: forward(tokens, token_type_ids=None, pad_mask=None)
+    -> (sequence_output, pooled_output)."""
+
+    def __init__(self, cfg: BertConfig = None, **kwargs):
+        super().__init__()
+        self.cfg = cfg or BertConfig(**kwargs)
+        from ..framework import core
+        self._adopt_tree(init_params(self.cfg, core.next_rng_key()))
+
+    def forward(self, tokens, token_type_ids=None, pad_mask=None):
+        fn = lambda p, t, tt, pm: forward(p, t, self.cfg, tt, pm)  # noqa: E731
+        return dispatch.call(fn, self._tree(), tokens, token_type_ids,
+                             pad_mask, _name="bert")
+
+
+class BertForPretraining(BertModel):
+    """forward(tokens, mlm_labels, nsp_labels=None, ...) -> scalar loss
+    (or (sequence_output, pooled_output) when labels are omitted)."""
+
+    def forward(self, tokens, mlm_labels=None, nsp_labels=None,
+                token_type_ids=None, pad_mask=None):
+        if mlm_labels is None:
+            return super().forward(tokens, token_type_ids, pad_mask)
+        fn = (lambda p, t, ml, nl, tt, pm:
+              pretrain_loss(p, t, ml, self.cfg, tt, pm, nl))
+        return dispatch.call(fn, self._tree(), tokens, mlm_labels,
+                             nsp_labels, token_type_ids, pad_mask,
+                             _name="bert_pretrain_loss")
+
+
+ErnieModel = BertModel
+ErnieForPretraining = BertForPretraining

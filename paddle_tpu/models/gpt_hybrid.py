@@ -34,6 +34,7 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .gpt import GPTConfig, init_params, _layer_norm
+from ..optimizer.functional import adamw_update
 from ..parallel.pipeline import pipeline_forward
 from ..parallel.ring_attention import ring_attention
 from ..ops.pallas.flash_attn import flash_attention
@@ -105,7 +106,10 @@ def _attn_local(cfg, q, k, v, sp_size):
     """q,k,v: [mb, N_l, nh_local, hd].  sp==1 -> Pallas flash; sp>1 -> ring
     attention over the 'sp' axis (K/V rotate, online-softmax merge)."""
     if sp_size == 1:
-        return flash_attention(q, k, v, True)
+        if cfg.use_flash:
+            return flash_attention(q, k, v, True)
+        from .gpt import _attention
+        return _attention(q, k, v, cfg)
     qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
     out = ring_attention(qt, kt, vt, axis_name="sp", causal=True)
     return jnp.swapaxes(out, 1, 2)
@@ -248,17 +252,6 @@ def _global_norm(grads, specs):
     return jnp.sqrt(total)
 
 
-def _adamw(p, g, m, v, lr, t, b1, b2, eps, wd, decay):
-    gf = g.astype(jnp.float32)
-    pf = p.astype(jnp.float32)
-    m = b1 * m + (1 - b1) * gf
-    v = b2 * v + (1 - b2) * gf * gf
-    mhat = m / (1 - b1 ** t)
-    vhat = v / (1 - b2 ** t)
-    upd = mhat / (jnp.sqrt(vhat) + eps) + (wd * pf if decay else 0.0)
-    return (pf - lr * upd).astype(p.dtype), m, v
-
-
 def make_train_step(cfg: GPTConfig, mesh, n_microbatch=1,
                     beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.1,
                     clip_norm=1.0):
@@ -285,8 +278,8 @@ def make_train_step(cfg: GPTConfig, mesh, n_microbatch=1,
         def upd(path, p, g, mm, vv):
             leaf = str(getattr(path[-1], "key", path[-1]))
             decay = leaf not in no_decay and leaf not in ln_names
-            return _adamw(p, g, mm, vv, lr, tf, beta1, beta2, eps,
-                          weight_decay, decay)
+            return adamw_update(p, g, mm, vv, lr, tf, beta1, beta2, eps,
+                                weight_decay, decay)
         out = jax.tree_util.tree_map_with_path(upd, params, grads, m, v)
         new_p = jax.tree_util.tree_map(lambda o: o[0], out,
                                        is_leaf=lambda o: isinstance(o, tuple))

@@ -1,0 +1,26 @@
+"""Functional optimizer updates shared by the jitted model train steps.
+
+The eager ``paddle_tpu.optimizer.AdamW`` class (optimizer/optimizers.py)
+serves the dygraph API; the model families' compiled train steps
+(models/gpt_hybrid.py, models/bert.py, ...) inline this pure function so the
+whole update fuses into the one XLA step program (ref: the reference fuses
+its update into adamw_op.cu for the same reason)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def adamw_update(p, g, m, v, lr, t, b1, b2, eps, wd, decay):
+    """One fused AdamW step in fp32 master precision.
+
+    p: param leaf (any dtype; updated in fp32, cast back), g: grad,
+    m/v: fp32 moments, t: fp32 1-based step count, decay: bool — apply
+    weight decay to this leaf.  Returns (new_p, new_m, new_v)."""
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m = b1 * m + (1 - b1) * gf
+    v = b2 * v + (1 - b2) * gf * gf
+    mhat = m / (1 - b1 ** t)
+    vhat = v / (1 - b2 ** t)
+    upd = mhat / (jnp.sqrt(vhat) + eps) + (wd * pf if decay else 0.0)
+    return (pf - lr * upd).astype(p.dtype), m, v

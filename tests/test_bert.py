@@ -1,0 +1,130 @@
+"""BERT/ERNIE family: functional core, pretrain loss, DP step, eager wrapper.
+
+Models the reference's bert dygraph/d2s tests (ref: python/paddle/fluid/
+tests/unittests/dygraph_to_static/test_bert.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.parallel.mesh import create_mesh
+from paddle_tpu.models import bert
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = bert.bert_tiny()
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B, N = 8, 64
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, N)), jnp.int32)
+    # mask 15% of positions for MLM
+    mask = rng.rand(B, N) < 0.15
+    labels = jnp.asarray(np.where(mask, np.asarray(toks), -100), jnp.int32)
+    nsp = jnp.asarray(rng.randint(0, 2, (B,)), jnp.int32)
+    return cfg, params, toks, labels, nsp
+
+
+def test_forward_shapes(setup):
+    cfg, params, toks, _, _ = setup
+    seq, pooled = bert.forward(params, toks, cfg)
+    assert seq.shape == (*toks.shape, cfg.hidden_size)
+    assert pooled.shape == (toks.shape[0], cfg.hidden_size)
+    logits = bert.mlm_logits(params, seq, cfg)
+    assert logits.shape == (*toks.shape, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_pad_mask_matches_trunc(setup):
+    """Masked-out tail must not change the attended prefix outputs."""
+    cfg, params, toks, _, _ = setup
+    n_valid = 48
+    pad = jnp.asarray(np.arange(toks.shape[1]) < n_valid, jnp.float32)
+    pad = jnp.broadcast_to(pad, toks.shape)
+    seq_m, _ = bert.forward(params, toks, cfg, pad_mask=pad)
+    seq_t, _ = bert.forward(params, toks[:, :n_valid], cfg)
+    np.testing.assert_allclose(np.asarray(seq_m[:, :n_valid]),
+                               np.asarray(seq_t), atol=1e-4)
+
+
+def test_pretrain_loss_sane(setup):
+    cfg, params, toks, labels, nsp = setup
+    loss = bert.pretrain_loss(params, toks, labels, cfg, nsp_labels=nsp)
+    # ~ln(V) + ln(2) at init
+    assert 0 < float(loss) < np.log(cfg.vocab_size) + np.log(2) + 1
+
+
+def test_dp_train_step_decreases_loss(setup):
+    cfg, _, toks, labels, nsp = setup
+    mesh = create_mesh(dp=8, tp=1, pp=1, sp=1)
+    p, m, v = bert.init_pretrain_state(cfg, jax.random.PRNGKey(1), mesh)
+    step = bert.make_train_step(cfg, mesh)
+    lr = jnp.float32(1e-3)
+    losses = []
+    for i in range(4):
+        p, m, v, loss = step(p, m, v, jnp.int32(i + 1), toks, labels, nsp,
+                             lr)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_dp_step_matches_single_device(setup):
+    cfg, _, toks, labels, nsp = setup
+    key = jax.random.PRNGKey(2)
+    mesh = create_mesh(dp=8, tp=1, pp=1, sp=1)
+    pd, md, vd = bert.init_pretrain_state(cfg, key, mesh)
+    ps, ms, vs = bert.init_pretrain_state(cfg, key)
+    step_d = bert.make_train_step(cfg, mesh)
+    step_s = bert.make_train_step(cfg)
+    lr = jnp.float32(1e-3)
+    pd, md, vd, ld = step_d(pd, md, vd, jnp.int32(1), toks, labels, nsp, lr)
+    ps, ms, vs, ls = step_s(ps, ms, vs, jnp.int32(1), toks, labels, nsp, lr)
+    np.testing.assert_allclose(float(ld), float(ls), rtol=1e-5)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(pd),
+            jax.tree_util.tree_leaves_with_path(ps)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5,
+                                   err_msg=str(path))
+
+
+def test_eager_bert_trains(setup):
+    cfg, _, toks, labels, nsp = setup
+    model = bert.BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    t = paddle.to_tensor(np.asarray(toks))
+    ml = paddle.to_tensor(np.asarray(labels))
+    nl = paddle.to_tensor(np.asarray(nsp))
+    losses = []
+    for _ in range(3):
+        loss = model(t, ml, nl)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_eager_state_dict_round_trip(setup):
+    cfg, _, toks, _, _ = setup
+    m1 = bert.BertModel(cfg)
+    m2 = bert.BertModel(cfg)
+    m2.set_state_dict(m1.state_dict())
+    t = paddle.to_tensor(np.asarray(toks))
+    s1, p1 = m1(t)
+    s2, p2 = m2(t)
+    np.testing.assert_allclose(np.asarray(s1.numpy()),
+                               np.asarray(s2.numpy()), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p1.numpy()),
+                               np.asarray(p2.numpy()), atol=1e-6)
+
+
+def test_ernie_alias_and_presets():
+    assert bert.ErnieModel is bert.BertModel
+    cfg = bert.ernie_3_base()
+    assert cfg.vocab_size % 128 == 0
+    assert cfg.hidden_size == 768 and cfg.num_layers == 12
+    assert bert.bert_base().num_params() > 80e6
