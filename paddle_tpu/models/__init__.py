@@ -6,6 +6,8 @@ for the dygraph API."""
 from . import gpt  # noqa: F401
 from .gpt import GPTConfig, GPT, gpt_tiny, gpt_345m, gpt3_1p3b  # noqa: F401
 from . import bert  # noqa: F401
+from . import rec  # noqa: F401
+from .rec import RecConfig, WideDeep, DeepFM, rec_tiny  # noqa: F401
 from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
                    ErnieModel, ErnieForPretraining, bert_tiny, bert_base,
                    bert_large, ernie_3_base)

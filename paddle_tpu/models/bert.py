@@ -31,11 +31,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..nn.layer.layers import Layer
+from .common import PytreeLayer
 from ..ops import dispatch
 from ..ops.pallas.flash_attn import flash_attention
 from ..optimizer.functional import adamw_update
-from ..tensor.tensor import Tensor
 
 
 @dataclasses.dataclass
@@ -315,25 +314,7 @@ def make_train_step(cfg: BertConfig, mesh=None, beta1=0.9, beta2=0.999,
 # eager Layer wrappers (dygraph API)
 # --------------------------------------------------------------------------
 
-class _PytreeLayer(Layer):
-    """Holds a functional core's pytree leaves as named Parameters."""
-
-    def _adopt_tree(self, tree):
-        flat, self._treedef = jax.tree_util.tree_flatten(tree)
-        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
-        self._leaf_names = []
-        for (path, _), leaf in zip(paths, flat):
-            name = "_".join(str(getattr(p, "key", p)) for p in path)
-            self._leaf_names.append(name)
-            self.add_parameter(name, Tensor(leaf, stop_gradient=False))
-
-    def _tree(self):
-        return jax.tree_util.tree_unflatten(
-            self._treedef,
-            [self._parameters[n] for n in self._leaf_names])
-
-
-class BertModel(_PytreeLayer):
+class BertModel(PytreeLayer):
     """Eager encoder: forward(tokens, token_type_ids=None, pad_mask=None)
     -> (sequence_output, pooled_output)."""
 

@@ -1,0 +1,30 @@
+"""Shared plumbing for the model zoo's eager Layer wrappers.
+
+Every model family keeps a pure functional core (param pytree + apply fns)
+for the jit/sharded path; ``PytreeLayer`` adopts such a pytree as named
+``Parameter``s so the dygraph API (tape autograd, state_dict, optimizers,
+hapi.Model) works on the same weights."""
+from __future__ import annotations
+
+import jax
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+class PytreeLayer(Layer):
+    """Holds a functional core's pytree leaves as named Parameters."""
+
+    def _adopt_tree(self, tree):
+        flat, self._treedef = jax.tree_util.tree_flatten(tree)
+        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        self._leaf_names = []
+        for (path, _), leaf in zip(paths, flat):
+            name = "_".join(str(getattr(p, "key", p)) for p in path)
+            self._leaf_names.append(name)
+            self.add_parameter(name, Tensor(leaf, stop_gradient=False))
+
+    def _tree(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef,
+            [self._parameters[n] for n in self._leaf_names])

@@ -25,10 +25,9 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..nn.layer.layers import Layer
+from .common import PytreeLayer
 from ..ops.pallas.flash_attn import flash_attention
 from ..ops import dispatch
-from ..tensor.tensor import Tensor
 
 
 @dataclasses.dataclass
@@ -202,7 +201,7 @@ def loss_fn(params, tokens, labels, cfg: GPTConfig):
 # eager Layer wrappers (dygraph API)
 # --------------------------------------------------------------------------
 
-class GPT(Layer):
+class GPT(PytreeLayer):
     """Eager wrapper: holds the pytree leaves as Parameters so state_dict /
     optimizers / hapi work; forward routes the whole functional core through
     one tape node (dispatch.call records jax.vjp of the full model)."""
@@ -211,19 +210,7 @@ class GPT(Layer):
         super().__init__()
         self.cfg = cfg or GPTConfig(**kwargs)
         from ..framework import core
-        tree = init_params(self.cfg, core.next_rng_key())
-        flat, self._treedef = jax.tree_util.tree_flatten(tree)
-        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
-        self._leaf_names = []
-        for (path, _), leaf in zip(paths, flat):
-            name = "_".join(str(getattr(p, "key", p)) for p in path)
-            self._leaf_names.append(name)
-            self.add_parameter(name, Tensor(leaf, stop_gradient=False))
-
-    def _tree(self):
-        return jax.tree_util.tree_unflatten(
-            self._treedef,
-            [self._parameters[n] for n in self._leaf_names])
+        self._adopt_tree(init_params(self.cfg, core.next_rng_key()))
 
     def forward(self, tokens):
         fn = functools.partial(

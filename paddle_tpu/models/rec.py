@@ -1,0 +1,290 @@
+"""Wide&Deep / DeepFM recommenders over sharded sparse embeddings.
+
+The reference serves these CTR models through its parameter-server path:
+sparse embedding tables live on pserver nodes, workers pull/push rows
+(ref: paddle/fluid/distributed/, python/paddle/fluid/incubate/fleet/
+parameter_server/, shard_index op in paddle/fluid/operators/shard_index_op.cc).
+
+TPU-native redesign: there is no parameter server — the embedding table is a
+normal array whose ROW axis is sharded over the mesh 'tp' axis (HBM across
+chips is the "server"); a lookup is a masked local gather + ``psum('tp')``,
+exactly the vocab-parallel embedding trick (models/gpt_hybrid.py::_vp_embed).
+Dense MLP parts are replicated; the batch is sharded over 'dp'; the whole
+train step is one SPMD program and XLA rides the lookups/reductions on ICI.
+
+Inputs follow the classic CTR layout: ``sparse_ids`` [B, F] int32 (one id
+per feature field, already hashed into the table), ``dense`` [B, Dd] fp32,
+``labels`` [B] {0,1}.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .common import PytreeLayer
+from ..ops import dispatch
+from ..optimizer.functional import adamw_update
+
+
+@dataclasses.dataclass
+class RecConfig:
+    vocab_size: int = 1000003        # hashed id space (rows of the table)
+    num_fields: int = 26             # sparse feature fields (Criteo layout)
+    dense_dim: int = 13              # dense feature count
+    embed_dim: int = 16              # per-field embedding width
+    mlp_dims: tuple = (400, 400, 400)
+    dtype: str = "float32"           # CTR nets are small: fp32 is fine
+    initializer_range: float = 0.01
+
+    def padded_vocab(self, shards=1):
+        """Rows padded so the table splits evenly over `shards`."""
+        v = self.vocab_size
+        return (v + shards - 1) // shards * shards
+
+
+def rec_tiny():
+    return RecConfig(vocab_size=1000, num_fields=8, dense_dim=4,
+                     embed_dim=8, mlp_dims=(32, 16))
+
+
+# --------------------------------------------------------------------------
+# shared pieces
+# --------------------------------------------------------------------------
+
+def _init_mlp(key, in_dim, dims, std, pd):
+    ws, bs = [], []
+    for d in dims + (1,):
+        key, k = jax.random.split(key)
+        ws.append((jax.random.normal(k, (in_dim, d), jnp.float32)
+                   * std).astype(pd))
+        bs.append(jnp.zeros((d,), pd))
+        in_dim = d
+    return ws, bs
+
+
+def _mlp(x, ws, bs):
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        x = x @ w + b
+        if i < len(ws) - 1:
+            x = jax.nn.relu(x)
+    return x[..., 0]                 # logits [B]
+
+
+def _lookup(table, ids):
+    """Plain (single-shard) embedding lookup: [B,F] -> [B,F,D]."""
+    return jnp.take(table, ids, axis=0)
+
+
+def _lookup_sharded(table, ids, axis="tp"):
+    """Row-sharded lookup inside shard_map: table [V/tp, D] local shard.
+    Masked local gather + psum — rows live on exactly one shard."""
+    v_local = table.shape[0]
+    idx = jax.lax.axis_index(axis)
+    local = ids - idx * v_local
+    ok = (local >= 0) & (local < v_local)
+    e = jnp.take(table, jnp.clip(local, 0, v_local - 1), axis=0)
+    e = jnp.where(ok[..., None], e, 0.0)
+    return jax.lax.psum(e, axis)
+
+
+def _bce_per_example(logits, labels):
+    """Element-wise binary cross entropy on logits (stable form)."""
+    y = labels.astype(jnp.float32)
+    return (jnp.maximum(logits, 0) - logits * y
+            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def _bce_logits(logits, labels):
+    return jnp.mean(_bce_per_example(logits, labels))
+
+
+# --------------------------------------------------------------------------
+# Wide&Deep
+# --------------------------------------------------------------------------
+
+def init_wide_deep(cfg: RecConfig, key, shards=1):
+    """Wide part: per-id scalar weights (a [V,1] table) + dense linear.
+    Deep part: [V,D] embeddings -> MLP over concat(embeddings, dense)."""
+    pd = jnp.dtype(cfg.dtype)
+    std = cfg.initializer_range
+    V = cfg.padded_vocab(shards)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    deep_in = cfg.num_fields * cfg.embed_dim + cfg.dense_dim
+    ws, bs = _init_mlp(k3, deep_in, cfg.mlp_dims, std, pd)
+    return {
+        "wide_table": (jax.random.normal(k1, (V, 1), jnp.float32)
+                       * std).astype(pd),
+        "wide_dense_w": (jax.random.normal(k4, (cfg.dense_dim,), jnp.float32)
+                         * std).astype(pd),
+        "embed": (jax.random.normal(k2, (V, cfg.embed_dim), jnp.float32)
+                  * std).astype(pd),
+        "mlp_w": ws, "mlp_b": bs,
+        "bias": jnp.zeros((), pd),
+    }
+
+
+def wide_deep_logits(params, sparse_ids, dense, cfg: RecConfig,
+                     lookup=_lookup):
+    wide = (jnp.sum(lookup(params["wide_table"], sparse_ids)[..., 0], -1)
+            + dense @ params["wide_dense_w"])
+    emb = lookup(params["embed"], sparse_ids)       # [B, F, D]
+    deep_in = jnp.concatenate(
+        [emb.reshape(emb.shape[0], -1), dense], axis=-1)
+    deep = _mlp(deep_in, params["mlp_w"], params["mlp_b"])
+    return wide + deep + params["bias"]
+
+
+# --------------------------------------------------------------------------
+# DeepFM
+# --------------------------------------------------------------------------
+
+def init_deepfm(cfg: RecConfig, key, shards=1):
+    """FM first-order table [V,1], shared second-order/deep table [V,D]."""
+    p = init_wide_deep(cfg, key, shards)
+    # same structure: wide_table doubles as the FM first-order weights
+    return p
+
+
+def deepfm_logits(params, sparse_ids, dense, cfg: RecConfig,
+                  lookup=_lookup):
+    first = (jnp.sum(lookup(params["wide_table"], sparse_ids)[..., 0], -1)
+             + dense @ params["wide_dense_w"])
+    emb = lookup(params["embed"], sparse_ids)       # [B, F, D]
+    # FM second order: 1/2 * sum_d[(sum_f e)^2 - sum_f e^2]
+    s = jnp.sum(emb, axis=1)
+    second = 0.5 * jnp.sum(s * s - jnp.sum(emb * emb, axis=1), axis=-1)
+    deep_in = jnp.concatenate(
+        [emb.reshape(emb.shape[0], -1), dense], axis=-1)
+    deep = _mlp(deep_in, params["mlp_w"], params["mlp_b"])
+    return first + second + deep + params["bias"]
+
+
+# --------------------------------------------------------------------------
+# sharded train step (embedding rows over 'tp', batch over 'dp')
+# --------------------------------------------------------------------------
+
+def param_specs(params):
+    """Tables row-sharded over 'tp'; everything else replicated."""
+    def spec(path, leaf):
+        name = str(getattr(path[0], "key", path[0]))
+        if name in ("wide_table", "embed"):
+            return P("tp")
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def init_sharded(cfg: RecConfig, mesh, key, model="wide_deep"):
+    """(params, m, v) placed: tables split over 'tp', rest replicated."""
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    init = init_wide_deep if model == "wide_deep" else init_deepfm
+    params = init(cfg, key, shards=axes.get("tp", 1))
+    specs = param_specs(params)
+    place = lambda x, s: jax.device_put(x, NamedSharding(mesh, s))  # noqa: E731
+    params = jax.tree_util.tree_map(place, params, specs)
+
+    def zeros():
+        return jax.tree_util.tree_map(
+            lambda p, s: place(jnp.zeros(p.shape, jnp.float32), s),
+            params, specs)
+    return params, zeros(), zeros()
+
+
+def make_train_step(cfg: RecConfig, mesh, model="wide_deep",
+                    beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0):
+    """Jitted ``step(params, m, v, t, sparse_ids, dense, labels, lr)`` ->
+    (params, m, v, loss).  sparse_ids/dense/labels are GLOBAL, batch-sharded
+    over 'dp'; tables stay sharded over 'tp' end to end (grads included)."""
+    logits_fn = (wide_deep_logits if model == "wide_deep"
+                 else deepfm_logits)
+    mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = mesh_axes.get("dp", 1)
+    init = init_wide_deep if model == "wide_deep" else init_deepfm
+    # specs from a shape-only template init (no compute at trace time)
+    template = jax.eval_shape(
+        lambda k: init(cfg, k, shards=mesh_axes.get("tp", 1)),
+        jax.random.PRNGKey(0))
+    specs = param_specs(template)
+
+    def loss_fn(params, ids, dense, labels):
+        logits = logits_fn(params, ids, dense, cfg,
+                           lookup=functools.partial(_lookup_sharded,
+                                                    axis="tp"))
+        # mean over the GLOBAL batch: psum local sums over dp
+        per = _bce_per_example(logits, labels)
+        total = jax.lax.psum(jnp.sum(per), "dp") if dp > 1 else jnp.sum(per)
+        n = jax.lax.psum(jnp.asarray(per.size, jnp.float32), "dp") \
+            if dp > 1 else jnp.asarray(per.size, jnp.float32)
+        return total / n
+
+    def step(params, m, v, t, ids, dense, labels, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, dense, labels)
+        # the replicated loss makes every copy's grad carry a factor of
+        # mesh.size; sum partials over each leaf's REPLICATED axes and
+        # divide by mesh.size (see gpt_hybrid._sync_grads rationale)
+        def red(g, s):
+            sharded = {a for part in s if part is not None
+                       for a in ((part,) if isinstance(part, str) else part)}
+            axes = tuple(a for a in mesh.axis_names if a not in sharded)
+            if axes:
+                g = jax.lax.psum(g, axes)
+            return g / mesh.size
+        grads = jax.tree_util.tree_map(red, grads, specs)
+        tf = t.astype(jnp.float32)
+
+        def upd(p, g, mm, vv):
+            return adamw_update(p, g, mm, vv, lr, tf, beta1, beta2, eps,
+                                weight_decay, weight_decay > 0)
+        out = jax.tree_util.tree_map(upd, params, grads, m, v)
+        tup = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda o: o[i], out, is_leaf=lambda o: isinstance(o, tuple))
+        return tup(0), tup(1), tup(2), loss
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, specs, specs, P(), P("dp"), P("dp"), P("dp"), P()),
+        out_specs=(specs, specs, specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+
+# --------------------------------------------------------------------------
+# eager Layer wrappers
+# --------------------------------------------------------------------------
+
+class _RecBase(PytreeLayer):
+    _init = None
+    _logits = staticmethod(None)
+
+    def __init__(self, cfg: RecConfig = None, **kwargs):
+        super().__init__()
+        self.cfg = cfg or RecConfig(**kwargs)
+        from ..framework import core
+        self._adopt_tree(type(self)._init(self.cfg, core.next_rng_key()))
+
+    def forward(self, sparse_ids, dense, labels=None):
+        logit_fn = type(self)._logits
+
+        def fn(p, ids, d, lab):
+            logits = logit_fn(p, ids, d, self.cfg)
+            if lab is None:
+                return jax.nn.sigmoid(logits)
+            return _bce_logits(logits, lab)
+        return dispatch.call(fn, self._tree(), sparse_ids, dense, labels,
+                             _name=type(self).__name__.lower())
+
+
+class WideDeep(_RecBase):
+    """forward(sparse_ids, dense) -> CTR probability [B]; with labels ->
+    scalar BCE loss."""
+    _init = staticmethod(init_wide_deep)
+    _logits = staticmethod(wide_deep_logits)
+
+
+class DeepFM(_RecBase):
+    _init = staticmethod(init_deepfm)
+    _logits = staticmethod(deepfm_logits)
