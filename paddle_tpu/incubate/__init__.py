@@ -6,3 +6,21 @@ attention for long context and fused Pallas ops.
 from ..parallel.ring_attention import ring_attention_sharded as ring_attention
 from ..nn.functional.attention import flash_attention
 from ..nn.functional.norm import rms_norm
+
+
+def fused_feedforward(x, w1, b1, w2, b2):
+    """gelu(x@w1+b1)@w2+b2 in one Pallas kernel (eager, differentiable;
+    the reference grows the same op as fused_feedforward in
+    paddle/fluid/operators/fused/fused_feedforward_op.cu)."""
+    from ..ops import dispatch
+    from ..ops.pallas.fused_ffn import fused_ffn as _ffn
+    return dispatch.call(lambda a, *p: _ffn(a, *p), x, w1, b1, w2, b2,
+                         _name="fused_feedforward")
+
+
+def fused_layer_norm(x, weight, bias, epsilon=1e-5):
+    """Fused last-axis LayerNorm Pallas kernel (eager, differentiable)."""
+    from ..ops import dispatch
+    from ..ops.pallas.norms import layer_norm as _ln
+    return dispatch.call(lambda a, w, b: _ln(a, w, b, epsilon),
+                         x, weight, bias, _name="fused_layer_norm")

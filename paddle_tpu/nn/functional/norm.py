@@ -11,6 +11,15 @@ from ...ops.dispatch import call
 from ...tensor.tensor import Tensor
 
 
+def _recording(*tensors):
+    """True when this call will land on the eager grad tape — the fused
+    Pallas norms recompute their forward in the backward (remat trade), so
+    training paths keep the single-pass XLA formula."""
+    from ...framework import core
+    return core.grad_enabled() and not core.in_tracing() and any(
+        isinstance(t, Tensor) and not t.stop_gradient for t in tensors)
+
+
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     def _n(a):
         nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
@@ -70,6 +79,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     nd = len(tuple(normalized_shape))
+
+    if (nd == 1 and weight is not None and bias is not None
+            and not _recording(x, weight, bias)):
+        # inference path: one fused Pallas kernel per call
+        # (ops/pallas/norms.py; falls back to the same XLA formula off-TPU)
+        from ...ops.pallas.norms import layer_norm as _fused_ln
+        return call(lambda a, w, b: _fused_ln(a, w, b, epsilon),
+                    x, weight, bias, _name="layer_norm")
 
     def _ln(a, *wb):
         axes = tuple(range(a.ndim - nd, a.ndim))
@@ -157,6 +174,11 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (modern LLM staple; used by the flagship GPT model)."""
+    if weight is not None and not _recording(x, weight):
+        from ...ops.pallas.norms import rms_norm as _fused_rms
+        return call(lambda a, w: _fused_rms(a, w, epsilon),
+                    x, weight, _name="rms_norm")
+
     def _rms(a, *w):
         ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
         out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)

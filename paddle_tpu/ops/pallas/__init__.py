@@ -2,3 +2,7 @@
 (paddle/fluid/operators/fused/*).
 """
 from . import flash_attn
+from . import norms
+from . import fused_ffn
+from .flash_attn import flash_attention  # noqa: F401
+from .norms import layer_norm, rms_norm  # noqa: F401

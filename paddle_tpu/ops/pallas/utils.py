@@ -1,0 +1,18 @@
+"""Shared probes for the Pallas kernel modules."""
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+
+def on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False

@@ -1,0 +1,138 @@
+"""Pallas kernels vs reference math (interpret mode on the CPU mesh).
+
+Models the reference's fused-op unittests (ref: python/paddle/fluid/tests/
+unittests/test_fused_attention_op.py, test_fused_feedforward_op.py,
+test_layer_norm_op.py): fused kernel output must match the unfused
+composition, and gradients must flow."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import norms, fused_ffn as ffn_mod
+from paddle_tpu.ops.pallas.flash_attn import flash_attention, _ref_attention
+
+
+@pytest.mark.parametrize("shape", [(8, 128), (2, 16, 256), (64, 384)])
+def test_layer_norm_matches_ref(shape):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    g = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+    b = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+    got = norms.layer_norm(x, g, b, 1e-5, True)       # pallas interpret
+    want = norms._ref_layer_norm(x, g, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(8, 128), (4, 8, 256)])
+def test_rms_norm_matches_ref(shape):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    g = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+    got = norms.rms_norm(x, g, 1e-6, True)
+    want = norms._ref_rms_norm(x, g, 1e-6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_layer_norm_grads():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(16, 128), jnp.float32)
+    g = jnp.asarray(rng.randn(128), jnp.float32)
+    b = jnp.asarray(rng.randn(128), jnp.float32)
+
+    def f_pallas(x, g, b):
+        return jnp.sum(jnp.sin(norms.layer_norm(x, g, b, 1e-5, True)))
+
+    def f_ref(x, g, b):
+        return jnp.sum(jnp.sin(norms._ref_layer_norm(x, g, b, 1e-5)))
+
+    gp = jax.grad(f_pallas, (0, 1, 2))(x, g, b)
+    gr = jax.grad(f_ref, (0, 1, 2))(x, g, b)
+    for a, w in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w), atol=1e-4)
+
+
+@pytest.mark.parametrize("M,H,F", [(128, 128, 256), (256, 256, 512)])
+def test_fused_ffn_matches_ref(M, H, F):
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(M, H) * 0.5, jnp.float32)
+    w1 = jnp.asarray(rng.randn(H, F) * 0.05, jnp.float32)
+    b1 = jnp.asarray(rng.randn(F) * 0.01, jnp.float32)
+    w2 = jnp.asarray(rng.randn(F, H) * 0.05, jnp.float32)
+    b2 = jnp.asarray(rng.randn(H) * 0.01, jnp.float32)
+    got = ffn_mod.fused_ffn(x, w1, b1, w2, b2, True)
+    want = ffn_mod._ref_ffn(x, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_fused_ffn_batched_and_grads():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 64, 128) * 0.5, jnp.float32)
+    w1 = jnp.asarray(rng.randn(128, 256) * 0.05, jnp.float32)
+    b1 = jnp.zeros((256,), jnp.float32)
+    w2 = jnp.asarray(rng.randn(256, 128) * 0.05, jnp.float32)
+    b2 = jnp.zeros((128,), jnp.float32)
+    got = ffn_mod.fused_ffn(x, w1, b1, w2, b2, True)
+    assert got.shape == x.shape
+
+    def f(x, w1, w2):
+        return jnp.sum(ffn_mod.fused_ffn(x, w1, b1, w2, b2, True) ** 2)
+
+    def fr(x, w1, w2):
+        return jnp.sum(ffn_mod._ref_ffn(x, w1, b1, w2, b2) ** 2)
+
+    gp = jax.grad(f, (0, 1, 2))(x, w1, w2)
+    gr = jax.grad(fr, (0, 1, 2))(x, w1, w2)
+    for a, w in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   atol=2e-3, rtol=1e-3)
+
+
+def test_incubate_fused_ops_eager():
+    """incubate.fused_feedforward / fused_layer_norm run on the eager tape
+    and backprop into their weights."""
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(6)
+    x = paddle.to_tensor(rng.randn(16, 128).astype(np.float32))
+    w1 = paddle.to_tensor((rng.randn(128, 256) * 0.05).astype(np.float32),
+                          stop_gradient=False)
+    b1 = paddle.to_tensor(np.zeros(256, np.float32), stop_gradient=False)
+    w2 = paddle.to_tensor((rng.randn(256, 128) * 0.05).astype(np.float32),
+                          stop_gradient=False)
+    b2 = paddle.to_tensor(np.zeros(128, np.float32), stop_gradient=False)
+    out = paddle.incubate.fused_feedforward(x, w1, b1, w2, b2)
+    assert tuple(out.shape) == (16, 128)
+    out.sum().backward()
+    assert w1.grad is not None and np.abs(np.asarray(
+        w1.grad.numpy())).sum() > 0
+
+    g = paddle.to_tensor(np.ones(128, np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.zeros(128, np.float32), stop_gradient=False)
+    y = paddle.incubate.fused_layer_norm(x, g, b)
+    y.sum().backward()
+    assert g.grad is not None
+    np.testing.assert_allclose(
+        np.asarray(y.numpy()),
+        np.asarray(norms._ref_layer_norm(
+            jnp.asarray(np.asarray(x.numpy())), jnp.ones(128),
+            jnp.zeros(128), 1e-5)),
+        atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_fallback_matches_dense(causal):
+    """On CPU flash_attention routes to the fused XLA path; check the
+    custom_vjp wiring end to end anyway."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 128, 4, 64) * 0.1, jnp.float32)
+    k = jnp.asarray(rng.randn(2, 128, 4, 64) * 0.1, jnp.float32)
+    v = jnp.asarray(rng.randn(2, 128, 4, 64) * 0.1, jnp.float32)
+    out = flash_attention(q, k, v, causal)
+    want = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, causal)))(q)
+    gw = jax.grad(lambda q: jnp.sum(_ref_attention(q, k, v, causal)))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gw), atol=1e-5)
