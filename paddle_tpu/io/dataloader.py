@@ -34,16 +34,35 @@ def default_collate_fn(batch):
     return Tensor(np.asarray(batch))
 
 
+def _numpy_collate(batch):
+    """Collate into a pytree of numpy arrays (native staging path)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_numpy_collate([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([b[k] for b in batch]) for k in sample}
+    return np.asarray(batch)
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_native_ring=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._default_collate = collate_fn is None
         self.num_workers = num_workers
+        self.use_native_ring = use_native_ring
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -138,7 +157,115 @@ class DataLoader:
             for t in threads:
                 t.join(timeout=0.1)
 
+    def _iter_native_ring(self):
+        """Native staging path (ref: C++ BlockingQueue reader, paddle/fluid/
+        operators/reader/blocking_queue.h): workers collate to numpy and
+        gather each batch into ONE C++ pool slab (memcpy with the GIL
+        released), the bounded ring backpressures producers, and the
+        consumer wraps popped views into Tensors — host staging overlaps
+        the device step."""
+        import jax
+        from jax.tree_util import tree_flatten, tree_unflatten
+
+        from .. import runtime
+
+        batches = list(self.batch_sampler)
+        ring = runtime.DataRing(
+            capacity=self.prefetch_factor * self.num_workers)
+        treedefs = {}
+        td_lock = threading.Lock()
+        errors = []
+        work_q: queue.Queue = queue.Queue()
+        for i, b in enumerate(batches):
+            work_q.put((i, b))
+
+        def collate(idxs):
+            samples = [self.dataset[i] for i in idxs]
+            if self._default_collate:
+                tree = _numpy_collate(samples)
+            else:
+                tree = jax.tree.map(
+                    lambda x: np.asarray(x.numpy() if isinstance(x, Tensor)
+                                         else x), self.collate_fn(samples))
+            leaves, td = tree_flatten(tree)
+            for leaf in leaves:
+                if not isinstance(leaf, np.ndarray) or leaf.dtype.hasobject:
+                    raise TypeError(
+                        "native-ring DataLoader requires numeric array "
+                        f"batches, got dtype={getattr(leaf, 'dtype', type(leaf))}; "
+                        "pass use_native_ring=False for object batches")
+            return leaves, td
+
+        def worker():
+            while True:
+                try:
+                    i, idxs = work_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    leaves, td = collate(idxs)
+                    with td_lock:
+                        treedefs[i] = (td, len(leaves))
+                    rc = ring.push(leaves, i)
+                    if rc == runtime.DataRing.CLOSED:
+                        return       # consumer shut down under us
+                    if rc != 0:
+                        raise MemoryError(
+                            f"native ring push failed (code {rc})")
+                except Exception as e:
+                    errors.append(e)
+                    ring.close()
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+
+        pending = {}
+        want = 0
+        try:
+            while want < len(batches):
+                if want in pending:
+                    yield pending.pop(want)
+                    want += 1
+                    continue
+                got = ring.pop()
+                if got is None:        # closed: error or all done
+                    if errors:
+                        raise errors[0]
+                    break
+                views, tag = got
+                td, _ = treedefs.pop(tag)
+                # Tensor() copies out of the slab (host->device put), so the
+                # views may be recycled after this line
+                tree = tree_unflatten(td, [Tensor(v.copy()) for v in views])
+                pending[tag] = tree
+            while want in pending:
+                yield pending.pop(want)
+                want += 1
+            if errors:
+                raise errors[0]
+        finally:
+            ring.close()
+            for t in threads:
+                t.join(timeout=30.0)
+            if any(t.is_alive() for t in threads):
+                # never free the native ring under a live producer; leak it
+                # (daemon threads will see closed on their next push)
+                pass
+            else:
+                ring.destroy()
+
     def __iter__(self):
         if self.num_workers and not self._iterable_mode:
+            use_ring = self.use_native_ring
+            if use_ring is None:
+                # auto mode must not stall the first epoch on a C++ compile:
+                # only take the native path when the library is already built
+                from .. import runtime
+                use_ring = runtime.is_prebuilt()
+            if use_ring:
+                return self._iter_native_ring()
             return self._iter_threaded()
         return self._iter_single()

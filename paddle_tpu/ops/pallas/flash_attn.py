@@ -14,12 +14,11 @@ import math
 import jax
 import jax.numpy as jnp
 
-try:
+from .utils import HAS_PALLAS, on_tpu
+
+if HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-except ImportError:  # pragma: no cover
-    _HAS_PALLAS = False
 
 NEG_INF = -1e30
 
@@ -41,7 +40,9 @@ def _ref_attention(q, k, v, causal):
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-               causal, sm_scale, block_q, block_k, seq_len):
+               causal, sm_scale, block_q, block_k, kv_len, q_offset):
+    """q_offset = kv_len - q_len: bottom-right causal alignment, matching
+    _ref_attention's tril(k=m-n) (query i attends keys j <= i+q_offset)."""
     qi = pl.program_id(2)   # query block index
     ki = pl.program_id(3)   # key block index
 
@@ -52,8 +53,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     if causal:
-        # skip K blocks fully above the diagonal
-        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+        # skip K blocks fully above the (bottom-right aligned) diagonal
+        run = (ki * block_k) <= (qi * block_q + block_q - 1 + q_offset)
     else:
         run = jnp.asarray(True)
 
@@ -65,12 +66,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale                             # [block_q, block_k]
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = cols < kv_len                        # mask padded KV tail
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            valid = valid & (rows + q_offset >= cols)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:]                            # [block_q, 128]
         m_cur = jnp.max(s, axis=1, keepdims=True)    # [block_q, 1]
@@ -89,7 +91,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[:] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128):
+def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
+                         interpret=False):
     """q,k,v: [B, N, H, D] — grid over (batch, head, q-block, k-block)."""
     B, N, H, D = q.shape
     Nk = k.shape[1]
@@ -97,26 +100,35 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128):
     block_q = min(block_q, N)
     block_k = min(block_k, Nk)
 
-    # work in [B,H,N,D]
+    # work in [B,H,N,D]; pad sequence dims to block multiples so OOB tiles
+    # never feed garbage into the p@v product (tail masked via kv_len)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
+    Np = pl.cdiv(N, block_q) * block_q
+    Nkp = pl.cdiv(Nk, block_k) * block_k
+    if Np != N:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, Np - N), (0, 0)))
+    if Nkp != Nk:
+        kh = jnp.pad(kh, ((0, 0), (0, 0), (0, Nkp - Nk), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, 0), (0, Nkp - Nk), (0, 0)))
 
-    grid = (B, H, pl.cdiv(N, block_q), pl.cdiv(Nk, block_k))
+    grid = (B, H, Np // block_q, Nkp // block_k)
 
     out = pl.pallas_call(
         functools.partial(_fa_kernel, causal=causal, sm_scale=sm_scale,
-                          block_q=block_q, block_k=block_k, seq_len=N),
+                          block_q=block_q, block_k=block_k, kv_len=Nk,
+                          q_offset=Nk - N),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
+            pl.BlockSpec((None, None, block_q, D),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
+            pl.BlockSpec((None, None, block_k, D),
                          lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
+            pl.BlockSpec((None, None, block_k, D),
                          lambda b, h, qi, ki: (b, h, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
+        out_specs=pl.BlockSpec((None, None, block_q, D),
                                lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
         scratch_shapes=[
@@ -124,20 +136,16 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        interpret=interpret,
     )(qh, kh, vh)
-    return jnp.swapaxes(out, 1, 2)
+    return jnp.swapaxes(out[:, :, :N], 1, 2)
 
 
 def _use_pallas(q):
-    if not _HAS_PALLAS:
-        return False
-    try:
-        if jax.devices()[0].platform == "cpu":
-            return False
-    except Exception:
+    if not (HAS_PALLAS and on_tpu()):
         return False
     B, N, H, D = q.shape
-    return (D % 128 == 0 or D in (64,)) and N >= 128 and N % 128 == 0
+    return (D % 128 == 0 or D in (64,)) and N >= 128
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

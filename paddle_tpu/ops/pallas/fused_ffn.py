@@ -80,7 +80,10 @@ def _pick_blocks(M, H, F, itemsize):
     """(block_m, block_f) fitting ~12MB VMEM, or None if untileable."""
     if H % 128 or F % 128:
         return None
-    block_m = 128 if M % 128 == 0 else (M if M % 8 == 0 and M <= 512
+    # sublane minimum scales inversely with itemsize: (8,128) f32, (16,128)
+    # bf16, (32,128) int8 — same guard as norms._rows_block
+    min_rows = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    block_m = 128 if M % 128 == 0 else (M if M % min_rows == 0 and M <= 512
                                         else None)
     if block_m is None:
         return None

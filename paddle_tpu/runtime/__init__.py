@@ -1,0 +1,231 @@
+"""Native host runtime (C++): staging memory pool + data ring.
+
+TPU-native analogue of the reference's C++ data path (ref:
+paddle/fluid/operators/reader/blocking_queue.h, paddle/fluid/memory/
+allocation/auto_growth_best_fit_allocator.cc).  The compute path is XLA;
+what stays native is the host side: batch staging buffers drawn from a
+size-class auto-growth pool, and a bounded blocking ring that overlaps
+worker collation + memcpy (GIL released via ctypes) with device steps.
+
+Degrades gracefully: if no C++ toolchain is available, is_available() is
+False and io.DataLoader falls back to its pure-Python queue.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from .build import build as _build
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            _lib_err = "no C++ toolchain"
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ptpu_pool_create.restype = ctypes.c_int64
+        lib.ptpu_pool_alloc.restype = ctypes.c_void_p
+        lib.ptpu_pool_alloc.argtypes = [ctypes.c_int64, ctypes.c_uint64]
+        lib.ptpu_pool_free.argtypes = [ctypes.c_int64, ctypes.c_void_p]
+        lib.ptpu_pool_destroy.argtypes = [ctypes.c_int64]
+        lib.ptpu_pool_stats.argtypes = [ctypes.c_int64,
+                                        ctypes.POINTER(ctypes.c_uint64)]
+        lib.ptpu_ring_create.restype = ctypes.c_int64
+        lib.ptpu_ring_create.argtypes = [ctypes.c_int]
+        lib.ptpu_ring_destroy.argtypes = [ctypes.c_int64]
+        lib.ptpu_ring_push_gather.restype = ctypes.c_int
+        lib.ptpu_ring_push_gather.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int]
+        lib.ptpu_ring_pop.restype = ctypes.c_int
+        lib.ptpu_ring_pop.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int]
+        lib.ptpu_ring_release.argtypes = [ctypes.c_int64, ctypes.c_void_p]
+        lib.ptpu_ring_close.argtypes = [ctypes.c_int64]
+        lib.ptpu_ring_size.restype = ctypes.c_int
+        lib.ptpu_ring_size.argtypes = [ctypes.c_int64]
+        lib.ptpu_ring_stats.argtypes = [ctypes.c_int64,
+                                        ctypes.POINTER(ctypes.c_uint64)]
+        _lib = lib
+        return _lib
+
+
+def is_available():
+    """True if the native library is loadable (builds it on first call)."""
+    return _load() is not None
+
+
+def is_prebuilt():
+    """True if the .so already exists and loads — never triggers a compile."""
+    from . import build as _b
+    import os
+    if not (os.path.exists(_b.LIB)
+            and os.path.getmtime(_b.LIB) >= os.path.getmtime(_b.SRC)):
+        return False
+    return _load() is not None
+
+
+class HostMemoryPool:
+    """Size-class auto-growth host allocator with statistics.
+
+    Analogue of the reference's AutoGrowthBestFitAllocator for host staging
+    memory (device memory is managed by XLA/libtpu on TPU).
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.ptpu_pool_create()
+
+    def alloc(self, nbytes: int) -> int:
+        p = self._lib.ptpu_pool_alloc(self._h, nbytes)
+        if not p:
+            raise MemoryError(f"pool alloc of {nbytes} bytes failed")
+        return p
+
+    def free(self, ptr: int):
+        self._lib.ptpu_pool_free(self._h, ptr)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.ptpu_pool_stats(self._h, out)
+        keys = ("reserved", "in_use", "peak_in_use", "alloc_count",
+                "grow_count", "free_count")
+        return dict(zip(keys, [int(v) for v in out]))
+
+    def close(self):
+        if self._h:
+            self._lib.ptpu_pool_destroy(self._h)
+            self._h = 0
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class DataRing:
+    """Bounded blocking ring of staged batches.
+
+    push(arrays, tag) gathers a batch's numpy arrays into one native slab
+    (single GIL-released memcpy pass) and blocks while the ring is full;
+    pop() returns (views, tag) where views are zero-copy numpy views into
+    the slab — consume (e.g. device-put) then the slab is recycled on the
+    next pop via deferred release.
+    """
+
+    CLOSED, TIMEOUT, OOM = -1, -2, -3
+
+    def __init__(self, capacity: int = 8):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.ptpu_ring_create(capacity)
+        self._meta = {}           # tag -> per-array (shape, dtype, nbytes)
+        self._meta_lock = threading.Lock()
+        self._pending_release = None
+
+    def push(self, arrays, tag: int, timeout_ms: int = -1) -> int:
+        arrs = [np.ascontiguousarray(a) for a in arrays]
+        n = len(arrs)
+        srcs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data for a in arrs])
+        lens = (ctypes.c_uint64 * n)(*[a.nbytes for a in arrs])
+        with self._meta_lock:
+            self._meta[tag] = [(a.shape, a.dtype, a.nbytes) for a in arrs]
+        rc = self._lib.ptpu_ring_push_gather(self._h, srcs, lens, n,
+                                             tag, timeout_ms)
+        if rc != 0:
+            with self._meta_lock:
+                self._meta.pop(tag, None)
+        return rc
+
+    def pop(self, timeout_ms: int = -1):
+        """Returns (list_of_array_views, tag) or None when closed+drained.
+
+        The views alias native memory that is recycled on the NEXT pop();
+        copy (or device-put) before then.
+        """
+        if self._pending_release is not None:
+            self._lib.ptpu_ring_release(self._h, self._pending_release)
+            self._pending_release = None
+        ptr = ctypes.c_void_p()
+        ln = ctypes.c_uint64()
+        tag = ctypes.c_uint64()
+        rc = self._lib.ptpu_ring_pop(self._h, ctypes.byref(ptr),
+                                     ctypes.byref(ln), ctypes.byref(tag),
+                                     timeout_ms)
+        if rc == self.CLOSED:
+            return None
+        if rc == self.TIMEOUT:
+            raise TimeoutError("DataRing.pop timed out")
+        with self._meta_lock:
+            meta = self._meta.pop(int(tag.value))
+        buf = (ctypes.c_char * ln.value).from_address(ptr.value)
+        flat = np.frombuffer(buf, dtype=np.uint8)
+        views, off = [], 0
+        for shape, dtype, nbytes in meta:
+            views.append(flat[off:off + nbytes].view(dtype).reshape(shape))
+            off += nbytes
+        self._pending_release = ptr.value
+        return views, int(tag.value)
+
+    def close(self):
+        self._lib.ptpu_ring_close(self._h)
+
+    def size(self) -> int:
+        return self._lib.ptpu_ring_size(self._h)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 8)()
+        self._lib.ptpu_ring_stats(self._h, out)
+        keys = ("pushed", "popped", "reserved", "in_use", "peak_in_use",
+                "alloc_count", "grow_count", "free_count")
+        return dict(zip(keys, [int(v) for v in out]))
+
+    def destroy(self):
+        if self._h:
+            if self._pending_release is not None:
+                self._lib.ptpu_ring_release(self._h, self._pending_release)
+                self._pending_release = None
+            self._lib.ptpu_ring_destroy(self._h)
+            self._h = 0
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+_host_pool = None
+
+
+def host_memory_pool() -> HostMemoryPool:
+    """Process-wide staging pool (paddle.device.cuda.memory_* analogue)."""
+    global _host_pool
+    if _host_pool is None:
+        _host_pool = HostMemoryPool()
+    return _host_pool
+
+
+def host_memory_stats() -> dict:
+    return host_memory_pool().stats()

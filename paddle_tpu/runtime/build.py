@@ -1,0 +1,45 @@
+"""Build the native runtime shared library.
+
+Compiles ptpu_runtime.cc -> libptpu_runtime.so next to this file.  Invoked
+lazily on first import of paddle_tpu.runtime (idempotent: skipped when the
+.so is newer than the source) or directly: python -m paddle_tpu.runtime.build
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_HERE, "ptpu_runtime.cc")
+LIB = os.path.join(_HERE, "libptpu_runtime.so")
+
+
+def build(force=False, quiet=True):
+    """Compile the runtime if needed; returns the .so path or None."""
+    if (not force and os.path.exists(LIB)
+            and os.path.getmtime(LIB) >= os.path.getmtime(SRC)):
+        return LIB
+    for cxx in (os.environ.get("CXX"), "g++", "c++", "clang++"):
+        if not cxx:
+            continue
+        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               SRC, "-o", LIB + ".tmp"]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode == 0:
+            os.replace(LIB + ".tmp", LIB)
+            return LIB
+        if not quiet:
+            sys.stderr.write(res.stderr)
+    return None
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv, quiet=False)
+    if path is None:
+        sys.exit("native runtime build failed")
+    print(path)

@@ -136,3 +136,47 @@ def test_flash_attention_fallback_matches_dense(causal):
     g = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, causal)))(q)
     gw = jax.grad(lambda q: jnp.sum(_ref_attention(q, k, v, causal)))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gw), atol=1e-5)
+
+
+@pytest.mark.parametrize("B,N,H,D", [(2, 256, 4, 64), (1, 512, 2, 128)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_kernel_interpret(B, N, H, D, causal):
+    """The actual TPU kernel body (online-softmax tiling, causal block skip)
+    vs unfused reference, via pallas interpret mode on CPU."""
+    from paddle_tpu.ops.pallas.flash_attn import _flash_attention_tpu
+
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(B, N, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, N, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, N, H, D), jnp.float32)
+    got = _flash_attention_tpu(q, k, v, causal, interpret=True)
+    want = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_flash_attention_kernel_interpret_uneven_blocks():
+    """Sequence not a multiple of the k-block: masked tail must not leak."""
+    from paddle_tpu.ops.pallas.flash_attn import _flash_attention_tpu
+
+    rng = np.random.RandomState(8)
+    q, k, v = [jnp.asarray(rng.randn(1, 384, 2, 64), jnp.float32)
+               for _ in range(3)]
+    got = _flash_attention_tpu(q, k, v, True, block_q=256, block_k=256,
+                               interpret=True)
+    want = _ref_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_flash_attention_kernel_cross_length_causal():
+    """Nk != N (prefix-cache decode shape): causal mask must be bottom-right
+    aligned like _ref_attention's tril(k=m-n)."""
+    from paddle_tpu.ops.pallas.flash_attn import _flash_attention_tpu
+
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 128, 2, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 320, 2, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 320, 2, 64), jnp.float32)
+    got = _flash_attention_tpu(q, k, v, True, block_q=128, block_k=128,
+                               interpret=True)
+    want = _ref_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
