@@ -49,20 +49,39 @@ def amp_state():
     return core._state.amp_state
 
 
-def _maybe_cast_inputs(opname, vals):
-    """Called from dispatch for white-listed ops under auto_cast."""
+def maybe_autocast_fn(fn, opname):
+    """Dispatch hook: wrap primitive ``fn`` so its floating inputs are cast
+    per the active auto_cast lists.  The cast happens INSIDE the op closure,
+    so under the eager tape jax.vjp applies the inverse cast to gradients
+    (bf16 activation grads accumulate back into fp32 master params)."""
+    import jax
+
     st = core._state.amp_state
     if st is None or not st.enable:
-        return vals
-    if opname in getattr(st, "black_list", black_list):
-        return [v.astype(jnp.float32)
-                if hasattr(v, "dtype") and v.dtype == st.dtype else v
-                for v in vals]
+        return fn
     if opname in getattr(st, "white_list", white_list):
-        return [v.astype(st.dtype)
-                if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
-                else v for v in vals]
-    return vals
+        target = st.dtype
+        # downcast any wider float onto the MXU dtype
+        src = lambda d: jnp.issubdtype(d, jnp.floating) and d != target
+    elif opname in getattr(st, "black_list", black_list):
+        # only undo the AMP downcast; leave fp64 pipelines alone
+        target = jnp.float32
+        low = st.dtype
+        src = lambda d: d == low
+    else:
+        return fn
+
+    def _cast(x):
+        if hasattr(x, "astype") and hasattr(x, "dtype") and src(x.dtype):
+            return x.astype(target)
+        return x
+
+    def wrapped(*a, **k):
+        a, k = jax.tree.map(_cast, (a, k))
+        return fn(*a, **k)
+
+    wrapped.__name__ = getattr(fn, "__name__", opname)
+    return wrapped
 
 
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",

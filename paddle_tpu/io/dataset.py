@@ -17,10 +17,12 @@ class IterableDataset(Dataset):
         raise NotImplementedError
 
     def __getitem__(self, idx):
-        raise RuntimeError("IterableDataset has no __getitem__")
+        raise TypeError("IterableDataset has no __getitem__")
 
     def __len__(self):
-        raise RuntimeError("IterableDataset has no __len__")
+        # TypeError (not RuntimeError) so list()/length_hint treat it as
+        # "no length available" instead of propagating
+        raise TypeError("IterableDataset has no __len__")
 
 
 class TensorDataset(Dataset):

@@ -56,6 +56,10 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
     """
     from ..tensor import Tensor
 
+    if core._state.amp_state is not None:
+        from ..amp.auto_cast import maybe_autocast_fn
+        fn = maybe_autocast_fn(fn, _name or getattr(fn, "__name__", "op"))
+
     leaves, treedef = tree_util.tree_flatten(
         (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
 

@@ -1,0 +1,219 @@
+"""jit/to_static, jit save/load, static graph, inference, amp, metric, lr.
+
+Models the reference's unittests (ref: python/paddle/fluid/tests/unittests/
+test_jit_save_load.py, test_executor_and_use_program.py, dygraph_to_static/*,
+test_imperative_auto_mixed_precision.py, python/paddle/tests/test_metrics.py,
+test_lr_scheduler.py): dygraph-vs-compiled parity, program feed/fetch,
+bf16 autocast dtype flow, scaler skip-on-nonfinite, metric math, lr curves.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def test_to_static_parity_and_caching():
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 12), paddle.nn.GELU(),
+                               paddle.nn.Linear(12, 3))
+    snet = paddle.jit.to_static(net)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        x = paddle.to_tensor(rng.randn(4, 6).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(net(x).numpy()),
+                                   np.asarray(snet(x).numpy()), atol=1e-5)
+
+
+def test_to_static_function_with_control_flow():
+    @paddle.jit.to_static
+    def f(x):
+        # python-level branch on tensor-free config is fine under tracing
+        y = paddle.nn.functional.relu(x)
+        return y * 2 + 1
+
+    x = paddle.to_tensor(np.asarray([[-1.0, 2.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(f(x).numpy()), [[1.0, 5.0]])
+
+
+def test_jit_save_load_inference_roundtrip():
+    net = paddle.nn.Sequential(paddle.nn.Linear(5, 7), paddle.nn.Tanh(),
+                               paddle.nn.Linear(7, 2))
+    x = paddle.to_tensor(np.random.RandomState(1).randn(3, 5)
+                         .astype(np.float32))
+    want = np.asarray(net(x).numpy())
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m")
+        paddle.jit.save(paddle.jit.to_static(net), p, input_spec=[x])
+        loaded = paddle.jit.load(p)
+        np.testing.assert_allclose(np.asarray(loaded(x).numpy()), want,
+                                   atol=1e-5)
+
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config(p))
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(np.asarray(x.numpy()))
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_static_program_feed_fetch_and_minimize():
+    paddle.enable_static()
+    try:
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = static.data("x", [None, 3], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = static.Executor()
+        exe.run(start)
+        rng = np.random.RandomState(0)
+        w = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+        losses = []
+        for _ in range(50):
+            xb = rng.randn(32, 3).astype(np.float32)
+            (lv,) = exe.run(main, feed={"x": xb, "y": xb @ w},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.05
+    finally:
+        paddle.disable_static()
+
+
+def test_auto_cast_bf16_dtype_flow():
+    lin = paddle.nn.Linear(8, 8)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = paddle.matmul(x, lin.weight)
+    assert str(y.dtype).endswith("bfloat16")
+    # params stay fp32 masters
+    assert str(lin.weight.dtype).endswith("float32")
+    y2 = paddle.matmul(x, lin.weight)
+    assert str(y2.dtype).endswith("float32")
+
+
+def test_grad_scaler_steps_and_skips():
+    lin = paddle.nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    w0 = np.asarray(lin.weight.numpy()).copy()
+
+    loss = paddle.nn.functional.mse_loss(lin(x), y)
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    opt.clear_grad()
+    w1 = np.asarray(lin.weight.numpy()).copy()
+    assert not np.allclose(w0, w1)          # finite grads -> stepped
+
+    # poison grads with inf: step must be skipped and scale reduced
+    inf_loss = paddle.sum(lin(x)) * paddle.to_tensor(np.float32(np.inf))
+    scale_before = scaler.get_init_loss_scaling() \
+        if not hasattr(scaler, "_scale") else float(
+            np.asarray(scaler._scale))
+    scaler.scale(inf_loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    w2 = np.asarray(lin.weight.numpy()).copy()
+    np.testing.assert_allclose(w1, w2)      # skipped
+
+
+def test_metrics_math():
+    from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+    acc = Accuracy()
+    pred = paddle.to_tensor(np.asarray(
+        [[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32))
+    label = paddle.to_tensor(np.asarray([[0], [1], [1]], np.int64))
+    acc.update(acc.compute(pred, label))
+    np.testing.assert_allclose(acc.accumulate(), 2 / 3, atol=1e-6)
+
+    prec, rec = Precision(), Recall()
+    preds = np.asarray([0.9, 0.8, 0.2, 0.6], np.float32)   # >0.5 -> pos
+    labels = np.asarray([1, 0, 0, 1], np.int64)
+    prec.update(preds, labels)
+    rec.update(preds, labels)
+    np.testing.assert_allclose(prec.accumulate(), 2 / 3, atol=1e-6)
+    np.testing.assert_allclose(rec.accumulate(), 1.0, atol=1e-6)
+
+    auc = Auc()
+    auc.update(np.stack([1 - preds, preds], -1), labels[:, None])
+    assert 0.5 <= auc.accumulate() <= 1.0
+
+
+def test_lr_schedulers_curves():
+    import paddle_tpu.optimizer.lr as lr
+
+    s = lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(6):
+        vals.append(s())
+        s.step()
+    np.testing.assert_allclose(vals, [1, 1, 0.5, 0.5, 0.25, 0.25])
+
+    w = lr.LinearWarmup(learning_rate=1.0, warmup_steps=4, start_lr=0.0,
+                        end_lr=1.0)
+    warm = []
+    for _ in range(5):
+        warm.append(w())
+        w.step()
+    np.testing.assert_allclose(warm[:4], [0.0, 0.25, 0.5, 0.75])
+
+    c = lr.CosineAnnealingDecay(learning_rate=2.0, T_max=10)
+    first = c()
+    for _ in range(10):
+        c.step()
+    assert c() < first * 0.1 + 1e-6
+
+    n = lr.NoamDecay(d_model=64, warmup_steps=10, learning_rate=1.0)
+    seq = []
+    for _ in range(30):
+        seq.append(n())
+        n.step()
+    peak = int(np.argmax(seq))
+    assert 5 <= peak <= 15                      # rises then decays
+
+    p = lr.ReduceOnPlateau(learning_rate=1.0, factor=0.5, patience=1)
+    for loss in [1.0, 1.0, 1.0, 1.0]:
+        p.step(loss)
+    assert p() < 1.0
+
+    lam = lr.LambdaDecay(learning_rate=2.0, lr_lambda=lambda e: 0.1 ** e)
+    lam.step()
+    np.testing.assert_allclose(lam(), 0.2)
+
+
+def test_optimizer_uses_scheduler():
+    lin = paddle.nn.Linear(2, 2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.5, step_size=1,
+                                          gamma=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=lin.parameters())
+    assert abs(opt.get_lr() - 0.5) < 1e-8
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-8
+
+
+def test_auto_cast_backward_keeps_fp32_master_grads():
+    lin = paddle.nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(4, 8)
+                         .astype(np.float32))
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        y = paddle.nn.functional.linear(x, lin.weight, lin.bias)
+        assert str(y.dtype).endswith("bfloat16")
+        loss = paddle.sum(y.astype("float32") ** 2)
+    loss.backward()
+    # grads must land in the master param dtype, not bf16
+    assert str(lin.weight.grad.dtype).endswith("float32")
+    assert np.abs(np.asarray(lin.weight.grad.numpy())).sum() > 0

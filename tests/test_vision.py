@@ -1,0 +1,119 @@
+"""paddle.vision: models forward/backward, transforms, synthetic datasets.
+
+Models the reference's vision unittests (ref: python/paddle/tests/
+test_vision_models.py, test_transforms.py, test_datasets.py): output shapes
+for every zoo architecture, a train step that moves ResNet BN stats,
+transform shape/value semantics, dataset mode/len contracts.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms
+from paddle_tpu.vision.datasets import MNIST, Cifar10, FashionMNIST
+from paddle_tpu.vision.models import (LeNet, MobileNetV1, MobileNetV2,
+                                      ResNet, resnet18, resnet50, vgg16)
+
+
+def _imgs(b=2, c=3, h=32, w=32, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(b, c, h, w).astype(np.float32))
+
+
+def test_lenet_forward_backward():
+    net = LeNet()
+    x = _imgs(c=1, h=28, w=28)
+    out = net(x)
+    assert tuple(out.shape) == (2, 10)
+    loss = paddle.nn.functional.cross_entropy(
+        out, paddle.to_tensor(np.asarray([1, 3], np.int64)))
+    loss.backward()
+    grads = [p.grad for p in net.parameters() if p.grad is not None]
+    assert grads, "no grads flowed"
+
+
+@pytest.mark.parametrize("ctor,num_classes", [
+    (resnet18, 10), (MobileNetV1, 7), (MobileNetV2, 5)])
+def test_small_backbones_forward(ctor, num_classes):
+    net = ctor(num_classes=num_classes)
+    out = net(_imgs())
+    assert tuple(out.shape) == (2, num_classes)
+
+
+def test_resnet50_and_vgg_forward():
+    out = resnet50(num_classes=4)(_imgs())
+    assert tuple(out.shape) == (2, 4)
+    out = vgg16(num_classes=3)(_imgs())
+    assert tuple(out.shape) == (2, 3)
+
+
+def test_resnet_train_step_updates_bn_stats():
+    net = resnet18(num_classes=10)
+    net.train()
+    bn = None
+    for layer in net.sublayers():
+        if isinstance(layer, paddle.nn.BatchNorm2D):
+            bn = layer
+            break
+    assert bn is not None
+    before = np.asarray(bn._mean.numpy()).copy()
+    out = net(_imgs(seed=3))
+    loss = paddle.sum(out ** 2)
+    loss.backward()
+    after = np.asarray(bn._mean.numpy())
+    assert not np.allclose(before, after), "BN running stats frozen in train"
+
+    net.eval()
+    frozen = np.asarray(bn._mean.numpy()).copy()
+    net(_imgs(seed=4))
+    np.testing.assert_allclose(np.asarray(bn._mean.numpy()), frozen)
+
+
+def test_transforms_pipeline():
+    rng = np.random.RandomState(0)
+    img = (rng.rand(40, 48, 3) * 255).astype(np.uint8)
+
+    t = transforms.Compose([
+        transforms.Resize((32, 32)),
+        transforms.ToTensor(),                       # CHW float [0,1]
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    out = np.asarray(t(img))
+    assert out.shape == (3, 32, 32)
+    assert out.min() >= -1.0001 and out.max() <= 1.0001
+
+    crop = transforms.CenterCrop(24)(img)
+    assert np.asarray(crop).shape[:2] == (24, 24)
+
+    rc = transforms.RandomCrop(16)(img)
+    assert np.asarray(rc).shape[:2] == (16, 16)
+
+    flip = transforms.RandomHorizontalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(np.asarray(flip), img[:, ::-1])
+
+    gray = transforms.Grayscale()(img)
+    assert np.asarray(gray).shape[-1] == 1
+
+    pad = transforms.Pad(2)(img)
+    assert np.asarray(pad).shape[:2] == (44, 52)
+
+
+def test_synthetic_datasets_contract():
+    for cls, shape in [(MNIST, (1, 28, 28)), (FashionMNIST, (1, 28, 28)),
+                       (Cifar10, (3, 32, 32))]:
+        train = cls(mode="train")
+        test = cls(mode="test")
+        assert len(train) > len(test) > 0
+        x, y = train[0]
+        arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        assert arr.shape == shape, (cls.__name__, arr.shape)
+        assert int(np.asarray(y).reshape(-1)[0]) >= 0
+
+
+def test_dataset_with_transform_feeds_loader():
+    ds = MNIST(mode="test", transform=transforms.Normalize(
+        mean=[0.1307], std=[0.3081], data_format="CHW"))
+    from paddle_tpu.io import DataLoader
+    x, y = next(iter(DataLoader(ds, batch_size=16)))
+    assert tuple(x.shape) == (16, 1, 28, 28)
+    assert tuple(y.shape)[0] == 16
