@@ -39,7 +39,7 @@ def _ref_attention(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                causal, sm_scale, block_q, block_k, kv_len, q_offset):
     """q_offset = kv_len - q_len: bottom-right causal alignment, matching
     _ref_attention's tril(k=m-n) (query i attends keys j <= i+q_offset)."""
@@ -89,11 +89,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(ki == pl.num_programs(3) - 1)
     def _finish():
         o_ref[:] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        # row logsumexp, saved for the backward recompute
+        lse_ref[:] = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
 
 
 def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
-                         interpret=False):
-    """q,k,v: [B, N, H, D] — grid over (batch, head, q-block, k-block)."""
+                         interpret=False, return_lse=False):
+    """q,k,v: [B, N, H, D] — grid over (batch, head, q-block, k-block).
+    With return_lse, also returns the per-row logsumexp [B, H, N] used by
+    the Pallas backward."""
     B, N, H, D = q.shape
     Nk = k.shape[1]
     sm_scale = 1.0 / math.sqrt(D)
@@ -115,7 +119,7 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
 
     grid = (B, H, Np // block_q, Nkp // block_k)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fa_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, kv_len=Nk,
                           q_offset=Nk - N),
@@ -128,9 +132,16 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
             pl.BlockSpec((None, None, block_k, D),
                          lambda b, h, qi, ki: (b, h, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, D),
-                               lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, block_q),
+                         lambda b, h, qi, ki: (b, h, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qh.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, Np), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -138,7 +149,10 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
         ],
         interpret=interpret,
     )(qh, kh, vh)
-    return jnp.swapaxes(out[:, :, :N], 1, 2)
+    out = jnp.swapaxes(out[:, :, :N], 1, 2)
+    if return_lse:
+        return out, lse[:, :, :N]
+    return out
 
 
 def _use_pallas(q):
@@ -146,6 +160,183 @@ def _use_pallas(q):
         return False
     B, N, H, D = q.shape
     return (D % 128 == 0 or D in (64,)) and N >= 128
+
+
+def _bwd_causal_skip(qi, ki, block_q, block_k, q_offset):
+    """Whole K-block above the (bottom-right aligned) diagonal?"""
+    return (ki * block_k) <= (qi * block_q + block_q - 1 + q_offset)
+
+
+def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+                   causal, sm_scale, block_q, block_k, kv_len, q_offset):
+    """Shared backward tile math: recompute the masked probability block
+    from the saved logsumexp and form ds.  Must mirror _fa_kernel's masking
+    (kv-tail + bottom-right causal) exactly.  Returns (p, ds, q, k, v, do)
+    in fp32."""
+    q = q_ref[:].astype(jnp.float32)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = cols < kv_len
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        valid = valid & (rows + q_offset >= cols)
+    p = jnp.where(valid, jnp.exp(s - lse_ref[:][:, None]), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[:][:, None]) * sm_scale
+    return p, ds, q, k, v, do
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                  acc_scr, *, causal, sm_scale, block_q, block_k, kv_len,
+                  q_offset):
+    """Grid (B, H, qi, ki): q block stationary, stream K/V blocks; ds@k
+    accumulates into the dq scratch, written once at the last ki."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (_bwd_causal_skip(qi, ki, block_q, block_k, q_offset)
+           if causal else jnp.asarray(True))
+
+    @pl.when(run)
+    def _body():
+        _, ds, _, k, _, _ = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            causal, sm_scale, block_q, block_k, kv_len, q_offset)
+        acc_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finish():
+        dq_ref[:] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_scr, dv_scr, *, causal, sm_scale,
+                   block_q, block_k, kv_len, q_offset):
+    """Grid (B, H, ki, qi): K/V block stationary, stream q/do blocks."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (_bwd_causal_skip(qi, ki, block_q, block_k, q_offset)
+           if causal else jnp.asarray(True))
+
+    @pl.when(run)
+    def _body():
+        p, ds, q, _, _, do = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            causal, sm_scale, block_q, block_k, kv_len, q_offset)
+        # dv += p^T @ do ; dk += ds^T @ q
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == pl.num_programs(3) - 1)
+    def _finish():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
+                             block_q=128, block_k=128, interpret=False):
+    """dq, dk, dv via tiled recompute from the saved logsumexp — O(N) memory
+    (the [N,N] score matrix never materializes), all matmuls on the MXU."""
+    B, N, H, D = q.shape
+    Nk = k.shape[1]
+    sm_scale = 1.0 / math.sqrt(D)
+    block_q = min(block_q, N)
+    block_k = min(block_k, Nk)
+
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    doh = jnp.swapaxes(do, 1, 2)
+    oh = jnp.swapaxes(out, 1, 2)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
+    delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32), -1)
+
+    Np = pl.cdiv(N, block_q) * block_q
+    Nkp = pl.cdiv(Nk, block_k) * block_k
+    if Np != N:
+        pad4 = ((0, 0), (0, 0), (0, Np - N), (0, 0))
+        qh = jnp.pad(qh, pad4)
+        doh = jnp.pad(doh, pad4)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, Np - N)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, Np - N)))
+    if Nkp != Nk:
+        pad4 = ((0, 0), (0, 0), (0, Nkp - Nk), (0, 0))
+        kh = jnp.pad(kh, pad4)
+        vh = jnp.pad(vh, pad4)
+
+    common = dict(causal=causal, sm_scale=sm_scale, block_q=block_q,
+                  block_k=block_k, kv_len=Nk, q_offset=Nk - N)
+    q_spec = pl.BlockSpec((None, None, block_q, D),
+                          lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((None, None, block_q),
+                            lambda b, h, i, j: (b, h, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel, **common),
+        grid=(B, H, Np // block_q, Nkp // block_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((None, None, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            q_spec, row_spec, row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    k_spec = pl.BlockSpec((None, None, block_k, D),
+                          lambda b, h, i, j: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, **common),
+        grid=(B, H, Nkp // block_k, Np // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            k_spec, k_spec,
+            pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_q),
+                         lambda b, h, i, j: (b, h, j)),
+            pl.BlockSpec((None, None, block_q),
+                         lambda b, h, i, j: (b, h, j)),
+        ],
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct(kh.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vh.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    return (jnp.swapaxes(dq[:, :, :N], 1, 2),
+            jnp.swapaxes(dk[:, :, :Nk], 1, 2),
+            jnp.swapaxes(dv[:, :, :Nk], 1, 2))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -156,13 +347,17 @@ def flash_attention(q, k, v, causal=False):
 
 
 def _fa_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
+    if _use_pallas(q):
+        out, lse = _flash_attention_tpu(q, k, v, causal, return_lse=True)
+        return out, (q, k, v, out, lse)
+    return _ref_attention(q, k, v, causal), (q, k, v, None, None)
 
 
 def _fa_bwd(causal, res, g):
-    # backward via XLA autodiff of the reference implementation (fused well by
-    # XLA; a bespoke Pallas backward kernel is a later optimization)
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        return _flash_attention_bwd_tpu(q, k, v, out, lse, g, causal)
+    # fallback: XLA autodiff of the dense reference
     _, vjp = jax.vjp(lambda a, b, c: _ref_attention(a, b, c, causal), q, k, v)
     return vjp(g)
 
