@@ -38,6 +38,16 @@ from .tensor.attribute import shape as shape  # noqa: E402,F811
 
 from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: E402
 from .framework.core import Generator  # noqa: E402
+from . import debug  # noqa: E402
+
+
+def get_rng_state():
+    """Exact host RNG stream position (list-of-one GeneratorState analogue)."""
+    return _core.default_generator().get_state()
+
+
+def set_rng_state(state):
+    _core.default_generator().set_state(state)
 
 from . import autograd  # noqa: E402
 from . import nn  # noqa: E402

@@ -1,0 +1,96 @@
+"""Failure detection: nan/inf guards (SURVEY.md §2.11).
+
+TPU-native analogue of the reference's debugger / nan-inf utils (ref:
+paddle/fluid/framework/details/nan_inf_utils_detail.cc, enabled there via
+FLAGS_check_nan_inf): in eager mode a dispatch-level guard checks every
+primitive's outputs and raises with the op name at the first non-finite
+value; under jit, ``check_numerics`` embeds an XLA-side checkify-style
+assert (jax.debug.check) so compiled steps fail loudly too.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+_enabled = False
+
+
+def enable_check_nan_inf(flag=True):
+    """Process-wide eager guard (FLAGS_check_nan_inf analogue)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def check_nan_inf_enabled():
+    return _enabled
+
+
+@contextlib.contextmanager
+def check_nan_inf_guard():
+    """Scoped version of enable_check_nan_inf."""
+    global _enabled
+    prev = _enabled
+    _enabled = True
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+class NanInfError(FloatingPointError):
+    pass
+
+
+def _assert_finite_eager(opname, vals):
+    """Called from dispatch when the guard is on; host-syncs (debug mode).
+    Traced values are skipped — under jit use check_numerics instead."""
+    for v in vals:
+        if isinstance(v, jax.core.Tracer):
+            continue
+        if (hasattr(v, "dtype")
+                and jnp.issubdtype(jnp.result_type(v), jnp.inexact)):
+            finite = bool(jnp.all(jnp.isfinite(v)))
+            if not finite:
+                n_nan = int(jnp.sum(jnp.isnan(v)))
+                n_inf = int(jnp.sum(jnp.isinf(v)))
+                raise NanInfError(
+                    f"op '{opname}' produced non-finite values "
+                    f"(nan={n_nan}, inf={n_inf}, shape={tuple(v.shape)}, "
+                    f"dtype={v.dtype})")
+
+
+def check_numerics(tree, message="check_numerics"):
+    """Jit-safe guard for compiled train steps: passes ``tree`` through
+    unchanged but attaches a host callback that aborts when any floating
+    leaf is non-finite (jax.debug.callback compiles into the HLO; the check
+    runs device-side, only the verdict ships to host).
+
+    Note: because the callback fires from the runtime, the failure surfaces
+    at the next sync point as the backend's callback error (wrapping this
+    NanInfError message), not as a typed NanInfError at the call site.  For
+    a recoverable in-graph verdict (e.g. skip-step logic), use
+    ``finite_mask`` instead."""
+    def _raise_if(bad):
+        if bad:
+            raise NanInfError(message + ": non-finite value detected")
+
+    def guard(x):
+        if (hasattr(x, "dtype")
+                and jnp.issubdtype(jnp.result_type(x), jnp.inexact)):
+            jax.debug.callback(_raise_if, ~jnp.all(jnp.isfinite(x)))
+        return x
+
+    return jax.tree.map(guard, tree)
+
+
+def finite_mask(tree):
+    """Scalar bool: every floating leaf of ``tree`` is finite (the grad-
+    scaler's found_inf test, usable inside jit without host sync)."""
+    leaves = [x for x in jax.tree.leaves(tree)
+              if hasattr(x, "dtype")
+              and jnp.issubdtype(jnp.result_type(x), jnp.inexact)]
+    if not leaves:
+        return jnp.bool_(True)
+    return jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()

@@ -237,6 +237,15 @@ class Generator:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def get_state(self):
+        """Exact stream position (paddle.get_rng_state analogue)."""
+        return {"seed": self._seed, "key": np.asarray(self._key)}
+
+    def set_state(self, state):
+        self._seed = int(state["seed"])
+        self._key = jnp.asarray(state["key"], dtype=jnp.uint32)
+        return self
+
 
 _generator = Generator(np.random.randint(0, 2**31 - 1))
 

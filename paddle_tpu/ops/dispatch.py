@@ -27,6 +27,12 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+def _nan_guard_on():
+    import sys
+    debug = sys.modules.get("paddle_tpu.debug")
+    return debug is not None and debug._enabled
+
+
 _static_graph_mod = None
 
 
@@ -78,6 +84,11 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
         a, k = tree_util.tree_unflatten(treedef, vals)
         out = fn(*a, **k)
         multi = isinstance(out, (tuple, list))
+        if _nan_guard_on():
+            from .. import debug
+            debug._assert_finite_eager(
+                _name or getattr(fn, "__name__", "op"),
+                out if multi else (out,))
         wrapped = (tuple(_wrap(o) for o in out) if multi
                    else (_wrap(out),))
         from ..static import graph as static_graph
@@ -102,6 +113,10 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
 
     multi = isinstance(out_vals, (tuple, list))
     outs = tuple(out_vals) if multi else (out_vals,)
+    if _nan_guard_on():
+        from .. import debug
+        debug._assert_finite_eager(_name or getattr(fn, "__name__", "op"),
+                                   outs)
     node = Node(
         vjp_fn=vjp_fn,
         parents=diff_tensors,

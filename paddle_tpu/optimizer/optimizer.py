@@ -147,6 +147,7 @@ class Optimizer:
                 pname = name_of.get(pid, str(pid))
                 sd[f"{pname}_{nm}"] = Tensor(arr)
         sd["@step"] = self._step_count
+        sd["@param_names"] = [p.name for p in self._parameters]
         if isinstance(self._lr, LRScheduler):
             sd["LR_Scheduler"] = self._lr.state_dict()
         return sd
@@ -155,13 +156,27 @@ class Optimizer:
         self._step_count = int(state_dict.get("@step", 0))
         if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(dict(state_dict["LR_Scheduler"]))
-        for p in self._parameters:
+        # auto-generated param names depend on layer-creation order, so a
+        # resumed process's fresh layers may carry different names; map the
+        # saved names onto the current parameters by position
+        saved_names = state_dict.get("@param_names")
+        for i, p in enumerate(self._parameters):
+            # saved positional name first: the current auto-generated name
+            # can collide with a DIFFERENT saved param's key when creation
+            # order shifted between runs
+            lookup_names = []
+            if saved_names is not None and i < len(saved_names):
+                lookup_names.append(saved_names[i])
+            lookup_names.append(p.name)
             for nm in self._accum_names:
-                key = f"{p.name}_{nm}"
-                if key in state_dict:
-                    v = state_dict[key]
-                    self._accumulators[nm][id(p)] = (
-                        v.value if isinstance(v, Tensor) else jnp.asarray(v))
+                for lname in lookup_names:
+                    key = f"{lname}_{nm}"
+                    if key in state_dict:
+                        v = state_dict[key]
+                        self._accumulators[nm][id(p)] = (
+                            v.value if isinstance(v, Tensor)
+                            else jnp.asarray(v))
+                        break
 
     set_dict = set_state_dict
 

@@ -1,3 +1,4 @@
 from . import lazy
 from .lazy import flops, try_import
 from .download import get_weights_path_from_url
+from .checkpoint import CheckpointManager  # noqa: E402,F401

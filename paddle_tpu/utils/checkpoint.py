@@ -1,0 +1,100 @@
+"""Training checkpoint/resume for long runs (SURVEY.md §2.11).
+
+TPU-native analogue of the reference's fleet checkpoint/auto-recovery path
+(ref: python/paddle/distributed/fleet/utils/fs.py +
+incubate/checkpoint/auto_checkpoint.py): one directory per step holding
+model + optimizer + LR-scheduler + RNG + step counter, written atomically
+(tmp dir + rename) so a preempted write can never be mistaken for a valid
+checkpoint, with keep-last-k retention and latest-step discovery on resume.
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+
+import numpy as np
+
+from ..io.serialization import load as _load, save as _save
+from ..framework import core
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    """Save/restore full training state.
+
+    >>> mgr = CheckpointManager("ckpts", keep=3)
+    >>> mgr.save(step, model=net, optimizer=opt, scheduler=sched)
+    >>> step = mgr.restore(model=net, optimizer=opt, scheduler=sched)
+    """
+
+    def __init__(self, root, keep=3):
+        self.root = root
+        self.keep = keep
+        self.last_extra = None
+        os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------ helpers
+    def _step_dirs(self):
+        out = []
+        for name in os.listdir(self.root):
+            m = _STEP_DIR.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                out.append((int(m.group(1)), os.path.join(self.root, name)))
+        return sorted(out)
+
+    def latest_step(self):
+        dirs = self._step_dirs()
+        return dirs[-1][0] if dirs else None
+
+    # ------------------------------------------------------------ save
+    def save(self, step, model=None, optimizer=None, scheduler=None,
+             extra=None):
+        final = os.path.join(self.root, f"step_{step}")
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        state = {"step": int(step),
+                 "rng_state": core.default_generator().get_state()}
+        if extra is not None:
+            state["extra"] = extra
+        if model is not None:
+            _save(model.state_dict(), os.path.join(tmp, "model.pdparams"))
+        if optimizer is not None:
+            _save(optimizer.state_dict(), os.path.join(tmp, "opt.pdopt"))
+        if scheduler is not None:
+            _save(scheduler.state_dict(), os.path.join(tmp, "lr.pdstate"))
+        _save(state, os.path.join(tmp, "meta.pdstate"))
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)          # atomic publish
+        self._retain()
+        return final
+
+    def _retain(self):
+        dirs = self._step_dirs()
+        for _, path in dirs[:-self.keep] if self.keep else []:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # ------------------------------------------------------------ restore
+    def restore(self, model=None, optimizer=None, scheduler=None, step=None):
+        """Load the given (or latest) step into the passed objects; returns
+        the restored step counter, or None when no checkpoint exists."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        path = os.path.join(self.root, f"step_{step}")
+        meta = _load(os.path.join(path, "meta.pdstate"))
+        if model is not None:
+            model.set_state_dict(_load(os.path.join(path, "model.pdparams")))
+        if optimizer is not None:
+            optimizer.set_state_dict(_load(os.path.join(path, "opt.pdopt")))
+        if scheduler is not None:
+            scheduler.set_state_dict(_load(os.path.join(path, "lr.pdstate")))
+        # restore the deterministic RNG stream position exactly
+        core.default_generator().set_state(meta["rng_state"])
+        self.last_extra = meta.get("extra")
+        return meta["step"]

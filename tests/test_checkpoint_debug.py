@@ -1,0 +1,133 @@
+"""Checkpoint/resume + nan-inf failure detection (SURVEY.md §2.11).
+
+Models the reference's auto-checkpoint and nan-inf-utils tests (ref:
+python/paddle/fluid/tests/unittests/test_auto_checkpoint.py,
+test_nan_inf.py): full training-state round trip with exact RNG stream
+restore, retention, atomicity; guard raises at the first non-finite op with
+the op name, and the jit-side check passes finite trees through.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import CheckpointManager
+
+
+def _step(net, opt, x, y):
+    loss = paddle.nn.functional.mse_loss(net(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def test_checkpoint_resume_bitwise():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(16, 2).astype(np.float32))
+
+    def make():
+        paddle.seed(7)
+        net = paddle.nn.Linear(4, 2)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=3, gamma=0.5)
+        opt = paddle.optimizer.Adam(learning_rate=sched,
+                                    parameters=net.parameters())
+        return net, opt, sched
+
+    with tempfile.TemporaryDirectory() as d:
+        # run A: 5 steps, checkpoint at 3, continue to 5
+        net, opt, sched = make()
+        mgr = CheckpointManager(d, keep=5)
+        for i in range(1, 6):
+            _step(net, opt, x, y)
+            sched.step()
+            mgr.save(i, model=net, optimizer=opt, scheduler=sched)
+        wA = np.asarray(net.weight.numpy()).copy()
+        rA = paddle.rand([3])   # post-training rng draw
+
+        # run B: fresh objects, restore step 3, replay 4..5
+        net2, opt2, sched2 = make()
+        mgr2 = CheckpointManager(d, keep=5)
+        step = mgr2.restore(model=net2, optimizer=opt2, scheduler=sched2,
+                            step=3)
+        assert step == 3
+        for i in range(4, 6):
+            _step(net2, opt2, x, y)
+            sched2.step()
+        np.testing.assert_array_equal(wA, np.asarray(net2.weight.numpy()))
+        rB = paddle.rand([3])
+        np.testing.assert_array_equal(np.asarray(rA.numpy()),
+                                      np.asarray(rB.numpy()))
+
+
+def test_checkpoint_retention_and_latest():
+    net = paddle.nn.Linear(2, 2)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, keep=2)
+        for i in (1, 2, 3, 4):
+            mgr.save(i, model=net)
+        kept = sorted(os.listdir(d))
+        assert kept == ["step_3", "step_4"]
+        assert mgr.latest_step() == 4
+        assert mgr.restore(model=net) == 4
+
+
+def test_checkpoint_restore_empty_dir():
+    with tempfile.TemporaryDirectory() as d:
+        assert CheckpointManager(d).restore(model=paddle.nn.Linear(2, 2)) \
+            is None
+
+
+def test_nan_guard_raises_with_op_name():
+    from paddle_tpu.debug import NanInfError, check_nan_inf_guard
+
+    x = paddle.to_tensor(np.asarray([1.0, 0.0], np.float32))
+    with check_nan_inf_guard():
+        paddle.add(x, x)                      # finite: fine
+        with pytest.raises(NanInfError, match="log"):
+            paddle.log(paddle.to_tensor(np.asarray([-1.0], np.float32)))
+    # guard is scoped: outside it non-finite passes silently
+    out = paddle.log(paddle.to_tensor(np.asarray([-1.0], np.float32)))
+    assert np.isnan(np.asarray(out.numpy())).all()
+
+
+def test_nan_guard_covers_taped_path():
+    from paddle_tpu.debug import NanInfError, check_nan_inf_guard
+
+    w = paddle.to_tensor(np.asarray([[1.0]], np.float32),
+                         stop_gradient=False)
+    with check_nan_inf_guard():
+        with pytest.raises(NanInfError):
+            paddle.matmul(w, paddle.to_tensor(
+                np.asarray([[np.inf]], np.float32)))
+
+
+def test_check_numerics_inside_jit():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.debug import check_numerics, finite_mask
+
+    @jax.jit
+    def f(x):
+        return check_numerics({"a": x * 2}, "train_step")["a"]
+
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2 * np.ones(3))
+    assert bool(finite_mask({"g": jnp.ones(2), "h": jnp.zeros(())}))
+    assert not bool(finite_mask({"g": jnp.asarray([np.inf])}))
+
+
+def test_nan_guard_skips_traced_ops():
+    """Guard must not explode on tracers when a jitted/to_static function
+    is compiled while the eager guard is enabled."""
+    from paddle_tpu.debug import check_nan_inf_guard
+
+    net = paddle.nn.Linear(3, 3)
+    snet = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    with check_nan_inf_guard():
+        out = snet(x)
+    assert tuple(out.shape) == (2, 3)
