@@ -21,7 +21,7 @@ class Node:
     """One recorded primitive application."""
 
     __slots__ = ("vjp_fn", "parents", "n_outputs", "out_shapes", "out_dtypes",
-                 "_accum", "name")
+                 "_accum", "name", "out_hooks")
 
     def __init__(self, vjp_fn, parents, n_outputs, out_shapes, out_dtypes,
                  name=""):
@@ -32,6 +32,8 @@ class Node:
         self.out_dtypes = out_dtypes
         self._accum: Optional[list] = None
         self.name = name
+        self.out_hooks = None         # {out_index: hook list} (register_hook
+                                      # on a non-leaf tensor)
 
     def seed(self, index: int, grad):
         if self._accum is None:
@@ -157,8 +159,16 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
     elif isinstance(grad, Tensor):
         grad = grad.value
 
+    # buffer per-tensor contributions so grad hooks fire exactly once with
+    # the completed grad of this backward pass (ref VarBase hook semantics)
+    pending = {}
+
+    def _add(t, g):
+        ent = pending.get(id(t))
+        pending[id(t)] = (t, g if ent is None else ent[1] + g)
+
     if watch and id(tensor) in watch:
-        tensor._accumulate_grad(grad)
+        _add(tensor, grad)
 
     root = tensor._node
     root.seed(tensor._node_index, grad)
@@ -171,6 +181,19 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
                 "Pass retain_graph=True to the first .backward() if you "
                 "need to backward twice.")
         cts = node.cotangents()
+        if node.out_hooks:
+            # register_hook on a non-leaf: its complete grad is this
+            # output's cotangent — fire once, apply rewrites
+            from ..tensor import Tensor
+
+            cts = list(cts)
+            for idx, hooks in node.out_hooks.items():
+                g = cts[idx]
+                for hook in tuple(hooks):
+                    out = hook(Tensor(g))
+                    if out is not None:
+                        g = out.value if isinstance(out, Tensor) else out
+                cts[idx] = g
         if node.n_outputs == 1:
             in_grads = node.vjp_fn(cts[0])
         else:
@@ -181,16 +204,18 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
             if watch:
                 # paddle.grad mode: accumulate ONLY into requested tensors
                 if id(parent) in watch:
-                    parent._accumulate_grad(g)
+                    _add(parent, g)
                 if parent._node is not None:
                     parent._node.seed(parent._node_index, g)
             elif parent._node is not None:
                 parent._node.seed(parent._node_index, g)
             else:
-                parent._accumulate_grad(g)
+                _add(parent, g)
         node._accum = None
         if not retain_graph:
             node.vjp_fn = None
+    for t, g in pending.values():
+        t._finalize_grad(g)
     if not retain_graph:
         # break links so the graph is freed and cannot be reused
         for node in order:

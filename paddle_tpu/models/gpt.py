@@ -138,10 +138,13 @@ def _attention(q, k, v, cfg):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def block_apply(cfg: GPTConfig, x, blk):
+def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
     """One transformer block.  x: [B, N, H]; blk: per-layer param dict
-    (no leading L axis).  The hybrid-parallel path has its own tp-sharded
-    block (models/gpt_hybrid.py::_sharded_block) — keep the math in sync."""
+    (no leading L axis).  ``attn_fn(q, k, v) -> ([B,N,nh,hd], aux)`` swaps
+    the attention inner loop (KV-cache decode passes one; default is the
+    training causal attention, aux=None).  The hybrid-parallel path has its
+    own tp-sharded block (models/gpt_hybrid.py::_sharded_block) — keep the
+    math in sync."""
     cd = jnp.dtype(cfg.dtype)
     B, N, H = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
@@ -150,7 +153,11 @@ def block_apply(cfg: GPTConfig, x, blk):
     qkv = jnp.einsum("bnh,hcd->bncd", h, blk["qkv_w"].astype(cd))
     qkv = qkv + blk["qkv_b"].astype(cd)
     q, k, v = [qkv[:, :, i].reshape(B, N, nh, hd) for i in range(3)]
-    a = _attention(q, k, v, cfg).reshape(B, N, -1)
+    if attn_fn is None:
+        a, aux = _attention(q, k, v, cfg), None
+    else:
+        a, aux = attn_fn(q, k, v)
+    a = a.reshape(B, N, -1)
     a = a @ blk["proj_w"].astype(cd) + blk["proj_b"].astype(cd)
     x = x + a
 
@@ -158,7 +165,8 @@ def block_apply(cfg: GPTConfig, x, blk):
     h = jax.nn.gelu(h @ blk["fc1_w"].astype(cd) + blk["fc1_b"].astype(cd),
                     approximate=True)
     h = h @ blk["fc2_w"].astype(cd) + blk["fc2_b"].astype(cd)
-    return x + h
+    x = x + h
+    return x if attn_fn is None else (x, aux)
 
 
 def embed(cfg: GPTConfig, params, tokens, pos_offset=0):
@@ -184,6 +192,110 @@ def forward(params, tokens, cfg: GPTConfig):
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
     # tied embeddings: logits = x @ wte^T
     return (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+
+
+def init_cache(cfg: GPTConfig, batch, max_len, dtype=None):
+    """Per-layer KV cache stacked on the layer axis:
+    {'k','v': [L, B, max_len, nh, hd], 'len': int32 tokens filled}."""
+    if max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"cache max_len {max_len} exceeds cfg.max_seq_len "
+            f"{cfg.max_seq_len}: positions past it would silently reuse "
+            "the last positional embedding (jnp.take clamps)")
+    cd = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.num_layers, batch, max_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd),
+            "len": jnp.int32(0)}
+
+
+def _cached_block(cfg, x, blk, k_cache, v_cache, cur_len):
+    """block_apply with a cache-appending attention: this chunk's K/V are
+    written at ``cur_len`` and queries attend the filled prefix.  x:
+    [B, T, H]; k_cache/v_cache: [B, max_len, nh, hd].  Returns
+    (x_out, k_cache, v_cache)."""
+    cd = jnp.dtype(cfg.dtype)
+    hd = cfg.head_dim
+    max_len = k_cache.shape[1]
+
+    def cached_attn(q, k, v):
+        T = q.shape[1]
+        kc = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, cur_len, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, cur_len, 0, 0))
+        # attend over the whole cache buffer, masking beyond cur_len+T and
+        # the causal future (query i at absolute position cur_len+i)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / math.sqrt(hd)
+        q_pos = cur_len + jnp.arange(T)[:, None]      # [T,1]
+        k_pos = jnp.arange(max_len)[None, :]          # [1,max_len]
+        mask = k_pos <= q_pos                         # causal + fill bound
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(cd)
+        a = jnp.einsum("bhqk,bkhd->bqhd", probs, vc.astype(cd))
+        return a, (kc, vc)
+
+    x, (k_cache, v_cache) = block_apply(cfg, x, blk, attn_fn=cached_attn)
+    return x, k_cache, v_cache
+
+
+def forward_cached(params, tokens, cfg: GPTConfig, cache):
+    """Prefill/decode forward: consumes ``tokens`` [B, T] starting at
+    cache['len'], returns (logits [B, T, V] fp32, updated cache)."""
+    cur = cache["len"]
+    x = embed(cfg, params, tokens, pos_offset=cur)
+
+    def scan_body(carry, layer):
+        xx = carry
+        blk, kc, vc = layer
+        xx, kc, vc = _cached_block(cfg, xx, blk, kc, vc, cur)
+        return xx, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs, "len": cur + tokens.shape[1]}
+
+
+def generate(params, cfg: GPTConfig, prompt, max_new_tokens,
+             temperature=0.0, top_k=0, key=None, eos_token=None):
+    """Jit-compatible autoregressive decoding with a KV cache.
+
+    prompt: [B, T0] int32.  Greedy when temperature == 0; otherwise
+    temperature softmax sampling, optionally top-k truncated.  Returns
+    [B, T0 + max_new_tokens] (generation continues past eos; mask with
+    ``eos_token`` downstream if early-stop semantics are needed — shapes
+    stay static for XLA).  Replaces the reference's fused decoding ops
+    (ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu
+    int8/cache path) with a scanned XLA program."""
+    B, T0 = prompt.shape
+    total = T0 + max_new_tokens
+    cache = init_cache(cfg, B, total)
+    logits, cache = forward_cached(params, prompt, cfg, cache)
+    last = logits[:, -1]
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def sample(lg, k):
+        if temperature and temperature > 0:
+            lg = lg / temperature
+            if top_k:
+                kth = jnp.sort(lg, -1)[:, -top_k][:, None]
+                lg = jnp.where(lg >= kth, lg, -1e30)
+            return jax.random.categorical(k, lg)
+        return jnp.argmax(lg, -1)
+
+    def step(carry, _):
+        cache, last, k = carry
+        k, sub = jax.random.split(k)
+        tok = sample(last, sub).astype(jnp.int32)
+        lg, cache = forward_cached(params, tok[:, None], cfg, cache)
+        return (cache, lg[:, -1], k), tok
+
+    (_, _, _), toks = jax.lax.scan(step, (cache, last, key),
+                                   None, length=max_new_tokens)
+    return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)], axis=1)
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig):

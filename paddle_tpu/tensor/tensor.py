@@ -49,7 +49,8 @@ def _to_jax_value(data, dtype=None, place=None):
 
 class Tensor:
     __slots__ = ("value", "stop_gradient", "_node", "_node_index", "_grad",
-                 "name", "persistable", "_weakref_slot", "__weakref__")
+                 "name", "persistable", "_grad_hooks", "_weakref_slot",
+                 "__weakref__")
 
     _next_id = [0]
 
@@ -120,6 +121,44 @@ class Tensor:
             self._grad = g
         else:
             self._grad = self._grad + g
+
+    def _finalize_grad(self, g):
+        """Called by the tape with this backward's COMPLETE grad for this
+        tensor: hooks observe/rewrite it once, then it accumulates."""
+        # snapshot: a hook removing itself must not skip its neighbor
+        for hook in tuple(getattr(self, "_grad_hooks", ())):
+            out = hook(Tensor(g))
+            if out is not None:
+                g = out.value if isinstance(out, Tensor) else out
+        self._accumulate_grad(g)
+
+    def register_hook(self, hook):
+        """Run ``hook(grad)`` when this tensor's grad is produced during
+        backward; a non-None return replaces the grad (ref semantics of
+        VarBase._register_grad_hook).  Returns a removable handle."""
+        if not hasattr(self, "_grad_hooks"):
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+        if self._node is not None:
+            # non-leaf: the complete grad exists as this node-output's
+            # cotangent during the tape walk; register there so the tape
+            # can fire (and apply rewrites from) the same hook list
+            d = getattr(self._node, "out_hooks", None)
+            if d is None:
+                d = self._node.out_hooks = {}
+            d[self._node_index] = self._grad_hooks
+
+        class _Handle:
+            def __init__(self, owner, fn):
+                self._owner, self._fn = owner, fn
+
+            def remove(self):
+                try:
+                    self._owner._grad_hooks.remove(self._fn)
+                except ValueError:
+                    pass
+
+        return _Handle(self, hook)
 
     def backward(self, grad_tensor=None, retain_graph=False):
         tape.backward(self, grad_tensor, retain_graph=retain_graph)

@@ -161,3 +161,51 @@ def test_double_backward_error_message():
     l.backward()
     with pytest.raises(RuntimeError, match="retain_graph"):
         l.backward()
+
+
+def test_register_hook_observes_and_rewrites_grad():
+    """ref: VarBase._register_grad_hook semantics — hook sees the incoming
+    grad, a non-None return replaces it; handles are removable."""
+    import paddle_tpu as paddle
+
+    w = paddle.to_tensor(np.asarray([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    seen = []
+    handle = w.register_hook(lambda g: seen.append(
+        np.asarray(g.numpy()).copy()) or g * 10)
+    (w * w).sum().backward()
+    np.testing.assert_allclose(seen[0], [4.0, 6.0])
+    np.testing.assert_allclose(np.asarray(w.grad.numpy()), [40.0, 60.0])
+
+    handle.remove()
+    w.clear_grad()
+    (w * w).sum().backward()
+    np.testing.assert_allclose(np.asarray(w.grad.numpy()), [4.0, 6.0])
+
+
+def test_register_hook_on_intermediate_tensor():
+    """Hooks on non-leaf tensors fire with the activation's complete grad
+    and rewrites propagate to upstream leaves (VarBase semantics)."""
+    import paddle_tpu as paddle
+
+    w = paddle.to_tensor(np.asarray([3.0], np.float32), stop_gradient=False)
+    y = w * w                     # intermediate
+    seen = []
+    y.register_hook(lambda g: seen.append(np.asarray(g.numpy()).copy())
+                    or g * 2)
+    (y * 5).sum().backward()
+    np.testing.assert_allclose(seen[0], [5.0])          # d(5y)/dy
+    # rewrite doubled y's grad -> w.grad = 2*5 * dy/dw = 10 * 2w = 60
+    np.testing.assert_allclose(np.asarray(w.grad.numpy()), [60.0])
+
+
+def test_register_hook_self_removal_does_not_skip_next():
+    import paddle_tpu as paddle
+
+    w = paddle.to_tensor(np.asarray([1.0], np.float32), stop_gradient=False)
+    fired = []
+    handle1 = w.register_hook(lambda g: (fired.append("h1"),
+                                         handle1.remove())[0])
+    w.register_hook(lambda g: fired.append("h2"))
+    (w * 2).sum().backward()
+    assert fired == ["h1", "h2"]
