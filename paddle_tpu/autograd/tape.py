@@ -141,6 +141,18 @@ def _topo_order(root_node) -> List[Node]:
     return order  # post-order: parents before children; reverse for backward
 
 
+def apply_grad_hooks(hooks, g):
+    """Fire grad hooks over raw value ``g`` (snapshot: a hook removing
+    itself must not skip its neighbor); non-None returns rewrite."""
+    from ..tensor import Tensor
+
+    for hook in tuple(hooks):
+        out = hook(Tensor(g))
+        if out is not None:
+            g = out.value if isinstance(out, Tensor) else out
+    return g
+
+
 def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
     """Run reverse-mode accumulation from ``tensor`` into leaf ``.grad``s.
 
@@ -160,12 +172,13 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
         grad = grad.value
 
     # buffer per-tensor contributions so grad hooks fire exactly once with
-    # the completed grad of this backward pass (ref VarBase hook semantics)
+    # the completed grad of this backward pass (ref VarBase hook semantics);
+    # entries are (tensor, grad, hooks_done)
     pending = {}
 
     def _add(t, g):
         ent = pending.get(id(t))
-        pending[id(t)] = (t, g if ent is None else ent[1] + g)
+        pending[id(t)] = (t, g if ent is None else ent[1] + g, False)
 
     if watch and id(tensor) in watch:
         _add(tensor, grad)
@@ -183,17 +196,16 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
         cts = node.cotangents()
         if node.out_hooks:
             # register_hook on a non-leaf: its complete grad is this
-            # output's cotangent — fire once, apply rewrites
-            from ..tensor import Tensor
-
+            # output's cotangent — fire once, apply rewrites; if the tensor
+            # is also watched (paddle.grad input), its accumulated grad is
+            # exactly this rewritten cotangent, with hooks already done
             cts = list(cts)
-            for idx, hooks in node.out_hooks.items():
-                g = cts[idx]
-                for hook in tuple(hooks):
-                    out = hook(Tensor(g))
-                    if out is not None:
-                        g = out.value if isinstance(out, Tensor) else out
+            for idx, (hooks, tref) in node.out_hooks.items():
+                g = apply_grad_hooks(hooks, cts[idx])
                 cts[idx] = g
+                t = tref()
+                if t is not None and watch and id(t) in watch:
+                    pending[id(t)] = (t, g, True)
         if node.n_outputs == 1:
             in_grads = node.vjp_fn(cts[0])
         else:
@@ -214,8 +226,11 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
         node._accum = None
         if not retain_graph:
             node.vjp_fn = None
-    for t, g in pending.values():
-        t._finalize_grad(g)
+    for t, g, hooks_done in pending.values():
+        if hooks_done:
+            t._accumulate_grad(g)
+        else:
+            t._finalize_grad(g)
     if not retain_graph:
         # break links so the graph is freed and cannot be reused
         for node in order:

@@ -260,17 +260,61 @@ def _decays(path):
     return not any(leaf.endswith(s) or leaf == s for s in _NO_DECAY)
 
 
+def param_specs(cfg: BertConfig):
+    """Megatron-layout PartitionSpecs for the GSPMD tensor-parallel path:
+    qkv/fc1 column-sharded over 'tp', proj/fc2 row-sharded, vocab embedding
+    + tied MLM decoder bias vocab-sharded.  Unlike models/gpt_hybrid.py
+    (explicit shard_map collectives), here the specs alone drive XLA to
+    insert the allreduces the reference adds by c_allreduce graph rewrite —
+    the GSPMD style of the same Megatron partitioning."""
+    return {
+        "wte": P("tp", None),
+        "wpe": P(), "wtt": P(),
+        "emb_ln_g": P(), "emb_ln_b": P(),
+        "blocks": {
+            "qkv_w": P(None, None, None, "tp"),
+            "qkv_b": P(None, None, "tp"),
+            "proj_w": P(None, "tp", None),
+            "proj_b": P(),
+            "ln1_g": P(), "ln1_b": P(),
+            "fc1_w": P(None, None, "tp"),
+            "fc1_b": P(None, "tp"),
+            "fc2_w": P(None, "tp", None),
+            "fc2_b": P(),
+            "ln2_g": P(), "ln2_b": P(),
+        },
+        "pool_w": P(), "pool_b": P(),
+        "mlm_w": P(), "mlm_b": P(),
+        "mlm_ln_g": P(), "mlm_ln_b": P(),
+        "mlm_bias": P("tp"),
+        "nsp_w": P(), "nsp_b": P(),
+    }
+
+
+def _mesh_specs(cfg, mesh):
+    """Param specs for ``mesh``: Megatron tp specs when it has a sized 'tp'
+    axis, replicated otherwise (pure DP)."""
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axes.get("tp", 1) > 1:
+        return param_specs(cfg)
+    return jax.tree_util.tree_map(lambda _: P(), param_specs(cfg))
+
+
 def init_pretrain_state(cfg: BertConfig, key, mesh=None):
-    """(params, m, v) — replicated over the mesh when one is given (DP:
-    params whole on every device, only the batch is sharded)."""
+    """(params, m, v) — placed with their mesh shardings when one is given:
+    replicated for DP, Megatron tp-sharded when the mesh has a 'tp' axis
+    (optimizer moments follow their parameter's sharding)."""
     params = init_params(cfg, key)
     zeros = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
     m, v = zeros, jax.tree_util.tree_map(jnp.copy, zeros)
     if mesh is not None:
-        rep = NamedSharding(mesh, P())
-        params, m, v = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, rep), (params, m, v))
+        specs = _mesh_specs(cfg, mesh)
+        place = lambda x, s: jax.device_put(  # noqa: E731
+            x, NamedSharding(mesh, s))
+        params = jax.tree_util.tree_map(place, params, specs)
+        m = jax.tree_util.tree_map(place, m, specs)
+        v = jax.tree_util.tree_map(place, v, specs)
     return params, m, v
 
 
@@ -302,12 +346,15 @@ def make_train_step(cfg: BertConfig, mesh=None, beta1=0.9, beta2=0.999,
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1, 2))
+    specs = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), _mesh_specs(cfg, mesh),
+        is_leaf=lambda x: isinstance(x, P))
     rep = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("dp"))
     return jax.jit(
         step, donate_argnums=(0, 1, 2),
-        in_shardings=(rep, rep, rep, rep, data, data, data, rep),
-        out_shardings=(rep, rep, rep, rep))
+        in_shardings=(specs, specs, specs, rep, data, data, data, rep),
+        out_shardings=(specs, specs, specs, rep))
 
 
 # --------------------------------------------------------------------------

@@ -243,6 +243,13 @@ def forward_cached(params, tokens, cfg: GPTConfig, cache):
     """Prefill/decode forward: consumes ``tokens`` [B, T] starting at
     cache['len'], returns (logits [B, T, V] fp32, updated cache)."""
     cur = cache["len"]
+    max_len = cache["k"].shape[2]
+    if (not isinstance(cur, jax.core.Tracer)
+            and int(cur) + tokens.shape[1] > max_len):
+        raise ValueError(
+            f"cache overflow: len {int(cur)} + {tokens.shape[1]} new tokens "
+            f"> cache size {max_len} (dynamic_update_slice would clamp the "
+            "write position and corrupt the cache)")
     x = embed(cfg, params, tokens, pos_offset=cur)
 
     def scan_body(carry, layer):
@@ -293,9 +300,15 @@ def generate(params, cfg: GPTConfig, prompt, max_new_tokens,
         lg, cache = forward_cached(params, tok[:, None], cfg, cache)
         return (cache, lg[:, -1], k), tok
 
-    (_, _, _), toks = jax.lax.scan(step, (cache, last, key),
-                                   None, length=max_new_tokens)
-    return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)], axis=1)
+    # scan produces max_new_tokens-1 tokens; the final token needs only a
+    # sample from the last logits, not another L-layer forward
+    (_, last, key), toks = jax.lax.scan(step, (cache, last, key),
+                                        None, length=max_new_tokens - 1)
+    _, sub = jax.random.split(key)
+    final = sample(last, sub).astype(jnp.int32)
+    toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), final[:, None]],
+                           axis=1)
+    return jnp.concatenate([prompt, toks], axis=1)
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig):

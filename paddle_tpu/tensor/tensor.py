@@ -125,12 +125,10 @@ class Tensor:
     def _finalize_grad(self, g):
         """Called by the tape with this backward's COMPLETE grad for this
         tensor: hooks observe/rewrite it once, then it accumulates."""
-        # snapshot: a hook removing itself must not skip its neighbor
-        for hook in tuple(getattr(self, "_grad_hooks", ())):
-            out = hook(Tensor(g))
-            if out is not None:
-                g = out.value if isinstance(out, Tensor) else out
-        self._accumulate_grad(g)
+        from ..autograd import tape
+
+        self._accumulate_grad(tape.apply_grad_hooks(
+            getattr(self, "_grad_hooks", ()), g))
 
     def register_hook(self, hook):
         """Run ``hook(grad)`` when this tensor's grad is produced during
@@ -141,12 +139,15 @@ class Tensor:
         self._grad_hooks.append(hook)
         if self._node is not None:
             # non-leaf: the complete grad exists as this node-output's
-            # cotangent during the tape walk; register there so the tape
-            # can fire (and apply rewrites from) the same hook list
+            # cotangent during the tape walk; register there (with a
+            # weakref back to self so watch-mode accumulation can reuse
+            # the already-rewritten value without double-firing)
+            import weakref
+
             d = getattr(self._node, "out_hooks", None)
             if d is None:
                 d = self._node.out_hooks = {}
-            d[self._node_index] = self._grad_hooks
+            d[self._node_index] = (self._grad_hooks, weakref.ref(self))
 
         class _Handle:
             def __init__(self, owner, fn):

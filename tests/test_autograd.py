@@ -209,3 +209,20 @@ def test_register_hook_self_removal_does_not_skip_next():
     w.register_hook(lambda g: fired.append("h2"))
     (w * 2).sum().backward()
     assert fired == ["h1", "h2"]
+
+
+def test_register_hook_fires_once_under_paddle_grad():
+    """Hook on a tensor that is BOTH a node output and a paddle.grad input
+    must fire exactly once; the rewritten grad is what paddle.grad returns
+    and what flows upstream."""
+    import paddle_tpu as paddle
+
+    w = paddle.to_tensor(np.asarray([3.0], np.float32), stop_gradient=False)
+    y = w * w
+    calls = []
+    y.register_hook(lambda g: calls.append(np.asarray(g.numpy()).copy())
+                    or g * 2)
+    (gy,) = paddle.grad((y * 5).sum(), [y], retain_graph=False)
+    assert len(calls) == 1, calls
+    np.testing.assert_allclose(calls[0], [5.0])
+    np.testing.assert_allclose(np.asarray(gy.numpy()), [10.0])

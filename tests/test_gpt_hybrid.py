@@ -172,3 +172,15 @@ def test_generate_sampling_modes():
                        key=jax.random.PRNGKey(3))
     assert out.shape == (1, 9)
     assert int(out.max()) < cfg.vocab_size
+
+
+def test_kv_cache_overflow_raises():
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.gpt_tiny()
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    cache = gpt.init_cache(cfg, 1, 8)
+    toks = jnp.zeros((1, 6), jnp.int32)
+    _, cache = gpt.forward_cached(params, toks, cfg, cache)
+    with pytest.raises(ValueError, match="overflow"):
+        gpt.forward_cached(params, jnp.zeros((1, 3), jnp.int32), cfg, cache)
