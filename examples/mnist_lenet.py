@@ -1,0 +1,54 @@
+"""LeNet on MNIST, dygraph style (the reference's hello-world train loop).
+
+Run: python examples/mnist_lenet.py [--epochs 1]
+"""
+import argparse
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.transforms import Normalize
+
+
+def main(epochs=1, batch_size=256, steps=None):
+    transform = Normalize(mean=[0.1307], std=[0.3081], data_format="CHW")
+    train = MNIST(mode="train", transform=transform)
+    test = MNIST(mode="test", transform=transform)
+
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+
+    loader = DataLoader(train, batch_size=batch_size, shuffle=True,
+                        num_workers=2)
+    for epoch in range(epochs):
+        model.train()
+        for step, (x, y) in enumerate(loader):
+            loss = paddle.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if step % 20 == 0:
+                print(f"epoch {epoch} step {step} "
+                      f"loss {float(loss.numpy()):.4f}")
+            if steps and step >= steps:
+                break
+
+    model.eval()
+    correct = total = 0
+    for x, y in DataLoader(test, batch_size=512):
+        pred = model(x).argmax(-1)
+        correct += int((pred == y.flatten()).sum().numpy())
+        total += int(y.shape[0])
+    print(f"test accuracy: {correct / total:.4f}")
+    return correct / total
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="cap steps per epoch (smoke mode)")
+    args = ap.parse_args()
+    main(epochs=args.epochs, steps=args.steps)

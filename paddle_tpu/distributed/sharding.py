@@ -1,15 +1,35 @@
 """Sharded (ZeRO) training (ref: python/paddle/distributed/sharding/ +
-fleet sharding meta-optimizer).
+fleet sharding meta-optimizer stages 1-3).
 
 TPU-native: optimizer-state sharding is a sharding-spec decision, not a
-communication rewrite.  group_sharded_parallel marks params so that the
-jitted train step places optimizer moments with a 'dp'-sharded
-NamedSharding (stage 1/2); stage 3 also shards the params themselves and
-XLA inserts the gather before use (fully-sharded data parallel).
+communication rewrite.  Each stage places state with a 'dp'-sharded
+NamedSharding and lets XLA insert the gathers/reduce-scatters:
+
+  stage 1 ('os')     — optimizer moments sharded over dp
+  stage 2 ('os_g')   — same placement; grads reduce-scatter into the
+                       sharded moment layout inside the jitted step
+  stage 3 ('p_g_os') — parameters themselves sharded (FSDP): XLA gathers
+                       them just-in-time before use
 """
 from __future__ import annotations
 
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from ..parallel import mesh as mesh_mod
+
+
+def _dp_spec(shape, dp_size):
+    """Shard the largest dp-divisible axis over 'dp'; replicated if none."""
+    if not shape:
+        return P()
+    cands = [i for i in range(len(shape)) if shape[i] % dp_size == 0]
+    if not cands:
+        return P()
+    axis = max(cands, key=lambda i: shape[i])
+    spec = [None] * len(shape)
+    spec[axis] = "dp"
+    return P(*spec)
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
@@ -17,20 +37,33 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            buffer_max_size=2**23, segment_size=2**20,
                            sync_comm=False):
     """level: 'os' (stage1: optimizer states), 'os_g' (stage2: +grads),
-    'p_g_os' (stage3: +params)."""
+    'p_g_os' (stage3: +params).  Requires an active mesh with a 'dp' axis
+    (parallel.mesh.set_mesh / mesh_scope)."""
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
     optimizer._zero_stage = stage
-    if stage >= 3:
-        for p in model.parameters():
-            # shard params along their largest axis over dp
-            shape = p.shape
-            if not shape:
-                continue
-            axis = max(range(len(shape)), key=lambda i: shape[i])
-            spec = [None] * len(shape)
-            spec[axis] = "dp"
-            p._sharding_axes = tuple(spec)
-        mesh_mod.shard_params(model)
+
+    mesh = mesh_mod.get_mesh()
+    if mesh is not None and "dp" in mesh.axis_names:
+        dp = dict(zip(mesh.axis_names, mesh.devices.shape))["dp"]
+        if dp > 1:
+            # stage>=1: moments live dp-sharded; the optimizer asks us how
+            # to place each accumulator it creates
+            def place_accumulator(p, zeros):
+                ns = NamedSharding(mesh, _dp_spec(zeros.shape, dp))
+                return jax.device_put(zeros, ns)
+
+            optimizer._accumulator_placement = place_accumulator
+            # re-place any accumulators that already exist
+            by_id = {id(p): p for p in optimizer._parameters}
+            for nm, d in optimizer._accumulators.items():
+                for pid, arr in list(d.items()):
+                    if pid in by_id:
+                        d[pid] = place_accumulator(by_id[pid], arr)
+            if stage >= 3:
+                for p in model.parameters():
+                    spec = _dp_spec(p.shape, dp)
+                    p._sharding_axes = tuple(spec)
+                mesh_mod.shard_params(model)
     return model, optimizer, scaler
 
 

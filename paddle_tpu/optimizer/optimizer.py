@@ -63,7 +63,11 @@ class Optimizer:
         return states
 
     def _init_accumulator(self, name, p):
-        return jnp.zeros_like(p.value)
+        zeros = jnp.zeros_like(p.value)
+        place = getattr(self, "_accumulator_placement", None)
+        if place is not None:      # ZeRO: dp-sharded moment placement
+            zeros = place(p, zeros)
+        return zeros
 
     def _update(self, p, g, state, lr, t=1):
         """Pure update rule.  ``t`` is the 1-based step count (python int
@@ -109,7 +113,12 @@ class Optimizer:
             new_val, new_state = self._update(p.value, g, state, lr,
                                               self._step_count)
             p.value = new_val
+            place = getattr(self, "_accumulator_placement", None)
             for nm, sv in new_state.items():
+                if place is not None:
+                    # ZeRO: keep moments dp-sharded across eager updates
+                    # (computation follows the unsharded grad otherwise)
+                    sv = place(p, sv)
                 self._accumulators[nm][id(p)] = sv
 
     def minimize(self, loss, startup_program=None, parameters=None,
