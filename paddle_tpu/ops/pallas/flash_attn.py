@@ -89,8 +89,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(ki == pl.num_programs(3) - 1)
     def _finish():
         o_ref[:] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
-        # row logsumexp, saved for the backward recompute
-        lse_ref[:] = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        # row logsumexp, saved for the backward recompute.  Kept lane-
+        # broadcast at [block_q, 128] — Mosaic rejects 1-D (squeezed)
+        # output blocks, so lse lives as [B, H, N, 128] like jax's own
+        # TPU flash kernel (all 128 lanes equal).
+        lse_ref[:] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
@@ -135,12 +138,12 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
         out_specs=[
             pl.BlockSpec((None, None, block_q, D),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((None, None, block_q),
-                         lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec((None, None, block_q, 128),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qh.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, Np), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Np, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -151,7 +154,7 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
     )(qh, kh, vh)
     out = jnp.swapaxes(out[:, :, :N], 1, 2)
     if return_lse:
-        return out, lse[:, :, :N]
+        return out, lse[:, :, :N]    # [B, H, N, 128], lane-broadcast
     return out
 
 
@@ -185,10 +188,11 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
         rows = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0)
         valid = valid & (rows + q_offset >= cols)
-    p = jnp.where(valid, jnp.exp(s - lse_ref[:][:, None]), 0.0)
+    # lse/delta blocks are [block_q, 128] lane-broadcast; lane 0 suffices
+    p = jnp.where(valid, jnp.exp(s - lse_ref[:][:, :1]), 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[:][:, None]) * sm_scale
+    ds = p * (dp - delta_ref[:][:, :1]) * sm_scale
     return p, ds, q, k, v, do
 
 
@@ -270,8 +274,11 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
     vh = jnp.swapaxes(v, 1, 2)
     doh = jnp.swapaxes(do, 1, 2)
     oh = jnp.swapaxes(out, 1, 2)
-    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it.
+    # Broadcast across 128 lanes to match the lse layout (Mosaic rejects
+    # 1-D row blocks).
     delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32), -1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
 
     Np = pl.cdiv(N, block_q) * block_q
     Nkp = pl.cdiv(Nk, block_k) * block_k
@@ -279,8 +286,8 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
         pad4 = ((0, 0), (0, 0), (0, Np - N), (0, 0))
         qh = jnp.pad(qh, pad4)
         doh = jnp.pad(doh, pad4)
-        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, Np - N)))
-        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, Np - N)))
+        lse = jnp.pad(lse, pad4)
+        delta = jnp.pad(delta, pad4)
     if Nkp != Nk:
         pad4 = ((0, 0), (0, 0), (0, Nkp - Nk), (0, 0))
         kh = jnp.pad(kh, pad4)
@@ -290,8 +297,8 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
                   block_k=block_k, kv_len=Nk, q_offset=Nk - N)
     q_spec = pl.BlockSpec((None, None, block_q, D),
                           lambda b, h, i, j: (b, h, i, 0))
-    row_spec = pl.BlockSpec((None, None, block_q),
-                            lambda b, h, i, j: (b, h, i))
+    row_spec = pl.BlockSpec((None, None, block_q, 128),
+                            lambda b, h, i, j: (b, h, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, **common),
@@ -321,10 +328,10 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
             k_spec, k_spec,
             pl.BlockSpec((None, None, block_q, D),
                          lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, block_q),
-                         lambda b, h, i, j: (b, h, j)),
-            pl.BlockSpec((None, None, block_q),
-                         lambda b, h, i, j: (b, h, j)),
+            pl.BlockSpec((None, None, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
         ],
         out_specs=[k_spec, k_spec],
         out_shape=[jax.ShapeDtypeStruct(kh.shape, k.dtype),

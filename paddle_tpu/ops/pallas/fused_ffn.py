@@ -59,21 +59,22 @@ def _fused_ffn_tpu(x2d, w1, b1, w2, b2, block_m, block_f, interpret):
     M, H = x2d.shape
     F = w1.shape[1]
     grid = (pl.cdiv(M, block_m), pl.cdiv(F, block_f))
+    # biases ride as [1, F] / [1, H] — Mosaic rejects 1-D (rank<2) blocks
     return pl.pallas_call(
         _ffn_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_m, H), lambda m, f: (m, 0)),
             pl.BlockSpec((H, block_f), lambda m, f: (0, f)),
-            pl.BlockSpec((block_f,), lambda m, f: (f,)),
+            pl.BlockSpec((1, block_f), lambda m, f: (0, f)),
             pl.BlockSpec((block_f, H), lambda m, f: (f, 0)),
-            pl.BlockSpec((H,), lambda m, f: (0,)),
+            pl.BlockSpec((1, H), lambda m, f: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block_m, H), lambda m, f: (m, 0)),
         out_shape=jax.ShapeDtypeStruct((M, H), x2d.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, H), jnp.float32)],
         interpret=interpret,
-    )(x2d, w1, b1, w2, b2)
+    )(x2d, w1, b1.reshape(1, F), w2, b2.reshape(1, H))
 
 
 def _pick_blocks(M, H, F, itemsize):

@@ -1,0 +1,175 @@
+"""On-chip Pallas kernel check: compile (no interpret) every kernel on the
+real TPU, assert parity vs the XLA reference path, and time both.
+
+Run:  python tools/tpu_kernel_check.py
+Writes results to stdout and tools/tpu_kernel_check.json.
+
+Timing note: in this environment ``block_until_ready`` does not synchronize
+through the remote-execution layer, so every timed region ends with a host
+fetch (``float(jnp.sum(...))``) — see VERDICT round 2.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def fetch(x):
+    """Host-sync: reduce to a scalar and pull it to the host."""
+    leaves = jax.tree_util.tree_leaves(x)
+    return float(sum(jnp.sum(jnp.abs(l).astype(jnp.float32)) for l in leaves))
+
+
+def timeit(fn, *args, iters=20):
+    fetch(fn(*args))                      # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    s = fetch(out)                        # host fetch closes the region
+    dt = (time.perf_counter() - t0) / iters
+    return dt, s
+
+
+def maxdiff(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    return max(float(jnp.max(jnp.abs(x.astype(jnp.float32)
+                                     - y.astype(jnp.float32))))
+               for x, y in zip(fa, fb))
+
+
+def check_flash_attention(results):
+    from paddle_tpu.ops.pallas import flash_attn as fa
+    B, N, H, D = 4, 1024, 8, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+
+    for causal in (False, True):
+        name = f"flash_attn_fwd{'_causal' if causal else ''}"
+        pallas_fn = jax.jit(lambda q, k, v: fa._flash_attention_tpu(
+            q, k, v, causal))
+        ref_fn = jax.jit(lambda q, k, v: fa._ref_attention(q, k, v, causal))
+        out_p = pallas_fn(q, k, v)
+        out_r = ref_fn(q, k, v)
+        md = maxdiff(out_p, out_r)
+        tp, _ = timeit(pallas_fn, q, k, v)
+        tr, _ = timeit(ref_fn, q, k, v)
+        results[name] = {"ok": md < 3e-2, "maxdiff": md,
+                         "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
+
+        # backward: full custom-vjp path vs XLA autodiff of the dense ref
+        name = f"flash_attn_bwd{'_causal' if causal else ''}"
+        loss_p = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                fa.flash_attention(q, k, v, causal).astype(jnp.float32)
+                ** 2), argnums=(0, 1, 2)))
+        loss_r = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                fa._ref_attention(q, k, v, causal).astype(jnp.float32)
+                ** 2), argnums=(0, 1, 2)))
+        gp = loss_p(q, k, v)
+        gr = loss_r(q, k, v)
+        md = maxdiff(gp, gr)
+        tp, _ = timeit(loss_p, q, k, v)
+        tr, _ = timeit(loss_r, q, k, v)
+        results[name] = {"ok": md < 0.25, "maxdiff": md,
+                         "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
+
+
+def check_fused_ffn(results):
+    from paddle_tpu.ops.pallas import fused_ffn as ff
+    M, Hd, F = 2048, 1024, 4096
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(M, Hd) * 0.1, jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(Hd, F) * 0.02, jnp.bfloat16)
+    b1 = jnp.asarray(rng.randn(F) * 0.01, jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(F, Hd) * 0.02, jnp.bfloat16)
+    b2 = jnp.asarray(rng.randn(Hd) * 0.01, jnp.bfloat16)
+
+    blocks = ff._pick_blocks(M, Hd, F, 2)
+    assert blocks is not None, "fused_ffn: shape not tileable"
+    pallas_fn = jax.jit(lambda *a: ff._fused_ffn_tpu(*a, *blocks,
+                                                     interpret=False))
+    ref_fn = jax.jit(ff._ref_ffn)
+    out_p = pallas_fn(x, w1, b1, w2, b2)
+    out_r = ref_fn(x, w1, b1, w2, b2)
+    md = maxdiff(out_p, out_r)
+    tp, _ = timeit(pallas_fn, x, w1, b1, w2, b2)
+    tr, _ = timeit(ref_fn, x, w1, b1, w2, b2)
+    results["fused_ffn_fwd"] = {"ok": md < 3e-2, "maxdiff": md,
+                                "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
+
+
+def check_norms(results):
+    from paddle_tpu.ops.pallas import norms
+    M, Hd = 4096, 1024
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(M, Hd), jnp.float32)
+    g = jnp.asarray(rng.randn(Hd) * 0.1 + 1.0, jnp.float32)
+    b = jnp.asarray(rng.randn(Hd) * 0.1, jnp.float32)
+
+    for name, p_fn, r_fn in [
+        ("layer_norm",
+         jax.jit(lambda x, g, b: norms.layer_norm(x, g, b)),
+         jax.jit(lambda x, g, b: norms._ref_layer_norm(x, g, b, 1e-5))),
+    ]:
+        out_p = p_fn(x, g, b)
+        out_r = r_fn(x, g, b)
+        md = maxdiff(out_p, out_r)
+        tp, _ = timeit(p_fn, x, g, b)
+        tr, _ = timeit(r_fn, x, g, b)
+        results[name] = {"ok": md < 1e-4, "maxdiff": md,
+                         "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
+
+    p_fn = jax.jit(lambda x, g: norms.rms_norm(x, g))
+    r_fn = jax.jit(lambda x, g: norms._ref_rms_norm(x, g, 1e-6))
+    md = maxdiff(p_fn(x, g), r_fn(x, g))
+    tp, _ = timeit(p_fn, x, g)
+    tr, _ = timeit(r_fn, x, g)
+    results["rms_norm"] = {"ok": md < 1e-4, "maxdiff": md,
+                           "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+    if dev.platform == "cpu":
+        print("WARNING: no TPU — kernels will run their XLA fallbacks only",
+              file=sys.stderr)
+
+    results = {"device": str(dev.device_kind)}
+    for check in (check_flash_attention, check_fused_ffn, check_norms):
+        try:
+            check(results)
+        except Exception as e:                      # noqa: BLE001
+            results[check.__name__] = {"ok": False,
+                                       "error": f"{type(e).__name__}: {e}"}
+
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tpu_kernel_check.json")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2, default=str)
+    ok = all(v.get("ok", True) for v in results.values()
+             if isinstance(v, dict))
+    for k, v in results.items():
+        if isinstance(v, dict) and "ok" in v:
+            status = "PASS" if v["ok"] else "FAIL"
+            extra = (f" pallas={v.get('pallas_ms', 0):.3f}ms"
+                     f" xla={v.get('xla_ms', 0):.3f}ms"
+                     f" maxdiff={v.get('maxdiff', 0):.2e}"
+                     if "pallas_ms" in v else f" {v.get('error', '')}")
+            print(f"{status} {k}{extra}")
+    print("ALL OK" if ok else "FAILURES PRESENT")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
