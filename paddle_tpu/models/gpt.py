@@ -44,11 +44,16 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     use_flash: bool = True
     remat: bool = True
+    # "full": recompute the whole block in the backward (min HBM, +~33%
+    # FLOPs); "dots": save matmul outputs, recompute elementwise/norms only
+    # (the TPU sweet spot — matmul results are what's expensive to redo)
+    remat_policy: str = "full"
 
     def __post_init__(self):
         if self.ffn_size == 0:
             self.ffn_size = 4 * self.hidden_size
         assert self.hidden_size % self.num_heads == 0
+        assert self.remat_policy in ("full", "dots"), self.remat_policy
 
     @property
     def head_dim(self):
@@ -183,7 +188,9 @@ def forward(params, tokens, cfg: GPTConfig):
     x = embed(cfg, params, tokens)
     blk_fn = functools.partial(block_apply, cfg)
     if cfg.remat:
-        blk_fn = jax.checkpoint(blk_fn)
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        blk_fn = jax.checkpoint(blk_fn, policy=policy)
 
     def scan_body(carry, blk):
         return blk_fn(carry, blk), None

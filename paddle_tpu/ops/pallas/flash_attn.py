@@ -14,7 +14,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from .utils import HAS_PALLAS, on_tpu
+from .utils import HAS_PALLAS, on_tpu, pallas_enabled
 
 if HAS_PALLAS:
     from jax.experimental import pallas as pl
@@ -96,7 +96,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[:] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
-def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
+def _flash_attention_tpu(q, k, v, causal, block_q=512, block_k=1024,
                          interpret=False, return_lse=False):
     """q,k,v: [B, N, H, D] — grid over (batch, head, q-block, k-block).
     With return_lse, also returns the per-row logsumexp [B, H, N] used by
@@ -159,7 +159,7 @@ def _flash_attention_tpu(q, k, v, causal, block_q=128, block_k=128,
 
 
 def _use_pallas(q):
-    if not (HAS_PALLAS and on_tpu()):
+    if not pallas_enabled():
         return False
     B, N, H, D = q.shape
     return (D % 128 == 0 or D in (64,)) and N >= 128

@@ -20,7 +20,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-from .utils import HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu
+from .utils import (HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu,
+                    pallas_enabled as _pallas_enabled)
 
 if _HAS_PALLAS:
     from jax.experimental import pallas as pl
@@ -107,7 +108,8 @@ def fused_ffn(x, w1, b1, w2, b2, interpret=False):
     H = x.shape[-1]
     M = math.prod(x.shape[:-1]) if x.ndim > 1 else 1
     blocks = _pick_blocks(M, H, w1.shape[1], jnp.dtype(x.dtype).itemsize)
-    use = (_HAS_PALLAS and (interpret or _on_tpu()) and blocks is not None)
+    use = (_HAS_PALLAS and (interpret or _pallas_enabled())
+           and blocks is not None)
     if not use:
         return _ref_ffn(x, w1, b1, w2, b2)
     out = _fused_ffn_tpu(x.reshape(M, H), w1, b1, w2, b2, *blocks,

@@ -20,7 +20,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-from .utils import HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu
+from .utils import (HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu,
+                    pallas_enabled as _pallas_enabled)
 
 if _HAS_PALLAS:
     from jax.experimental import pallas as pl
@@ -94,7 +95,7 @@ def layer_norm(x, g, b, eps=1e-5, interpret=False):
     """Fused LayerNorm over the last axis.  x: [..., H]; g,b: [H]."""
     rows = math.prod(x.shape[:-1]) if x.ndim > 1 else 1
     H = x.shape[-1]
-    use = (_HAS_PALLAS and (interpret or _on_tpu())
+    use = (_HAS_PALLAS and (interpret or _pallas_enabled())
            and _tileable(rows, H, x.dtype))
     if not use:
         return _ref_layer_norm(x, g, b, eps)
@@ -122,7 +123,7 @@ def rms_norm(x, g, eps=1e-6, interpret=False):
     """Fused RMSNorm over the last axis.  x: [..., H]; g: [H]."""
     rows = math.prod(x.shape[:-1]) if x.ndim > 1 else 1
     H = x.shape[-1]
-    use = (_HAS_PALLAS and (interpret or _on_tpu())
+    use = (_HAS_PALLAS and (interpret or _pallas_enabled())
            and _tileable(rows, H, x.dtype))
     if not use:
         return _ref_rms_norm(x, g, eps)

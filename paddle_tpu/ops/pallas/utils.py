@@ -1,6 +1,8 @@
 """Shared probes for the Pallas kernel modules."""
 from __future__ import annotations
 
+import os
+
 import jax
 
 try:
@@ -16,3 +18,13 @@ def on_tpu():
         return jax.devices()[0].platform == "tpu"
     except Exception:  # pragma: no cover
         return False
+
+
+def pallas_enabled():
+    """Master gate for the compiled Pallas paths.  Set
+    ``PADDLE_TPU_DISABLE_PALLAS=1`` to force every op to its XLA fallback
+    (bench.py's safety valve: a lowering regression must never crash a
+    training run — it degrades to the fused-XLA path instead)."""
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "") not in ("", "0"):
+        return False
+    return HAS_PALLAS and on_tpu()

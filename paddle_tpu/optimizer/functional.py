@@ -14,13 +14,16 @@ def adamw_update(p, g, m, v, lr, t, b1, b2, eps, wd, decay):
     """One fused AdamW step in fp32 master precision.
 
     p: param leaf (any dtype; updated in fp32, cast back), g: grad,
-    m/v: fp32 moments, t: fp32 1-based step count, decay: bool — apply
-    weight decay to this leaf.  Returns (new_p, new_m, new_v)."""
+    m/v: moments (math always runs fp32; stored back in their own dtype,
+    so bf16 moments halve optimizer-state HBM on big models), t: fp32
+    1-based step count, decay: bool — apply weight decay to this leaf.
+    Returns (new_p, new_m, new_v)."""
+    mdt, vdt = m.dtype, v.dtype
     gf = g.astype(jnp.float32)
     pf = p.astype(jnp.float32)
-    m = b1 * m + (1 - b1) * gf
-    v = b2 * v + (1 - b2) * gf * gf
+    m = b1 * m.astype(jnp.float32) + (1 - b1) * gf
+    v = b2 * v.astype(jnp.float32) + (1 - b2) * gf * gf
     mhat = m / (1 - b1 ** t)
     vhat = v / (1 - b2 ** t)
     upd = mhat / (jnp.sqrt(vhat) + eps) + (wd * pf if decay else 0.0)
-    return (pf - lr * upd).astype(p.dtype), m, v
+    return (pf - lr * upd).astype(p.dtype), m.astype(mdt), v.astype(vdt)

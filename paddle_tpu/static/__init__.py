@@ -5,6 +5,7 @@ from .graph import (Program, Executor, CompiledProgram, BuildStrategy,
                     global_scope, scope_guard, Scope, in_static_mode,
                     _set_static_mode)
 from . import nn
+from .control_flow import cond, while_loop, case, switch_case
 from ..jit.api import InputSpec
 
 

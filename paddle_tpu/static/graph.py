@@ -28,6 +28,13 @@ from ..tensor.tensor import Tensor, Parameter
 
 _static_mode = [False]
 _var_counter = itertools.count()
+# var-ids the replay machinery can provide in some env: feeds, parameters,
+# recorded-op outputs.  Control-flow composites use this to decide whether
+# an external capture is live or a build-time constant (control_flow.py).
+_live_var_ids = set()
+# var-id -> weakref(Tensor): lets control flow recover build-time values
+# for const baking without scanning the heap.
+_var_tensors = {}
 
 
 def in_static_mode():
@@ -138,6 +145,7 @@ class program_guard:
 
 
 def _ensure_var_id(t: Tensor, program: Program):
+    import weakref
     vid = getattr(t, "_weakref_slot", None)
     if vid is None:
         vid = next(_var_counter)
@@ -145,10 +153,16 @@ def _ensure_var_id(t: Tensor, program: Program):
         program.var_meta[vid] = (tuple(t.shape), t.dtype)
         if isinstance(t, Parameter):
             program.params[vid] = t
+            _live_var_ids.add(vid)
     elif vid not in program.var_meta:
         program.var_meta[vid] = (tuple(t.shape), t.dtype)
         if isinstance(t, Parameter):
             program.params[vid] = t
+            _live_var_ids.add(vid)
+    try:
+        _var_tensors[vid] = weakref.ref(t)
+    except TypeError:  # pragma: no cover
+        pass
     return vid
 
 
@@ -162,6 +176,7 @@ def record_call(fn, leaves, treedef, out_tensors, name):
         else:
             specs.append(("const", l))
     out_ids = [_ensure_var_id(t, prog) for t in out_tensors]
+    _live_var_ids.update(out_ids)
     prog.record(fn, treedef, specs, out_ids, name)
 
 
@@ -174,6 +189,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     prog = default_main_program()
     vid = _ensure_var_id(t, prog)
     prog.feed_ids[name] = vid
+    _live_var_ids.add(vid)
     t.name = name
     return t
 

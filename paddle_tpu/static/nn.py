@@ -6,6 +6,7 @@ from __future__ import annotations
 
 from .. import nn as _nn
 from ..nn import functional as F
+from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,

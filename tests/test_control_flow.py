@@ -1,0 +1,225 @@
+"""Control flow ops (static.nn.cond/while_loop/case/switch_case) in eager,
+traced, and static-record modes (SURVEY.md §2; ref
+python/paddle/fluid/layers/control_flow.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import cond, while_loop, case, switch_case
+
+
+# ---------------------------------------------------------------- eager ----
+
+def test_cond_eager_branch_select():
+    x = paddle.to_tensor(3.0)
+    out = cond(x > 2.0, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+    out = cond(x > 5.0, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 2.0
+
+
+def test_cond_eager_grad_through_taken_branch():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    out = cond(x > 2.0, lambda: x * x, lambda: x)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+    y = paddle.to_tensor(1.0, stop_gradient=False)
+    out = cond(y > 2.0, lambda: y * y, lambda: 3 * y)
+    out.backward()
+    np.testing.assert_allclose(y.grad.numpy(), 3.0)
+
+
+def test_cond_eager_multi_output():
+    x = paddle.to_tensor([1.0, 2.0])
+    a, b = cond(paddle.to_tensor(True), lambda: (x + 1, x * 2),
+                lambda: (x - 1, x / 2))
+    np.testing.assert_allclose(a.numpy(), [2, 3])
+    np.testing.assert_allclose(b.numpy(), [2, 4])
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0.0)
+    i_out, s_out = while_loop(lambda i, s: i < 5,
+                              lambda i, s: (i + 1, s + 2.0), [i, s])
+    assert int(i_out) == 5
+    assert float(s_out) == 10.0
+
+
+def test_while_loop_eager_grad_unrolled():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    i = paddle.to_tensor(0)
+    # y = x^(2^3) after 3 doublings of the exponent: ((x^2)^2)^2
+    _, y = while_loop(lambda i, y: i < 3, lambda i, y: (i + 1, y * y),
+                      [i, x])
+    y.backward()
+    # d/dx x^8 = 8 x^7
+    np.testing.assert_allclose(x.grad.numpy(), 8 * 2.0 ** 7, rtol=1e-6)
+
+
+def test_case_eager():
+    x = paddle.to_tensor(1.0)
+    out = case([(paddle.to_tensor(False), lambda: x + 1),
+                (paddle.to_tensor(True), lambda: x + 10)],
+               default=lambda: x)
+    assert float(out) == 11.0
+    out = case([(paddle.to_tensor(False), lambda: x + 1),
+                (paddle.to_tensor(False), lambda: x + 10)],
+               default=lambda: x - 5)
+    assert float(out) == -4.0
+
+
+def test_switch_case_eager():
+    x = paddle.to_tensor([1.0, 2.0])
+    fns = [lambda: x * 1, lambda: x * 2, lambda: x * 3]
+    np.testing.assert_allclose(
+        switch_case(paddle.to_tensor(1), fns).numpy(), [2, 4])
+    # out of range -> default (last)
+    np.testing.assert_allclose(
+        switch_case(paddle.to_tensor(7), fns).numpy(), [3, 6])
+
+
+# --------------------------------------------------------------- traced ----
+
+def test_cond_traced_under_jit():
+    class Net(paddle.nn.Layer):
+        def forward(self, x):
+            return cond(x.sum() > 0, lambda: x * 2, lambda: -x)
+
+    net = paddle.jit.to_static(Net())
+    out = net(paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(), [2, 4])
+    out = net(paddle.to_tensor([-1.0, -2.0]))
+    np.testing.assert_allclose(out.numpy(), [1, 2])
+
+
+def test_while_loop_traced_under_jit():
+    class Net(paddle.nn.Layer):
+        def forward(self, x):
+            i = paddle.zeros([], "int32")
+            _, y = while_loop(lambda i, y: i < 4,
+                              lambda i, y: (i + 1, y + x), [i, x * 0])
+            return y
+
+    net = paddle.jit.to_static(Net())
+    out = net(paddle.to_tensor([1.5, 2.5]))
+    np.testing.assert_allclose(out.numpy(), [6, 10])
+
+
+def test_switch_case_traced_under_jit():
+    class Net(paddle.nn.Layer):
+        def forward(self, idx, x):
+            return switch_case(idx, [lambda: x + 1, lambda: x * 10,
+                                     lambda: x - 1])
+
+    net = paddle.jit.to_static(Net())
+    np.testing.assert_allclose(
+        net(paddle.to_tensor(0), paddle.to_tensor(2.0)).numpy(), 3.0)
+    np.testing.assert_allclose(
+        net(paddle.to_tensor(1), paddle.to_tensor(2.0)).numpy(), 20.0)
+
+
+# ------------------------------------------------------- static program ----
+
+def test_cond_static_program_feed_dependent():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            pred = (x.sum() > 0)
+            out = cond(pred, lambda: x * 2, lambda: -x)
+        exe = static.Executor()
+        r1, = exe.run(main, feed={"x": np.array([1, 2], np.float32)},
+                      fetch_list=[out])
+        np.testing.assert_allclose(r1, [2, 4])
+        r2, = exe.run(main, feed={"x": np.array([-1, -2], np.float32)},
+                      fetch_list=[out])
+        np.testing.assert_allclose(r2, [1, 2])
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_static_program():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            i = paddle.zeros([], "int32")
+            acc = paddle.zeros([2], "float32")
+            i_f, acc_f = while_loop(lambda i, a: i < 3,
+                                    lambda i, a: (i + 1, a + x), [i, acc])
+        exe = static.Executor()
+        r, = exe.run(main, feed={"x": np.array([1, 2], np.float32)},
+                     fetch_list=[acc_f])
+        np.testing.assert_allclose(r, [3, 6])
+    finally:
+        paddle.disable_static()
+
+
+def test_switch_case_static_program():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            idx = static.data("idx", [], "int64")
+            x = static.data("x", [2], "float32")
+            out = switch_case(idx, [lambda: x + 1, lambda: x * 10])
+        exe = static.Executor()
+        r, = exe.run(main, feed={"idx": np.array(1, np.int64),
+                                 "x": np.array([1, 2], np.float32)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(r, [10, 20])
+        r, = exe.run(main, feed={"idx": np.array(0, np.int64),
+                                 "x": np.array([1, 2], np.float32)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(r, [2, 3])
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_static_passthrough_branches():
+    """A plain select — both branches return captured tensors unchanged."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            y = static.data("y", [2], "float32")
+            out = cond((x.sum() > 0), lambda: x, lambda: y)
+        exe = static.Executor()
+        r, = exe.run(main, feed={"x": np.array([1, 2], np.float32),
+                                 "y": np.array([5, 6], np.float32)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(r, [1, 2])
+        r, = exe.run(main, feed={"x": np.array([-1, -2], np.float32),
+                                 "y": np.array([5, 6], np.float32)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(r, [5, 6])
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_static_captures_parameter():
+    """A branch reading a Parameter must resolve it live (not baked)."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            lin = paddle.nn.Linear(2, 2)
+            pred = (x.sum() > 0)
+            out = cond(pred, lambda: lin(x), lambda: x)
+        exe = static.Executor()
+        r, = exe.run(main, feed={"x": np.array([1, 1], np.float32)},
+                     fetch_list=[out])
+        w = lin.weight.numpy()
+        b = lin.bias.numpy()
+        np.testing.assert_allclose(r, np.array([1, 1]) @ w + b, rtol=1e-5)
+    finally:
+        paddle.disable_static()
