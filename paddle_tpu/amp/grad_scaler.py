@@ -33,17 +33,25 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
+        """One fused finite-check over the whole grad tree: the per-leaf
+        any(~isfinite) reductions stay on device and a single scalar is
+        fetched to the host (one round-trip per step, not per param)."""
         if not self._enable:
             return
         inv = 1.0 / self._scale
-        found = False
+        grads = []
         for p in optimizer._parameters:
             if p is not None and p._grad is not None:
                 g = p._grad * inv
-                if bool(jnp.any(~jnp.isfinite(g))):
-                    found = True
                 p._grad = g
-        self._found_inf = found
+                grads.append(g)
+        if grads:
+            bad = jnp.zeros((), jnp.bool_)
+            for g in grads:
+                bad = bad | jnp.any(~jnp.isfinite(g))
+            self._found_inf = bool(bad)    # the only host sync
+        else:
+            self._found_inf = False
 
     def step(self, optimizer):
         if not self._enable:

@@ -21,7 +21,7 @@ class Node:
     """One recorded primitive application."""
 
     __slots__ = ("vjp_fn", "parents", "n_outputs", "out_shapes", "out_dtypes",
-                 "_accum", "name", "out_hooks")
+                 "_accum", "name", "out_hooks", "fwd_closure")
 
     def __init__(self, vjp_fn, parents, n_outputs, out_shapes, out_dtypes,
                  name=""):
@@ -34,6 +34,8 @@ class Node:
         self.name = name
         self.out_hooks = None         # {out_index: hook list} (register_hook
                                       # on a non-leaf tensor)
+        self.fwd_closure = None       # pure fn(*parent_vals) -> out(s), for
+                                      # create_graph double-backward
 
     def seed(self, index: int, grad):
         if self._accum is None:
@@ -226,6 +228,7 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
         node._accum = None
         if not retain_graph:
             node.vjp_fn = None
+            node.fwd_closure = None   # frees captured forward arrays too
     for t, g, hooks_done in pending.values():
         if hooks_done:
             t._accumulate_grad(g)
@@ -237,14 +240,98 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
             node.parents = ()
 
 
+def _backward_create_graph(tensor, grad, watch):
+    """Reverse pass whose every vjp application is itself dispatched and
+    tape-recorded, so the returned grads carry a live graph (double
+    backward).  Each node's vjp is REBUILT from its forward closure with
+    the parent tensors as differentiable inputs — the second derivative
+    therefore sees the primal dependence of the first (ref dygraph
+    double-grad: python/paddle/fluid/imperative/partial_grad_engine.cc).
+    Returns {id(watched tensor): grad Tensor}."""
+    import numpy as np
+    import jax
+    from ..tensor import Tensor
+    from ..ops import dispatch
+
+    root = tensor._node
+    # per-node output cotangent Tensors
+    acc: dict = {}
+
+    def seed(node, idx, g):
+        key = (id(node), idx)
+        acc[key] = g if key not in acc else acc[key] + g
+
+    out_grads: dict = {}
+
+    def add_out(t, g):
+        out_grads[id(t)] = g if id(t) not in out_grads else \
+            out_grads[id(t)] + g
+
+    g0 = grad if isinstance(grad, Tensor) else Tensor(grad)
+    if id(tensor) in watch:
+        add_out(tensor, g0)
+    seed(root, tensor._node_index, g0)
+
+    for node in reversed(_topo_order(root)):
+        if node.fwd_closure is None:
+            raise RuntimeError(
+                f"create_graph=True cannot differentiate through node "
+                f"'{node.name}': no forward closure available (the graph "
+                "was freed by a backward() without retain_graph, or the "
+                "node is a PyLayer — custom PyLayers do not support "
+                "eager double-grad)")
+        inexact = [i for i in range(node.n_outputs)
+                   if jnp.issubdtype(node.out_dtypes[i], jnp.inexact)]
+        cts = []
+        for i in inexact:
+            g = acc.get((id(node), i))
+            if g is None:
+                g = Tensor(jnp.zeros(node.out_shapes[i],
+                                     node.out_dtypes[i]))
+            cts.append(g)
+        n_ct, n_par = len(cts), len(node.parents)
+        closure = node.fwd_closure
+        n_out = node.n_outputs
+        shapes, dtypes = node.out_shapes, node.out_dtypes
+        inexact_t = tuple(inexact)
+
+        def vjp_op(*vals, _closure=closure, _n_ct=n_ct, _n_out=n_out,
+                   _shapes=shapes, _dtypes=dtypes, _inexact=inexact_t):
+            ct_vals, parent_vals = vals[:_n_ct], vals[_n_ct:]
+            _, vjp_fn = jax.vjp(_closure, *parent_vals)
+            full = []
+            k = 0
+            for i in range(_n_out):
+                if i in _inexact:
+                    full.append(ct_vals[k])
+                    k += 1
+                else:
+                    full.append(np.zeros(_shapes[i], jax.dtypes.float0))
+            ct = full[0] if _n_out == 1 else tuple(full)
+            gs = vjp_fn(ct)
+            return tuple(gs) if len(gs) > 1 else gs[0]
+
+        grads = dispatch.call(vjp_op, *cts, *node.parents,
+                              _name=f"grad_{node.name}")
+        if not isinstance(grads, tuple):
+            grads = (grads,)
+        for parent, g in zip(node.parents, grads):
+            if id(parent) in watch:
+                add_out(parent, g)
+            if parent._node is not None:
+                seed(parent._node, parent._node_index, g)
+    return out_grads
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad: functional gradient of outputs wrt inputs (eager tape).
 
-    ref: python/paddle/fluid/dygraph/base.py::grad.  create_graph (double
-    backward) is supported under jit via jax.grad composition, not on the
-    eager tape.
+    ref: python/paddle/fluid/dygraph/base.py::grad.  With
+    ``create_graph=True`` the reverse pass is itself recorded on the tape
+    (each vjp rebuilt from its forward closure), so the results support a
+    further backward — gradient penalties work in pure eager mode.
     """
     from ..tensor import Tensor
 
@@ -257,12 +344,29 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
 
+    watch = {id(t) for t in inputs}
+
+    if create_graph:
+        merged: dict = {}
+        for o, go in zip(outputs, grad_outputs):
+            if o._node is None:
+                continue
+            g0 = go if go is not None else Tensor(jnp.ones(o.shape, o.dtype))
+            for tid, gt in _backward_create_graph(o, g0, watch).items():
+                merged[tid] = gt if tid not in merged else merged[tid] + gt
+        results = []
+        for t in inputs:
+            g = merged.get(id(t))
+            if g is None and not allow_unused:
+                g = Tensor(jnp.zeros(t.shape, t.dtype))
+            results.append(g)
+        return results
+
     # save/restore existing leaf grads: paddle.grad must not touch .grad
     saved = [t._grad for t in inputs]
     for t in inputs:
         t._grad = None
     retain = True if retain_graph is None else retain_graph
-    watch = {id(t) for t in inputs}
     try:
         for o, go in zip(outputs, grad_outputs):
             backward(o, go, retain_graph=retain, watch=watch)

@@ -125,6 +125,9 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
         out_dtypes=[o.dtype for o in outs],
         name=_name or getattr(fn, "__name__", "op"),
     )
+    # kept for double-grad: create_graph replays jax.vjp(closure) through
+    # dispatch so second-order derivatives see the primal dependence
+    node.fwd_closure = closure
     wrapped = tuple(
         _wrap(o, stop_gradient=not jnp.issubdtype(o.dtype, jnp.inexact),
               node=node, index=i)

@@ -154,6 +154,21 @@ def _branch_out_ids(trace):
     return [_ensure_var_id(x, trace.sub) for x in leaves]
 
 
+def _fresh_output_tree(tree, produced):
+    """Composite outputs must get their OWN var-ids: a branch that returns
+    a captured tensor unchanged would otherwise alias the input's id, and
+    replay would clobber the input's env slot for every later reader.
+    Leaves not produced inside the branch are re-wrapped as new Tensors."""
+    from ..tensor import Tensor
+
+    def remap(x):
+        vid = getattr(x, "_weakref_slot", None)
+        if vid is not None and vid in produced:
+            return x
+        return Tensor(x.value)
+    return jax.tree_util.tree_map(remap, tree)
+
+
 # --------------------------------------------------------------------------
 # cond
 # --------------------------------------------------------------------------
@@ -228,11 +243,12 @@ def _static_cond(pred, true_fn, false_fn):
     pred_t = pred if _is_tensor(pred) else Tensor(jnp.asarray(pred))
     in_specs = [_in_spec(pred_t, prog)]
     in_specs += [("var", v) for v in live]
-    out_leaves = jax.tree_util.tree_leaves(t.out)
+    out_tree = _fresh_output_tree(t.out, t.produced)
+    out_leaves = jax.tree_util.tree_leaves(out_tree)
     out_ids = [_ensure_var_id(x, prog) for x in out_leaves]
     prog.record(composite, _args_treedef(1 + len(live)), in_specs, out_ids,
                 "cond")
-    return t.out
+    return out_tree
 
 
 # --------------------------------------------------------------------------
@@ -330,6 +346,7 @@ def _static_while(cond_fn, body_fn, loop_vars):
 
     in_specs = [_in_spec(v, prog) for v in loop_vars]
     in_specs += [("var", v) for v in live]
+    b_out = list(_fresh_output_tree(b_out, bt.produced))
     out_ids = [_ensure_var_id(x, prog) for x in b_out]
     prog.record(composite, _args_treedef(n + len(live)), in_specs, out_ids,
                 "while_loop")

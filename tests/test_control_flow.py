@@ -205,6 +205,47 @@ def test_cond_static_passthrough_branches():
         paddle.disable_static()
 
 
+def test_cond_static_passthrough_does_not_clobber_input():
+    """The composite's output must not alias the captured input's var-id:
+    downstream reads of the input still see the feed value."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            y = static.data("y", [2], "float32")
+            out = cond((x.sum() > 0), lambda: x, lambda: y)
+            z = x + 1.0      # must read the ORIGINAL x, not the cond output
+        exe = static.Executor()
+        r_out, r_z = exe.run(
+            main, feed={"x": np.array([-1, -2], np.float32),
+                        "y": np.array([5, 6], np.float32)},
+            fetch_list=[out, z])
+        np.testing.assert_allclose(r_out, [5, 6])     # false branch -> y
+        np.testing.assert_allclose(r_z, [0, -1])      # x + 1, unclobbered
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_static_passthrough_loop_var():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            i = paddle.zeros([], "int32")
+            i_f, x_same = while_loop(lambda i, a: i < 3,
+                                     lambda i, a: (i + 1, a), [i, x])
+            w = x * 10.0
+        exe = static.Executor()
+        r_x, r_w = exe.run(main, feed={"x": np.array([1, 2], np.float32)},
+                           fetch_list=[x_same, w])
+        np.testing.assert_allclose(r_x, [1, 2])
+        np.testing.assert_allclose(r_w, [10, 20])
+    finally:
+        paddle.disable_static()
+
+
 def test_cond_static_captures_parameter():
     """A branch reading a Parameter must resolve it live (not baked)."""
     paddle.enable_static()

@@ -1,0 +1,103 @@
+"""paddle.distribution numerics vs closed forms (SURVEY.md §2; ref
+python/paddle/distribution.py:168,390,640)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Uniform, Normal, Categorical,
+                                     kl_divergence)
+
+
+def test_uniform_sample_log_prob_entropy():
+    u = Uniform(low=2.0, high=6.0)
+    s = u.sample([2000], seed=7)
+    a = s.numpy()
+    assert a.shape == (2000,)
+    assert (a >= 2.0).all() and (a < 6.0).all()
+    np.testing.assert_allclose(a.mean(), 4.0, atol=0.15)
+
+    np.testing.assert_allclose(
+        u.log_prob(paddle.to_tensor(3.0)).numpy(), -math.log(4.0),
+        rtol=1e-6)
+    assert u.log_prob(paddle.to_tensor(7.0)).numpy() == -np.inf
+    np.testing.assert_allclose(u.probs(paddle.to_tensor(3.0)).numpy(),
+                               0.25, rtol=1e-6)
+    np.testing.assert_allclose(u.entropy().numpy(), math.log(4.0),
+                               rtol=1e-6)
+
+
+def test_uniform_batched():
+    u = Uniform(low=paddle.to_tensor([0.0, 1.0]),
+                high=paddle.to_tensor([1.0, 3.0]))
+    s = u.sample([5], seed=3)
+    assert s.shape == [5, 2]
+    np.testing.assert_allclose(u.entropy().numpy(),
+                               [0.0, math.log(2.0)], rtol=1e-6)
+
+
+def test_normal_closed_forms():
+    n = Normal(loc=1.0, scale=2.0)
+    s = n.sample([4000], seed=11)
+    a = s.numpy()
+    np.testing.assert_allclose(a.mean(), 1.0, atol=0.15)
+    np.testing.assert_allclose(a.std(), 2.0, atol=0.15)
+
+    # log N(x=2 | 1, 2) = -0.125 - log(2) - 0.5 log(2π)
+    want = -0.125 - math.log(2.0) - 0.5 * math.log(2 * math.pi)
+    np.testing.assert_allclose(n.log_prob(paddle.to_tensor(2.0)).numpy(),
+                               want, rtol=1e-6)
+    np.testing.assert_allclose(n.probs(paddle.to_tensor(2.0)).numpy(),
+                               math.exp(want), rtol=1e-6)
+    np.testing.assert_allclose(
+        n.entropy().numpy(), 0.5 + 0.5 * math.log(2 * math.pi)
+        + math.log(2.0), rtol=1e-6)
+
+
+def test_normal_kl():
+    p = Normal(0.0, 1.0)
+    q = Normal(1.0, 2.0)
+    # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2
+    want = math.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+    np.testing.assert_allclose(kl_divergence(p, q).numpy(), want, rtol=1e-6)
+    np.testing.assert_allclose(kl_divergence(p, p).numpy(), 0.0, atol=1e-7)
+
+
+def test_categorical():
+    logits = paddle.to_tensor([math.log(0.2), math.log(0.3), math.log(0.5)])
+    c = Categorical(logits)
+    s = c.sample([8000], seed=5)
+    a = s.numpy()
+    freq = np.bincount(a, minlength=3) / len(a)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    np.testing.assert_allclose(
+        c.probs(paddle.to_tensor([0, 2])).numpy(), [0.2, 0.5], rtol=1e-5)
+    np.testing.assert_allclose(
+        c.log_prob(paddle.to_tensor([1])).numpy(), [math.log(0.3)],
+        rtol=1e-5)
+    want_h = -(0.2 * math.log(0.2) + 0.3 * math.log(0.3)
+               + 0.5 * math.log(0.5))
+    np.testing.assert_allclose(c.entropy().numpy(), want_h, rtol=1e-5)
+
+
+def test_categorical_kl_batched():
+    p = Categorical(paddle.to_tensor([[0.0, 0.0], [1.0, 0.0]]))
+    q = Categorical(paddle.to_tensor([[0.0, 0.0], [0.0, 0.0]]))
+    kl = kl_divergence(p, q).numpy()
+    assert kl.shape == (2,)
+    np.testing.assert_allclose(kl[0], 0.0, atol=1e-7)
+    # p = softmax([1,0]) = [e/(1+e), 1/(1+e)]
+    e = math.e
+    p0, p1 = e / (1 + e), 1 / (1 + e)
+    want = p0 * math.log(2 * p0) + p1 * math.log(2 * p1)
+    np.testing.assert_allclose(kl[1], want, rtol=1e-5)
+
+
+def test_sampling_reproducible_via_paddle_seed():
+    paddle.seed(99)
+    a = Normal(0.0, 1.0).sample([4]).numpy()
+    paddle.seed(99)
+    b = Normal(0.0, 1.0).sample([4]).numpy()
+    np.testing.assert_array_equal(a, b)
