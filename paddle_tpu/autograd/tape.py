@@ -289,14 +289,26 @@ def _backward_create_graph(tensor, grad, watch):
                 g = Tensor(jnp.zeros(node.out_shapes[i],
                                      node.out_dtypes[i]))
             cts.append(g)
-        n_ct, n_par = len(cts), len(node.parents)
+        if node.out_hooks:
+            # honor register_hook rewrites, same as the plain backward
+            from ..tensor import Tensor as _T
+            for pos, i in enumerate(inexact):
+                ent = node.out_hooks.get(i)
+                if ent:
+                    g = cts[pos]
+                    for hook in tuple(ent[0]):
+                        out = hook(g if isinstance(g, _T) else _T(g))
+                        if out is not None:
+                            g = out
+                    cts[pos] = g
+        n_ct = len(cts)
         closure = node.fwd_closure
         n_out = node.n_outputs
-        shapes, dtypes = node.out_shapes, node.out_dtypes
+        shapes = node.out_shapes
         inexact_t = tuple(inexact)
 
         def vjp_op(*vals, _closure=closure, _n_ct=n_ct, _n_out=n_out,
-                   _shapes=shapes, _dtypes=dtypes, _inexact=inexact_t):
+                   _shapes=shapes, _inexact=inexact_t):
             ct_vals, parent_vals = vals[:_n_ct], vals[_n_ct:]
             _, vjp_fn = jax.vjp(_closure, *parent_vals)
             full = []

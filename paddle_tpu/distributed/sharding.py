@@ -1,15 +1,14 @@
-"""Sharded (ZeRO) training (ref: python/paddle/distributed/sharding/ +
-fleet sharding meta-optimizer stages 1-3).
+"""Sharded (ZeRO) training — the eager placement API (ref:
+python/paddle/distributed/sharding/ + fleet sharding meta-optimizer).
 
-TPU-native: optimizer-state sharding is a sharding-spec decision, not a
-communication rewrite.  Each stage places state with a 'dp'-sharded
-NamedSharding and lets XLA insert the gathers/reduce-scatters:
-
-  stage 1 ('os')     — optimizer moments sharded over dp
-  stage 2 ('os_g')   — same placement; grads reduce-scatter into the
-                       sharded moment layout inside the jitted step
-  stage 3 ('p_g_os') — parameters themselves sharded (FSDP): XLA gathers
-                       them just-in-time before use
+This module serves the dygraph ``group_sharded_parallel`` surface: it
+PLACES existing eager state with dp-sharded NamedShardings and lets GSPMD
+insert collectives per-op.  The real compiled ZeRO — explicit
+reduce-scatter of grads into the sharded moment layout, gather-on-use FSDP
+with sub-axis (flattened+padded) sharding so every leaf shards regardless
+of axis divisibility, all inside ONE jitted shard_map step — lives in
+``paddle_tpu.parallel.zero`` (make_zero_train_step / init_zero_state);
+use that for training loops, as fleet's static path does.
 """
 from __future__ import annotations
 

@@ -126,7 +126,9 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
         name=_name or getattr(fn, "__name__", "op"),
     )
     # kept for double-grad: create_graph replays jax.vjp(closure) through
-    # dispatch so second-order derivatives see the primal dependence
+    # dispatch so second-order derivatives see the primal dependence.
+    # Costs only refcounts on buffers the vjp residuals mostly pin anyway;
+    # backward(retain_graph=False) clears it with vjp_fn.
     node.fwd_closure = closure
     wrapped = tuple(
         _wrap(o, stop_gradient=not jnp.issubdtype(o.dtype, jnp.inexact),

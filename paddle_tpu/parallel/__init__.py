@@ -3,3 +3,6 @@ from .mesh import (create_mesh, set_mesh, get_mesh, mesh_scope, sharding,
                    shard_constraint, shard_params, P)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_forward, make_pipelined
+from . import zero
+from .zero import (make_zero_train_step, init_zero_state, gather_params,
+                   state_bytes_per_device)

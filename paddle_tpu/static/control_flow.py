@@ -133,6 +133,14 @@ def _split_externals(ext_ids):
     return live, const_env
 
 
+def _mark_live(out_ids):
+    """Composite outputs are produced by a recorded op — later composites
+    must treat captures of them as live, not bake build-time dummies
+    (prog.record bypasses record_call's registry update)."""
+    from .graph import _live_var_ids
+    _live_var_ids.update(out_ids)
+
+
 def _in_spec(t, prog):
     """Leaf spec for a composite input: a live var reference when replay
     can supply it, else its build-time value baked as a const (covers
@@ -248,6 +256,7 @@ def _static_cond(pred, true_fn, false_fn):
     out_ids = [_ensure_var_id(x, prog) for x in out_leaves]
     prog.record(composite, _args_treedef(1 + len(live)), in_specs, out_ids,
                 "cond")
+    _mark_live(out_ids)
     return out_tree
 
 
@@ -350,6 +359,7 @@ def _static_while(cond_fn, body_fn, loop_vars):
     out_ids = [_ensure_var_id(x, prog) for x in b_out]
     prog.record(composite, _args_treedef(n + len(live)), in_specs, out_ids,
                 "while_loop")
+    _mark_live(out_ids)
     return b_out
 
 

@@ -246,6 +246,30 @@ def test_while_loop_static_passthrough_loop_var():
         paddle.disable_static()
 
 
+def test_cond_static_chained_composites():
+    """A later cond capturing an earlier cond's output must see it live."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            y = static.data("y", [2], "float32")
+            out = cond((x.sum() > 0), lambda: x, lambda: y)
+            res = cond((x.sum() > 100), lambda: out * 0.0,
+                       lambda: out + 1.0)
+        exe = static.Executor()
+        r, = exe.run(main, feed={"x": np.array([1, 2], np.float32),
+                                 "y": np.array([5, 6], np.float32)},
+                     fetch_list=[res])
+        np.testing.assert_allclose(r, [2, 3])
+        r, = exe.run(main, feed={"x": np.array([-1, -2], np.float32),
+                                 "y": np.array([5, 6], np.float32)},
+                     fetch_list=[res])
+        np.testing.assert_allclose(r, [6, 7])
+    finally:
+        paddle.disable_static()
+
+
 def test_cond_static_captures_parameter():
     """A branch reading a Parameter must resolve it live (not baked)."""
     paddle.enable_static()

@@ -1,0 +1,99 @@
+"""Compiled ZeRO stages 1/2/3: parity with unsharded AdamW + per-device
+state-memory shrink (VERDICT r2 item 6; ref fleet sharding_optimizer.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.parallel.mesh import create_mesh
+from paddle_tpu.parallel import zero
+from paddle_tpu.optimizer.functional import adamw_update
+
+HYPERS = dict(lr=1e-2, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.01)
+
+
+def _make_problem():
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(7, 13), jnp.float32),   # 91: not %8
+        "b1": jnp.asarray(rng.randn(13), jnp.float32),      # 13: not %8
+        "w2": jnp.asarray(rng.randn(13, 3), jnp.float32),
+        "b2": jnp.asarray(rng.randn(3), jnp.float32),       # 3 < dp
+    }
+    x = jnp.asarray(rng.randn(16, 7), jnp.float32)
+    y = jnp.asarray(rng.randn(16, 3), jnp.float32)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        out = h @ p["w2"] + p["b2"]
+        return jnp.mean((out - yb) ** 2)
+
+    return params, (x, y), loss_fn
+
+
+def _reference_run(params, batch, loss_fn, steps):
+    m = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), params)
+    v = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), params)
+    for t in range(1, steps + 1):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        out = jax.tree_util.tree_map(
+            lambda p, g, mm, vv: adamw_update(
+                p, g, mm, vv, HYPERS["lr"], float(t), HYPERS["beta1"],
+                HYPERS["beta2"], HYPERS["eps"], HYPERS["weight_decay"],
+                True),
+            params, grads, m, v)
+        tup = lambda o: isinstance(o, tuple) and len(o) == 3  # noqa: E731
+        params = jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=tup)
+        m = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=tup)
+        v = jax.tree_util.tree_map(lambda o: o[2], out, is_leaf=tup)
+    return params, float(loss)
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_parity_and_memory(stage):
+    params, batch, loss_fn = _make_problem()
+    mesh = create_mesh(dp=8)
+    steps = 5
+
+    state = zero.init_zero_state(params, mesh, stage=stage)
+    step = zero.make_zero_train_step(loss_fn, params, mesh, stage=stage,
+                                     **HYPERS)
+    for _ in range(steps):
+        state, loss = step(state, batch)
+
+    got = zero.gather_params(state, params, mesh, stage)
+    want, _ = _reference_run(params, batch, loss_fn, steps)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=2e-5, atol=2e-6, err_msg=k)
+
+    # memory proof: every moment leaf is ~1/dp per device (flat + pad)
+    total = sum(int(np.prod(p.shape)) for p in params.values()) * 4
+    per_dev = zero.state_bytes_per_device(state[1])
+    assert per_dev <= total / 8 + 8 * 4 * len(params), (per_dev, total)
+    if stage == 3:
+        p_per_dev = zero.state_bytes_per_device(state[0])
+        assert p_per_dev <= total / 8 + 8 * 4 * len(params)
+
+
+def test_zero_stage2_loss_decreases():
+    params, batch, loss_fn = _make_problem()
+    mesh = create_mesh(dp=8)
+    state = zero.init_zero_state(params, mesh, stage=2)
+    step = zero.make_zero_train_step(loss_fn, params, mesh, stage=2,
+                                     **HYPERS)
+    state, l0 = step(state, batch)
+    for _ in range(20):
+        state, l1 = step(state, batch)
+    assert float(l1) < float(l0)
+
+
+def test_flatten_roundtrip():
+    rng = np.random.RandomState(3)
+    for shape in [(5,), (7, 13), (1,), (3, 5, 2), ()]:
+        x = jnp.asarray(rng.randn(*shape), jnp.float32)
+        f = zero.flatten_leaf(x, 8)
+        assert f.shape[0] == 8
+        y = zero.unflatten_leaf(f, shape, x.dtype)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
