@@ -1,71 +1,134 @@
-"""Inference API (ref: paddle/fluid/inference/api/paddle_inference_api.h,
-python/paddle/inference/__init__.py).
+"""Inference API (ref: paddle/fluid/inference/api/analysis_predictor.cc,
+paddle_inference_api.h, python/paddle/inference/__init__.py).
 
-TPU-native: a saved program (jit.save artifact) loads into a Predictor whose
-run() is one cached XLA executable — the reference's IR pass pipeline
-(fusion, memory planning) is XLA's job here.
+Two artifact kinds load into the same Predictor:
+
+  * standalone StableHLO (inference/export.py::save_inference_model) —
+    parameters baked in, loadable in a fresh process with no Python class
+    (the analogue of the reference's frozen __model__ + params); named
+    input/output handles come from the .pdmeta manifest.
+  * jit.save pickles (.pdmodel/.pdiparams) — in-ecosystem reload of a
+    Layer; re-traced on first run.
+
+Config genuinely selects the execution device; the reference's IR pass
+pipeline (fusion, memory planning) is XLA's job here.
 """
 from __future__ import annotations
 
 import numpy as np
+import jax
 
 from ..jit import api as jit_api
 from ..tensor.tensor import Tensor
+from . import export as export_mod
+from .export import save_inference_model, StandaloneModel
 
 
 class Config:
+    """ref paddle_inference_api.h::AnalysisConfig — device selection and
+    optimization toggles (the latter are XLA's defaults here)."""
+
     def __init__(self, model_path=None, params_path=None):
         self.model_path = model_path
         self.params_path = params_path
-        self._device = "tpu"
+        self._device = None          # None -> default platform
         self._memory_pool_mb = 0
+        self._ir_optim = True
 
+    # -- device selection (really honored by Predictor) --
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._device = "tpu"  # accelerator
+        """Accelerator request: maps to the TPU platform."""
+        self._device = "tpu"
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def enable_tpu(self):
+        self._device = "tpu"
 
     def disable_gpu(self):
         self._device = "cpu"
 
+    def device(self):
+        """Resolved jax device (or None for platform default)."""
+        if self._device is None:
+            return None
+        for d in jax.devices():
+            if d.platform == self._device:
+                return d
+        if self._device == "cpu":
+            return jax.devices("cpu")[0]
+        return None
+
+    # -- optimization toggles: XLA always fuses/plans; kept for parity --
     def enable_memory_optim(self):
-        pass
+        self._ir_optim = True
 
     def switch_ir_optim(self, flag=True):
-        pass  # XLA always optimizes
+        self._ir_optim = bool(flag)
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        self._num_threads = int(n)
 
 
 class Predictor:
     def __init__(self, config):
         if isinstance(config, str):
             config = Config(config)
+        self._config = config
         path = config.model_path
         if path.endswith(jit_api._JIT_SUFFIX):
             path = path[: -len(jit_api._JIT_SUFFIX)]
-        self._traced = jit_api.load(path)
-        self._traced._layer.eval()
-        self._inputs = []
+        dev = config.device()
+        if export_mod.exists(path):
+            self._model = StandaloneModel(path, device=dev)
+            self._traced = None
+            self._in_names = self._model.input_names()
+            self._out_names = self._model.output_names()
+        else:
+            self._model = None
+            self._traced = jit_api.load(path)
+            self._traced._layer.eval()
+            meta = getattr(self._traced, "_meta", None) or {}
+            n_in = len(meta.get("input_spec", [])) or 1
+            self._in_names = [f"x{i}" for i in range(n_in)]
+            self._out_names = ["out0"]
+        self._device = dev
+        self._inputs = {}
         self._outputs = None
 
+    # -- named IO handles (ref: GetInputHandle/GetOutputHandle) --
     def get_input_names(self):
-        return [f"x{i}" for i in range(max(len(self._inputs), 1))]
-
-    def get_input_handle(self, name):
-        return _Handle(self, name)
+        return list(self._in_names)
 
     def get_output_names(self):
-        return ["out0"]
+        return list(self._out_names)
+
+    def get_input_handle(self, name):
+        if name not in self._in_names:
+            raise KeyError(f"unknown input '{name}'; have {self._in_names}")
+        return _Handle(self, name)
 
     def get_output_handle(self, name):
-        return _OutHandle(self)
+        if name not in self._out_names:
+            raise KeyError(
+                f"unknown output '{name}'; have {self._out_names}")
+        return _OutHandle(self, self._out_names.index(name))
 
     def run(self, inputs=None):
         if inputs is not None:
-            self._inputs = [Tensor(np.asarray(x)) if not isinstance(x, Tensor)
-                            else x for x in inputs]
-        out = self._traced(*self._inputs)
-        self._outputs = out if isinstance(out, (list, tuple)) else [out]
+            self._inputs = {n: np.asarray(x.numpy() if isinstance(x, Tensor)
+                                          else x)
+                            for n, x in zip(self._in_names, inputs)}
+        ordered = [self._inputs[n] for n in self._in_names]
+        if self._model is not None:
+            outs = self._model(*ordered)
+            self._outputs = [np.asarray(o) for o in outs]
+        else:
+            args = [Tensor(jax.device_put(o, self._device)
+                           if self._device is not None else o)
+                    for o in ordered]
+            out = self._traced(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            self._outputs = [o.numpy() for o in outs]
         return self._outputs
 
 
@@ -73,21 +136,25 @@ class _Handle:
     def __init__(self, predictor, name):
         self.predictor = predictor
         self.name = name
+        self._shape = None
 
     def copy_from_cpu(self, arr):
-        self.predictor._inputs.append(Tensor(np.asarray(arr)))
+        arr = np.asarray(arr)
+        if self._shape is not None:
+            arr = arr.reshape(self._shape)
+        self.predictor._inputs[self.name] = arr
 
     def reshape(self, shape):
-        pass
+        self._shape = tuple(shape)
 
 
 class _OutHandle:
-    def __init__(self, predictor):
+    def __init__(self, predictor, index):
         self.predictor = predictor
+        self.index = index
 
     def copy_to_cpu(self):
-        out = self.predictor._outputs[0]
-        return out.numpy()
+        return self.predictor._outputs[self.index]
 
 
 def create_predictor(config):

@@ -1,0 +1,148 @@
+"""Standalone inference export: serialized StableHLO + named IO.
+
+TPU-native re-design of the reference's inference deployment surface
+(ref: paddle/fluid/inference/api/analysis_predictor.cc — serialized
+__model__ program + params, named input/output handles;
+python/paddle/static/io.py::save_inference_model).  The reference saves a
+protobuf ProgramDesc and replays IR passes at load; here the traced model
+is exported as **StableHLO bytes** via ``jax.export`` with parameters baked
+in, so the artifact is fully standalone: a fresh process needs no Python
+class, no pickle, no source — just this file pair:
+
+  <prefix>.stablehlo   serialized multi-platform (cpu+tpu) StableHLO
+  <prefix>.pdmeta      json: input/output names, shapes, dtypes
+
+This doubles as the interchange format the reference reaches via
+``paddle.onnx.export`` (see paddle_tpu/onnx/__init__.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..framework import core
+from ..tensor.tensor import Tensor
+
+META_SUFFIX = ".pdmeta"
+HLO_SUFFIX = ".stablehlo"
+
+
+def _input_avals(input_spec):
+    avals = []
+    for s in input_spec:
+        if isinstance(s, (tuple, list)):
+            shape, dtype = s
+        else:
+            shape, dtype = s.shape, s.dtype
+        shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                      else int(d) for d in shape)
+        avals.append(jax.ShapeDtypeStruct(shape, jnp.dtype(
+            core.convert_dtype(dtype))))
+    return avals
+
+
+def save_inference_model(path_prefix, layer_or_fn, input_spec,
+                         input_names=None, output_names=None,
+                         platforms=("cpu", "tpu")):
+    """Export ``layer_or_fn`` to a standalone artifact.
+
+    input_spec: list of InputSpec or (shape, dtype) pairs; None/-1 dims
+    become 1 (export is shape-specialized, like the reference's frozen
+    inference program).  Parameters are baked into the program as
+    constants.  Returns the meta dict.
+    """
+    from ..nn.layer.layers import Layer
+    from ..jit import functional as fx
+    from ..jit.api import TracedLayer
+
+    if isinstance(layer_or_fn, TracedLayer):
+        layer_or_fn = layer_or_fn._layer or layer_or_fn._fn
+
+    avals = _input_avals(input_spec)
+    rng = jax.random.PRNGKey(0)
+
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        was_training = layer.training
+        layer.eval()
+        pv, bv = fx.param_arrays(layer)
+
+        def pure(*arg_vals):
+            out, _ = fx.functional_call(layer, pv, bv, arg_vals,
+                                        rng_key=rng)
+            return out
+    else:
+        fn = layer_or_fn
+        was_training = None
+
+        def pure(*arg_vals):
+            with fx.trace_mode(rng):
+                args = [Tensor(a) for a in arg_vals]
+                out = fn(*args)
+            return jax.tree_util.tree_map(
+                lambda x: x.value if isinstance(x, Tensor) else x, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+    try:
+        exported = jax_export.export(jax.jit(pure),
+                                     platforms=list(platforms))(*avals)
+    finally:
+        if was_training:
+            layer.train()
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + HLO_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+
+    in_names = list(input_names or
+                    [f"x{i}" for i in range(len(avals))])
+    n_out = len(exported.out_avals)
+    out_names = list(output_names or [f"out{i}" for i in range(n_out)])
+    meta = {
+        "format": "stablehlo",
+        "platforms": list(platforms),
+        "inputs": [{"name": n, "shape": list(a.shape),
+                    "dtype": str(np.dtype(a.dtype))}
+                   for n, a in zip(in_names, avals)],
+        "outputs": [{"name": n, "shape": [int(d) for d in a.shape],
+                     "dtype": str(np.dtype(a.dtype))}
+                    for n, a in zip(out_names, exported.out_avals)],
+    }
+    with open(path_prefix + META_SUFFIX, "w") as f:
+        json.dump(meta, f, indent=1)
+    return meta
+
+
+class StandaloneModel:
+    """Loaded standalone artifact: call(*arrays) -> tuple of arrays."""
+
+    def __init__(self, path_prefix, device=None):
+        with open(path_prefix + HLO_SUFFIX, "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(path_prefix + META_SUFFIX) as f:
+            self.meta = json.load(f)
+        self._device = device
+        self._call = jax.jit(self._exported.call)
+
+    def input_names(self):
+        return [i["name"] for i in self.meta["inputs"]]
+
+    def output_names(self):
+        return [o["name"] for o in self.meta["outputs"]]
+
+    def __call__(self, *arrays):
+        arrays = [jnp.asarray(a) for a in arrays]
+        if self._device is not None:
+            arrays = [jax.device_put(a, self._device) for a in arrays]
+        out = self._call(*arrays)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+
+def exists(path_prefix):
+    return (os.path.exists(path_prefix + HLO_SUFFIX)
+            and os.path.exists(path_prefix + META_SUFFIX))

@@ -1,0 +1,26 @@
+"""paddle.onnx — model export for interchange.
+
+The reference exports to ONNX via paddle2onnx
+(ref: python/paddle/onnx/__init__.py::export).  The TPU-native interchange
+format is **StableHLO**: it is what XLA consumes directly, it round-trips
+through ``jax.export``, and it carries multi-platform (cpu+tpu) lowerings
+in one artifact.  ``paddle.onnx.export`` therefore emits the same
+standalone artifact as ``paddle.inference.save_inference_model`` —
+``<path>.stablehlo`` + ``<path>.pdmeta`` — loadable by
+``paddle.inference.Predictor`` (or raw ``jax.export.deserialize``) in a
+fresh process with no Python model class.
+"""
+from __future__ import annotations
+
+from ..inference.export import save_inference_model
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """Export ``layer`` to the standalone StableHLO artifact at ``path``.
+
+    Mirrors ref paddle.onnx.export(layer, path, input_spec, ...);
+    ``opset_version`` is accepted for API parity and ignored (StableHLO
+    versions itself).  Returns the artifact's meta manifest."""
+    if input_spec is None:
+        raise ValueError("input_spec is required to export a model")
+    return save_inference_model(path, layer, input_spec, **configs)
