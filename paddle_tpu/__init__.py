@@ -66,6 +66,7 @@ from . import version  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import distribution  # noqa: E402
 from . import onnx  # noqa: E402
+from . import reader  # noqa: E402
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
 from . import incubate  # noqa: E402

@@ -143,7 +143,9 @@ def load(path, **configs):
     with open(path + _PARAM_SUFFIX, "rb") as f:
         state = pickle.load(f)
     layer.set_state_dict({k: Tensor(v) for k, v in state.items()})
-    return TracedLayer(layer.forward, layer=layer)
+    traced = TracedLayer(layer.forward, layer=layer)
+    traced._meta = blob.get("meta", {})   # input_spec etc. for Predictor
+    return traced
 
 
 def enable_static():

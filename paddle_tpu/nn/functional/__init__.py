@@ -28,3 +28,8 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
                    npair_loss, dice_loss)
 from .attention import scaled_dot_product_attention, flash_attention
 from .extension import diag_embed, sequence_mask, temporal_shift
+from .sequence import (sequence_pad, sequence_unpad, sequence_pool,
+                       sequence_softmax, sequence_reverse, sequence_expand,
+                       sequence_concat, sequence_enumerate, sequence_erase,
+                       sequence_conv, sequence_first_step,
+                       sequence_last_step)

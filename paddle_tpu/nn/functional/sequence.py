@@ -1,0 +1,264 @@
+"""Sequence op family — padded+masked batch form.
+
+TPU-native re-design of the reference's LoD sequence operators
+(ref: paddle/fluid/operators/sequence_ops/ — 16 ops over LoDTensor's
+ragged level-of-detail layout).  LoD is hostile to XLA (dynamic shapes,
+per-row offsets), so every op here takes the regular-layout equivalent —
+a padded ``[B, T, ...]`` tensor plus a ``lengths [B]`` vector — and masks.
+Static shapes throughout: everything jits, vmaps, and differentiates.
+
+The flat<->padded bridge (``sequence_pad``/``sequence_unpad``) converts
+the reference's concatenated-rows layout at the boundary.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+
+
+def _mask(lengths, T, dtype=jnp.float32):
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_pad(x, lengths, pad_value=0.0, maxlen=None, name=None):
+    """Flat concatenated rows -> padded batch.
+
+    x: [sum(lengths), ...] (the reference's LoDTensor data layout);
+    lengths: [B].  Returns [B, maxlen, ...] (ref sequence_pad_op.cc).
+    maxlen must be static (defaults to max(lengths) evaluated eagerly)."""
+    import numpy as np
+    from ...tensor.tensor import Tensor
+    lv = lengths.value if isinstance(lengths, Tensor) else jnp.asarray(
+        lengths)
+    T = int(maxlen) if maxlen is not None else int(np.asarray(lv).max())
+
+    def _pad(flat, lens):
+        B = lens.shape[0]
+        starts = jnp.cumsum(lens) - lens
+        idx = starts[:, None] + jnp.arange(T)[None, :]          # [B, T]
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        out = flat[idx]                                          # [B,T,...]
+        vshape = valid.shape + (1,) * (out.ndim - 2)
+        return jnp.where(valid.reshape(vshape), out, pad_value)
+
+    return call(_pad, x, lengths, _name="sequence_pad")
+
+
+def sequence_unpad(x, lengths, name=None):
+    """Padded batch -> flat concatenated rows (ref sequence_unpad_op.cc).
+    Output keeps the padded total length (static shape); entries beyond
+    sum(lengths) are zeros — slice with sum(lengths) host-side if the
+    exact flat size is needed."""
+    def _unpad(padded, lens):
+        B, T = padded.shape[:2]
+        starts = jnp.cumsum(lens) - lens
+        pos = starts[:, None] + jnp.arange(T)[None, :]
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+        flat_idx = jnp.where(valid, pos, B * T - 1).reshape(-1)
+        src = padded.reshape((B * T,) + padded.shape[2:])
+        out = jnp.zeros_like(src)
+        vals = jnp.where(valid.reshape((B * T,) + (1,) * (src.ndim - 1)),
+                         src, 0)
+        return out.at[flat_idx].add(vals)
+
+    return call(_unpad, x, lengths, _name="sequence_unpad")
+
+
+def sequence_pool(x, lengths, pool_type="sum", pad_value=0.0, name=None):
+    """Masked pooling over time (ref sequence_pool_op.cc: sum / average /
+    sqrt / max / last / first).  x: [B, T, ...]; lengths: [B]."""
+    pool_type = pool_type.lower()
+
+    def _pool(padded, lens):
+        T = padded.shape[1]
+        m = _mask(lens, T, padded.dtype)
+        mshape = m.shape + (1,) * (padded.ndim - 2)
+        mm = m.reshape(mshape)
+        if pool_type == "sum":
+            return jnp.sum(padded * mm, axis=1)
+        if pool_type in ("average", "mean", "avg"):
+            denom = jnp.maximum(lens.astype(padded.dtype), 1).reshape(
+                (-1,) + (1,) * (padded.ndim - 2))
+            return jnp.sum(padded * mm, axis=1) / denom
+        if pool_type == "sqrt":
+            denom = jnp.sqrt(jnp.maximum(
+                lens.astype(padded.dtype), 1)).reshape(
+                (-1,) + (1,) * (padded.ndim - 2))
+            return jnp.sum(padded * mm, axis=1) / denom
+        if pool_type == "max":
+            neg = jnp.asarray(jnp.finfo(padded.dtype).min, padded.dtype)
+            return jnp.max(jnp.where(mm > 0, padded, neg), axis=1)
+        if pool_type == "first":
+            return padded[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(lens - 1, 0)
+            return jnp.take_along_axis(
+                padded, idx.reshape((-1, 1) + (1,) * (padded.ndim - 2)),
+                axis=1)[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return call(_pool, x, lengths, _name=f"sequence_pool_{pool_type}")
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Masked softmax over the time axis (ref sequence_softmax_op.cc).
+    x: [B, T] or [B, T, ...]."""
+    def _sm(padded, lens):
+        T = padded.shape[1]
+        valid = (_mask(lens, T, jnp.float32) > 0)
+        vshape = valid.shape + (1,) * (padded.ndim - 2)
+        v = valid.reshape(vshape)
+        logits = jnp.where(v, padded.astype(jnp.float32), -jnp.inf)
+        out = jax.nn.softmax(logits, axis=1)
+        return jnp.where(v, out, 0.0).astype(padded.dtype)
+
+    return call(_sm, x, lengths, _name="sequence_softmax")
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each row's valid prefix, padding stays in place
+    (ref sequence_reverse_op.h)."""
+    def _rev(padded, lens):
+        T = padded.shape[1]
+        t = jnp.arange(T)[None, :]
+        src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+        return jnp.take_along_axis(
+            padded, src.reshape(src.shape + (1,) * (padded.ndim - 2)),
+            axis=1)
+
+    return call(_rev, x, lengths, _name="sequence_reverse")
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Repeat row i of x ref_lengths[i] times into a padded layout
+    (ref sequence_expand_op.cc with x of one step per sequence):
+    returns [B, max(ref_lengths), ...] where row i holds x[i] repeated."""
+    # padded semantics: broadcast each row over time, mask by lengths
+    import numpy as np
+    from ...tensor.tensor import Tensor
+    lv = ref_lengths.value if isinstance(ref_lengths, Tensor) \
+        else jnp.asarray(ref_lengths)
+    T = int(np.asarray(lv).max())
+
+    def _expand(xv, lens):
+        out = jnp.broadcast_to(
+            xv[:, None], (xv.shape[0], T) + xv.shape[1:])
+        m = _mask(lens, T, xv.dtype).reshape(
+            (xv.shape[0], T) + (1,) * (xv.ndim - 1))
+        return out * m
+
+    return call(_expand, x, ref_lengths, _name="sequence_expand")
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concatenate per-sample sequences from several padded batches
+    (ref sequence_concat_op.cc): result row i = concat of every input's
+    valid prefix for sample i.  Returns (padded, lengths)."""
+    import numpy as np
+    from ...tensor.tensor import Tensor
+
+    lvs = [l.value if isinstance(l, Tensor) else jnp.asarray(l)
+           for l in lengths_list]
+    T_out = int(sum(int(np.asarray(l).max()) for l in lvs))
+
+    def _concat(*vals):
+        n = len(vals) // 2
+        padded, lens = vals[:n], vals[n:]
+        B = padded[0].shape[0]
+        feat = padded[0].shape[2:]
+        out = jnp.zeros((B, T_out) + feat, padded[0].dtype)
+        offset = jnp.zeros((B,), jnp.int32)
+        for p, l in zip(padded, lens):
+            T = p.shape[1]
+            t = jnp.arange(T)[None, :]
+            valid = t < l[:, None]
+            dest = offset[:, None] + t                      # [B, T]
+            dest = jnp.where(valid, dest, T_out - 1)
+            rows = jnp.broadcast_to(jnp.arange(B)[:, None], dest.shape)
+            vals_m = jnp.where(
+                valid.reshape(valid.shape + (1,) * len(feat)), p, 0)
+            out = out.at[rows.reshape(-1), dest.reshape(-1)].add(
+                vals_m.reshape((-1,) + feat))
+            offset = offset + l.astype(jnp.int32)
+        return out, offset
+
+    flat = list(xs) + list(lengths_list)
+    return call(_concat, *flat, _name="sequence_concat")
+
+
+def sequence_enumerate(x, win_size, pad_value=0, name=None):
+    """Sliding windows of ids (ref sequence_enumerate_op.cc).
+    x: [B, T] int -> [B, T, win_size]; positions past T fill pad_value
+    (row-length masking is the caller's lengths mask)."""
+    def _enum(ids):
+        B, T = ids.shape
+        t = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+        valid = t < T
+        t = jnp.clip(t, 0, T - 1)
+        out = ids[:, t]                                     # [B, T, W]
+        return jnp.where(valid[None], out, pad_value)
+
+    return call(_enum, x, _name="sequence_enumerate")
+
+
+def sequence_erase(x, lengths, tokens, pad_value=0, name=None):
+    """Remove listed token ids, compacting each row's valid prefix
+    (ref sequence_erase_op.cc).  Returns (compacted [B,T], new_lengths)."""
+    tokens = tuple(int(t) for t in tokens)
+
+    def _erase(ids, lens):
+        B, T = ids.shape
+        t = jnp.arange(T)[None, :]
+        valid = t < lens[:, None]
+        keep = valid
+        for tok in tokens:
+            keep = keep & (ids != tok)
+        # stable compaction: sort by (dropped, position)
+        key = jnp.where(keep, t, T + t)
+        order = jnp.argsort(key, axis=1)
+        compacted = jnp.take_along_axis(ids, order, axis=1)
+        new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+        still = t < new_len[:, None]
+        return jnp.where(still, compacted, pad_value), new_len
+
+    return call(_erase, x, lengths, _name="sequence_erase")
+
+
+def sequence_conv(x, lengths, weight, context_size=3, context_start=None,
+                  name=None):
+    """Context-window convolution over time (ref sequence_conv_op.cc):
+    each step concatenates its context window (zero past row length) and
+    multiplies by ``weight [context_size*H, F]``."""
+    if context_start is None:
+        context_start = -((context_size - 1) // 2)
+
+    def _conv(padded, lens, w):
+        B, T, H = padded.shape
+        t = jnp.arange(T)[None, :]
+        valid = t < lens[:, None]
+        cols = []
+        for k in range(context_size):
+            shift = context_start + k
+            src = t + shift
+            ok = valid & (src >= 0) & (src < lens[:, None])
+            g = jnp.take_along_axis(
+                padded, jnp.clip(src, 0, T - 1)[..., None], axis=1)
+            cols.append(jnp.where(ok[..., None], g, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)        # [B, T, ctx*H]
+        out = ctx @ w                               # MXU matmul
+        return jnp.where(valid[..., None], out, 0.0)
+
+    return call(_conv, x, lengths, weight, _name="sequence_conv")
+
+
+def sequence_first_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "last")

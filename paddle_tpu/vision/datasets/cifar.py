@@ -1,10 +1,41 @@
-"""Cifar10/100 (ref: python/paddle/vision/datasets/cifar.py) — synthetic
-surrogate with reference schema (32x32x3 -> transform, int label)."""
+"""Cifar10/100 (ref: python/paddle/vision/datasets/cifar.py).
+
+Parses the real tar.gz batch archives (pickled dicts of Nx3072 uint8 rows,
+the reference's on-disk format) when ``data_file`` exists; in this
+zero-egress environment, absent files fall back to a deterministic
+learnable synthetic surrogate with the exact reference schema."""
 from __future__ import annotations
+
+import os
+import pickle
+import tarfile
 
 import numpy as np
 
 from ...io.dataset import Dataset
+
+
+def _parse_cifar_archive(path, mode):
+    """tar.gz of pickled batches -> (images [N,32,32,3] u8, labels [N]).
+    Cifar100 archives carry fine_labels; plain 'labels' otherwise."""
+    images, labels = [], []
+    with tarfile.open(path, "r:*") as tf:
+        for member in sorted(tf.getnames()):
+            base = os.path.basename(member)
+            is_train = base.startswith("data_batch") or base == "train"
+            is_test = base.startswith("test_batch") or base == "test"
+            if not ((mode == "train" and is_train)
+                    or (mode != "train" and is_test)):
+                continue
+            with tf.extractfile(member) as f:
+                d = pickle.load(f, encoding="bytes")
+            data = np.asarray(d[b"data"], np.uint8)
+            images.append(data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            key = b"fine_labels" if b"fine_labels" in d else b"labels"
+            labels.append(np.asarray(d[key], np.int64))
+    if not images:
+        raise ValueError(f"no {mode} batches found in {path}")
+    return np.concatenate(images), np.concatenate(labels)
 
 
 class Cifar10(Dataset):
@@ -14,6 +45,10 @@ class Cifar10(Dataset):
                  download=True, backend="numpy"):
         self.mode = mode
         self.transform = transform
+        if data_file is not None and os.path.exists(data_file):
+            self.images, self.labels = _parse_cifar_archive(
+                data_file, mode)
+            return
         n = 2048 if mode == "train" else 256
         rng = np.random.RandomState(7 if mode == "train" else 8)
         self.labels = rng.randint(0, self.n_classes, n).astype(np.int64)
