@@ -7,6 +7,13 @@ rides jax.sharding over TPU meshes.  API mirrors the reference
 """
 __version__ = "0.1.0"
 
+# Multi-host bootstrap must beat any XLA backend touch, and importing this
+# package initializes backends — so when the launcher env is present
+# (distributed/launch.py sets it), connect the jax.distributed coordinator
+# here, first thing (ref: the launcher's init_nccl-before-anything rule).
+from ._dist_bootstrap import maybe_init_distributed as _mid
+_mid()
+
 import jax.numpy as jnp
 
 from .framework import core as _core

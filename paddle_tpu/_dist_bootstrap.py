@@ -1,0 +1,42 @@
+"""Multi-host coordinator bootstrap — the ONE place that calls
+jax.distributed.initialize.
+
+Lives at the package top level (NOT under framework/) because importing
+``framework.core`` constructs a PRNG key at module scope, which would
+initialize the XLA backend before initialize could run.  Both entry
+points route here: ``paddle_tpu/__init__`` (fires when the launcher env
+is present, before the package touches jax) and
+``distributed.parallel.init_parallel_env`` (direct callers).
+"""
+from __future__ import annotations
+
+import os
+
+_done = [False]
+
+
+def maybe_init_distributed():
+    """Connect to the coordinator iff the launcher env asks for it.
+    Idempotent.  Raises with an actionable message if called after XLA
+    backends were already initialized."""
+    if _done[0]:
+        return
+    _done[0] = True
+    master = os.environ.get("PADDLE_MASTER")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if not master or nprocs <= 1:
+        return
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=master,
+            num_processes=nprocs,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    except RuntimeError as e:
+        raise RuntimeError(
+            "paddle_tpu multi-host bootstrap failed: jax.distributed."
+            "initialize must run before any XLA backend use.  Launch "
+            "through `python -m paddle_tpu.distributed.launch` (which "
+            "re-execs the script into a clean interpreter), or set "
+            "PADDLE_MASTER/PADDLE_TRAINERS_NUM/PADDLE_TRAINER_ID before "
+            "importing paddle_tpu.") from e

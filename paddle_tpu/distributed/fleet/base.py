@@ -157,6 +157,14 @@ class Fleet:
 
     def distributed_optimizer(self, optimizer, strategy=None):
         optimizer._is_fleet_distributed = True
+        strategy = strategy or self._strategy
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            from ...optimizer.gradient_merge import GradientMergeOptimizer
+            cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                avg=cfg.get("avg", True))
         return optimizer
 
     def state_dict(self):

@@ -1,39 +1,107 @@
-"""paddle.distributed.launch (ref: python/paddle/distributed/launch.py).
+"""paddle.distributed.launch (ref: python/paddle/distributed/launch/).
 
 Single-controller SPMD: on TPU pods each HOST runs one process of the same
-script; this launcher sets the coordinator env and execs the training script
-once per host (the per-device process fan-out of the reference does not
-apply — XLA drives all local chips from one process).
+script — XLA drives all local chips from one process, so the per-GPU
+process fan-out of the reference maps to a per-host fan-out here.  The
+launcher manages those processes for local testing (``--nproc-per-node``),
+wires the coordinator env (``PADDLE_MASTER`` → jax.distributed.initialize
+in init_parallel_env), waits on children, and tears the group down on the
+first failure like the reference's elastic launcher.
 """
 from __future__ import annotations
 
 import argparse
 import os
-import runpy
+import signal
+import subprocess
 import sys
+import time
 
 
-def main():
+def build_env(rank, nranks, master, base=None):
+    env = dict(base if base is not None else os.environ)
+    if master:
+        env["PADDLE_MASTER"] = master
+    env["PADDLE_TRAINERS_NUM"] = str(nranks)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    return env
+
+
+def _free_local_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_procs(script_argv, nprocs, master, env_base=None, rank_base=0,
+                 nranks=None):
+    """Spawn nprocs copies of the script with per-rank env (global ranks
+    rank_base..rank_base+nprocs-1 of nranks total); wait; kill the group
+    on the first failure.  Returns the first nonzero exit code (0 if all
+    succeeded).  With several local workers and no master given, a free
+    local coordinator port is picked so the group really synchronizes
+    (unsynced same-host replicas would silently train divergent models)."""
+    nranks = nranks if nranks is not None else nprocs
+    if master is None and nranks > 1:
+        master = f"127.0.0.1:{_free_local_port()}"
+    procs = []
+    for i in range(nprocs):
+        env = build_env(rank_base + i, nranks, master, env_base)
+        procs.append(subprocess.Popen(
+            [sys.executable] + script_argv, env=env))
+    rc = 0
+    try:
+        remaining = set(range(nprocs))
+        while remaining:
+            for i in list(remaining):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                remaining.discard(i)
+                if r != 0 and rc == 0:
+                    rc = r
+                    for j in remaining:
+                        procs[j].send_signal(signal.SIGTERM)
+            if remaining:
+                time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def main(argv=None):
     parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     parser.add_argument("--master", default=None,
                         help="coordinator address host:port")
     parser.add_argument("--nnodes", type=int, default=1)
-    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--rank", type=int, default=0,
+                        help="this node's rank")
+    parser.add_argument("--nproc-per-node", "--nproc_per_node", type=int,
+                        default=1, dest="nproc_per_node",
+                        help="local process fan-out (testing; on TPU one "
+                             "process per host drives every chip)")
     parser.add_argument("--gpus", default=None, help="ignored on TPU")
     parser.add_argument("--devices", default=None)
     parser.add_argument("script", nargs=argparse.REMAINDER)
-    args = parser.parse_args()
-
-    if args.master:
-        os.environ["PADDLE_MASTER"] = args.master
-    os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
-    os.environ["PADDLE_TRAINER_ID"] = str(args.rank)
+    args = parser.parse_args(argv)
 
     if not args.script:
         parser.error("no training script given")
-    script = args.script[0]
-    sys.argv = args.script
-    runpy.run_path(script, run_name="__main__")
+    if args.nnodes > 1 and not args.master:
+        parser.error("--master host:port is required when --nnodes > 1")
+
+    # Always RE-EXEC into fresh interpreters: this launcher process has
+    # already imported paddle_tpu (and with it the XLA backend), so the
+    # coordinator bootstrap can only fire in a clean child where the env
+    # is set before `import paddle_tpu`.
+    npp = max(args.nproc_per_node, 1)
+    sys.exit(launch_procs(
+        args.script, npp, args.master,
+        rank_base=args.rank * npp,
+        nranks=args.nnodes * npp))
 
 
 if __name__ == "__main__":

@@ -45,18 +45,16 @@ _initialized = False
 
 def init_parallel_env():
     """Initialize SPMD environment.  For multi-host pods set
-    PADDLE_MASTER/PADDLE_TRAINERS_NUM and this calls
-    jax.distributed.initialize; single host is a no-op beyond env setup."""
+    PADDLE_MASTER/PADDLE_TRAINERS_NUM (the launcher does) and the shared
+    bootstrap connects jax.distributed; single host is a no-op beyond env
+    setup.  The bootstrap normally already fired at ``import paddle_tpu``
+    — this call covers direct users who set the env afterwards (it must
+    then run before any other jax use, or it raises with guidance)."""
     global _env, _initialized
     if _initialized:
         return _env
-    master = os.environ.get("PADDLE_MASTER")
-    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if master and nprocs > 1 and jax.process_count() == 1:
-        jax.distributed.initialize(
-            coordinator_address=master,
-            num_processes=nprocs,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    from .._dist_bootstrap import maybe_init_distributed
+    maybe_init_distributed()
     _env = ParallelEnv()
     _initialized = True
     return _env
@@ -83,10 +81,57 @@ def parallel_helper_env():
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """ref: python/paddle/distributed/spawn.py.  Under the SPMD model the
-    single controller already drives every chip, so spawn degenerates to one
-    invocation (parity shim for scripts written against the proc-per-GPU
-    model)."""
+    """ref: python/paddle/distributed/spawn.py.
+
+    Under the SPMD model one controller already drives every local chip,
+    so ``nprocs in (-1, 0, 1)`` runs ``func`` in-process (the TPU-correct
+    mode).  ``nprocs > 1`` really forks worker processes (multiprocessing
+    'spawn' context, rank in PADDLE_TRAINER_ID) for scripts written
+    against the reference's proc-per-device model — intended for CPU
+    testing; on real TPU hosts multiple processes cannot share the chip.
+    """
+    if nprocs is None or nprocs <= 1:
+        init_parallel_env()
+        return func(*args)
+
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    procs = []
+    # children must see their per-rank env AT IMPORT (the package-level
+    # coordinator bootstrap fires then); workers are local-only, so the
+    # parent's coordinator env must not leak into them
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_MASTER", "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM")}
+    try:
+        os.environ.pop("PADDLE_MASTER", None)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        for rank in range(nprocs):
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            p = ctx.Process(target=_spawn_worker,
+                            args=(func, args, rank, nprocs), daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not join:
+        return procs
+    failed = []
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            failed.append(p.exitcode)
+    if failed:
+        raise RuntimeError(f"spawn: {len(failed)} worker(s) failed with "
+                           f"exit codes {failed}")
+    return None
+
+
+def _spawn_worker(func, args, rank, nprocs):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
     init_parallel_env()
-    result = func(*args)
-    return result
+    func(*args)

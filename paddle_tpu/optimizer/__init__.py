@@ -2,4 +2,5 @@
 from .optimizer import Optimizer
 from .optimizers import (SGD, Momentum, Adagrad, Adadelta, Adam, AdamW,
                          Adamax, RMSProp, Lamb)
+from .gradient_merge import GradientMergeOptimizer
 from . import lr

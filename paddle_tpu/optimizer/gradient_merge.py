@@ -1,0 +1,65 @@
+"""Gradient merge (accumulation) meta-optimizer.
+
+TPU-native form of the reference's gradient-merge meta-optimizer
+(ref: python/paddle/distributed/fleet/meta_optimizers/
+gradient_merge_optimizer.py — it rewrites the static program to gate the
+optimizer block behind a step-mod counter).  Here it wraps any eager
+optimizer: every ``step()`` folds the current grads into on-device
+accumulators and zeroes them; each ``k_steps``-th call applies the inner
+optimizer on the (averaged) accumulated grads.  All accumulation is device
+arithmetic — no host sync per micro-step.
+"""
+from __future__ import annotations
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = bool(avg)
+        self._acc = {}          # id(param) -> accumulated grad value
+        self._micro = 0
+
+    @property
+    def _parameters(self):
+        return self._inner._parameters
+
+    def step(self):
+        self._micro += 1
+        boundary = (self._micro % self._k) == 0
+        for p in self._inner._parameters:
+            if p is None or p._grad is None:
+                continue
+            g = p._grad         # raw device value (Tensor._grad slot)
+            acc = self._acc.get(id(p))
+            self._acc[id(p)] = g if acc is None else acc + g
+            p._grad = None      # micro-step grads never reach the inner opt
+        if not boundary:
+            return
+        scale = 1.0 / self._k if self._avg else 1.0
+        for p in self._inner._parameters:
+            acc = self._acc.pop(id(p), None)
+            if acc is not None:
+                p._grad = acc * scale
+        self._inner.step()
+        for p in self._inner._parameters:
+            p._grad = None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._inner._parameters:
+            if p is not None:
+                p._grad = None
+
+    def minimize(self, loss, **kwargs):
+        from ..static.graph import in_static_mode
+        if in_static_mode():
+            # static programs register train_spec through the inner
+            # optimizer (Executor owns the step loop there; feed k_steps
+            # micro-batches per logical step for the same effect)
+            return self._inner.minimize(loss, **kwargs)
+        loss.backward()
+        self.step()
+
+    # delegate the rest of the optimizer surface
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -10,16 +10,51 @@ from ..jit.api import InputSpec
 
 
 class ParallelExecutor(Executor):
-    """ref: fluid/parallel_executor.py — data-parallel execution is expressed
-    with shardings under XLA; API kept for compatibility."""
+    """ref: fluid/parallel_executor.py — the reference replicates the
+    program per device and all-reduces grads over NCCL; here data
+    parallelism is a sharding decision: feeds are placed batch-sharded
+    over a 'dp' mesh (params replicated) and GSPMD inserts the gradient
+    all-reduce inside the same jitted step."""
 
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  build_strategy=None, exec_strategy=None, scope=None,
-                 share_vars_from=None, num_trainers=1, trainer_id=0):
+                 share_vars_from=None, num_trainers=1, trainer_id=0,
+                 places=None):
         super().__init__()
         self._main_program = main_program
+        import jax
+        devices = places if isinstance(places, (list, tuple)) and places \
+            and not isinstance(places[0], str) else None
+        devices = devices or jax.devices()
+        if len(devices) > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            import numpy as _np
+            self._mesh = Mesh(_np.asarray(devices), axis_names=("dp",))
+            self._feed_sharding = NamedSharding(self._mesh,
+                                                PartitionSpec("dp"))
+            self._rep_sharding = NamedSharding(self._mesh, PartitionSpec())
+        else:
+            self._mesh = None
+            self._feed_sharding = None
+            self._rep_sharding = None
+
+    def _place_feed(self, v):
+        import jax
+        if self._feed_sharding is None or v.ndim == 0 \
+                or v.shape[0] % self._mesh.size:
+            return v
+        return jax.device_put(v, self._feed_sharding)
+
+    def _place_param(self, v):
+        import jax
+        if self._rep_sharding is None:
+            return v
+        return jax.device_put(v, self._rep_sharding)
 
     def run(self, fetch_list=None, feed=None, program=None, **kwargs):
+        if isinstance(fetch_list, Program):
+            # Executor-style positional call run(program, feed, fetch_list)
+            program, fetch_list = fetch_list, program
         return super().run(program or self._main_program, feed, fetch_list,
                            **kwargs)
 

@@ -62,6 +62,7 @@ class Program:
         self.feed_ids = {}      # name -> var_id
         self.params = {}        # var_id -> Parameter
         self.var_meta = {}      # var_id -> (shape, dtype)
+        self.captured = {}      # var_id -> Tensor (buffers/eager captures)
         self.train_spec = None  # (loss_var_id, optimizer)
         self.fetch_cache = {}
         self.random_seed = None
@@ -76,6 +77,7 @@ class Program:
         p.feed_ids = dict(self.feed_ids)
         p.params = dict(self.params)
         p.var_meta = dict(self.var_meta)
+        p.captured = dict(self.captured)
         if not for_test:
             p.train_spec = self.train_spec
         return p
@@ -91,12 +93,27 @@ class Program:
         return list(self.var_meta.keys())
 
     def replay(self, env):
-        """env: var_id -> concrete/traced value.  Mutates env with outputs."""
+        """env: var_id -> concrete/traced value.  Mutates env with outputs.
+        Var-ids absent from env (layer BUFFERS like BN running stats, or
+        eager tensors captured at build) resolve to their current value
+        via the weakref registry — they ride into the program as
+        constants, matching the reference's persistable-non-param vars."""
         for op in self.ops:
             leaves = []
             for kind, ref in op.leaf_specs:
                 if kind == "var":
-                    leaves.append(env[ref])
+                    if ref in env:
+                        leaves.append(env[ref])
+                    elif ref in self.captured:
+                        leaves.append(self.captured[ref].value)
+                    else:
+                        wr = _var_tensors.get(ref)
+                        t = wr() if wr is not None else None
+                        if t is None:
+                            raise KeyError(
+                                f"program replay: var id {ref} is neither "
+                                "in the env nor alive as a build tensor")
+                        leaves.append(t.value)
                 else:
                     leaves.append(ref)
             args, kwargs = jax.tree_util.tree_unflatten(op.treedef, leaves)
@@ -172,7 +189,13 @@ def record_call(fn, leaves, treedef, out_tensors, name):
     specs = []
     for l in leaves:
         if isinstance(l, Tensor):
-            specs.append(("var", _ensure_var_id(l, prog)))
+            vid = _ensure_var_id(l, prog)
+            if vid not in _live_var_ids:
+                # external capture (layer buffer, eager tensor): keep it
+                # alive so replay can read its value after the builder's
+                # locals are gone
+                prog.captured[vid] = l
+            specs.append(("var", vid))
         else:
             specs.append(("const", l))
     out_ids = [_ensure_var_id(t, prog) for t in out_tensors]
@@ -202,6 +225,13 @@ class Executor:
         self.place = place
         self._cache = {}
 
+    # placement hooks — ParallelExecutor shards feeds over its dp mesh
+    def _place_feed(self, v):
+        return v
+
+    def _place_param(self, v):
+        return v
+
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
         program = program or default_main_program()
@@ -225,11 +255,11 @@ class Executor:
                 v = v.value
             else:
                 v = jnp.asarray(np.asarray(v))
-            feed_vals.append(v)
+            feed_vals.append(self._place_feed(v))
 
         param_ids = sorted(program.params.keys())
         params = [program.params[i] for i in param_ids]
-        param_vals = [p.value for p in params]
+        param_vals = [self._place_param(p.value) for p in params]
 
         key = (id(program), tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),

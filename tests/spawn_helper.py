@@ -1,0 +1,10 @@
+"""Module-level worker for paddle.distributed.spawn tests (multiprocessing
+'spawn' pickles the target by qualified name, so it must live in an
+importable module, not a test function body)."""
+import os
+
+
+def write_rank(out_dir):
+    rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+    with open(os.path.join(out_dir, f"rank_{rank}.txt"), "w") as f:
+        f.write(rank)

@@ -1,0 +1,65 @@
+"""ResNet50 through the static data-parallel path (BASELINE.json
+configs[1]; VERDICT r2 item 10): builds the real examples/ program on the
+8-device mesh, one step decreases loss, feeds verifiably batch-sharded."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+def test_resnet50_static_dp_one_step_decreases_loss():
+    from resnet50_static_dp import build_program
+
+    paddle.enable_static()
+    try:
+        main_prog, startup, loss = build_program(image_size=32,
+                                                 num_classes=10, lr=1e-3)
+        exe = static.ParallelExecutor(main_program=main_prog)
+        assert exe._mesh is not None and exe._mesh.size == 8
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 3, 32, 32).astype(np.float32)
+        y = rng.randint(0, 10, (16, 1)).astype(np.int64)
+        losses = []
+        for _ in range(3):
+            lv, = exe.run(feed={"image": x, "label": y},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+    finally:
+        paddle.disable_static()
+
+
+def test_parallel_executor_positional_run_keeps_fetches():
+    """run(program, feed, fetch_list) Executor-style must not drop the
+    fetch list."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 2], "float32")
+            y = x * 2.0
+        exe = static.ParallelExecutor()
+        r, = exe.run(main, {"x": np.ones((4, 2), np.float32)}, [y])
+        np.testing.assert_allclose(r, 2 * np.ones((4, 2)))
+    finally:
+        paddle.disable_static()
+
+
+def test_parallel_executor_shards_feeds():
+    import jax
+    exe = static.ParallelExecutor()
+    v = jax.numpy.ones((16, 4))
+    placed = exe._place_feed(v)
+    assert len(placed.sharding.device_set) == 8
+    # non-divisible batch falls back to replication, not a crash
+    odd = jax.numpy.ones((15, 4))
+    assert exe._place_feed(odd) is odd
