@@ -74,6 +74,7 @@ from . import regularizer  # noqa: E402
 from . import distribution  # noqa: E402
 from . import onnx  # noqa: E402
 from . import reader  # noqa: E402
+from . import quantization  # noqa: E402
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
 from . import incubate  # noqa: E402

@@ -1,0 +1,280 @@
+"""Quantization: QAT fake-quant training + post-training quantization.
+
+TPU-native re-design of the reference's slim quantization stack
+(ref: python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py:123 PostTrainingQuantization,
+quantization_pass.py QuantizationTransformPass, imperative/qat.py).  The
+reference rewrites program graphs to insert fake_quantize ops and emits
+cuDNN/MKL-DNN int8 kernels; here:
+
+  * fake quantization is a pure function with a straight-through
+    estimator (``jax.custom_vjp`` identity gradient) — it fuses into the
+    surrounding XLA program;
+  * QAT wraps Linear/Conv2D layers so weights (per-channel absmax) and
+    activations (EMA absmax observers) train against quantization noise;
+  * deployment runs REAL int8 matmuls on the MXU
+    (``lax.dot_general(int8, int8) -> int32`` then rescale), which is
+    where TPU int8 throughput comes from;
+  * PostTrainingQuantization calibrates observers on sample data without
+    training, then converts.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..ops.dispatch import call
+from .. import nn
+
+__all__ = ["fake_quantize", "quant_absmax_scale", "int8_matmul",
+           "QuantConfig", "QAT", "PostTrainingQuantization",
+           "QuantedLinear"]
+
+
+# --------------------------------------------------------------------------
+# functional core
+# --------------------------------------------------------------------------
+
+def quant_absmax_scale(x, axis=None, bits=8):
+    """absmax scale so x/scale fits [-qmax, qmax] (per-tensor, or
+    per-channel when axis given)."""
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    qmax = 2.0 ** (bits - 1) - 1
+    if axis is None:
+        s = jnp.max(jnp.abs(v)) / qmax
+    else:
+        red = tuple(i for i in range(v.ndim) if i != axis)
+        s = jnp.max(jnp.abs(v), axis=red, keepdims=False) / qmax
+    return jnp.maximum(s, 1e-8)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, bits):
+    return _fake_quant(x, scale, bits), (x, scale)
+
+
+def _fq_bwd(bits, res, g):
+    # straight-through: pass gradients only where x was inside the clip
+    # range (standard QAT STE; the scale gets no gradient — observers own
+    # it, matching the reference's moving-average absmax quantizers)
+    x, scale = res
+    qmax = 2.0 ** (bits - 1) - 1
+    inside = (jnp.abs(x / scale) <= qmax).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quantize(x, scale, bits=8, name=None):
+    """Quantize-dequantize with STE gradient.  scale: scalar or
+    per-channel (broadcastable against x)."""
+    return call(lambda xv, sv: _fake_quant(xv, sv, bits), x, scale,
+                _name="fake_quantize")
+
+
+def int8_matmul(x, w_int8, x_scale, w_scale, name=None):
+    """Real int8 GEMM: quantize x per-tensor, int8xint8->int32 on the MXU,
+    rescale to float.  w_int8: [in, out] int8; w_scale: [out] or scalar."""
+    def _mm(xv, wv, xs, ws):
+        xq = jnp.clip(jnp.round(xv / xs), -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, wv, (((xv.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return acc.astype(jnp.float32) * (xs * ws)
+
+    return call(_mm, x, w_int8, x_scale, w_scale, _name="int8_matmul")
+
+
+# --------------------------------------------------------------------------
+# observers + QAT layer wrappers
+# --------------------------------------------------------------------------
+
+class AbsmaxObserver:
+    """EMA absmax activation observer (ref imperative/qat.py moving-average
+    quantizer)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        self.bits = bits
+        self.momentum = momentum
+        self.scale = None
+
+    def observe(self, x):
+        # EMA stays a device scalar: no host sync in the training hot path
+        s = quant_absmax_scale(x, bits=self.bits)
+        if self.scale is None:
+            self.scale = s
+        else:
+            self.scale = self.momentum * self.scale \
+                + (1 - self.momentum) * s
+        return self.scale
+
+
+class QuantConfig:
+    """Which layers to quantize and how (ref PostTrainingQuantization's
+    quantizable_op_type / weight_bits / activation_bits)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_layer_type=("Linear", "Conv2D"),
+                 activation_momentum=0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.quantizable_layer_type = tuple(quantizable_layer_type)
+        self.activation_momentum = activation_momentum
+
+
+class _QATWrapper(nn.Layer):
+    """Fake-quant both the weight (per-output-channel) and the input
+    activation (EMA per-tensor) around the wrapped layer's forward."""
+
+    def __init__(self, layer, config: QuantConfig):
+        super().__init__()
+        self.inner = layer
+        self._cfg = config
+        self._obs = AbsmaxObserver(config.activation_bits,
+                                   config.activation_momentum)
+
+    def forward(self, x):
+        cfg = self._cfg
+        a_scale = self._obs.observe(x.value if isinstance(x, Tensor)
+                                    else x)
+        x = fake_quantize(x, Tensor(jnp.asarray(a_scale, jnp.float32)),
+                          bits=cfg.activation_bits)
+        w = self.inner.weight
+        axis = w.ndim - 1 if type(self.inner).__name__ == "Linear" else 0
+        w_scale = quant_absmax_scale(w, axis=axis, bits=cfg.weight_bits)
+        if axis == w.ndim - 1:
+            w_scale_b = w_scale[None, :] if w.ndim == 2 else w_scale
+        else:
+            w_scale_b = w_scale.reshape((-1,) + (1,) * (w.ndim - 1))
+        orig = self.inner.weight
+        try:
+            self.inner.weight = fake_quantize(
+                orig, Tensor(w_scale_b), bits=cfg.weight_bits)
+            return self.inner(x)
+        finally:
+            self.inner.weight = orig
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+
+def _wrap_children(model, config, type_names):
+    for name, child in list(model.named_children()):
+        if type(child).__name__ in type_names:
+            setattr(model, name, _QATWrapper(child, config))
+        else:
+            _wrap_children(child, config, type_names)
+
+
+class QAT:
+    """Quantization-aware training (ref imperative/qat.py::ImperativeQuantAware):
+    ``quantize(model)`` wraps layers in place; train as usual; ``convert``
+    freezes int8 weights + scales for deployment."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model):
+        _wrap_children(model, self.config,
+                       set(self.config.quantizable_layer_type))
+        return model
+
+    def convert(self, model):
+        """Replace QAT wrappers with real-int8 deploy layers."""
+        for name, child in list(model.named_children()):
+            if isinstance(child, _QATWrapper):
+                inner = child.inner
+                if type(inner).__name__ == "Linear":
+                    setattr(model, name, QuantedLinear.from_float(
+                        inner, child._obs.scale, self.config))
+                # Conv stays fake-quant folded: bake quantized weights
+                else:
+                    w = inner.weight
+                    ws = quant_absmax_scale(w, axis=0,
+                                            bits=self.config.weight_bits)
+                    inner.weight.set_value(fake_quantize(
+                        w, Tensor(ws.reshape((-1,) + (1,) * (w.ndim - 1))),
+                        bits=self.config.weight_bits))
+                    setattr(model, name, inner)
+            else:
+                self.convert(child)
+        return model
+
+
+class QuantedLinear(nn.Layer):
+    """Deploy-time int8 linear: stored int8 weights, MXU int8 GEMM."""
+
+    def __init__(self, w_int8, w_scale, bias, a_scale):
+        super().__init__()
+        self.w_int8 = w_int8              # jnp int8 [in, out]
+        self.w_scale = w_scale            # [out] fp32
+        self.bias = bias                  # Tensor | None
+        self.a_scale = float(a_scale)
+
+    @classmethod
+    def from_float(cls, linear, a_scale, config: QuantConfig):
+        if a_scale is None:
+            raise ValueError(
+                "convert() before calibration: run at least one forward "
+                "pass (QAT training or PTQ calibration batches) so the "
+                "activation observers have scales")
+        w = linear.weight.value
+        qmax = 2.0 ** (config.weight_bits - 1) - 1
+        ws = quant_absmax_scale(linear.weight, axis=1,
+                                bits=config.weight_bits)
+        w_int8 = jnp.clip(jnp.round(w / ws[None, :]), -qmax, qmax
+                          ).astype(jnp.int8)
+        return cls(w_int8, ws, getattr(linear, "bias", None),
+                   float(jax.device_get(a_scale)))
+
+    def forward(self, x):
+        out = int8_matmul(x, Tensor(self.w_int8),
+                          Tensor(jnp.float32(self.a_scale)),
+                          Tensor(self.w_scale))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+# --------------------------------------------------------------------------
+# post-training quantization
+# --------------------------------------------------------------------------
+
+class PostTrainingQuantization:
+    """Calibrate activation scales on sample batches, then convert
+    (ref post_training_quantization.py:123 — there it drives an Executor
+    over a program; here it drives the eager model directly)."""
+
+    def __init__(self, model, config: QuantConfig | None = None):
+        self.model = model
+        self.config = config or QuantConfig()
+        self._qat = QAT(self.config)
+
+    def quantize(self, calib_batches):
+        """calib_batches: iterable of model inputs (Tensor or tuple)."""
+        self._qat.quantize(self.model)
+        import paddle_tpu as paddle
+        with paddle.no_grad():
+            for batch in calib_batches:
+                if isinstance(batch, (tuple, list)):
+                    self.model(*batch)
+                else:
+                    self.model(batch)
+        return self._qat.convert(self.model)
+
+    def save_quantized_model(self, path, input_spec=None):
+        from ..inference.export import save_inference_model
+        if input_spec is None:
+            raise ValueError("input_spec required")
+        return save_inference_model(path, self.model, input_spec)

@@ -1,0 +1,108 @@
+"""Quantization: fake-quant STE, QAT wrap/train/convert, real int8 matmul,
+post-training quantization (VERDICT r2 missing item 3; ref
+fluid/contrib/slim/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (fake_quantize, quant_absmax_scale,
+                                     int8_matmul, QuantConfig, QAT,
+                                     PostTrainingQuantization,
+                                     QuantedLinear)
+import jax.numpy as jnp
+
+
+def test_fake_quantize_roundtrip_error_bounded():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(64, 32).astype(np.float32))
+    scale = paddle.to_tensor(quant_absmax_scale(x))
+    y = fake_quantize(x, scale)
+    err = np.abs(y.numpy() - x.numpy()).max()
+    assert err <= float(scale.numpy()) / 2 + 1e-7
+    # idempotent: quantizing a quantized tensor is exact
+    y2 = fake_quantize(y, scale)
+    np.testing.assert_allclose(y2.numpy(), y.numpy(), atol=1e-7)
+
+
+def test_fake_quantize_ste_gradient():
+    x = paddle.to_tensor(np.array([0.1, -0.4, 5.0], np.float32),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(0.5 / 127))  # clips the 5.0
+    y = (fake_quantize(x, scale) * paddle.to_tensor(
+        np.array([1.0, 2.0, 3.0], np.float32))).sum()
+    y.backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g[:2], [1.0, 2.0])   # inside: pass-through
+    assert g[2] == 0.0                              # clipped: blocked
+
+
+def test_int8_matmul_close_to_float():
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 64).astype(np.float32)
+    w = rng.randn(64, 32).astype(np.float32) * 0.1
+    ws = quant_absmax_scale(paddle.to_tensor(w), axis=1)
+    w_int8 = jnp.clip(jnp.round(w / np.asarray(ws)[None, :]),
+                      -127, 127).astype(jnp.int8)
+    xs = float(np.abs(x).max() / 127)
+    out = int8_matmul(paddle.to_tensor(x), paddle.to_tensor(w_int8),
+                      paddle.to_tensor(np.float32(xs)),
+                      paddle.to_tensor(ws))
+    want = x @ w
+    rel = np.abs(out.numpy() - want) / (np.abs(want).max() + 1e-6)
+    assert rel.max() < 0.03, rel.max()
+
+
+def test_qat_wrap_train_convert():
+    rng = np.random.RandomState(2)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    qat = QAT(QuantConfig())
+    qat.quantize(net)
+    from paddle_tpu.quantization import _QATWrapper
+    assert isinstance(net[0], _QATWrapper)
+
+    x = rng.randn(32, 8).astype(np.float32)
+    w_true = rng.randn(8, 4).astype(np.float32)
+    y = x @ w_true
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    losses = []
+    for _ in range(60):
+        out = net(paddle.to_tensor(x))
+        loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+    float_out = net(paddle.to_tensor(x)).numpy()
+    qat.convert(net)
+    assert isinstance(net[0], QuantedLinear)
+    q_out = net(paddle.to_tensor(x)).numpy()
+    rel = np.abs(q_out - float_out).max() / (np.abs(float_out).max() + 1e-6)
+    assert rel < 0.1, rel
+
+
+def test_post_training_quantization():
+    rng = np.random.RandomState(3)
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 12), paddle.nn.Tanh(),
+                               paddle.nn.Linear(12, 3))
+    x = rng.randn(40, 6).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    ptq = PostTrainingQuantization(net, QuantConfig())
+    qnet = ptq.quantize([paddle.to_tensor(x[i:i + 8])
+                         for i in range(0, 40, 8)])
+    got = qnet(paddle.to_tensor(x)).numpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.1, rel
+
+
+def test_ptq_save_quantized_model(tmp_path):
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+    ptq = PostTrainingQuantization(net)
+    ptq.quantize([paddle.to_tensor(np.ones((2, 4), np.float32))])
+    meta = ptq.save_quantized_model(str(tmp_path / "q"),
+                                    input_spec=[((2, 4), "float32")])
+    assert meta["format"] == "stablehlo"
