@@ -87,7 +87,23 @@ def _run_config(cfg, batch, steps, mesh, moment_dtype):
     return batch * N * steps / dt, final_loss
 
 
+def _arm_watchdog(seconds=1500):
+    """The axon tunnel can wedge so hard that even jax.devices() blocks
+    forever; a hung bench is worse than a failed one.  SIGALRM turns a
+    wedge into a diagnosed nonzero exit."""
+    import signal
+
+    def fire(signum, frame):
+        print("# bench watchdog: no completion after "
+              f"{seconds}s — TPU tunnel wedged?", file=sys.stderr)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+
+
 def main():
+    _arm_watchdog()
     from paddle_tpu.parallel.mesh import create_mesh
     from paddle_tpu.models import gpt
 

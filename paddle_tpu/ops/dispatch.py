@@ -61,6 +61,21 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
     they are Tensors requiring grad (e.g. integer index operands).
     """
     from ..tensor import Tensor
+    from .. import profiler as _prof
+
+    if _prof.is_enabled():
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return _call_inner(fn, args, kwargs, _nondiff, _name)
+        finally:
+            _prof.record_op(_name or getattr(fn, "__name__", "op"),
+                            _time.perf_counter() - t0, t_start=t0)
+    return _call_inner(fn, args, kwargs, _nondiff, _name)
+
+
+def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
+    from ..tensor import Tensor
 
     if core._state.amp_state is not None:
         from ..amp.auto_cast import maybe_autocast_fn

@@ -1,12 +1,18 @@
-"""Profiler/tracing (ref: python/paddle/fluid/profiler.py +
-paddle/fluid/platform/profiler.cc).
+"""Profiler/tracing (ref: python/paddle/fluid/profiler.py,
+python/paddle/utils/profiler.py + paddle/fluid/platform/profiler.cc).
 
-TPU-native: wraps jax.profiler for device traces (viewable in TensorBoard /
-xprof) plus a lightweight host-side op timer for eager mode.
+TPU-native: device-side traces ride ``jax.profiler`` (xprof, viewable in
+TensorBoard), host-side eager dispatch is timed per op through the
+``ops.dispatch`` hook, and the collected events export to the
+chrome://tracing JSON format like the reference's profiler.cc exporter.
+Eager timings measure host dispatch latency (XLA execution is async);
+device truth comes from the xprof trace.
 """
 from __future__ import annotations
 
 import contextlib
+import json
+import threading
 import time
 from collections import defaultdict
 
@@ -14,16 +20,21 @@ import jax
 
 _op_times = defaultdict(float)
 _op_counts = defaultdict(int)
+_events = []                    # (name, t_start, dur) host-side
+_events_lock = threading.Lock()
 _enabled = False
+_t0 = 0.0
 
 
 def start_profiler(state="All", tracer_option="Default", log_dir=None):
-    global _enabled
+    global _enabled, _t0
     _enabled = True
+    _t0 = time.perf_counter()
     if log_dir:
         jax.profiler.start_trace(log_dir)
     _op_times.clear()
     _op_counts.clear()
+    del _events[:]
 
 
 def stop_profiler(sorted_key="total", profile_path=None):
@@ -33,7 +44,13 @@ def stop_profiler(sorted_key="total", profile_path=None):
         jax.profiler.stop_trace()
     except RuntimeError:
         pass
+    if profile_path:
+        export_chrome_tracing(profile_path)
     return summary()
+
+
+def is_enabled():
+    return _enabled
 
 
 def summary():
@@ -44,10 +61,27 @@ def summary():
     return out
 
 
-def record_op(name, seconds):
+def record_op(name, seconds, t_start=None):
     if _enabled:
         _op_times[name] += seconds
         _op_counts[name] += 1
+        with _events_lock:
+            _events.append((name, (t_start if t_start is not None
+                                   else time.perf_counter() - seconds)
+                            - _t0, seconds))
+
+
+def export_chrome_tracing(path):
+    """Write collected host events as chrome://tracing 'X' events
+    (the reference's profiler.cc emits the same format)."""
+    trace = {"traceEvents": [
+        {"name": name, "ph": "X", "pid": 0, "tid": 0,
+         "ts": round(ts * 1e6, 3), "dur": round(dur * 1e6, 3),
+         "cat": "op"}
+        for name, ts, dur in _events]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
 
 
 @contextlib.contextmanager
@@ -65,7 +99,7 @@ def record_event(name):
     try:
         yield
     finally:
-        record_op(name, time.perf_counter() - t0)
+        record_op(name, time.perf_counter() - t0, t_start=t0)
 
 
 class RecordEvent:
@@ -78,7 +112,57 @@ class RecordEvent:
         return self
 
     def __exit__(self, *a):
-        record_op(self.name, time.perf_counter() - self._t0)
+        record_op(self.name, time.perf_counter() - self._t0,
+                  t_start=self._t0)
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"       # accepted for parity; maps to the accelerator
+    TPU = "tpu"
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style session (ref:
+    python/paddle/profiler/profiler.py in later reference versions;
+    start/stop/step lifecycle with an optional chrome-trace export)."""
+
+    def __init__(self, targets=(ProfilerTarget.CPU, ProfilerTarget.TPU),
+                 scheduler=None, on_trace_ready=None, log_dir=None):
+        self.targets = tuple(targets)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.log_dir = log_dir
+        self._step = 0
+
+    def start(self):
+        start_profiler(log_dir=self.log_dir)
+        return self
+
+    def stop(self):
+        result = stop_profiler()
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+        return result
+
+    def step(self):
+        self._step += 1
+        record_op("profiler_step", 0.0, t_start=time.perf_counter())
+
+    def step_num(self):
+        return self._step
+
+    def summary(self, sorted_by="total", **kwargs):
+        return summary()
+
+    def export_chrome_tracing(self, path):
+        return export_chrome_tracing(path)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
 
 
 def trace(log_dir):

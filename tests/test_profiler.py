@@ -1,0 +1,53 @@
+"""Profiler: op timing via the dispatch hook, Profiler session API,
+chrome-trace export (SURVEY §2.11; ref fluid/profiler.py)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+def test_dispatch_ops_recorded():
+    profiler.start_profiler()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    _ = (x @ x + x).sum()
+    rows = profiler.stop_profiler()
+    names = [r[0] for r in rows[1:]]
+    assert any("matmul" in n for n in names), names
+    assert all(r[1] >= 1 for r in rows[1:])
+
+
+def test_profiler_session_and_chrome_export(tmp_path):
+    p = profiler.Profiler()
+    with p:
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        for _ in range(3):
+            x = x * 2.0
+            p.step()
+    assert p.step_num() == 3
+    path = str(tmp_path / "trace.json")
+    p.export_chrome_tracing(path)
+    with open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert len(evs) >= 3
+    assert all(e["ph"] == "X" and "dur" in e for e in evs)
+
+
+def test_profiler_off_no_recording():
+    profiler.start_profiler()
+    profiler.stop_profiler()
+    before = len(profiler.summary())
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    _ = x + x
+    assert len(profiler.summary()) == before
+
+
+def test_record_event_context():
+    profiler.start_profiler()
+    with profiler.RecordEvent("custom_block"):
+        pass
+    rows = profiler.stop_profiler()
+    assert any(r[0] == "custom_block" for r in rows[1:])
