@@ -60,6 +60,12 @@ def _load():
         lib.ptpu_ring_size.argtypes = [ctypes.c_int64]
         lib.ptpu_ring_stats.argtypes = [ctypes.c_int64,
                                         ctypes.POINTER(ctypes.c_uint64)]
+        lib.ptpu_preprocess_u8_nhwc_to_f32_nchw.restype = ctypes.c_int
+        lib.ptpu_preprocess_u8_nhwc_to_f32_nchw.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_float,
+            ctypes.c_void_p, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -229,3 +235,59 @@ def host_memory_pool() -> HostMemoryPool:
 
 def host_memory_stats() -> dict:
     return host_memory_pool().stats()
+
+
+def preprocess_images(images, mean, std, scale=1.0 / 255.0, n_threads=0):
+    """Fused u8 NHWC -> normalized f32 NCHW batch preprocess in native code
+    (the reference does per-image normalize/to_tensor in Python workers;
+    ref python/paddle/vision/transforms/functional.py).
+
+    images: uint8 array [N, H, W, C] or list of [H, W, C] arrays;
+    mean/std: per-channel (post-scale units, like transforms.Normalize);
+    returns float32 [N, C, H, W].  Falls back to numpy when the native
+    library is unavailable.
+    """
+    import os
+
+    if isinstance(images, np.ndarray):
+        assert images.ndim == 4, images.shape
+        images = [images[i] for i in range(images.shape[0])]
+    if not images:
+        raise ValueError("preprocess_images: empty batch")
+    for a in images:
+        if np.asarray(a).dtype != np.uint8:
+            raise TypeError("preprocess_images expects uint8 images, got "
+                            f"{np.asarray(a).dtype} (normalize raw pixels, "
+                            "not already-scaled floats)")
+    imgs = [np.ascontiguousarray(a, np.uint8) for a in images]
+    n = len(imgs)
+    h, w, c = imgs[0].shape
+    for a in imgs:
+        if a.shape != (h, w, c):
+            raise ValueError("preprocess_images: all images must share one "
+                             f"shape; got {a.shape} vs {(h, w, c)}")
+    mean = np.asarray(mean, np.float32).reshape(c)
+    std = np.asarray(std, np.float32).reshape(c)
+
+    lib = _load()
+    if lib is None:
+        batch = np.stack(imgs).astype(np.float32) * scale
+        batch = (batch - mean) / std
+        return np.ascontiguousarray(batch.transpose(0, 3, 1, 2))
+
+    out = np.empty((n, c, h, w), np.float32)
+    srcs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in imgs])
+    inv_std = np.ascontiguousarray(1.0 / std, np.float32)
+    mean_c = np.ascontiguousarray(mean, np.float32)
+    if n_threads <= 0:
+        n_threads = min(8, max(1, (os.cpu_count() or 2) - 1))
+    rc = lib.ptpu_preprocess_u8_nhwc_to_f32_nchw(
+        srcs, n, h, w, c,
+        mean_c.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        inv_std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_float(scale),
+        out.ctypes.data_as(ctypes.c_void_p), n_threads)
+    if rc != 0:
+        raise RuntimeError(f"ptpu_preprocess failed rc={rc}")
+    return out

@@ -31,6 +31,7 @@
 #include <mutex>
 #include <new>
 #include <unordered_map>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -326,6 +327,60 @@ void ptpu_ring_stats(int64_t h, uint64_t* out) {
     out[6] = r->pool.grow_count;
     out[7] = r->pool.free_count;
   }
+}
+
+// ---- fused image preprocess ----
+//
+// The reference's vision data path does uint8 decode -> float normalize ->
+// HWC->CHW transpose per image in Python workers (ref:
+// python/paddle/vision/transforms/functional.py::normalize/to_tensor);
+// this fuses all three into one threaded C pass so DataLoader collation
+// feeds the host->HBM staging ring at memory bandwidth.
+//
+// srcs: n pointers to u8 [H, W, C] images; out: f32 [n, C, H, W];
+// out[i][ch][y][x] = (src[y][x][ch] * scale - mean[ch]) * inv_std[ch].
+int ptpu_preprocess_u8_nhwc_to_f32_nchw(const uint8_t* const* srcs, int n,
+                                        int h, int w, int c,
+                                        const float* mean,
+                                        const float* inv_std, float scale,
+                                        float* out, int n_threads) {
+  if (n <= 0 || h <= 0 || w <= 0 || c <= 0 || c > 16) return -1;
+  const int64_t plane = static_cast<int64_t>(h) * w;
+  const int64_t img_out = plane * c;
+  float pre_mul[16], pre_sub[16];
+  for (int ch = 0; ch < c; ++ch) {
+    pre_mul[ch] = scale * inv_std[ch];
+    pre_sub[ch] = mean[ch] * inv_std[ch];
+  }
+  auto work = [&](int begin, int end) {
+    for (int i = begin; i < end; ++i) {
+      const uint8_t* src = srcs[i];
+      float* dst = out + i * img_out;
+      for (int64_t p = 0; p < plane; ++p) {
+        const uint8_t* px = src + p * c;
+        for (int ch = 0; ch < c; ++ch) {
+          dst[ch * plane + p] = px[ch] * pre_mul[ch] - pre_sub[ch];
+        }
+      }
+    }
+  };
+  int threads = n_threads > 0 ? n_threads : 1;
+  if (threads > n) threads = n;
+  if (threads <= 1) {
+    work(0, n);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  const int chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    const int b = t * chunk;
+    const int e = b + chunk < n ? b + chunk : n;
+    if (b >= e) break;
+    pool.emplace_back(work, b, e);
+  }
+  for (auto& th : pool) th.join();
+  return 0;
 }
 
 }  // extern "C"

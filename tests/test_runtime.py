@@ -170,3 +170,28 @@ def test_dataloader_native_ring_propagates_worker_error():
     with pytest.raises(ValueError, match="boom"):
         list(DataLoader(Bad(), batch_size=2, num_workers=2,
                         use_native_ring=True))
+
+
+def test_native_preprocess_matches_numpy():
+    from paddle_tpu import runtime
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (6, 17, 23, 3)).astype(np.uint8)
+    mean = [0.485, 0.456, 0.406]
+    std = [0.229, 0.224, 0.225]
+    got = runtime.preprocess_images(imgs, mean, std)
+    want = (imgs.astype(np.float32) / 255.0 - np.float32(mean)) \
+        / np.float32(std)
+    want = want.transpose(0, 3, 1, 2)
+    assert got.shape == (6, 3, 17, 23) and got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_native_preprocess_single_channel_and_list():
+    from paddle_tpu import runtime
+    rng = np.random.RandomState(1)
+    imgs = [rng.randint(0, 256, (8, 8, 1)).astype(np.uint8)
+            for _ in range(3)]
+    got = runtime.preprocess_images(imgs, [0.5], [0.5])
+    want = np.stack([(a.astype(np.float32) / 255.0 - 0.5) / 0.5
+                     for a in imgs]).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
