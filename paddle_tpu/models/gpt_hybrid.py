@@ -198,17 +198,43 @@ def _backbone(cfg, sp_size, pp_size, n_microbatch, params, x):
                        cfg.layer_norm_eps)
 
 
-def _fwd_loss(cfg, sp_size, pp_size, n_microbatch, params, tokens, labels):
+def _fwd_loss(cfg, sp_size, pp_size, n_microbatch, params, tokens, labels,
+              xent_chunks=1):
     x = _vp_embed(cfg, params, tokens)       # [B_l, N_l, H]
     x = _backbone(cfg, sp_size, pp_size, n_microbatch, params, x)
-    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
-    ce = _vp_xent(logits, labels)
-    valid = (labels >= 0).astype(jnp.float32)
+    wte = params["wte"]
+
+    def ce_of(xc, lc):
+        logits = (xc @ wte.astype(xc.dtype).T).astype(jnp.float32)
+        ce = _vp_xent(logits, lc)
+        valid = (lc >= 0).astype(jnp.float32)
+        return jnp.sum(ce * valid), jnp.sum(valid)
+
+    if xent_chunks > 1:
+        # the [B_l, N_l, V/tp] fp32 logits are the activation-memory hog at
+        # 1.3B scale (~400MB/sample-K); scanning sequence chunks under
+        # jax.checkpoint keeps only one chunk's logits live in fwd AND bwd
+        # at ~2% extra FLOPs (vocab-matmul recompute)
+        B_l, N_l = labels.shape
+        assert N_l % xent_chunks == 0, (N_l, xent_chunks)
+        C = N_l // xent_chunks
+        xr = x.reshape(B_l, xent_chunks, C, x.shape[-1]).swapaxes(0, 1)
+        lr = labels.reshape(B_l, xent_chunks, C).swapaxes(0, 1)
+
+        def body(carry, xl):
+            xc, lc = xl
+            t, c = jax.checkpoint(ce_of)(xc, lc)
+            return (carry[0] + t, carry[1] + c), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.float32(0), jnp.float32(0)), (xr, lr))
+    else:
+        total, count = ce_of(x, labels)
     # every pp rank holds the broadcast outputs and contributes an identical
     # term; psum-ing both numerator and count over pp keeps the mean AND the
     # backward weights exact (the broadcast-ppermute transpose sums them).
-    total = jax.lax.psum(jnp.sum(ce * valid), ("dp", "sp", "pp"))
-    count = jax.lax.psum(jnp.sum(valid), ("dp", "sp", "pp"))
+    total = jax.lax.psum(total, ("dp", "sp", "pp"))
+    count = jax.lax.psum(count, ("dp", "sp", "pp"))
     return total / jnp.maximum(count, 1.0)
 
 
@@ -259,17 +285,20 @@ def _global_norm(grads, specs):
 
 def make_train_step(cfg: GPTConfig, mesh, n_microbatch=1,
                     beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.1,
-                    clip_norm=1.0):
+                    clip_norm=1.0, xent_chunks=1):
     """Returns jitted ``step(params, m, v, t, tokens, labels, lr) ->
     (params, m, v, loss)``.  tokens/labels: GLOBAL [B, N] int32, batch
-    sharded over dp, sequence over sp; t: int32 step count (1-based)."""
+    sharded over dp, sequence over sp; t: int32 step count (1-based).
+    ``xent_chunks>1`` chunk-scans the vocab projection + cross entropy
+    (rematerialized) to cap logits activation memory."""
     sp_size, pp_size = _check_mesh(cfg, mesh)
     specs = param_specs(cfg)
 
     def step(params, m, v, t, tokens, labels, lr):
         loss, grads = jax.value_and_grad(
             lambda p: _fwd_loss(cfg, sp_size, pp_size, n_microbatch,
-                                p, tokens, labels))(params)
+                                p, tokens, labels,
+                                xent_chunks=xent_chunks))(params)
         grads = _sync_grads(grads, specs, mesh.size)
         if clip_norm:
             gn = _global_norm(grads, specs)

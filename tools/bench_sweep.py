@@ -41,7 +41,8 @@ dev = jax.devices()[0]
 mesh = create_mesh(dp=1, tp=1, pp=1, sp=1, devices=[dev])
 params, m, mv = gpt_hybrid.init_sharded(cfg, mesh, jax.random.PRNGKey(0),
                                         moment_dtype=moment_dtype)
-step = gpt_hybrid.make_train_step(cfg, mesh, n_microbatch=1)
+step = gpt_hybrid.make_train_step(cfg, mesh, n_microbatch=1,
+                                  xent_chunks=v.get("xent_chunks", 1))
 N = cfg.max_seq_len
 toks = jnp.asarray(np.random.RandomState(0).randint(
     0, cfg.vocab_size, (batch, N)), jnp.int32)
