@@ -59,6 +59,63 @@ class ParallelExecutor(Executor):
                            **kwargs)
 
 
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Freeze a static Program into the standalone StableHLO artifact
+    (ref: python/paddle/static/io.py::save_inference_model — there a
+    pruned ProgramDesc + persistables; here parameters/buffers bake into
+    the exported program, same file pair as jit/inference export)."""
+    import jax
+    from .graph import default_main_program, _ensure_var_id
+    from ..inference.export import save_inference_model as _export
+    from ..tensor.tensor import Tensor
+
+    program = program or default_main_program()
+    feed_vars = [feed_vars] if isinstance(feed_vars, Tensor) else feed_vars
+    fetch_vars = [fetch_vars] if isinstance(fetch_vars, Tensor) \
+        else fetch_vars
+    feed_ids = [_ensure_var_id(v, program) for v in feed_vars]
+    fetch_ids = [_ensure_var_id(v, program) for v in fetch_vars]
+    param_ids = sorted(program.params.keys())
+    param_vals = [program.params[i].value for i in param_ids]
+
+    def fn(*feeds):
+        # the export harness hands Tensors; replay wants raw values
+        feeds = [f.value if isinstance(f, Tensor) else f for f in feeds]
+        env = dict(zip(feed_ids, feeds))
+        env.update(dict(zip(param_ids, param_vals)))
+        program.replay(env)
+        return tuple(env[i] for i in fetch_ids)
+
+    input_spec = [(tuple(v.shape), str(v.dtype)) for v in feed_vars]
+    names = [getattr(v, "name", None) or f"x{i}"
+             for i, v in enumerate(feed_vars)]
+    return _export(path_prefix, fn, input_spec, input_names=names)
+
+
+class _LoadedInferenceProgram:
+    """Stand-in program returned by load_inference_model; Executor.run
+    dispatches to the deserialized StableHLO callable."""
+
+    def __init__(self, model):
+        self.model = model
+        self.ops = True   # truthy: Executor must not treat it as startup
+
+    def run(self, feed, fetch_list=None):
+        import numpy as np
+        ordered = [np.asarray(feed[n]) for n in self.model.input_names()]
+        return [np.asarray(o) for o in self.model(*ordered)]
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; run via ``exe.run(program, feed=..., fetch_list=...)``."""
+    from ..inference.export import StandaloneModel
+    model = StandaloneModel(path_prefix)
+    prog = _LoadedInferenceProgram(model)
+    return [prog, model.input_names(), model.output_names()]
+
+
 def save(program, model_path, **kwargs):
     from ..io.serialization import save as _save
     state = {f"param_{i}": p for i, p in enumerate(program.all_parameters())}

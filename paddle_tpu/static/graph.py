@@ -235,6 +235,9 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
         program = program or default_main_program()
+        if hasattr(program, "model") and hasattr(program, "run"):
+            # deserialized inference artifact (static.load_inference_model)
+            return program.run(feed or {}, fetch_list)
         if getattr(program, "_is_startup", False) or not program.ops:
             return []  # startup: params already initialized eagerly
         feed = feed or {}

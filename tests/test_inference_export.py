@@ -114,3 +114,32 @@ def test_function_export(tmp_path):
     out = pred.get_output_handle("out0").copy_to_cpu()
     want = fn(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_static_save_load_inference_model(tmp_path):
+    """The classic fluid deployment loop: build static program, freeze it,
+    reload in (potentially another process) and run through Executor."""
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [3, 4], "float32")
+            lin = paddle.nn.Linear(4, 2)
+            out = lin(x) * 2.0
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        want, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+        prefix = str(tmp_path / "static_model")
+        static.save_inference_model(prefix, [x], [out], exe, program=main)
+    finally:
+        paddle.disable_static()
+
+    prog, feed_names, fetch_names = static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    exe2 = static.Executor()
+    got, = exe2.run(prog, feed={"x": xv}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
