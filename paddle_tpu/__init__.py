@@ -75,6 +75,9 @@ from . import distribution  # noqa: E402
 from . import onnx  # noqa: E402
 from . import reader  # noqa: E402
 from . import quantization  # noqa: E402
+from . import dataset  # noqa: E402
+from . import hub  # noqa: E402
+from .reader import batch  # noqa: E402  (paddle.batch, ref batch.py)
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
 from . import incubate  # noqa: E402

@@ -1,0 +1,71 @@
+"""Legacy paddle.dataset reader creators, paddle.batch, paddle.hub local
+source (ref python/paddle/dataset/, batch.py, hub.py)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_dataset_mnist_reader_schema():
+    r = paddle.dataset.mnist.train()
+    img, label = next(iter(r()))
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert isinstance(label, int) and 0 <= label <= 9
+
+
+def test_dataset_uci_housing_reader():
+    r = paddle.dataset.uci_housing.train()
+    x, y = next(iter(r()))
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_dataset_cifar_reader():
+    r = paddle.dataset.cifar.train10()
+    img, label = next(iter(r()))
+    assert img.shape == (3072,) and 0.0 <= img.min() <= img.max() <= 1.0
+    assert 0 <= label <= 9
+
+
+def test_dataset_imdb_reader_and_word_dict():
+    wd = paddle.dataset.imdb.word_dict()
+    assert len(wd) > 0
+    ids, label = next(iter(paddle.dataset.imdb.train(wd)()))
+    assert isinstance(ids, list) and label in (0, 1)
+
+
+def test_paddle_batch_composes_with_dataset():
+    batches = list(paddle.batch(
+        paddle.reader.firstn(paddle.dataset.uci_housing.train(), 10), 4)())
+    assert [len(b) for b in batches] == [4, 4, 2]
+    xs = np.stack([x for x, _ in batches[0]])
+    assert xs.shape == (4, 13)
+
+
+def test_hub_local_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent("""
+        import paddle_tpu as paddle
+
+        def tiny_mlp(hidden=4):
+            \"\"\"A tiny MLP entrypoint.\"\"\"
+            return paddle.nn.Sequential(
+                paddle.nn.Linear(2, hidden), paddle.nn.ReLU(),
+                paddle.nn.Linear(hidden, 1))
+
+        def _private():
+            pass
+    """))
+    names = paddle.hub.list(str(tmp_path))
+    assert "tiny_mlp" in names and "_private" not in names
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+    net = paddle.hub.load(str(tmp_path), "tiny_mlp", hidden=8)
+    out = net(paddle.to_tensor(np.ones((3, 2), np.float32)))
+    assert tuple(out.shape) == (3, 1)
+
+
+def test_hub_remote_sources_raise():
+    with pytest.raises(RuntimeError):
+        paddle.hub.list("some/repo", source="github")
