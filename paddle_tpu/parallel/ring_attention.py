@@ -4,6 +4,13 @@ Long-context replacement for the reference's fused attention at scale: Q
 stays resident per shard while K/V blocks rotate around the 'sp' ring via
 ppermute, overlapping compute with ICI transfers.  Online-softmax running
 stats merge partial results exactly (same math as flash attention).
+
+The backward is a CUSTOM VJP (ring-flash): probabilities are never saved —
+each step recomputes its score block from the saved per-row logsumexp
+while dK/dV accumulators ride the rotating K/V around the full ring and
+land home after `size` hops.  Without this, autodiff of the forward scan
+would checkpoint a [B,H,Nq_local,Nk_local] probability block per ring
+step (O(N^2/sp) per device) — exactly what kills long-context training.
 """
 from __future__ import annotations
 
@@ -18,25 +25,29 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, scale, causal, q_off, k_off):
-    """Attention over one (q_shard, k_block) pair with running-stat outputs.
-    q: [B,H,Nq,D]; returns (out_unnorm, row_max, row_sumexp)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+def _scores(q, k, scale, causal, q_off, k_off):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
     if causal:
         nq, nk = s.shape[-2], s.shape[-1]
         rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (nq, nk), 0)
         cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (nq, nk), 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
+
+
+def _block_attn(q, k, v, scale, causal, q_off, k_off):
+    """Attention over one (q_shard, k_block) pair with running-stat outputs.
+    q: [B,H,Nq,D]; returns (out_unnorm, row_max, row_sumexp) in fp32."""
+    s = _scores(q, k, scale, causal, q_off, k_off)
     m = jnp.max(s, axis=-1, keepdims=True)                 # [B,H,Nq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name="sp", causal=False):
-    """q,k,v: LOCAL shards [B, H, N_local, D] inside a shard_map over
-    ``axis_name``.  Returns the local output shard."""
+def _ring_fwd_impl(q, k, v, axis_name, causal):
     scale = 1.0 / math.sqrt(q.shape[-1])
     n_local = q.shape[2]
     idx = jax.lax.axis_index(axis_name)
@@ -47,13 +58,12 @@ def ring_attention(q, k, v, axis_name="sp", causal=False):
 
     def body(i, carry):
         o, m, l, k, v = carry
-        # rotate K/V one step around the ring (overlaps with next compute)
         perm = [(j, (j + 1) % size) for j in range(size)]
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        src = (idx - i - 1) % size  # shard the K/V block originated from
-        k_off = src * n_local
-        o2, m2, l2 = _block_attn(q, k, v, scale, causal, q_off, k_off)
+        src = (idx - i - 1) % size  # shard this K/V block originated from
+        o2, m2, l2 = _block_attn(q, k, v, scale, causal, q_off,
+                                 src * n_local)
         m_new = jnp.maximum(m, m2)
         a1 = jnp.exp(m - m_new)
         a2 = jnp.exp(m2 - m_new)
@@ -62,13 +72,92 @@ def ring_attention(q, k, v, axis_name="sp", causal=False):
         return o, m_new, l, k, v
 
     o, m, l, _, _ = jax.lax.fori_loop(0, size - 1, body, (o, m, l, k, v))
-    return o / jnp.maximum(l, 1e-30)
+    l = jnp.maximum(l, 1e-30)
+    out = (o / l).astype(q.dtype)
+    lse = m + jnp.log(l)                                   # [B,H,Nq,1]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention(q, k, v, axis_name="sp", causal=False):
+    """q,k,v: LOCAL shards [B, H, N_local, D] inside a shard_map over
+    ``axis_name``.  Returns the local output shard."""
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, res, g):
+    """Ring-flash backward.  dQ accumulates locally; (dK, dV) accumulators
+    travel WITH the rotating K/V so after the full `size` hops they land
+    back on the shard that owns those K/V rows."""
+    q, k, v, out, lse = res
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    n_local = q.shape[2]
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    q_off = idx * n_local
+
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    # delta_i = rowsum(dO_i * O_i)  [B,H,Nq,1]
+    delta = jnp.sum(gf * of, axis=-1, keepdims=True)
+
+    perm = [(j, (j + 1) % size) for j in range(size)]
+
+    def compute(dq, dk_acc, dv_acc, k_rot, v_rot, i):
+        src = (idx - i) % size           # owner of the current K/V block
+        s = _scores(q, k_rot, scale, causal, q_off, src * n_local)
+        p = jnp.exp(s - lse)             # recomputed, never stored
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf,
+                        v_rot.astype(jnp.float32))
+        ds = p * (dp - delta) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_rot.astype(jnp.float32))
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        return dq, dk_acc, dv_acc
+
+    def step(carry, i):
+        dq, k_rot, v_rot, dk_acc, dv_acc = carry
+        dq, dk_acc, dv_acc = compute(dq, dk_acc, dv_acc, k_rot, v_rot, i)
+        # rotate K/V together with their gradient accumulators
+        k_rot = jax.lax.ppermute(k_rot, axis_name, perm)
+        v_rot = jax.lax.ppermute(v_rot, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (dq, k_rot, v_rot, dk_acc, dv_acc), None
+
+    # accumulators must carry the same varying-manual-axes type as the
+    # rotating k/v (shard_map VMA tracking) — derive them from the inputs
+    zeros_k = k.astype(jnp.float32) * 0.0
+    zeros_v = v.astype(jnp.float32) * 0.0
+    init = (qf * 0.0, k, v, zeros_k, zeros_v)
+    (dq, k_rot, v_rot, dk, dv), _ = jax.lax.scan(
+        step, init, jnp.arange(size - 1))
+    # last block: compute, then rotate ONLY the accumulators home — the
+    # k/v blocks themselves have no further consumer (dead ICI otherwise)
+    dq, dk, dv = compute(dq, dk, dv, k_rot, v_rot, size - 1)
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    # after `size` rotations the accumulators are home: each shard now
+    # holds the gradient of ITS OWN k/v rows summed over every q shard
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention_sharded(mesh, q, k, v, causal=False, axis_name="sp"):
     """Entry point on GLOBAL arrays [B,H,N,D]: shard N over ``axis_name``."""
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal),
         mesh=mesh,
         in_specs=(P(None, None, axis_name, None),) * 3,
         out_specs=P(None, None, axis_name, None))
