@@ -1,0 +1,79 @@
+"""ERNIE-3.0-Base / BERT-base pretrain throughput on one chip
+(BASELINE.json's headline metric names ERNIE tokens/sec/chip).
+
+Prints one JSON line like bench.py; timed region ends with a host fetch
+(block_until_ready does not sync through the remote-exec layer here).
+Run: python tools/bench_bert.py [--model ernie|bert] [--batch N]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="ernie", choices=["ernie", "bert"])
+    ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    from bench import _peak_flops, TARGET_MFU, _arm_watchdog
+    from paddle_tpu.models import bert
+
+    _arm_watchdog()
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    cfg = (bert.ernie_3_base() if args.model == "ernie"
+           else bert.bert_base()) if on_tpu else bert.bert_tiny()
+    batch = args.batch or (64 if on_tpu else 4)
+    steps = args.steps if on_tpu else 2
+    N = cfg.max_seq_len if hasattr(cfg, "max_seq_len") else 512
+
+    params, m, v = bert.init_pretrain_state(cfg, jax.random.PRNGKey(0))
+    step = bert.make_train_step(cfg)
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, N)),
+                       jnp.int32)
+    # 15% masked-LM positions, rest ignored (-100)
+    mask = rng.rand(batch, N) < 0.15
+    mlm = jnp.asarray(np.where(mask, np.asarray(toks), -100), jnp.int32)
+    nsp = jnp.asarray(rng.randint(0, 2, (batch,)), jnp.int32)
+    lr = jnp.float32(1e-4)
+
+    params, m, v, loss = step(params, m, v, jnp.int32(1), toks, mlm, nsp,
+                              lr)
+    float(loss)                      # compile + warm (host fetch)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, m, v, loss = step(params, m, v, jnp.int32(i + 2), toks,
+                                  mlm, nsp, lr)
+    final_loss = float(loss)         # host fetch closes the region
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    tokens_per_sec = batch * N * steps / dt
+    mfu = tokens_per_sec * cfg.flops_per_token() / _peak_flops(dev)
+    assert 0.0 < mfu <= 1.0 or not on_tpu, mfu
+    print(json.dumps({
+        "metric": f"{args.model}_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / TARGET_MFU, 4),
+    }))
+    print(f"# model={args.model} params={cfg.num_params()/1e6:.0f}M "
+          f"seq={N} batch={batch} loss={final_loss:.4f} mfu={mfu:.3f}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
