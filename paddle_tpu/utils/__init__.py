@@ -2,3 +2,27 @@ from . import lazy
 from .lazy import flops, try_import
 from .download import get_weights_path_from_url
 from .checkpoint import CheckpointManager  # noqa: E402,F401
+from . import unique_name
+from . import cpp_extension
+from .install_check import run_check
+
+
+def deprecated(update_to="", since="", reason=""):
+    """ref python/paddle/utils/deprecated.py — warn once per call site."""
+    import functools
+    import warnings
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use '{update_to}' instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorate

@@ -1,0 +1,37 @@
+"""paddle.utils.run_check (ref: python/paddle/utils/install_check.py):
+smoke-verify the install — forward + backward + optimizer step on the
+available device, and a sharded step when multiple devices exist."""
+from __future__ import annotations
+
+
+def run_check():
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+
+    dev = jax.devices()[0]
+    print(f"Running verify PaddlePaddle(TPU-native) ... device: "
+          f"{dev.device_kind} ({dev.platform}) x{len(jax.devices())}")
+
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(loss))
+
+    if len(jax.devices()) > 1:
+        from paddle_tpu.parallel.mesh import create_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = create_mesh(dp=len(jax.devices()))
+        arr = jax.device_put(
+            np.ones((len(jax.devices()), 2), np.float32),
+            NamedSharding(mesh, P("dp")))
+        total = float(jax.jit(lambda a: a.sum())(arr))
+        assert total == 2 * len(jax.devices())
+        print(f"PaddlePaddle(TPU-native) works on {len(jax.devices())} "
+              "devices.")
+    print("PaddlePaddle(TPU-native) is installed successfully!")

@@ -1,0 +1,67 @@
+"""paddle.utils: unique_name, run_check, deprecated, cpp_extension
+(ref python/paddle/utils/)."""
+import ctypes
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import unique_name, cpp_extension, run_check
+
+
+def test_unique_name_generate_and_guard():
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+        assert c == "fc_0"
+    d = unique_name.generate("fc")
+    assert d not in (a, b, c)
+    with unique_name.guard("scope_"):
+        assert unique_name.generate("w").startswith("scope_w_")
+
+
+def test_run_check_smoke(capsys):
+    run_check()
+    out = capsys.readouterr().out
+    assert "successfully" in out
+
+
+def test_deprecated_warns():
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api(x):
+        return x + 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api(1) == 2
+    assert any("deprecated" in str(x.message) for x in w)
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "myext.cc"
+    src.write_text(
+        'extern "C" int add_ints(int a, int b) { return a + b; }\n'
+        'extern "C" double scale(double x) { return x * 2.5; }\n')
+    lib = cpp_extension.load("myext", [str(src)],
+                             build_directory=str(tmp_path))
+    lib.add_ints.restype = ctypes.c_int
+    lib.add_ints.argtypes = [ctypes.c_int, ctypes.c_int]
+    assert lib.add_ints(2, 40) == 42
+    lib.scale.restype = ctypes.c_double
+    lib.scale.argtypes = [ctypes.c_double]
+    assert lib.scale(2.0) == 5.0
+    # cache: second load with no source change reuses the .so
+    lib2 = cpp_extension.load("myext", [str(src)],
+                              build_directory=str(tmp_path))
+    assert lib2 is not None
+
+
+def test_cpp_extension_build_error(tmp_path):
+    src = tmp_path / "bad.cc"
+    src.write_text("this is not C++")
+    with pytest.raises(RuntimeError):
+        cpp_extension.load("bad", [str(src)],
+                           build_directory=str(tmp_path))
