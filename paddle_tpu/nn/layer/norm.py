@@ -252,7 +252,17 @@ class SpectralNorm(Layer):
 
         def _sn(w, u, v):
             wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
-            sigma = u @ wm @ v
-            return w / sigma
+            # stored u degenerates if the layer was built on dummy zero
+            # weights (static build pass); restart from a fixed vector
+            u = jnp.where(jnp.linalg.norm(u) < 1e-6,
+                          jnp.ones_like(u) / jnp.sqrt(1.0 * u.shape[0]), u)
+            # in-graph refresh so replayed programs track the live w
+            for _ in range(2):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v         # = ||wm @ v|| >= 0 by construction
+            return w / jnp.maximum(sigma, eps)
         return call(_sn, weight, self.weight_u, self.weight_v,
                     _name="spectral_norm")

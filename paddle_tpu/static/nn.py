@@ -85,3 +85,70 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
     if act:
         out = getattr(F, act)(out)
     return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    in_ch = input.shape[1 if data_format.startswith("NC") else -1]
+    layer = _nn.Conv2DTranspose(in_ch, num_filters, filter_size or 4,
+                                stride, padding, dilation=dilation,
+                                groups=groups, weight_attr=param_attr,
+                                bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size) \
+        if output_size is not None else layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None):
+    in_ch = input.shape[1 if data_format.startswith("NC") else -1]
+    layer = _nn.Conv3D(in_ch, num_filters, filter_size, stride, padding,
+                       dilation, groups, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    num = 1 if mode == "all" else x.shape[1]
+    return _nn.PReLU(num_parameters=num, weight_attr=param_attr)(x)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    ch = input.shape[1 if data_layout.startswith("NC") else -1]
+    out = _nn.GroupNorm(groups, ch, epsilon, param_attr, bias_attr)(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    ch = input.shape[1]
+    return _nn.InstanceNorm2D(ch, epsilon, weight_attr=param_attr,
+                              bias_attr=bias_attr)(input)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    layer = _nn.SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                             eps=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    layer = _nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(F, act)(out)
+    return out

@@ -1,0 +1,61 @@
+"""static.nn layer builders beyond the core set (ref fluid/layers/nn.py):
+conv2d_transpose, conv3d, prelu, group_norm, instance_norm,
+bilinear_tensor_product, spectral_norm — built into a Program and run
+through the Executor."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_static_nn_builders_build_and_run():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [2, 4, 8, 8], "float32")
+            vol = static.data("vol", [2, 3, 4, 8, 8], "float32")
+            x2 = static.data("x2", [2, 3], "float32")
+            y2 = static.data("y2", [2, 5], "float32")
+            a = static.nn.conv2d_transpose(img, 6, 3)
+            b = static.nn.conv3d(vol, 5, 3)
+            c = static.nn.prelu(img)
+            d = static.nn.group_norm(img, groups=2)
+            e = static.nn.instance_norm(img)
+            f = static.nn.bilinear_tensor_product(x2, y2, 7)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.randn(2, 4, 8, 8).astype(np.float32),
+                "vol": rng.randn(2, 3, 4, 8, 8).astype(np.float32),
+                "x2": rng.randn(2, 3).astype(np.float32),
+                "y2": rng.randn(2, 5).astype(np.float32)}
+        outs = exe.run(main, feed=feed, fetch_list=[a, b, c, d, e, f])
+        shapes = [o.shape for o in outs]
+        assert shapes == [(2, 6, 10, 10), (2, 5, 2, 6, 6), (2, 4, 8, 8),
+                          (2, 4, 8, 8), (2, 4, 8, 8), (2, 7)], shapes
+        for o in outs:
+            assert np.isfinite(o).all()
+        # group_norm output: per-group normalized => ~zero mean
+        assert abs(outs[3].mean()) < 0.1
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_spectral_norm():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            w = static.data("w", [8, 6], "float32")
+            out = static.nn.spectral_norm(w, power_iters=3)
+        exe = static.Executor()
+        rng = np.random.RandomState(1)
+        wv = rng.randn(8, 6).astype(np.float32) * 5
+        got, = exe.run(main, feed={"w": wv}, fetch_list=[out])
+        assert np.isfinite(got).all()
+        # largest singular value of the normalized weight is ~1
+        s = np.linalg.svd(got, compute_uv=False)
+        assert s[0] < 2.0, s
+    finally:
+        paddle.disable_static()
