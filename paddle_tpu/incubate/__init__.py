@@ -4,6 +4,7 @@ Hosts the TPU-extras that go beyond the stable Paddle 2.0 surface: ring
 attention for long context and fused Pallas ops.
 """
 from ..parallel.ring_attention import ring_attention_sharded as ring_attention
+from ..parallel import moe
 from ..nn.functional.attention import flash_attention
 from ..nn.functional.norm import rms_norm
 

@@ -6,3 +6,5 @@ from .pipeline import pipeline_forward, make_pipelined
 from . import zero
 from .zero import (make_zero_train_step, init_zero_state, gather_params,
                    state_bytes_per_device)
+from . import moe
+from .moe import moe_ffn, init_moe_params

@@ -1,0 +1,128 @@
+"""Expert parallelism: Mixture-of-Experts FFN over a mesh axis.
+
+TPU-native expert-parallel layer (the reference line grows this as
+incubate/distributed/models/moe with NCCL alltoall; here the whole MoE
+block is SPMD inside ``shard_map``):
+
+  * switch-style top-1 routing with capacity buffers (static shapes —
+    XLA needs a fixed [E, C, H] dispatch tensor; overflow tokens fall
+    through with their residual, the standard Switch-Transformer drop);
+  * experts are SHARDED over the mesh axis (``ep``, commonly reusing the
+    dp axis): each rank holds E/size experts, tokens travel to their
+    expert's rank via ``lax.all_to_all`` riding ICI and come back the
+    same way;
+  * everything is differentiable: routing probabilities scale the
+    combined output (straight-through over the hard top-1 choice), and
+    the auxiliary load-balancing loss is returned alongside.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(key, n_experts, hidden, ffn, dtype=jnp.float32):
+    """Gate + stacked expert FFN weights ([E, ...] leading expert axis —
+    shard it over the ep axis with P('ep', ...)."""
+    ks = jax.random.split(key, 3)
+    std = 0.02
+    return {
+        "gate_w": jax.random.normal(ks[0], (hidden, n_experts),
+                                    jnp.float32).astype(dtype) * std,
+        "w1": jax.random.normal(ks[1], (n_experts, hidden, ffn),
+                                jnp.float32).astype(dtype) * std,
+        "b1": jnp.zeros((n_experts, ffn), dtype),
+        "w2": jax.random.normal(ks[2], (n_experts, ffn, hidden),
+                                jnp.float32).astype(dtype) * std,
+        "b2": jnp.zeros((n_experts, hidden), dtype),
+    }
+
+
+def moe_ffn(x, params, axis_name="ep", capacity_factor=1.25,
+            n_experts=None):
+    """x: LOCAL [T, H] tokens inside a shard_map over ``axis_name``;
+    params: LOCAL shards — gate_w replicated [H, E], expert weights
+    [E_local, ...] (expert axis sharded over ``axis_name``).
+
+    Returns (out [T, H], aux_loss scalar)."""
+    size = jax.lax.axis_size(axis_name)
+    T, H = x.shape
+    e_local = params["w1"].shape[0]
+    E = n_experts or e_local * size
+    assert e_local * size == E, (e_local, size, E)
+    C = max(1, int(math.ceil(T / E * capacity_factor)))
+
+    xf = x.astype(jnp.float32)
+    logits = xf @ params["gate_w"].astype(jnp.float32)       # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)       # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based
+    pos_in_e = jnp.sum(pos, axis=1) - 1                       # [T]
+    keep = pos_in_e < C
+
+    # scatter tokens into the [E, C, H] dispatch buffer (dropped -> zeros)
+    disp = jnp.zeros((E, C, H), x.dtype)
+    e_idx = jnp.where(keep, expert, 0)
+    c_idx = jnp.clip(pos_in_e, 0, C - 1)
+    disp = disp.at[e_idx, c_idx].add(
+        jnp.where(keep[:, None], x, 0).astype(x.dtype))
+
+    # tokens travel to their expert's rank: [E, C, H] -> regroup so this
+    # rank holds its local experts' tokens from EVERY rank
+    disp = disp.reshape(size, e_local, C, H)
+    disp = jax.lax.all_to_all(disp, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)
+    # [size, e_local, C, H]: axis 0 = source rank
+    disp = jnp.swapaxes(disp, 0, 1).reshape(e_local, size * C, H)
+
+    # local expert FFN (batched einsum over the expert axis -> MXU)
+    h = jnp.einsum("ech,ehf->ecf", disp.astype(jnp.float32),
+                   params["w1"].astype(jnp.float32))
+    h = jax.nn.gelu(h + params["b1"].astype(jnp.float32)[:, None, :],
+                    approximate=True)
+    y = jnp.einsum("ecf,efh->ech", h, params["w2"].astype(jnp.float32))
+    y = y + params["b2"].astype(jnp.float32)[:, None, :]
+
+    # return trip
+    y = y.reshape(e_local, size, C, H).swapaxes(0, 1)        # [size,e_l,C,H]
+    y = jax.lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)
+    y = y.reshape(E, C, H)
+
+    # gather each surviving token's output, scale by its gate prob
+    out = y[e_idx, c_idx]                                     # [T, H]
+    out = jnp.where(keep[:, None], out * gate[:, None].astype(y.dtype),
+                    0.0)
+
+    # Switch load-balancing aux loss: E * sum_e f_e * P_e
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)       # [E]
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn_dense_reference(x, params_full, capacity_factor=None):
+    """Single-device reference: every token through its argmax expert,
+    no capacity limit (for parity tests; params_full has the FULL [E,...]
+    expert axis)."""
+    xf = x.astype(jnp.float32)
+    logits = xf @ params_full["gate_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    w1 = params_full["w1"].astype(jnp.float32)[expert]       # [T, H, F]
+    b1 = params_full["b1"].astype(jnp.float32)[expert]
+    w2 = params_full["w2"].astype(jnp.float32)[expert]
+    b2 = params_full["b2"].astype(jnp.float32)[expert]
+    h = jax.nn.gelu(jnp.einsum("th,thf->tf", xf, w1) + b1,
+                    approximate=True)
+    y = jnp.einsum("tf,tfh->th", h, w2) + b2
+    return (y * gate[:, None]).astype(x.dtype)
