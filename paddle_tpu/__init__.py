@@ -114,3 +114,82 @@ def get_cudnn_version():
 
 def is_grad_enabled():
     return _core.grad_enabled()
+
+
+# ---- long-tail top-level parity (ref: python/paddle/__init__.py) ----
+from .distributed.data_parallel import DataParallel  # noqa: E402
+from .tensor.attribute import rank  # noqa: E402
+from .tensor.math import add_n, cast, tanh_  # noqa: E402
+from .tensor.manipulation import crop_tensor  # noqa: E402
+from .tensor.linalg import inv as inverse  # noqa: E402
+from .jit.api import disable_static as enable_dygraph  # noqa: E402
+from .jit.api import enable_static as disable_dygraph  # noqa: E402
+
+# legacy place/class aliases: every accelerator place maps to the TPU
+# (ref exposes NPUPlace/XPUPlace; VarBase/ComplexTensor are the fluid-era
+# tensor classes users may still reference)
+from .framework.core import TPUPlace as NPUPlace  # noqa: E402,F401
+from .framework.core import TPUPlace as XPUPlace  # noqa: E402,F401
+VarBase = Tensor
+ComplexTensor = Tensor
+
+
+def is_compiled_with_npu():
+    return False
+
+
+# "cuda" rng == the accelerator rng stream here (one TPU chip)
+def get_cuda_rng_state():
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        set_rng_state(state_list[0])
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (ref: python/paddle/tensor/to_string.py).
+    Tensor.__repr__ prints via numpy, so numpy's printoptions are the
+    single source of truth."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone learnable parameter (ref: paddle.create_parameter /
+    fluid layer_helper_base.create_parameter)."""
+    import numpy as _np
+    from .nn import initializer as _I
+    from .framework.param_attr import ParamAttr as _PA
+    attr = _PA._to_attr(attr)
+    init = default_initializer or (attr.initializer if attr else None)
+    if init is None:
+        init = _I.Constant(0.0) if is_bias else _I.XavierUniform()
+    dt = _core.convert_dtype(dtype)
+    p = Parameter(init([int(s) for s in shape], dt))
+    if attr is not None and attr.name:
+        p.name = attr.name
+    elif name:
+        p.name = name
+    p.trainable = attr.trainable if attr is not None else True
+    # in static mode the parameter belongs to the program even before any
+    # op touches it (ref: layer_helper registers into the startup program)
+    from .static.graph import in_static_mode, default_main_program, \
+        _ensure_var_id
+    if in_static_mode():
+        _ensure_var_id(p, default_main_program())
+    return p

@@ -4,5 +4,6 @@ from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
                       Sampler, SequenceSampler, RandomSampler,
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
-from .dataloader import DataLoader, default_collate_fn
+from .dataloader import (DataLoader, default_collate_fn, get_worker_info,
+                         WorkerInfo)
 from .serialization import save, load

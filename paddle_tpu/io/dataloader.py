@@ -16,6 +16,29 @@ import numpy as np
 from .dataset import BatchSampler, IterableDataset
 from ..tensor.tensor import Tensor
 
+_worker_tls = threading.local()
+
+
+class WorkerInfo:
+    """ref: fluid/dataloader/worker.py::WorkerInfo — identifies the worker
+    a sample is being produced in, so IterableDatasets can shard."""
+
+    def __init__(self, id, num_workers, dataset, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: that worker's WorkerInfo; in the main
+    process/thread: None (ref: paddle.io.get_worker_info)."""
+    return getattr(_worker_tls, "info", None)
+
 
 def default_collate_fn(batch):
     sample = batch[0]
@@ -115,7 +138,9 @@ class DataLoader:
         for _ in range(self.num_workers):
             work_q.put(done)
 
-        def worker():
+        def worker(wid):
+            _worker_tls.info = WorkerInfo(wid, self.num_workers,
+                                          self.dataset)
             while True:
                 item = work_q.get()
                 if item is done:
@@ -127,8 +152,8 @@ class DataLoader:
                 except Exception as e:  # surface in main thread
                     out_q.put((i, e))
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self.num_workers)]
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
         for t in threads:
             t.start()
 
@@ -196,7 +221,9 @@ class DataLoader:
                         "pass use_native_ring=False for object batches")
             return leaves, td
 
-        def worker():
+        def worker(wid):
+            _worker_tls.info = WorkerInfo(wid, self.num_workers,
+                                          self.dataset)
             while True:
                 try:
                     i, idxs = work_q.get_nowait()
@@ -217,8 +244,8 @@ class DataLoader:
                     ring.close()
                     return
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self.num_workers)]
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
         for t in threads:
             t.start()
 
@@ -257,7 +284,50 @@ class DataLoader:
             else:
                 ring.destroy()
 
+    def _iter_iterable_workers(self):
+        """Multi-worker IterableDataset: each worker thread iterates the
+        dataset under its own WorkerInfo (datasets shard themselves via
+        get_worker_info, reference semantics) and batches locally."""
+        out_q: queue.Queue = queue.Queue(
+            maxsize=self.prefetch_factor * self.num_workers)
+        done = object()
+
+        def worker(wid):
+            _worker_tls.info = WorkerInfo(wid, self.num_workers,
+                                          self.dataset)
+            try:
+                buf = []
+                for sample in self.dataset:
+                    buf.append(sample)
+                    if len(buf) == self.batch_size:
+                        out_q.put(self.collate_fn(buf))
+                        buf = []
+                if buf and not self.drop_last:
+                    out_q.put(self.collate_fn(buf))
+            except Exception as e:
+                out_q.put(e)
+            finally:
+                out_q.put(done)
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        finished = 0
+        while finished < self.num_workers:
+            item = out_q.get()
+            if item is done:
+                finished += 1
+                continue
+            if isinstance(item, Exception):
+                raise item
+            yield item
+        for t in threads:
+            t.join(timeout=0.1)
+
     def __iter__(self):
+        if self.num_workers and self._iterable_mode:
+            return self._iter_iterable_workers()
         if self.num_workers and not self._iterable_mode:
             use_ring = self.use_native_ring
             if use_ring is None:

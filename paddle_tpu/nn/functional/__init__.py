@@ -25,9 +25,12 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
                    margin_ranking_loss, hinge_embedding_loss,
                    cosine_embedding_loss, triplet_margin_loss, ctc_loss,
                    square_error_cost, log_loss, sigmoid_focal_loss,
-                   npair_loss, dice_loss)
+                   npair_loss, dice_loss, hsigmoid_loss)
+from .activation import tanh_
 from .attention import scaled_dot_product_attention, flash_attention
-from .extension import diag_embed, sequence_mask, temporal_shift
+from .extension import (diag_embed, sequence_mask, temporal_shift,
+                        gather_tree)
+from .vision import affine_grid, grid_sample
 from .sequence import (sequence_pad, sequence_unpad, sequence_pool,
                        sequence_softmax, sequence_reverse, sequence_expand,
                        sequence_concat, sequence_enumerate, sequence_erase,

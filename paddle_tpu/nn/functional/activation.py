@@ -173,3 +173,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def glu(x, axis=-1, name=None):
     return call(lambda a: jax.nn.glu(a, axis=axis), x, _name="glu")
+
+
+# single implementation lives with the other inplace tensor ops
+from ...tensor.math import tanh_  # noqa: E402,F401

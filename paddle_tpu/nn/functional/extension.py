@@ -23,6 +23,31 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     return call(_sm, x, _name="sequence_mask")
 
 
+def gather_tree(ids, parents):
+    """Beam-search ancestry backtrace (ref: fluid gather_tree_op).
+    ids/parents: [max_time, batch, beam_width].  Walks parent pointers from
+    the last step backwards so each beam's full token path is materialized —
+    a reversed lax.scan, compiler-friendly (no host loop)."""
+    import jax.lax as lax
+
+    def _gt(idv, parv):
+        T = idv.shape[0]
+        batch = idv.shape[1]
+
+        def step(beam_idx, t):
+            # beam_idx: [batch, beam] — which original beam each output
+            # slot follows at time t+1; token at t comes from that beam.
+            tok = jnp.take_along_axis(idv[t], beam_idx, axis=-1)
+            nxt = jnp.take_along_axis(parv[t], beam_idx, axis=-1)
+            return nxt, tok
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2], dtype=idv.dtype),
+                                (batch, idv.shape[2]))
+        _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+    return call(_gt, ids, parents, _name="gather_tree")
+
+
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                    name=None):
     def _ts(a):

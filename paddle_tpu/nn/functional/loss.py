@@ -303,3 +303,37 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
 
 def mbce_loss(*a, **k):
     raise NotImplementedError
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (ref:
+    nn/functional/loss.py::hsigmoid_loss / fluid hierarchical_sigmoid_op).
+    The per-sample path from root to leaf is code_len = ceil(log2(C)) long;
+    each internal node contributes a sigmoid CE term.  The unrolled walk is
+    static (code_len is shape-derived), so XLA fuses the whole loss."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom tree not yet supported")
+
+    def _hs(x, lbl, w, b):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        code_len = int(np.ceil(np.log2(num_classes)))
+        node = lbl + num_classes - 1
+        losses = jnp.zeros(lbl.shape[0], x.dtype)
+        for _ in range(code_len):
+            parent = (node - 1) // 2
+            is_right = (node % 2 == 0).astype(x.dtype)
+            valid = (node > 0).astype(x.dtype)
+            logits = jnp.sum(x * w[jnp.maximum(parent, 0)], axis=-1)
+            if b is not None:
+                logits = logits + b[jnp.maximum(parent, 0)]
+            ce = jnp.maximum(logits, 0) - logits * is_right \
+                + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            losses = losses + ce * valid
+            node = parent
+        return losses[:, None]   # per-sample [N, 1], reference shape
+    if bias is not None:
+        return call(_hs, input, label, weight, bias, _name="hsigmoid_loss")
+    return call(lambda x, l, w: _hs(x, l, w, None), input, label, weight,
+                _name="hsigmoid_loss")

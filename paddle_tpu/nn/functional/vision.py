@@ -1,0 +1,142 @@
+"""Vision sampling functionals (ref: python/paddle/nn/functional/vision.py:
+affine_grid / grid_sample over the fluid affine_grid_op / grid_sampler_op
+CUDA kernels).  TPU-native: both ops are pure gather/matmul compositions, so
+they lower to XLA gathers that fuse with surrounding work — no custom kernel
+needed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import call
+
+
+def _base_coords(n, align_corners):
+    """Normalized sample centers along an axis of length n, in [-1, 1]."""
+    if align_corners:
+        if n == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.linspace(-1.0, 1.0, n)
+    # pixel centers: (2i + 1)/n - 1
+    return (2.0 * jnp.arange(n, dtype=jnp.float32) + 1.0) / n - 1.0
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: [N, 2, 3] (4-D out_shape [N,C,H,W]) or [N, 3, 4] (5-D).
+    Returns sampling grid [N, H, W, 2] / [N, D, H, W, 3] for grid_sample."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(s) for s in out_shape.numpy().tolist()]
+    out_shape = [int(s) for s in out_shape]
+
+    def _ag(th):
+        # elementwise multiply-add, NOT a matmul: a [*,3] @ [3,2] contraction
+        # would ride the MXU in bf16 and lose ~3 decimal digits of grid
+        # precision; the VPU fp32 path is exact and just as fused.
+        th = th.astype(jnp.float32)
+        if len(out_shape) == 4:
+            _, _, H, W = out_shape
+            gx, gy = jnp.meshgrid(_base_coords(W, align_corners),
+                                  _base_coords(H, align_corners))  # [H,W]
+            coords = (gx, gy)
+        else:
+            _, _, D, H, W = out_shape
+            gz, gy, gx = jnp.meshgrid(_base_coords(D, align_corners),
+                                      _base_coords(H, align_corners),
+                                      _base_coords(W, align_corners),
+                                      indexing="ij")
+            coords = (gx, gy, gz)
+        nd = len(coords)
+        sp = (1,) * nd
+        out = []
+        for j in range(nd):           # output coordinate channel
+            acc = th[:, j, nd].reshape(-1, *sp)          # translation
+            for k, c in enumerate(coords):
+                acc = acc + th[:, j, k].reshape(-1, *sp) * c[None]
+            out.append(acc)
+        return jnp.stack(out, -1)
+    return call(_ag, theta, _name="affine_grid")
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect(x, lo, hi):
+    """Reflect coordinate into [lo, hi] (torch/paddle reflection rule)."""
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    x = jnp.abs(x - lo) % (2.0 * rng)
+    return lo + jnp.where(x > rng, 2.0 * rng - x, x)
+
+
+def _resolve_coord(c, size, padding_mode, align_corners):
+    """Map an unnormalized (possibly out-of-range) coordinate according to
+    the padding mode.  Returns the coordinate to sample (zeros mode keeps it
+    out of range; validity is masked at gather time)."""
+    if padding_mode == "border":
+        return jnp.clip(c, 0.0, size - 1.0)
+    if padding_mode == "reflection":
+        if align_corners:
+            c = _reflect(c, 0.0, float(size - 1))
+        else:
+            c = _reflect(c, -0.5, size - 0.5)
+        return jnp.clip(c, 0.0, size - 1.0)
+    return c   # zeros
+
+
+def _gather_2d(img, iy, ix, valid):
+    """img: [C, H, W]; iy/ix: [...spatial] int32; valid: bool mask.
+    Out-of-range indices are clamped for the gather and zeroed by mask."""
+    C, H, W = img.shape
+    iyc = jnp.clip(iy, 0, H - 1)
+    ixc = jnp.clip(ix, 0, W - 1)
+    out = img[:, iyc, ixc]                     # [C, ...spatial]
+    return jnp.where(valid[None], out, 0.0)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] with (x, y) in [-1, 1].
+    Bilinear/nearest sampling with zeros/border/reflection padding —
+    numerics match the reference grid_sampler_op (torch-compatible)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def _gs(xv, gv):
+        N, C, H, W = xv.shape
+        gv = gv.astype(jnp.float32)
+        fx = _unnormalize(gv[..., 0], W, align_corners)    # [N,Hg,Wg]
+        fy = _unnormalize(gv[..., 1], H, align_corners)
+        fx = _resolve_coord(fx, W, padding_mode, align_corners)
+        fy = _resolve_coord(fy, H, padding_mode, align_corners)
+
+        def sample_one(img, sx, sy):
+            if mode == "nearest":
+                ix = jnp.round(sx).astype(jnp.int32)
+                iy = jnp.round(sy).astype(jnp.int32)
+                valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+                return _gather_2d(img, iy, ix, valid)
+            x0 = jnp.floor(sx)
+            y0 = jnp.floor(sy)
+            wx = (sx - x0).astype(xv.dtype)
+            wy = (sy - y0).astype(xv.dtype)
+            x0i = x0.astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            out = 0.0
+            for dy, dx, w in ((0, 0, (1 - wy) * (1 - wx)),
+                              (0, 1, (1 - wy) * wx),
+                              (1, 0, wy * (1 - wx)),
+                              (1, 1, wy * wx)):
+                iy = y0i + dy
+                ix = x0i + dx
+                valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+                out = out + w[None] * _gather_2d(img, iy, ix, valid)
+            return out
+
+        return jax.vmap(sample_one)(xv, fx, fy).astype(xv.dtype)
+    return call(_gs, x, grid, _name="grid_sample")

@@ -141,32 +141,9 @@ class HSigmoidLoss(Layer):
             shape=[num_classes - 1], attr=bias_attr, is_bias=True)
 
     def forward(self, input, label):
-        import jax
-        import jax.numpy as jnp
-        from ...ops.dispatch import call
-        num_classes = self._num_classes
-
-        def _hs(x, lbl, w, b):
-            # complete binary tree: internal nodes 0..num_classes-2;
-            # leaf i path derived from (i + num_classes - 1)'s ancestors
-            lbl = lbl.reshape(-1).astype(jnp.int32)
-            code_len = int(np.ceil(np.log2(num_classes)))
-            node = lbl + num_classes - 1
-            losses = jnp.zeros(lbl.shape[0], x.dtype)
-            for _ in range(code_len):
-                parent = (node - 1) // 2
-                is_right = (node % 2 == 0).astype(x.dtype)
-                valid = (node > 0).astype(x.dtype)
-                logits = jnp.sum(x * w[jnp.maximum(parent, 0)], axis=-1) \
-                    + b[jnp.maximum(parent, 0)]
-                # sigmoid CE: right child label 1, left 0
-                ce = jnp.maximum(logits, 0) - logits * is_right \
-                    + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-                losses = losses + ce * valid
-                node = parent
-            return jnp.mean(losses)
-        return call(_hs, input, label, self.weight, self.bias,
-                    _name="hsigmoid_loss")
+        from ..functional.loss import hsigmoid_loss
+        return hsigmoid_loss(input, label, self._num_classes, self.weight,
+                             self.bias)
 
 
 class TripletMarginLoss(Layer):

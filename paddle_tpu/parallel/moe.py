@@ -101,10 +101,14 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=1.25,
     out = jnp.where(keep[:, None], out * gate[:, None].astype(y.dtype),
                     0.0)
 
-    # Switch load-balancing aux loss: E * sum_e f_e * P_e
+    # Switch load-balancing aux loss: E * sum_e f_e * P_e.  frac/mean_p are
+    # shard-local statistics — pmean them so the returned scalar is truly
+    # replicated (an unreduced value under a replicated out-spec would make
+    # the backward psum inconsistent with the forward value).
     frac = jnp.mean(onehot.astype(jnp.float32), axis=0)       # [E]
     mean_p = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * mean_p)
+    aux = jax.lax.pmean(aux, axis_name)
     return out.astype(x.dtype), aux
 
 

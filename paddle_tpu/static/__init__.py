@@ -6,6 +6,10 @@ from .graph import (Program, Executor, CompiledProgram, BuildStrategy,
                     _set_static_mode)
 from . import nn
 from .control_flow import cond, while_loop, case, switch_case
+from .backward import append_backward, gradients
+from .misc import (Variable, WeightNormParamAttr, Print, py_func,
+                   create_global_var, name_scope, cpu_places, cuda_places,
+                   load_program_state, set_program_state)
 from ..jit.api import InputSpec
 
 

@@ -1,0 +1,62 @@
+"""Static-graph backward API (ref: python/paddle/fluid/backward.py:
+append_backward / gradients build an explicit reverse op-graph of *_grad
+ops).  TPU-native: no reverse graph exists — each grad var is a placeholder
+whose value the Executor computes by differentiating the recorded replay
+with jax.grad at fetch time (graph.py::eval_fetch).  The cut-based replay
+(Program.replay_cut) makes intermediates differentiable targets too."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor.tensor import Tensor, Parameter
+from .graph import default_main_program, _ensure_var_id
+
+
+def _mint_grad_var(program, target, wrt, seed=None):
+    tgt_id = _ensure_var_id(target, program)
+    wrt_id = _ensure_var_id(wrt, program)
+    import jax.numpy as jnp
+    g = Tensor(jnp.zeros(tuple(wrt.shape), wrt.dtype))
+    g.stop_gradient = True
+    g.name = (getattr(wrt, "name", None) or f"var_{wrt_id}") + "@GRAD"
+    gid = _ensure_var_id(g, program)
+    seed_val = None
+    if seed is not None:
+        seed_val = seed.value if isinstance(seed, Tensor) else np.asarray(seed)
+    program.grad_map[gid] = (tgt_id, wrt_id, seed_val)
+    return g
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Returns [(parameter, grad_var)] like the reference; fetch the grad
+    vars through Executor.run to evaluate them."""
+    program = default_main_program()
+    if parameter_list is None:
+        parameter_list = [program.params[i] for i in sorted(program.params)]
+    no_grad = set(id(v) for v in (no_grad_set or ()))
+    out = []
+    for p in parameter_list:
+        if id(p) in no_grad or not getattr(p, "trainable", True):
+            continue
+        out.append((p, _mint_grad_var(program, loss, p)))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs); non-scalar targets are seeded with
+    target_gradients (default: ones, i.e. grad of sum — reference
+    semantics)."""
+    program = default_main_program()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    elif not isinstance(target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    if len(targets) != 1:
+        raise NotImplementedError(
+            "multiple targets: call gradients once per target and add_n")
+    no_grad = set(id(v) for v in (no_grad_set or ()))
+    return [_mint_grad_var(program, targets[0], x, target_gradients[0])
+            for x in inputs if id(x) not in no_grad]

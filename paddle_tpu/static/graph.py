@@ -66,6 +66,10 @@ class Program:
         self.train_spec = None  # (loss_var_id, optimizer)
         self.fetch_cache = {}
         self.random_seed = None
+        # grad_vid -> (target_vid, wrt_vid, seed_or_None): placeholders
+        # minted by append_backward/gradients, realized at fetch time by
+        # differentiating the replay (backward.py)
+        self.grad_map = {}
 
     def record(self, fn, treedef, leaf_specs, out_ids, name):
         self.ops.append(OpRecord(fn, treedef, leaf_specs, out_ids, name))
@@ -78,6 +82,7 @@ class Program:
         p.params = dict(self.params)
         p.var_meta = dict(self.var_meta)
         p.captured = dict(self.captured)
+        p.grad_map = dict(self.grad_map)
         if not for_test:
             p.train_spec = self.train_spec
         return p
@@ -92,36 +97,47 @@ class Program:
     def list_vars(self):
         return list(self.var_meta.keys())
 
-    def replay(self, env):
+    def lookup(self, env, vid):
+        """Resolve a var id: the env, then build-time captures (layer
+        BUFFERS like BN running stats, eager tensors), then the weakref
+        registry — non-env hits ride into the program as constants,
+        matching the reference's persistable-non-param vars."""
+        if vid in env:
+            return env[vid]
+        if vid in self.captured:
+            return self.captured[vid].value
+        wr = _var_tensors.get(vid)
+        t = wr() if wr is not None else None
+        if t is None:
+            raise KeyError(
+                f"program replay: var id {vid} is neither in the env "
+                "nor alive as a build tensor")
+        return t.value
+
+    def replay(self, env, skip_out=None):
         """env: var_id -> concrete/traced value.  Mutates env with outputs.
-        Var-ids absent from env (layer BUFFERS like BN running stats, or
-        eager tensors captured at build) resolve to their current value
-        via the weakref registry — they ride into the program as
-        constants, matching the reference's persistable-non-param vars."""
+        With ``skip_out``, that var's produced value is discarded (the
+        pre-seeded env value stays — see replay_cut)."""
         for op in self.ops:
-            leaves = []
-            for kind, ref in op.leaf_specs:
-                if kind == "var":
-                    if ref in env:
-                        leaves.append(env[ref])
-                    elif ref in self.captured:
-                        leaves.append(self.captured[ref].value)
-                    else:
-                        wr = _var_tensors.get(ref)
-                        t = wr() if wr is not None else None
-                        if t is None:
-                            raise KeyError(
-                                f"program replay: var id {ref} is neither "
-                                "in the env nor alive as a build tensor")
-                        leaves.append(t.value)
-                else:
-                    leaves.append(ref)
+            leaves = [self.lookup(env, ref) if kind == "var" else ref
+                      for kind, ref in op.leaf_specs]
             args, kwargs = jax.tree_util.tree_unflatten(op.treedef, leaves)
             out = op.fn(*args, **kwargs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             for oid, o in zip(op.out_ids, outs):
-                env[oid] = o
+                if oid != skip_out:
+                    env[oid] = o
         return env
+
+    def replay_cut(self, env, cut_id, cut_val):
+        """Replay with var ``cut_id`` pinned to ``cut_val``: every read of
+        the var sees cut_val, and the op that produces it has its output
+        discarded.  Differentiating the result w.r.t. cut_val yields the
+        adjoint at that node — how append_backward/gradients differentiate
+        w.r.t. intermediates without a reverse op graph (the reference
+        builds explicit *_grad ops; XLA's autodiff replaces that)."""
+        env[cut_id] = cut_val
+        return self.replay(env, skip_out=cut_id)
 
 
 _default_main = [Program()]
@@ -136,7 +152,7 @@ def default_startup_program():
     return _default_startup[0]
 
 
-def set_program_state(main=None, startup=None):
+def _set_default_programs(main=None, startup=None):
     if main is not None:
         _default_main[0] = main
     if startup is not None:
@@ -303,6 +319,22 @@ class Executor:
             program.replay(env)
             return env
 
+        def eval_fetch(env, fid, feed_vals, param_vals):
+            """A fetch id minted by append_backward/gradients resolves to
+            d(target)/d(wrt): re-replay with the wrt var cut and let XLA
+            differentiate (the two replays CSE away under jit)."""
+            if fid not in program.grad_map:
+                return env[fid]
+            tgt_id, wrt_id, seed = program.grad_map[fid]
+
+            def scalar_of(wv):
+                env2 = dict(zip(feed_var_ids, feed_vals))
+                env2.update(dict(zip(param_ids, param_vals)))
+                program.replay_cut(env2, wrt_id, wv)
+                t = env2[tgt_id]
+                return jnp.sum(t) if seed is None else jnp.sum(t * seed)
+            return jax.grad(scalar_of)(program.lookup(env, wrt_id))
+
         if program.train_spec is not None:
             loss_id, opt = program.train_spec
 
@@ -314,14 +346,16 @@ class Executor:
                     lambda pv: loss_of(pv), has_aux=True)(list(param_vals))
                 new_params, new_states = opt.apply_updates_pytree(
                     list(param_vals), grads, states, lr, t)
-                fetches = tuple(env[i] for i in fetch_ids)
+                fetches = tuple(eval_fetch(env, i, feed_vals, param_vals)
+                                for i in fetch_ids)
                 return fetches, new_params, new_states
 
             return jax.jit(train_step)
 
         def infer(feed_vals, param_vals):
             env = forward(feed_vals, param_vals)
-            return tuple(env[i] for i in fetch_ids)
+            return tuple(eval_fetch(env, i, feed_vals, param_vals)
+                         for i in fetch_ids)
         return jax.jit(infer)
 
     def close(self):

@@ -1,0 +1,184 @@
+"""Static long-tail API: Print, py_func, create_global_var, name_scope,
+places, program-state io (ref: python/paddle/static/__init__.py re-exports
+of fluid layers.Print / layers.py_func / layer_helper create_global_var /
+framework.name_scope / io.load_program_state)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..framework.param_attr import ParamAttr
+from ..ops.dispatch import call
+from ..tensor.tensor import Tensor, Parameter
+from .graph import (default_main_program, global_scope, _ensure_var_id,
+                    Program)
+
+# the reference's Variable class IS the static tensor; here one Tensor type
+# serves eager and static (record) modes
+Variable = Tensor
+
+
+class WeightNormParamAttr(ParamAttr):
+    """ref: fluid/param_attr.py::WeightNormParamAttr — marks a parameter
+    for weight normalization along ``dim`` (consumed by nn.utils.weight_norm)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable,
+                         do_model_average=do_model_average,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """ref: fluid/layers/control_flow.py::Print — debug-print a var at
+    execution time.  jax.debug.print works identically eager and inside the
+    jitted replay (XLA host callback), so one path serves both modes."""
+    tag = message or getattr(input, "name", None) or "var"
+
+    def _p(x):
+        jax.debug.print(tag + ": {}", x)
+        return x + 0
+    return call(_p, input, _name="print")
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """ref: fluid/layers/nn.py::py_func — embed arbitrary host Python in the
+    graph.  TPU-native: jax.pure_callback ships the op to the host from
+    inside the compiled program; backward_func (if given) rides a
+    custom_vjp whose bwd is another host callback, called with
+    (*inputs, *outputs, *out_grads) minus any skipped vars."""
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    single_out = not isinstance(out, (list, tuple))
+    out_shapes = tuple(
+        jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype))
+        for o in outs)
+    skipped = set(id(v) for v in (skip_vars_in_backward_input or ()))
+
+    def np_fwd(*vals):
+        r = func(*vals)
+        rs = r if isinstance(r, (list, tuple)) else (r,)
+        return tuple(np.asarray(v) for v in rs)
+
+    def fwd_jax(*vals):
+        return jax.pure_callback(np_fwd, out_shapes, *vals)
+
+    if backward_func is None:
+        fn = fwd_jax
+    else:
+        fn = jax.custom_vjp(fwd_jax)
+
+        def _fwd(*vals):
+            o = fwd_jax(*vals)
+            return o, (vals, o)
+
+        def _bwd(res, gs):
+            vals, o = res
+            bwd_in = [v for t, v in zip(xs, vals) if id(t) not in skipped]
+            bwd_in += [v for t, v in zip(outs, o) if id(t) not in skipped]
+            bwd_in += list(gs)
+            in_shapes = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                              for v in vals)
+
+            def np_bwd(*bv):
+                r = backward_func(*bv)
+                rs = r if isinstance(r, (list, tuple)) else (r,)
+                return tuple(np.asarray(v) for v in rs)
+            return jax.pure_callback(np_bwd, in_shapes, *bwd_in)
+
+        fn.defvjp(_fwd, _bwd)
+
+    result = call(fn, *xs, _name="py_func")
+    results = result if isinstance(result, (list, tuple)) else [result]
+    # the reference writes results INTO the out vars; mirror that so code
+    # holding the templates sees the values
+    for tpl, r in zip(outs, results):
+        tpl._rebind(r)
+    return outs[0] if single_out else outs
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """ref: fluid/layer_helper_base.py::create_global_var — a persistent
+    non-parameter var, registered in the global scope by name."""
+    dt = core.convert_dtype(dtype)
+    t = Tensor(jnp.full([int(s) for s in shape], value, dt))
+    t.stop_gradient = True
+    t.name = name or f"global_var_{id(t)}"
+    global_scope()._vars[t.name] = t
+    prog = default_main_program()
+    vid = _ensure_var_id(t, prog)
+    prog.captured[vid] = t
+    return t
+
+
+_name_scope_stack: list[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """ref: fluid/framework.py::name_scope — hierarchical op-name prefix
+    (debugging/profiler aid)."""
+    _name_scope_stack.append(str(prefix or "scope"))
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+def current_name_scope():
+    return "/".join(_name_scope_stack)
+
+
+def cpu_places(device_count=None):
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [core.CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """The accelerator places: TPU chips here (ref returns CUDAPlace per
+    visible GPU)."""
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [core.TPUPlace(i) for i in device_ids]
+
+
+def load_program_state(model_path, var_list=None):
+    """Load a ``static.save`` checkpoint as {name: ndarray} (ref:
+    python/paddle/fluid/io.py::load_program_state)."""
+    from ..io.serialization import load as _load
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    state = _load(path)
+    out = {}
+    for k, v in state.items():
+        arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+        out[k] = arr
+    return out
+
+
+def set_program_state(program, state_dict):
+    """Assign a load_program_state dict into a Program's parameters (ref:
+    fluid/io.py::set_program_state).  Matches by param name, falling back
+    to the positional ``param_{i}`` names static.save writes."""
+    params = program.all_parameters()
+    by_name = {getattr(p, "name", None): p for p in params}
+    for i, p in enumerate(params):
+        by_name.setdefault(f"param_{i}", p)
+    for k, v in state_dict.items():
+        p = by_name.get(k)
+        if p is not None:
+            p.set_value(np.asarray(v))

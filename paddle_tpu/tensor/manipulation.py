@@ -376,3 +376,7 @@ _install()
 def _i64():
     from ..framework import core as _c
     return _c.convert_dtype("int64")
+
+
+# legacy 1.x name (ref: fluid/layers/nn.py::crop_tensor)
+crop_tensor = crop

@@ -407,3 +407,23 @@ _install()
 def _i64():
     from ..framework import core as _c
     return _c.convert_dtype("int64")
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (ref: paddle.add_n / fluid sum_op)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if len(inputs) == 1:
+        return call(lambda a: a + 0, inputs[0], _name="add_n")
+    import functools as _ft
+    return call(lambda *xs: _ft.reduce(jnp.add, xs), *inputs, _name="add_n")
+
+
+def cast(x, dtype, name=None):
+    from ..framework import core
+    dt = core.convert_dtype(dtype)
+    return call(lambda a: a.astype(dt), x, _name="cast")
+
+
+def tanh_(x, name=None):
+    return x._rebind(tanh(x))

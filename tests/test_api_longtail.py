@@ -1,0 +1,252 @@
+"""Long-tail API parity: vision sampling functionals, static backward /
+py_func / program-state surface, top-level aliases, DataLoader worker info.
+
+Goldens: torch-cpu for grid_sample/affine_grid (the reference's
+grid_sampler_op is torch-compatible), jax.grad for static backward.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+
+torch = pytest.importorskip("torch")
+
+
+class TestVisionFunctionals:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("ac", [True, False])
+    def test_grid_sample_vs_torch(self, mode, pad, ac):
+        x = np.random.RandomState(0).randn(2, 3, 5, 7).astype("float32")
+        g = (np.random.RandomState(1).rand(2, 4, 6, 2)
+             .astype("float32") * 2.4 - 1.2)   # includes out-of-range
+        ours = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                             mode=mode, padding_mode=pad,
+                             align_corners=ac).numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(g), mode=mode, padding_mode=pad,
+            align_corners=ac).numpy()
+        np.testing.assert_allclose(ours, ref, atol=2e-5)
+
+    @pytest.mark.parametrize("ac", [True, False])
+    def test_affine_grid_vs_torch(self, ac):
+        th = np.random.RandomState(2).randn(2, 2, 3).astype("float32")
+        ours = F.affine_grid(paddle.to_tensor(th), [2, 3, 4, 5],
+                             align_corners=ac).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(th), [2, 3, 4, 5], align_corners=ac).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("ac", [True, False])
+    def test_affine_grid_3d(self, ac):
+        th = np.random.RandomState(3).randn(2, 3, 4).astype("float32")
+        ours = F.affine_grid(paddle.to_tensor(th), [2, 3, 2, 4, 5],
+                             align_corners=ac).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(th), [2, 3, 2, 4, 5], align_corners=ac).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_grid_sample_grad(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(1, 2, 4, 4).astype("float32"))
+        x.stop_gradient = False
+        g = paddle.to_tensor(
+            (np.random.RandomState(5).rand(1, 3, 3, 2) * 1.8 - 0.9)
+            .astype("float32"))
+        out = F.grid_sample(x, g)
+        out.sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_gather_tree(self):
+        ids = np.array([[[2, 3], [4, 5]], [[6, 7], [8, 9]]], np.int64)
+        par = np.array([[[0, 0], [1, 0]], [[1, 0], [0, 1]]], np.int64)
+        out = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(par)).numpy()
+        exp = np.zeros_like(ids)
+        T, B, W = ids.shape
+        for b in range(B):
+            for w in range(W):
+                beam = w
+                for t in range(T - 1, -1, -1):
+                    exp[t, b, w] = ids[t, b, beam]
+                    beam = par[t, b, beam]
+        np.testing.assert_array_equal(out, exp)
+
+    def test_hsigmoid_loss_functional(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(6).randn(4, 8).astype("float32"))
+        lbl = paddle.to_tensor(np.array([0, 3, 5, 9]))
+        w = paddle.to_tensor(
+            np.random.RandomState(7).randn(9, 8).astype("float32") * 0.1)
+        loss = F.hsigmoid_loss(x, lbl, 10, w)
+        assert loss.shape == [4, 1]
+        assert (loss.numpy() > 0).all()
+
+
+class TestTopLevelAliases:
+    def test_add_n_cast_inverse_rank(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose(paddle.add_n([a, b]).numpy(), [4.0, 6.0])
+        assert "int" in str(paddle.cast(a, "int64").dtype)
+        m = paddle.to_tensor([[2.0, 0.0], [0.0, 4.0]])
+        np.testing.assert_allclose(paddle.inverse(m).numpy(),
+                                   [[0.5, 0.0], [0.0, 0.25]])
+        assert int(paddle.rank(m)) == 2
+
+    def test_add_n_grad(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        a.stop_gradient = False
+        out = paddle.add_n([a, a])
+        out.sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [2.0, 2.0])
+
+    def test_inplace_tanh(self):
+        t = paddle.to_tensor([0.5])
+        r = paddle.tanh_(t)
+        np.testing.assert_allclose(t.numpy(), np.tanh([0.5]), atol=1e-6)
+        assert r is t
+
+    def test_create_parameter(self):
+        w = paddle.create_parameter([3, 4], "float32")
+        assert w.shape == [3, 4] and w.trainable
+        b = paddle.create_parameter([4], "float32", is_bias=True)
+        np.testing.assert_allclose(b.numpy(), np.zeros(4))
+
+    def test_legacy_aliases(self):
+        assert paddle.VarBase is paddle.Tensor
+        assert isinstance(paddle.NPUPlace(0), paddle.TPUPlace)
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        paddle.set_printoptions(precision=4)
+        crop = paddle.crop_tensor(paddle.to_tensor(np.arange(12.).reshape(3, 4)),
+                                  shape=[2, 2], offsets=[1, 1])
+        np.testing.assert_allclose(crop.numpy(), [[5., 6.], [9., 10.]])
+
+
+class TestStaticBackward:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_append_backward_and_gradients(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 3], "float32")
+            w = paddle.create_parameter([3, 2], "float32")
+            y = paddle.matmul(x, w)
+            loss = paddle.mean(paddle.tanh(y) ** 2)
+            pairs = static.append_backward(loss)
+            (gy,) = static.gradients(loss, [y])
+            exe = static.Executor()
+            xv = np.random.RandomState(0).randn(4, 3).astype("float32")
+            lossv, gw, gyv = exe.run(prog, feed={"x": xv},
+                                     fetch_list=[loss, pairs[0][1], gy])
+        import jax, jax.numpy as jnp
+        wv = np.asarray(w.numpy())
+        g_ref = jax.grad(lambda W: jnp.mean(jnp.tanh(xv @ W) ** 2))(wv)
+        np.testing.assert_allclose(gw, g_ref, atol=1e-5)
+        gy_ref = jax.grad(lambda Y: jnp.mean(jnp.tanh(Y) ** 2))(xv @ wv)
+        np.testing.assert_allclose(gyv, gy_ref, atol=1e-5)
+
+    def test_py_func_forward_backward(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3], "float32")
+            out = static.create_global_var([3], 0.0, "float32")
+            r = static.py_func(lambda a: np.sin(a), x, out,
+                               backward_func=lambda a, o, do: np.cos(a) * do)
+            (gx,) = static.gradients(paddle.sum(r), [x])
+            exe = static.Executor()
+            xv = np.array([0.1, 0.2, 0.3], np.float32)
+            rv, gxv = exe.run(prog, feed={"x": xv}, fetch_list=[r, gx])
+        np.testing.assert_allclose(rv, np.sin(xv), atol=1e-6)
+        np.testing.assert_allclose(gxv, np.cos(xv), atol=1e-6)
+
+    def test_gradients_wrt_captured_var(self):
+        # regression: the wrt var lives in program.captured (not produced
+        # by any op, not a feed/param) — eval_fetch must resolve it via
+        # the same fallback chain as replay
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3], "float32")
+            v = static.create_global_var([3], 2.0, "float32")
+            loss = paddle.sum(x * v * v)
+            (gv,) = static.gradients(loss, [v])
+            exe = static.Executor()
+            xv = np.array([1.0, 2.0, 3.0], np.float32)
+            (gvv,) = exe.run(prog, feed={"x": xv}, fetch_list=[gv])
+        np.testing.assert_allclose(gvv, 2 * 2.0 * xv, atol=1e-6)
+
+    def test_program_state_roundtrip(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            w = paddle.create_parameter([2, 2], "float32")
+        path = os.path.join(tempfile.mkdtemp(), "model")
+        static.save(prog, path)
+        state = static.load_program_state(path)
+        orig = dict(state)
+        static.set_program_state(prog, {k: np.zeros_like(v)
+                                        for k, v in state.items()})
+        assert float(np.abs(np.asarray(w.numpy())).sum()) == 0.0
+        static.set_program_state(prog, orig)
+        assert float(np.abs(np.asarray(w.numpy())).sum()) > 0.0
+
+    def test_print_and_places_and_scope(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            y = static.Print(x, message="dbg")
+            exe = static.Executor()
+            (yv,) = exe.run(prog, feed={"x": np.ones(2, np.float32)},
+                            fetch_list=[y])
+        np.testing.assert_allclose(yv, [1.0, 1.0])
+        assert static.cpu_places()
+        assert static.cuda_places()
+        with static.name_scope("blk"):
+            from paddle_tpu.static.misc import current_name_scope
+            assert "blk" in current_name_scope()
+        assert static.Variable is paddle.Tensor
+        assert static.WeightNormParamAttr(dim=0).dim == 0
+
+
+class TestWorkerInfo:
+    def test_main_thread_none(self):
+        assert paddle.io.get_worker_info() is None
+
+    def test_iterable_sharding(self):
+        class DS(paddle.io.IterableDataset):
+            def __iter__(self):
+                wi = paddle.io.get_worker_info()
+                for i in range(wi.id, 10, wi.num_workers):
+                    yield np.float32(i)
+
+        dl = paddle.io.DataLoader(DS(), batch_size=2, num_workers=2)
+        vals = sorted(float(v) for b in dl for v in b.numpy().ravel())
+        assert vals == [float(i) for i in range(10)]
+
+    def test_map_style_worker_info_set(self):
+        seen = []
+
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                wi = paddle.io.get_worker_info()
+                seen.append(None if wi is None else wi.id)
+                return np.float32(i)
+
+        dl = paddle.io.DataLoader(DS(), batch_size=2, num_workers=2,
+                                  use_native_ring=False)
+        n = sum(int(np.asarray(b.numpy()).size) for b in dl)
+        assert n == 8
+        assert any(w is not None for w in seen)

@@ -271,7 +271,8 @@ class TestLosses:
     def test_hsigmoid(self):
         hs = nn.HSigmoidLoss(8, 10)
         loss = hs(randt(4, 8), paddle.to_tensor(np.array([1, 5, 3, 9])))
-        assert np.isfinite(loss.numpy())
+        assert loss.shape == [4, 1]   # per-sample cost, reference shape
+        assert np.isfinite(loss.numpy()).all()
 
 
 class TestContainersStateDict:
