@@ -171,12 +171,14 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     """Standalone learnable parameter (ref: paddle.create_parameter /
-    fluid layer_helper_base.create_parameter)."""
-    import numpy as _np
+    fluid layer_helper_base.create_parameter).  Same precedence as
+    Layer.create_parameter: attr.initializer > default_initializer >
+    Constant(0) for biases / XavierUniform for weights."""
     from .nn import initializer as _I
     from .framework.param_attr import ParamAttr as _PA
     attr = _PA._to_attr(attr)
-    init = default_initializer or (attr.initializer if attr else None)
+    init = (attr.initializer if attr is not None and attr.initializer
+            is not None else default_initializer)
     if init is None:
         init = _I.Constant(0.0) if is_bias else _I.XavierUniform()
     dt = _core.convert_dtype(dtype)
@@ -185,7 +187,12 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
         p.name = attr.name
     elif name:
         p.name = name
-    p.trainable = attr.trainable if attr is not None else True
+    if attr is not None:
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.trainable = attr.trainable
+        p.stop_gradient = not attr.trainable
+        p.need_clip = attr.need_clip
     # in static mode the parameter belongs to the program even before any
     # op touches it (ref: layer_helper registers into the startup program)
     from .static.graph import in_static_mode, default_main_program, \

@@ -180,3 +180,65 @@ class Categorical(Distribution):
 def kl_divergence(p: Distribution, q: Distribution):
     """Module-level dispatcher (ref distribution.py exposes per-class)."""
     return p.kl_divergence(q)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) (ref distribution.py's MultivariateNormalDiag):
+    a diagonal-covariance Gaussian — all math stays per-dimension, so it is
+    elementwise + a reduce (no cholesky needed)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)   # diagonal entries
+        self.name = name or "MultivariateNormalDiag"
+
+    @property
+    def _d(self):
+        return self.loc.shape[-1]
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        bshape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        z = jax.random.normal(self._key(seed), shape + bshape,
+                              dtype=jnp.result_type(self.loc, self.scale))
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale * self.scale
+        per_dim = (-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale)
+                   - 0.5 * math.log(2 * math.pi))
+        return Tensor(jnp.sum(per_dim, axis=-1))
+
+    def entropy(self):
+        bshape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        per_dim = 0.5 + 0.5 * math.log(2 * math.pi) \
+            + jnp.log(jnp.broadcast_to(self.scale, bshape))
+        return Tensor(jnp.sum(per_dim, axis=-1))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, MultivariateNormalDiag)
+        v1 = self.scale ** 2
+        v2 = other.scale ** 2
+        per_dim = (jnp.log(other.scale) - jnp.log(self.scale)
+                   + (v1 + (self.loc - other.loc) ** 2) / (2 * v2) - 0.5)
+        return Tensor(jnp.sum(per_dim, axis=-1))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """Sample one category index per row of a probability matrix
+    (ref: fluid/layers/nn.py::sampling_id; the fluid op draws one uniform
+    per row and walks the CDF — here jax.random.categorical on log-probs,
+    one fused pass)."""
+    from .ops.dispatch import call as _call
+    from .framework.core import next_rng_key, convert_dtype
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+
+    def _sid(p):
+        logp = jnp.log(jnp.maximum(p.astype(jnp.float32), 1e-30))
+        idx = jax.random.categorical(key, logp, axis=-1)
+        return idx.astype(convert_dtype(dtype))
+    return _call(_sid, x, _name="sampling_id")
+
+
+__all__ += ["MultivariateNormalDiag", "sampling_id"]

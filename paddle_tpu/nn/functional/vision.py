@@ -106,6 +106,11 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         raise ValueError(f"unsupported mode {mode!r}")
     if padding_mode not in ("zeros", "border", "reflection"):
         raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+    ndim = len(x.shape) if hasattr(x, "shape") else x.ndim
+    if ndim != 4:
+        raise NotImplementedError(
+            f"grid_sample supports 4-D [N,C,H,W] input, got {ndim}-D; "
+            "volumetric (5-D) sampling is not implemented")
 
     def _gs(xv, gv):
         N, C, H, W = xv.shape

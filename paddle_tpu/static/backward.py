@@ -58,5 +58,8 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         raise NotImplementedError(
             "multiple targets: call gradients once per target and add_n")
     no_grad = set(id(v) for v in (no_grad_set or ()))
-    return [_mint_grad_var(program, targets[0], x, target_gradients[0])
-            for x in inputs if id(x) not in no_grad]
+    # one entry PER input, None for excluded vars — positional alignment is
+    # part of the reference contract
+    return [None if id(x) in no_grad
+            else _mint_grad_var(program, targets[0], x, target_gradients[0])
+            for x in inputs]

@@ -22,19 +22,9 @@ from .graph import (default_main_program, global_scope, _ensure_var_id,
 Variable = Tensor
 
 
-class WeightNormParamAttr(ParamAttr):
-    """ref: fluid/param_attr.py::WeightNormParamAttr — marks a parameter
-    for weight normalization along ``dim`` (consumed by nn.utils.weight_norm)."""
-
-    def __init__(self, dim=None, name=None, initializer=None,
-                 learning_rate=1.0, regularizer=None, trainable=True,
-                 do_model_average=False, need_clip=True):
-        super().__init__(name=name, initializer=initializer,
-                         learning_rate=learning_rate, regularizer=regularizer,
-                         trainable=trainable,
-                         do_model_average=do_model_average,
-                         need_clip=need_clip)
-        self.dim = dim
+# one class, one identity — isinstance checks must see the same type
+# whether imported from static or framework
+from ..framework.param_attr import WeightNormParamAttr  # noqa: E402,F401
 
 
 def Print(input, first_n=-1, message=None, summarize=20,

@@ -26,3 +26,23 @@ def deprecated(update_to="", since="", reason=""):
             return fn(*args, **kwargs)
         return wrapper
     return decorate
+
+
+def require_version(min_version, max_version=None):
+    """ref: python/paddle/utils/__init__.py::require_version — raise unless
+    the installed version is inside [min_version, max_version]."""
+    from .. import __version__
+
+    def _parts(v, width):
+        ps = [int(p) for p in str(v).split(".") if p.isdigit()]
+        return ps + [0] * (width - len(ps))   # "0.1" == "0.1.0"
+    w = max(len(str(v).split(".")) for v in
+            (__version__, min_version, max_version or "0"))
+    cur = _parts(__version__, w)
+    if _parts(min_version, w) > cur:
+        raise Exception(
+            f"paddle_tpu version {__version__} < required {min_version}")
+    if max_version is not None and _parts(max_version, w) < cur:
+        raise Exception(
+            f"paddle_tpu version {__version__} > allowed {max_version}")
+    return True

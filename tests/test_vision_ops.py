@@ -1,0 +1,241 @@
+"""paddle.vision.ops: deform_conv2d vs a naive numpy golden, YOLO box
+decode invariants, yolo_loss behavior, host image io; plus the
+distribution long-tail (MultivariateNormalDiag, sampling_id)."""
+import io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _naive_deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                         dilation=1, dg=1, groups=1, mask=None):
+    """Straight-loop reference implementation."""
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, Kh, Kw = weight.shape
+    sh = sw = stride
+    ph = pw = padding
+    dh = dw = dilation
+    Ho = (H + 2 * ph - (dh * (Kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (Kw - 1) + 1)) // sw + 1
+    K = Kh * Kw
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    msk = (mask.reshape(N, dg, K, Ho, Wo) if mask is not None
+           else np.ones((N, dg, K, Ho, Wo), np.float32))
+    out = np.zeros((N, Cout, Ho, Wo), np.float32)
+    cg = Cin // dg
+    cpg = Cin // groups       # channels per conv group
+
+    def bil(img, y, x_):
+        if y <= -1 or y >= img.shape[0] or x_ <= -1 or x_ >= img.shape[1]:
+            return 0.0
+        y0, x0 = int(np.floor(y)), int(np.floor(x_))
+        wy, wx = y - y0, x_ - x0
+        v = 0.0
+        for ddy, ddx, w_ in ((0, 0, (1 - wy) * (1 - wx)),
+                             (0, 1, (1 - wy) * wx),
+                             (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
+            yy, xx = y0 + ddy, x0 + ddx
+            if 0 <= yy < img.shape[0] and 0 <= xx < img.shape[1]:
+                v += w_ * img[yy, xx]
+        return v
+
+    for n in range(N):
+        for m in range(Cout):
+            g = m // (Cout // groups)
+            for ho in range(Ho):
+                for wo in range(Wo):
+                    acc = 0.0
+                    for ci in range(Cin_g):
+                        c = g * cpg + ci
+                        dgi = c // cg
+                        for ki in range(Kh):
+                            for kj in range(Kw):
+                                k = ki * Kw + kj
+                                y = (ho * sh - ph + ki * dh
+                                     + off[n, dgi, k, 0, ho, wo])
+                                x_ = (wo * sw - pw + kj * dw
+                                      + off[n, dgi, k, 1, ho, wo])
+                                acc += (weight[m, ci, ki, kj]
+                                        * bil(x[n, c], y, x_)
+                                        * msk[n, dgi, k, ho, wo])
+                    out[n, m, ho, wo] = acc
+            if bias is not None:
+                out[n, m] += bias[m]
+    return out
+
+
+class TestDeformConv:
+    @pytest.mark.parametrize("use_mask", [False, True])
+    def test_vs_naive(self, use_mask):
+        rng = np.random.RandomState(0)
+        N, Cin, H, W, Cout, Kh = 1, 2, 5, 5, 3, 3
+        x = rng.randn(N, Cin, H, W).astype("float32")
+        w = rng.randn(Cout, Cin, Kh, Kh).astype("float32") * 0.3
+        b = rng.randn(Cout).astype("float32")
+        off = rng.randn(N, 2 * Kh * Kh, H, W).astype("float32") * 0.5
+        m = (rng.rand(N, Kh * Kh, H, W).astype("float32")
+             if use_mask else None)
+        ours = V.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+            paddle.to_tensor(b), padding=1,
+            mask=None if m is None else paddle.to_tensor(m)).numpy()
+        ref = _naive_deform_conv2d(x, off, w, b, padding=1, mask=m)
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_zero_offset_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32") * 0.2
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        ours = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                               paddle.to_tensor(w), padding=1).numpy()
+        conv = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        padding=1).numpy()
+        np.testing.assert_allclose(ours, conv, atol=1e-4)
+
+    def test_layer_and_grad(self):
+        layer = V.DeformConv2D(3, 4, 3, padding=1)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 3, 6, 6).astype("float32"))
+        x.stop_gradient = False
+        off = paddle.to_tensor(
+            np.random.RandomState(3).randn(1, 18, 6, 6).astype("float32")
+            * 0.1)
+        off.stop_gradient = False
+        out = layer(x, off)
+        assert out.shape == [1, 4, 6, 6]
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.isfinite(off.grad.numpy()).all()
+        assert layer.weight.grad is not None
+
+
+class TestYolo:
+    def _head(self, rng, N=2, S=3, cls=4, H=5):
+        return rng.randn(N, S * (5 + cls), H, H).astype("float32") * 0.5
+
+    def test_yolo_box_shapes_and_range(self):
+        rng = np.random.RandomState(0)
+        x = self._head(rng)
+        img = np.array([[320, 480], [320, 480]], np.int32)
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img),
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   class_num=4, conf_thresh=0.0,
+                                   downsample_ratio=32)
+        b, s = boxes.numpy(), scores.numpy()
+        assert b.shape == (2, 3 * 5 * 5, 4) and s.shape == (2, 75, 4)
+        assert (b[..., 0] >= 0).all() and (b[..., 2] <= 479).all()
+        assert (b[..., 1] >= 0).all() and (b[..., 3] <= 319).all()
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_yolo_box_conf_thresh_zeroes(self):
+        rng = np.random.RandomState(1)
+        x = self._head(rng)
+        img = np.full((2, 2), 320, np.int32)
+        _, s_all = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                              [10, 13, 16, 30, 33, 23], 4, 0.0, 32)
+        b_hi, s_hi = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                [10, 13, 16, 30, 33, 23], 4, 0.999, 32)
+        assert np.abs(s_hi.numpy()).sum() < np.abs(s_all.numpy()).sum()
+        assert (np.abs(b_hi.numpy()).sum(-1) > 0).mean() < 0.05
+
+    def test_yolo_loss_finite_and_positive(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(self._head(rng, N=2))
+        gt = np.zeros((2, 3, 4), np.float32)
+        gt[:, 0] = [0.5, 0.5, 0.3, 0.4]      # one real box; rest padding
+        lbl = np.zeros((2, 3), np.int64)
+        loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                           anchors=[10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+                                    59, 119, 116, 90, 156, 198, 373, 326],
+                           anchor_mask=[6, 7, 8], class_num=4,
+                           ignore_thresh=0.7, downsample_ratio=32)
+        lv = loss.numpy()
+        assert lv.shape == (2,) and np.isfinite(lv).all() and (lv > 0).all()
+
+    def test_yolo_loss_grad_and_descent(self):
+        rng = np.random.RandomState(3)
+        xv = self._head(rng, N=1)
+        gt = np.zeros((1, 2, 4), np.float32)
+        gt[:, 0] = [0.5, 0.5, 0.5, 0.5]
+        lbl = np.zeros((1, 2), np.int64)
+        kw = dict(anchors=[116, 90, 156, 198, 373, 326],
+                  anchor_mask=[0, 1, 2], class_num=4,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                           **kw)
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # one SGD step reduces the loss
+        x2 = paddle.to_tensor(xv - 0.5 * g)
+        l2 = V.yolo_loss(x2, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                         **kw)
+        assert float(l2.sum()) < float(loss.sum())
+
+    def test_yolo_loss_no_gt_only_objectness(self):
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(self._head(rng, N=1))
+        gt = np.zeros((1, 2, 4), np.float32)    # all padding
+        lbl = np.zeros((1, 2), np.int64)
+        loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                           anchors=[116, 90, 156, 198, 373, 326],
+                           anchor_mask=[0, 1, 2], class_num=4,
+                           ignore_thresh=0.7, downsample_ratio=32)
+        assert float(loss.sum()) > 0   # negatives still pay objectness
+
+
+class TestImageIO:
+    def test_read_file_decode_jpeg(self):
+        from PIL import Image
+        arr = (np.random.RandomState(5).rand(16, 20, 3) * 255).astype("uint8")
+        path = os.path.join(tempfile.mkdtemp(), "img.jpg")
+        Image.fromarray(arr).save(path, quality=95)
+        raw = V.read_file(path)
+        assert raw.dtype == np.uint8 and raw.shape[0] > 100
+        img = V.decode_jpeg(raw, mode="rgb")
+        assert img.shape == [3, 16, 20]
+        gray = V.decode_jpeg(raw, mode="gray")
+        assert gray.shape == [1, 16, 20]
+
+
+class TestDistributionLongtail:
+    def test_mvn_diag(self):
+        import paddle_tpu.distribution as D
+        loc = np.array([0.0, 1.0], np.float32)
+        scale = np.array([1.0, 2.0], np.float32)
+        d = D.MultivariateNormalDiag(loc, scale)
+        s = d.sample((1000,)).numpy()
+        assert s.shape == (1000, 2)
+        np.testing.assert_allclose(s.mean(0), loc, atol=0.25)
+        # log_prob vs scipy closed form (independent normals)
+        from scipy import stats
+        v = np.array([[0.5, 0.5]], np.float32)
+        ref = (stats.norm.logpdf(0.5, 0, 1)
+               + stats.norm.logpdf(0.5, 1, 2))
+        np.testing.assert_allclose(d.log_prob(v).numpy()[0], ref, atol=1e-5)
+        # KL(p, p) == 0
+        assert abs(float(d.kl_divergence(d).numpy())) < 1e-6
+        ent_ref = (stats.norm.entropy(0, 1) + stats.norm.entropy(1, 2))
+        np.testing.assert_allclose(float(d.entropy().numpy()), ent_ref,
+                                   atol=1e-5)
+
+    def test_sampling_id(self):
+        import paddle_tpu.distribution as D
+        p = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+        idx = D.sampling_id(paddle.to_tensor(p)).numpy()
+        np.testing.assert_array_equal(idx, [1, 0])
+
+    def test_require_version(self):
+        paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("99.0")
