@@ -40,6 +40,11 @@ complex128 = jnp.dtype("complex128")
 from .tensor import Tensor, to_tensor  # noqa: E402
 from .tensor.tensor import Parameter  # noqa: E402
 from .tensor import *  # noqa: F401,F403,E402
+# the star import rebinds submodule names (tensor, math, ...) into this
+# namespace — restore paddle.tensor as the PACKAGE, like the reference
+# (`from . import tensor` won't do: it resolves the shadowed attribute)
+import sys as _sys  # noqa: E402
+tensor = _sys.modules[__name__ + ".tensor"]
 from .tensor.logic import is_tensor  # noqa: E402
 from .tensor.attribute import shape as shape  # noqa: E402,F811
 
@@ -77,6 +82,7 @@ from . import reader  # noqa: E402
 from . import quantization  # noqa: E402
 from . import dataset  # noqa: E402
 from . import hub  # noqa: E402
+from . import fluid  # noqa: E402
 from .reader import batch  # noqa: E402  (paddle.batch, ref batch.py)
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402

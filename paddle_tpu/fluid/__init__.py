@@ -1,0 +1,64 @@
+"""paddle.fluid compatibility façade (ref: python/paddle/fluid/__init__.py).
+
+The reference is fluid-era PaddlePaddle: most of its models, docs, and user
+code spell the API as ``fluid.layers.fc`` / ``fluid.dygraph.Linear`` /
+``fluid.optimizer.AdamOptimizer``.  This package maps that entire spelling
+onto the TPU-native core — every call delegates to the same
+record-or-eager dispatch as the paddle_tpu 2.x API, so fluid-style programs
+compile through XLA unchanged.  No fluid machinery (ProgramDesc, Scope
+kernels, ParallelExecutor) is recreated: the names are the compatibility
+surface, the semantics are the TPU-native ones.
+"""
+from ..framework.core import (CPUPlace, TPUPlace, CUDAPlace,
+                              CUDAPinnedPlace)
+from ..framework.param_attr import ParamAttr, WeightNormParamAttr
+from ..static.graph import (Program, Executor, CompiledProgram,
+                            BuildStrategy, ExecutionStrategy,
+                            default_main_program, default_startup_program,
+                            program_guard, global_scope, scope_guard, Scope)
+from ..static.misc import name_scope, cuda_places, cpu_places, Variable
+from ..static.backward import append_backward, gradients
+from ..static import ParallelExecutor
+from .. import regularizer
+from ..nn.clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
+from ..io.dataloader import DataLoader
+from ..jit.api import enable_static as disable_dygraph
+from ..jit.api import disable_static as enable_dygraph
+from ..framework import (in_dygraph_mode, get_default_dtype,
+                         set_default_dtype)
+
+from . import layers
+from . import dygraph
+from . import optimizer
+from . import initializer
+from . import io
+from . import core
+from . import clip
+
+# fluid.data / fluid.embedding are module-level in the reference
+from .layers import data, embedding
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def set_flags(flags):
+    """fluid.set_flags — FLAGS_* are CUDA-allocator/debug switches with no
+    TPU analogue; accepted and recorded for introspection."""
+    _flags.update(flags or {})
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags.get(k) for k in keys}
+
+
+_flags = {}
+
+
+# gradient clip helpers under their fluid names
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,7 @@
+"""fluid.clip (ref: python/paddle/fluid/clip.py)."""
+from ..nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                       ClipGradByGlobalNorm)
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,27 @@
+"""fluid.core — the reference's pybind'd C++ core surface (ref:
+paddle/fluid/pybind/pybind.cc).  The TPU-native runtime has no monolithic
+core module; these are the names user code actually touches."""
+from ..framework.core import (CPUPlace, TPUPlace, CUDAPlace,  # noqa: F401
+                              CUDAPinnedPlace, Place)
+from ..static.graph import Scope, global_scope  # noqa: F401
+from ..tensor.tensor import Tensor as VarBase  # noqa: F401
+from ..tensor.tensor import Tensor as LoDTensor  # noqa: F401
+
+
+def get_cuda_device_count():
+    return 0
+
+
+def get_tpu_device_count():
+    import jax
+    return len([d for d in jax.devices() if d.platform != "cpu"])
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+class ops:
+    """Stand-in for the raw op namespace — fluid.core.ops.* calls have no
+    meaning without the fluid op registry; everything routes through the
+    Python API here."""

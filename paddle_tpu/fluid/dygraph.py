@@ -1,0 +1,169 @@
+"""fluid.dygraph — imperative-mode spelling (ref:
+python/paddle/fluid/dygraph/{base,layers,nn}.py).  The fluid dygraph layer
+classes take ``input_dim``-style ctor args and an ``act=`` string; each one
+wraps the TPU-native nn layer and applies the activation."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .. import nn as _nn
+from ..nn import functional as F
+from ..tensor.tensor import Tensor, Parameter
+from ..autograd import no_grad  # noqa: F401
+
+Layer = _nn.Layer
+Sequential = _nn.Sequential
+LayerList = _nn.LayerList
+ParameterList = _nn.ParameterList
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """ref: dygraph/base.py::guard — eager mode is the default here; the
+    context only guarantees static mode is off inside."""
+    from ..static.graph import in_static_mode, _set_static_mode
+    was = in_static_mode()
+    _set_static_mode(False)
+    try:
+        yield
+    finally:
+        _set_static_mode(was)
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    t = Tensor(np.asarray(value))
+    if dtype is not None:
+        t = t.astype(dtype)
+    return t
+
+
+def enabled():
+    from ..framework import in_dygraph_mode
+    return in_dygraph_mode()
+
+
+def _actfn(act):
+    return None if act is None else getattr(F, act)
+
+
+class _ActWrap(_nn.Layer):
+    def __init__(self, inner, act):
+        super().__init__()
+        self._inner = inner
+        self._act = _actfn(act)
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return getattr(self._inner, "bias", None)
+
+    def forward(self, x, *a, **kw):
+        out = self._inner(x, *a, **kw)
+        return self._act(out) if self._act else out
+
+
+class Linear(_ActWrap):
+    """ref: dygraph/nn.py::Linear(input_dim, output_dim, act=...)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(_nn.Linear(input_dim, output_dim,
+                                    weight_attr=param_attr,
+                                    bias_attr=bias_attr), act)
+
+
+class Conv2D(_ActWrap):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32",
+                 use_cudnn=True):
+        super().__init__(_nn.Conv2D(num_channels, num_filters, filter_size,
+                                    stride=stride, padding=padding,
+                                    dilation=dilation, groups=groups,
+                                    weight_attr=param_attr,
+                                    bias_attr=bias_attr), act)
+
+
+class BatchNorm(_ActWrap):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", **kw):
+        super().__init__(_nn.BatchNorm(num_channels, momentum=momentum,
+                                       epsilon=epsilon), act)
+
+
+class Embedding(_nn.Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        self._emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                                  weight_attr=param_attr)
+
+    @property
+    def weight(self):
+        return self._emb.weight
+
+    def forward(self, x):
+        return self._emb(x)
+
+
+class Pool2D(_nn.Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False):
+        super().__init__()
+        self._global = global_pooling
+        self._type = pool_type
+        if not global_pooling:
+            cls = _nn.MaxPool2D if pool_type == "max" else _nn.AvgPool2D
+            self._pool = cls(pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        if self._global:
+            fn = (F.adaptive_max_pool2d if self._type == "max"
+                  else F.adaptive_avg_pool2d)
+            return fn(x, 1)
+        return self._pool(x)
+
+
+class LayerNorm(_nn.Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__()
+        self._ln = _nn.LayerNorm(normalized_shape, epsilon=epsilon)
+        self._act = _actfn(act)
+
+    def forward(self, x):
+        out = self._ln(x)
+        return self._act(out) if self._act else out
+
+
+class Dropout(_nn.Dropout):
+    pass
+
+
+def save_dygraph(state_dict, model_path):
+    from ..io.serialization import save
+    suffix = ".pdopt" if any(
+        isinstance(k, str) and k in ("LR_Scheduler",) for k in state_dict
+    ) else ".pdparams"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path):
+    """Returns (param_dict, opt_dict) like the reference."""
+    import os
+    from ..io.serialization import load
+    params = opt = None
+    if os.path.exists(model_path + ".pdparams"):
+        params = load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = load(model_path + ".pdopt")
+    return params, opt

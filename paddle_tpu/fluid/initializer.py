@@ -1,0 +1,13 @@
+"""fluid.initializer (ref: python/paddle/fluid/initializer.py) — fluid
+exposes *Initializer class names plus short aliases."""
+from ..nn.initializer import (Constant, Normal, TruncatedNormal,  # noqa
+                              Uniform, XavierNormal, XavierUniform,
+                              KaimingNormal, KaimingUniform, Assign)
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

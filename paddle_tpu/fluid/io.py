@@ -1,0 +1,61 @@
+"""fluid.io — legacy persistence spelling (ref: python/paddle/fluid/io.py).
+The fluid signatures (dirname-first, executor-threaded) wrap the standalone
+StableHLO export and the Program state dict."""
+from __future__ import annotations
+
+import os
+
+from ..static import (save_inference_model as _save_inf,
+                      load_inference_model as _load_inf,
+                      save as _save_prog, load as _load_prog,
+                      load_program_state, set_program_state)  # noqa: F401
+from ..static.graph import default_main_program
+from ..io.serialization import save as _save_obj, load as _load_obj
+from ..reader import (shuffle, buffered, map_readers, batch,  # noqa: F401
+                      chain, compose, firstn, xmap_readers)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kwargs):
+    """fluid signature: feed names (not vars) + a dirname.  Resolve names
+    through the program's feed registry, then export."""
+    program = main_program or default_main_program()
+    from ..tensor.tensor import Tensor
+    from ..static.graph import _var_tensors
+    feeds = []
+    for n in feeded_var_names:
+        vid = program.feed_ids.get(n)
+        if vid is None:
+            raise ValueError(f"feed var {n!r} not found in program")
+        wr = _var_tensors.get(vid)
+        t = wr() if wr is not None else None
+        if t is None:
+            raise ValueError(f"feed var {n!r} is no longer alive")
+        feeds.append(t)
+    path_prefix = os.path.join(dirname, model_filename or "model")
+    os.makedirs(dirname, exist_ok=True)
+    return _save_inf(path_prefix, feeds, target_vars, executor,
+                     program=program)
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, **kwargs):
+    path_prefix = os.path.join(dirname, model_filename or "model")
+    return _load_inf(path_prefix, executor)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    _save_prog(program, os.path.join(dirname, filename or "params"))
+
+
+save_persistables = save_params
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    _load_prog(program, os.path.join(dirname, filename or "params"))
+
+
+load_persistables = load_params

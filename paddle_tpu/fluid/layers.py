@@ -1,0 +1,270 @@
+"""fluid.layers — the fluid-era op spelling (ref:
+python/paddle/fluid/layers/{nn,tensor,ops,control_flow,loss}.py, ~20k LoC
+of per-op Python wrappers over the op registry).
+
+Here each name binds to the TPU-native op already in the core: the fluid
+argument conventions (``input``/``x``, ``act=`` strings, elementwise_* with
+axis broadcasting, reduce_* with ``dim=``) are adapted in thin wrappers and
+everything dispatches through ops.dispatch.call — eager on the tape,
+recorded under static mode, traced under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as _T
+from ..tensor.tensor import Tensor
+from .. import nn as _nn
+from ..nn import functional as F
+from ..static import nn as _snn
+from ..static.graph import data as _static_data, in_static_mode
+from ..static.control_flow import cond, while_loop, case, switch_case  # noqa: F401
+from ..static.misc import Print, py_func, create_global_var  # noqa: F401
+from ..static.backward import append_backward, gradients  # noqa: F401
+from ..framework import core as _core
+
+# ---- builders shared with paddle.static.nn ----
+fc = _snn.fc
+conv2d = _snn.conv2d
+conv2d_transpose = _snn.conv2d_transpose
+conv3d = _snn.conv3d
+batch_norm = _snn.batch_norm
+layer_norm = _snn.layer_norm
+pool2d = _snn.pool2d
+prelu = _snn.prelu
+group_norm = _snn.group_norm
+instance_norm = _snn.instance_norm
+spectral_norm = _snn.spectral_norm
+bilinear_tensor_product = _snn.bilinear_tensor_product
+embedding = _snn.embedding
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         lod_level=0):
+    """fluid.layers.data prepends a batch dim unless told otherwise (ref:
+    fluid/layers/io.py::data) — the 2.x static.data does not."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return _static_data(name, shape, dtype, lod_level)
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    return getattr(F, act)(out)
+
+
+# ---- elementwise family (fluid spelling, axis broadcast) ----
+def _elementwise(fn):
+    def op(x, y, axis=-1, act=None, name=None):
+        if axis != -1 and hasattr(y, "shape") and len(y.shape) < len(x.shape):
+            # fluid's mid-axis broadcast: align y's dims starting at `axis`
+            extra = len(x.shape) - axis - len(y.shape)
+            y = _T.reshape(y, list(y.shape) + [1] * extra)
+        return _act(fn(x, y), act)
+    return op
+
+
+elementwise_add = _elementwise(_T.add)
+elementwise_sub = _elementwise(_T.subtract)
+elementwise_mul = _elementwise(_T.multiply)
+elementwise_div = _elementwise(_T.divide)
+elementwise_max = _elementwise(_T.maximum)
+elementwise_min = _elementwise(_T.minimum)
+elementwise_pow = _elementwise(_T.pow)
+elementwise_mod = _elementwise(_T.remainder)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """ref fluid mul_op: flatten both sides to 2-D then matmul."""
+    xs = list(x.shape)
+    ys = list(y.shape)
+    x2 = _T.reshape(x, [int(np.prod(xs[:x_num_col_dims])), -1])
+    y2 = _T.reshape(y, [int(np.prod(ys[:y_num_col_dims])), -1])
+    out = _T.matmul(x2, y2)
+    return _T.reshape(out, xs[:x_num_col_dims] + ys[y_num_col_dims:])
+
+
+matmul = _T.matmul
+
+
+# ---- reduce family (fluid: dim=, keep_dim=) ----
+def _reduce(fn):
+    def op(input, dim=None, keep_dim=False, name=None):
+        return fn(input, axis=dim, keepdim=keep_dim)
+    return op
+
+
+reduce_sum = _reduce(_T.sum)
+reduce_mean = _reduce(_T.mean)
+reduce_max = _reduce(_T.max)
+reduce_min = _reduce(_T.min)
+reduce_prod = _reduce(_T.prod)
+mean = _T.mean
+
+
+# ---- unary/math ops ----
+for _name in ("abs exp log sqrt rsqrt square sin cos tanh sigmoid floor "
+              "ceil round reciprocal sign erf cumsum clip stanh "
+              "logsumexp".split()):
+    globals()[_name] = getattr(_T, _name)
+relu = F.relu
+softmax = F.softmax
+log_softmax = F.log_softmax
+gelu = F.gelu
+leaky_relu = F.leaky_relu
+relu6 = F.relu6
+hard_sigmoid = F.hardsigmoid
+hard_swish = F.hardswish
+swish = F.swish
+soft_relu = F.softplus
+elu = F.elu
+pow = _T.pow
+scale = lambda x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, \
+    name=None: _act(x * scale + bias if bias_after_scale
+                    else (x + bias) * scale, act)
+
+
+# ---- tensor manipulation ----
+concat = _T.concat
+reshape = _T.reshape
+transpose = _T.transpose
+split = _T.split
+squeeze = _T.squeeze
+unsqueeze = _T.unsqueeze
+stack = _T.stack
+unstack = _T.unstack
+expand_as = _T.expand_as
+flatten = _T.flatten
+gather = _T.gather
+gather_nd = _T.gather_nd
+scatter = _T.scatter
+slice = _T.slice
+strided_slice = _T.strided_slice
+shape = _T.shape_op if hasattr(_T, "shape_op") else _T.shape
+cast = _T.cast
+tile = _T.tile
+where = _T.where
+topk = _T.topk
+argmax = _T.argmax
+argmin = _T.argmin
+argsort = _T.argsort
+one_hot = F.one_hot
+unique = _T.unique
+crop_tensor = _T.manipulation.crop
+
+
+def expand(x, expand_times, name=None):
+    """ref fluid expand_op: per-dim REPEAT counts (2.x tile), not target
+    sizes."""
+    return _T.tile(x, expand_times)
+
+
+def assign(input, output=None):
+    out = _T.assign(input)
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    t = _T.full(shape, value, dtype=dtype)
+    if out is not None:
+        out._rebind(t)
+        return out
+    return t
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return _T.full(shape, value, dtype=dtype)
+
+
+zeros = _T.zeros
+ones = _T.ones
+zeros_like = _T.zeros_like
+ones_like = _T.ones_like
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    return _T.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    return _T.normal(mean=mean, std=std, shape=shape)
+
+
+def range(start, end, step, dtype):
+    return _T.arange(start, end, step, dtype=dtype)
+
+
+# ---- losses/metrics ----
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """ref fluid cross_entropy op takes PROBABILITIES (post-softmax) —
+    2.x takes logits.  NLL over log-probs, per-sample [N, 1]."""
+    lp = _T.log(_T.clip(input, 1e-15, 1.0))
+    if soft_label:
+        return _T.reshape(-_T.sum(label * lp, axis=-1), [-1, 1])
+    out = F.nll_loss(lp, label, ignore_index=ignore_index,
+                     reduction="none")
+    return _T.reshape(out, [-1, 1])
+
+
+softmax_with_cross_entropy = F.softmax_with_cross_entropy
+
+
+def square_error_cost(input, label):
+    return F.square_error_cost(input, label)
+
+
+def accuracy(input, label, k=1):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer"):
+    mode = ("upscale_in_train"
+            if dropout_implementation == "upscale_in_train"
+            else "downscale_in_infer")
+    return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+label_smooth = F.label_smooth
+sequence_mask = F.sequence_mask
+# sequence op family (padded+masked TPU-native forms)
+from ..nn.functional.sequence import (sequence_pad, sequence_unpad,  # noqa
+    sequence_pool, sequence_softmax, sequence_reverse, sequence_expand,
+    sequence_concat, sequence_conv, sequence_first_step,
+    sequence_last_step)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    import jax.numpy as jnp
+    from ..ops.dispatch import call
+
+    def _cbn(v):
+        n = jnp.sqrt(jnp.sum(v * v))
+        return v * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+    return call(_cbn, x, _name="clip_by_norm")
+
+
+def reduce_all(input, dim=None, keep_dim=False):
+    return _T.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False):
+    return _T.any(input, axis=dim, keepdim=keep_dim)
+
+
+equal = _T.equal
+not_equal = _T.not_equal
+less_than = _T.less_than
+greater_than = _T.greater_than
+logical_and = _T.logical_and
+logical_or = _T.logical_or
+logical_not = _T.logical_not

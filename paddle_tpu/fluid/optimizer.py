@@ -1,0 +1,46 @@
+"""fluid.optimizer — *Optimizer class names (ref:
+python/paddle/fluid/optimizer.py).  Fluid ctors take ``learning_rate``
+first and ``parameter_list=``; delegate to the TPU-native optimizers."""
+from __future__ import annotations
+
+from .. import optimizer as _opt
+
+
+def _wrap(cls):
+    class FluidOpt(cls):
+        def __init__(self, learning_rate=0.001, parameter_list=None,
+                     regularization=None, grad_clip=None, name=None,
+                     **kwargs):
+            super().__init__(learning_rate=learning_rate,
+                             parameters=parameter_list,
+                             weight_decay=regularization,
+                             grad_clip=grad_clip, **kwargs)
+
+        def minimize(self, loss, startup_program=None, parameter_list=None,
+                     no_grad_set=None):
+            """fluid dygraph pattern is ``loss.backward(); opt.minimize()``
+            — minimize only APPLIES the already-computed grads (the 2.x
+            minimize would run a second backward)."""
+            from ..framework import in_dygraph_mode
+            params = list(parameter_list or self._parameters or [])
+            if in_dygraph_mode() and any(
+                    getattr(p, "grad", None) is not None for p in params):
+                self.step()
+                return None, [(p, p.grad) for p in params
+                              if p.grad is not None]
+            return super().minimize(loss, startup_program=startup_program,
+                                    parameters=parameter_list,
+                                    no_grad_set=no_grad_set)
+    FluidOpt.__name__ = cls.__name__ + "Optimizer"
+    return FluidOpt
+
+
+SGDOptimizer = _wrap(_opt.SGD)
+MomentumOptimizer = _wrap(_opt.Momentum)
+AdagradOptimizer = _wrap(_opt.Adagrad)
+AdamOptimizer = _wrap(_opt.Adam)
+AdamaxOptimizer = _wrap(_opt.Adamax)
+RMSPropOptimizer = _wrap(_opt.RMSProp)
+AdadeltaOptimizer = _wrap(_opt.Adadelta)
+LambOptimizer = _wrap(_opt.Lamb)
+Optimizer = _opt.Optimizer

@@ -60,7 +60,21 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     return call(_ce, input, label, *args, _name="cross_entropy")
 
 
-softmax_with_cross_entropy = cross_entropy
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    """Reference semantics (fluid softmax_with_cross_entropy_op): PER-SAMPLE
+    loss with the class axis kept as size 1 ([N, 1] for [N, C] logits), no
+    reduction; optionally also the softmax."""
+    from ..functional.activation import softmax as _softmax
+    from ...tensor.manipulation import unsqueeze as _unsq
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = _unsq(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",

@@ -1,0 +1,202 @@
+"""fluid compatibility façade: the reference-era spelling must run
+unmodified on the TPU-native core (ref: python/paddle/fluid)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+fluid = paddle.fluid
+
+
+class TestFluidDygraph:
+    def test_guard_and_layers(self):
+        with fluid.dygraph.guard():
+            x = fluid.dygraph.to_variable(
+                np.random.RandomState(0).randn(4, 3).astype("float32"))
+            lin = fluid.dygraph.Linear(3, 5, act="relu")
+            y = lin(x)
+            assert y.shape == [4, 5] and (y.numpy() >= 0).all()
+            conv = fluid.dygraph.Conv2D(1, 2, 3, act="sigmoid")
+            img = fluid.dygraph.to_variable(
+                np.random.RandomState(1).randn(1, 1, 8, 8).astype("float32"))
+            out = conv(img)
+            assert out.shape == [1, 2, 6, 6]
+            assert (out.numpy() > 0).all() and (out.numpy() < 1).all()
+            emb = fluid.dygraph.Embedding([10, 4])
+            assert emb(fluid.dygraph.to_variable(
+                np.array([1, 2]))).shape == [2, 4]
+            pool = fluid.dygraph.Pool2D(2, "max", 2)
+            assert pool(img).shape == [1, 1, 4, 4]
+            gp = fluid.dygraph.Pool2D(global_pooling=True, pool_type="avg")
+            assert gp(img).shape == [1, 1, 1, 1]
+            bn = fluid.dygraph.BatchNorm(2, act="relu")
+            assert bn(out).shape == [1, 2, 6, 6]
+            ln = fluid.dygraph.LayerNorm([8])
+            assert ln(fluid.dygraph.to_variable(
+                np.ones((2, 8), np.float32))).shape == [2, 8]
+
+    def test_backward_minimize_trains(self):
+        with fluid.dygraph.guard():
+            rng = np.random.RandomState(0)
+            xv = rng.randn(32, 4).astype("float32")
+            yv = (xv @ rng.randn(4, 1).astype("float32"))
+            lin = fluid.dygraph.Linear(4, 1)
+            opt = fluid.optimizer.SGDOptimizer(
+                0.1, parameter_list=lin.parameters())
+            first = last = None
+            for _ in range(30):
+                x = fluid.dygraph.to_variable(xv)
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square_error_cost(
+                        lin(x), fluid.dygraph.to_variable(yv)))
+                loss.backward()
+                opt.minimize(loss)
+                opt.clear_grad()
+                first = first if first is not None else float(loss)
+                last = float(loss)
+            assert last < first * 0.2
+
+    def test_save_load_dygraph(self):
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(3, 2)
+            path = os.path.join(tempfile.mkdtemp(), "m")
+            fluid.dygraph.save_dygraph(lin.state_dict(), path)
+            params, opt = fluid.dygraph.load_dygraph(path)
+            assert opt is None
+            lin2 = fluid.dygraph.Linear(3, 2)
+            lin2.set_state_dict(params)
+            np.testing.assert_allclose(np.asarray(lin2.weight.numpy()),
+                                       np.asarray(lin.weight.numpy()))
+
+
+class TestFluidStatic:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_fc_regression_trains(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = fluid.layers.data("x", [4])
+            yt = fluid.layers.data("y", [1])
+            h = fluid.layers.relu(fluid.layers.fc(x, 16))
+            yp = fluid.layers.fc(h, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(yp, yt))
+            opt = fluid.optimizer.SGDOptimizer(0.05)
+            opt.minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(start)
+            rng = np.random.RandomState(0)
+            xv = rng.randn(16, 4).astype("float32")
+            yv = xv.sum(1, keepdims=True).astype("float32") * 0.3
+            first = last = None
+            for _ in range(25):
+                (lv,) = exe.run(prog, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])
+                first = first if first is not None else float(lv)
+                last = float(lv)
+        assert last < first * 0.3
+
+    def test_inference_model_roundtrip(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = fluid.layers.data("img", [3, 4], append_batch_size=False)
+            y = fluid.layers.fc(x, 2)
+            exe = fluid.Executor()
+            d = tempfile.mkdtemp()
+            fluid.io.save_inference_model(d, ["img"], [y], exe,
+                                          main_program=prog)
+            prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+            (out,) = exe.run(prog2, feed={"img": np.ones((3, 4), "float32")},
+                             fetch_list=fetches)
+        assert out.shape == (3, 2)
+
+
+class TestFluidLayersOps:
+    def test_elementwise_axis_broadcast(self):
+        a = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+        b = paddle.to_tensor(np.arange(3, dtype=np.float32))
+        c = fluid.layers.elementwise_add(a, b, axis=1)
+        assert c.shape == [2, 3, 4]
+        assert float(c.numpy()[0, 2, 0]) == 3.0
+
+    def test_fill_expand_assign(self):
+        d = fluid.layers.fill_constant([2, 2], "float32", 7.0)
+        assert (d.numpy() == 7).all()
+        e = fluid.layers.expand(
+            paddle.to_tensor(np.ones((1, 2), np.float32)), [3, 1])
+        assert e.shape == [3, 2]
+        f = fluid.layers.fill_constant_batch_size_like(e, [1, 5], "float32",
+                                                       2.0)
+        assert f.shape == [3, 5]
+
+    def test_cross_entropy_takes_probs(self):
+        probs = paddle.to_tensor(np.array([[0.9, 0.1]], np.float32))
+        ce = fluid.layers.cross_entropy(probs, paddle.to_tensor(np.array([0])))
+        assert ce.shape == [1, 1]
+        np.testing.assert_allclose(float(ce.numpy()[0, 0]), -np.log(0.9),
+                                   atol=1e-5)
+
+    def test_softmax_with_cross_entropy_per_sample(self):
+        logits = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 5).astype("float32"))
+        lbl = paddle.to_tensor(np.random.RandomState(1).randint(0, 5, (8, 1)))
+        loss, sm = fluid.layers.softmax_with_cross_entropy(
+            logits, lbl, return_softmax=True)
+        assert loss.shape == [8, 1]
+        assert sm.shape == [8, 5]
+        np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(8), atol=1e-5)
+        # golden: manual log-softmax gather
+        lp = logits.numpy() - np.log(
+            np.exp(logits.numpy()).sum(-1, keepdims=True))
+        ref = -np.take_along_axis(lp, np.asarray(lbl.numpy()), axis=1)
+        np.testing.assert_allclose(loss.numpy(), ref, atol=1e-5)
+
+    def test_mul_reduce_scale(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.ones((3, 4), np.float32))
+        assert fluid.layers.mul(x, y).shape == [2, 4]
+        s = fluid.layers.scale(x, scale=2.0, bias=1.0)
+        assert float(s.numpy()[0, 0]) == 3.0
+        r = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)
+        assert r.shape == [2, 1]
+
+    def test_dropout_modes(self):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        # downgrade_in_infer at test time multiplies by keep-prob... the
+        # reference keeps values at inference; train-mode zeros some
+        out = fluid.layers.dropout(x, 0.5, is_test=True)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_control_flow_reexports(self):
+        assert fluid.layers.cond is paddle.static.cond
+        assert fluid.layers.while_loop is paddle.static.while_loop
+
+    def test_initializer_aliases(self):
+        init = fluid.initializer.ConstantInitializer(3.0)
+        w = paddle.create_parameter([2, 2], "float32", attr=paddle.ParamAttr(
+            initializer=init))
+        assert (np.asarray(w.numpy()) == 3.0).all()
+        assert fluid.initializer.MSRAInitializer is not None
+
+    def test_core_and_places(self):
+        assert isinstance(fluid.CPUPlace(), paddle.CPUPlace)
+        assert fluid.core.get_cuda_device_count() == 0
+        assert fluid.core.VarBase is paddle.Tensor
+
+    def test_clip_by_norm(self):
+        v = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        out = fluid.layers.clip_by_norm(v, 1.0)
+        np.testing.assert_allclose(np.linalg.norm(out.numpy()), 1.0,
+                                   atol=1e-5)
+
+    def test_flags(self):
+        fluid.set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.5})
+        assert fluid.get_flags("FLAGS_fraction_of_gpu_memory_to_use") == {
+            "FLAGS_fraction_of_gpu_memory_to_use": 0.5}
