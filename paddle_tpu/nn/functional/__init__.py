@@ -35,4 +35,5 @@ from .sequence import (sequence_pad, sequence_unpad, sequence_pool,
                        sequence_softmax, sequence_reverse, sequence_expand,
                        sequence_concat, sequence_enumerate, sequence_erase,
                        sequence_conv, sequence_first_step,
-                       sequence_last_step)
+                       sequence_last_step, sequence_reshape,
+                       sequence_expand_as, sequence_slice, sequence_scatter)

@@ -262,3 +262,74 @@ def sequence_first_step(x, lengths, name=None):
 
 def sequence_last_step(x, lengths, name=None):
     return sequence_pool(x, lengths, "last")
+
+
+def sequence_reshape(x, lengths, new_dim, name=None):
+    """ref sequence_reshape_op.cc: re-chunk each sequence's flattened
+    feature stream into rows of ``new_dim``.  Padded form: [B, T, D] ->
+    [B, T*D/new_dim, new_dim]; lengths scale by D/new_dim.  Returns
+    (out, new_lengths)."""
+    D = int(x.shape[-1])
+    T = int(x.shape[1])
+    assert (T * D) % new_dim == 0, (T, D, new_dim)
+
+    def _rs(padded, lens):
+        B = padded.shape[0]
+        out = padded.reshape(B, T * D // new_dim, new_dim)
+        return out, lens * D // new_dim
+    return call(_rs, x, lengths, _name="sequence_reshape")
+
+
+def sequence_expand_as(x, ref_lengths, maxlen=None, name=None):
+    """ref sequence_expand_as_op.cc: row b of x (one entry per sequence)
+    repeats to fill sequence b of the reference layout.  Padded form:
+    x [B, ...] -> [B, T, ...] masked by ref_lengths."""
+    import numpy as np
+    from ...tensor.tensor import Tensor
+    lv = (ref_lengths.value if isinstance(ref_lengths, Tensor)
+          else jnp.asarray(ref_lengths))
+    T = int(maxlen) if maxlen is not None else int(np.asarray(lv).max())
+
+    def _ea(xv, lens):
+        out = jnp.broadcast_to(xv[:, None], (xv.shape[0], T) + xv.shape[1:])
+        m = _mask(lens, T, out.dtype)
+        return out * m.reshape(m.shape + (1,) * (out.ndim - 2))
+    return call(_ea, x, ref_lengths, _name="sequence_expand_as")
+
+
+def sequence_slice(x, lengths, offset, length, name=None):
+    """ref sequence_slice_op.cc: per-sequence sub-span.  Padded form:
+    out[b, j] = x[b, offset[b] + j] for j < length[b], zeros beyond.
+    Output keeps the padded width (static shape).  Returns
+    (out, new_lengths=length)."""
+    def _sl(padded, lens, off, ln):
+        B, T = padded.shape[:2]
+        off = off.reshape(B).astype(jnp.int32)
+        ln = ln.reshape(B).astype(jnp.int32)
+        idx = off[:, None] + jnp.arange(T)[None, :]
+        valid = (jnp.arange(T)[None, :] < ln[:, None]) \
+            & (idx < lens[:, None].astype(jnp.int32))
+        idx = jnp.clip(idx, 0, T - 1)
+        out = jnp.take_along_axis(
+            padded, idx.reshape((B, T) + (1,) * (padded.ndim - 2)),
+            axis=1) if padded.ndim > 2 else jnp.take_along_axis(padded, idx,
+                                                               axis=1)
+        vshape = valid.shape + (1,) * (out.ndim - 2)
+        return jnp.where(valid.reshape(vshape), out, 0), ln
+    return call(_sl, x, lengths, offset, length, _name="sequence_slice",
+                _nondiff=(1, 2, 3))
+
+
+def sequence_scatter(x, index, updates, lengths, name=None):
+    """ref sequence_scatter_op.cc: per-sequence positional ADD of updates
+    into x.  Padded form: x [B, T]; index/updates [B, S] with ``lengths``
+    [B] valid update counts; out[b, index[b, s]] += updates[b, s]."""
+    def _sc(xv, idx, upd, lens):
+        B, S = idx.shape
+        valid = jnp.arange(S)[None, :] < lens[:, None]
+        idx = jnp.clip(idx.astype(jnp.int32), 0, xv.shape[1] - 1)
+        upd = jnp.where(valid, upd, 0).astype(xv.dtype)
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
+        return xv.at[bidx.reshape(-1), idx.reshape(-1)].add(upd.reshape(-1))
+    return call(_sc, x, index, updates, lengths, _name="sequence_scatter",
+                _nondiff=(1, 3))

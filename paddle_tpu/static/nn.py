@@ -152,3 +152,184 @@ def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
     if act:
         out = getattr(F, act)(out)
     return out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    layer = _nn.Conv3DTranspose(input.shape[1], num_filters,
+                                filter_size or 1, stride=stride,
+                                padding=padding, dilation=dilation,
+                                groups=groups, weight_attr=param_attr,
+                                bias_attr=bias_attr)
+    out = layer(input, output_size=output_size) \
+        if output_size is not None else layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None,
+              slot_dim=-1):
+    """ref fluid/layers/nn.py::data_norm — normalization from ACCUMULATED
+    batch statistics parameters (batch_size / batch_sum / batch_square_sum),
+    the CTR-model alternative to batch_norm.  The three stat params are
+    trainable state updated by the optimizer's data-norm hook in the
+    reference; here they are parameters the user (or a wrapper) updates."""
+    import jax.numpy as jnp
+    from .. import create_parameter
+    from ..nn.initializer import Constant
+    from ..ops.dispatch import call
+    D = int(input.shape[-1])
+    batch_size = create_parameter([D], "float32",
+                                  default_initializer=Constant(1e4))
+    batch_sum = create_parameter([D], "float32",
+                                 default_initializer=Constant(0.0))
+    batch_square_sum = create_parameter(
+        [D], "float32", default_initializer=Constant(1e4))
+
+    def _dn(x, n, s, sq):
+        mean = s / n
+        var = sq / n - mean * mean
+        return (x - mean) / jnp.sqrt(jnp.maximum(var, epsilon))
+    out = call(_dn, input, batch_size, batch_sum, batch_square_sum,
+               _name="data_norm")
+    return getattr(F, act)(out) if act else out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """ref fluid/layers/nn.py::row_conv (lookahead convolution from the
+    Deep Speech 2 line): out[t] = sum_{j=0..k} w[j] * x[t+j].
+    input: [B, T, D]; one weight column per future step+self."""
+    import jax.numpy as jnp
+    from .. import create_parameter
+    from ..ops.dispatch import call
+    D = int(input.shape[-1])
+    k = int(future_context_size)
+    w = create_parameter([k + 1, D], "float32", attr=param_attr)
+
+    def _rc(x, wv):
+        T = x.shape[1]
+        outs = 0.0
+        for j in range(k + 1):     # static unroll; XLA fuses the shifts
+            shifted = jnp.pad(x, ((0, 0), (0, j), (0, 0)))[:, j:j + T]
+            outs = outs + shifted * wv[j]
+        return outs
+    out = call(_rc, input, w, _name="row_conv")
+    return getattr(F, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """ref fluid/layers/nn.py::nce (noise-contrastive estimation): sigmoid
+    CE on the true class logit plus ``num_neg_samples`` sampled noise
+    logits.  Per-sample loss [N, 1].  Negatives are drawn once per call
+    from the uniform (or custom) proposal — a fixed static-shape sample
+    set, which is both XLA-friendly and the standard NCE estimator."""
+    import jax
+    import jax.numpy as jnp
+    from .. import create_parameter
+    from ..framework import core
+    from ..ops.dispatch import call
+    D = int(input.shape[-1])
+    w = create_parameter([num_total_classes, D], "float32", attr=param_attr)
+    b = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                         is_bias=True)
+    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+    if custom_dist is not None:
+        import numpy as np
+        p = jnp.asarray(np.asarray(custom_dist, np.float32))
+        logp = jnp.log(jnp.maximum(p, 1e-30))
+        neg = jax.random.categorical(key, logp, shape=(num_neg_samples,))
+    else:
+        neg = jax.random.randint(key, (num_neg_samples,), 0,
+                                 num_total_classes)
+
+    def _nce(x, lbl, wv, bv):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        pos_logit = jnp.sum(x * wv[lbl], -1) + bv[lbl]          # [N]
+        neg_logit = x @ wv[neg].T + bv[neg]                     # [N, K]
+        def bce(z, t):
+            return jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        loss = bce(pos_logit, 1.0) + jnp.sum(bce(neg_logit, 0.0), -1)
+        return loss[:, None]
+    return call(_nce, input, label, w, b, _name="nce")
+
+
+def crf_decoding(input, transition, lengths=None, label=None, name=None):
+    """ref fluid/layers/nn.py::crf_decoding over crf_decoding_op: Viterbi
+    decode.  input: [B, T, D] unary potentials; transition: [D+2, D] in
+    the reference layout (row 0 start scores, row 1 stop scores, rows
+    2.. the [D, D] transition matrix).  Returns the argmax path [B, T]
+    (entries beyond ``lengths`` are zero).  lax.scan carries the Viterbi
+    lattice — no host loop, jit-friendly."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.dispatch import call
+
+    def _viterbi(emis, trans, *rest):
+        lens = rest[0] if rest else None
+        B, T, D = emis.shape
+        start = trans[0]
+        stop = trans[1]
+        A = trans[2:]                                    # [D, D]
+        if lens is None:
+            lens_v = jnp.full((B,), T, jnp.int32)
+        else:
+            lens_v = lens.reshape(B).astype(jnp.int32)
+
+        alpha0 = start + emis[:, 0]                      # [B, D]
+        if T == 1:
+            last = jnp.argmax(alpha0 + stop[None], -1)
+            return last[:, None].astype(jnp.int64)
+
+        def step(alpha, t):
+            cand = alpha[:, :, None] + A[None]           # [B, prev, cur]
+            best_prev = jnp.argmax(cand, axis=1)         # [B, D]
+            alpha_new = jnp.max(cand, axis=1) + emis[:, t]
+            live = (t < lens_v)[:, None]
+            return jnp.where(live, alpha_new, alpha), best_prev
+
+        alpha, ptrs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        last = jnp.argmax(alpha + stop[None], -1)        # label at len-1
+
+        def back(cur, i):
+            # ptrs[i] holds the best-prev table for position t = i + 1;
+            # dead positions (t > len-1) pass the carry through unchanged
+            prev = jnp.take_along_axis(ptrs[i], cur[:, None], -1)[:, 0]
+            prev = jnp.where(i + 1 <= lens_v - 1, prev, cur)
+            return prev, cur
+
+        first, ys = jax.lax.scan(back, last, jnp.arange(T - 2, -1, -1))
+        # ys: labels at positions T-1 .. 1; first: label at position 0
+        path = jnp.concatenate([first[:, None], ys[::-1].T], axis=1)
+        mask = jnp.arange(T)[None, :] < lens_v[:, None]
+        return jnp.where(mask, path, 0).astype(jnp.int64)
+    args = [input, transition] + ([lengths] if lengths is not None else [])
+    return call(_viterbi, *args, _name="crf_decoding",
+                _nondiff=tuple(range(len(args))))
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     is_test=False, entry=None, dtype="float32"):
+    """ref static.nn.sparse_embedding — the PS-backed embedding; here the
+    dense sharded embedding serves both (the TP/row-sharded path lives in
+    distributed fleet, models/rec.py)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+# shared names the reference exposes under static.nn as well
+from ..static.misc import py_func  # noqa: E402,F401
+from ..vision.ops import deform_conv2d  # noqa: E402,F401
+from ..nn.functional.sequence import (  # noqa: E402,F401
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_reverse, sequence_expand, sequence_expand_as, sequence_concat,
+    sequence_enumerate, sequence_erase, sequence_conv, sequence_first_step,
+    sequence_last_step, sequence_reshape, sequence_slice, sequence_scatter)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .. import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
