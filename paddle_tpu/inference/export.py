@@ -32,17 +32,28 @@ META_SUFFIX = ".pdmeta"
 HLO_SUFFIX = ".stablehlo"
 
 
-def _input_avals(input_spec):
+def _input_avals(input_spec, symbolic=False):
+    """None/-1 dims: with ``symbolic`` they become ONE shared symbolic
+    dimension "b" (dynamic batch — every unknown dim is assumed to be the
+    batch, which is the reference Predictor's contract too); otherwise they
+    specialize to 1."""
+    scope = jax_export.SymbolicScope() if symbolic else None
     avals = []
     for s in input_spec:
         if isinstance(s, (tuple, list)):
             shape, dtype = s
         else:
             shape, dtype = s.shape, s.dtype
-        shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
-                      else int(d) for d in shape)
-        avals.append(jax.ShapeDtypeStruct(shape, jnp.dtype(
-            core.convert_dtype(dtype))))
+        dt = jnp.dtype(core.convert_dtype(dtype))
+        dyn = [d is None or (isinstance(d, int) and d < 0) for d in shape]
+        if symbolic and any(dyn):
+            spec = ", ".join("b" if isdyn else str(int(d))
+                             for d, isdyn in zip(shape, dyn))
+            shape = jax_export.symbolic_shape(spec, scope=scope)
+        else:
+            shape = tuple(1 if isdyn else int(d)
+                          for d, isdyn in zip(shape, dyn))
+        avals.append(jax.ShapeDtypeStruct(tuple(shape), dt))
     return avals
 
 
@@ -52,9 +63,11 @@ def save_inference_model(path_prefix, layer_or_fn, input_spec,
     """Export ``layer_or_fn`` to a standalone artifact.
 
     input_spec: list of InputSpec or (shape, dtype) pairs; None/-1 dims
-    become 1 (export is shape-specialized, like the reference's frozen
-    inference program).  Parameters are baked into the program as
-    constants.  Returns the meta dict.
+    export as a shared SYMBOLIC batch dimension, so one artifact serves
+    any batch size (shape-polymorphic StableHLO).  Models whose ops can't
+    lower polymorphically fall back to specialization at 1, recorded in
+    the meta.  Parameters are baked into the program as constants.
+    Returns the meta dict.
     """
     from ..nn.layer.layers import Layer
     from ..jit import functional as fx
@@ -63,7 +76,11 @@ def save_inference_model(path_prefix, layer_or_fn, input_spec,
     if isinstance(layer_or_fn, TracedLayer):
         layer_or_fn = layer_or_fn._layer or layer_or_fn._fn
 
-    avals = _input_avals(input_spec)
+    has_dynamic = any(
+        any(d is None or (isinstance(d, int) and d < 0)
+            for d in (s[0] if isinstance(s, (tuple, list)) else s.shape))
+        for s in input_spec)
+    avals = _input_avals(input_spec, symbolic=has_dynamic)
     rng = jax.random.PRNGKey(0)
 
     if isinstance(layer_or_fn, Layer):
@@ -88,9 +105,19 @@ def save_inference_model(path_prefix, layer_or_fn, input_spec,
                 lambda x: x.value if isinstance(x, Tensor) else x, out,
                 is_leaf=lambda x: isinstance(x, Tensor))
 
+    symbolic = has_dynamic
     try:
-        exported = jax_export.export(jax.jit(pure),
-                                     platforms=list(platforms))(*avals)
+        try:
+            exported = jax_export.export(jax.jit(pure),
+                                         platforms=list(platforms))(*avals)
+        except Exception:                               # noqa: BLE001
+            if not has_dynamic:
+                raise
+            # some ops can't lower shape-polymorphically — specialize
+            symbolic = False
+            avals = _input_avals(input_spec, symbolic=False)
+            exported = jax_export.export(jax.jit(pure),
+                                         platforms=list(platforms))(*avals)
     finally:
         if was_training:
             layer.train()
@@ -103,13 +130,17 @@ def save_inference_model(path_prefix, layer_or_fn, input_spec,
                     [f"x{i}" for i in range(len(avals))])
     n_out = len(exported.out_avals)
     out_names = list(output_names or [f"out{i}" for i in range(n_out)])
+    def _dims(shape):
+        return [int(d) if isinstance(d, int) else -1 for d in shape]
+
     meta = {
         "format": "stablehlo",
         "platforms": list(platforms),
-        "inputs": [{"name": n, "shape": list(a.shape),
+        "dynamic_batch": symbolic,
+        "inputs": [{"name": n, "shape": _dims(a.shape),
                     "dtype": str(np.dtype(a.dtype))}
                    for n, a in zip(in_names, avals)],
-        "outputs": [{"name": n, "shape": [int(d) for d in a.shape],
+        "outputs": [{"name": n, "shape": _dims(a.shape),
                      "dtype": str(np.dtype(a.dtype))}
                     for n, a in zip(out_names, exported.out_avals)],
     }

@@ -143,3 +143,24 @@ def test_static_save_load_inference_model(tmp_path):
     exe2 = static.Executor()
     got, = exe2.run(prog, feed={"x": xv}, fetch_list=fetch_names)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_batch_polymorphic_export(tmp_path):
+    """None batch dims export as ONE shape-polymorphic artifact serving
+    any batch size (regression: exports used to specialize batch to 1)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.export import (save_inference_model,
+                                             StandaloneModel)
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet().eval()
+    pref = str(tmp_path / "poly")
+    meta = save_inference_model(pref, net, [((None, 1, 28, 28), "float32")])
+    assert meta["dynamic_batch"] is True
+    assert meta["inputs"][0]["shape"][0] == -1
+    m = StandaloneModel(pref)
+    for b in (1, 3, 7):
+        out = m(np.random.RandomState(b).randn(b, 1, 28, 28)
+                .astype("float32"))
+        assert out[0].shape == (b, 10)
