@@ -268,3 +268,12 @@ greater_than = _T.greater_than
 logical_and = _T.logical_and
 logical_or = _T.logical_or
 logical_not = _T.logical_not
+
+
+# detection family (ref fluid/layers/detection.py)
+from ..vision.detection import (prior_box, density_prior_box,  # noqa: E402
+    anchor_generator, iou_similarity, box_coder, box_clip, bipartite_match,
+    target_assign, multiclass_nms, matrix_nms, ssd_loss, multi_box_head,
+    polygon_box_transform)
+from ..vision.ops import yolo_box  # noqa: E402,F401
+from ..vision.ops import yolo_loss as yolov3_loss  # noqa: E402,F401

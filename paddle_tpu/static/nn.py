@@ -333,3 +333,6 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     from .. import create_parameter as _cp
     return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
                default_initializer=default_initializer)
+
+
+from ..vision.detection import multi_box_head  # noqa: E402,F401

@@ -3,6 +3,7 @@ from . import datasets
 from . import models
 from . import transforms
 from . import ops
+from . import detection
 from .models import *  # noqa: F401,F403
 from .datasets import MNIST, FashionMNIST, Cifar10, Cifar100, Flowers  # noqa
 

@@ -1,0 +1,195 @@
+"""Detection op family vs naive numpy goldens (ref:
+fluid/layers/detection.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import detection as D
+
+
+def _np_iou(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(aa[:, None] + ab[None] - inter, 1e-10)
+
+
+def _rand_boxes(rng, n):
+    xy = rng.rand(n, 2) * 0.6
+    wh = rng.rand(n, 2) * 0.4 + 0.05
+    return np.concatenate([xy, xy + wh], -1).astype("float32")
+
+
+class TestBoxMath:
+    def test_iou_similarity(self):
+        rng = np.random.RandomState(0)
+        a, b = _rand_boxes(rng, 5), _rand_boxes(rng, 7)
+        out = D.iou_similarity(paddle.to_tensor(a),
+                               paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(out, _np_iou(a, b), atol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(1)
+        priors = _rand_boxes(rng, 6)
+        targets = _rand_boxes(rng, 6)
+        var = np.full((6, 4), 0.1, np.float32)
+        enc = D.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                          paddle.to_tensor(targets),
+                          code_type="encode_center_size")
+        dec = D.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                          enc, code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), targets, atol=1e-4)
+
+    def test_box_clip(self):
+        b = np.array([[-5, -5, 50, 50], [10, 10, 200, 300]], np.float32)
+        out = D.box_clip(paddle.to_tensor(b),
+                         paddle.to_tensor(np.array([100., 120., 1.],
+                                                   np.float32))).numpy()
+        np.testing.assert_allclose(out[0], [0, 0, 50, 50])
+        np.testing.assert_allclose(out[1], [10, 10, 119, 99])
+
+
+class TestPriors:
+    def test_prior_box_shapes_and_values(self):
+        x = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, var = D.prior_box(x, img, min_sizes=[16.0], max_sizes=[32.0],
+                                 aspect_ratios=[2.0], flip=True, clip=True)
+        # P = 1 (ar=1,min) + 2 (ar=2, 1/2) + 1 (sqrt(min*max)) = 4
+        assert boxes.shape == [4, 4, 4, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        # center of cell (0,0) should be at 8/64 = 0.125
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        np.testing.assert_allclose(cx, 0.125, atol=1e-6)
+        assert var.shape == [4, 4, 4, 4]
+
+    def test_anchor_generator(self):
+        x = paddle.to_tensor(np.zeros((1, 8, 2, 3), np.float32))
+        anchors, var = D.anchor_generator(x, anchor_sizes=[32.0, 64.0],
+                                          aspect_ratios=[1.0],
+                                          stride=[16.0, 16.0])
+        assert anchors.shape == [2, 3, 2, 4]
+        a = anchors.numpy()
+        np.testing.assert_allclose((a[0, 0, 0, 0] + a[0, 0, 0, 2]) / 2, 8.0,
+                                   atol=1e-4)
+
+    def test_density_prior_box(self):
+        x = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, var = D.density_prior_box(x, img, densities=[2],
+                                         fixed_sizes=[8.0],
+                                         fixed_ratios=[1.0],
+                                         flatten_to_2d=True)
+        assert boxes.shape == [2 * 2 * 4, 4]
+
+
+class TestMatching:
+    def test_bipartite_match_greedy(self):
+        # dist rows=gt, cols=priors; global greedy: (0,1)=0.9 first,
+        # then (1,0)=0.7
+        dist = np.array([[0.3, 0.9, 0.1], [0.7, 0.8, 0.2]], np.float32)
+        mi, md = D.bipartite_match(paddle.to_tensor(dist))
+        np.testing.assert_array_equal(mi.numpy(), [1, 0, -1])
+        np.testing.assert_allclose(md.numpy(), [0.7, 0.9, 0.0], atol=1e-6)
+
+    def test_bipartite_match_per_prediction(self):
+        dist = np.array([[0.3, 0.9, 0.6], [0.7, 0.8, 0.2]], np.float32)
+        mi, _ = D.bipartite_match(paddle.to_tensor(dist),
+                                  match_type="per_prediction",
+                                  dist_threshold=0.5)
+        # col 2 unmatched by greedy but col-best row 0 has 0.6 >= 0.5
+        assert mi.numpy()[2] == 0
+
+    def test_target_assign(self):
+        x = np.array([[1., 2.], [3., 4.]], np.float32)
+        mi = np.array([1, -1, 0])
+        out, w = D.target_assign(paddle.to_tensor(x), paddle.to_tensor(mi))
+        np.testing.assert_allclose(out.numpy(), [[3, 4], [0, 0], [1, 2]])
+        np.testing.assert_allclose(w.numpy().ravel(), [1, 0, 1])
+
+
+class TestNMS:
+    def test_multiclass_nms_suppresses(self):
+        # two heavily overlapping boxes + one distinct, single class
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]     # class 1 (0 is background)
+        out = D.multiclass_nms(paddle.to_tensor(boxes),
+                               paddle.to_tensor(scores),
+                               score_threshold=0.1, nms_threshold=0.5,
+                               keep_top_k=5).numpy()
+        valid = out[0][out[0, :, 0] >= 0]
+        assert valid.shape[0] == 2          # overlap suppressed
+        np.testing.assert_allclose(sorted(valid[:, 1], reverse=True),
+                                   [0.9, 0.7], atol=1e-6)
+
+    def test_multiclass_nms_score_threshold(self):
+        boxes = np.array([[[0, 0, 10, 10]]], np.float32)
+        scores = np.zeros((1, 2, 1), np.float32)
+        scores[0, 1] = [0.05]
+        out = D.multiclass_nms(paddle.to_tensor(boxes),
+                               paddle.to_tensor(scores),
+                               score_threshold=0.1).numpy()
+        assert (out[0, :, 0] == -1).all()
+
+    def test_matrix_nms_decays_overlaps(self):
+        boxes = np.array([[[0, 0, 10, 10], [0.2, 0.2, 10.2, 10.2],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.85, 0.7]
+        out = D.matrix_nms(paddle.to_tensor(boxes),
+                           paddle.to_tensor(scores),
+                           score_threshold=0.1, keep_top_k=5).numpy()
+        valid = out[0][out[0, :, 0] >= 0]
+        s = {round(float(v), 2) for v in valid[:, 1]}
+        assert 0.9 in s and 0.7 in s        # top + distinct survive intact
+        # the overlapping 0.85 box must be decayed below its raw score
+        decayed = [v for v in valid[:, 1] if 0.0 < v < 0.8 and
+                   abs(v - 0.7) > 1e-3]
+        assert decayed, valid
+
+
+class TestSSD:
+    def test_ssd_loss_positive_and_descends(self):
+        rng = np.random.RandomState(0)
+        N, C = 8, 4
+        priors = _rand_boxes(rng, N)
+        loc = paddle.to_tensor(rng.randn(2, N, 4).astype("float32") * 0.1)
+        conf = paddle.to_tensor(rng.randn(2, N, C).astype("float32"))
+        gt = np.zeros((2, 3, 4), np.float32)
+        gt[:, 0] = priors[0] + 0.01         # one gt near prior 0
+        lbl = np.ones((2, 3), np.int64)
+        loc.stop_gradient = False
+        loss = D.ssd_loss(loc, conf, paddle.to_tensor(gt),
+                          paddle.to_tensor(lbl), paddle.to_tensor(priors))
+        assert float(loss) > 0
+        loss.backward()
+        g = loc.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_multi_box_head(self):
+        imgs = paddle.to_tensor(np.zeros((2, 3, 64, 64), np.float32))
+        f1 = paddle.to_tensor(np.random.RandomState(0)
+                              .randn(2, 8, 8, 8).astype("float32"))
+        f2 = paddle.to_tensor(np.random.RandomState(1)
+                              .randn(2, 8, 4, 4).astype("float32"))
+        locs, confs, boxes, var = D.multi_box_head(
+            [f1, f2], imgs, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            flip=True)
+        n_priors = boxes.shape[0]
+        assert locs.shape == [2, n_priors, 4]
+        assert confs.shape == [2, n_priors, 3]
+        assert var.shape == [n_priors, 4]
+
+    def test_fluid_reexports(self):
+        fl = paddle.fluid.layers
+        assert fl.prior_box is D.prior_box
+        assert fl.multiclass_nms is D.multiclass_nms
+        assert fl.yolov3_loss is paddle.vision.ops.yolo_loss
