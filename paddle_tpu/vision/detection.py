@@ -53,12 +53,12 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
                 ars.append(1.0 / float(ar))
 
     whs = []
-    for ms in list(min_sizes):
+    for i, ms in enumerate(min_sizes):
         ms = float(ms)
         if min_max_aspect_ratios_order:
             whs.append((ms, ms))
             if max_sizes:
-                mx = float(max_sizes[list(min_sizes).index(ms)])
+                mx = float(max_sizes[i])
                 whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
             for ar in ars:
                 if abs(ar - 1.0) < 1e-6:
@@ -68,7 +68,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
             for ar in ars:
                 whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
             if max_sizes:
-                mx = float(max_sizes[list(min_sizes).index(ms)])
+                mx = float(max_sizes[i])
                 whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
     P = len(whs)
     wh = np.asarray(whs, np.float32)                       # [P, 2]
@@ -183,40 +183,51 @@ def iou_similarity(x, y, box_normalized=True, name=None):
 def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True,
               axis=0, name=None):
-    """SSD box encode/decode with prior variances (ref box_coder_op)."""
+    """SSD box encode/decode with prior variances (ref box_coder_op).
+
+    encode: prior [M,4], target [N,4] -> [N, M, 4] (every target against
+    every prior).  decode: target [N,M,4] with prior [M,4] (axis=0) or
+    [N,4] (axis=1) broadcast along ``axis``; a 2-D aligned target [M,4]
+    decodes row-to-row."""
     encode = code_type.lower().startswith("encode")
+    off = 0.0 if box_normalized else 1.0
+
+    def _cwh(b):
+        w = b[..., 2] - b[..., 0] + off
+        h = b[..., 3] - b[..., 1] + off
+        return b[..., 0] + w * 0.5, b[..., 1] + h * 0.5, w, h
 
     def _bc(pb, pv, tb):
         pb = pb.astype(jnp.float32)
         tb = tb.astype(jnp.float32)
-        pw = pb[..., 2] - pb[..., 0] + (0.0 if box_normalized else 1.0)
-        ph = pb[..., 3] - pb[..., 1] + (0.0 if box_normalized else 1.0)
-        pcx = pb[..., 0] + pw * 0.5
-        pcy = pb[..., 1] + ph * 0.5
+        pcx, pcy, pw, ph = _cwh(pb)
         if pv is not None:
             pv = pv.astype(jnp.float32)
         if encode:
-            tw = tb[..., 2] - tb[..., 0] + (0.0 if box_normalized else 1.0)
-            th = tb[..., 3] - tb[..., 1] + (0.0 if box_normalized else 1.0)
-            tcx = tb[..., 0] + tw * 0.5
-            tcy = tb[..., 1] + th * 0.5
-            # encode: target [M,4] vs prior [N,4] -> [N? ] ref does [N,M,4];
-            # here aligned rows (the common SSD-training usage)
-            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
-                             jnp.log(jnp.maximum(tw / pw, 1e-10)),
-                             jnp.log(jnp.maximum(th / ph, 1e-10))], -1)
+            tcx, tcy, tw, th = _cwh(tb)
+            # [N, M, 4]: target rows against prior columns
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None]) / pw[None],
+                (tcy[:, None] - pcy[None]) / ph[None],
+                jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10)),
+                jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10))], -1)
             if pv is not None:
-                out = out / pv
+                out = out / pv[None]
             return out
+        if tb.ndim == 3:
+            # broadcast the prior stats along `axis` of the [N, M, 4] target
+            exp = (lambda v: v[None, :]) if axis == 0 else \
+                (lambda v: v[:, None])
+            pcx, pcy, pw, ph = map(exp, (pcx, pcy, pw, ph))
+            if pv is not None:
+                pv = exp(pv)
         d = tb if pv is None else tb * pv
         ocx = pcx + d[..., 0] * pw
         ocy = pcy + d[..., 1] * ph
         ow = pw * jnp.exp(d[..., 2])
         oh = ph * jnp.exp(d[..., 3])
         return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
-                          ocx + ow * 0.5 - (0.0 if box_normalized else 1.0),
-                          ocy + oh * 0.5 - (0.0 if box_normalized else 1.0)],
-                         -1)
+                          ocx + ow * 0.5 - off, ocy + oh * 0.5 - off], -1)
     if prior_box_var is None:
         return call(lambda pb, tb: _bc(pb, None, tb), prior_box, target_box,
                     _name="box_coder")
@@ -225,14 +236,22 @@ def box_coder(prior_box, prior_box_var, target_box,
 
 
 def box_clip(input, im_info, name=None):
-    """Clip boxes to image bounds (ref box_clip_op).  im_info: [B, 3]
-    (h, w, scale) or [2] (h, w)."""
+    """Clip boxes to ORIGINAL image bounds (ref box_clip_op).  im_info:
+    [B, 3] or [3] (scaled_h, scaled_w, scale) — bounds are
+    round(h/scale)-1, round(w/scale)-1; a 2-vector (h, w) implies
+    scale 1."""
     def _clip(b, info):
         info = info.astype(jnp.float32)
         if info.ndim == 1:
             h, w = info[0], info[1]
+            if info.shape[0] >= 3:
+                h = jnp.round(h / info[2])
+                w = jnp.round(w / info[2])
         else:
             h, w = info[..., 0], info[..., 1]
+            if info.shape[-1] >= 3:
+                h = jnp.round(h / info[..., 2])
+                w = jnp.round(w / info[..., 2])
             extra = b.ndim - h.ndim - 1
             h = h.reshape(h.shape + (1,) * extra)
             w = w.reshape(w.shape + (1,) * extra)
@@ -303,30 +322,40 @@ def target_assign(input, matched_indices, negative_indices=None,
                   mismatch_value=0, name=None):
     """Gather rows by match index; mismatches filled (ref
     target_assign_op).  input [M, K], matched_indices [N] ->
-    (out [N, K], out_weight [N, 1])."""
-    def _ta(x, mi):
+    (out [N, K], out_weight [N, 1]).  Rows listed in negative_indices
+    get out = mismatch_value with weight 1 (mined negatives DO count in
+    the downstream loss — reference semantics)."""
+    def _ta(x, mi, *rest):
         mi = mi.astype(jnp.int32)
         safe = jnp.clip(mi, 0, x.shape[0] - 1)
         out = x[safe]
         pos = (mi >= 0)
         out = jnp.where(pos[:, None], out, mismatch_value)
-        return out, pos.astype(jnp.float32)[:, None]
-    return call(_ta, input, matched_indices, _name="target_assign",
-                _nondiff=(1,))
+        w = pos.astype(jnp.float32)
+        if rest:
+            neg = jnp.clip(rest[0].reshape(-1).astype(jnp.int32), 0,
+                           mi.shape[0] - 1)
+            w = w.at[neg].set(1.0)
+            out = out.at[neg].set(mismatch_value)
+        return out, w[:, None]
+    args = [input, matched_indices] + (
+        [negative_indices] if negative_indices is not None else [])
+    return call(_ta, *args, _name="target_assign",
+                _nondiff=tuple(range(1, len(args))))
 
 
 # --------------------------------------------------------------------------
 # NMS family — fixed-size outputs (TPU contract: label -1 marks padding)
 # --------------------------------------------------------------------------
 
-def _nms_single_class(boxes, scores, iou_threshold, top_k):
-    """boxes [N,4], scores [N] -> keep mask [N] via greedy NMS over the
-    top_k highest-scoring boxes (lax.fori_loop, static shapes)."""
-    N = boxes.shape[0]
+def _nms_single_class(scores, iou_full, iou_threshold, top_k):
+    """scores [N], iou_full [N,N] (original order, shared across classes)
+    -> keep mask [N] via greedy NMS over the top_k highest-scoring boxes
+    (lax.fori_loop, static shapes)."""
+    N = scores.shape[0]
     K = min(top_k, N)
     order = jnp.argsort(-scores)
-    b = boxes[order]
-    iou = _pairwise_iou(b, b)
+    iou = iou_full[order][:, order]
 
     def body(i, keep):
         # suppressed if any higher-ranked KEPT box overlaps > threshold
@@ -353,6 +382,9 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
         B, C, N = sc.shape
 
         def per_image(boxes, scores_ci):
+            # one IoU matrix, shared by every class (the box set is
+            # identical; only the score ordering differs)
+            iou_full = _pairwise_iou(boxes, boxes)
             keeps = []
             for c in range(C):
                 if c == background_label:
@@ -361,7 +393,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                 s = scores_ci[c]
                 valid = s > score_threshold
                 s_m = jnp.where(valid, s, -1e9)
-                keep = _nms_single_class(boxes, s_m, nms_threshold,
+                keep = _nms_single_class(s_m, iou_full, nms_threshold,
                                          nms_top_k) & valid
                 keeps.append(keep)
             keep_all = jnp.stack(keeps)                      # [C, N]
@@ -391,6 +423,11 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     sequential suppression; natively parallel on TPU."""
     def _mx(bb, sc):
         B, C, N = sc.shape
+        if all(c == background_label for c in range(C)):
+            # no foreground classes: all-invalid output
+            return jnp.concatenate(
+                [jnp.full((B, keep_top_k, 1), -1.0),
+                 jnp.zeros((B, keep_top_k, 5))], -1)
 
         def per_image(boxes, scores_ci):
             rows = []
@@ -400,6 +437,9 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                 s = scores_ci[c]
                 valid = s > score_threshold
                 s_m = jnp.where(valid, s, 0.0)
+                # only the nms_top_k best candidates per class compete
+                s_m = jnp.where(
+                    jnp.argsort(jnp.argsort(-s_m)) < nms_top_k, s_m, 0.0)
                 order = jnp.argsort(-s_m)
                 b_s = boxes[order]
                 s_s = s_m[order]
@@ -439,13 +479,20 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
 
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              prior_box_var=None, background_label=0, overlap_threshold=0.5,
-             neg_pos_ratio=3.0, loc_loss_weight=1.0, conf_loss_weight=1.0,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
              name=None):
-    """SSD multibox loss (ref detection.py::ssd_loss): match priors to gt
-    by IoU, smooth-L1 on encoded offsets for positives, softmax CE on
-    labels with 3:1 hard-negative mining (masked top-k — no ragged
-    sorting).  location [B, N, 4]; confidence [B, N, C]; gt_box [B, G, 4]
-    normalized xyxy; gt_label [B, G]; prior_box [N, 4]."""
+    """SSD multibox loss (ref detection.py::ssd_loss, full fluid
+    signature): match priors to gt by IoU, smooth-L1 on encoded offsets
+    for positives, softmax CE on labels with hard-negative mining (masked
+    top-k — no ragged sorting).  Negatives are mined only among priors
+    whose best overlap < ``neg_overlap``.  location [B, N, 4];
+    confidence [B, N, C]; gt_box [B, G, 4] normalized xyxy;
+    gt_label [B, G]; prior_box [N, 4]."""
+    if mining_type != "max_negative":
+        raise NotImplementedError("only max_negative mining is supported")
+
     def _loss(loc, conf, gb, gl, pb, *rest):
         pv = rest[0] if rest else None
         B, N, _ = loc.shape
@@ -456,18 +503,22 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
             valid_g = (gb_i[:, 2] > gb_i[:, 0]) & (gb_i[:, 3] > gb_i[:, 1])
             iou = _pairwise_iou(gb_i, pb)                   # [G, N]
             iou = jnp.where(valid_g[:, None], iou, -1.0)
-            best_g = jnp.argmax(iou, axis=0)                # per prior
+            best_g = jnp.argmax(iou, axis=0).astype(jnp.int32)
             best_iou = jnp.max(iou, axis=0)
             pos = best_iou >= overlap_threshold             # [N]
-            # force-match: each gt's best prior is positive regardless of
-            # threshold (the reference's bipartite step)
+            # force-match: each VALID gt's best prior is positive
+            # regardless of threshold (the reference's bipartite step).
+            # Scatter per-gt rows into a [G, N] lattice first — duplicate
+            # prior indices then resolve by max-IoU instead of JAX's
+            # implementation-defined duplicate-scatter order.
             best_p = jnp.argmax(iou, axis=1)                # [G]
-            forced = jnp.zeros((N,), bool).at[best_p].set(valid_g)
+            g_rows = jnp.arange(G)
+            lattice = jnp.full((G, N), -jnp.inf).at[g_rows, best_p].set(
+                jnp.where(valid_g, iou[g_rows, best_p], -jnp.inf))
+            forced = jnp.max(lattice, axis=0) > -jnp.inf    # [N]
+            forced_g = jnp.argmax(lattice, axis=0).astype(jnp.int32)
             pos = pos | forced
-            best_g = jnp.where(forced,
-                               jnp.zeros((N,), jnp.int32).at[best_p].set(
-                                   jnp.arange(G, dtype=jnp.int32)),
-                               best_g.astype(jnp.int32))
+            best_g = jnp.where(forced, forced_g, best_g)
 
             tgt_box = gb_i[best_g]                          # [N, 4]
             enc = _encode(pb, pv, tgt_box)
@@ -482,11 +533,16 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
             n_pos = jnp.sum(pos)
             n_neg = jnp.minimum((n_pos * neg_pos_ratio).astype(jnp.int32),
                                 N - n_pos.astype(jnp.int32))
-            neg_ce = jnp.where(pos, -1e9, ce)
+            if sample_size is not None:
+                n_neg = jnp.minimum(n_neg, sample_size)
+            # mine only among true negatives (overlap below neg_overlap)
+            minable = (~pos) & (best_iou < neg_overlap)
+            neg_ce = jnp.where(minable, ce, -1e9)
             thresh = jnp.sort(neg_ce)[::-1][jnp.maximum(n_neg - 1, 0)]
-            hard_neg = (~pos) & (neg_ce >= thresh) & (n_neg > 0)
+            hard_neg = minable & (neg_ce >= thresh) & (n_neg > 0)
             conf_l = jnp.sum(ce * (pos | hard_neg))
-            denom = jnp.maximum(n_pos.astype(jnp.float32), 1.0)
+            denom = jnp.maximum(n_pos.astype(jnp.float32), 1.0) \
+                if normalize else 1.0
             return (loc_loss_weight * loc_l
                     + conf_loss_weight * conf_l) / denom
 

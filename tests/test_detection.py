@@ -35,14 +35,29 @@ class TestBoxMath:
     def test_box_coder_roundtrip(self):
         rng = np.random.RandomState(1)
         priors = _rand_boxes(rng, 6)
-        targets = _rand_boxes(rng, 6)
+        targets = _rand_boxes(rng, 5)
         var = np.full((6, 4), 0.1, np.float32)
         enc = D.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
                           paddle.to_tensor(targets),
                           code_type="encode_center_size")
+        assert enc.shape == [5, 6, 4]      # reference [N, M, 4]
+        # decode broadcasts prior [M,4] along axis 0 of [N, M, 4]
         dec = D.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
-                          enc, code_type="decode_center_size")
-        np.testing.assert_allclose(dec.numpy(), targets, atol=1e-4)
+                          enc, code_type="decode_center_size", axis=0)
+        assert dec.shape == [5, 6, 4]
+        # every column m decodes back to the original target row n
+        for m in range(6):
+            np.testing.assert_allclose(dec.numpy()[:, m], targets,
+                                       atol=1e-4)
+
+    def test_box_coder_aligned_decode(self):
+        rng = np.random.RandomState(2)
+        priors = _rand_boxes(rng, 4)
+        deltas = (rng.randn(4, 4) * 0.1).astype("float32")
+        dec = D.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(deltas),
+                          code_type="decode_center_size")
+        assert dec.shape == [4, 4]
 
     def test_box_clip(self):
         b = np.array([[-5, -5, 50, 50], [10, 10, 200, 300]], np.float32)
@@ -51,6 +66,15 @@ class TestBoxMath:
                                                    np.float32))).numpy()
         np.testing.assert_allclose(out[0], [0, 0, 50, 50])
         np.testing.assert_allclose(out[1], [10, 10, 119, 99])
+
+    def test_box_clip_scale(self):
+        # im_info (scaled_h, scaled_w, scale): bounds are the ORIGINAL
+        # image, round(h/scale)-1 (reference Faster-RCNN convention)
+        b = np.array([[0, 0, 500, 700]], np.float32)
+        out = D.box_clip(paddle.to_tensor(b),
+                         paddle.to_tensor(np.array([800., 600., 2.],
+                                                   np.float32))).numpy()
+        np.testing.assert_allclose(out[0], [0, 0, 299, 399])
 
 
 class TestPriors:
@@ -112,6 +136,16 @@ class TestMatching:
         np.testing.assert_allclose(out.numpy(), [[3, 4], [0, 0], [1, 2]])
         np.testing.assert_allclose(w.numpy().ravel(), [1, 0, 1])
 
+    def test_target_assign_negatives(self):
+        # mined negatives get weight 1 and mismatch_value rows
+        x = np.array([[1., 2.], [3., 4.]], np.float32)
+        mi = np.array([1, -1, -1])
+        neg = np.array([1])
+        out, w = D.target_assign(paddle.to_tensor(x), paddle.to_tensor(mi),
+                                 negative_indices=paddle.to_tensor(neg))
+        np.testing.assert_allclose(w.numpy().ravel(), [1, 1, 0])
+        np.testing.assert_allclose(out.numpy()[1], [0, 0])
+
 
 class TestNMS:
     def test_multiclass_nms_suppresses(self):
@@ -172,6 +206,32 @@ class TestSSD:
         loss.backward()
         g = loc.grad.numpy()
         assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_ssd_loss_padding_gt_force_match(self):
+        # regression: all-zero padding gt rows must not steal prior 0's
+        # force-match from a valid gt whose best prior IS 0
+        rng = np.random.RandomState(7)
+        priors = _rand_boxes(rng, 4)
+        gt = np.zeros((1, 3, 4), np.float32)
+        gt[0, 0] = priors[0]                 # exact match with prior 0
+        lbl = np.full((1, 3), 2, np.int64)
+        loc = paddle.to_tensor(np.zeros((1, 4, 4), np.float32))
+        conf = paddle.to_tensor(np.zeros((1, 4, 3), np.float32))
+        l1 = D.ssd_loss(loc, conf, paddle.to_tensor(gt),
+                        paddle.to_tensor(lbl), paddle.to_tensor(priors))
+        # with the gt removed the loss must differ (prior 0 now background)
+        gt2 = np.zeros((1, 3, 4), np.float32)
+        l2 = D.ssd_loss(loc, conf, paddle.to_tensor(gt2),
+                        paddle.to_tensor(lbl), paddle.to_tensor(priors))
+        assert abs(float(l1) - float(l2)) > 1e-6
+
+    def test_matrix_nms_background_only_classes(self):
+        boxes = np.zeros((1, 2, 4), np.float32)
+        scores = np.ones((1, 1, 2), np.float32)     # only background class
+        out = D.matrix_nms(paddle.to_tensor(boxes),
+                           paddle.to_tensor(scores),
+                           score_threshold=0.1).numpy()
+        assert (out[0, :, 0] == -1).all()
 
     def test_multi_box_head(self):
         imgs = paddle.to_tensor(np.zeros((2, 3, 64, 64), np.float32))
