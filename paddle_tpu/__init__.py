@@ -82,7 +82,6 @@ from . import reader  # noqa: E402
 from . import quantization  # noqa: E402
 from . import dataset  # noqa: E402
 from . import hub  # noqa: E402
-from . import fluid  # noqa: E402
 from .reader import batch  # noqa: E402  (paddle.batch, ref batch.py)
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
@@ -206,3 +205,7 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     if in_static_mode():
         _ensure_var_id(p, default_main_program())
     return p
+
+
+# fluid facade imports create_parameter & friends — must come last
+from . import fluid  # noqa: E402

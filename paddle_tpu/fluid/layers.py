@@ -277,3 +277,9 @@ from ..vision.detection import (prior_box, density_prior_box,  # noqa: E402
     polygon_box_transform)
 from ..vision.ops import yolo_box  # noqa: E402,F401
 from ..vision.ops import yolo_loss as yolov3_loss  # noqa: E402,F401
+
+
+# long tail, part 2 (ref fluid/layers/{nn,ops,tensor,loss,metric_op,
+# learning_rate_scheduler}.py)
+from .layers_ext import *  # noqa: E402,F401,F403
+from .layers_ext import sum, size, rank, pad  # noqa: E402,F401,F811

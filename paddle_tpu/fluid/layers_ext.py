@@ -1,0 +1,770 @@
+"""fluid.layers long tail (ref: python/paddle/fluid/layers/{nn,ops,tensor,
+loss,metric_op,learning_rate_scheduler,control_flow}.py).
+
+Part 2 of the fluid spelling: everything here either delegates to the
+TPU-native core under the fluid name/convention or is a small real op
+implemented in jnp (ops the 2.x API dropped but fluid-era code uses).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from ..tensor.tensor import Tensor
+from .. import tensor as _T
+from ..nn import functional as F
+from ..static import nn as _snn
+from .. import optimizer as _opt
+
+# ---------------------------------------------------------------- aliases
+from ..tensor.creation import linspace, eye, diag, triu  # noqa: F401
+from ..tensor.manipulation import (unbind, flip as reverse,  # noqa: F401
+                                   scatter_nd, scatter_nd_add, shard_index)
+from ..tensor.attribute import rank  # noqa: F401
+from ..tensor.math import floor_divide as elementwise_floordiv  # noqa: F401
+from ..tensor.logic import (greater_equal, less_equal,  # noqa: F401
+                            logical_xor, is_empty)
+from ..tensor.math import multiplex, isfinite  # noqa: F401
+from ..nn.functional import (maxout, mish, selu, unfold,  # noqa: F401
+                             grid_sample as grid_sampler,
+                             affine_grid, gather_tree, pixel_shuffle,
+                             channel_shuffle as shuffle_channel,
+                             temporal_shift, mse_loss, kl_div as kldiv_loss,
+                             log_loss, dice_loss, npair_loss,
+                             sigmoid_focal_loss,
+                             margin_ranking_loss as margin_rank_loss,
+                             local_response_norm as lrn)
+from ..nn.functional.activation import (hardshrink as hard_shrink,  # noqa
+                                        softshrink, thresholded_relu)
+from .. import create_parameter  # noqa: F401
+from ..static.nn import (crf_decoding, data_norm, nce, row_conv,  # noqa
+                         conv3d_transpose, sparse_embedding)
+from ..vision.ops import deform_conv2d as deformable_conv  # noqa: F401
+from ..vision.ops import read_file  # noqa: F401
+from ..distribution import sampling_id  # noqa: F401
+
+sum = _T.sum          # noqa: A001  (fluid.layers.sum is elementwise list-sum)
+size = _T.numel
+
+
+def sums(input, out=None):
+    from ..tensor.math import add_n
+    r = add_n(input)
+    if out is not None:
+        out._rebind(r)
+        return out
+    return r
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return F.hardtanh(x, t_min, t_max)
+
+
+def cos_sim(X, Y):
+    return F.cosine_similarity(X, Y, axis=-1)
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    return F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def increment(x, value=1.0, in_place=True):
+    out = x + value
+    if in_place:
+        return x._rebind(out)
+    return out
+
+
+def has_inf(x):
+    return _T.any(_T.isinf(x))
+
+
+def has_nan(x):
+    return _T.any(_T.isnan(x))
+
+
+def unique_with_counts(x, dtype="int32"):
+    return _T.unique(x, return_index=True, return_counts=True)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    w = create_parameter([num_classes - 1, int(input.shape[-1])], "float32",
+                         attr=param_attr)
+    b = create_parameter([num_classes - 1], "float32", attr=bias_attr,
+                         is_bias=True)
+    return F.hsigmoid_loss(input, label, num_classes, w, b,
+                           path_table=path_table, path_code=path_code)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """ref smooth_l1_op: per-sample [N, 1] with optional inside/outside
+    weights and sigma-scaled transition point."""
+    s2 = (sigma or 1.0) ** 2
+
+    def _sl(a, b, *w):
+        iw = w[0] if len(w) > 0 else None
+        ow = w[1] if len(w) > 1 else None
+        d = a - b
+        if iw is not None:
+            d = d * iw
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+        if ow is not None:
+            loss = loss * ow
+        return jnp.sum(loss.reshape(loss.shape[0], -1), -1, keepdims=True)
+    args = [x, y] + [w for w in (inside_weight, outside_weight)
+                     if w is not None]
+    return call(_sl, *args, _name="smooth_l1")
+
+
+def huber_loss(input, label, delta):
+    def _h(a, b):
+        d = jnp.abs(a - b)
+        return jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return call(_h, input, label, _name="huber_loss")
+
+
+def rank_loss(label, left, right, name=None):
+    """ref rank_loss_op (RankNet): sigmoid CE on score difference."""
+    def _rl(lbl, l, r):
+        z = l - r
+        return jnp.maximum(z, 0) - z * lbl + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return call(_rl, label, left, right, _name="rank_loss")
+
+
+def bpr_loss(input, label, name=None):
+    """ref bpr_loss_op (Bayesian Personalized Ranking): -mean over
+    negatives of log sigmoid(pos_score - neg_score), per sample [N, 1]."""
+    def _b(x, lbl):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, lbl[:, None], 1)       # [N, 1]
+        diff = pos - x
+        logsig = -jnp.log1p(jnp.exp(-diff))
+        mask = jax.nn.one_hot(lbl, x.shape[-1]) == 0
+        per = -jnp.sum(logsig * mask, -1, keepdims=True) / (x.shape[-1] - 1)
+        return per
+    return call(_b, input, label, _name="bpr_loss")
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """ref teacher_student_sigmoid_loss_op (CTR distillation)."""
+    def _ts(x, lbl):
+        x = jnp.clip(x.reshape(-1), soft_max_lower_bound, soft_max_up_bound)
+        lbl = lbl.reshape(-1)
+        teacher = lbl - jnp.floor(lbl)       # fractional part: soft label
+        hard = jnp.floor(lbl)                # integral part: hard label
+        ce = jnp.maximum(x, 0) - x * hard + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        soft = jnp.maximum(x, 0) - x * teacher \
+            + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return (ce + soft)[:, None]
+    return call(_ts, input, label, _name="teacher_student_sigmoid_loss")
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    def _sce(z, t):
+        valid = t != ignore_index
+        ce = jnp.maximum(z, 0) - z * jnp.where(valid, t, 0.0) \
+            + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        ce = jnp.where(valid, ce, 0.0)
+        if normalize:
+            ce = ce / jnp.maximum(jnp.sum(valid.astype(ce.dtype)), 1.0)
+        return ce
+    return call(_sce, x, label, _name="sigmoid_cross_entropy_with_logits")
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """ref center_loss_op: 0.5 * ||x - c_y||^2 against learned per-class
+    centers (centers update via their gradient here — the TPU-native
+    stand-in for the reference's in-kernel center update)."""
+    centers = create_parameter([num_classes, int(input.shape[-1])],
+                               "float32", attr=param_attr)
+
+    def _cl(x, lbl, c):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        d = x - c[lbl]
+        return 0.5 * jnp.sum(d * d, -1, keepdims=True)
+    return call(_cl, input, label, centers, _name="center_loss")
+
+
+def mean_iou(input, label, num_classes):
+    """ref mean_iou_op: mean IoU over classes + per-class intersect/union."""
+    def _mi(pred, lbl):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        oh_p = jax.nn.one_hot(pred, num_classes)
+        oh_l = jax.nn.one_hot(lbl, num_classes)
+        inter = jnp.sum(oh_p * oh_l, 0)
+        union = jnp.sum(oh_p, 0) + jnp.sum(oh_l, 0) - inter
+        present = union > 0
+        iou = jnp.where(present, inter / jnp.maximum(union, 1e-10), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(
+            jnp.sum(present.astype(jnp.float32)), 1.0)
+        return miou, inter.astype(jnp.int64), union.astype(jnp.int64)
+    return call(_mi, input, label, _name="mean_iou", _nondiff=(0, 1))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    return Tensor(np.asarray(m.accumulate(), np.float32)), None, None
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
+                      reduction="none")
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    pairs = [(paddings[2 * i], paddings[2 * i + 1])
+             for i in range(len(paddings) // 2)]
+    def _p(a):
+        return jnp.pad(a, pairs, constant_values=pad_value)
+    return call(_p, x, _name="pad")
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return F.pad(input, list(paddings), mode="constant"
+                 if mode == "constant" else mode, value=pad_value,
+                 data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y at the tail of every dim up to x's shape (ref
+    pad_constant_like_op)."""
+    pairs = [(0, int(a) - int(b)) for a, b in zip(x.shape, y.shape)]
+    def _p(a):
+        return jnp.pad(a, pairs, constant_values=pad_value)
+    return call(_p, y, _name="pad_constant_like")
+
+
+def space_to_depth(x, blocksize, name=None):
+    return F.pixel_unshuffle(x, blocksize)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=
+                None, out_stride=1, name=None):
+    """ref im2sequence_op: unfold patches, rows = spatial positions."""
+    out = F.unfold(input, filter_size, strides=stride, paddings=padding)
+    # [B, C*k*k, L] -> [B*L, C*k*k]
+    B, CKK, L = out.shape
+    return _T.reshape(_T.transpose(out, [0, 2, 1]), [B * L, CKK])
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    def _ac(a, s, b):
+        if data_layout.startswith("NC"):
+            s = s.reshape(1, -1, *([1] * (a.ndim - 2)))
+            b = b.reshape(1, -1, *([1] * (a.ndim - 2)))
+        out = a * s + b
+        return out
+    out = call(_ac, x, scale, bias, _name="affine_channel")
+    return getattr(F, act)(out) if act else out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """ref add_position_encoding_op: sinusoidal PE added to [B, T, D]."""
+    def _pe(x):
+        B, T, D = x.shape
+        half = D // 2
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        div = jnp.exp(jnp.arange(half, dtype=jnp.float32)
+                      * -(math.log(10000.0) / max(half - 1, 1)))
+        pe = jnp.concatenate([jnp.sin(pos * div), jnp.cos(pos * div)], -1)
+        if pe.shape[-1] < D:
+            pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[-1])))
+        return alpha * x + beta * pe[None]
+    return call(_pe, input, _name="add_position_encoding")
+
+
+def random_crop(x, shape, seed=None):
+    from ..framework import core
+    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+    def _rc(a):
+        starts = []
+        ks = jax.random.split(key, len(shape))
+        out = a
+        for i, s in enumerate(shape):
+            axis = a.ndim - len(shape) + i
+            hi = a.shape[axis] - s + 1
+            st = jax.random.randint(ks[i], (), 0, max(hi, 1))
+            out = jax.lax.dynamic_slice_in_dim(out, st, s, axis)
+        return out
+    return call(_rc, x, _name="random_crop")
+
+
+def fsp_matrix(x, y):
+    """ref fsp_op (knowledge distillation): gram between two feature maps
+    [B, Cx, H, W], [B, Cy, H, W] -> [B, Cx, Cy]."""
+    def _f(a, b):
+        B, Ca, H, W = a.shape
+        Cb = b.shape[1]
+        af = a.reshape(B, Ca, H * W)
+        bf = b.reshape(B, Cb, H * W)
+        return jnp.einsum("bch,bdh->bcd", af, bf) / (H * W)
+    return call(_f, x, y, _name="fsp_matrix")
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    mode = resample.lower()
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=mode, align_corners=align_corners,
+                         data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, **kw):
+    return image_resize(input, out_shape, scale, resample="BILINEAR", **kw)
+
+
+def resize_nearest(input, out_shape=None, scale=None, **kw):
+    kw.setdefault("align_corners", False)
+    return image_resize(input, out_shape, scale, resample="NEAREST", **kw)
+
+
+def resize_linear(input, out_shape=None, scale=None, **kw):
+    return image_resize(input, out_shape, scale, resample="LINEAR", **kw)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, **kw):
+    return image_resize(input, out_shape, scale, resample="TRILINEAR", **kw)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    H, W = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (H, W) if H < W else (W, H)
+    scale = out_short_len / short
+    out = (int(round(H * scale)), int(round(W * scale)))
+    return image_resize(input, out_shape=out, resample=resample)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    fn = (F.adaptive_max_pool2d if pool_type == "max"
+          else F.adaptive_avg_pool2d)
+    return fn(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    fn = (F.adaptive_max_pool3d if pool_type == "max"
+          else F.adaptive_avg_pool3d)
+    return fn(input, pool_size)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False, **kw):
+    if global_pooling:
+        return (F.adaptive_max_pool3d(input, 1) if pool_type == "max"
+                else F.adaptive_avg_pool3d(input, 1))
+    fn = F.max_pool3d if pool_type == "max" else F.avg_pool3d
+    return fn(input, pool_size, pool_stride, pool_padding,
+              ceil_mode=ceil_mode)
+
+
+def inplace_abn(input, **kwargs):
+    return _snn.batch_norm(input, **{k: v for k, v in kwargs.items()
+                                     if k in ("act", "momentum", "epsilon",
+                                              "param_attr", "bias_attr",
+                                              "is_test")})
+
+
+# selected-rows are a fluid storage optimization; dense here
+def merge_selected_rows(x, name=None):
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return x
+
+
+def lod_reset(x, y=None, target_lod=None):
+    return x       # padded layout carries no LoD
+
+
+def lod_append(x, level):
+    return x
+
+
+# ------------------------------------------------------- LR decay builders
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    return _opt.lr.NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _opt.lr.ExponentialDecay(learning_rate,
+                                    decay_rate ** (1.0 / decay_steps)) \
+        if not staircase else _opt.lr.StepDecay(
+            learning_rate, decay_steps, decay_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _opt.lr.NaturalExpDecay(learning_rate, decay_rate / decay_steps)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    return _opt.lr.InverseTimeDecay(learning_rate, decay_rate / decay_steps)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    return _opt.lr.PolynomialDecay(learning_rate, decay_steps,
+                                   end_learning_rate, power, cycle)
+
+
+def piecewise_decay(boundaries, values):
+    return _opt.lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return _opt.lr.CosineAnnealingDecay(learning_rate,
+                                        step_each_epoch * epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    base = learning_rate if not isinstance(learning_rate, (int, float)) \
+        else float(learning_rate)
+    return _opt.lr.LinearWarmup(base, warmup_steps, start_lr, end_lr)
+
+
+# --------------------------------------------------- tensor array / misc
+def create_tensor(dtype, name=None, persistable=False):
+    from ..framework import core
+    return Tensor(jnp.zeros((), core.convert_dtype(dtype)))
+
+
+def create_array(dtype):
+    return []
+
+
+def array_write(x, i, array=None):
+    array = array if array is not None else []
+    idx = int(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    return Tensor(np.asarray(len(array), np.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):
+    vals = [v for v in input if v is not None]
+    out = _T.stack(vals, axis) if use_stack else _T.concat(vals, axis)
+    sizes = Tensor(np.asarray([1 if use_stack else int(v.shape[axis])
+                               for v in vals], np.int32))
+    return out, sizes
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    from ..static.misc import create_global_var
+    key = counter_name or "@STEP_COUNTER@"
+    from ..static.graph import global_scope
+    scope = global_scope()
+    v = scope.find_var(key)
+    if v is None:
+        v = create_global_var([1], begin - step, "int64", name=key)
+    v._rebind(v + step)
+    return v
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    def _a(c):
+        def fail(c_):
+            jax.debug.print("Assert failed: {}", c_)
+            return c_
+        return jax.lax.cond(jnp.all(c), lambda c_: c_, fail, c)
+    return call(_a, cond, _name="assert")
+
+
+# ------------------------------------------------------------ ROI pooling
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    """ref roi_align_op (Mask R-CNN): average of bilinear samples per bin.
+    input [N, C, H, W]; rois [R, 4] xyxy in input-image coords (all rois
+    on batch image 0 unless rois_num maps them); returns
+    [R, C, ph, pw]."""
+    nsr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def _ra(x, r, *rest):
+        N, C, H, W = x.shape
+        R = r.shape[0]
+        if rest:
+            rn = rest[0].astype(jnp.int32)          # rois per image [N]
+            img_of = jnp.repeat(jnp.arange(N), rn, total_repeat_length=R)
+        else:
+            img_of = jnp.zeros((R,), jnp.int32)
+        rb = r.astype(jnp.float32) * spatial_scale
+        x1, y1, x2, y2 = rb[:, 0], rb[:, 1], rb[:, 2], rb[:, 3]
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pooled_width
+        bin_h = rh / pooled_height
+
+        # sample lattice: [ph, pw, nsr, nsr] offsets per roi
+        py = jnp.arange(pooled_height, dtype=jnp.float32)
+        px = jnp.arange(pooled_width, dtype=jnp.float32)
+        sy = (jnp.arange(nsr, dtype=jnp.float32) + 0.5) / nsr
+        sx = (jnp.arange(nsr, dtype=jnp.float32) + 0.5) / nsr
+        # ys[r, ph, s] = y1 + (py + sy) * bin_h
+        ys = (y1[:, None, None] + (py[None, :, None] + sy[None, None, :])
+              * bin_h[:, None, None])              # [R, ph, nsr]
+        xs = (x1[:, None, None] + (px[None, :, None] + sx[None, None, :])
+              * bin_w[:, None, None])              # [R, pw, nsr]
+
+        def one_roi(img_idx, ys_i, xs_i):
+            img = x[img_idx]                        # [C, H, W]
+            yy = jnp.broadcast_to(ys_i[:, None, :, None],
+                                  (pooled_height, pooled_width, nsr, nsr))
+            xx = jnp.broadcast_to(xs_i[None, :, None, :],
+                                  (pooled_height, pooled_width, nsr, nsr))
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            acc = 0.0
+            for dy, dx, w in ((0, 0, (1 - wy) * (1 - wx)),
+                              (0, 1, (1 - wy) * wx),
+                              (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
+                iy = jnp.clip(y0.astype(jnp.int32) + dy, 0, H - 1)
+                ix = jnp.clip(x0.astype(jnp.int32) + dx, 0, W - 1)
+                acc = acc + w[None] * img[:, iy, ix]
+            return jnp.mean(acc, axis=(-2, -1))     # avg over samples
+        return jax.vmap(one_roi)(img_of, ys, xs)
+    args = [input, rois] + ([rois_num] if rois_num is not None else [])
+    return call(_ra, *args, _name="roi_align",
+                _nondiff=tuple(range(1, len(args))))
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    """ref roi_pool_op (Fast R-CNN): max over each quantized bin."""
+    def _rp(x, r, *rest):
+        N, C, H, W = x.shape
+        R = r.shape[0]
+        if rest:
+            rn = rest[0].astype(jnp.int32)
+            img_of = jnp.repeat(jnp.arange(N), rn, total_repeat_length=R)
+        else:
+            img_of = jnp.zeros((R,), jnp.int32)
+        rb = jnp.round(r.astype(jnp.float32) * spatial_scale)
+        x1 = rb[:, 0].astype(jnp.int32)
+        y1 = rb[:, 1].astype(jnp.int32)
+        # rois are INCLUSIVE pixel boxes: width = x2 - x1 + 1 (Fast R-CNN)
+        x2 = jnp.maximum(rb[:, 2].astype(jnp.int32) + 1, x1 + 1)
+        y2 = jnp.maximum(rb[:, 3].astype(jnp.int32) + 1, y1 + 1)
+
+        gy = jnp.arange(H)
+        gx = jnp.arange(W)
+
+        def one_roi(img_idx, rx1, ry1, rx2, ry2):
+            img = x[img_idx]
+            bh = (ry2 - ry1).astype(jnp.float32) / pooled_height
+            bw = (rx2 - rx1).astype(jnp.float32) / pooled_width
+            outs = []
+            for ph in range(pooled_height):
+                for pw_ in range(pooled_width):
+                    ys = ry1 + jnp.floor(ph * bh).astype(jnp.int32)
+                    ye = ry1 + jnp.ceil((ph + 1) * bh).astype(jnp.int32)
+                    xs_ = rx1 + jnp.floor(pw_ * bw).astype(jnp.int32)
+                    xe = rx1 + jnp.ceil((pw_ + 1) * bw).astype(jnp.int32)
+                    m = ((gy[:, None] >= ys) & (gy[:, None] < ye)
+                         & (gx[None, :] >= xs_) & (gx[None, :] < xe))
+                    v = jnp.where(m[None], img, -jnp.inf)
+                    mx = jnp.max(v, axis=(1, 2))
+                    outs.append(jnp.where(jnp.isfinite(mx), mx, 0.0))
+            return jnp.stack(outs, -1).reshape(C, pooled_height,
+                                               pooled_width)
+        return jax.vmap(one_roi)(img_of, x1, y1, x2, y2)
+    args = [input, rois] + ([rois_num] if rois_num is not None else [])
+    return call(_rp, *args, _name="roi_pool",
+                _nondiff=tuple(range(1, len(args))))
+
+
+# --------------------------------------------------- sequence decode/eval
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (ref edit_distance_op).  input/label:
+    [B, T] padded int sequences with lengths.  The DP runs as a lax.scan
+    over input positions carrying one DP row — O(T_l) memory."""
+    def _ed(a, b, *rest):
+        al = rest[0].reshape(-1).astype(jnp.int32) if rest else \
+            jnp.full((a.shape[0],), a.shape[1], jnp.int32)
+        bl = rest[1].reshape(-1).astype(jnp.int32) if len(rest) > 1 else \
+            jnp.full((b.shape[0],), b.shape[1], jnp.int32)
+
+        Tb = b.shape[1]
+
+        def one(seq_a, seq_b, la, lb):
+            init = jnp.arange(Tb + 1, dtype=jnp.float32)
+            init = jnp.where(jnp.arange(Tb + 1) <= lb, init, jnp.inf)
+
+            def step(row, i):
+                ai = seq_a[i]
+                live = i < la
+
+                def inner(carry, j):
+                    prev_diag, newrow = carry
+                    cost = jnp.where(seq_b[j] == ai, 0.0, 1.0)
+                    val = jnp.minimum(jnp.minimum(
+                        row[j + 1] + 1.0,          # delete
+                        newrow[j] + 1.0),          # insert
+                        prev_diag + cost)          # substitute
+                    val = jnp.where(j + 1 <= lb, val, jnp.inf)
+                    return (row[j + 1], newrow.at[j + 1].set(val)), None
+
+                new0 = jnp.full((Tb + 1,), jnp.inf).at[0].set(
+                    jnp.float32(i + 1))
+                (_, newrow), _ = jax.lax.scan(
+                    inner, (row[0], new0), jnp.arange(Tb))
+                return jnp.where(live, newrow, row), None
+
+            row, _ = jax.lax.scan(step, init, jnp.arange(a.shape[1]))
+            d = row[lb]
+            if normalized:
+                d = d / jnp.maximum(lb.astype(jnp.float32), 1.0)
+            return d
+        dist = jax.vmap(one)(a.astype(jnp.int32), b.astype(jnp.int32),
+                             al, bl)
+        return dist[:, None], bl
+    args = [input, label] + [v for v in (input_length, label_length)
+                             if v is not None]
+    return call(_ed, *args, _name="edit_distance",
+                _nondiff=tuple(range(len(args))))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None):
+    """ref ctc_greedy_decoder_op: argmax per frame, collapse repeats,
+    drop blanks.  input [B, T, C] (batched padded form).  Returns
+    (decoded [B, T] padded with -1, lengths [B])."""
+    def _cgd(x, *rest):
+        B, T, C = x.shape
+        lens = rest[0].reshape(-1).astype(jnp.int32) if rest else \
+            jnp.full((B,), T, jnp.int32)
+        ids = jnp.argmax(x, -1).astype(jnp.int32)          # [B, T]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32),
+                                ids[:, :-1]], 1)
+        live = jnp.arange(T)[None, :] < lens[:, None]
+        keep = (ids != blank) & (ids != prev) & live
+
+        def one(row_ids, row_keep):
+            # stable-compact kept tokens to the front
+            order = jnp.argsort(~row_keep, stable=True)
+            out = jnp.where(row_keep[order], row_ids[order], -1)
+            return out, jnp.sum(row_keep.astype(jnp.int32))
+        dec, n = jax.vmap(one)(ids, keep)
+        return dec, n
+    args = [input] + ([input_length] if input_length is not None else [])
+    return call(_cgd, *args, _name="ctc_greedy_decoder",
+                _nondiff=tuple(range(len(args))))
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """ref linear_chain_crf_op: negative log-likelihood of a linear-chain
+    CRF.  input [B, T, D] unary potentials; label [B, T].  Creates the
+    [D+2, D] transition parameter (rows 0/1 start/stop, rest [D, D]) —
+    the same layout crf_decoding consumes.  Forward algorithm rides a
+    lax.scan (log-sum-exp lattice)."""
+    D = int(input.shape[-1])
+    transition = create_parameter([D + 2, D], "float32", attr=param_attr)
+
+    def _crf(emis, lbl, trans, *rest):
+        B, T, _ = emis.shape
+        lens = rest[0].reshape(-1).astype(jnp.int32) if rest else \
+            jnp.full((B,), T, jnp.int32)
+        start, stop, A = trans[0], trans[1], trans[2:]
+        lbl = lbl.astype(jnp.int32)
+
+        def one(e, y, L):
+            # log partition
+            alpha0 = start + e[0]
+
+            def step(alpha, t):
+                nxt = jax.nn.logsumexp(alpha[:, None] + A, axis=0) + e[t]
+                alpha = jnp.where(t < L, nxt, alpha)
+                return alpha, None
+            alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+            logZ = jax.nn.logsumexp(alpha + stop)
+            # gold path score
+            live = jnp.arange(T) < L
+            unary = jnp.sum(jnp.where(
+                live, jnp.take_along_axis(e, y[:, None], 1)[:, 0], 0.0))
+            pair_live = (jnp.arange(1, T) < L)
+            pairs = jnp.where(pair_live, A[y[:-1], y[1:]], 0.0)
+            gold = (start[y[0]] + unary + jnp.sum(pairs)
+                    + stop[y[jnp.maximum(L - 1, 0)]])
+            return logZ - gold
+        nll = jax.vmap(one)(emis.astype(jnp.float32), lbl, lens)
+        return nll[:, None]
+    args = [input, label, transition] + (
+        [length] if length is not None else [])
+    return call(_crf, *args, _name="linear_chain_crf", _nondiff=(1, 3))
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """ref detection.py::detection_output: decode SSD locs against priors
+    then multiclass NMS.  loc [B, N, 4]; scores [B, N, C] (post-softmax);
+    returns [B, keep_top_k, 6] fixed-shape rows (label -1 padding)."""
+    from ..vision.detection import box_coder, multiclass_nms
+    from ..tensor.manipulation import transpose as _tr
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    return multiclass_nms(decoded, _tr(scores, [0, 2, 1]),
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=
+                                       True, use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """ref sampled_softmax_with_cross_entropy_op: softmax CE over the true
+    class plus ``num_samples`` uniformly sampled negatives — the large-
+    vocab training shortcut.  Per-sample loss [N, 1]."""
+    from ..framework import core
+    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+
+    def _ss(x, lbl):
+        N, C = x.shape
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        neg = jax.random.randint(key, (num_samples,), 0, C)
+        pos_logit = jnp.take_along_axis(x, lbl[:, None], 1)    # [N, 1]
+        neg_logit = x[:, neg]                                  # [N, S]
+        if remove_accidental_hits:
+            hit = neg[None, :] == lbl[:, None]
+            neg_logit = jnp.where(hit, -1e9, neg_logit)
+        z = jnp.concatenate([pos_logit, neg_logit], 1)
+        return -jax.nn.log_softmax(z, -1)[:, :1]
+    return call(_ss, logits, label,
+                _name="sampled_softmax_with_cross_entropy", _nondiff=(1,))

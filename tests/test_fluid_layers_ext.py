@@ -1,0 +1,261 @@
+"""fluid.layers long-tail: real-op numerics (edit_distance vs python
+Levenshtein, linear_chain_crf vs brute force, roi_align/roi_pool manual
+cases, ctc decode) plus delegation sanity."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+fl = paddle.fluid.layers
+
+
+def _lev(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1))
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[len(a), len(b)]
+
+
+class TestEditDistance:
+    def test_vs_python_levenshtein(self):
+        rng = np.random.RandomState(0)
+        B, Ta, Tb = 4, 7, 6
+        a = rng.randint(0, 5, (B, Ta))
+        b = rng.randint(0, 5, (B, Tb))
+        la = np.array([7, 5, 3, 1])
+        lb = np.array([6, 6, 2, 4])
+        d, _ = fl.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                                normalized=False,
+                                input_length=paddle.to_tensor(la),
+                                label_length=paddle.to_tensor(lb))
+        for i in range(B):
+            ref = _lev(list(a[i, :la[i]]), list(b[i, :lb[i]]))
+            np.testing.assert_allclose(d.numpy()[i, 0], ref,
+                                       err_msg=f"pair {i}")
+
+    def test_normalized(self):
+        a = np.array([[1, 2, 3]])
+        b = np.array([[1, 2, 4, 5]])
+        d, _ = fl.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                                normalized=True)
+        np.testing.assert_allclose(d.numpy()[0, 0], _lev([1, 2, 3],
+                                                         [1, 2, 4, 5]) / 4)
+
+
+class TestLinearChainCrf:
+    def test_nll_vs_bruteforce(self):
+        rng = np.random.RandomState(1)
+        B, T, D = 2, 4, 3
+        emis = rng.randn(B, T, D).astype("float32")
+        lbl = rng.randint(0, D, (B, T))
+        paddle.seed(0)
+        nll = fl.linear_chain_crf(paddle.to_tensor(emis),
+                                  paddle.to_tensor(lbl))
+        # recover the transition parameter the builder created
+        import paddle_tpu.fluid.layers_ext as ext
+        # brute force with the same transition: recompute via public API —
+        # build again with a FIXED transition through create_parameter
+        from paddle_tpu.framework.param_attr import ParamAttr
+        from paddle_tpu.nn.initializer import Assign
+        trans = rng.randn(D + 2, D).astype("float32")
+        nll2 = fl.linear_chain_crf(
+            paddle.to_tensor(emis), paddle.to_tensor(lbl),
+            param_attr=ParamAttr(initializer=Assign(trans)))
+        start, stop, A = trans[0], trans[1], trans[2:]
+        for b in range(B):
+            scores = []
+            for path in itertools.product(range(D), repeat=T):
+                s = start[path[0]] + emis[b, 0, path[0]]
+                for t in range(1, T):
+                    s += A[path[t - 1], path[t]] + emis[b, t, path[t]]
+                s += stop[path[-1]]
+                scores.append(s)
+            logZ = np.log(np.sum(np.exp(np.array(scores)
+                                        - max(scores)))) + max(scores)
+            gold = start[lbl[b, 0]] + emis[b, 0, lbl[b, 0]]
+            for t in range(1, T):
+                gold += A[lbl[b, t - 1], lbl[b, t]] + emis[b, t, lbl[b, t]]
+            gold += stop[lbl[b, -1]]
+            np.testing.assert_allclose(nll2.numpy()[b, 0], logZ - gold,
+                                       atol=1e-4)
+
+    def test_crf_pair_decoding_consistency(self):
+        # the argmax path must have lower NLL than a random path
+        rng = np.random.RandomState(2)
+        emis = rng.randn(1, 5, 3).astype("float32") * 2
+        from paddle_tpu.framework.param_attr import ParamAttr
+        from paddle_tpu.nn.initializer import Assign
+        trans = rng.randn(5, 3).astype("float32")
+        best = fl.crf_decoding(paddle.to_tensor(emis),
+                               paddle.to_tensor(trans)).numpy()[0]
+        nll_best = fl.linear_chain_crf(
+            paddle.to_tensor(emis), paddle.to_tensor(best[None]),
+            param_attr=ParamAttr(initializer=Assign(trans))).numpy()[0, 0]
+        rand = (best + 1) % 3
+        nll_rand = fl.linear_chain_crf(
+            paddle.to_tensor(emis), paddle.to_tensor(rand[None]),
+            param_attr=ParamAttr(initializer=Assign(trans))).numpy()[0, 0]
+        assert nll_best < nll_rand
+
+
+class TestRoi:
+    def test_roi_align_constant_image(self):
+        # constant image -> every pooled value equals the constant
+        x = np.full((1, 2, 8, 8), 3.5, np.float32)
+        rois = np.array([[0, 0, 7, 7], [2, 2, 5, 5]], np.float32)
+        out = fl.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                           pooled_height=2, pooled_width=2).numpy()
+        assert out.shape == (2, 2, 2, 2)
+        np.testing.assert_allclose(out, 3.5, atol=1e-5)
+
+    def test_roi_align_gradient_flows(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(1, 1, 6, 6).astype("float32"))
+        x.stop_gradient = False
+        rois = paddle.to_tensor(np.array([[1, 1, 4, 4]], np.float32))
+        out = fl.roi_align(x, rois, pooled_height=2, pooled_width=2)
+        out.sum().backward()
+        assert np.abs(x.grad.numpy()).sum() > 0
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 1] = 5.0
+        x[0, 0, 3, 3] = 7.0
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        out = fl.roi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                          pooled_height=2, pooled_width=2).numpy()
+        assert out[0, 0, 0, 0] == 5.0
+        assert out[0, 0, 1, 1] == 7.0
+
+
+class TestDecode:
+    def test_ctc_greedy_decoder(self):
+        # frames argmax: [1, 1, blank, 2, 2, blank] -> [1, 2]
+        T, C = 6, 4
+        x = np.full((1, T, C), -5.0, np.float32)
+        hot = [1, 1, 0, 2, 2, 0]       # blank = 0
+        for t, c in enumerate(hot):
+            x[0, t, c] = 5.0
+        dec, n = fl.ctc_greedy_decoder(paddle.to_tensor(x), blank=0)
+        assert int(n.numpy()[0]) == 2
+        np.testing.assert_array_equal(dec.numpy()[0, :2], [1, 2])
+        assert (dec.numpy()[0, 2:] == -1).all()
+
+    def test_detection_output_shapes(self):
+        rng = np.random.RandomState(4)
+        N = 6
+        priors = np.concatenate([rng.rand(N, 2) * 0.5,
+                                 rng.rand(N, 2) * 0.5 + 0.5], -1) \
+            .astype("float32")
+        pvar = np.full((N, 4), 0.1, np.float32)
+        loc = rng.randn(1, N, 4).astype("float32") * 0.1
+        scores = np.abs(rng.rand(1, N, 3)).astype("float32")
+        out = fl.detection_output(paddle.to_tensor(loc),
+                                  paddle.to_tensor(scores),
+                                  paddle.to_tensor(priors),
+                                  paddle.to_tensor(pvar),
+                                  score_threshold=0.01, keep_top_k=10)
+        assert out.shape == [1, 10, 6]
+
+    def test_sampled_softmax(self):
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(4, 100).astype("float32"))
+        x.stop_gradient = False
+        lbl = paddle.to_tensor(rng.randint(0, 100, (4, 1)))
+        loss = fl.sampled_softmax_with_cross_entropy(x, lbl, 10, seed=3)
+        assert loss.shape == [4, 1] and (loss.numpy() > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestSmallOps:
+    def test_losses(self):
+        a = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        b = paddle.to_tensor(np.array([[1.5, 0.0]], np.float32))
+        sl = fl.smooth_l1(a, b)
+        np.testing.assert_allclose(sl.numpy()[0, 0],
+                                   0.5 * 0.25 + (2.0 - 0.5), atol=1e-6)
+        h = fl.huber_loss(a, b, 1.0)
+        np.testing.assert_allclose(h.numpy()[0], [0.125, 1.5], atol=1e-6)
+        lbl = paddle.to_tensor(np.array([[1.0]], np.float32))
+        rl = fl.rank_loss(lbl, paddle.to_tensor(np.array([[2.0]], "float32")),
+                          paddle.to_tensor(np.array([[0.0]], "float32")))
+        np.testing.assert_allclose(rl.numpy()[0, 0], np.log1p(np.exp(-2.0)),
+                                   atol=1e-6)
+        bp = fl.bpr_loss(paddle.to_tensor(
+            np.array([[2.0, 0.0, 0.0]], "float32")),
+            paddle.to_tensor(np.array([[0]])))
+        assert float(bp.numpy()[0, 0]) > 0
+
+    def test_mean_iou(self):
+        pred = paddle.to_tensor(np.array([0, 1, 1, 2]))
+        lbl = paddle.to_tensor(np.array([0, 1, 2, 2]))
+        miou, inter, union = fl.mean_iou(pred, lbl, 3)
+        # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3
+        np.testing.assert_allclose(float(miou.numpy()), 2 / 3, atol=1e-6)
+
+    def test_pe_fsp_pad(self):
+        x = paddle.to_tensor(np.zeros((1, 4, 8), np.float32))
+        pe = fl.add_position_encoding(x)
+        assert pe.shape == [1, 4, 8]
+        assert np.abs(pe.numpy()).sum() > 0
+        f1 = paddle.to_tensor(np.ones((2, 3, 4, 4), np.float32))
+        f2 = paddle.to_tensor(np.ones((2, 5, 4, 4), np.float32))
+        g = fl.fsp_matrix(f1, f2)
+        assert g.shape == [2, 3, 5]
+        np.testing.assert_allclose(g.numpy(), 1.0)
+        y = paddle.to_tensor(np.ones((2, 2), np.float32))
+        xbig = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        p = fl.pad_constant_like(xbig, y, 9.0)
+        assert p.shape == [3, 4] and p.numpy()[2, 3] == 9.0
+
+    def test_resize_and_pools(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(6).randn(1, 2, 8, 8).astype("float32"))
+        assert fl.resize_bilinear(x, out_shape=[4, 4]).shape == [1, 2, 4, 4]
+        assert fl.resize_nearest(x, out_shape=[16, 16]).shape \
+            == [1, 2, 16, 16]
+        assert fl.image_resize_short(x, 4).shape == [1, 2, 4, 4]
+        assert fl.adaptive_pool2d(x, 2, "avg").shape == [1, 2, 2, 2]
+
+    def test_lr_builders(self):
+        s = fl.piecewise_decay([100, 200], [0.1, 0.05, 0.01])
+        assert abs(s() - 0.1) < 1e-9
+        n = fl.noam_decay(512, 4000)
+        assert n() > 0
+        c = fl.cosine_decay(0.1, 10, 5)
+        assert abs(c() - 0.1) < 1e-9
+
+    def test_tensor_array(self):
+        arr = fl.create_array("float32")
+        fl.array_write(paddle.to_tensor(np.ones((2, 2), np.float32)),
+                       0, arr)
+        fl.array_write(paddle.to_tensor(np.zeros((2, 2), np.float32)),
+                       1, arr)
+        assert int(fl.array_length(arr)) == 2
+        out, sizes = fl.tensor_array_to_tensor(arr, axis=0)
+        assert out.shape == [4, 2]
+        r = fl.array_read(arr, 1)
+        assert (r.numpy() == 0).all()
+
+    def test_misc_delegations(self):
+        x = paddle.to_tensor(np.array([[1.0, -2.0]], np.float32))
+        assert fl.brelu(x, 0.0, 1.0).numpy()[0, 0] == 1.0
+        assert float(fl.has_nan(x).numpy()) == 0
+        assert fl.l2_normalize(x).shape == [1, 2]
+        img = paddle.to_tensor(
+            np.random.RandomState(7).randn(1, 4, 4, 4).astype("float32"))
+        assert fl.space_to_depth(img, 2).shape == [1, 16, 2, 2]
+        s = fl.im2sequence(img, filter_size=2, stride=2)
+        assert s.shape == [4, 16]
+        crop = fl.random_crop(img, [2, 2], seed=1)
+        assert crop.shape[-2:] == [2, 2]
+        sc = fl.sigmoid_cross_entropy_with_logits(
+            x, paddle.to_tensor(np.array([[1.0, 0.0]], np.float32)))
+        assert (sc.numpy() >= 0).all()
