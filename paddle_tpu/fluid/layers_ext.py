@@ -768,3 +768,124 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
         return -jax.nn.log_softmax(z, -1)[:, :1]
     return call(_ss, logits, label,
                 _name="sampled_softmax_with_cross_entropy", _nondiff=(1,))
+
+
+from ..tensor.manipulation import crop  # noqa: E402,F401
+from ..nn.functional.sequence import (sequence_enumerate,  # noqa: E402,F401
+                                      sequence_expand_as, sequence_reshape,
+                                      sequence_scatter, sequence_slice)
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    dtype="float32", seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _T.normal(mean=mean, std=std, shape=shape)
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype="float32", seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _T.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001
+    """ref hash_op (CTR feature hashing): num_hash deterministic hashes of
+    each int row into [0, hash_size).  The reference uses xxhash of the
+    row bytes; any fixed high-quality integer mix works for the purpose
+    (bucketing) — here a splitmix64-style mix per hash seed."""
+    def _h(x):
+        x = x.astype(jnp.uint32)
+        outs = []
+        for k in range(num_hash):
+            h = x * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9 + k)
+            h = h ^ (h >> 16)
+            h = h * jnp.uint32(0x85EBCA6B)
+            h = h ^ (h >> 13)
+            # combine along the last axis so the whole row hashes as one
+            row = jnp.sum(h, -1, keepdims=True, dtype=jnp.uint32)
+            outs.append((row % jnp.uint32(hash_size)).astype(jnp.int64))
+        return jnp.concatenate(outs, -1)
+    return call(_h, input, _name="hash", _nondiff=(0,))
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_value=4.135, name=None):
+    """ref box_decoder_and_assign_op: decode per-class deltas
+    [N, C*4] against priors, then keep each row's argmax-class box."""
+    def _bda(pb, pv, tb, sc):
+        N = pb.shape[0]
+        C = sc.shape[1]
+        tb = tb.reshape(N, C, 4).astype(jnp.float32)
+        pb = pb.astype(jnp.float32)
+        pv = pv.astype(jnp.float32)
+        pw = pb[:, 2] - pb[:, 0] + 1.0
+        ph = pb[:, 3] - pb[:, 1] + 1.0
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        d = tb * pv[:, None, :]
+        dxy = d[..., :2]
+        dwh = jnp.clip(d[..., 2:], -box_clip_value, box_clip_value)
+        ocx = pcx[:, None] + dxy[..., 0] * pw[:, None]
+        ocy = pcy[:, None] + dxy[..., 1] * ph[:, None]
+        ow = pw[:, None] * jnp.exp(dwh[..., 0])
+        oh = ph[:, None] * jnp.exp(dwh[..., 1])
+        decoded = jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                             ocx + ow * 0.5 - 1.0, ocy + oh * 0.5 - 1.0],
+                            -1)                         # [N, C, 4]
+        best = jnp.argmax(sc, -1)                       # [N]
+        assigned = jnp.take_along_axis(
+            decoded, best[:, None, None].astype(jnp.int32)
+            .repeat(4, -1), 1)[:, 0]
+        return decoded.reshape(N, C * 4), assigned
+    return call(_bda, prior_box, prior_box_var, target_box, box_score,
+                _name="box_decoder_and_assign")
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """ref psroi_pool_op (R-FCN): position-sensitive average pooling —
+    bin (i, j) of output channel c averages input channel
+    c*ph*pw + i*pw + j over the bin region."""
+    def _ps(x, r, *rest):
+        N, C, H, W = x.shape
+        assert C == output_channels * pooled_height * pooled_width, C
+        R = r.shape[0]
+        if rest:
+            rn = rest[0].astype(jnp.int32)
+            img_of = jnp.repeat(jnp.arange(N), rn, total_repeat_length=R)
+        else:
+            img_of = jnp.zeros((R,), jnp.int32)
+        rb = r.astype(jnp.float32) * spatial_scale
+        gy = jnp.arange(H, dtype=jnp.float32)
+        gx = jnp.arange(W, dtype=jnp.float32)
+
+        def one_roi(img_idx, box):
+            img = x[img_idx].reshape(output_channels, pooled_height,
+                                     pooled_width, H, W)
+            x1, y1, x2, y2 = box
+            bh = jnp.maximum(y2 - y1, 0.1) / pooled_height
+            bw = jnp.maximum(x2 - x1, 0.1) / pooled_width
+            outs = []
+            for i in range(pooled_height):
+                for j in range(pooled_width):
+                    ys = y1 + i * bh
+                    ye = y1 + (i + 1) * bh
+                    xs_ = x1 + j * bw
+                    xe = x1 + (j + 1) * bw
+                    m = ((gy[:, None] >= jnp.floor(ys))
+                         & (gy[:, None] < jnp.ceil(ye))
+                         & (gx[None, :] >= jnp.floor(xs_))
+                         & (gx[None, :] < jnp.ceil(xe)))
+                    cnt = jnp.maximum(jnp.sum(m), 1.0)
+                    v = jnp.sum(img[:, i, j] * m[None], axis=(1, 2)) / cnt
+                    outs.append(v)
+            return jnp.stack(outs, -1).reshape(output_channels,
+                                               pooled_height, pooled_width)
+        return jax.vmap(one_roi)(img_of, rb)
+    args = [input, rois] + ([rois_num] if rois_num is not None else [])
+    return call(_ps, *args, _name="psroi_pool",
+                _nondiff=tuple(range(1, len(args))))

@@ -244,6 +244,44 @@ class TestSmallOps:
         r = fl.array_read(arr, 1)
         assert (r.numpy() == 0).all()
 
+    def test_hash_deterministic_bucketed(self):
+        x = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+        h1 = fl.hash(x, 100, num_hash=2)
+        h2 = fl.hash(x, 100, num_hash=2)
+        assert h1.shape == [2, 2]
+        assert (h1.numpy() == h2.numpy()).all()
+        assert (h1.numpy() >= 0).all() and (h1.numpy() < 100).all()
+
+    def test_psroi_pool_position_sensitive(self):
+        # channel (out 0, bin (0,0)) hot -> only that output bin nonzero
+        x = np.zeros((1, 2 * 2 * 2, 4, 4), np.float32)
+        x[0, 0] = 1.0
+        rois = np.array([[0., 0., 4., 4.]], np.float32)
+        out = fl.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                            2, 1.0, 2, 2).numpy()
+        assert out.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, atol=1e-5)
+        assert abs(out[0, 0, 0, 1]) < 1e-5
+        assert abs(out[0, 1].sum()) < 1e-5
+
+    def test_box_decoder_and_assign(self):
+        pb = np.array([[0., 0., 10., 10.]], np.float32)
+        pv = np.ones((1, 4), np.float32)
+        tb = np.zeros((1, 8), np.float32)     # zero deltas, 2 classes
+        sc = np.array([[0.2, 0.8]], np.float32)
+        dec, assigned = fl.box_decoder_and_assign(
+            paddle.to_tensor(pb), paddle.to_tensor(pv),
+            paddle.to_tensor(tb), paddle.to_tensor(sc))
+        assert dec.shape == [1, 8] and assigned.shape == [1, 4]
+        np.testing.assert_allclose(assigned.numpy()[0], [0, 0, 10, 10],
+                                   atol=1e-5)
+
+    def test_batch_size_like_randoms(self):
+        base = paddle.to_tensor(np.zeros((5, 3), np.float32))
+        g = fl.gaussian_random_batch_size_like(base, [1, 7])
+        u = fl.uniform_random_batch_size_like(base, [1, 4])
+        assert g.shape == [5, 7] and u.shape == [5, 4]
+
     def test_misc_delegations(self):
         x = paddle.to_tensor(np.array([[1.0, -2.0]], np.float32))
         assert fl.brelu(x, 0.0, 1.0).numpy()[0, 0] == 1.0
