@@ -889,3 +889,88 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
     args = [input, rois] + ([rois_num] if rois_num is not None else [])
     return call(_ps, *args, _name="psroi_pool",
                 _nondiff=tuple(range(1, len(args))))
+
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded):
+    """Decode (begin, end, type) chunks from a tag-id sequence.  Tag-id
+    layout matches the reference chunk_eval_op: for a scheme with K tag
+    kinds (IOB: B,I / IOE: I,E / IOBES: B,I,E,S / IO: I), id =
+    chunk_type * K + tag_kind; the single O tag is num_chunk_types * K."""
+    kinds = {"IOB": ["B", "I"], "IOE": ["I", "E"],
+             "IOBES": ["B", "I", "E", "S"], "IO": ["I"]}[scheme]
+    K = len(kinds)
+    o_tag = num_chunk_types * K
+    chunks = []
+    start = None
+    cur_type = None
+
+    def close(end):
+        nonlocal start, cur_type
+        if start is not None and cur_type not in excluded:
+            chunks.append((start, end, cur_type))
+        start = None
+        cur_type = None
+
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t >= o_tag or t < 0:
+            close(i)
+            continue
+        ctype, kind = t // K, kinds[t % K]
+        if scheme == "IO":
+            if cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOB":
+            if kind == "B" or cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOE":
+            if cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+            if kind == "E":
+                close(i + 1)
+        else:  # IOBES
+            if kind in ("B", "S") or cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+            if kind in ("E", "S"):
+                close(i + 1)
+    close(len(tags))
+    return set(chunks)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """ref chunk_eval_op: chunk-level precision/recall/F1 for sequence
+    tagging (NER).  Host-side metric (eval path, not jitted): input/label
+    [B, T] tag ids (+ optional lengths).  Returns (precision, recall, f1,
+    num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+    scheme = chunk_scheme.upper()
+    if scheme == "PLAIN":
+        scheme = "IO"
+    excluded = set(excluded_chunk_types or ())
+    inf = np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+    lab = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    if inf.ndim == 1:
+        inf, lab = inf[None], lab[None]
+    lens = (np.asarray(seq_length.numpy() if hasattr(seq_length, "numpy")
+                       else seq_length).reshape(-1)
+            if seq_length is not None
+            else np.full(inf.shape[0], inf.shape[1]))
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        ci = _extract_chunks(inf[b, :lens[b]], scheme, num_chunk_types,
+                             excluded)
+        cl = _extract_chunks(lab[b, :lens[b]], scheme, num_chunk_types,
+                             excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    mk = lambda v, dt=np.float32: Tensor(np.asarray(v, dt))
+    return (mk(p), mk(r), mk(f1), mk(n_inf, np.int64),
+            mk(n_lab, np.int64), mk(n_cor, np.int64))

@@ -244,6 +244,22 @@ class TestSmallOps:
         r = fl.array_read(arr, 1)
         assert (r.numpy() == 0).all()
 
+    def test_chunk_eval_iob(self):
+        # IOB, 2 types: B-0=0, I-0=1, B-1=2, I-1=3, O=4
+        lab = paddle.to_tensor(np.array([[0, 1, 4, 2, 3, 4]]))
+        inf = paddle.to_tensor(np.array([[0, 1, 4, 2, 4, 4]]))
+        p, r, f1, ni, nl, nc = fl.chunk_eval(inf, lab, "IOB", 2)
+        assert (int(ni), int(nl), int(nc)) == (2, 2, 1)
+        np.testing.assert_allclose(float(f1), 0.5)
+        _, _, f1x, *_ = fl.chunk_eval(lab, lab, "IOB", 2)
+        assert float(f1x) == 1.0
+
+    def test_chunk_eval_iobes(self):
+        # IOBES, 1 type: B=0, I=1, E=2, S=3, O=4
+        lab = paddle.to_tensor(np.array([[0, 1, 2, 4, 3]]))  # [0,3) and [4,5)
+        p, r, f1, ni, nl, nc = fl.chunk_eval(lab, lab, "IOBES", 1)
+        assert int(nl) == 2 and float(f1) == 1.0
+
     def test_hash_deterministic_bucketed(self):
         x = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
         h1 = fl.hash(x, 100, num_hash=2)
