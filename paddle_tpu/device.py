@@ -65,3 +65,23 @@ class cuda:
 
 class tpu(cuda):
     pass
+
+
+# place aliases + enumeration (ref: python/paddle/device.py re-exports)
+from .framework.core import (CPUPlace, TPUPlace, CUDAPlace,  # noqa: E402
+                             CUDAPinnedPlace)
+from .framework.core import TPUPlace as XPUPlace  # noqa: E402,F401
+from .static.misc import cpu_places, cuda_places  # noqa: E402,F401
+
+
+def cuda_pinned_places(device_count=None):
+    n = device_count or 1
+    return [CUDAPinnedPlace() for _ in range(n)]
+
+
+def get_cudnn_version():
+    return None
+
+
+def is_compiled_with_npu():
+    return False

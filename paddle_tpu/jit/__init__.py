@@ -1,3 +1,36 @@
 from .api import (to_static, not_to_static, save, load, TracedLayer,
                   InputSpec, enable_static, disable_static)
 from . import functional
+
+# legacy dygraph-to-static surface (ref: fluid/dygraph/jit.py,
+# dygraph_to_static/program_translator.py): with jax.jit there is no
+# source-translation pass — ProgramTranslator survives as the enable/
+# disable switch and TranslatedLayer as the loaded-artifact class.
+from .api import TracedLayer as TranslatedLayer  # noqa: F401,E402
+
+_verbosity = [0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _verbosity[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    _verbosity[0] = int(level)
+
+
+class ProgramTranslator:
+    """Singleton switch for to_static (ref ProgramTranslator.enable)."""
+    _inst = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    def enable(self, enable_to_static=True):
+        self.enable_to_static = bool(enable_to_static)
