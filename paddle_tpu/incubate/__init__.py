@@ -25,3 +25,6 @@ def fused_layer_norm(x, weight, bias, epsilon=1e-5):
     from ..ops.pallas.norms import layer_norm as _ln
     return dispatch.call(lambda a, w, b: _ln(a, w, b, epsilon),
                          x, weight, bias, _name="fused_layer_norm")
+
+from . import optimizer
+from .optimizer import LookAhead, ModelAverage

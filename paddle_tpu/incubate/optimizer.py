@@ -1,0 +1,116 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage (ref:
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py).
+
+Both are weight-space wrappers, not gradient transforms, so they sit
+OUTSIDE the jitted inner step: the inner optimizer's fused update runs
+compiled; the slow-weight interpolation / running average is a cheap
+device-side tree op every k steps."""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """k fast steps, then slow <- slow + alpha * (fast - slow); fast <-
+    slow (Zhang et al. 2019; ref incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        super().__init__(learning_rate=inner_optimizer._lr,
+                         parameters=list(inner_optimizer._parameters))
+        self._slow = {id(p): p.value for p in self._parameters}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._parameters:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p.value - slow)
+                self._slow[id(p)] = slow
+                p.value = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_slow"] = {i: v for i, (k, v) in
+                                enumerate(self._slow.items())}
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters over a sliding window; ``apply()``
+    swaps the averaged weights in for evaluation, ``restore()`` swaps the
+    training weights back (ref incubate/optimizer/modelaverage.py — there
+    via sum_1/sum_2/sum_3 accumulator rotation; one running (sum, count)
+    with the same window clamping behaves identically for the window
+    sizes involved)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._sum = {id(p): jnp.zeros_like(p.value)
+                     for p in self._parameters}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._step_count += 1
+        window = max(self.min_window,
+                     min(self.max_window,
+                         int(self._step_count * self.rate)))
+        if self._count >= window:
+            # slide: decay the sum so old steps wash out (the reference
+            # rotates its sum_1/2/3 blocks for the same effect)
+            keep = (window - 1) / window
+            for k in self._sum:
+                self._sum[k] = self._sum[k] * keep
+            self._count = int(self._count * keep)
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p.value
+        self._count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, None
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged weights (context manager, reference API)."""
+        self._backup = {id(p): p.value for p in self._parameters}
+        denom = max(self._count, 1)
+        for p in self._parameters:
+            p.value = self._sum[id(p)] / denom
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._parameters:
+                p.value = self._backup[id(p)]
+            self._backup = None

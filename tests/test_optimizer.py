@@ -194,3 +194,50 @@ def test_auto_cast_context():
         assert amp_state() is not None
     from paddle_tpu.amp.auto_cast import amp_state
     assert amp_state() is None
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_converges_and_interpolates(self):
+        import paddle_tpu as paddle
+        import numpy as np
+        rng = np.random.RandomState(0)
+        xv = rng.randn(64, 4).astype("float32")
+        w_true = rng.randn(4, 1).astype("float32")
+        yv = xv @ w_true
+        lin = paddle.nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=5)
+        first = last = None
+        for _ in range(40):
+            loss = ((lin(paddle.to_tensor(xv))
+                     - paddle.to_tensor(yv)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first * 0.2, (first, last)
+        # slow weights must equal the live weights right after a sync step
+        assert opt._step_count % 5 == 0
+        np.testing.assert_allclose(
+            np.asarray(lin.weight.numpy()),
+            np.asarray(opt._slow[id(lin.weight)]), atol=1e-6)
+
+    def test_model_average_apply_restore(self):
+        import paddle_tpu as paddle
+        import numpy as np
+        lin = paddle.nn.Linear(2, 1)
+        ma = paddle.incubate.ModelAverage(
+            0.15, parameters=lin.parameters(), min_average_window=10,
+            max_average_window=20)
+        seen = []
+        for i in range(4):
+            lin.weight.set_value(
+                np.full((2, 1), float(i), np.float32))
+            ma.step()
+            seen.append(float(i))
+        live = np.asarray(lin.weight.numpy()).copy()
+        with ma.apply():
+            avg = np.asarray(lin.weight.numpy())
+            np.testing.assert_allclose(avg, np.mean(seen), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), live)
