@@ -221,9 +221,19 @@ def square_error_cost(input, label):
     return F.square_error_cost(input, label)
 
 
-def accuracy(input, label, k=1):
-    from ..metric import accuracy as _acc
-    return _acc(input, label, k=k)
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy as a DISPATCHED op (unlike metric.accuracy's
+    host-side numpy) so it records into static programs and jits."""
+    import jax.numpy as jnp
+    from ..ops.dispatch import call
+
+    def _acc(p, l):
+        idx = jnp.argsort(-p, axis=-1)[..., :k]
+        if l.ndim == p.ndim:
+            l = jnp.squeeze(l, -1)
+        hit = jnp.any(idx == l[..., None], -1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return call(_acc, input, label, _name="accuracy", _nondiff=(1,))
 
 
 def dropout(x, dropout_prob, is_test=False, seed=None,
