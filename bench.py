@@ -103,11 +103,15 @@ def _arm_watchdog(seconds=1500):
 
 
 def main():
-    _arm_watchdog()
+    # device probe gets a SHORT fuse: a dead axon relay makes
+    # jax.devices() hang forever (r3 observed), and burning the full
+    # 1500s watchdog on it would eat the driver's budget
+    _arm_watchdog(300)
     from paddle_tpu.parallel.mesh import create_mesh
     from paddle_tpu.models import gpt
 
     dev = jax.devices()[0]
+    _arm_watchdog()           # full budget for compile + timed steps
     on_tpu = dev.platform not in ("cpu",)
     if on_tpu:
         _preflight_pallas()
