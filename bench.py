@@ -106,12 +106,14 @@ def main():
     # device probe gets a SHORT fuse: a dead axon relay makes
     # jax.devices() hang forever (r3 observed), and burning the full
     # 1500s watchdog on it would eat the driver's budget
+    t_start = time.perf_counter()
     _arm_watchdog(300)
     from paddle_tpu.parallel.mesh import create_mesh
     from paddle_tpu.models import gpt
 
     dev = jax.devices()[0]
-    _arm_watchdog()           # full budget for compile + timed steps
+    # remaining budget for compile + timed steps — total stays <= 1500s
+    _arm_watchdog(max(1500 - int(time.perf_counter() - t_start), 60))
     on_tpu = dev.platform not in ("cpu",)
     if on_tpu:
         _preflight_pallas()
