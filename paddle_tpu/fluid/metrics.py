@@ -1,0 +1,313 @@
+"""fluid.metrics — streaming metric classes (ref:
+python/paddle/fluid/metrics.py).  Host-side accumulators (metrics are the
+eval path, not the compiled hot loop); DetectionMAP consumes the
+fixed-shape [K, 6] rows detection_output/multiclass_nms emit (label -1 =
+padding) instead of the reference's ragged LoD layout."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
+           "Accuracy", "ChunkEvaluator", "EditDistance", "Auc",
+           "DetectionMAP"]
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k, v in list(self.__dict__.items()):
+            if k.startswith("_") or k == "metrics":
+                continue
+            self.__dict__[k] = 0.0 if isinstance(v, float) else \
+                0 if isinstance(v, int) else v
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def update(self, *a, **kw):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+
+class Precision(MetricBase):
+    """Binary streaming precision: preds are P(positive)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) >= 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(np.sum((p == 1) & (l == 1)))
+        self.fp += int(np.sum((p == 1) & (l == 0)))
+
+    def eval(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) >= 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(np.sum((p == 1) & (l == 1)))
+        self.fn += int(np.sum((p == 0) & (l == 1)))
+
+    def eval(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Accuracy(MetricBase):
+    """Streaming weighted mean of per-batch accuracies (fluid semantics:
+    update(value, weight))."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if weight < 0:
+            raise ValueError("weight must be nonnegative")
+        self.value += float(_np(value).reshape(-1)[0]) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulates the counters fluid.layers.chunk_eval emits."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(_np(num_infer_chunks))
+        self.num_label_chunks += int(_np(num_label_chunks))
+        self.num_correct_chunks += int(_np(num_correct_chunks))
+
+    def eval(self):
+        p = (self.num_correct_chunks / self.num_infer_chunks
+             if self.num_infer_chunks else 0.0)
+        r = (self.num_correct_chunks / self.num_label_chunks
+             if self.num_label_chunks else 0.0)
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = _np(distances).reshape(-1)
+        self.total_distance += float(np.sum(d))
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(d > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no sequences accumulated")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """Threshold-bucketed streaming ROC AUC (ref fluid metrics.Auc)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        idx = np.clip((p * self._num).astype(np.int64), 0, self._num)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def eval(self):
+        tot_pos = tot_neg = 0
+        area = 0.0
+        for i in range(self._num, -1, -1):
+            pos, neg = self._stat_pos[i], self._stat_neg[i]
+            area += neg * (tot_pos + pos + tot_pos) / 2.0
+            tot_pos += pos
+            tot_neg += neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return area / (tot_pos * tot_neg)
+
+    def reset(self):
+        self._stat_pos[:] = 0
+        self._stat_neg[:] = 0
+
+
+class DetectionMAP:
+    """VOC mean average precision over fixed-shape detections (ref
+    fluid/metrics.py::DetectionMAP over detection_map_op).
+
+    update(dets, gt_labels, gt_boxes, difficult=None) per image (or
+    batched): dets [K, 6] rows (label, score, x1, y1, x2, y2) with label
+    -1 padding; gt_boxes [G, 4]; gt_labels [G] (padding boxes are
+    all-zero).  accumulate() -> mAP ('11point' or 'integral')."""
+
+    def __init__(self, class_num, overlap_threshold=0.5,
+                 evaluate_difficult=False, ap_version="integral"):
+        self.class_num = class_num
+        self.thr = overlap_threshold
+        self.eval_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self._dets = []     # (img_id, label, score, box)
+        self._gts = []      # (img_id, label, box, difficult)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        ix1 = max(a[0], b[0])
+        iy1 = max(a[1], b[1])
+        ix2 = min(a[2], b[2])
+        iy2 = min(a[3], b[3])
+        iw = max(ix2 - ix1, 0.0)
+        ih = max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, dets, gt_labels, gt_boxes, difficult=None):
+        dets = _np(dets)
+        gl = _np(gt_labels)
+        gb = _np(gt_boxes)
+        diff = _np(difficult) if difficult is not None else None
+        if dets.ndim == 2:
+            dets, gl, gb = dets[None], gl[None], gb[None]
+            diff = diff[None] if diff is not None else None
+        for b in range(dets.shape[0]):
+            img = self._img
+            self._img += 1
+            for row in dets[b]:
+                if row[0] < 0:
+                    continue
+                self._dets.append((img, int(row[0]), float(row[1]),
+                                   row[2:6].astype(float)))
+            for g in range(gb.shape[1]):
+                box = gb[b, g]
+                if box[2] <= box[0] or box[3] <= box[1]:
+                    continue
+                d = bool(diff[b, g]) if diff is not None else False
+                self._gts.append((img, int(np.ravel(gl[b, g])[0]),
+                                  box.astype(float), d))
+
+    def accumulate(self):
+        aps = []
+        for c in range(self.class_num):
+            gts_c = [(i, box, d) for (i, l, box, d) in self._gts if l == c]
+            if not gts_c:
+                continue
+            npos = sum(1 for (_, _, d) in gts_c
+                       if self.eval_difficult or not d)
+            dets_c = sorted((d for d in self._dets if d[1] == c),
+                            key=lambda r: -r[2])
+            matched = set()
+            tp, fp = [], []
+            for (img, _, score, box) in dets_c:
+                cands = [(k, g) for k, g in enumerate(gts_c)
+                         if g[0] == img]
+                best_iou, best_k = 0.0, -1
+                for k, (_, gbox, gdiff) in cands:
+                    iou = self._iou(box, gbox)
+                    if iou > best_iou:
+                        best_iou, best_k = iou, k
+                if best_iou >= self.thr and best_k not in matched:
+                    gdiff = gts_c[best_k][2]
+                    if gdiff and not self.eval_difficult:
+                        continue     # difficult matches don't count at all
+                    matched.add(best_k)
+                    tp.append(1)
+                    fp.append(0)
+                else:
+                    tp.append(0)
+                    fp.append(1)
+            if npos == 0:
+                continue
+            tp = np.cumsum(tp)
+            fp = np.cumsum(fp)
+            rec = tp / npos
+            prec = tp / np.maximum(tp + fp, 1e-10)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    mask = rec >= t
+                    ap += (np.max(prec[mask]) if mask.any() else 0.0) / 11
+            else:
+                ap = 0.0
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                for i in range(len(mrec) - 1):
+                    if mrec[i + 1] != mrec[i]:
+                        ap += (mrec[i + 1] - mrec[i]) * mpre[i + 1]
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+    get_map_var = accumulate

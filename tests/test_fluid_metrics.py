@@ -1,0 +1,123 @@
+"""fluid.metrics streaming classes incl. VOC DetectionMAP goldens."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+fm = paddle.fluid.metrics
+
+
+class TestStreaming:
+    def test_precision_recall(self):
+        p = fm.Precision()
+        r = fm.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.eval() == pytest.approx(2 / 3)    # tp=2 fp=1
+        assert r.eval() == pytest.approx(2 / 3)    # tp=2 fn=1
+
+    def test_accuracy_weighted(self):
+        a = fm.Accuracy()
+        a.update(0.5, 10)
+        a.update(1.0, 10)
+        assert a.eval() == pytest.approx(0.75)
+
+    def test_chunk_evaluator_composes_with_chunk_eval(self):
+        fl = paddle.fluid.layers
+        lab = paddle.to_tensor(np.array([[0, 1, 4, 2, 3, 4]]))
+        inf = paddle.to_tensor(np.array([[0, 1, 4, 2, 4, 4]]))
+        _, _, _, ni, nl, nc = fl.chunk_eval(inf, lab, "IOB", 2)
+        ce = fm.ChunkEvaluator()
+        ce.update(ni, nl, nc)
+        ce.update(ni, nl, nc)
+        p, r, f1 = ce.eval()
+        assert f1 == pytest.approx(0.5)
+
+    def test_edit_distance(self):
+        ed = fm.EditDistance()
+        ed.update(np.array([0.0, 2.0]), 2)
+        avg, err = ed.eval()
+        assert avg == pytest.approx(1.0)
+        assert err == pytest.approx(0.5)
+
+    def test_auc_perfect_and_random(self):
+        auc = fm.Auc()
+        auc.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 1, 0, 0]))
+        assert auc.eval() == pytest.approx(1.0)
+        auc.reset()
+        auc.update(np.array([0.6, 0.6, 0.6, 0.6]), np.array([1, 0, 1, 0]))
+        assert auc.eval() == pytest.approx(0.5)
+
+    def test_composite(self):
+        c = fm.CompositeMetric()
+        c.add_metric(fm.Precision())
+        c.add_metric(fm.Recall())
+        c.update(np.array([0.9]), np.array([1]))
+        assert c.eval() == [1.0, 1.0]
+
+
+class TestDetectionMAP:
+    def test_perfect_detections(self):
+        m = fm.DetectionMAP(class_num=2)
+        gt_boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+        gt_labels = np.array([[0, 1]])
+        dets = np.array([[[0, 0.9, 0, 0, 10, 10],
+                          [1, 0.8, 20, 20, 30, 30],
+                          [-1, 0, 0, 0, 0, 0]]], "float32")
+        m.update(dets, gt_labels, gt_boxes)
+        assert m.accumulate() == pytest.approx(1.0)
+
+    def test_false_positive_halves_ap(self):
+        m = fm.DetectionMAP(class_num=1)
+        gt_boxes = np.array([[[0, 0, 10, 10]]], "float32")
+        gt_labels = np.array([[0]])
+        # fp with the HIGHER score ranks first: precision@match = 1/2
+        dets = np.array([[[0, 0.9, 50, 50, 60, 60],
+                          [0, 0.8, 0, 0, 10, 10]]], "float32")
+        m.update(dets, gt_labels, gt_boxes)
+        assert m.accumulate() == pytest.approx(0.5)
+
+    def test_11point_version(self):
+        m = fm.DetectionMAP(class_num=1, ap_version="11point")
+        gt_boxes = np.array([[[0, 0, 10, 10]]], "float32")
+        gt_labels = np.array([[0]])
+        dets = np.array([[[0, 0.9, 0, 0, 10, 10]]], "float32")
+        m.update(dets, gt_labels, gt_boxes)
+        assert m.accumulate() == pytest.approx(1.0)
+
+    def test_duplicate_detection_is_fp(self):
+        m = fm.DetectionMAP(class_num=1)
+        gt_boxes = np.array([[[0, 0, 10, 10]]], "float32")
+        gt_labels = np.array([[0]])
+        dets = np.array([[[0, 0.9, 0, 0, 10, 10],
+                          [0, 0.8, 0, 0, 10, 10]]], "float32")
+        m.update(dets, gt_labels, gt_boxes)
+        # second match of the same gt counts as fp; integral AP stays 1.0
+        # at recall 1 reached by the first det
+        assert m.accumulate() == pytest.approx(1.0)
+
+
+class TestContribAmp:
+    def test_mixed_precision_decorate_trains(self):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        amp_opt = paddle.fluid.contrib.mixed_precision.decorate(opt)
+        rng = np.random.RandomState(0)
+        xv = rng.randn(16, 4).astype("float32")
+        yv = xv.sum(1, keepdims=True).astype("float32")
+        first = last = None
+        for _ in range(15):
+            loss = ((lin(paddle.to_tensor(xv))
+                     - paddle.to_tensor(yv)) ** 2).mean()
+            amp_opt.minimize(loss)
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first * 0.5
+
+    def test_slim_quant_aliases(self):
+        q = paddle.fluid.contrib.slim.quantization
+        assert q.PostTrainingQuantization is not None
+        assert q.QuantizationTransformPass is not None
