@@ -1,0 +1,489 @@
+"""Block-style control flow: While / Switch / IfElse / StaticRNN (ref:
+python/paddle/fluid/layers/control_flow.py — the `with op.block():`
+spelling over sub-block ProgramDescs).
+
+TPU-native mechanics: the `with` body records ops into the main Program
+once (executing eagerly on build values, so shapes resolve).  On exit the
+recorded slice is CUT out and replaced by ONE composite op that replays it
+under the matching lax primitive (`while_loop` / `cond` chain / `scan`).
+Mutation is tracked through var-id adoption: `layers.assign(new, var)`
+rebinds `var` to the new op output's id, so a snapshot-diff of live
+tensors' ids yields the loop-carried (before, after) pairs — no block
+rewrite passes, and the whole loop compiles into the surrounding XLA
+program.
+
+These classes require static mode (so does the reference's While)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..static import graph as G
+from ..static.control_flow import (_split_externals, _mark_live,
+                                   _args_treedef, _available_here)
+from ..tensor.tensor import Tensor
+
+
+def _carried_specs(vbs, entry_vals, prog):
+    """in_specs for loop-carried ids: a live var reference when the replay
+    env will hold it, else the value SNAPSHOTTED AT BLOCK ENTRY baked as a
+    const (build-time tensors like fill_constant results mutate during the
+    build pass, so their current value is NOT the loop init)."""
+    usable = G._live_var_ids & _available_here(prog)
+    return [("var", vb) if vb in usable else ("const", entry_vals[vb])
+            for vb in vbs]
+
+
+def _require_static(what):
+    if not G.in_static_mode():
+        raise RuntimeError(
+            f"{what} is a static-graph block op (matches the reference); "
+            "use the functional cond/while_loop in dygraph")
+
+
+def _snapshot_all_tensors():
+    """(tensor, slot_or_None, value) for EVERY live Tensor — build-time
+    only (once per block).  gc enumeration is needed because tensors made
+    by creation ops (fill_constant & co) have no var id until first READ,
+    which may happen inside the block being captured."""
+    import gc
+    out = []
+    for o in gc.get_objects():
+        if type(o) is Tensor or isinstance(o, Tensor):
+            out.append((o, getattr(o, "_weakref_slot", None), o.value))
+    return out
+
+
+def _mutation_pairs_full(snapshot, produced, captured):
+    """(tensor, vb, va, entry_value) for every snapshotted tensor now
+    holding an id produced inside the slice.  Tensors with no entry id get
+    their in-slice read id recovered from the capture registry; the entry
+    VALUE (snapshotted before the body built) is the carry init."""
+    pairs = []
+    for t, slot0, val0 in snapshot:
+        cur = getattr(t, "_weakref_slot", None)
+        if cur is None or cur not in produced or cur == slot0:
+            continue
+        vb = slot0
+        if vb is None:
+            vb = next((vid for vid, ct in captured.items() if ct is t),
+                      None)
+            if vb is None:
+                continue
+        pairs.append((t, vb, cur, val0))
+    return pairs
+
+
+def _slice_program(parent, start):
+    """Cut parent.ops[start:] into a fresh sub-Program."""
+    sub = G.Program()
+    sub.ops = parent.ops[start:]
+    del parent.ops[start:]
+    sub.captured = parent.captured
+    return sub
+
+
+def _slice_reads(sub, exclude):
+    produced, ext = set(), []
+    for op in sub.ops:
+        for kind, ref in op.leaf_specs:
+            if kind == "var" and ref not in produced and ref not in ext \
+                    and ref not in exclude:
+                ext.append(ref)
+        produced.update(op.out_ids)
+    return ext, produced
+
+
+class While:
+    """ref control_flow.py::While — `with while_op.block():` loops while
+    the cond var is truthy; body mutations via layers.assign carry."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        _require_static("While")
+        self._cond = cond
+        self._prog = G.default_main_program()
+
+    def block(self):
+        return _WhileBlock(self)
+
+
+class _WhileBlock:
+    def __init__(self, op):
+        self._op = op
+
+    def __enter__(self):
+        self._start = len(self._op._prog.ops)
+        self._snapshot = _snapshot_all_tensors()
+        self._cond_vid0 = G._ensure_var_id(self._op._cond, self._op._prog)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        prog = self._op._prog
+        sub = _slice_program(prog, self._start)
+        ext_all, produced = _slice_reads(sub, exclude=())
+        pairs = _mutation_pairs_full(self._snapshot, produced,
+                                     prog.captured)
+        if not any(p[1] == self._cond_vid0 for p in pairs):
+            raise ValueError(
+                "While block must reassign the cond var (layers.assign) "
+                "or the loop would never terminate")
+        vbs = [p[1] for p in pairs]
+        vas = [p[2] for p in pairs]
+        entry_vals = {vb: v0 for _, vb, _, v0 in pairs}
+        cond_pos = vbs.index(self._cond_vid0)
+        ext = [e for e in ext_all if e not in vbs]
+        live, const_env = _split_externals(ext)
+        n = len(vbs)
+
+        def composite(*vals):
+            init, ext_vals = vals[:n], vals[n:]
+
+            def env_for(carry):
+                env = dict(zip(vbs, carry))
+                env.update(dict(zip(live, ext_vals)))
+                env.update(const_env)
+                return env
+
+            def c(carry):
+                return jnp.reshape(
+                    jnp.asarray(carry[cond_pos]).astype(bool), ())
+
+            def b(carry):
+                env = env_for(carry)
+                sub.replay(env)
+                return tuple(env[va] for va in vas)
+
+            return jax.lax.while_loop(c, b, tuple(init))
+
+        in_specs = _carried_specs(vbs, entry_vals, prog)
+        in_specs += [("var", v) for v in live]
+        prog.record(composite, _args_treedef(n + len(live)), in_specs,
+                    list(vas), "while_block")
+        _mark_live(vas)
+        return False
+
+
+class Switch:
+    """ref control_flow.py::Switch — first true case's assignments win:
+
+        with fluid.layers.Switch() as switch:
+            with switch.case(cond): layers.assign(a, out)
+            with switch.default():  layers.assign(b, out)
+    """
+
+    def __init__(self, name=None):
+        _require_static("Switch")
+        self._prog = G.default_main_program()
+        self._cases = []          # (cond_or_None, sub, pairs)
+        self._entry_vals = {}     # vb -> entry value (first case wins)
+
+    def __enter__(self):
+        return self
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        prog = self._prog
+        # canonicalize mutated vars by TENSOR identity: each case sees its
+        # own (vb, va) ids for the same logical variable
+        cols = []                 # [tensor]
+        col_vb0 = []              # first-seen vb (for the in_spec)
+        col_v0 = []               # entry value (first case's snapshot)
+        for _, _, pairs in self._cases:
+            for t, vb, va, v0 in pairs:
+                if not any(t is c for c in cols):
+                    cols.append(t)
+                    col_vb0.append(vb)
+                    col_v0.append(v0)
+        n = len(cols)
+        # per-case maps: column -> (seed vid, result vid)
+        case_maps = []
+        for cond, sub, pairs in self._cases:
+            m = {}
+            for t, vb, va, _ in pairs:
+                for ci, c in enumerate(cols):
+                    if t is c:
+                        m[ci] = (vb, va)
+            case_maps.append(m)
+        cases = [(cond, sub) for cond, sub, _ in self._cases]
+
+        carried_vids = set(vb for m in case_maps for vb, _ in m.values())
+        ext = []
+        for _, sub, _ in self._cases:
+            es, _ = _slice_reads(sub, exclude=carried_vids)
+            for e in es:
+                if e not in ext:
+                    ext.append(e)
+        live, const_env = _split_externals(ext)
+        cond_vids = [G._ensure_var_id(c, prog)
+                     for c, _ in cases if c is not None]
+
+        def composite(*vals):
+            init = vals[:n]
+            conds = vals[n:n + len(cond_vids)]
+            ext_vals = vals[n + len(cond_vids):]
+
+            def run_case(idx):
+                def f(carry):
+                    _, sub = cases[idx]
+                    amap = case_maps[idx]
+                    env = dict(zip(live, ext_vals))
+                    env.update(const_env)
+                    for ci, (vb, _) in amap.items():
+                        env[vb] = carry[ci]
+                    sub.replay(env)
+                    return tuple(
+                        env[amap[ci][1]] if ci in amap else carry[ci]
+                        for ci in range(n))
+                return f
+
+            def chain(idx, carry):
+                if idx >= len(cases):
+                    return tuple(carry)
+                cond, _ = cases[idx]
+                if cond is None:          # default: always runs if reached
+                    return run_case(idx)(carry)
+                ci = sum(1 for c, _ in cases[:idx] if c is not None)
+                return jax.lax.cond(
+                    jnp.reshape(jnp.asarray(conds[ci]).astype(bool), ()),
+                    run_case(idx), lambda cr: chain(idx + 1, cr), carry)
+
+            return chain(0, tuple(init))
+
+        entry_vals = dict(zip(col_vb0, col_v0))
+        in_specs = _carried_specs(col_vb0, entry_vals, prog)
+        in_specs += [("var", c) for c in cond_vids]
+        in_specs += [("var", v) for v in live]
+        # each tensor's CURRENT id is where later program reads resolve
+        out_ids = [getattr(t, "_weakref_slot") for t in cols]
+        prog.record(composite,
+                    _args_treedef(n + len(cond_vids) + len(live)),
+                    in_specs, out_ids, "switch_block")
+        _mark_live(out_ids)
+        return False
+
+
+class _SwitchCase:
+    def __init__(self, sw, cond):
+        self._sw = sw
+        self._cond = cond
+
+    def __enter__(self):
+        self._start = len(self._sw._prog.ops)
+        self._snapshot = _snapshot_all_tensors()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        sub = _slice_program(self._sw._prog, self._start)
+        _, produced = _slice_reads(sub, exclude=())
+        pairs = _mutation_pairs_full(self._snapshot, produced,
+                                     self._sw._prog.captured)
+        self._sw._cases.append((self._cond, sub, pairs))
+        return False
+
+
+class IfElse:
+    """ref control_flow.py::IfElse.  The reference PARTITIONS rows by the
+    mask, runs each block on its slice, and merges; the TPU-native dense
+    equivalent computes both blocks on the full batch and row-selects with
+    the mask (no dynamic shapes; XLA prunes dead lanes).  Usage:
+
+        ie = IfElse(cond)            # cond: [N, 1] bool
+        with ie.true_block():
+            ie.output(f(x))
+        with ie.false_block():
+            ie.output(g(x))
+        merged, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._true_outs = None
+        self._false_outs = None
+        self._current = None
+
+    class _Block:
+        def __init__(self, ie, branch):
+            self._ie = ie
+            self._branch = branch
+
+        def __enter__(self):
+            self._ie._current = self._branch
+            self._ie._cur_outs = []
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is None:
+                if self._branch == "true":
+                    self._ie._true_outs = self._ie._cur_outs
+                else:
+                    self._ie._false_outs = self._ie._cur_outs
+            self._ie._current = None
+            return False
+
+    def true_block(self):
+        return IfElse._Block(self, "true")
+
+    def false_block(self):
+        return IfElse._Block(self, "false")
+
+    def input(self, x):
+        return x            # dense semantics: blocks see the full batch
+
+    def output(self, *outs):
+        self._cur_outs.extend(outs)
+
+    def __call__(self):
+        if self._true_outs is None or self._false_outs is None:
+            raise ValueError("IfElse needs both true_block and false_block")
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError("IfElse blocks must output the same arity")
+        from ..ops.dispatch import call as _call
+
+        merged = []
+        for t_o, f_o in zip(self._true_outs, self._false_outs):
+            def _merge(c, a, b):
+                c = c.astype(bool).reshape(
+                    (-1,) + (1,) * (a.ndim - 1))
+                return jnp.where(c, a, b)
+            merged.append(_call(_merge, self._cond, t_o, f_o,
+                                _name="ifelse_merge"))
+        return merged
+
+
+class StaticRNN:
+    """ref control_flow.py::StaticRNN — per-timestep block over time-major
+    sequences, lowered to ONE lax.scan composite:
+
+        rnn = StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x)            # x: [T, B, D]
+            prev = rnn.memory(init=h0)
+            h = layers.fc(concat([w, prev]))
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()                          # [T, B, H]
+    """
+
+    def __init__(self, name=None):
+        _require_static("StaticRNN")
+        self._prog = G.default_main_program()
+        self._inputs = []      # (slot_tensor, full_sequence)
+        self._mems = []        # (slot_tensor, init_tensor)
+        self._updates = {}     # id(slot_tensor) -> new tensor
+        self._outputs = []
+        self._in_block = False
+
+    def step(self):
+        return _RNNStep(self)
+
+    def step_input(self, x):
+        assert self._in_block, "step_input must be called inside step()"
+        slot = Tensor(x.value[0])          # build value: t = 0 slice
+        self._inputs.append((slot, x))
+        return slot
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        assert self._in_block, "memory must be called inside step()"
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init= or shape=+batch_ref=")
+            B = batch_ref.shape[ref_batch_dim_idx]
+            init = Tensor(jnp.full((B,) + tuple(shape), init_value,
+                                   jnp.float32))
+        slot = Tensor(init.value)
+        self._mems.append((slot, init))
+        return slot
+
+    def update_memory(self, mem, new):
+        self._updates[id(mem)] = new
+
+    def step_output(self, out):
+        self._outputs.append(out)
+
+    output = step_output
+
+    def __call__(self):
+        outs = self._result
+        return outs if len(outs) > 1 else outs[0]
+
+    def _finalize(self, sub):
+        prog = self._prog
+        in_vids = [G._ensure_var_id(s, sub) for s, _ in self._inputs]
+        mem_vids = [G._ensure_var_id(s, sub) for s, _ in self._mems]
+        upd_vids = []
+        for slot, _ in self._mems:
+            new = self._updates.get(id(slot))
+            if new is None:
+                raise ValueError("every memory needs an update_memory")
+            upd_vids.append(G._ensure_var_id(new, sub))
+        out_vids = [G._ensure_var_id(o, sub) for o in self._outputs]
+
+        ext_all, produced = _slice_reads(
+            sub, exclude=set(in_vids) | set(mem_vids))
+        ext = [e for e in ext_all if e not in in_vids + mem_vids]
+        live, const_env = _split_externals(ext)
+        seq_vids = [G._ensure_var_id(x, prog) for _, x in self._inputs]
+        init_vids = [G._ensure_var_id(i, prog) for _, i in self._mems]
+        n_seq, n_mem = len(seq_vids), len(init_vids)
+
+        def composite(*vals):
+            seqs = vals[:n_seq]
+            inits = vals[n_seq:n_seq + n_mem]
+            ext_vals = vals[n_seq + n_mem:]
+
+            def body(carry, xs_t):
+                env = dict(zip(mem_vids, carry))
+                env.update(dict(zip(in_vids, xs_t)))
+                env.update(dict(zip(live, ext_vals)))
+                env.update(const_env)
+                sub.replay(env)
+                return (tuple(env[u] for u in upd_vids),
+                        tuple(env[o] for o in out_vids))
+
+            _, ys = jax.lax.scan(body, tuple(inits), tuple(seqs))
+            return ys
+
+        in_specs = [("var", v) for v in seq_vids + init_vids + live]
+        results = []
+        for o, x0 in zip(self._outputs,
+                         [self._inputs[0][1]] * len(self._outputs)):
+            T = self._inputs[0][1].shape[0]
+            results.append(Tensor(jnp.broadcast_to(
+                o.value[None], (T,) + tuple(o.shape)).copy()
+                if hasattr(o.value, "shape") else o.value))
+        out_ids = [G._ensure_var_id(r, prog) for r in results]
+        prog.record(composite,
+                    _args_treedef(n_seq + n_mem + len(live)),
+                    in_specs, out_ids, "static_rnn")
+        _mark_live(out_ids)
+        self._result = results
+
+
+class _RNNStep:
+    def __init__(self, rnn):
+        self._rnn = rnn
+
+    def __enter__(self):
+        self._start = len(self._rnn._prog.ops)
+        self._rnn._in_block = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._rnn._in_block = False
+        if exc_type is not None:
+            return False
+        sub = _slice_program(self._rnn._prog, self._start)
+        self._rnn._finalize(sub)
+        return False

@@ -162,7 +162,14 @@ def expand(x, expand_times, name=None):
 
 
 def assign(input, output=None):
-    out = _T.assign(input)
+    """Dispatched identity (ref assign_op).  Must be an OP, not a host
+    copy: the out tensor needs a recorded var id so block-style control
+    flow (control_blocks.While/Switch) can see the mutation when
+    ``output`` rebinds to it."""
+    from ..ops.dispatch import call
+    if not isinstance(input, Tensor):
+        input = Tensor(np.asarray(input))
+    out = call(lambda a: a, input, _name="assign")
     if output is not None:
         output._rebind(out)
         return output
@@ -293,3 +300,8 @@ from ..vision.ops import yolo_loss as yolov3_loss  # noqa: E402,F401
 # learning_rate_scheduler}.py)
 from .layers_ext import *  # noqa: E402,F401,F403
 from .layers_ext import sum, size, rank, pad  # noqa: E402,F401,F811
+
+
+# block-style control flow (ref control_flow.py While/Switch/IfElse/
+# StaticRNN — `with op.block():` spelling over lax composites)
+from .control_blocks import While, Switch, IfElse, StaticRNN  # noqa: E402,F401

@@ -1,0 +1,161 @@
+"""Block-style control flow (fluid While/Switch/IfElse/StaticRNN) over the
+record-replay composites (control_blocks.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+fluid = paddle.fluid
+
+
+class TestWhileBlock:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_accumulation_loop(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = fluid.layers.data("x", [4])
+            i = fluid.layers.fill_constant([1], "int32", 0)
+            acc = fluid.layers.fill_constant([1, 4], "float32", 0.0)
+            lim = fluid.layers.fill_constant([1], "int32", 5)
+            cond = fluid.layers.less_than(i, lim)
+            w = fluid.layers.While(cond)
+            with w.block():
+                fluid.layers.assign(fluid.layers.elementwise_add(acc, x),
+                                    acc)
+                fluid.layers.assign(
+                    fluid.layers.increment(i, 1, in_place=False), i)
+                fluid.layers.assign(fluid.layers.less_than(i, lim), cond)
+            exe = fluid.Executor()
+            xv = np.ones((1, 4), np.float32)
+            av, iv = exe.run(prog, feed={"x": xv}, fetch_list=[acc, i])
+            # runtime-dependent: doubling the feed doubles the result
+            av2, _ = exe.run(prog, feed={"x": xv * 2}, fetch_list=[acc, i])
+        assert (av == 5.0).all() and int(iv.ravel()[0]) == 5
+        assert (av2 == 10.0).all()
+
+    def test_missing_cond_reassign_raises(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            i = fluid.layers.fill_constant([1], "int32", 0)
+            lim = fluid.layers.fill_constant([1], "int32", 5)
+            cond = fluid.layers.less_than(i, lim)
+            w = fluid.layers.While(cond)
+            with pytest.raises(ValueError, match="reassign the cond"):
+                with w.block():
+                    fluid.layers.assign(
+                        fluid.layers.increment(i, 1, in_place=False), i)
+
+
+class TestSwitchBlock:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_lr_schedule_idiom(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            step = fluid.layers.data("step", [1], dtype="int64")
+            lr = fluid.layers.fill_constant([1], "float32", 0.0)
+            b1 = fluid.layers.fill_constant([1], "int64", 100)
+            b2 = fluid.layers.fill_constant([1], "int64", 200)
+            with fluid.layers.Switch() as sw:
+                with sw.case(fluid.layers.less_than(step, b1)):
+                    fluid.layers.assign(fluid.layers.fill_constant(
+                        [1], "float32", 0.1), lr)
+                with sw.case(fluid.layers.less_than(step, b2)):
+                    fluid.layers.assign(fluid.layers.fill_constant(
+                        [1], "float32", 0.05), lr)
+                with sw.default():
+                    fluid.layers.assign(fluid.layers.fill_constant(
+                        [1], "float32", 0.01), lr)
+            exe = fluid.Executor()
+            vals = [exe.run(prog, feed={"step": np.array([s])},
+                            fetch_list=[lr])[0][0]
+                    for s in (50, 150, 500)]
+        np.testing.assert_allclose(vals, [0.1, 0.05, 0.01], atol=1e-7)
+
+
+class TestStaticRNN:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_cumsum_memory_carry(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = fluid.layers.data("x", [6, 3, 4], append_batch_size=False)
+            h0 = fluid.layers.fill_constant([3, 4], "float32", 0.0)
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                w = rnn.step_input(x)
+                prev = rnn.memory(init=h0)
+                h = fluid.layers.elementwise_add(w, prev)
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            out = rnn()
+            exe = fluid.Executor()
+            (ov,) = exe.run(prog, feed={"x": np.ones((6, 3, 4), "float32")},
+                            fetch_list=[out])
+        assert ov.shape == (6, 3, 4)
+        np.testing.assert_allclose(ov[:, 0, 0], np.arange(1, 7))
+
+    def test_rnn_with_fc_trains(self):
+        """Weights used inside the scan get gradients: a tiny RNN
+        regression trained through the composite must reduce loss."""
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = fluid.layers.data("x", [5, 8, 2], append_batch_size=False)
+            y = fluid.layers.data("y", [8, 4], append_batch_size=False)
+            h0 = fluid.layers.fill_constant([8, 4], "float32", 0.0)
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                w = rnn.step_input(x)
+                prev = rnn.memory(init=h0)
+                joint = fluid.layers.concat([w, prev], 1)   # [8, 6]
+                h = fluid.layers.fc(joint, 4, activation="tanh")
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            out = rnn()                                     # [5, 8, 4]
+            last = fluid.layers.slice(out, axes=[0], starts=[4], ends=[5])
+            last = fluid.layers.reshape(last, [8, 4])
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(last, y))
+            opt = fluid.optimizer.AdamOptimizer(5e-3)
+            opt.minimize(loss)
+            exe = fluid.Executor()
+            rng = np.random.RandomState(0)
+            xv = rng.randn(5, 8, 2).astype("float32")
+            yv = np.tanh(xv.sum(0) @ rng.randn(2, 4)).astype("float32")
+            first = cur = None
+            for _ in range(60):
+                (lv,) = exe.run(prog, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])
+                first = first if first is not None else float(lv)
+                cur = float(lv)
+        assert cur < first * 0.5, (first, cur)
+
+
+class TestIfElse:
+    def test_dense_merge_and_grad(self):
+        x = paddle.to_tensor(np.array([[1.], [-2.], [3.]], np.float32))
+        x.stop_gradient = False
+        cond = paddle.to_tensor(np.array([[True], [False], [True]]))
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(ie.input(x) * 10)
+        with ie.false_block():
+            ie.output(ie.input(x) - 100)
+        (merged,) = ie()
+        np.testing.assert_allclose(merged.numpy().ravel(),
+                                   [10., -102., 30.])
+        merged.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy().ravel(), [10., 1., 10.])
