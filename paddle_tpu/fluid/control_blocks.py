@@ -41,17 +41,24 @@ def _require_static(what):
             "use the functional cond/while_loop in dygraph")
 
 
-def _snapshot_all_tensors():
-    """(tensor, slot_or_None, value) for EVERY live Tensor — build-time
-    only (once per block).  gc enumeration is needed because tensors made
-    by creation ops (fill_constant & co) have no var id until first READ,
-    which may happen inside the block being captured."""
+def _tensor_objects():
+    """Every live Tensor — ONE gc heap scan per block construct (build
+    time only).  gc enumeration is needed because tensors made by creation
+    ops (fill_constant & co) have no var id until first READ, which may
+    happen inside the block being captured, so no id-keyed registry can
+    enumerate them."""
     import gc
-    out = []
-    for o in gc.get_objects():
-        if type(o) is Tensor or isinstance(o, Tensor):
-            out.append((o, getattr(o, "_weakref_slot", None), o.value))
-    return out
+    return [o for o in gc.get_objects() if isinstance(o, Tensor)]
+
+
+def _snapshot_from(objs):
+    """(tensor, slot_or_None, value) at this instant for known objects —
+    lets a multi-case Switch reuse one heap scan across cases."""
+    return [(o, getattr(o, "_weakref_slot", None), o.value) for o in objs]
+
+
+def _snapshot_all_tensors():
+    return _snapshot_from(_tensor_objects())
 
 
 def _mutation_pairs_full(snapshot, produced, captured):
@@ -113,8 +120,11 @@ class _WhileBlock:
 
     def __enter__(self):
         self._start = len(self._op._prog.ops)
-        self._snapshot = _snapshot_all_tensors()
+        # cond gets its id BEFORE the snapshot: a fresh cond tensor that is
+        # reassigned but never read inside the body would otherwise be
+        # unrecoverable (no captured entry) and flag as "not reassigned"
         self._cond_vid0 = G._ensure_var_id(self._op._cond, self._op._prog)
+        self._snapshot = _snapshot_all_tensors()
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -180,6 +190,7 @@ class Switch:
         self._entry_vals = {}     # vb -> entry value (first case wins)
 
     def __enter__(self):
+        self._objs = _tensor_objects()     # one heap scan for all cases
         return self
 
     def case(self, condition):
@@ -258,9 +269,11 @@ class Switch:
 
             return chain(0, tuple(init))
 
+        from ..static.control_flow import _in_spec
         entry_vals = dict(zip(col_vb0, col_v0))
         in_specs = _carried_specs(col_vb0, entry_vals, prog)
-        in_specs += [("var", c) for c in cond_vids]
+        in_specs += [_in_spec(c, prog)
+                     for c, _ in cases if c is not None]
         in_specs += [("var", v) for v in live]
         # each tensor's CURRENT id is where later program reads resolve
         out_ids = [getattr(t, "_weakref_slot") for t in cols]
@@ -278,7 +291,7 @@ class _SwitchCase:
 
     def __enter__(self):
         self._start = len(self._sw._prog.ops)
-        self._snapshot = _snapshot_all_tensors()
+        self._snapshot = _snapshot_from(self._sw._objs)
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -430,13 +443,10 @@ class StaticRNN:
             upd_vids.append(G._ensure_var_id(new, sub))
         out_vids = [G._ensure_var_id(o, sub) for o in self._outputs]
 
-        ext_all, produced = _slice_reads(
+        ext, produced = _slice_reads(
             sub, exclude=set(in_vids) | set(mem_vids))
-        ext = [e for e in ext_all if e not in in_vids + mem_vids]
         live, const_env = _split_externals(ext)
-        seq_vids = [G._ensure_var_id(x, prog) for _, x in self._inputs]
-        init_vids = [G._ensure_var_id(i, prog) for _, i in self._mems]
-        n_seq, n_mem = len(seq_vids), len(init_vids)
+        n_seq, n_mem = len(self._inputs), len(self._mems)
 
         def composite(*vals):
             seqs = vals[:n_seq]
@@ -455,14 +465,18 @@ class StaticRNN:
             _, ys = jax.lax.scan(body, tuple(inits), tuple(seqs))
             return ys
 
-        in_specs = [("var", v) for v in seq_vids + init_vids + live]
-        results = []
-        for o, x0 in zip(self._outputs,
-                         [self._inputs[0][1]] * len(self._outputs)):
-            T = self._inputs[0][1].shape[0]
-            results.append(Tensor(jnp.broadcast_to(
-                o.value[None], (T,) + tuple(o.shape)).copy()
-                if hasattr(o.value, "shape") else o.value))
+        # seq/init inputs: live var refs when replay can supply them,
+        # const-baked CURRENT values otherwise (creation-op tensors like a
+        # fill_constant h0 are not in the replay env and must not rely on
+        # the weakref registry surviving — same rule as _in_spec)
+        from ..static.control_flow import _in_spec
+        in_specs = [_in_spec(x, prog) for _, x in self._inputs]
+        in_specs += [_in_spec(i, prog) for _, i in self._mems]
+        in_specs += [("var", v) for v in live]
+        T = self._inputs[0][1].shape[0]
+        results = [Tensor(jnp.broadcast_to(
+            o.value[None], (T,) + tuple(o.shape)).copy())
+            for o in self._outputs]
         out_ids = [G._ensure_var_id(r, prog) for r in results]
         prog.record(composite,
                     _args_treedef(n_seq + n_mem + len(live)),

@@ -159,3 +159,59 @@ class TestIfElse:
                                    [10., -102., 30.])
         merged.sum().backward()
         np.testing.assert_allclose(x.grad.numpy().ravel(), [10., 1., 10.])
+
+
+class TestRegressionsFromReview:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_staticrnn_survives_gc_of_build_locals(self):
+        """init tensors made by creation ops must be const-baked, not
+        resolved through the weakref registry at run time."""
+        import gc
+
+        def build():
+            prog, start = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, start):
+                x = fluid.layers.data("x", [3, 2, 4],
+                                      append_batch_size=False)
+                h0 = fluid.layers.fill_constant([2, 4], "float32", 1.0)
+                rnn = fluid.layers.StaticRNN()
+                with rnn.step():
+                    w = rnn.step_input(x)
+                    prev = rnn.memory(init=h0)
+                    h = fluid.layers.elementwise_add(w, prev)
+                    rnn.update_memory(prev, h)
+                    rnn.step_output(h)
+                out = rnn()
+            return prog, out
+
+        prog, out = build()
+        gc.collect()
+        with fluid.program_guard(prog):
+            exe = fluid.Executor()
+            (ov,) = exe.run(prog, feed={"x": np.ones((3, 2, 4), "float32")},
+                            fetch_list=[out])
+        np.testing.assert_allclose(ov[:, 0, 0], [2.0, 3.0, 4.0])
+
+    def test_while_cond_never_read_in_body(self):
+        """A cond reassigned but never READ inside the body must still be
+        detected as loop-carried."""
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            i = fluid.layers.fill_constant([1], "int32", 0)
+            lim = fluid.layers.fill_constant([1], "int32", 3)
+            cond = fluid.layers.fill_constant([1], "bool", True)
+            w = fluid.layers.While(cond)
+            with w.block():
+                fluid.layers.assign(
+                    fluid.layers.increment(i, 1, in_place=False), i)
+                fluid.layers.assign(fluid.layers.less_than(i, lim), cond)
+            exe = fluid.Executor()
+            # no feeds: give the executor a dummy fetch-only run
+            x = fluid.layers.assign(i)
+            (iv,) = exe.run(prog, feed={}, fetch_list=[x])
+        assert int(np.ravel(iv)[0]) == 3
