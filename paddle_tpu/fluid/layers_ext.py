@@ -974,3 +974,7 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
     mk = lambda v, dt=np.float32: Tensor(np.asarray(v, dt))
     return (mk(p), mk(r), mk(f1), mk(n_inf, np.int64),
             mk(n_lab, np.int64), mk(n_cor, np.int64))
+
+
+from ..vision.detection import (generate_proposals,  # noqa: E402,F401
+                                rpn_target_assign, locality_aware_nms)

@@ -631,3 +631,167 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         vars_all.append(reshape(var, [-1, 4]))
     return (concat(locs, 1), concat(confs, 1),
             concat(boxes_all, 0), concat(vars_all, 0))
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (ref detection.py::generate_proposals over
+    generate_proposals_op): decode anchor deltas, clip to the image, drop
+    boxes below min_size, NMS, keep post_nms_top_n.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; anchors/variances
+    [H, W, A, 4]; im_info [N, 3].  Fixed-shape output (TPU contract):
+    (rois [N, post_nms_top_n, 4], roi_probs [N, post_nms_top_n, 1]) with
+    zero rows past each image's proposal count; with return_rois_num also
+    [N] counts.  The reference emits the same data as a ragged LoD pair."""
+    def _gp(sc, bd, info, an, var):
+        N, A, H, W = sc.shape
+        M = A * H * W
+        an = an.reshape(-1, 4).astype(jnp.float32)          # [M', 4]
+        var_f = var.reshape(-1, 4).astype(jnp.float32)
+        # [N, 4A, H, W] -> [N, H, W, A, 4] -> [N, M, 4]
+        bd_r = bd.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2) \
+            .reshape(N, -1, 4).astype(jnp.float32)
+        sc_r = sc.transpose(0, 2, 3, 1).reshape(N, -1)      # [N, M]
+
+        aw = an[:, 2] - an[:, 0] + 1.0
+        ah = an[:, 3] - an[:, 1] + 1.0
+        acx = an[:, 0] + aw * 0.5
+        acy = an[:, 1] + ah * 0.5
+
+        def per_image(deltas, s, inf):
+            d = deltas * var_f
+            cx = acx + d[:, 0] * aw
+            cy = acy + d[:, 1] * ah
+            w = aw * jnp.exp(jnp.minimum(d[:, 2], 10.0))
+            h = ah * jnp.exp(jnp.minimum(d[:, 3], 10.0))
+            x1 = cx - w * 0.5
+            y1 = cy - h * 0.5
+            x2 = cx + w * 0.5 - 1.0
+            y2 = cy + h * 0.5 - 1.0
+            imh, imw = inf[0], inf[1]
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+            keep = ((x2 - x1 + 1 >= min_size * inf[2])
+                    & (y2 - y1 + 1 >= min_size * inf[2]))
+            s_m = jnp.where(keep, s, -1e9)
+            K = min(pre_nms_top_n, s_m.shape[0])
+            top = jnp.argsort(-s_m)[:K]
+            boxes = jnp.stack([x1, y1, x2, y2], -1)[top]
+            st = s_m[top]
+            iou = _pairwise_iou(boxes, boxes)
+            nkeep = _nms_single_class(st, iou, nms_thresh, K)
+            s_f = jnp.where(nkeep & (st > -1e8), st, -1e9)
+            P = post_nms_top_n
+            sel = jnp.argsort(-s_f)[:P]
+            valid = s_f[sel] > -1e8
+            out_b = jnp.where(valid[:, None], boxes[sel], 0.0)
+            out_s = jnp.where(valid, s_f[sel], 0.0)[:, None]
+            return out_b, out_s, jnp.sum(valid.astype(jnp.int32))
+        rois, probs, num = jax.vmap(per_image)(
+            bd_r, sc_r, info.astype(jnp.float32))
+        return rois, probs, num
+    out = call(_gp, scores, bbox_deltas, im_info, anchors, variances,
+               _name="generate_proposals", _nondiff=(0, 1, 2, 3, 4))
+    rois, probs, num = out
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False,
+                      name=None):
+    """RPN training targets (ref detection.py::rpn_target_assign).
+
+    DENSE form (TPU contract): instead of the reference's gathered index
+    lists, returns per-anchor tensors — (labels [N, M] {1 fg, 0 bg, -1
+    ignore}, bbox_targets [N, M, 4], fg_mask [N, M], bg_mask [N, M]).
+    Assignment rule matches the reference: anchors with IoU >=
+    positive_overlap (plus each gt's best anchor) are fg; IoU <
+    negative_overlap are bg; the rest ignored.  Subsampling to
+    rpn_batch_size_per_im uses score-free deterministic truncation (the
+    masked-top-k analogue of the reference's random draw)."""
+    def _rta(ab, gb):
+        M = ab.shape[0]
+        ab_f = ab.reshape(-1, 4).astype(jnp.float32)
+
+        def per_image(gt):
+            valid_g = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+            iou = _pairwise_iou(gt, ab_f)                   # [G, M]
+            iou = jnp.where(valid_g[:, None], iou, -1.0)
+            best_iou = jnp.max(iou, axis=0)
+            best_g = jnp.argmax(iou, axis=0)
+            fg = best_iou >= rpn_positive_overlap
+            # each valid gt's best anchor is fg (reference force match)
+            G = gt.shape[0]
+            best_a = jnp.argmax(iou, axis=1)
+            lattice = jnp.full((G, M), -jnp.inf).at[
+                jnp.arange(G), best_a].set(
+                jnp.where(valid_g, iou[jnp.arange(G), best_a], -jnp.inf))
+            fg = fg | (jnp.max(lattice, axis=0) > -jnp.inf)
+            bg = (best_iou < rpn_negative_overlap) & ~fg
+
+            # cap fg at fraction*batch, bg at batch-n_fg (deterministic)
+            max_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+            fg_rank = jnp.cumsum(fg.astype(jnp.int32)) - 1
+            fg = fg & (fg_rank < max_fg)
+            n_fg = jnp.sum(fg.astype(jnp.int32))
+            bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+            bg = bg & (bg_rank < rpn_batch_size_per_im - n_fg)
+
+            labels = jnp.where(fg, 1, jnp.where(bg, 0, -1))
+            # encode targets against matched gts
+            tgt = gt[best_g]
+            aw = ab_f[:, 2] - ab_f[:, 0] + 1.0
+            ah = ab_f[:, 3] - ab_f[:, 1] + 1.0
+            acx = ab_f[:, 0] + aw * 0.5
+            acy = ab_f[:, 1] + ah * 0.5
+            tw = tgt[:, 2] - tgt[:, 0] + 1.0
+            th = tgt[:, 3] - tgt[:, 1] + 1.0
+            tcx = tgt[:, 0] + tw * 0.5
+            tcy = tgt[:, 1] + th * 0.5
+            enc = jnp.stack([(tcx - acx) / aw, (tcy - acy) / ah,
+                             jnp.log(jnp.maximum(tw / aw, 1e-10)),
+                             jnp.log(jnp.maximum(th / ah, 1e-10))], -1)
+            enc = jnp.where(fg[:, None], enc, 0.0)
+            return labels, enc, fg, bg
+        gb_f = gb.astype(jnp.float32)
+        if gb_f.ndim == 2:
+            gb_f = gb_f[None]
+        return jax.vmap(per_image)(gb_f)
+    return call(_rta, anchor_box, gt_boxes, _name="rpn_target_assign",
+                _nondiff=(0, 1))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """ref locality_aware_nms_op (EAST text detection): consecutive
+    same-class boxes that overlap merge by score-weighted average BEFORE
+    standard multiclass NMS."""
+    def _merge(bb, sc):
+        def per_image(boxes, s):
+            # weighted merge: each box absorbs its overlapping neighbours,
+            # weighted by their best class score (one matrix pass — the
+            # locality-aware step; EAST is effectively single-class)
+            w = jnp.max(s, axis=0)                          # [N]
+            iou = _pairwise_iou(boxes, boxes)
+            wmat = jnp.where(iou > nms_threshold, w[None, :], 0.0)
+            wsum = jnp.sum(wmat, -1, keepdims=True)
+            return (wmat @ boxes) / jnp.maximum(wsum, 1e-10)
+        return jax.vmap(per_image)(bb.astype(jnp.float32),
+                                   sc.astype(jnp.float32))
+    merged = call(_merge, bboxes, scores, _name="lanms_merge",
+                  _nondiff=(0, 1))
+    return multiclass_nms(merged, scores, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)

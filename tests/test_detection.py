@@ -253,3 +253,60 @@ class TestSSD:
         assert fl.prior_box is D.prior_box
         assert fl.multiclass_nms is D.multiclass_nms
         assert fl.yolov3_loss is paddle.vision.ops.yolo_loss
+
+
+class TestRPN:
+    def test_generate_proposals_shapes_and_validity(self):
+        rng = np.random.RandomState(0)
+        N, A, H, W = 1, 3, 4, 4
+        anchors, var = D.anchor_generator(
+            paddle.to_tensor(np.zeros((N, 8, H, W), np.float32)),
+            anchor_sizes=[32., 64., 128.], aspect_ratios=[1.0],
+            stride=[16., 16.])
+        scores = paddle.to_tensor(rng.randn(N, A, H, W).astype("float32"))
+        deltas = paddle.to_tensor(
+            (rng.randn(N, 4 * A, H, W) * 0.1).astype("float32"))
+        im_info = paddle.to_tensor(np.array([[64., 64., 1.]], np.float32))
+        rois, probs, num = D.generate_proposals(
+            scores, deltas, im_info, anchors, var, pre_nms_top_n=30,
+            post_nms_top_n=10, nms_thresh=0.7, min_size=1.0,
+            return_rois_num=True)
+        r, p, n = rois.numpy(), probs.numpy(), num.numpy()
+        assert r.shape == (1, 10, 4) and p.shape == (1, 10, 1)
+        k = int(n[0])
+        assert 0 < k <= 10
+        # valid rois are inside the image
+        assert (r[0, :k, 0] >= 0).all() and (r[0, :k, 2] <= 63).all()
+        assert (r[0, k:] == 0).all()
+
+    def test_rpn_target_assign_dense(self):
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                            [100, 100, 110, 110]], np.float32)
+        gts = np.array([[[0, 0, 10, 10]]], np.float32)
+        labels, enc, fg, bg = D.rpn_target_assign(
+            None, None, paddle.to_tensor(anchors), None,
+            paddle.to_tensor(gts), rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3)
+        l = labels.numpy()[0]
+        assert l[0] == 1          # exact-match anchor is fg
+        assert l[1] == 0 and l[2] == 0
+        e = enc.numpy()[0]
+        np.testing.assert_allclose(e[0], 0.0, atol=1e-5)  # perfect match
+        assert (e[1] == 0).all()  # bg targets zeroed
+
+    def test_locality_aware_nms_merges(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.9, 0.8]
+        out = D.locality_aware_nms(paddle.to_tensor(boxes),
+                                   paddle.to_tensor(scores),
+                                   score_threshold=0.1, nms_top_k=10,
+                                   keep_top_k=5, nms_threshold=0.5,
+                                   background_label=0).numpy()
+        valid = out[0][out[0, :, 0] >= 0]
+        assert valid.shape[0] == 2
+        # the two overlapping boxes merged toward their average
+        merged_box = valid[np.argmax(valid[:, 1])][2:]
+        np.testing.assert_allclose(merged_box, [0.5, 0.5, 10.5, 10.5],
+                                   atol=1e-4)
