@@ -501,3 +501,109 @@ class _RNNStep:
         sub = _slice_program(self._rnn._prog, self._start)
         self._rnn._finalize(sub)
         return False
+
+
+class DynamicRNN(StaticRNN):
+    """ref control_flow.py::DynamicRNN — variable-length recurrence.  The
+    reference walks a LoD layout with a shrinking sorted batch; the
+    padded+masked TPU form takes BATCH-MAJOR ``x [B, T, D]`` plus
+    ``lengths [B]`` and masks carries/outputs past each row's length
+    (dead lanes compute and are discarded — the XLA-friendly trade):
+
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            w = rnn.step_input(x, lengths)
+            prev = rnn.memory(init=h0)
+            h = ...
+            rnn.update_memory(prev, h)
+            rnn.output(h)
+        out = rnn()          # [B, T, H], zeros past lengths
+    """
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._lengths = None
+
+    def block(self):
+        return self.step()
+
+    def step_input(self, x, lengths=None, level=0):
+        assert self._in_block, "step_input must be called inside block()"
+        if lengths is not None:
+            self._lengths = lengths
+        slot = Tensor(x.value[:, 0])       # [B, D] slice at t = 0
+        self._inputs.append((slot, x))
+        return slot
+
+    @staticmethod
+    def _time_major(x):
+        return jnp.swapaxes(x, 0, 1)
+
+    def _finalize(self, sub):
+        prog = self._prog
+        in_vids = [G._ensure_var_id(s, sub) for s, _ in self._inputs]
+        mem_vids = [G._ensure_var_id(s, sub) for s, _ in self._mems]
+        upd_vids = []
+        for slot, _ in self._mems:
+            new = self._updates.get(id(slot))
+            if new is None:
+                raise ValueError("every memory needs an update_memory")
+            upd_vids.append(G._ensure_var_id(new, sub))
+        out_vids = [G._ensure_var_id(o, sub) for o in self._outputs]
+
+        ext, _ = _slice_reads(sub, exclude=set(in_vids) | set(mem_vids))
+        live, const_env = _split_externals(ext)
+        n_seq, n_mem = len(self._inputs), len(self._mems)
+        T = self._inputs[0][1].shape[1]
+        has_len = self._lengths is not None
+
+        def composite(*vals):
+            seqs = vals[:n_seq]
+            inits = vals[n_seq:n_seq + n_mem]
+            k = n_seq + n_mem
+            lens = vals[k] if has_len else None
+            ext_vals = vals[k + (1 if has_len else 0):]
+            seqs_tm = tuple(jnp.swapaxes(s, 0, 1) for s in seqs)
+
+            def body(carry, xs):
+                t, xs_t = xs
+                env = dict(zip(mem_vids, carry))
+                env.update(dict(zip(in_vids, xs_t)))
+                env.update(dict(zip(live, ext_vals)))
+                env.update(const_env)
+                sub.replay(env)
+                if lens is not None:
+                    alive = (t < lens.reshape(-1).astype(jnp.int32))
+                    new_carry = tuple(
+                        jnp.where(alive.reshape((-1,) + (1,) * (c.ndim - 1)),
+                                  env[u], c)
+                        for u, c in zip(upd_vids, carry))
+                    outs = tuple(
+                        jnp.where(alive.reshape(
+                            (-1,) + (1,) * (env[o].ndim - 1)),
+                            env[o], 0.0) for o in out_vids)
+                else:
+                    new_carry = tuple(env[u] for u in upd_vids)
+                    outs = tuple(env[o] for o in out_vids)
+                return new_carry, outs
+
+            _, ys = jax.lax.scan(body, tuple(inits),
+                                 (jnp.arange(T), seqs_tm))
+            return tuple(jnp.swapaxes(y, 0, 1) for y in ys)  # batch-major
+
+        from ..static.control_flow import _in_spec
+        in_specs = [_in_spec(x, prog) for _, x in self._inputs]
+        in_specs += [_in_spec(i, prog) for _, i in self._mems]
+        if has_len:
+            in_specs.append(_in_spec(self._lengths, prog))
+        in_specs += [("var", v) for v in live]
+        results = [Tensor(jnp.broadcast_to(
+            o.value[:, None], (o.shape[0], T) + tuple(o.shape[1:])).copy())
+            for o in self._outputs]
+        out_ids = [G._ensure_var_id(r, prog) for r in results]
+        prog.record(composite,
+                    _args_treedef(n_seq + n_mem + (1 if has_len else 0)
+                                  + len(live)),
+                    in_specs, out_ids, "dynamic_rnn")
+        _mark_live(out_ids)
+        self._result = results

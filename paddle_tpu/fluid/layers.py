@@ -304,4 +304,5 @@ from .layers_ext import sum, size, rank, pad  # noqa: E402,F401,F811
 
 # block-style control flow (ref control_flow.py While/Switch/IfElse/
 # StaticRNN — `with op.block():` spelling over lax composites)
-from .control_blocks import While, Switch, IfElse, StaticRNN  # noqa: E402,F401
+from .control_blocks import (While, Switch, IfElse, StaticRNN,  # noqa: E402,F401
+                             DynamicRNN)

@@ -215,3 +215,34 @@ class TestRegressionsFromReview:
             x = fluid.layers.assign(i)
             (iv,) = exe.run(prog, feed={}, fetch_list=[x])
         assert int(np.ravel(iv)[0]) == 3
+
+
+class TestDynamicRNN:
+    def setup_method(self, m):
+        paddle.enable_static()
+
+    def teardown_method(self, m):
+        paddle.disable_static()
+
+    def test_masked_variable_length_recurrence(self):
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = fluid.layers.data("x", [3, 5, 2], append_batch_size=False)
+            lens = fluid.layers.data("lens", [3], dtype="int64",
+                                     append_batch_size=False)
+            h0 = fluid.layers.fill_constant([3, 2], "float32", 0.0)
+            rnn = fluid.layers.DynamicRNN()
+            with rnn.block():
+                w = rnn.step_input(x, lens)
+                prev = rnn.memory(init=h0)
+                h = fluid.layers.elementwise_add(w, prev)
+                rnn.update_memory(prev, h)
+                rnn.output(h)
+            out = rnn()
+            exe = fluid.Executor()
+            (ov,) = exe.run(prog, feed={"x": np.ones((3, 5, 2), "float32"),
+                                        "lens": np.array([5, 3, 1])},
+                            fetch_list=[out])
+        np.testing.assert_allclose(ov[0, :, 0], [1, 2, 3, 4, 5])
+        np.testing.assert_allclose(ov[1, :, 0], [1, 2, 3, 0, 0])
+        np.testing.assert_allclose(ov[2, :, 0], [1, 0, 0, 0, 0])
