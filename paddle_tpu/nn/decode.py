@@ -58,6 +58,7 @@ class BeamSearchDecoder(Decoder):
         sample = states[0] if isinstance(states, (tuple, list)) else states
         batch = sample.shape[0]
         self.batch_size = batch
+        self._parents = []      # per-step beam ancestry for gather_tree
         start = creation.full([batch, self.beam_size], self.start_token,
                               "int64")
         log_probs = creation.full([batch, self.beam_size], -1e9, "float32")
@@ -112,12 +113,22 @@ class BeamSearchDecoder(Decoder):
             next_cell_states = gather_state(next_cell_states)
 
         outputs = Tensor(token_idx.astype(jnp.int32))
+        self._parents.append(Tensor(beam_idx.astype(jnp.int32)))
         next_states = (next_cell_states, Tensor(top_lp),
                        Tensor(new_finished))
         return outputs, next_states, outputs, Tensor(new_finished)
 
     def finalize(self, outputs, final_states, sequence_lengths):
-        return outputs, final_states
+        """outputs arrive TIME-MAJOR [T, B, beam]; beam slots at each step
+        are post-prune and their ancestry hops beams, so the full paths
+        are reconstructed with gather_tree over the recorded parent
+        pointers (ref fluid gather_tree_op — the reference decoder does
+        the same backtrace)."""
+        if not self._parents:
+            return outputs, final_states
+        from .functional.extension import gather_tree
+        parents = manip.stack(self._parents, axis=0)      # [T, B, beam]
+        return gather_tree(outputs, parents), final_states
 
     @property
     def tracks_own_finished(self):
@@ -137,8 +148,13 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
         inputs = next_inputs
         if bool(np.all(finished.numpy())):
             break
-    outputs = manip.stack(outputs_list, axis=0 if output_time_major else 1)
+    # finalize always sees TIME-MAJOR [T, B, ...] (reference contract);
+    # the requested orientation is applied after
+    outputs = manip.stack(outputs_list, axis=0)
     outputs, final_states = decoder.finalize(outputs, states, seq_len)
+    if not output_time_major:
+        perm = [1, 0] + list(range(2, len(outputs.shape)))
+        outputs = manip.transpose(outputs, perm)
     if return_length:
         lengths = Tensor(np.full(outputs.shape[0], len(outputs_list)))
         return outputs, final_states, lengths
