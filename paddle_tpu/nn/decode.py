@@ -152,10 +152,11 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
     # the requested orientation is applied after
     outputs = manip.stack(outputs_list, axis=0)
     outputs, final_states = decoder.finalize(outputs, states, seq_len)
+    batch = outputs.shape[1]        # time-major here: [T, B, ...]
     if not output_time_major:
         perm = [1, 0] + list(range(2, len(outputs.shape)))
         outputs = manip.transpose(outputs, perm)
     if return_length:
-        lengths = Tensor(np.full(outputs.shape[0], len(outputs_list)))
+        lengths = Tensor(np.full(batch, len(outputs_list)))
         return outputs, final_states, lengths
     return outputs, final_states

@@ -719,25 +719,36 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     negative_overlap are bg; the rest ignored.  Subsampling to
     rpn_batch_size_per_im uses score-free deterministic truncation (the
     masked-top-k analogue of the reference's random draw)."""
-    def _rta(ab, gb):
+    def _rta(ab, gb, *rest):
+        info = rest[0].astype(jnp.float32) if rest else None
         M = ab.shape[0]
         ab_f = ab.reshape(-1, 4).astype(jnp.float32)
 
-        def per_image(gt):
+        def per_image(gt, inf):
             valid_g = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+            # straddle filter: anchors outside the image (beyond the
+            # threshold) take no part in training (label -1, reference
+            # rpn_straddle_thresh semantics); inf None disables it
+            if inf is None:
+                inside = jnp.ones((ab_f.shape[0],), bool)
+            else:
+                th = rpn_straddle_thresh
+                inside = ((ab_f[:, 0] >= -th) & (ab_f[:, 1] >= -th)
+                          & (ab_f[:, 2] < inf[1] + th)
+                          & (ab_f[:, 3] < inf[0] + th))
             iou = _pairwise_iou(gt, ab_f)                   # [G, M]
-            iou = jnp.where(valid_g[:, None], iou, -1.0)
+            iou = jnp.where(valid_g[:, None] & inside[None, :], iou, -1.0)
             best_iou = jnp.max(iou, axis=0)
             best_g = jnp.argmax(iou, axis=0)
-            fg = best_iou >= rpn_positive_overlap
+            fg = (best_iou >= rpn_positive_overlap) & inside
             # each valid gt's best anchor is fg (reference force match)
             G = gt.shape[0]
             best_a = jnp.argmax(iou, axis=1)
             lattice = jnp.full((G, M), -jnp.inf).at[
                 jnp.arange(G), best_a].set(
                 jnp.where(valid_g, iou[jnp.arange(G), best_a], -jnp.inf))
-            fg = fg | (jnp.max(lattice, axis=0) > -jnp.inf)
-            bg = (best_iou < rpn_negative_overlap) & ~fg
+            fg = fg | ((jnp.max(lattice, axis=0) > -jnp.inf) & inside)
+            bg = (best_iou < rpn_negative_overlap) & ~fg & inside
 
             # cap fg at fraction*batch, bg at batch-n_fg (deterministic)
             max_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
@@ -766,9 +777,13 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         gb_f = gb.astype(jnp.float32)
         if gb_f.ndim == 2:
             gb_f = gb_f[None]
-        return jax.vmap(per_image)(gb_f)
-    return call(_rta, anchor_box, gt_boxes, _name="rpn_target_assign",
-                _nondiff=(0, 1))
+        if info is None:
+            return jax.vmap(lambda g: per_image(g, None))(gb_f)
+        return jax.vmap(per_image)(gb_f, info)
+    args = [anchor_box, gt_boxes] + ([im_info] if im_info is not None
+                                     else [])
+    return call(_rta, *args, _name="rpn_target_assign",
+                _nondiff=tuple(range(len(args))))
 
 
 def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
@@ -780,8 +795,11 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
     def _merge(bb, sc):
         def per_image(boxes, s):
             # weighted merge: each box absorbs its overlapping neighbours,
-            # weighted by their best class score (one matrix pass — the
-            # locality-aware step; EAST is effectively single-class)
+            # weighted by their best FOREGROUND score (background
+            # confidence must not drag detection geometry; EAST is
+            # effectively single-class)
+            if 0 <= background_label < s.shape[0]:
+                s = s.at[background_label].set(0.0)
             w = jnp.max(s, axis=0)                          # [N]
             iou = _pairwise_iou(boxes, boxes)
             wmat = jnp.where(iou > nms_threshold, w[None, :], 0.0)

@@ -310,3 +310,29 @@ class TestRPN:
         merged_box = valid[np.argmax(valid[:, 1])][2:]
         np.testing.assert_allclose(merged_box, [0.5, 0.5, 10.5, 10.5],
                                    atol=1e-4)
+
+
+class TestRPNReviewFixes:
+    def test_straddle_filter_excludes_outside_anchors(self):
+        anchors = np.array([[0, 0, 10, 10],        # inside
+                            [60, 60, 80, 80]], np.float32)  # outside 64x64
+        gts = np.array([[[0, 0, 10, 10]]], np.float32)
+        im_info = np.array([[64., 64., 1.]], np.float32)
+        labels, enc, fg, bg = D.rpn_target_assign(
+            None, None, paddle.to_tensor(anchors), None,
+            paddle.to_tensor(gts), im_info=paddle.to_tensor(im_info),
+            rpn_straddle_thresh=0.0)
+        l = labels.numpy()[0]
+        assert l[0] == 1           # matched inside anchor
+        assert l[1] == -1          # straddling anchor excluded entirely
+
+    def test_dynamic_decode_return_length_batch_sized(self):
+        import paddle_tpu.nn as nn
+        from tests.test_beam_search import RiggedCell, END
+        dec = nn.BeamSearchDecoder(RiggedCell(), start_token=0,
+                                   end_token=END, beam_size=2)
+        h0 = paddle.to_tensor(np.zeros((5, 1), np.float32))
+        out, _, lens = nn.dynamic_decode(dec, inits=h0, max_step_num=3,
+                                         output_time_major=True,
+                                         return_length=True)
+        assert lens.shape[0] == 5          # batch-sized, not time-sized
