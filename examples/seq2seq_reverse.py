@@ -1,0 +1,99 @@
+"""Seq2seq with beam-search decoding: learn to REVERSE token sequences.
+
+The fluid-era NMT recipe (ref: the reference's machine-translation line —
+RNN encoder/decoder + beam search) on the TPU-native stack: nn.GRU
+encoder, GRUCell decoder trained with teacher forcing, and
+BeamSearchDecoder + dynamic_decode (gather_tree ancestry) for inference.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/seq2seq_reverse.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+V = 12          # 0 pad/start, 1..9 payload, 10 start, 11 end
+START, END = 10, 11
+T = 5
+H = 64
+
+
+def make_batch(rng, n):
+    src = rng.randint(1, 10, (n, T))
+    tgt = src[:, ::-1].copy()
+    dec_in = np.concatenate([np.full((n, 1), START), tgt[:, :-1]], 1)
+    return src, dec_in, tgt
+
+
+class Seq2Seq(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.src_emb = nn.Embedding(V, H)
+        self.tgt_emb = nn.Embedding(V, H)
+        self.encoder = nn.GRU(H, H)
+        self.cell = nn.GRUCell(H, H)
+        self.proj = nn.Linear(H, V)
+
+    def encode(self, src):
+        _, h = self.encoder(self.src_emb(src))
+        return h[0]                                  # [B, H]
+
+    def forward(self, src, dec_in):
+        h = self.encode(src)
+        emb = self.tgt_emb(dec_in)                   # [B, T, H]
+        outs = []
+        state = h
+        for t in range(T):
+            o, state = self.cell(emb[:, t], state)
+            outs.append(self.proj(o))
+        return paddle.stack(outs, axis=1)            # [B, T, V]
+
+
+def main():
+    rng = np.random.RandomState(0)
+    model = Seq2Seq()
+    opt = paddle.optimizer.Adam(2e-3, parameters=model.parameters())
+    lossf = nn.CrossEntropyLoss()
+
+    first = last = None
+    for step in range(300):
+        src, dec_in, tgt = make_batch(rng, 64)
+        logits = model(paddle.to_tensor(src), paddle.to_tensor(dec_in))
+        loss = lossf(paddle.reshape(logits, [-1, V]),
+                     paddle.to_tensor(tgt.reshape(-1)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+        if step % 100 == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+    assert last < 0.2, (first, last)
+
+    # beam-search inference through the SAME cell + projection
+    class DecCell(nn.Layer):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, tok_emb, state):
+            o, s = self.m.cell(tok_emb, state)
+            return self.m.proj(o), s
+
+    src, _, tgt = make_batch(rng, 4)
+    h = model.encode(paddle.to_tensor(src))
+    dec = nn.BeamSearchDecoder(DecCell(model), start_token=START,
+                               end_token=END, beam_size=3,
+                               embedding_fn=model.tgt_emb)
+    out, _ = nn.dynamic_decode(dec, inits=h, max_step_num=T)
+    best = out.numpy()[:, :, 0]                      # [B, T] best beam
+    acc = (best[:, :T] == tgt).mean()
+    print("greedy-beam decode accuracy:", acc)
+    print("sample src:", src[0], "-> decoded:", best[0], "(want",
+          tgt[0], ")")
+    assert acc > 0.9, acc
+    print("seq2seq + beam search: OK")
+
+
+if __name__ == "__main__":
+    main()
