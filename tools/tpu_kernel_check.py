@@ -84,6 +84,38 @@ def check_flash_attention(results):
                          "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
 
 
+def check_flash_bench_shape(results):
+    """Flash attention at the FLAGSHIP bench shape (bench.py: 1.3B config,
+    [4, 2048, 16, 128] bf16 causal) with a block-size sweep — decides
+    whether bench.py should flip use_flash on (r3 sweep: XLA fused
+    attention won at this shape; re-measure after kernel changes)."""
+    from paddle_tpu.ops.pallas import flash_attn as fa
+    if jax.devices()[0].platform == "cpu":
+        return
+    B, N, H, D = 4, 2048, 16, 128
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, N, H, D) * 0.1, jnp.bfloat16)
+
+    ref_fn = jax.jit(lambda q: fa._ref_attention(q, q, q, True))
+    tr, _ = timeit(ref_fn, q, iters=10)
+    entry = {"xla_ms": tr * 1e3, "blocks": {}}
+    best = None
+    for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 1024)):
+        try:
+            p_fn = jax.jit(lambda q, bq=bq, bk=bk: fa._flash_attention_tpu(
+                q, q, q, True, block_q=bq, block_k=bk))
+            tp, _ = timeit(p_fn, q, iters=10)
+            entry["blocks"][f"{bq}x{bk}"] = tp * 1e3
+            if best is None or tp * 1e3 < best:
+                best = tp * 1e3
+        except Exception as e:                      # noqa: BLE001
+            entry["blocks"][f"{bq}x{bk}"] = f"{type(e).__name__}: {e}"
+    entry["best_pallas_ms"] = best
+    entry["pallas_beats_xla"] = bool(best is not None
+                                     and best < tr * 1e3)
+    results["flash_attn_bench_shape"] = entry
+
+
 def check_fused_ffn(results):
     from paddle_tpu.ops.pallas import fused_ffn as ff
     M, Hd, F = 2048, 1024, 4096
@@ -146,7 +178,8 @@ def main():
               file=sys.stderr)
 
     results = {"device": str(dev.device_kind)}
-    for check in (check_flash_attention, check_fused_ffn, check_norms):
+    for check in (check_flash_attention, check_flash_bench_shape,
+                  check_fused_ffn, check_norms):
         try:
             check(results)
         except Exception as e:                      # noqa: BLE001
