@@ -186,8 +186,11 @@ def main():
             results[check.__name__] = {"ok": False,
                                        "error": f"{type(e).__name__}: {e}"}
 
+    # CPU runs only exercise fallbacks — never clobber the committed
+    # on-chip results
+    suffix = ".json" if dev.platform != "cpu" else "_cpu.json"
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "tpu_kernel_check.json")
+                            "tpu_kernel_check" + suffix)
     with open(out_path, "w") as f:
         json.dump(results, f, indent=2, default=str)
     ok = all(v.get("ok", True) for v in results.values()
