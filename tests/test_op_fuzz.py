@@ -189,3 +189,78 @@ class TestApiSurfaceGuard:
             for part in ns.split("."):
                 obj = getattr(obj, part, None)
                 assert obj is not None, f"paddle.{ns} missing"
+
+
+class TestNumericGradients:
+    """Finite-difference cross-checks for the round-3 differentiable ops."""
+
+    def _num_grad(self, f, x, eps=1e-3):
+        g = np.zeros_like(x)
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            i = it.multi_index
+            xp = x.copy(); xp[i] += eps
+            xm = x.copy(); xm[i] -= eps
+            g[i] = (f(xp) - f(xm)) / (2 * eps)
+            it.iternext()
+        return g
+
+    def test_grid_sample_numeric_grad(self):
+        import paddle_tpu.nn.functional as F
+        rng = R(0)
+        x0 = rng.randn(1, 1, 4, 4).astype("float32")
+        g0 = (rng.rand(1, 2, 2, 2) * 1.2 - 0.6).astype("float32")
+
+        def f(xv):
+            return float(F.grid_sample(t(xv), t(g0)).sum())
+
+        xt = t(x0); xt.stop_gradient = False
+        out = F.grid_sample(xt, t(g0))
+        out.sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(),
+                                   self._num_grad(f, x0), atol=2e-2)
+
+    def test_deform_conv_numeric_grad_offset(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng = R(1)
+        x0 = rng.randn(1, 1, 5, 5).astype("float32")
+        w0 = rng.randn(1, 1, 3, 3).astype("float32") * 0.3
+        off0 = (rng.randn(1, 18, 3, 3) * 0.3).astype("float32")
+
+        def f(ov):
+            return float(deform_conv2d(t(x0), t(ov), t(w0)).sum())
+
+        ot = t(off0); ot.stop_gradient = False
+        out = deform_conv2d(t(x0), ot, t(w0))
+        out.sum().backward()
+        np.testing.assert_allclose(ot.grad.numpy(),
+                                   self._num_grad(f, off0), atol=3e-2)
+
+    def test_hsigmoid_numeric_grad(self):
+        import paddle_tpu.nn.functional as F
+        rng = R(2)
+        x0 = rng.randn(3, 4).astype("float32")
+        w0 = rng.randn(5, 4).astype("float32") * 0.2
+        lbl = np.array([0, 2, 5])
+
+        def f(xv):
+            return float(F.hsigmoid_loss(t(xv), t(lbl), 6, t(w0)).sum())
+
+        xt = t(x0); xt.stop_gradient = False
+        F.hsigmoid_loss(xt, t(lbl), 6, t(w0)).sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(),
+                                   self._num_grad(f, x0), atol=2e-2)
+
+    def test_roi_align_numeric_grad(self):
+        fl = paddle.fluid.layers
+        rng = R(3)
+        x0 = rng.randn(1, 1, 6, 6).astype("float32")
+        rois = np.array([[1., 1., 4.5, 4.5]], np.float32)
+
+        def f(xv):
+            return float(fl.roi_align(t(xv), t(rois), 2, 2).sum())
+
+        xt = t(x0); xt.stop_gradient = False
+        fl.roi_align(xt, t(rois), 2, 2).sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(),
+                                   self._num_grad(f, x0), atol=2e-2)
