@@ -66,6 +66,14 @@ def _load():
             ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.c_float,
             ctypes.c_void_p, ctypes.c_int]
+        lib.ptpu_wp_create.restype = ctypes.c_int64
+        lib.ptpu_wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.c_char_p]
+        lib.ptpu_wp_destroy.argtypes = [ctypes.c_int64]
+        lib.ptpu_wp_encode.restype = ctypes.c_int64
+        lib.ptpu_wp_encode.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         _lib = lib
         return _lib
 

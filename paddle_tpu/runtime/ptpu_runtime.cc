@@ -384,3 +384,144 @@ int ptpu_preprocess_u8_nhwc_to_f32_nchw(const uint8_t* const* srcs, int n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// WordPiece tokenizer (ref: the ERNIE/BERT data pipeline's host-side
+// tokenization — reference tokenization.py implements the same algorithm
+// in Python; models feed int ids).  Basic tokenize (whitespace +
+// punctuation split, optional ASCII lowercase) then greedy longest-match
+// wordpiece with a "##" continuation prefix.  UTF-8 bytes outside ASCII
+// pass through opaquely (multi-byte chars are treated as atomic units).
+namespace wp {
+
+struct Tok {
+  std::unordered_map<std::string, int> vocab;
+  int unk_id = 0;
+  std::string cont = "##";
+};
+
+std::unordered_map<int64_t, Tok*> g_toks;
+
+inline bool is_punct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+// one UTF-8 character's byte length from its lead byte
+inline int u8len(unsigned char c) {
+  if (c < 0x80) return 1;
+  if ((c >> 5) == 0x6) return 2;
+  if ((c >> 4) == 0xE) return 3;
+  if ((c >> 3) == 0x1E) return 4;
+  return 1;
+}
+
+void wordpiece(const Tok& tk, const std::string& word,
+               std::vector<int>* out) {
+  if (word.empty()) return;
+  size_t start = 0;
+  std::vector<int> pieces;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int found = -1;
+    size_t found_end = start;
+    while (end > start) {
+      std::string sub = word.substr(start, end - start);
+      if (start > 0) sub = tk.cont + sub;
+      auto it = tk.vocab.find(sub);
+      if (it != tk.vocab.end()) { found = it->second; found_end = end; break; }
+      // shrink by one UTF-8 char from the right
+      size_t e = start;
+      size_t prev = start;
+      while (e < end) { prev = e; e += u8len((unsigned char)word[e]); }
+      end = prev;
+    }
+    if (found < 0) { out->push_back(tk.unk_id); return; }
+    pieces.push_back(found);
+    start = found_end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace wp
+
+extern "C" {
+
+int64_t ptpu_wp_create(const char* vocab_data, int64_t len,
+                       const char* unk_token) {
+  auto* tk = new wp::Tok();
+  // vocab: newline-separated tokens; line index = id
+  int id = 0;
+  const char* p = vocab_data;
+  const char* endp = vocab_data + len;
+  while (p < endp) {
+    const char* nl = (const char*)memchr(p, '\n', endp - p);
+    size_t n = nl ? (size_t)(nl - p) : (size_t)(endp - p);
+    while (n > 0 && (p[n - 1] == '\r')) --n;
+    if (n > 0) tk->vocab.emplace(std::string(p, n), id);
+    ++id;
+    if (!nl) break;
+    p = nl + 1;
+  }
+  auto it = tk->vocab.find(unk_token ? unk_token : "[UNK]");
+  tk->unk_id = it == tk->vocab.end() ? 0 : it->second;
+  int64_t h = g_next++;
+  std::lock_guard<std::mutex> g(g_mu);
+  wp::g_toks[h] = tk;
+  return h;
+}
+
+void ptpu_wp_destroy(int64_t h) {
+  wp::Tok* t;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = wp::g_toks.find(h);
+    if (it == wp::g_toks.end()) return;
+    t = it->second;
+    wp::g_toks.erase(it);
+  }
+  delete t;
+}
+
+int64_t ptpu_wp_encode(int64_t h, const char* text, int64_t text_len,
+                       int do_lower, int* out_ids, int64_t max_out) {
+  wp::Tok* tk;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = wp::g_toks.find(h);
+    if (it == wp::g_toks.end()) return -1;
+    tk = it->second;
+  }
+  std::vector<int> ids;
+  std::string word;
+  auto flush = [&]() {
+    if (!word.empty()) { wp::wordpiece(*tk, word, &ids); word.clear(); }
+  };
+  int64_t i = 0;
+  while (i < text_len) {
+    unsigned char c = (unsigned char)text[i];
+    if (c < 0x80) {
+      if (isspace(c)) { flush(); ++i; continue; }
+      if (wp::is_punct(c)) {
+        flush();
+        word.assign(1, (char)c);
+        flush();
+        ++i;
+        continue;
+      }
+      word.push_back(do_lower ? (char)tolower(c) : (char)c);
+      ++i;
+    } else {
+      int n = wp::u8len(c);
+      for (int k = 0; k < n && i < text_len; ++k, ++i)
+        word.push_back(text[i]);
+    }
+  }
+  flush();
+  int64_t n = (int64_t)ids.size() < max_out ? (int64_t)ids.size() : max_out;
+  for (int64_t k = 0; k < n; ++k) out_ids[k] = ids[k];
+  return (int64_t)ids.size();
+}
+
+}  // extern "C"
+

@@ -8,3 +8,4 @@ pipelines exercise identically.
 from .datasets import (UCIHousing, Imdb, Imikolov, Movielens, Conll05st,
                        WMT14, WMT16)
 from .viterbi import viterbi_decode, ViterbiDecoder
+from .tokenizer import FullTokenizer, WordpieceTokenizer, load_vocab  # noqa

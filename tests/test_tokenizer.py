@@ -1,0 +1,78 @@
+"""WordPiece tokenizer: native C++ vs pure-Python parity + goldens."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.tokenizer import FullTokenizer, _basic_tokenize
+
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##s", "##ed", "##ing", "over", "lazy", "dog",
+         "un", "##aff", "##able", ",", ".", "!", "a", "b", "c"]
+
+
+@pytest.fixture()
+def vocab_file(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+class TestWordpiece:
+    def test_golden_tokenization(self, vocab_file):
+        tok = FullTokenizer(vocab_file, use_native=False)
+        assert tok.tokenize("The quick brown fox jumps!") == \
+            ["the", "quick", "brown", "fox", "jump", "##s", "!"]
+        assert tok.tokenize("unaffable") == ["un", "##aff", "##able"]
+        # unknown word -> [UNK] as a whole
+        assert tok.tokenize("zzz") == ["[UNK]"]
+        # punctuation isolation
+        assert tok.tokenize("fox,dog.") == ["fox", ",", "dog", "."]
+
+    def test_case_handling(self, vocab_file):
+        lower = FullTokenizer(vocab_file, do_lower_case=True,
+                              use_native=False)
+        keep = FullTokenizer(vocab_file, do_lower_case=False,
+                             use_native=False)
+        assert lower.encode("THE") == [VOCAB.index("the")]
+        assert keep.encode("THE") == [VOCAB.index("[UNK]")]
+
+    def test_native_matches_python(self, vocab_file):
+        from paddle_tpu import runtime
+        if not runtime.is_available():
+            pytest.skip("no native runtime")
+        nat = FullTokenizer(vocab_file, use_native=True)
+        py = FullTokenizer(vocab_file, use_native=False)
+        assert nat._native is not None
+        texts = [
+            "The quick brown fox jumps over the lazy dog!",
+            "unaffable, unaffable. jumping jumped",
+            "a b c abc cab",
+            "",
+            "  spaced   out  ",
+            "punct!!!...,,",
+            "mixed CASE Words",
+        ]
+        for s in texts:
+            assert nat.encode(s) == py.encode(s), s
+
+    def test_native_fuzz_parity(self, vocab_file):
+        from paddle_tpu import runtime
+        if not runtime.is_available():
+            pytest.skip("no native runtime")
+        nat = FullTokenizer(vocab_file, use_native=True)
+        py = FullTokenizer(vocab_file, use_native=False)
+        rng = np.random.RandomState(0)
+        alphabet = list("abc theniqus.,!ZQ ")
+        for _ in range(200):
+            s = "".join(rng.choice(alphabet)
+                        for _ in range(rng.randint(0, 40)))
+            assert nat.encode(s) == py.encode(s), repr(s)
+
+    def test_ids_roundtrip(self, vocab_file):
+        tok = FullTokenizer(vocab_file, use_native=False)
+        toks = tok.tokenize("the quick fox")
+        ids = tok.convert_tokens_to_ids(toks)
+        assert tok.convert_ids_to_tokens(ids) == toks
