@@ -124,11 +124,14 @@ def main():
         # measured faster end-to-end than the Pallas kernel (sweep r3:
         # 10,477 vs 6,871 tok/s); flash + ring attention remain the long-
         # sequence / sequence-parallel path.
+        cfg_13b = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                       num_heads=16, max_seq_len=2048,
+                       param_dtype="bfloat16", use_flash=False)
         configs = [
-            (gpt.GPTConfig(vocab_size=50304, hidden_size=2048,
-                           num_layers=24, num_heads=16, max_seq_len=2048,
-                           param_dtype="bfloat16", use_flash=False),
-             4, 8, jnp.bfloat16),
+            # batch 6 first (deeper MXU utilization); falls back to the
+            # r3-measured batch-4 config (0.474 MFU) on OOM/failure
+            (gpt.GPTConfig(**cfg_13b), 6, 8, jnp.bfloat16),
+            (gpt.GPTConfig(**cfg_13b), 4, 8, jnp.bfloat16),
             # fallback: 355M in full fp32 (judge-measured 0.336 MFU in r2)
             (gpt.GPTConfig(vocab_size=50304, hidden_size=1024,
                            num_layers=24, num_heads=16, max_seq_len=1024,
