@@ -36,6 +36,9 @@ from . import core
 from . import clip
 from . import metrics
 from . import contrib
+from . import nets
+from . import backward
+from ..utils import unique_name  # fluid.unique_name.guard()
 
 # fluid.data / fluid.embedding are module-level in the reference
 from .layers import data, embedding

@@ -1,0 +1,7 @@
+"""fluid.backward (ref: python/paddle/fluid/backward.py)."""
+from ..static.backward import append_backward, gradients  # noqa: F401
+
+
+def gradients_with_optimizer(program, optimizer, inputs=None, outputs=None):
+    raise NotImplementedError(
+        "use optimizer.minimize(loss) inside the program guard")

@@ -1,0 +1,85 @@
+"""fluid.nets — classic composite helpers (ref:
+python/paddle/fluid/nets.py: conv+pool/attention compositions the fluid
+book examples build models from)."""
+from __future__ import annotations
+
+from . import layers
+from ..nn import functional as F
+from ..tensor import manipulation as manip
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    conv = layers.conv2d(input, num_filters, filter_size,
+                         stride=conv_stride, padding=conv_padding,
+                         dilation=conv_dilation, groups=conv_groups,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act)
+    return layers.pool2d(conv, pool_size, pool_type, pool_stride,
+                         pool_padding, global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """VGG-style conv block: N convs (+BN +dropout) then one pool."""
+    def listify(v, n):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+    n = len(conv_num_filter)
+    paddings = listify(conv_padding, n)
+    fsizes = listify(conv_filter_size, n)
+    with_bn = listify(conv_with_batchnorm, n)
+    drops = listify(conv_batchnorm_drop_rate, n)
+    attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * n
+
+    tmp = input
+    for i in range(n):
+        tmp = layers.conv2d(tmp, conv_num_filter[i], fsizes[i],
+                            padding=paddings[i], param_attr=attrs[i],
+                            act=None if with_bn[i] else conv_act)
+        if with_bn[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if drops[i] > 0:
+                tmp = layers.dropout(tmp, drops[i])
+    return layers.pool2d(tmp, pool_size, pool_type, pool_stride)
+
+
+def sequence_conv_pool(input, lengths, num_filters, filter_size,
+                       param_attr=None, act="sigmoid", pool_type="max",
+                       bias_attr=None):
+    """Padded+masked form of the text-CNN block: sequence_conv then a
+    masked sequence_pool (the reference's LoD version takes one ragged
+    input; here ``lengths`` carries the per-row sequence sizes)."""
+    from .. import create_parameter
+    H = int(input.shape[-1])
+    w = create_parameter([filter_size * H, num_filters], "float32",
+                         attr=param_attr)
+    conv = F.sequence_conv(input, lengths, w, context_size=filter_size)
+    if act:
+        conv = getattr(F, act)(conv)
+    return F.sequence_pool(conv, lengths, pool_type)
+
+
+def glu(input, dim=-1):
+    return F.glu(input, axis=dim)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head SDP attention (ref nets.py::scaled_dot_product_attention).
+    q [B, Lq, D]; k/v [B, Lk, D]; D divisible by num_heads."""
+    B, Lq, D = queries.shape
+    q = manip.reshape(queries, [B, Lq, num_heads, D // num_heads])
+    k = manip.reshape(keys, [B, keys.shape[1], num_heads, D // num_heads])
+    v = manip.reshape(values, [B, values.shape[1], num_heads,
+                               D // num_heads])
+    out = F.scaled_dot_product_attention(q, k, v,
+                                         dropout_p=dropout_rate)
+    return manip.reshape(out, [B, Lq, D])

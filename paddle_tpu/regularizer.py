@@ -22,3 +22,8 @@ class L2Decay:
 
 L1DecayRegularizer = L1Decay
 L2DecayRegularizer = L2Decay
+
+
+# fluid-era class names (ref fluid/regularizer.py)
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

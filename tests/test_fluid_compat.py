@@ -200,3 +200,41 @@ class TestFluidLayersOps:
         fluid.set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.5})
         assert fluid.get_flags("FLAGS_fraction_of_gpu_memory_to_use") == {
             "FLAGS_fraction_of_gpu_memory_to_use": 0.5}
+
+
+class TestFluidNets:
+    def test_simple_img_conv_pool(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 16, 16).astype("float32"))
+        out = fluid.nets.simple_img_conv_pool(x, 8, 3, 2, 2,
+                                              conv_padding=1, act="relu")
+        assert out.shape == [2, 8, 8, 8]
+        assert (out.numpy() >= 0).all()
+
+    def test_img_conv_group_vgg_block(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 8, 8).astype("float32"))
+        out = fluid.nets.img_conv_group(x, [4, 4], 2, conv_act="relu",
+                                        conv_with_batchnorm=True)
+        assert out.shape == [2, 4, 4, 4]
+
+    def test_sequence_conv_pool(self):
+        seq = paddle.to_tensor(
+            np.random.RandomState(2).randn(3, 6, 8).astype("float32"))
+        lens = paddle.to_tensor(np.array([6, 4, 2]))
+        out = fluid.nets.sequence_conv_pool(seq, lens, 10, 3)
+        assert out.shape == [3, 10]
+
+    def test_sdpa_and_glu(self):
+        q = paddle.to_tensor(
+            np.random.RandomState(3).randn(2, 5, 8).astype("float32"))
+        assert fluid.nets.scaled_dot_product_attention(
+            q, q, q, num_heads=2).shape == [2, 5, 8]
+        assert fluid.nets.glu(q).shape == [2, 5, 4]
+
+    def test_module_aliases(self):
+        assert fluid.backward.append_backward is paddle.static.append_backward
+        with fluid.unique_name.guard():
+            pass
+        import paddle_tpu.regularizer as R
+        assert R.L2DecayRegularizer is R.L2Decay
