@@ -215,7 +215,8 @@ class TestFluidNets:
         x = paddle.to_tensor(
             np.random.RandomState(1).randn(2, 3, 8, 8).astype("float32"))
         out = fluid.nets.img_conv_group(x, [4, 4], 2, conv_act="relu",
-                                        conv_with_batchnorm=True)
+                                        conv_with_batchnorm=True,
+                                        pool_stride=2)
         assert out.shape == [2, 4, 4, 4]
 
     def test_sequence_conv_pool(self):
