@@ -61,7 +61,9 @@ def sequence_conv_pool(input, lengths, num_filters, filter_size,
     H = int(input.shape[-1])
     w = create_parameter([filter_size * H, num_filters], "float32",
                          attr=param_attr)
-    conv = F.sequence_conv(input, lengths, w, context_size=filter_size)
+    b = create_parameter([num_filters], "float32", attr=bias_attr,
+                         is_bias=True)
+    conv = F.sequence_conv(input, lengths, w, context_size=filter_size) + b
     if act:
         conv = getattr(F, act)(conv)
     return F.sequence_pool(conv, lengths, pool_type)

@@ -29,6 +29,11 @@ def _sdpa_ref(q, k, v, mask=None, scale=None, causal=False, dropout_p=0.0):
     if mask is not None:
         logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p:
+        from ...framework import core
+        keep = 1.0 - dropout_p
+        m = jax.random.bernoulli(core.next_rng_key(), keep, probs.shape)
+        probs = jnp.where(m, probs / keep, 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
 
@@ -36,10 +41,14 @@ def _sdpa_ref(q, k, v, mask=None, scale=None, causal=False, dropout_p=0.0):
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
+    p = dropout_p if training else 0.0
     if attn_mask is not None:
-        return call(lambda q, k, v, m: _sdpa_ref(q, k, v, m, causal=is_causal),
+        return call(lambda q, k, v, m: _sdpa_ref(q, k, v, m,
+                                                 causal=is_causal,
+                                                 dropout_p=p),
                     query, key, value, attn_mask, _name="sdpa")
-    return call(lambda q, k, v: _sdpa_ref(q, k, v, None, causal=is_causal),
+    return call(lambda q, k, v: _sdpa_ref(q, k, v, None, causal=is_causal,
+                                          dropout_p=p),
                 query, key, value, _name="sdpa")
 
 

@@ -23,7 +23,3 @@ class L2Decay:
 L1DecayRegularizer = L1Decay
 L2DecayRegularizer = L2Decay
 
-
-# fluid-era class names (ref fluid/regularizer.py)
-L1DecayRegularizer = L1Decay
-L2DecayRegularizer = L2Decay

@@ -458,7 +458,9 @@ int64_t ptpu_wp_create(const char* vocab_data, int64_t len,
     const char* nl = (const char*)memchr(p, '\n', endp - p);
     size_t n = nl ? (size_t)(nl - p) : (size_t)(endp - p);
     while (n > 0 && (p[n - 1] == '\r')) --n;
-    if (n > 0) tk->vocab.emplace(std::string(p, n), id);
+    // LAST duplicate wins, matching the Python dict load (reference
+    // tokenization.py reads sequentially with plain assignment)
+    if (n > 0) tk->vocab[std::string(p, n)] = id;
     ++id;
     if (!nl) break;
     p = nl + 1;
@@ -501,7 +503,10 @@ int64_t ptpu_wp_encode(int64_t h, const char* text, int64_t text_len,
   while (i < text_len) {
     unsigned char c = (unsigned char)text[i];
     if (c < 0x80) {
-      if (isspace(c)) { flush(); ++i; continue; }
+      // match Python str.isspace for ASCII: 9-13, 28-31, 32
+      if (c == 32 || (c >= 9 && c <= 13) || (c >= 28 && c <= 31)) {
+        flush(); ++i; continue;
+      }
       if (wp::is_punct(c)) {
         flush();
         word.assign(1, (char)c);

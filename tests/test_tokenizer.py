@@ -65,10 +65,30 @@ class TestWordpiece:
         nat = FullTokenizer(vocab_file, use_native=True)
         py = FullTokenizer(vocab_file, use_native=False)
         rng = np.random.RandomState(0)
-        alphabet = list("abc theniqus.,!ZQ ")
+        alphabet = list("abc theniqus.,!ZQ ") + ["\x1c", "\x1d", "\x1f", "\t", "\n"]
         for _ in range(200):
             s = "".join(rng.choice(alphabet)
                         for _ in range(rng.randint(0, 40)))
+            assert nat.encode(s) == py.encode(s), repr(s)
+
+    def test_duplicate_vocab_last_wins(self, tmp_path):
+        p = tmp_path / "dup.txt"
+        p.write_text("[UNK]\na\nb\na\n", encoding="utf-8")
+        py = FullTokenizer(str(p), use_native=False)
+        assert py.encode("a") == [3]       # last occurrence wins
+        from paddle_tpu import runtime
+        if runtime.is_available():
+            nat = FullTokenizer(str(p), use_native=True)
+            assert nat.encode("a") == [3]
+
+    def test_control_char_whitespace_parity(self, vocab_file):
+        from paddle_tpu import runtime
+        if not runtime.is_available():
+            pytest.skip("no native runtime")
+        nat = FullTokenizer(vocab_file, use_native=True)
+        py = FullTokenizer(vocab_file, use_native=False)
+        for s in ("a\x1cb", "fox\x1ddog", "the\x1equick", "a\x1fb",
+                  "a\x0bb", "a\x0cb"):
             assert nat.encode(s) == py.encode(s), repr(s)
 
     def test_ids_roundtrip(self, vocab_file):
