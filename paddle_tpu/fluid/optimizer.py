@@ -44,3 +44,78 @@ RMSPropOptimizer = _wrap(_opt.RMSProp)
 AdadeltaOptimizer = _wrap(_opt.Adadelta)
 LambOptimizer = _wrap(_opt.Lamb)
 Optimizer = _opt.Optimizer
+
+
+DecayedAdagradOptimizer = _wrap(_opt.optimizers.DecayedAdagrad)
+FtrlOptimizer = _wrap(_opt.optimizers.Ftrl)
+DpsgdOptimizer = _wrap(_opt.optimizers.Dpsgd)
+LarsMomentumOptimizer = _wrap(_opt.optimizers.LarsMomentum)
+
+# fluid also exposes the short names
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Ftrl = FtrlOptimizer
+Dpsgd = DpsgdOptimizer
+LarsMomentum = LarsMomentumOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+
+from ..incubate.optimizer import (LookAhead as _LookAhead,  # noqa: E402
+                                  ModelAverage,
+                                  ExponentialMovingAverage)  # noqa: F401
+
+
+def LookaheadOptimizer(inner_optimizer, alpha=0.5, k=5):
+    """fluid spelling of incubate.LookAhead."""
+    return _LookAhead(inner_optimizer, alpha=alpha, k=k)
+
+
+class PipelineOptimizer:
+    """ref fluid/optimizer.py::PipelineOptimizer — in the TPU-native design
+    pipeline parallelism is a MESH decision (pp axis + ppermute microbatch
+    schedule, parallel/pipeline.py), not a graph rewrite; this wrapper
+    keeps the fluid spelling and delegates optimization to the inner
+    optimizer."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._inner = optimizer
+        self.num_microbatches = num_microbatches
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program=startup_program,
+                                    parameter_list=parameter_list,
+                                    no_grad_set=no_grad_set)
+
+
+class RecomputeOptimizer:
+    """ref fluid/optimizer.py::RecomputeOptimizer — activation
+    rematerialization.  The static Executor honors the flag by wrapping
+    the replayed forward in jax.checkpoint when the training optimizer
+    carries ``_recompute`` (checkpoint segments are XLA's choice — the
+    TPU-native equivalent of the reference's checkpoint list)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        optimizer._recompute = True
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints   # segment hints; XLA remats
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program=startup_program,
+                                    parameter_list=parameter_list,
+                                    no_grad_set=no_grad_set)

@@ -114,3 +114,47 @@ class ModelAverage(Optimizer):
             for p in self._parameters:
                 p.value = self._backup[id(p)]
             self._backup = None
+
+
+class ExponentialMovingAverage:
+    """ref fluid/optimizer.py::ExponentialMovingAverage — EMA of params
+    with optional Adam-style bias correction (thres_steps unsupported);
+    ``update()`` after each step, ``apply()``/``restore()`` around eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameters=None):
+        from ..static.graph import default_main_program, in_static_mode
+        if parameters is None and in_static_mode():
+            parameters = default_main_program().all_parameters()
+        self._decay = float(decay)
+        self._parameters = list(parameters or [])
+        # zero-init + bias correction (the Adam-style estimator the
+        # reference uses): ema_t / (1 - decay^t) is unbiased from step 1
+        self._ema = {id(p): jnp.zeros_like(p.value)
+                     for p in self._parameters}
+        self._step = 0
+        self._backup = None
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        for p in self._parameters:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * p.value
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p.value for p in self._parameters}
+        corr = 1.0 - self._decay ** max(self._step, 1)
+        for p in self._parameters:
+            p.value = self._ema[id(p)] / corr
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._parameters:
+                p.value = self._backup[id(p)]
+            self._backup = None

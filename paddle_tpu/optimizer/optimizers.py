@@ -219,3 +219,103 @@ class Lamb(Optimizer):
                           jnp.where(u_norm > 0, w_norm / u_norm, 1.0), 1.0)
         new_p = pf - lr * ratio * update
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class DecayedAdagrad(Optimizer):
+    """ref fluid/optimizer.py::DecayedAdagradOptimizer — adagrad with an
+    exponentially decayed accumulator."""
+    _accum_names = ("moment",)
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        m = self._decay * state["moment"] + (1 - self._decay) * g * g
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Ftrl(Optimizer):
+    """ref fluid/optimizer.py::FtrlOptimizer (FTRL-proximal, McMahan 2013):
+    per-coordinate adaptive rates with L1/L2 proximal shrinkage — the CTR
+    workhorse next to the sparse-embedding models."""
+    _accum_names = ("squared", "linear")
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        n, z = state["squared"], state["linear"]
+        n_new = n + g * g
+        sigma = (n_new ** (-self._lr_power)
+                 - n ** (-self._lr_power)) / lr
+        z_new = z + g - sigma * p
+        new_p = jnp.where(
+            jnp.abs(z_new) <= self._l1,
+            jnp.zeros_like(p),
+            (jnp.sign(z_new) * self._l1 - z_new)
+            / (n_new ** (-self._lr_power) / lr + 2 * self._l2))
+        return new_p, {"squared": n_new, "linear": z_new}
+
+
+class Dpsgd(Optimizer):
+    """ref fluid/optimizer.py::DpsgdOptimizer — differentially private SGD:
+    per-update clipping + gaussian noise (Abadi et al. 2016)."""
+    _accum_names = ()
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, seed=0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._clip = clip
+        self._batch = batch_size
+        self._sigma = sigma
+        self._seed = seed
+
+    def _update(self, p, g, state, lr, t=1):
+        import jax
+        g = g.astype(p.dtype)
+        norm = jnp.sqrt(jnp.sum(g * g))
+        g = g / jnp.maximum(1.0, norm / self._clip)
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 jnp.asarray(t, jnp.int32))
+        key = jax.random.fold_in(key, p.size % 7919)
+        noise = jax.random.normal(key, g.shape, jnp.float32) \
+            * (self._sigma * self._clip / self._batch)
+        return p - lr * (g + noise.astype(p.dtype)), {}
+
+
+class LarsMomentum(Momentum):
+    """ref fluid/optimizer.py::LarsMomentumOptimizer (You et al. 2017):
+    layer-wise adaptive rate scaling — local lr = coeff * ||w|| /
+    (||g|| + lambda * ||w||), then momentum."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         grad_clip=grad_clip)
+        self._coeff = lars_coeff
+        self._lwd = lars_weight_decay
+
+    def _update(self, p, g, state, lr, t=1):
+        g = g.astype(p.dtype)
+        wn = jnp.sqrt(jnp.sum(p * p))
+        gn = jnp.sqrt(jnp.sum(g * g))
+        local = jnp.where(
+            (wn > 0) & (gn > 0),
+            self._coeff * wn / (gn + self._lwd * wn + 1e-12),
+            1.0)
+        g_eff = g + self._lwd * p
+        v = self._momentum * state["velocity"] + lr * local * g_eff
+        return p - v, {"velocity": v}

@@ -342,6 +342,10 @@ class Executor:
                 def loss_of(pv):
                     env = forward(feed_vals, pv)
                     return env[loss_id], env
+                if getattr(opt, "_recompute", False):
+                    # fluid RecomputeOptimizer: rematerialize the forward
+                    # in the backward pass (activation memory -> FLOPs)
+                    loss_of = jax.checkpoint(loss_of)
                 grads, env = jax.grad(
                     lambda pv: loss_of(pv), has_aux=True)(list(param_vals))
                 new_params, new_states = opt.apply_updates_pytree(
