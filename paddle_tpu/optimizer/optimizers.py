@@ -309,13 +309,13 @@ class LarsMomentum(Momentum):
         self._lwd = lars_weight_decay
 
     def _update(self, p, g, state, lr, t=1):
+        # reference lars_momentum_op: local_lr = lr * coeff * ||w|| /
+        # (||g|| + lambda * ||w|| + eps); zero-norm params get zero local
+        # lr (exclude biases from LARS param lists, as the reference does)
         g = g.astype(p.dtype)
         wn = jnp.sqrt(jnp.sum(p * p))
         gn = jnp.sqrt(jnp.sum(g * g))
-        local = jnp.where(
-            (wn > 0) & (gn > 0),
-            self._coeff * wn / (gn + self._lwd * wn + 1e-12),
-            1.0)
+        local = self._coeff * wn / (gn + self._lwd * wn + 1e-12)
         g_eff = g + self._lwd * p
         v = self._momentum * state["velocity"] + lr * local * g_eff
         return p - v, {"velocity": v}
