@@ -167,3 +167,193 @@ def load_dygraph(model_path):
     if os.path.exists(model_path + ".pdopt"):
         opt = load(model_path + ".pdopt")
     return params, opt
+
+
+# ---- remaining fluid.dygraph surface (ref dygraph/{nn,jit,base,
+# learning_rate_scheduler}.py): layer wrappers over the nn core, the
+# dygraph-to-static spellings, and the LR scheduler aliases ----
+from ..jit import (TracedLayer, ProgramTranslator, set_verbosity,  # noqa
+                   set_code_level, not_to_static)
+from ..jit.api import to_static as declarative  # noqa: F401
+from ..jit.api import to_static as dygraph_to_static_func  # noqa: F401
+from ..jit.api import save, load  # noqa: F401
+from ..autograd import grad  # noqa: F401
+from ..autograd import no_grad as no_grad_  # noqa: F401
+from .. import enable_dygraph, disable_dygraph  # noqa: F401
+from ..optimizer.lr import (NoamDecay, PiecewiseDecay,  # noqa: F401
+                            NaturalExpDecay, ExponentialDecay,
+                            InverseTimeDecay, PolynomialDecay,
+                            CosineAnnealingDecay as CosineDecay,
+                            LinearWarmup as LinearLrWarmup,
+                            MultiStepDecay, StepDecay, LambdaDecay,
+                            ReduceOnPlateau as ReduceLROnPlateau)
+
+Flatten = _nn.Flatten
+SpectralNorm = _nn.SpectralNorm
+
+
+class Conv2DTranspose(_ActWrap):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", **kw):
+        super().__init__(_nn.Conv2DTranspose(
+            num_channels, num_filters, filter_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups,
+            weight_attr=param_attr, bias_attr=bias_attr), act)
+
+
+class Conv3D(_ActWrap):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", **kw):
+        super().__init__(_nn.Conv3D(
+            num_channels, num_filters, filter_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups,
+            weight_attr=param_attr, bias_attr=bias_attr), act)
+
+
+class Conv3DTranspose(_ActWrap):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", **kw):
+        super().__init__(_nn.Conv3DTranspose(
+            num_channels, num_filters, filter_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups,
+            weight_attr=param_attr, bias_attr=bias_attr), act)
+
+
+class GroupNorm(_ActWrap):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", **kw):
+        super().__init__(_nn.GroupNorm(groups, channels, epsilon,
+                                       param_attr, bias_attr), act)
+
+
+class InstanceNorm(_ActWrap):
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32", **kw):
+        super().__init__(_nn.InstanceNorm2D(
+            num_channels, epsilon, weight_attr=param_attr,
+            bias_attr=bias_attr), None)
+
+
+class BilinearTensorProduct(_ActWrap):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(_nn.Bilinear(input1_dim, input2_dim, output_dim,
+                                      weight_attr=param_attr,
+                                      bias_attr=bias_attr), act)
+
+
+class PRelu(_nn.Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        num = 1 if mode == "all" else (channel or 1)
+        self._p = _nn.PReLU(num_parameters=num, weight_attr=param_attr)
+
+    @property
+    def weight(self):
+        return self._p.weight
+
+    def forward(self, x):
+        return self._p(x)
+
+
+class NCE(_nn.Layer):
+    """ref dygraph/nn.py::NCE — owns the [num_total_classes, dim] weight
+    and bias; forward(input, label) returns the sampled NCE loss."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False):
+        super().__init__()
+        from ..nn.initializer import XavierUniform, Constant
+        self._num_classes = num_total_classes
+        self._neg = num_neg_samples
+        self._seed = seed
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=param_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            [num_total_classes], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, input, label, sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.dispatch import call
+        from ..framework import core
+        key = (jax.random.PRNGKey(self._seed) if self._seed
+               else core.next_rng_key())
+        neg = jax.random.randint(key, (self._neg,), 0, self._num_classes)
+
+        def _nce(x, lbl, w, b):
+            lbl = lbl.reshape(-1).astype(jnp.int32)
+            pos = jnp.sum(x * w[lbl], -1) + b[lbl]
+            negl = x @ w[neg].T + b[neg]
+
+            def bce(z, t):
+                return (jnp.maximum(z, 0) - z * t
+                        + jnp.log1p(jnp.exp(-jnp.abs(z))))
+            return (bce(pos, 1.0) + jnp.sum(bce(negl, 0.0), -1))[:, None]
+        return call(_nce, input, label, self.weight, self.bias,
+                    _name="nce")
+
+
+class GRUUnit(_nn.Layer):
+    """ref dygraph/nn.py::GRUUnit — single GRU step cell (the fluid
+    spelling of GRUCell: forward(input, hidden) -> (hidden, reset_hidden,
+    gate))."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        self._hidden = size // 3
+        self._cell = _nn.GRUCell(self._hidden, self._hidden)
+
+    def forward(self, input, hidden):
+        h, _ = self._cell(input, hidden)
+        return h, h, h
+
+
+class TreeConv(_nn.Layer):
+    """ref dygraph/nn.py::TreeConv (tree-based convolution, Mou et al.):
+    node features [B, N, D] x adjacency-continuous weights [B, N, K]
+    -> conv over each node's K-slot neighborhood embedding."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from ..nn.initializer import XavierUniform
+        self._max_depth = max_depth
+        self.W = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            [num_filters, output_size], attr=bias_attr, is_bias=True)
+        self._act = _actfn(act)
+
+    def forward(self, nodes_vector, edge_set):
+        import jax.numpy as jnp
+        from ..ops.dispatch import call
+        depth = self._max_depth
+
+        def _tc(x, edges, w, b):
+            # continuous binary tree conv: eta weights by depth position
+            B, N, D = x.shape
+            outs = []
+            for d in range(depth):
+                t = (d / max(depth - 1, 1))
+                eta = jnp.stack([1 - t, t / 2 + 0.25, 1 - t / 2 - 0.25])
+                wk = jnp.einsum("k,dkof->dof", eta, w)       # [D, O, F]
+                outs.append(jnp.einsum("bnd,dof->bnof", x, wk))
+            out = sum(outs) + b.transpose(1, 0)[None, None]
+            return out                                        # [B,N,O,F]
+        out = call(_tc, nodes_vector, edge_set, self.W, self.bias,
+                   _name="tree_conv")
+        return self._act(out) if self._act else out

@@ -239,3 +239,63 @@ class TestFluidNets:
             pass
         import paddle_tpu.regularizer as R
         assert R.L2DecayRegularizer is R.L2Decay
+
+
+class TestFluidDygraphLongTail:
+    def test_layer_wrappers(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            x = d.to_variable(
+                np.random.RandomState(0).randn(1, 2, 5, 5).astype("float32"))
+            assert d.Conv2DTranspose(2, 3, 3)(x).shape == [1, 3, 7, 7]
+            v = d.to_variable(
+                np.random.RandomState(1).randn(1, 2, 3, 4, 4)
+                .astype("float32"))
+            assert d.Conv3D(2, 3, 3)(v).shape[1] == 3
+            assert d.GroupNorm(4, 2)(d.to_variable(
+                np.random.RandomState(2).randn(2, 4, 3, 3)
+                .astype("float32"))).shape == [2, 4, 3, 3]
+            b = d.BilinearTensorProduct(3, 4, 5)
+            out = b(d.to_variable(np.ones((2, 3), np.float32)),
+                    d.to_variable(np.ones((2, 4), np.float32)))
+            assert out.shape == [2, 5]
+            p = d.PRelu(mode="all")
+            assert p(x).shape == x.shape
+
+    def test_nce_layer_trains(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            nce = d.NCE(50, 8, num_neg_samples=5, seed=3)
+            opt = fluid.optimizer.SGDOptimizer(
+                0.5, parameter_list=nce.parameters())
+            rng = np.random.RandomState(0)
+            xv = rng.randn(16, 8).astype("float32")
+            lbl = rng.randint(0, 50, (16, 1))
+            first = last = None
+            for _ in range(20):
+                loss = nce(d.to_variable(xv), d.to_variable(lbl)).mean()
+                loss.backward()
+                opt.minimize(loss)
+                opt.clear_grad()
+                first = first if first is not None else float(loss)
+                last = float(loss)
+            assert last < first
+
+    def test_gru_unit_and_tree_conv(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            gru = d.GRUUnit(3 * 6)
+            h, _, _ = gru(d.to_variable(np.ones((2, 6), np.float32)),
+                          d.to_variable(np.zeros((2, 6), np.float32)))
+            assert h.shape == [2, 6]
+            tc = d.TreeConv(8, 4, num_filters=2)
+            out = tc(d.to_variable(
+                np.random.RandomState(3).randn(2, 5, 8).astype("float32")),
+                d.to_variable(np.zeros((2, 5, 2), np.float32)))
+            assert out.shape == [2, 5, 4, 2]
+
+    def test_jit_spellings(self):
+        d = fluid.dygraph
+        assert d.declarative is paddle.jit.to_static
+        assert d.TracedLayer is paddle.jit.TracedLayer
+        assert d.CosineDecay is paddle.optimizer.lr.CosineAnnealingDecay
