@@ -194,12 +194,19 @@ SpectralNorm = _nn.SpectralNorm
 
 class Conv2DTranspose(_ActWrap):
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
-                 padding=0, dilation=1, groups=1, param_attr=None,
-                 bias_attr=None, act=None, dtype="float32", **kw):
+                 padding=0, dilation=1, groups=1, output_size=None,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32", **kw):
         super().__init__(_nn.Conv2DTranspose(
             num_channels, num_filters, filter_size, stride=stride,
             padding=padding, dilation=dilation, groups=groups,
             weight_attr=param_attr, bias_attr=bias_attr), act)
+        self._out_size = output_size
+
+    def forward(self, x):
+        out = self._inner(x, output_size=self._out_size) \
+            if self._out_size is not None else self._inner(x)
+        return self._act(out) if self._act else out
 
 
 class Conv3D(_ActWrap):
@@ -229,12 +236,31 @@ class GroupNorm(_ActWrap):
                                        param_attr, bias_attr), act)
 
 
-class InstanceNorm(_ActWrap):
+class InstanceNorm(_nn.Layer):
+    """fluid InstanceNorm accepts 3-D (NCL) through 5-D (NCDHW) inputs —
+    dispatch by rank; one [C] scale/bias pair serves every rank."""
+
     def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
                  bias_attr=None, dtype="float32", **kw):
-        super().__init__(_nn.InstanceNorm2D(
-            num_channels, epsilon, weight_attr=param_attr,
-            bias_attr=bias_attr), None)
+        super().__init__()
+        self._in = _nn.InstanceNorm2D(num_channels, epsilon,
+                                      weight_attr=param_attr,
+                                      bias_attr=bias_attr)
+        self._eps = epsilon
+
+    @property
+    def weight(self):
+        return self._in.weight
+
+    @property
+    def bias(self):
+        return self._in.bias
+
+    def forward(self, x):
+        if len(x.shape) == 4:
+            return self._in(x)
+        return F.instance_norm(x, weight=self._in.weight,
+                               bias=self._in.bias, eps=self._eps)
 
 
 class BilinearTensorProduct(_ActWrap):
@@ -274,6 +300,7 @@ class NCE(_nn.Layer):
         self._num_classes = num_total_classes
         self._neg = num_neg_samples
         self._seed = seed
+        self._calls = 0
         self.weight = self.create_parameter(
             [num_total_classes, dim], attr=param_attr,
             default_initializer=XavierUniform())
@@ -286,8 +313,12 @@ class NCE(_nn.Layer):
         import jax.numpy as jnp
         from ..ops.dispatch import call
         from ..framework import core
-        key = (jax.random.PRNGKey(self._seed) if self._seed
-               else core.next_rng_key())
+        # fresh negatives EVERY batch (NCE's unbiasedness needs
+        # resampling); seed only pins the reproducible stream
+        self._calls += 1
+        key = (jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                  self._calls)
+               if self._seed else core.next_rng_key())
         neg = jax.random.randint(key, (self._neg,), 0, self._num_classes)
 
         def _nce(x, lbl, w, b):
@@ -304,26 +335,55 @@ class NCE(_nn.Layer):
 
 
 class GRUUnit(_nn.Layer):
-    """ref dygraph/nn.py::GRUUnit — single GRU step cell (the fluid
-    spelling of GRUCell: forward(input, hidden) -> (hidden, reset_hidden,
-    gate))."""
+    """ref dygraph/nn.py::GRUUnit over gru_unit_op: a single GRU step on
+    PRE-PROJECTED gate input.  ``input`` is [B, 3D] (the fc(x, 3D) output,
+    reference contract), hidden [B, D]; owns the [D, 3D] hidden-to-gate
+    weight.  Returns (hidden, reset_hidden_prev, gate)."""
 
     def __init__(self, size, param_attr=None, bias_attr=None,
                  activation="tanh", gate_activation="sigmoid",
                  origin_mode=False, dtype="float32"):
         super().__init__()
-        self._hidden = size // 3
-        self._cell = _nn.GRUCell(self._hidden, self._hidden)
+        from ..nn.initializer import XavierUniform
+        D = size // 3
+        self._d = D
+        self._origin = origin_mode
+        self.weight = self.create_parameter(
+            [D, 3 * D], attr=param_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([3 * D], attr=bias_attr,
+                                          is_bias=True)
 
     def forward(self, input, hidden):
-        h, _ = self._cell(input, hidden)
-        return h, h, h
+        import jax
+        import jax.numpy as jnp
+        from ..ops.dispatch import call
+        D = self._d
+        origin = self._origin
+
+        def _gru(x, h, w, b):
+            xg = x + b
+            hu = h @ w[:, :D]
+            hr = h @ w[:, D:2 * D]
+            u = jax.nn.sigmoid(xg[:, :D] + hu)
+            r = jax.nn.sigmoid(xg[:, D:2 * D] + hr)
+            rh = r * h
+            c = jnp.tanh(xg[:, 2 * D:] + rh @ w[:, 2 * D:])
+            # origin_mode True: h = u*h + (1-u)*c; False (default, like
+            # the reference gru_unit_op): h = (1-u)*h + u*c
+            hn = u * h + (1 - u) * c if origin else (1 - u) * h + u * c
+            gate = jnp.concatenate([u, r, c], -1)
+            return hn, rh, gate
+        return call(_gru, input, hidden, self.weight, self.bias,
+                    _name="gru_unit")
 
 
 class TreeConv(_nn.Layer):
-    """ref dygraph/nn.py::TreeConv (tree-based convolution, Mou et al.):
-    node features [B, N, D] x adjacency-continuous weights [B, N, K]
-    -> conv over each node's K-slot neighborhood embedding."""
+    """ref dygraph/nn.py::TreeConv over tree_conv_op (TBCNN, Mou et al.):
+    node features [B, N, D] + ``edge_set`` [B, E, 2] (parent, child)
+    int pairs -> for every node, a convolution over (self, children-mean,
+    parent) with the three eta-slot weight matrices.  Messages flow along
+    the ACTUAL edges via segment scatter-adds — structure matters."""
 
     def __init__(self, feature_size, output_size, num_filters=1,
                  max_depth=2, act="tanh", param_attr=None, bias_attr=None,
@@ -331,6 +391,7 @@ class TreeConv(_nn.Layer):
         super().__init__()
         from ..nn.initializer import XavierUniform
         self._max_depth = max_depth
+        # slots: 0 = self/top, 1 = children aggregate, 2 = parent
         self.W = self.create_parameter(
             [feature_size, 3, output_size, num_filters], attr=param_attr,
             default_initializer=XavierUniform())
@@ -339,21 +400,33 @@ class TreeConv(_nn.Layer):
         self._act = _actfn(act)
 
     def forward(self, nodes_vector, edge_set):
+        import jax
         import jax.numpy as jnp
         from ..ops.dispatch import call
-        depth = self._max_depth
 
         def _tc(x, edges, w, b):
-            # continuous binary tree conv: eta weights by depth position
             B, N, D = x.shape
-            outs = []
-            for d in range(depth):
-                t = (d / max(depth - 1, 1))
-                eta = jnp.stack([1 - t, t / 2 + 0.25, 1 - t / 2 - 0.25])
-                wk = jnp.einsum("k,dkof->dof", eta, w)       # [D, O, F]
-                outs.append(jnp.einsum("bnd,dof->bnof", x, wk))
-            out = sum(outs) + b.transpose(1, 0)[None, None]
-            return out                                        # [B,N,O,F]
+            edges = edges.astype(jnp.int32)
+            parent = jnp.clip(edges[..., 0], 0, N - 1)     # [B, E]
+            child = jnp.clip(edges[..., 1], 0, N - 1)
+            valid = (edges[..., 0] != edges[..., 1])[..., None]
+
+            def agg(feats, src, dst):
+                # sum feats[src] into rows dst, then mean by in-degree
+                msg = jnp.take_along_axis(
+                    feats, src[..., None].repeat(D, -1), 1) * valid
+                out = jnp.zeros_like(feats)
+                out = jax.vmap(lambda o, d, m: o.at[d].add(m))(
+                    out, dst, msg)
+                cnt = jax.vmap(lambda d, v: jnp.zeros((N,)).at[d].add(
+                    v[:, 0]))(dst, valid.astype(jnp.float32))
+                return out / jnp.maximum(cnt[..., None], 1.0)
+
+            child_agg = agg(x, child, parent)    # children -> their parent
+            par_agg = agg(x, parent, child)      # parent -> its children
+            stacked = jnp.stack([x, child_agg, par_agg], 2)  # [B,N,3,D]
+            out = jnp.einsum("bnkd,dkof->bnof", stacked, w)
+            return out + b.transpose(1, 0)[None, None]        # [B,N,O,F]
         out = call(_tc, nodes_vector, edge_set, self.W, self.bias,
-                   _name="tree_conv")
+                   _name="tree_conv", _nondiff=(1,))
         return self._act(out) if self._act else out

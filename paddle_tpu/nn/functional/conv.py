@@ -100,6 +100,12 @@ def _conv_transpose_nd(nd, x, weight, bias, stride, padding, output_padding,
     d = _tup(dilation, nd)
     op_pad = _tup(output_padding, nd) if output_padding else (0,) * nd
     pad = _padding(padding, nd) if not isinstance(padding, str) else padding
+    if output_size is not None:
+        out_req = [int(v) for v in (
+            output_size if isinstance(output_size, (list, tuple))
+            else [output_size] * nd)]
+    else:
+        out_req = None
 
     def _convt(a, w, *b):
         # weight layout [in_c, out_c/groups, *k] (paddle transpose-conv)
@@ -108,8 +114,19 @@ def _conv_transpose_nd(nd, x, weight, bias, stride, padding, output_padding,
             pads = pad
         else:
             k = w.shape[2:]
+            if out_req is not None:
+                # output_size picks among the stride-many valid sizes:
+                # extra output padding = requested - default size
+                sp = (a.shape[2:2 + nd] if not channel_last
+                      else a.shape[1:1 + nd])
+                op = [out_req[i] - ((sp[i] - 1) * s[i]
+                                    - (pad[i][0] + pad[i][1])
+                                    + d[i] * (k[i] - 1) + 1)
+                      for i in range(nd)]
+            else:
+                op = op_pad
             pads = [(d[i] * (k[i] - 1) - pad[i][0],
-                     d[i] * (k[i] - 1) - pad[i][1] + op_pad[i])
+                     d[i] * (k[i] - 1) - pad[i][1] + op[i])
                     for i in range(nd)]
         # grouped transpose conv: split along channel groups
         if channel_last:

@@ -289,7 +289,9 @@ class Dpsgd(Optimizer):
         g = g / jnp.maximum(1.0, norm / self._clip)
         key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
                                  jnp.asarray(t, jnp.int32))
-        key = jax.random.fold_in(key, p.size % 7919)
+        # per-PARAMETER stream: params of equal size must not share noise
+        # (independence is what the DP accounting assumes)
+        key = jax.random.fold_in(key, id(p) % (2**31 - 1))
         noise = jax.random.normal(key, g.shape, jnp.float32) \
             * (self._sigma * self._clip / self._batch)
         return p - lr * (g + noise.astype(p.dtype)), {}
@@ -302,9 +304,10 @@ class LarsMomentum(Momentum):
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  lars_coeff=0.001, lars_weight_decay=0.0005,
-                 parameters=None, grad_clip=None, name=None):
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
         super().__init__(learning_rate, momentum, parameters,
-                         grad_clip=grad_clip)
+                         weight_decay=weight_decay, grad_clip=grad_clip)
         self._coeff = lars_coeff
         self._lwd = lars_weight_decay
 

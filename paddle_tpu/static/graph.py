@@ -339,15 +339,23 @@ class Executor:
             loss_id, opt = program.train_spec
 
             def train_step(feed_vals, param_vals, states, lr, t):
-                def loss_of(pv):
-                    env = forward(feed_vals, pv)
-                    return env[loss_id], env
                 if getattr(opt, "_recompute", False):
                     # fluid RecomputeOptimizer: rematerialize the forward
-                    # in the backward pass (activation memory -> FLOPs)
-                    loss_of = jax.checkpoint(loss_of)
-                grads, env = jax.grad(
-                    lambda pv: loss_of(pv), has_aux=True)(list(param_vals))
+                    # in the backward (activation memory -> FLOPs).  Only
+                    # the SCALAR loss comes out of the checkpointed region
+                    # — returning the env would keep every activation live
+                    # and defeat the remat; fetches re-run a forward-only
+                    # pass (no residuals) outside it.
+                    loss_fn = jax.checkpoint(
+                        lambda pv: forward(feed_vals, pv)[loss_id])
+                    grads = jax.grad(loss_fn)(list(param_vals))
+                    env = forward(feed_vals, list(param_vals))
+                else:
+                    def loss_of(pv):
+                        env = forward(feed_vals, pv)
+                        return env[loss_id], env
+                    grads, env = jax.grad(
+                        loss_of, has_aux=True)(list(param_vals))
                 new_params, new_states = opt.apply_updates_pytree(
                     list(param_vals), grads, states, lr, t)
                 fetches = tuple(eval_fetch(env, i, feed_vals, param_vals)

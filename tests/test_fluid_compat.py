@@ -284,14 +284,16 @@ class TestFluidDygraphLongTail:
     def test_gru_unit_and_tree_conv(self):
         with fluid.dygraph.guard():
             d = fluid.dygraph
-            gru = d.GRUUnit(3 * 6)
-            h, _, _ = gru(d.to_variable(np.ones((2, 6), np.float32)),
+            gru = d.GRUUnit(3 * 6)     # input is pre-projected [B, 3D]
+            h, _, _ = gru(d.to_variable(np.ones((2, 18), np.float32)),
                           d.to_variable(np.zeros((2, 6), np.float32)))
             assert h.shape == [2, 6]
             tc = d.TreeConv(8, 4, num_filters=2)
+            edges = np.array([[[0, 1], [1, 2], [1, 3], [0, 4]]] * 2,
+                             np.int64)
             out = tc(d.to_variable(
                 np.random.RandomState(3).randn(2, 5, 8).astype("float32")),
-                d.to_variable(np.zeros((2, 5, 2), np.float32)))
+                d.to_variable(edges))
             assert out.shape == [2, 5, 4, 2]
 
     def test_jit_spellings(self):
@@ -299,3 +301,66 @@ class TestFluidDygraphLongTail:
         assert d.declarative is paddle.jit.to_static
         assert d.TracedLayer is paddle.jit.TracedLayer
         assert d.CosineDecay is paddle.optimizer.lr.CosineAnnealingDecay
+
+
+class TestDygraphReviewRegressions:
+    def test_gru_unit_preprojected_contract(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            D = 6
+            gru = d.GRUUnit(3 * D)
+            h, rh, gate = gru(
+                d.to_variable(np.random.RandomState(0)
+                              .randn(2, 3 * D).astype("float32")),
+                d.to_variable(np.zeros((2, D), np.float32)))
+            assert h.shape == [2, D]
+            assert rh.shape == [2, D] and gate.shape == [2, 3 * D]
+
+    def test_tree_conv_uses_structure(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            tc = d.TreeConv(8, 4, num_filters=2)
+            x = d.to_variable(np.random.RandomState(1)
+                              .randn(1, 5, 8).astype("float32"))
+            e1 = d.to_variable(np.array([[[0, 1], [0, 2], [1, 3]]],
+                                        np.int64))
+            e2 = d.to_variable(np.array([[[0, 3], [2, 4], [1, 2]]],
+                                        np.int64))
+            assert np.abs(tc(x, e1).numpy()
+                          - tc(x, e2).numpy()).max() > 1e-6
+
+    def test_nce_resamples_negatives(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            nce = d.NCE(100, 8, num_neg_samples=5, seed=7)
+            xi = d.to_variable(np.random.RandomState(2)
+                               .randn(4, 8).astype("float32"))
+            li = d.to_variable(np.random.RandomState(3)
+                               .randint(0, 100, (4, 1)))
+            assert float(nce(xi, li).sum()) != float(nce(xi, li).sum())
+
+    def test_conv_transpose_output_size(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            ct = d.Conv2DTranspose(2, 3, 4, stride=2, output_size=[9, 9])
+            out = ct(d.to_variable(
+                np.random.randn(1, 2, 4, 4).astype("float32")))
+            assert out.shape == [1, 3, 9, 9]
+
+    def test_instance_norm_all_ranks(self):
+        with fluid.dygraph.guard():
+            d = fluid.dygraph
+            inorm = d.InstanceNorm(4)
+            for shape in ((2, 4, 7), (2, 4, 6, 6), (1, 4, 2, 3, 3)):
+                x = d.to_variable(np.random.randn(*shape).astype("float32"))
+                assert inorm(x).shape == list(shape)
+
+    def test_lars_fluid_wrapper_constructs_and_steps(self):
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(4, 1)
+            opt = fluid.optimizer.LarsMomentumOptimizer(
+                0.1, parameter_list=lin.parameters())
+            loss = lin(fluid.dygraph.to_variable(
+                np.ones((2, 4), np.float32))).mean()
+            loss.backward()
+            opt.minimize(loss)
