@@ -123,6 +123,13 @@ def _conv_transpose_nd(nd, x, weight, bias, stride, padding, output_padding,
                                     - (pad[i][0] + pad[i][1])
                                     + d[i] * (k[i] - 1) + 1)
                       for i in range(nd)]
+                for i, o in enumerate(op):
+                    if not (0 <= o < s[i]):
+                        raise ValueError(
+                            f"output_size[{i}]={out_req[i]} out of the "
+                            f"valid range [{out_req[i] - o}, "
+                            f"{out_req[i] - o + s[i] - 1}] (reference "
+                            "conv_transpose contract)")
             else:
                 op = op_pad
             pads = [(d[i] * (k[i] - 1) - pad[i][0],

@@ -342,10 +342,16 @@ class TestDygraphReviewRegressions:
     def test_conv_transpose_output_size(self):
         with fluid.dygraph.guard():
             d = fluid.dygraph
-            ct = d.Conv2DTranspose(2, 3, 4, stride=2, output_size=[9, 9])
+            # valid output_size range for stride 2, k 4, in 4: [10, 11]
+            ct = d.Conv2DTranspose(2, 3, 4, stride=2, output_size=[11, 11])
             out = ct(d.to_variable(
                 np.random.randn(1, 2, 4, 4).astype("float32")))
-            assert out.shape == [1, 3, 9, 9]
+            assert out.shape == [1, 3, 11, 11]
+            with pytest.raises(ValueError, match="output_size"):
+                bad = d.Conv2DTranspose(2, 3, 4, stride=2,
+                                        output_size=[9, 9])
+                bad(d.to_variable(
+                    np.random.randn(1, 2, 4, 4).astype("float32")))
 
     def test_instance_norm_all_ranks(self):
         with fluid.dygraph.guard():

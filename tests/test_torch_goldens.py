@@ -1,0 +1,160 @@
+"""Core nn.functional numerics vs torch-cpu goldens: conv family (incl.
+transpose output_size), pooling (ceil_mode/padding), norms, activations
+with nontrivial definitions.  The reference's OpTest compares against its
+own CPU kernels; torch-cpu is the independent oracle available here."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+TF = torch.nn.functional
+
+
+def t(a):
+    return paddle.to_tensor(a)
+
+
+def tt(a):
+    return torch.tensor(a)
+
+
+R = np.random.RandomState
+
+
+class TestConvVsTorch:
+    @pytest.mark.parametrize("stride,padding,dilation,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2)])
+    def test_conv2d(self, stride, padding, dilation, groups):
+        rng = R(0)
+        x = rng.randn(2, 4, 9, 9).astype("float32")
+        w = rng.randn(6, 4 // groups, 3, 3).astype("float32") * 0.2
+        b = rng.randn(6).astype("float32")
+        ours = F.conv2d(t(x), t(w), t(b), stride=stride, padding=padding,
+                        dilation=dilation, groups=groups).numpy()
+        ref = TF.conv2d(tt(x), tt(w), tt(b), stride=stride,
+                        padding=padding, dilation=dilation,
+                        groups=groups).numpy()
+        np.testing.assert_allclose(ours, ref, atol=2e-4)
+
+    def test_conv1d_conv3d(self):
+        rng = R(1)
+        x1 = rng.randn(2, 3, 11).astype("float32")
+        w1 = rng.randn(5, 3, 3).astype("float32") * 0.2
+        np.testing.assert_allclose(
+            F.conv1d(t(x1), t(w1), padding=1).numpy(),
+            TF.conv1d(tt(x1), tt(w1), padding=1).numpy(), atol=2e-4)
+        x3 = rng.randn(1, 2, 5, 5, 5).astype("float32")
+        w3 = rng.randn(3, 2, 2, 2, 2).astype("float32") * 0.2
+        np.testing.assert_allclose(
+            F.conv3d(t(x3), t(w3)).numpy(),
+            TF.conv3d(tt(x3), tt(w3)).numpy(), atol=2e-4)
+
+    @pytest.mark.parametrize("stride,padding,output_size", [
+        (2, 0, None), (2, 1, None), (2, 1, [9, 9]), (3, 1, [12, 12])])
+    def test_conv2d_transpose(self, stride, padding, output_size):
+        rng = R(2)
+        x = rng.randn(1, 3, 4, 4).astype("float32")
+        w = rng.randn(3, 5, 4, 4).astype("float32") * 0.2
+        ours = F.conv2d_transpose(t(x), t(w), stride=stride,
+                                  padding=padding,
+                                  output_size=output_size).numpy()
+        ref = TF.conv_transpose2d(
+            tt(x), tt(w), stride=stride, padding=padding,
+            output_padding=0 if output_size is None
+            else output_size[0] - ((4 - 1) * stride - 2 * padding + 4)
+        ).numpy()
+        np.testing.assert_allclose(ours, ref, atol=2e-4)
+
+
+class TestPoolVsTorch:
+    @pytest.mark.parametrize("ceil_mode", [False, True])
+    def test_max_pool2d(self, ceil_mode):
+        x = R(3).randn(2, 3, 7, 7).astype("float32")
+        ours = F.max_pool2d(t(x), 3, 2, 1, ceil_mode=ceil_mode).numpy()
+        ref = TF.max_pool2d(tt(x), 3, 2, 1, ceil_mode=ceil_mode).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_avg_pool2d(self):
+        x = R(4).randn(2, 3, 8, 8).astype("float32")
+        np.testing.assert_allclose(
+            F.avg_pool2d(t(x), 2, 2).numpy(),
+            TF.avg_pool2d(tt(x), 2, 2).numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("out", [1, 2, 3])
+    def test_adaptive_avg_pool2d(self, out):
+        x = R(5).randn(2, 3, 7, 7).astype("float32")
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(t(x), out).numpy(),
+            TF.adaptive_avg_pool2d(tt(x), out).numpy(), atol=1e-5)
+
+
+class TestNormVsTorch:
+    def test_layer_norm(self):
+        x = R(6).randn(4, 6, 8).astype("float32")
+        g = R(7).rand(8).astype("float32") + 0.5
+        b = R(8).randn(8).astype("float32")
+        np.testing.assert_allclose(
+            F.layer_norm(t(x), [8], weight=t(g), bias=t(b)).numpy(),
+            TF.layer_norm(tt(x), [8], tt(g), tt(b)).numpy(), atol=1e-5)
+
+    def test_group_norm(self):
+        x = R(9).randn(2, 6, 4, 4).astype("float32")
+        g = np.ones(6, np.float32)
+        b = np.zeros(6, np.float32)
+        np.testing.assert_allclose(
+            F.group_norm(t(x), 3, weight=t(g), bias=t(b)).numpy(),
+            TF.group_norm(tt(x), 3, tt(g), tt(b)).numpy(), atol=1e-5)
+
+    def test_instance_norm(self):
+        x = R(10).randn(2, 3, 5, 5).astype("float32")
+        np.testing.assert_allclose(
+            F.instance_norm(t(x)).numpy(),
+            TF.instance_norm(tt(x)).numpy(), atol=1e-5)
+
+    def test_batch_norm_eval_mode(self):
+        x = R(11).randn(4, 3, 5, 5).astype("float32")
+        mean = R(12).randn(3).astype("float32")
+        var = R(13).rand(3).astype("float32") + 0.5
+        g = R(14).rand(3).astype("float32") + 0.5
+        b = R(15).randn(3).astype("float32")
+        ours = F.batch_norm(t(x), t(mean), t(var), weight=t(g), bias=t(b),
+                            training=False).numpy()
+        ref = TF.batch_norm(tt(x), tt(mean), tt(var), tt(g), tt(b),
+                            training=False).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+class TestActivationsVsTorch:
+    @pytest.mark.parametrize("ours,theirs", [
+        (lambda x: F.gelu(x), lambda x: TF.gelu(x)),
+        (lambda x: F.gelu(x, approximate=True),
+         lambda x: TF.gelu(x, approximate="tanh")),
+        (lambda x: F.silu(x), TF.silu),
+        (lambda x: F.mish(x), TF.mish),
+        (lambda x: F.softplus(x), TF.softplus),
+        (lambda x: F.elu(x, 1.0), TF.elu),
+        (lambda x: F.selu(x), TF.selu),
+        (lambda x: F.hardswish(x), TF.hardswish),
+        (lambda x: F.hardsigmoid(x), TF.hardsigmoid),
+        (lambda x: F.log_softmax(x, -1),
+         lambda x: TF.log_softmax(x, -1)),
+    ], ids=["gelu", "gelu_tanh", "silu", "mish", "softplus", "elu",
+            "selu", "hardswish", "hardsigmoid", "log_softmax"])
+    def test_activation(self, ours, theirs):
+        x = (R(16).randn(3, 7) * 2).astype("float32")
+        np.testing.assert_allclose(ours(t(x)).numpy(),
+                                   theirs(tt(x)).numpy(), atol=2e-5)
+
+    def test_softmax_cross_entropy_family(self):
+        logits = R(17).randn(6, 9).astype("float32")
+        lbl = R(18).randint(0, 9, (6,))
+        np.testing.assert_allclose(
+            F.cross_entropy(t(logits), t(lbl)).numpy(),
+            TF.cross_entropy(tt(logits), torch.tensor(lbl)).numpy(),
+            atol=1e-5)
+        np.testing.assert_allclose(
+            F.nll_loss(t(np.log(np.abs(logits) + 0.1)), t(lbl)).numpy(),
+            TF.nll_loss(tt(np.log(np.abs(logits) + 0.1)),
+                        torch.tensor(lbl)).numpy(), atol=1e-5)
