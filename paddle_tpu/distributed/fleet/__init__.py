@@ -15,3 +15,4 @@ from .base import (DistributedStrategy, Fleet, fleet, init, is_first_worker,
 from . import meta_parallel
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                             VocabParallelEmbedding, get_rng_state_tracker)
+from . import metrics  # noqa: E402

@@ -1,0 +1,79 @@
+"""fleet.metrics — cross-trainer metric aggregation (ref:
+python/paddle/distributed/fleet/metrics/metric.py: each helper all_reduces
+a local accumulator over the trainer fleet, then finishes the metric on
+the host).  Here the reduce rides paddle.distributed.all_reduce (XLA
+collectives / jax.distributed); single-process runs reduce over one
+rank and are exact."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _np(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy(), np.float64)
+    return np.asarray(x, np.float64)
+
+
+def _all_reduce(arr, op="sum"):
+    from .. import collective as C
+    from ...tensor.tensor import Tensor
+    if C.get_world_size() <= 1:
+        return arr
+    t = Tensor(arr.astype(np.float32))
+    red = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+           "min": C.ReduceOp.MIN}[op]
+    C.all_reduce(t, op=red)
+    return np.asarray(t.numpy(), np.float64)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001
+    return _all_reduce(_np(input), "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _all_reduce(_np(input), "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _all_reduce(_np(input), "min")
+
+
+def acc(correct, total, scope=None, util=None):
+    c = _all_reduce(_np(correct), "sum")
+    t = _all_reduce(_np(total), "sum")
+    return float(c.sum() / np.maximum(t.sum(), 1.0))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    e = _all_reduce(_np(abserr), "sum")
+    n = _all_reduce(_np(total_ins_num), "sum")
+    return float(e.sum() / np.maximum(n.sum(), 1.0))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = _all_reduce(_np(sqrerr), "sum")
+    n = _all_reduce(_np(total_ins_num), "sum")
+    return float(e.sum() / np.maximum(n.sum(), 1.0))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from the threshold-bucketed pos/neg counts every trainer
+    accumulated (same layout fluid.metrics.Auc keeps)."""
+    pos = _all_reduce(_np(stat_pos), "sum")
+    neg = _all_reduce(_np(stat_neg), "sum")
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        area += neg[i] * (tot_pos + pos[i] + tot_pos) / 2.0
+        tot_pos += pos[i]
+        tot_neg += neg[i]
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    return float(area / (tot_pos * tot_neg))

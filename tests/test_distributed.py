@@ -213,3 +213,22 @@ def test_ring_attention_custom_vjp_grads_match_dense():
         for g, wnt, name in zip(got, want, "qkv"):
             np.testing.assert_allclose(np.asarray(g), np.asarray(wnt),
                                        atol=5e-5, err_msg=f"d{name}")
+
+
+class TestFleetMetrics:
+    def test_single_process_aggregation(self):
+        import numpy as np
+        from paddle_tpu.distributed.fleet import metrics as fm
+        assert float(fm.sum(np.array([3.0]))) == 3.0
+        assert float(fm.max(np.array([5.0]))) == 5.0
+        assert fm.acc(np.array([8.0]), np.array([10.0])) == 0.8
+        assert fm.mae(np.array([4.0]), np.array([8.0])) == 0.5
+        assert fm.rmse(np.array([8.0]), np.array([2.0])) == 2.0
+        # AUC from bucketed counts: perfect separation -> 1.0
+        pos = np.zeros(10); neg = np.zeros(10)
+        pos[9] = 5; neg[0] = 5
+        assert fm.auc(pos, neg) == 1.0
+        # random mixture -> 0.5
+        pos2 = np.zeros(10); neg2 = np.zeros(10)
+        pos2[4] = 5; neg2[4] = 5
+        assert abs(fm.auc(pos2, neg2) - 0.5) < 1e-9
