@@ -19,8 +19,9 @@ def _np(x):
 
 def _all_reduce(arr, op="sum"):
     from .. import collective as C
+    from ..parallel import get_world_size
     from ...tensor.tensor import Tensor
-    if C.get_world_size() <= 1:
+    if get_world_size() <= 1:
         return arr
     t = Tensor(arr.astype(np.float32))
     red = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
