@@ -3,6 +3,8 @@ era (ref: python/paddle/fluid/contrib/): slim quantization and
 mixed-precision training, both delegating to the TPU-native stacks."""
 from types import SimpleNamespace
 
+from . import contrib_layers as layers  # noqa: F401
+
 from .. import quantization as _q
 from ..amp import auto_cast, GradScaler
 

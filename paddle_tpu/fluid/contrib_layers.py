@@ -1,0 +1,168 @@
+"""fluid.contrib.layers — the PS/CTR-era fused op subset with TPU-native
+equivalents (ref: python/paddle/fluid/contrib/layers/nn.py).  Excluded:
+the parameter-server tree-retrieval internals (tdm_*, search_pyramid_hash,
+_pull_box_extended_sparse) and research exotica (bilateral_slice,
+correlation) — no TPU-meaningful contract."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+from .. import tensor as _T
+from ..nn import functional as F
+
+__all__ = ["fused_elemwise_activation", "shuffle_batch", "partial_concat",
+           "partial_sum", "batch_fc", "fused_embedding_seq_pool",
+           "fused_bn_add_act", "multiclass_nms2", "sparse_embedding",
+           "tree_conv"]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """ref fused_elemwise_activation_op: compose one elementwise binary op
+    with one unary activation (XLA fuses this anyway — the spelling is the
+    compatibility surface)."""
+    binaries = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply}
+    unaries = {"relu": jax.nn.relu, "scale": lambda a: a * scale,
+               "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid,
+               "gelu": jax.nn.gelu}
+    f1, f2 = functor_list
+
+    def _fea(a, b):
+        if f1 in binaries:            # binary(unary? no: binary then unary)
+            return unaries[f2](binaries[f1](a, b))
+        return binaries[f2](unaries[f1](a), b)
+    return call(_fea, x, y, _name="fused_elemwise_activation")
+
+
+def shuffle_batch(x, seed=None):
+    """ref shuffle_batch_op: random permutation along the batch dim."""
+    from ..framework import core
+    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+
+    def _sb(a):
+        perm = jax.random.permutation(key, a.shape[0])
+        return jnp.take(a, perm, axis=0)
+    return call(_sb, x, _name="shuffle_batch")
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """ref partial_concat_op: concat the [start:start+length] column slice
+    of every input."""
+    def _pc(*xs):
+        outs = []
+        for a in xs:
+            end = a.shape[1] if length < 0 else start_index + length
+            outs.append(a[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+    return call(_pc, *input, _name="partial_concat")
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """ref partial_sum_op: sum the same column slice of every input."""
+    def _ps(*xs):
+        acc = None
+        for a in xs:
+            end = a.shape[1] if length < 0 else start_index + length
+            sl = a[:, start_index:end]
+            acc = sl if acc is None else acc + sl
+        return acc
+    return call(_ps, *input, _name="partial_sum")
+
+
+def batch_fc(input, param_size, param_attr=None, bias_size=None,
+             bias_attr=None, act=None):
+    """ref batch_fc_op (CTR slot-wise FC): input [S, B, D] with per-slot
+    weights [S, D, O] — one batched einsum on the MXU."""
+    from .. import create_parameter
+    w = create_parameter(list(param_size), "float32", attr=param_attr)
+    b = create_parameter(list(bias_size), "float32", attr=bias_attr,
+                         is_bias=True) if bias_size else None
+
+    def _bfc(x, wv, *rest):
+        out = jnp.einsum("sbd,sdo->sbo", x, wv)
+        if rest:
+            out = out + rest[0]
+        return out
+    out = call(_bfc, input, w, *([b] if b is not None else []),
+               _name="batch_fc")
+    return getattr(F, act)(out) if act else out
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner="sum", param_attr=None,
+                             dtype="float32"):
+    """ref fused_embedding_seq_pool_op: embedding lookup + sequence pool in
+    one op.  Padded form: input [B, T] int ids (padding_idx rows drop out
+    of the pool); returns [B, D]."""
+    from .. import create_parameter
+    w = create_parameter([size[0], size[1]], dtype, attr=param_attr)
+
+    def _fesp(ids, wv):
+        ids_i = ids.astype(jnp.int32)
+        emb = wv[jnp.clip(ids_i, 0, wv.shape[0] - 1)]        # [B, T, D]
+        if padding_idx is not None:
+            mask = (ids_i != padding_idx)[..., None]
+            emb = emb * mask
+            denom = jnp.maximum(jnp.sum(mask, axis=1), 1)
+        else:
+            denom = ids_i.shape[1]
+        s = jnp.sum(emb, axis=1)
+        return s / denom if combiner == "avg" else s
+    return call(_fesp, input, w, _name="fused_embedding_seq_pool",
+                _nondiff=(0,))
+
+
+def fused_bn_add_act(x, y, act="relu", momentum=0.9, epsilon=1e-5,
+                     param_attr=None, bias_attr=None,
+                     moving_mean_name=None, moving_variance_name=None,
+                     name=None):
+    """ref fused_bn_add_act_op: act(batch_norm(x) + y) — a composition XLA
+    fuses; built on the static.nn batch_norm builder."""
+    from ..static import nn as snn
+    out = snn.batch_norm(x, param_attr=param_attr, bias_attr=bias_attr) + y
+    return getattr(F, act)(out) if act else out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold=0.0, nms_top_k=400,
+                    keep_top_k=100, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=False,
+                    name=None):
+    """ref multiclass_nms2_op: multiclass_nms that can also return the
+    kept rows' flat indices (fixed-shape: -1 marks padding)."""
+    from ..vision.detection import multiclass_nms
+    out = multiclass_nms(bboxes, scores, score_threshold=score_threshold,
+                         nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                         nms_threshold=nms_threshold,
+                         background_label=background_label)
+    if not return_index:
+        return out
+
+    def _match(o, bb):
+        # recover each kept row's box index by matching coordinates
+        eq = jnp.all(jnp.abs(o[..., None, 2:6] - bb[:, None]) < 1e-6, -1)
+        idx = jnp.argmax(eq, -1)
+        valid = o[..., 0] >= 0
+        return jnp.where(valid, idx, -1)
+    index = call(_match, out, bboxes, _name="nms2_index",
+                 _nondiff=(0, 1))
+    return out, index
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", **kw):
+    from ..static.nn import sparse_embedding as _se
+    return _se(input, size, padding_idx=padding_idx,
+               param_attr=param_attr, dtype=dtype)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Builder spelling of dygraph TreeConv (ref contrib tree_conv)."""
+    from .dygraph import TreeConv
+    layer = TreeConv(int(nodes_vector.shape[-1]), output_size,
+                     num_filters=num_filters, max_depth=max_depth, act=act,
+                     param_attr=param_attr, bias_attr=bias_attr)
+    return layer(nodes_vector, edge_set)

@@ -313,3 +313,51 @@ class TestSmallOps:
         sc = fl.sigmoid_cross_entropy_with_logits(
             x, paddle.to_tensor(np.array([[1.0, 0.0]], np.float32)))
         assert (sc.numpy() >= 0).all()
+
+
+class TestContribLayers:
+    def test_fused_elemwise_activation(self):
+        cl = paddle.fluid.contrib.layers
+        x = paddle.to_tensor(np.array([[1., -2.]], np.float32))
+        y = paddle.to_tensor(np.ones((1, 2), np.float32))
+        out = cl.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"])
+        np.testing.assert_allclose(out.numpy(), [[2., 0.]])
+
+    def test_shuffle_partial_batchfc(self):
+        cl = paddle.fluid.contrib.layers
+        sb = cl.shuffle_batch(
+            paddle.to_tensor(np.arange(8.).reshape(4, 2)), seed=3)
+        assert sorted(sb.numpy()[:, 0].tolist()) == [0., 2., 4., 6.]
+        a = paddle.to_tensor(np.arange(6.).reshape(2, 3).astype("float32"))
+        b = paddle.to_tensor(
+            (np.arange(6.).reshape(2, 3) + 10).astype("float32"))
+        assert cl.partial_concat([a, b], 1, 2).shape == [2, 4]
+        np.testing.assert_allclose(
+            cl.partial_sum([a, b], 0, 2).numpy(),
+            a.numpy()[:, :2] + b.numpy()[:, :2])
+        assert cl.batch_fc(
+            paddle.to_tensor(np.ones((3, 2, 4), np.float32)),
+            [3, 4, 5], bias_size=[3, 1, 5]).shape == [3, 2, 5]
+
+    def test_fused_embedding_seq_pool(self):
+        cl = paddle.fluid.contrib.layers
+        ids = paddle.to_tensor(np.array([[1, 2, 0], [3, 0, 0]], np.int64))
+        emb_sum = cl.fused_embedding_seq_pool(ids, [10, 6], padding_idx=0)
+        assert emb_sum.shape == [2, 6]
+        emb_avg = cl.fused_embedding_seq_pool(ids, [10, 6], padding_idx=0,
+                                              combiner="avg")
+        assert np.isfinite(emb_avg.numpy()).all()
+
+    def test_multiclass_nms2_index(self):
+        cl = paddle.fluid.contrib.layers
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        rows, idx = cl.multiclass_nms2(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, keep_top_k=5, nms_threshold=0.5,
+            return_index=True)
+        v = idx.numpy()[0][rows.numpy()[0, :, 0] >= 0]
+        assert set(v.tolist()) == {0, 2}
