@@ -1,8 +1,9 @@
 """fluid.contrib.layers — the PS/CTR-era fused op subset with TPU-native
-equivalents (ref: python/paddle/fluid/contrib/layers/nn.py).  Excluded:
-the parameter-server tree-retrieval internals (tdm_*, search_pyramid_hash,
-_pull_box_extended_sparse) and research exotica (bilateral_slice,
-correlation) — no TPU-meaningful contract."""
+equivalents (ref: python/paddle/fluid/contrib/layers/nn.py), incl. the
+FlowNet correlation cost volume and the pyramid text-matching ops.
+Excluded: the parameter-server tree-retrieval internals (tdm_*,
+search_pyramid_hash, _pull_box_extended_sparse) and bilateral_slice/
+var_conv_2d — no TPU-meaningful contract."""
 from __future__ import annotations
 
 import jax
@@ -166,3 +167,96 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
                      num_filters=num_filters, max_depth=max_depth, act=act,
                      param_attr=param_attr, bias_attr=bias_attr)
     return layer(nodes_vector, edge_set)
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """ref correlation_op (FlowNet cost volume): for each spatial position,
+    mean dot product between x's patch and y's patch at every displacement
+    in a (2d+1)^2 window.  Output [B, (2d+1)^2, H, W].  Pure shifted
+    elementwise products + channel mean — XLA fuses the window loop."""
+    assert kernel_size == 1, "kernel_size>1 not supported (FlowNet uses 1)"
+    d = max_displacement // stride2
+
+    def _corr(a, b):
+        B, C, H, W = a.shape
+        pad = pad_size
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        outs = []
+        for dy in range(-d, d + 1):
+            for dx in range(-d, d + 1):
+                oy = pad + dy * stride2
+                ox = pad + dx * stride2
+                shifted = jax.lax.dynamic_slice(
+                    bp, (0, 0, oy, ox), (B, C, H, W))
+                outs.append(jnp.mean(a * shifted, axis=1))
+        out = jnp.stack(outs, 1)                   # [B, (2d+1)^2, H, W]
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+    return call(_corr, x, y, _name="correlation")
+
+
+def match_matrix_tensor(x, y, channel_num, param_attr=None,
+                        dtype="float32", act=None, x_lengths=None,
+                        y_lengths=None):
+    """ref match_matrix_tensor_op (pyramid text matching): bilinear match
+    matrix m[b, c, i, j] = x_i^T W_c y_j.  Padded form: x [B, Lx, D],
+    y [B, Ly, D] (+ optional lengths masking)."""
+    from .. import create_parameter
+    D = int(x.shape[-1])
+    Dy = int(y.shape[-1])
+    w = create_parameter([D, channel_num, Dy], dtype, attr=param_attr)
+
+    def _mm(xv, yv, wv, *lens):
+        m = jnp.einsum("bid,dce,bje->bcij", xv, wv, yv)
+        if lens:
+            lx = lens[0].reshape(-1).astype(jnp.int32)
+            mask_x = (jnp.arange(xv.shape[1])[None, :]
+                      < lx[:, None])[:, None, :, None]
+            m = m * mask_x
+            if len(lens) > 1:
+                ly = lens[1].reshape(-1).astype(jnp.int32)
+                mask_y = (jnp.arange(yv.shape[1])[None, :]
+                          < ly[:, None])[:, None, None, :]
+                m = m * mask_y
+        return m
+    args = [x, y, w] + [l for l in (x_lengths, y_lengths) if l is not None]
+    out = call(_mm, *args, _name="match_matrix_tensor",
+               _nondiff=tuple(range(3, len(args))))
+    return getattr(F, act)(out) if act else out
+
+
+def sequence_topk_avg_pooling(input, row_lengths, col_lengths, topks,
+                              channel_num):
+    """ref sequence_topk_avg_pooling_op: over a match matrix
+    [B, C, Lx, Ly], for each row i average its top-k column values, for
+    every k in ``topks``.  Padded+masked form (col_lengths masks the
+    column tail).  Returns [B, Lx, C * len(topks)]."""
+    ks = [int(k) for k in topks]
+    kmax = max(ks)
+
+    def _tap(m, rl, cl):
+        B, C, Lx, Ly = m.shape
+        cmask = (jnp.arange(Ly)[None, :]
+                 < cl.reshape(-1, 1).astype(jnp.int32))  # [B, Ly]
+        neg = jnp.where(cmask[:, None, None, :], m, -jnp.inf)
+        top = jax.lax.top_k(neg, min(kmax, Ly))[0]       # [B,C,Lx,kmax]
+        top = jnp.where(jnp.isfinite(top), top, 0.0)
+        ncols = jnp.sum(cmask, -1)[:, None, None]        # [B,1,1]
+        outs = []
+        for k in ks:
+            avail = jnp.minimum(ncols, k)
+            s = jnp.sum(top[..., :k], -1)
+            outs.append(s / jnp.maximum(avail, 1))
+        out = jnp.stack(outs, -1)                        # [B,C,Lx,K]
+        rmask = (jnp.arange(Lx)[None, :]
+                 < rl.reshape(-1, 1).astype(jnp.int32))  # [B, Lx]
+        out = out * rmask[:, None, :, None]
+        return out.transpose(0, 2, 1, 3).reshape(B, Lx, -1)
+    return call(_tap, input, row_lengths, col_lengths,
+                _name="sequence_topk_avg_pooling", _nondiff=(1, 2))
+
+
+__all__ += ["correlation", "match_matrix_tensor",
+            "sequence_topk_avg_pooling"]

@@ -361,3 +361,44 @@ class TestContribLayers:
             return_index=True)
         v = idx.numpy()[0][rows.numpy()[0, :, 0] >= 0]
         assert set(v.tolist()) == {0, 2}
+
+    def test_correlation_vs_naive(self):
+        cl = paddle.fluid.contrib.layers
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 6, 6).astype("float32")
+        y = rng.randn(1, 3, 6, 6).astype("float32")
+        pad = 2
+        out = cl.correlation(paddle.to_tensor(x), paddle.to_tensor(y),
+                             pad_size=pad, kernel_size=1,
+                             max_displacement=2, stride1=1,
+                             stride2=1).numpy()
+        yp = np.pad(y, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        naive = np.zeros((1, 25, 6, 6), np.float32)
+        i = 0
+        for dy in range(-2, 3):
+            for dx in range(-2, 3):
+                sh = yp[:, :, pad + dy:pad + dy + 6, pad + dx:pad + dx + 6]
+                naive[:, i] = (x * sh).mean(1)
+                i += 1
+        np.testing.assert_allclose(out, naive, atol=1e-5)
+
+    def test_match_matrix_and_topk_pool(self):
+        cl = paddle.fluid.contrib.layers
+        rng = np.random.RandomState(1)
+        mm = cl.match_matrix_tensor(
+            paddle.to_tensor(rng.randn(2, 4, 8).astype("float32")),
+            paddle.to_tensor(rng.randn(2, 5, 8).astype("float32")), 3,
+            x_lengths=paddle.to_tensor(np.array([4, 2])),
+            y_lengths=paddle.to_tensor(np.array([5, 3])))
+        assert mm.shape == [2, 3, 4, 5]
+        assert abs(mm.numpy()[1, :, 2:, :]).sum() == 0
+        tap = cl.sequence_topk_avg_pooling(
+            mm, paddle.to_tensor(np.array([4, 2])),
+            paddle.to_tensor(np.array([5, 3])), topks=[1, 3],
+            channel_num=3)
+        assert tap.shape == [2, 4, 6]
+        assert np.isfinite(tap.numpy()).all()
+        # top-1 equals the max over valid columns
+        m0 = mm.numpy()[0, 0, 0, :5]
+        np.testing.assert_allclose(tap.numpy()[0, 0, 0], m0.max(),
+                                   atol=1e-5)
