@@ -23,9 +23,19 @@ def _all_reduce(arr, op="sum"):
     from ...tensor.tensor import Tensor
     if get_world_size() <= 1:
         return arr
-    t = Tensor(arr.astype(np.float32))
     red = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
            "min": C.ReduceOp.MIN}[op]
+    if op == "sum":
+        # devices only carry f32 (x64 off): reduce a (hi, lo) float split
+        # so counts beyond 2^24 (routine for CTR accumulators) stay exact
+        hi = arr.astype(np.float32)
+        lo = (arr - hi.astype(np.float64)).astype(np.float32)
+        th, tl = Tensor(hi), Tensor(lo)
+        C.all_reduce(th, op=red)
+        C.all_reduce(tl, op=red)
+        return (np.asarray(th.numpy(), np.float64)
+                + np.asarray(tl.numpy(), np.float64))
+    t = Tensor(arr.astype(np.float32))
     C.all_reduce(t, op=red)
     return np.asarray(t.numpy(), np.float64)
 

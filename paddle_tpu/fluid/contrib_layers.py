@@ -31,16 +31,20 @@ def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
     f1, f2 = functor_list
 
     def _fea(a, b):
-        if f1 in binaries:            # binary(unary? no: binary then unary)
-            return unaries[f2](binaries[f1](a, b))
-        return binaries[f2](unaries[f1](a), b)
+        # reference order: functor_list[0] is the OUTER functor —
+        # ['elementwise_add', 'scale'] == add(x, scale(y));
+        # ['scale', 'elementwise_add'] == scale(add(x, y))
+        if f1 in binaries:
+            return binaries[f1](a, unaries[f2](b))
+        return unaries[f1](binaries[f2](a, b))
     return call(_fea, x, y, _name="fused_elemwise_activation")
 
 
 def shuffle_batch(x, seed=None):
     """ref shuffle_batch_op: random permutation along the batch dim."""
     from ..framework import core
-    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+    key = (jax.random.PRNGKey(seed) if seed is not None
+           else core.next_rng_key())
 
     def _sb(a):
         perm = jax.random.permutation(key, a.shape[0])
@@ -48,15 +52,20 @@ def shuffle_batch(x, seed=None):
     return call(_sb, x, _name="shuffle_batch")
 
 
+def _col_slice(a, start_index, length):
+    """[start : start+length] columns; negative start counts from the end
+    (reference partial_* contract)."""
+    s = start_index + a.shape[1] if start_index < 0 else start_index
+    e = a.shape[1] if length < 0 else s + length
+    return a[:, s:e]
+
+
 def partial_concat(input, start_index=0, length=-1):
     """ref partial_concat_op: concat the [start:start+length] column slice
     of every input."""
     def _pc(*xs):
-        outs = []
-        for a in xs:
-            end = a.shape[1] if length < 0 else start_index + length
-            outs.append(a[:, start_index:end])
-        return jnp.concatenate(outs, axis=1)
+        return jnp.concatenate(
+            [_col_slice(a, start_index, length) for a in xs], axis=1)
     return call(_pc, *input, _name="partial_concat")
 
 
@@ -65,8 +74,7 @@ def partial_sum(input, start_index=0, length=-1):
     def _ps(*xs):
         acc = None
         for a in xs:
-            end = a.shape[1] if length < 0 else start_index + length
-            sl = a[:, start_index:end]
+            sl = _col_slice(a, start_index, length)
             acc = sl if acc is None else acc + sl
         return acc
     return call(_ps, *input, _name="partial_sum")
@@ -122,7 +130,8 @@ def fused_bn_add_act(x, y, act="relu", momentum=0.9, epsilon=1e-5,
     """ref fused_bn_add_act_op: act(batch_norm(x) + y) — a composition XLA
     fuses; built on the static.nn batch_norm builder."""
     from ..static import nn as snn
-    out = snn.batch_norm(x, param_attr=param_attr, bias_attr=bias_attr) + y
+    out = snn.batch_norm(x, momentum=momentum, epsilon=epsilon,
+                         param_attr=param_attr, bias_attr=bias_attr) + y
     return getattr(F, act)(out) if act else out
 
 
@@ -135,7 +144,8 @@ def multiclass_nms2(bboxes, scores, score_threshold=0.0, nms_top_k=400,
     from ..vision.detection import multiclass_nms
     out = multiclass_nms(bboxes, scores, score_threshold=score_threshold,
                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
-                         nms_threshold=nms_threshold,
+                         nms_threshold=nms_threshold, normalized=normalized,
+                         nms_eta=nms_eta,
                          background_label=background_label)
     if not return_index:
         return out
@@ -176,11 +186,14 @@ def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
     in a (2d+1)^2 window.  Output [B, (2d+1)^2, H, W].  Pure shifted
     elementwise products + channel mean — XLA fuses the window loop."""
     assert kernel_size == 1, "kernel_size>1 not supported (FlowNet uses 1)"
+    assert corr_type_multiply == 1, "only multiplicative correlation"
     d = max_displacement // stride2
 
     def _corr(a, b):
         B, C, H, W = a.shape
-        pad = pad_size
+        # pad enough for the largest displacement even when the caller's
+        # pad_size understates it — slices must read ZEROS, never clamp
+        pad = max(pad_size, d * stride2)
         bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
         outs = []
         for dy in range(-d, d + 1):

@@ -320,9 +320,13 @@ class TestContribLayers:
         cl = paddle.fluid.contrib.layers
         x = paddle.to_tensor(np.array([[1., -2.]], np.float32))
         y = paddle.to_tensor(np.ones((1, 2), np.float32))
+        # reference order: functor_list[0] is OUTER
         out = cl.fused_elemwise_activation(
-            x, y, ["elementwise_add", "relu"])
-        np.testing.assert_allclose(out.numpy(), [[2., 0.]])
+            x, y, ["elementwise_add", "relu"])      # x + relu(y)
+        np.testing.assert_allclose(out.numpy(), [[2., -1.]])
+        out2 = cl.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_add"])      # relu(x + y)
+        np.testing.assert_allclose(out2.numpy(), [[2., 0.]])
 
     def test_shuffle_partial_batchfc(self):
         cl = paddle.fluid.contrib.layers
@@ -333,6 +337,13 @@ class TestContribLayers:
         b = paddle.to_tensor(
             (np.arange(6.).reshape(2, 3) + 10).astype("float32"))
         assert cl.partial_concat([a, b], 1, 2).shape == [2, 4]
+        neg = cl.partial_concat([a, b], start_index=-2, length=2)
+        np.testing.assert_allclose(
+            neg.numpy(), np.concatenate([a.numpy()[:, -2:],
+                                         b.numpy()[:, -2:]], 1))
+        s0a = cl.shuffle_batch(a, seed=0)
+        s0b = cl.shuffle_batch(a, seed=0)
+        np.testing.assert_allclose(s0a.numpy(), s0b.numpy())
         np.testing.assert_allclose(
             cl.partial_sum([a, b], 0, 2).numpy(),
             a.numpy()[:, :2] + b.numpy()[:, :2])
