@@ -1,9 +1,9 @@
-"""fluid.contrib.layers — the PS/CTR-era fused op subset with TPU-native
-equivalents (ref: python/paddle/fluid/contrib/layers/nn.py), incl. the
-FlowNet correlation cost volume and the pyramid text-matching ops.
-Excluded: the parameter-server tree-retrieval internals (tdm_*,
-search_pyramid_hash, _pull_box_extended_sparse) and bilateral_slice/
-var_conv_2d — no TPU-meaningful contract."""
+"""fluid.contrib.layers — the contrib op set with TPU-native equivalents
+(ref: python/paddle/fluid/contrib/layers/nn.py): the CTR fused ops, the
+FlowNet correlation cost volume, HDRNet bilateral_slice, pyramid
+text-matching, and padded var_conv_2d.  Excluded: only the
+parameter-server tree-retrieval internals (tdm_*, search_pyramid_hash,
+_pull_box_extended_sparse) whose contract is the PS runtime itself."""
 from __future__ import annotations
 
 import jax
@@ -273,3 +273,96 @@ def sequence_topk_avg_pooling(input, row_lengths, col_lengths, topks,
 
 __all__ += ["correlation", "match_matrix_tensor",
             "sequence_topk_avg_pooling"]
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """ref bilateral_slice_op (HDRNet, Gharbi et al. 2017): trilinearly
+    slice a bilateral grid of affine coefficients at (x, y, guide(x,y))
+    and apply them to the input image.
+
+    x [B, C, H, W]; guide [B, H, W] in [0, 1]; grid
+    [B, coeff, GD, GH, GW] with coeff = C*(C+1) when has_offset else C*C.
+    Returns [B, C, H, W]."""
+    def _bs(img, gd, gr):
+        B, C, H, W = img.shape
+        _, n_coeff, GD, GH, GW = gr.shape
+        # sample positions (grid-cell centers convention)
+        gx = (jnp.arange(W, dtype=jnp.float32) + 0.5) / W * GW - 0.5
+        gy = (jnp.arange(H, dtype=jnp.float32) + 0.5) / H * GH - 0.5
+        gz = gd * GD - 0.5                               # [B, H, W]
+
+        x0 = jnp.floor(gx).astype(jnp.int32)             # [W]
+        y0 = jnp.floor(gy).astype(jnp.int32)             # [H]
+        z0 = jnp.floor(gz).astype(jnp.int32)             # [B, H, W]
+        fx = (gx - x0)[None, None, :]                    # [1, 1, W]
+        fy = (gy - y0)[None, :, None]                    # [1, H, 1]
+        fz = gz - z0                                     # [B, H, W]
+
+        def take(zc, yc, xc):
+            # gr: [B, coeff, GD, GH, GW] -> gather [B, coeff, H, W]
+            zc = jnp.clip(zc, 0, GD - 1)                 # [B, H, W]
+            yc = jnp.clip(yc, 0, GH - 1)                 # [H]
+            xc = jnp.clip(xc, 0, GW - 1)                 # [W]
+            g1 = gr[:, :, :, yc][:, :, :, :, xc]         # [B,coeff,GD,H,W]
+            return jnp.take_along_axis(
+                g1, zc[:, None, None], axis=2)[:, :, 0]  # [B, coeff, H, W]
+
+        out = 0.0
+        for dz in (0, 1):
+            wz = (1 - fz) if dz == 0 else fz             # [B, H, W]
+            for dy in (0, 1):
+                wy = (1 - fy) if dy == 0 else fy
+                for dx in (0, 1):
+                    wx = (1 - fx) if dx == 0 else fx
+                    w = (wz[:, None] * wy[None] * wx[None])
+                    out = out + w * take(z0 + dz, y0 + dy, x0 + dx)
+        coeffs = out                                     # [B, coeff, H, W]
+        per = C + 1 if has_offset else C
+        res = []
+        for c in range(C):
+            acc = 0.0
+            for i in range(C):
+                acc = acc + coeffs[:, c * per + i] * img[:, i]
+            if has_offset:
+                acc = acc + coeffs[:, c * per + C]
+            res.append(acc)
+        return jnp.stack(res, 1)
+    return call(_bs, x, guide, grid, _name="bilateral_slice")
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """ref var_conv_2d_op (ragged per-sample image sizes from LoD row/col
+    offsets): padded+masked form — input [B, Cin, H, W] with per-sample
+    valid heights ``row`` and widths ``col``; convolution runs dense and
+    positions outside each sample's valid region are zeroed (in AND out,
+    so invalid pixels neither contribute nor appear)."""
+    from ..static import nn as snn
+
+    def _mask(a, r, c):
+        H, W = a.shape[-2:]
+        rm = (jnp.arange(H)[None, :] < r.reshape(-1, 1).astype(jnp.int32))
+        cm = (jnp.arange(W)[None, :] < c.reshape(-1, 1).astype(jnp.int32))
+        return a * (rm[:, None, :, None] & cm[:, None, None, :])
+    masked = call(_mask, input, row, col, _name="var_conv_mask",
+                  _nondiff=(1, 2))
+    out = snn.conv2d(masked, output_channel, filter_size, stride=stride,
+                     padding=(filter_size - 1) // 2, param_attr=param_attr,
+                     act=act)
+
+    def _remask(a, r, c):
+        s = stride
+        H, W = a.shape[-2:]
+        ro = (r.astype(jnp.float32) / s).astype(jnp.int32)
+        co = (c.astype(jnp.float32) / s).astype(jnp.int32)
+        rm = (jnp.arange(H)[None, :]
+              < jnp.maximum(ro, 1).reshape(-1, 1))
+        cm = (jnp.arange(W)[None, :]
+              < jnp.maximum(co, 1).reshape(-1, 1))
+        return a * (rm[:, None, :, None] & cm[:, None, None, :])
+    return call(_remask, out, row, col, _name="var_conv_remask",
+                _nondiff=(1, 2))
+
+
+__all__ += ["bilateral_slice", "var_conv_2d"]

@@ -413,3 +413,36 @@ class TestContribLayers:
         m0 = mm.numpy()[0, 0, 0, :5]
         np.testing.assert_allclose(tap.numpy()[0, 0, 0], m0.max(),
                                    atol=1e-5)
+
+    def test_bilateral_slice_identity_and_offset(self):
+        cl = paddle.fluid.contrib.layers
+        B, C, H, W = 1, 3, 8, 8
+        GD, GH, GW = 4, 4, 4
+        per = C + 1
+        grid = np.zeros((B, C * per, GD, GH, GW), np.float32)
+        for c in range(C):
+            grid[:, c * per + c] = 1.0       # identity affine, no offset
+        x = np.random.RandomState(0).rand(B, C, H, W).astype("float32")
+        guide = np.random.RandomState(1).rand(B, H, W).astype("float32")
+        out = cl.bilateral_slice(paddle.to_tensor(x),
+                                 paddle.to_tensor(guide),
+                                 paddle.to_tensor(grid), has_offset=True)
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+        grid2 = np.zeros_like(grid)
+        grid2[:, [per - 1, 2 * per - 1, 3 * per - 1]] = 2.0
+        out2 = cl.bilateral_slice(paddle.to_tensor(x),
+                                  paddle.to_tensor(guide),
+                                  paddle.to_tensor(grid2), has_offset=True)
+        np.testing.assert_allclose(out2.numpy(), 2.0, atol=1e-5)
+
+    def test_var_conv_2d_masks_invalid_regions(self):
+        cl = paddle.fluid.contrib.layers
+        out = cl.var_conv_2d(
+            paddle.to_tensor(np.ones((2, 1, 6, 6), np.float32)),
+            paddle.to_tensor(np.array([6, 3])),
+            paddle.to_tensor(np.array([6, 2])), 1, 4, 3)
+        v = out.numpy()
+        assert v.shape == (2, 4, 6, 6)
+        assert np.abs(v[1, :, 3:, :]).sum() == 0
+        assert np.abs(v[1, :, :, 2:]).sum() == 0
+        assert np.abs(v[0]).sum() > 0
