@@ -13,3 +13,4 @@ from . import sharding
 def launch():
     from .launch import main
     main()
+from . import utils  # noqa: E402

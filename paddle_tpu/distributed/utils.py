@@ -1,0 +1,244 @@
+"""paddle.distributed.utils — launcher plumbing (ref:
+python/paddle/distributed/utils.py: the Cluster/Pod/Trainer descriptors
+and local process management the reference launch.py builds on).  The
+TPU-native launcher (distributed/launch.py) bootstraps jax.distributed
+instead of NCCL; these helpers keep the reference surface for scripts
+that orchestrate their own pods."""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import time
+
+__all__ = ["Cluster", "Pod", "Trainer", "TrainerProc", "get_cluster",
+           "get_host_name_ip", "find_free_ports", "get_logger",
+           "add_arguments", "start_local_trainers",
+           "terminate_local_procs", "watch_local_trainers",
+           "pull_worker_log", "Hdfs", "JobServer"]
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []          # accelerator ordinals (TPU chips here)
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return f"Trainer(rank={self.rank}, endpoint={self.endpoint})"
+
+    def __eq__(self, other):
+        return (self.rank == other.rank and self.endpoint == other.endpoint
+                and self.gpus == other.gpus)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+
+class Pod:
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+
+    def __str__(self):
+        return (f"Pod(rank={self.rank}, addr={self.addr}, "
+                f"trainers={len(self.trainers)})")
+
+    def rank_of_trainer(self, t):
+        return self.trainers.index(t)
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def world_device_ids(self):
+        return [t.gpus for p in self.pods for t in p.trainers]
+
+    def __str__(self):
+        return f"Cluster(pods={len(self.pods)})"
+
+
+class Hdfs:
+    """Placeholder descriptor (the reference attaches HDFS checkpoint
+    locations to the cluster; no HDFS client exists in this image)."""
+
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return bool(self.hdfs_ugi and self.hdfs_name and self.hdfs_path)
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_ids):
+    """Build the Cluster/Pod/Trainer descriptor tree (reference layout:
+    one pod per node, one trainer per device group)."""
+    cluster = Cluster()
+    per_node = len(device_ids)
+    rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        eps = (trainer_endpoints[node_rank]
+               if trainer_endpoints and isinstance(trainer_endpoints[0],
+                                                   (list, tuple))
+               else trainer_endpoints[node_rank * per_node:
+                                      (node_rank + 1) * per_node])
+        for i, dev in enumerate(device_ids):
+            t = Trainer()
+            t.gpus = list(dev) if isinstance(dev, (list, tuple)) else [dev]
+            t.endpoint = eps[i] if i < len(eps) else None
+            t.rank = rank
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    return cluster, cluster.pods[node_ips.index(node_ip)]
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """num distinct currently-free TCP ports."""
+    ports = set()
+    socks = []
+    try:
+        while len(ports) < num:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("", 0))
+            socks.append(s)         # hold open so ports stay distinct
+            ports.add(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(levelname)s %(asctime)s %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """ref utils.add_arguments — argparse helper used by launch scripts."""
+    argparser.add_argument("--" + argname, default=default, type=type,
+                           help=help + f" Default: {default}.", **kwargs)
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = 0
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None, envs=None):
+    """Spawn one process per trainer in this pod with the reference's env
+    contract (PADDLE_TRAINER_ID / ENDPOINTS), jax.distributed-ready."""
+    procs = []
+    world = cluster.trainers_endpoints()
+    for idx, t in enumerate(pod.trainers):
+        env = dict(os.environ)
+        env.update(envs or {})
+        env.update({
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_CURRENT_ENDPOINT": str(t.endpoint),
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(map(str, world)),
+        })
+        cmd = ["python", training_script] + list(training_script_args)
+        tp = TrainerProc()
+        tp.rank = t.rank
+        tp.local_rank = idx
+        tp.cmd = cmd
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            tp.log_fn = open(os.path.join(log_dir,
+                                          f"workerlog.{idx}"), "w")
+            tp.proc = subprocess.Popen(cmd, env=env, stdout=tp.log_fn,
+                                       stderr=subprocess.STDOUT)
+        else:
+            tp.proc = subprocess.Popen(cmd, env=env)
+        procs.append(tp)
+    return procs
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll; returns still-alive procs, raising if any died nonzero."""
+    alive = []
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            raise RuntimeError(
+                f"trainer rank {tp.rank} exited with code {ret} "
+                f"(cmd: {' '.join(tp.cmd)})")
+    return alive
+
+
+def terminate_local_procs(procs):
+    for tp in procs:
+        if tp.proc is not None and tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + 5
+    for tp in procs:
+        if tp.proc is None:
+            continue
+        try:
+            tp.proc.wait(timeout=max(deadline - time.time(), 0.1))
+        except subprocess.TimeoutExpired:
+            tp.proc.send_signal(signal.SIGKILL)
+        if tp.log_fn:
+            tp.log_fn.close()
+
+
+def pull_worker_log(tp):
+    if not tp.log_fn:
+        return
+    with open(tp.log_fn.name) as f:
+        f.seek(tp.log_offset)
+        data = f.read()
+        tp.log_offset = f.tell()
+    if data:
+        print(data, end="")

@@ -156,3 +156,62 @@ def test_fleet_strategy_gradient_merge_wraps():
                              parameters=lin.parameters()))
     assert isinstance(opt, GradientMergeOptimizer)
     assert opt._k == 4
+
+
+class TestDistributedUtils:
+    def test_cluster_descriptors(self):
+        from paddle_tpu.distributed import utils as U
+        cluster, pod = U.get_cluster(
+            ["10.0.0.1", "10.0.0.2"], "10.0.0.2",
+            ["10.0.0.1:6170", "10.0.0.1:6171",
+             "10.0.0.2:6170", "10.0.0.2:6171"], [0, 1])
+        assert cluster.trainers_nranks() == 4
+        assert pod.rank == 1
+        assert pod.trainers[0].rank == 2
+        assert cluster.trainers_endpoints()[3] == "10.0.0.2:6171"
+
+    def test_free_ports_and_host(self):
+        from paddle_tpu.distributed import utils as U
+        ports = U.find_free_ports(3)
+        assert len(ports) == 3 and all(1024 < p < 65536 for p in ports)
+        assert U.get_host_name_ip() is None or \
+            len(U.get_host_name_ip()) == 2
+
+    def test_start_watch_terminate_local(self, tmp_path):
+        import sys
+        from paddle_tpu.distributed import utils as U
+        script = tmp_path / "w.py"
+        script.write_text("import os, sys\n"
+                          "print('rank', os.environ['PADDLE_TRAINER_ID'])\n")
+        cluster, pod = U.get_cluster(["127.0.0.1"], "127.0.0.1",
+                                     ["127.0.0.1:6200", "127.0.0.1:6201"],
+                                     [0, 1])
+        procs = U.start_local_trainers(cluster, pod, str(script), [],
+                                       log_dir=str(tmp_path))
+        import time
+        deadline = time.time() + 30
+        while procs and time.time() < deadline:
+            procs = U.watch_local_trainers(procs, 2)
+            time.sleep(0.2)
+        assert not procs
+        logs = sorted(p.name for p in tmp_path.glob("workerlog.*"))
+        assert len(logs) == 2
+        assert "rank 0" in open(logs[0]).read()
+
+    def test_failed_trainer_raises(self, tmp_path):
+        from paddle_tpu.distributed import utils as U
+        import pytest, time
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        cluster, pod = U.get_cluster(["127.0.0.1"], "127.0.0.1",
+                                     ["127.0.0.1:6300"], [0])
+        procs = U.start_local_trainers(cluster, pod, str(script), [],
+                                       log_dir=str(tmp_path))
+        with pytest.raises(RuntimeError, match="exited with code 3"):
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                procs = U.watch_local_trainers(procs, 1)
+                if not procs:
+                    break
+                time.sleep(0.2)
+        U.terminate_local_procs(procs)
