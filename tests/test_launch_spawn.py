@@ -194,7 +194,7 @@ class TestDistributedUtils:
             procs = U.watch_local_trainers(procs, 2)
             time.sleep(0.2)
         assert not procs
-        logs = sorted(p.name for p in tmp_path.glob("workerlog.*"))
+        logs = sorted(str(p) for p in tmp_path.glob("workerlog.*"))
         assert len(logs) == 2
         assert "rank 0" in open(logs[0]).read()
 
