@@ -186,7 +186,8 @@ def start_local_trainers(cluster, pod, training_script,
             "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
             "PADDLE_TRAINER_ENDPOINTS": ",".join(map(str, world)),
         })
-        cmd = ["python", training_script] + list(training_script_args)
+        import sys
+        cmd = [sys.executable, training_script] + list(training_script_args)
         tp = TrainerProc()
         tp.rank = t.rank
         tp.local_rank = idx
@@ -204,16 +205,26 @@ def start_local_trainers(cluster, pod, training_script,
 
 
 def watch_local_trainers(procs, nranks):
-    """Poll; returns still-alive procs, raising if any died nonzero."""
+    """Poll; returns still-alive procs.  A nonzero exit terminates the
+    sibling trainers and closes every log before raising — the caller
+    never inherits orphans from a failed pod."""
     alive = []
+    failed = None
     for tp in procs:
         ret = tp.proc.poll()
         if ret is None:
             alive.append(tp)
-        elif ret != 0:
-            raise RuntimeError(
-                f"trainer rank {tp.rank} exited with code {ret} "
-                f"(cmd: {' '.join(tp.cmd)})")
+            continue
+        if tp.log_fn and not tp.log_fn.closed:
+            tp.log_fn.close()
+        if ret != 0 and failed is None:
+            failed = (tp, ret)
+    if failed is not None:
+        tp, ret = failed
+        terminate_local_procs(alive)
+        raise RuntimeError(
+            f"trainer rank {tp.rank} exited with code {ret} "
+            f"(cmd: {' '.join(tp.cmd)})")
     return alive
 
 
@@ -229,7 +240,8 @@ def terminate_local_procs(procs):
             tp.proc.wait(timeout=max(deadline - time.time(), 0.1))
         except subprocess.TimeoutExpired:
             tp.proc.send_signal(signal.SIGKILL)
-        if tp.log_fn:
+            tp.proc.wait()          # reap — no zombies for the supervisor
+        if tp.log_fn and not tp.log_fn.closed:
             tp.log_fn.close()
 
 

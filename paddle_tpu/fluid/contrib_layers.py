@@ -354,8 +354,10 @@ def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
     def _remask(a, r, c):
         s = stride
         H, W = a.shape[-2:]
-        ro = (r.astype(jnp.float32) / s).astype(jnp.int32)
-        co = (c.astype(jnp.float32) / s).astype(jnp.int32)
+        # ceil division, reference (row - 1) // stride + 1: a valid size
+        # not divisible by the stride still owns its last output row/col
+        ro = (r.astype(jnp.int32) - 1) // s + 1
+        co = (c.astype(jnp.int32) - 1) // s + 1
         rm = (jnp.arange(H)[None, :]
               < jnp.maximum(ro, 1).reshape(-1, 1))
         cm = (jnp.arange(W)[None, :]

@@ -446,3 +446,14 @@ class TestContribLayers:
         assert np.abs(v[1, :, 3:, :]).sum() == 0
         assert np.abs(v[1, :, :, 2:]).sum() == 0
         assert np.abs(v[0]).sum() > 0
+
+    def test_var_conv_2d_ceil_stride_mask(self):
+        # valid size 5 with stride 2 owns ceil(5/2)=3 output rows
+        cl = paddle.fluid.contrib.layers
+        out = cl.var_conv_2d(
+            paddle.to_tensor(np.ones((1, 1, 6, 6), np.float32)),
+            paddle.to_tensor(np.array([5])),
+            paddle.to_tensor(np.array([5])), 1, 2, 3, stride=2)
+        v = out.numpy()
+        assert np.abs(v[0, :, 2, :]).sum() > 0      # 3rd output row kept
+        assert np.abs(v[0, :, 3:, :]).sum() == 0
