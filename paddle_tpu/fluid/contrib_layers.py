@@ -1,9 +1,10 @@
 """fluid.contrib.layers — the contrib op set with TPU-native equivalents
 (ref: python/paddle/fluid/contrib/layers/nn.py): the CTR fused ops, the
 FlowNet correlation cost volume, HDRNet bilateral_slice, pyramid
-text-matching, and padded var_conv_2d.  Excluded: only the
-parameter-server tree-retrieval internals (tdm_*, search_pyramid_hash,
-_pull_box_extended_sparse) whose contract is the PS runtime itself."""
+text-matching, padded var_conv_2d, and the tree-based-deep-match table
+ops (tdm_child/tdm_sampler as pure gathers + per-layer sampling).
+Excluded: only search_pyramid_hash and _pull_box_extended_sparse, whose
+contract is the parameter-server hash-embedding runtime itself."""
 from __future__ import annotations
 
 import jax
@@ -368,3 +369,96 @@ def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
 
 
 __all__ += ["bilateral_slice", "var_conv_2d"]
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32",
+              tree_info=None):
+    """ref tdm_child_op (tree-based deep match): for each input node id,
+    gather its ``child_nums`` children ids from the tree-info table and a
+    leaf mask.  tree_info rows: [layer, parent, child_0..child_k] with 0
+    meaning "no child" (node 0 is the conventional padding).  Pure gather.
+
+    Accepts the table either as ``tree_info`` (array/Tensor) or via
+    ``param_attr`` initializer, reference-style."""
+    from ..framework import core
+    from ..tensor.tensor import Tensor
+    import numpy as np
+    if tree_info is None:
+        raise ValueError("pass tree_info=[node_nums, 3+child_nums] table")
+    info = (tree_info if isinstance(tree_info, Tensor)
+            else Tensor(np.asarray(tree_info)))
+    dt = core.convert_dtype(dtype)
+
+    def _tc(ids, tbl):
+        ids_i = ids.astype(jnp.int32)
+        rows = tbl[jnp.clip(ids_i, 0, tbl.shape[0] - 1)]
+        child = rows[..., 2:2 + child_nums].astype(dt)
+        leaf_mask = (jnp.sum(child != 0, axis=-1, keepdims=True) == 0
+                     ).astype(dt)
+        return child, leaf_mask
+    return call(_tc, x, info, _name="tdm_child", _nondiff=(0, 1))
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_travel=None, tree_layer=None, dtype="int32"):
+    """ref tdm_sampler_op: for each leaf's root-to-leaf travel path, emit
+    the positive node per layer plus N uniformly sampled negatives from
+    the same layer (excluding the positive).  travel [leaf_num, n_layer]
+    node ids; layer table = flat node ids + per-layer counts.
+
+    Returns (out, labels) — [B, n_layer, 1+neg] ids and {1,0} labels —
+    or per-layer lists when output_list (reference default)."""
+    from ..framework import core
+    from ..tensor.tensor import Tensor
+    import numpy as np
+    if tree_travel is None or tree_layer is None:
+        raise ValueError("pass tree_travel and tree_layer tables")
+    travel = (tree_travel if isinstance(tree_travel, Tensor)
+              else Tensor(np.asarray(tree_travel)))
+    layers_flat = np.concatenate(
+        [np.asarray(l).reshape(-1) for l in tree_layer]) \
+        if isinstance(tree_layer, (list, tuple)) \
+        else np.asarray(tree_layer.numpy()
+                        if isinstance(tree_layer, Tensor) else tree_layer)
+    starts = np.cumsum([0] + list(layer_node_num_list))[:-1]
+    key0 = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
+    n_layer = len(layer_node_num_list)
+    dt = core.convert_dtype(dtype)
+    lf = jnp.asarray(layers_flat)
+
+    def _ts(ids, trv):
+        ids_i = ids.reshape(-1).astype(jnp.int32)
+        path = trv[jnp.clip(ids_i, 0, trv.shape[0] - 1)]   # [B, n_layer]
+        outs, labs = [], []
+        for li in range(n_layer):
+            pos = path[:, li].astype(jnp.int32)            # [B]
+            k = neg_samples_num_list[li]
+            cnt = layer_node_num_list[li]
+            key = jax.random.fold_in(key0, li)
+            # sample k negatives per row, resample-shift collisions with
+            # the positive (uniform over the remaining cnt-1 nodes)
+            u = jax.random.randint(key, (pos.shape[0], k), 0, cnt - 1)
+            layer_ids = lf[starts[li] + u]
+            pos_b = pos[:, None]
+            shifted = lf[starts[li] + (u + 1) % cnt]
+            negs = jnp.where(layer_ids == pos_b, shifted, layer_ids)
+            row = jnp.concatenate(
+                [pos_b, negs.astype(jnp.int32)], -1) if output_positive \
+                else negs.astype(jnp.int32)
+            lab = jnp.concatenate(
+                [jnp.ones_like(pos_b), jnp.zeros_like(negs)], -1) \
+                if output_positive else jnp.zeros_like(negs)
+            outs.append(row.astype(dt))
+            labs.append(lab.astype(dt))
+        return tuple(outs) + tuple(labs)
+    res = call(_ts, x, travel, _name="tdm_sampler", _nondiff=(0, 1))
+    outs, labs = list(res[:n_layer]), list(res[n_layer:])
+    if output_list:
+        return outs, labs
+    from ..tensor.manipulation import stack
+    return stack(outs, 1), stack(labs, 1)
+
+
+__all__ += ["tdm_child", "tdm_sampler"]

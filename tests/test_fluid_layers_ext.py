@@ -457,3 +457,24 @@ class TestContribLayers:
         v = out.numpy()
         assert np.abs(v[0, :, 2, :]).sum() > 0      # 3rd output row kept
         assert np.abs(v[0, :, 3:, :]).sum() == 0
+
+    def test_tdm_child_and_sampler(self):
+        cl = paddle.fluid.contrib.layers
+        info = np.array([[0, 0, 0, 0], [1, 0, 2, 3], [2, 1, 4, 5],
+                         [2, 1, 0, 0], [3, 2, 0, 0], [3, 2, 0, 0]],
+                        np.int32)
+        child, leaf = cl.tdm_child(paddle.to_tensor(np.array([[1], [3]])),
+                                   6, 2, tree_info=info)
+        np.testing.assert_array_equal(child.numpy()[0, 0], [2, 3])
+        assert leaf.numpy()[0, 0, 0] == 0 and leaf.numpy()[1, 0, 0] == 1
+
+        travel = np.array([[0, 0]] * 4 + [[2, 4], [2, 5]], np.int32)
+        outs, labs = cl.tdm_sampler(
+            paddle.to_tensor(np.array([[4], [5]])), [1, 1], [2, 2], 2,
+            seed=3, tree_travel=travel, tree_layer=[[2, 3], [4, 5]])
+        o0, o1 = outs[0].numpy(), outs[1].numpy()
+        assert (o0[:, 0] == [2, 2]).all()
+        assert (o1[:, 0] == [4, 5]).all()          # layer-1 positives
+        assert (o0[:, 1] != o0[:, 0]).all()        # negatives differ
+        assert (o1[:, 1] != o1[:, 0]).all()
+        assert (labs[0].numpy() == [[1, 0], [1, 0]]).all()
