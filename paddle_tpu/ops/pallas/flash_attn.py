@@ -19,8 +19,46 @@ from .utils import HAS_PALLAS, on_tpu, pallas_enabled
 if HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    # batch / head / stationary-block axes are embarrassingly parallel; only
+    # the innermost (streamed) axis carries the online-softmax / accumulator
+    # recurrence.  Telling Mosaic so unlocks grid reordering + pipelining.
+    _COMPILER_PARAMS = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 NEG_INF = -1e30
+
+# Default tilings; tools/tpu_kernel_check.py sweeps these on-chip and
+# bench.py installs the winners via set_default_blocks so the gate only
+# ever approves the configuration that actually executes.
+_FWD_BLOCKS = (512, 1024)
+_BWD_BLOCKS = (512, 512)
+
+
+def set_default_blocks(fwd=None, bwd=None):
+    """Install (block_q, block_k) tilings for the fwd/bwd kernels."""
+    global _FWD_BLOCKS, _BWD_BLOCKS
+    if fwd is not None:
+        _FWD_BLOCKS = tuple(fwd)
+    if bwd is not None:
+        _BWD_BLOCKS = tuple(bwd)
+
+
+def _valid_mask(qi, ki, shape, causal, mask_tail, block_q, block_k,
+                kv_len, q_offset):
+    """Shared fwd/bwd tile mask (padded-KV tail + bottom-right causal);
+    returns None when the whole tile is valid.  One definition keeps the
+    backward's recompute masking mirrored with the forward by construction."""
+    valid = None
+    if mask_tail or causal:
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        if mask_tail:
+            valid = cols < kv_len
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, shape, 0)
+            c = rows + q_offset >= cols
+            valid = c if valid is None else (valid & c)
+    return valid
 
 
 def _ref_attention(q, k, v, causal):
@@ -40,9 +78,16 @@ def _ref_attention(q, k, v, causal):
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-               causal, sm_scale, block_q, block_k, kv_len, q_offset):
+               causal, sm_scale, block_q, block_k, kv_len, q_offset,
+               mask_tail):
     """q_offset = kv_len - q_len: bottom-right causal alignment, matching
-    _ref_attention's tril(k=m-n) (query i attends keys j <= i+q_offset)."""
+    _ref_attention's tril(k=m-n) (query i attends keys j <= i+q_offset).
+
+    MXU discipline (round-4): the dots consume q/k/v in their STORED dtype
+    (bf16 in the flagship) with fp32 accumulation — casting inputs to fp32
+    first quarters the systolic-array throughput and was the whole reason
+    the r3 kernel lost to XLA.  mask_tail is static: when the KV length is
+    a block multiple the tail mask is elided entirely."""
     qi = pl.program_id(2)   # query block index
     ki = pl.program_id(3)   # key block index
 
@@ -60,19 +105,16 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(run)
     def _body():
-        q = q_ref[:].astype(jnp.float32)            # [block_q, d]
-        k = k_ref[:].astype(jnp.float32)            # [block_k, d]
-        v = v_ref[:].astype(jnp.float32)
+        q = q_ref[:]                                 # [block_q, d] bf16/f32
+        k = k_ref[:]                                 # [block_k, d]
+        v = v_ref[:]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s * sm_scale                             # [block_q, block_k]
-        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = cols < kv_len                        # mask padded KV tail
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            valid = valid & (rows + q_offset >= cols)
-        s = jnp.where(valid, s, NEG_INF)
+        s = s * sm_scale                             # [block_q, block_k] f32
+        valid = _valid_mask(qi, ki, s.shape, causal, mask_tail,
+                            block_q, block_k, kv_len, q_offset)
+        if valid is not None:
+            s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:]                            # [block_q, 128]
         m_cur = jnp.max(s, axis=1, keepdims=True)    # [block_q, 1]
@@ -80,8 +122,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])        # [block_q,1]
         p = jnp.exp(s - m_new[:, :1])                        # [block_q,block_k]
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        # p@v on the MXU in the stored dtype (bf16 p, standard flash-attn
+        # practice); fp32 accumulate in scratch
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -96,11 +140,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[:] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
-def _flash_attention_tpu(q, k, v, causal, block_q=512, block_k=1024,
+def _flash_attention_tpu(q, k, v, causal, block_q=None, block_k=None,
                          interpret=False, return_lse=False):
     """q,k,v: [B, N, H, D] — grid over (batch, head, q-block, k-block).
     With return_lse, also returns the per-row logsumexp [B, H, N] used by
     the Pallas backward."""
+    if block_q is None:
+        block_q = _FWD_BLOCKS[0]
+    if block_k is None:
+        block_k = _FWD_BLOCKS[1]
     B, N, H, D = q.shape
     Nk = k.shape[1]
     sm_scale = 1.0 / math.sqrt(D)
@@ -125,7 +173,7 @@ def _flash_attention_tpu(q, k, v, causal, block_q=512, block_k=1024,
     out, lse = pl.pallas_call(
         functools.partial(_fa_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, kv_len=Nk,
-                          q_offset=Nk - N),
+                          q_offset=Nk - N, mask_tail=Nkp != Nk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, D),
@@ -150,6 +198,7 @@ def _flash_attention_tpu(q, k, v, causal, block_q=512, block_k=1024,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(qh, kh, vh)
     out = jnp.swapaxes(out[:, :, :N], 1, 2)
@@ -171,25 +220,25 @@ def _bwd_causal_skip(qi, ki, block_q, block_k, q_offset):
 
 
 def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
-                   causal, sm_scale, block_q, block_k, kv_len, q_offset):
+                   causal, sm_scale, block_q, block_k, kv_len, q_offset,
+                   mask_tail):
     """Shared backward tile math: recompute the masked probability block
     from the saved logsumexp and form ds.  Must mirror _fa_kernel's masking
-    (kv-tail + bottom-right causal) exactly.  Returns (p, ds, q, k, v, do)
-    in fp32."""
-    q = q_ref[:].astype(jnp.float32)
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
+    (kv-tail + bottom-right causal) exactly.  Dots consume the stored dtype
+    (bf16 on the MXU) with fp32 accumulation, like the forward.  Returns
+    (p, ds) in fp32 plus the raw (q, k, v, do) tiles."""
+    q = q_ref[:]
+    k = k_ref[:]
+    v = v_ref[:]
+    do = do_ref[:]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = cols < kv_len
-    if causal:
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0)
-        valid = valid & (rows + q_offset >= cols)
+    valid = _valid_mask(qi, ki, s.shape, causal, mask_tail,
+                        block_q, block_k, kv_len, q_offset)
     # lse/delta blocks are [block_q, 128] lane-broadcast; lane 0 suffices
-    p = jnp.where(valid, jnp.exp(s - lse_ref[:][:, :1]), 0.0)
+    p = jnp.exp(s - lse_ref[:][:, :1])
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta_ref[:][:, :1]) * sm_scale
@@ -198,7 +247,7 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                   acc_scr, *, causal, sm_scale, block_q, block_k, kv_len,
-                  q_offset):
+                  q_offset, mask_tail):
     """Grid (B, H, qi, ki): q block stationary, stream K/V blocks; ds@k
     accumulates into the dq scratch, written once at the last ki."""
     qi = pl.program_id(2)
@@ -215,9 +264,9 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _body():
         _, ds, _, k, _, _ = _bwd_recompute(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
-            causal, sm_scale, block_q, block_k, kv_len, q_offset)
+            causal, sm_scale, block_q, block_k, kv_len, q_offset, mask_tail)
         acc_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == pl.num_programs(3) - 1)
@@ -227,7 +276,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, sm_scale,
-                   block_q, block_k, kv_len, q_offset):
+                   block_q, block_k, kv_len, q_offset, mask_tail):
     """Grid (B, H, ki, qi): K/V block stationary, stream q/do blocks."""
     ki = pl.program_id(2)
     qi = pl.program_id(3)
@@ -244,13 +293,14 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _body():
         p, ds, q, _, _, do = _bwd_recompute(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
-            causal, sm_scale, block_q, block_k, kv_len, q_offset)
-        # dv += p^T @ do ; dk += ds^T @ q
+            causal, sm_scale, block_q, block_k, kv_len, q_offset, mask_tail)
+        # dv += p^T @ do ; dk += ds^T @ q — transposed operands stay in the
+        # stored dtype so the MXU runs at full (bf16) rate
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == pl.num_programs(3) - 1)
@@ -260,9 +310,13 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
-                             block_q=128, block_k=128, interpret=False):
+                             block_q=None, block_k=None, interpret=False):
     """dq, dk, dv via tiled recompute from the saved logsumexp — O(N) memory
     (the [N,N] score matrix never materializes), all matmuls on the MXU."""
+    if block_q is None:
+        block_q = _BWD_BLOCKS[0]
+    if block_k is None:
+        block_k = _BWD_BLOCKS[1]
     B, N, H, D = q.shape
     Nk = k.shape[1]
     sm_scale = 1.0 / math.sqrt(D)
@@ -294,7 +348,8 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
         vh = jnp.pad(vh, pad4)
 
     common = dict(causal=causal, sm_scale=sm_scale, block_q=block_q,
-                  block_k=block_k, kv_len=Nk, q_offset=Nk - N)
+                  block_k=block_k, kv_len=Nk, q_offset=Nk - N,
+                  mask_tail=Nkp != Nk)
     q_spec = pl.BlockSpec((None, None, block_q, D),
                           lambda b, h, i, j: (b, h, i, 0))
     row_spec = pl.BlockSpec((None, None, block_q, 128),
@@ -314,6 +369,7 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(qh, kh, vh, doh, lse, delta)
 
@@ -338,12 +394,36 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
                    jax.ShapeDtypeStruct(vh.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(qh, kh, vh, doh, lse, delta)
 
     return (jnp.swapaxes(dq[:, :, :N], 1, 2),
             jnp.swapaxes(dk[:, :, :Nk], 1, 2),
             jnp.swapaxes(dv[:, :, :Nk], 1, 2))
+
+
+def _flash_fwd_bwd_probe(q, bwd_block_q, bwd_block_k):
+    """Kernel-check helper: self-attention fwd+bwd with EXPLICIT backward
+    block sizes (forward keeps its defaults) so tools/tpu_kernel_check.py
+    can sweep the backward tiling on-chip."""
+    @jax.custom_vjp
+    def f(q):
+        return _flash_attention_tpu(q, q, q, True)
+
+    def fwd(q):
+        out, lse = _flash_attention_tpu(q, q, q, True, return_lse=True)
+        return out, (q, out, lse)
+
+    def bwd(res, g):
+        q, out, lse = res
+        dq, dk, dv = _flash_attention_bwd_tpu(
+            q, q, q, out, lse, g, True,
+            block_q=bwd_block_q, block_k=bwd_block_k)
+        return (dq + dk + dv,)
+
+    f.defvjp(fwd, bwd)
+    return f(q)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -96,23 +96,49 @@ def check_flash_bench_shape(results):
     rng = np.random.RandomState(3)
     q = jnp.asarray(rng.randn(B, N, H, D) * 0.1, jnp.bfloat16)
 
+    # forward sweep
     ref_fn = jax.jit(lambda q: fa._ref_attention(q, q, q, True))
     tr, _ = timeit(ref_fn, q, iters=10)
-    entry = {"xla_ms": tr * 1e3, "blocks": {}}
-    best = None
-    for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 1024)):
+    entry = {"xla_fwd_ms": tr * 1e3, "fwd_blocks": {}}
+    best = best_cfg = None
+    for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 1024),
+                   (2048, 512), (1024, 2048)):
         try:
             p_fn = jax.jit(lambda q, bq=bq, bk=bk: fa._flash_attention_tpu(
                 q, q, q, True, block_q=bq, block_k=bk))
             tp, _ = timeit(p_fn, q, iters=10)
-            entry["blocks"][f"{bq}x{bk}"] = tp * 1e3
+            entry["fwd_blocks"][f"{bq}x{bk}"] = tp * 1e3
             if best is None or tp * 1e3 < best:
-                best = tp * 1e3
+                best, best_cfg = tp * 1e3, (bq, bk)
         except Exception as e:                      # noqa: BLE001
-            entry["blocks"][f"{bq}x{bk}"] = f"{type(e).__name__}: {e}"
-    entry["best_pallas_ms"] = best
-    entry["pallas_beats_xla"] = bool(best is not None
-                                     and best < tr * 1e3)
+            entry["fwd_blocks"][f"{bq}x{bk}"] = f"{type(e).__name__}: {e}"
+    entry["best_fwd_ms"] = best
+    entry["best_fwd_blocks"] = best_cfg
+
+    # backward sweep (full custom-vjp path vs XLA autodiff of the dense ref)
+    def make_grad(f):
+        return jax.jit(jax.grad(lambda q: jnp.sum(
+            f(q).astype(jnp.float32) ** 2)))
+    tr_b, _ = timeit(make_grad(lambda q: fa._ref_attention(q, q, q, True)),
+                     q, iters=10)
+    entry["xla_bwd_ms"] = tr_b * 1e3
+    entry["bwd_blocks"] = {}
+    best_b = best_b_cfg = None
+    for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512)):
+        try:
+            g_fn = make_grad(lambda q, bq=bq, bk=bk: fa._flash_fwd_bwd_probe(
+                q, bq, bk))
+            tb, _ = timeit(g_fn, q, iters=10)
+            entry["bwd_blocks"][f"{bq}x{bk}"] = tb * 1e3
+            if best_b is None or tb * 1e3 < best_b:
+                best_b, best_b_cfg = tb * 1e3, (bq, bk)
+        except Exception as e:                      # noqa: BLE001
+            entry["bwd_blocks"][f"{bq}x{bk}"] = f"{type(e).__name__}: {e}"
+    entry["best_bwd_ms"] = best_b
+    entry["best_bwd_blocks"] = best_b_cfg
+    entry["pallas_beats_xla"] = bool(
+        best is not None and best < entry["xla_fwd_ms"]
+        and best_b is not None and best_b < entry["xla_bwd_ms"])
     results["flash_attn_bench_shape"] = entry
 
 
