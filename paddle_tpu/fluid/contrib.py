@@ -58,3 +58,48 @@ class mixed_precision(SimpleNamespace):
                 return None
 
         return _AmpOptimizer(optimizer)
+
+
+def op_freq_statistic(program):
+    """ref fluid/contrib/op_frequence.py:23 — op-type frequency over a
+    Program's recorded ops: returns (uni_op_freq, adj_2_op_freq) as
+    frequency-sorted (name, count) item lists like the reference's
+    OrderedDicts."""
+    from collections import Counter, OrderedDict
+
+    names = [op.name for op in program.ops]
+    uni = Counter(names)
+    adj = Counter(f"{a}->{b}" for a, b in zip(names, names[1:]))
+    uni_sorted = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return list(uni_sorted.items()), list(adj_sorted.items())
+
+
+def model_stat_summary(main_prog):
+    """ref fluid/contrib/model_stat.py:39 — parameter / memory summary of
+    a Program.  The reference walks conv/fc ops for FLOPs off the fluid
+    op-desc protobuf; the record-replay Program keeps callables instead,
+    so this reports per-parameter shapes/sizes plus op counts, printed in
+    the reference's table spirit and returned as a dict."""
+    rows = []
+    total_params = 0
+    for vid, p in main_prog.params.items():
+        shape = tuple(int(s) for s in p.shape)
+        n = 1
+        for s in shape:
+            n *= s
+        total_params += n
+        rows.append((getattr(p, "name", str(vid)), shape, n))
+    uni, _ = op_freq_statistic(main_prog)
+    print("+----------------------- model summary ----------------------+")
+    for name, shape, n in rows:
+        print(f"| {name:<30} {str(shape):<20} {n:>10} |")
+    print(f"| total params: {total_params:>12}  ops: "
+          f"{sum(c for _, c in uni):>6} kinds: {len(uni):>4} |")
+    print("+------------------------------------------------------------+")
+    return {"params": rows, "total_params": total_params,
+            "op_freq": uni}
+
+
+# reference spelling: fluid.contrib.summary(main_prog)
+summary = model_stat_summary

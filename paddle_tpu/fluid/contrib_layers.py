@@ -143,23 +143,15 @@ def multiclass_nms2(bboxes, scores, score_threshold=0.0, nms_top_k=400,
     """ref multiclass_nms2_op: multiclass_nms that can also return the
     kept rows' flat indices (fixed-shape: -1 marks padding)."""
     from ..vision.detection import multiclass_nms
+    # the selected indices are threaded out of the NMS itself (duplicate
+    # boxes make coordinate reverse-matching ambiguous — round-3 advisor)
     out = multiclass_nms(bboxes, scores, score_threshold=score_threshold,
                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
                          nms_threshold=nms_threshold, normalized=normalized,
                          nms_eta=nms_eta,
-                         background_label=background_label)
-    if not return_index:
-        return out
-
-    def _match(o, bb):
-        # recover each kept row's box index by matching coordinates
-        eq = jnp.all(jnp.abs(o[..., None, 2:6] - bb[:, None]) < 1e-6, -1)
-        idx = jnp.argmax(eq, -1)
-        valid = o[..., 0] >= 0
-        return jnp.where(valid, idx, -1)
-    index = call(_match, out, bboxes, _name="nms2_index",
-                 _nondiff=(0, 1))
-    return out, index
+                         background_label=background_label,
+                         return_index=return_index)
+    return out
 
 
 def sparse_embedding(input, size, padding_idx=None, param_attr=None,

@@ -253,6 +253,18 @@ def dropout(x, dropout_prob, is_test=False, seed=None,
 
 label_smooth = F.label_smooth
 sequence_mask = F.sequence_mask
+# dynamic-RNN op family (padded+masked TPU-native forms, ref rnn.py:2262+)
+from .rnn_ops import (dynamic_lstm, dynamic_lstmp, dynamic_gru,  # noqa
+                      gru_unit, lstm, beam_search, beam_search_decode)
+# decode stack fluid spellings (ref rnn.py:866 BeamSearchDecoder,
+# :1581 dynamic_decode)
+from ..nn.decode import (BeamSearchDecoder, dynamic_decode,  # noqa: F401
+                         Decoder)
+# fluid cell/decode-helper surface (ref rnn.py:62+)
+from .rnn_cells import (RNNCell, GRUCell, LSTMCell, rnn, birnn,  # noqa
+                        lstm_unit, DecodeHelper, TrainingHelper,
+                        GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                        BasicDecoder)
 # sequence op family (padded+masked TPU-native forms)
 from ..nn.functional.sequence import (sequence_pad, sequence_unpad,  # noqa
     sequence_pool, sequence_softmax, sequence_reverse, sequence_expand,
@@ -291,7 +303,9 @@ logical_not = _T.logical_not
 from ..vision.detection import (prior_box, density_prior_box,  # noqa: E402
     anchor_generator, iou_similarity, box_coder, box_clip, bipartite_match,
     target_assign, multiclass_nms, matrix_nms, ssd_loss, multi_box_head,
-    polygon_box_transform)
+    polygon_box_transform, distribute_fpn_proposals, collect_fpn_proposals,
+    retinanet_target_assign, retinanet_detection_output,
+    roi_perspective_transform)
 from ..vision.ops import yolo_box  # noqa: E402,F401
 from ..vision.ops import yolo_loss as yolov3_loss  # noqa: E402,F401
 

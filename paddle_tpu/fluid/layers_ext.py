@@ -978,3 +978,271 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
 
 from ..vision.detection import (generate_proposals,  # noqa: E402,F401
                                 rpn_target_assign, locality_aware_nms)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """ref nn.py:14001 / cvm_op (CTR show/click columns): with use_cvm the
+    first two embedding dims become log(show+1) and log(click+1)-log(show+1)
+    (values taken from the input's own leading columns, as the reference
+    kernel does); without it they are dropped."""
+    def _cvm(x, _cvm_info):
+        if use_cvm:
+            c0 = jnp.log(x[:, :1] + 1.0)
+            c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+            return jnp.concatenate([c0, c1, x[:, 2:]], 1)
+        return x[:, 2:]
+    return call(_cvm, input, cvm, _name="cvm")
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """ref nn.py:12755 / similarity_focus_op: for each batch row and each
+    index along ``axis``, greedily pick the largest remaining element of
+    the selected 2-D slice whose row AND column are still unmarked (the
+    reference's sort-then-scan is equivalent), and light up that (row,
+    col) across the whole axis dimension.  Returns a 0/1 mask shaped like
+    input ([N, d1, d2, d3], axis in {1, 2, 3})."""
+    assert axis in (1, 2, 3), "axis must be 1, 2 or 3"
+
+    def _sf(x):
+        N = x.shape[0]
+        # normalize to axis==1 layout [N, A, H, W], undo at the end
+        if axis == 1:
+            xs = x
+        elif axis == 2:
+            xs = jnp.transpose(x, (0, 2, 1, 3))
+        else:
+            xs = jnp.transpose(x, (0, 3, 1, 2))
+        A, H, W = xs.shape[1], xs.shape[2], xs.shape[3]
+        NEG = -jnp.inf
+
+        def per_slice(sl):                      # [H, W] -> mask [H, W]
+            def body(_, carry):
+                s, m = carry
+                flat = jnp.argmax(s)
+                r, c = flat // W, flat % W
+                ok = s[r, c] > NEG
+                m = jnp.where(ok, m.at[r, c].set(1.0), m)
+                s = jnp.where(ok, s.at[r, :].set(NEG).at[:, c].set(NEG), s)
+                return s, m
+            _, m = jax.lax.fori_loop(
+                0, min(H, W), body, (sl.astype(jnp.float32),
+                                     jnp.zeros((H, W), jnp.float32)))
+            return m
+
+        masks = jax.vmap(jax.vmap(per_slice))(
+            xs[:, jnp.asarray(list(indexes))])            # [N, I, H, W]
+        mask = jnp.max(masks, axis=1)                     # OR over indexes
+        out = jnp.broadcast_to(mask[:, None], (N, A, H, W))
+        if axis == 2:
+            out = jnp.transpose(out, (0, 2, 1, 3))
+        elif axis == 3:
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out.astype(x.dtype)
+
+    return call(_sf, input, _nondiff=(0,), _name="similarity_focus")
+
+
+def _hat_integral(a, b, centers):
+    """Integral of the unit hat function centered at each of ``centers``
+    over [a, b] (scalars broadcast): closed form of the PrRoI bilinear
+    basis.  a/b: [...]; centers: [K] -> [..., K]."""
+    def H(t):
+        # antiderivative of max(0, 1-|t|): H(-1)=0, H(0)=.5, H(1)=1
+        t = jnp.clip(t, -1.0, 1.0)
+        return jnp.where(t <= 0.0, (t + 1.0) ** 2 / 2.0,
+                         1.0 - (1.0 - t) ** 2 / 2.0)
+    ta = a[..., None] - centers
+    tb = b[..., None] - centers
+    return H(tb) - H(ta)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (ref nn.py:13807 / prroi_pool_op, PrRoIPool,
+    arXiv:1807.11590): each output bin is the EXACT integral of the
+    bilinearly-interpolated feature over the continuous bin, divided by
+    the bin area.  Because the bilinear basis is a product of 1-D hat
+    functions, the integral separates: out = Wy @ F @ Wx^T per channel,
+    with Wy/Wx built from closed-form hat integrals — two matmuls on the
+    MXU instead of the reference's per-cell scalar accumulation.
+
+    input [N, C, H, W]; rois [R, 4] (x1, y1, x2, y2, un-normalized);
+    batch_roi_nums [N] maps RoIs to images (default: all on image 0).
+    Returns [R, C, pooled_height, pooled_width]."""
+    PH, PW = int(pooled_height), int(pooled_width)
+
+    def _pr(x, r, *rest):
+        N, C, H, W = x.shape
+        R = r.shape[0]
+        if rest:
+            counts = rest[0].astype(jnp.int32)
+            ends = jnp.cumsum(counts)
+            img_of = jnp.sum((jnp.arange(R)[:, None]
+                              >= ends[None, :]).astype(jnp.int32), -1)
+            img_of = jnp.clip(img_of, 0, N - 1)
+        else:
+            img_of = jnp.zeros((R,), jnp.int32)
+        rs = r.astype(jnp.float32) * spatial_scale
+
+        def per_roi(roi, feat):
+            x1, y1, x2, y2 = roi
+            roi_w = jnp.maximum(x2 - x1, 0.0)
+            roi_h = jnp.maximum(y2 - y1, 0.0)
+            bw = roi_w / PW
+            bh = roi_h / PH
+            # bin edges
+            bx0 = x1 + jnp.arange(PW) * bw                # [PW]
+            by0 = y1 + jnp.arange(PH) * bh
+            Wx = _hat_integral(bx0, bx0 + bw,
+                               jnp.arange(W, dtype=jnp.float32))  # [PW, W]
+            Wy = _hat_integral(by0, by0 + bh,
+                               jnp.arange(H, dtype=jnp.float32))  # [PH, H]
+            acc = jnp.einsum("ph,chw,qw->cpq", Wy, feat, Wx)
+            area = jnp.maximum(bw * bh, 0.0)
+            return jnp.where(area > 0.0, acc / jnp.maximum(area, 1e-12),
+                             0.0)
+
+        return jax.vmap(per_roi)(rs, x[img_of].astype(jnp.float32)) \
+            .astype(x.dtype)
+
+    args = [input, rois] + ([batch_roi_nums]
+                            if batch_roi_nums is not None else [])
+    return call(_pr, *args, _name="prroi_pool",
+                _nondiff=(1,) if batch_roi_nums is None else (1, 2))
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """Deformable (PS-)RoI pooling (ref nn.py:14592 /
+    deformable_psroi_pooling_op): each bin shifts by a learned offset
+    from ``trans`` then averages sample_per_part^2 bilinear samples.
+
+    input [N, C, H, W]; rois [R, 4]; trans [R, 2, part_h, part_w] (or any
+    broadcastable leading shape when no_trans).  position_sensitive picks
+    channel (c*gh+..) per bin, output C' = C // (group_size[0]*
+    group_size[1]); otherwise channels pass through.  All RoIs map to
+    image 0 unless a 5th roi column carries the batch index (the padded
+    analog of the reference's RoI LoD)."""
+    PH, PW = int(pooled_height), int(pooled_width)
+    gh_, gw_ = int(group_size[0]), int(group_size[1])
+    if part_size is None:
+        part_size = (PH, PW)
+    part_h, part_w = int(part_size[0]), int(part_size[1])
+    spp = int(sample_per_part)
+
+    def _dr(x, r, tr):
+        N, C, H, W = x.shape
+        R = r.shape[0]
+        if r.shape[1] >= 5:
+            img_of = r[:, 4].astype(jnp.int32)
+            r4 = r[:, :4]
+        else:
+            img_of = jnp.zeros((R,), jnp.int32)
+            r4 = r
+        rs = r4.astype(jnp.float32)
+        x_f = x.astype(jnp.float32)
+        C_out = C // (gh_ * gw_) if position_sensitive else C
+
+        def per_roi(roi, t, feat):
+            # reference rounding: start = round(x)*scale - 0.5,
+            # end = (round(x2)+1)*scale - 0.5
+            sw = jnp.round(roi[0]) * spatial_scale - 0.5
+            sh = jnp.round(roi[1]) * spatial_scale - 0.5
+            ew = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+            eh = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+            roi_w = jnp.maximum(ew - sw, 0.1)
+            roi_h = jnp.maximum(eh - sh, 0.1)
+            bw = roi_w / PW
+            bh = roi_h / PH
+            sub_w = bw / spp
+            sub_h = bh / spp
+
+            ph = jnp.arange(PH)
+            pw = jnp.arange(PW)
+            pgrid_h, pgrid_w = jnp.meshgrid(ph, pw, indexing="ij")
+            p_h = jnp.floor(pgrid_h.astype(jnp.float32) / PH
+                            * part_h).astype(jnp.int32)
+            p_w = jnp.floor(pgrid_w.astype(jnp.float32) / PW
+                            * part_w).astype(jnp.int32)
+            if no_trans:
+                tx = jnp.zeros((PH, PW))
+                ty = jnp.zeros((PH, PW))
+            else:
+                tx = t[0][p_h, p_w] * trans_std
+                ty = t[1][p_h, p_w] * trans_std
+            wstart = pgrid_w * bw + sw + tx * roi_w       # [PH, PW]
+            hstart = pgrid_h * bh + sh + ty * roi_h
+
+            # sample grid [PH, PW, spp, spp]
+            iw = jnp.arange(spp, dtype=jnp.float32)
+            sx = wstart[..., None, None] + (iw[None, :] + 0.5) * sub_w
+            sy = hstart[..., None, None] + (iw[:, None] + 0.5) * sub_h
+            sx = jnp.broadcast_to(sx, sx.shape[:2] + (spp, spp))
+            sy = jnp.broadcast_to(sy, sy.shape[:2] + (spp, spp))
+            ok = ((sx > -0.5) & (sx < W - 0.5)
+                  & (sy > -0.5) & (sy < H - 0.5))
+            sxc = jnp.clip(sx, 0.0, W - 1.0)
+            syc = jnp.clip(sy, 0.0, H - 1.0)
+            x0 = jnp.floor(sxc).astype(jnp.int32)
+            y0 = jnp.floor(syc).astype(jnp.int32)
+            x1 = jnp.minimum(x0 + 1, W - 1)
+            y1 = jnp.minimum(y0 + 1, H - 1)
+            lx = sxc - x0
+            ly = syc - y0
+
+            if position_sensitive:
+                gh_idx = jnp.clip((pgrid_h * gh_) // PH, 0, gh_ - 1)
+                gw_idx = jnp.clip((pgrid_w * gw_) // PW, 0, gw_ - 1)
+                # channel block per bin: c_in = (c*gh + gh_idx)*gw + gw_idx
+                c_base = (jnp.arange(C_out)[:, None, None] * gh_
+                          + gh_idx[None]) * gw_ + gw_idx[None]  # [C',PH,PW]
+                chan = c_base[..., None, None]
+                feat_g = feat[chan, y0[None], x0[None]] * \
+                    ((1 - lx) * (1 - ly))[None]
+                feat_g += feat[chan, y0[None], x1[None]] * \
+                    (lx * (1 - ly))[None]
+                feat_g += feat[chan, y1[None], x0[None]] * \
+                    ((1 - lx) * ly)[None]
+                feat_g += feat[chan, y1[None], x1[None]] * \
+                    (lx * ly)[None]
+                val = feat_g                               # [C',PH,PW,s,s]
+            else:
+                def bil(f2d):
+                    v = (f2d[y0, x0] * (1 - lx) * (1 - ly)
+                         + f2d[y0, x1] * lx * (1 - ly)
+                         + f2d[y1, x0] * (1 - lx) * ly
+                         + f2d[y1, x1] * lx * ly)
+                    return v
+                val = jax.vmap(bil)(feat)                  # [C,PH,PW,s,s]
+            val = jnp.where(ok[None], val, 0.0)
+            cnt = jnp.sum(ok.astype(jnp.float32), axis=(-2, -1))
+            return jnp.sum(val, axis=(-2, -1)) / jnp.maximum(cnt, 1.0)
+
+        tr_b = jnp.broadcast_to(jnp.asarray(tr, jnp.float32),
+                                (R, 2, part_h, part_w))
+        return jax.vmap(per_roi)(rs, tr_b, x_f[img_of]).astype(x.dtype)
+
+    return call(_dr, input, rois, trans, _name="deformable_roi_pooling",
+                _nondiff=(1,))
+
+
+# fluid.layers historically re-exported the distribution classes and a
+# persistable-var load op
+from ..distribution import (Uniform, Normal, Categorical,  # noqa: E402,F401
+                            MultivariateNormalDiag)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """ref io.py load op: fill ``out`` with a tensor saved by save();
+    delegates to the io serialization used by paddle.save/load."""
+    import numpy as _np_mod
+    from .. import load as _load
+    val = _load(file_path)
+    arr = _np_mod.asarray(val.numpy() if hasattr(val, "numpy") else val)
+    if load_as_fp16:
+        arr = arr.astype("float16")
+    out._rebind(Tensor(arr))
+    return out

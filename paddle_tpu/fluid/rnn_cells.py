@@ -1,0 +1,376 @@
+"""fluid.layers RNN-cell / decode-helper surface
+(ref: python/paddle/fluid/layers/rnn.py:62 RNNCell, :229 GRUCell, :327
+LSTMCell, :437 rnn, :661 birnn, :1673 DecodeHelper, :1742 TrainingHelper,
+:1895 GreedyEmbeddingHelper, :2026 SampleEmbeddingHelper, :2127
+BasicDecoder, :3392 lstm_unit).
+
+The fluid cells use the BasicLSTMUnit/BasicGRUUnit weight layout
+(contrib/layers/rnn_impl.py): ONE [input+hidden, k*hidden] matrix applied
+to concat([x, h]) — different from the 2.x nn cells' split ih/hh weights —
+with LSTM gate order {i, j(candidate), f, o} and GRU gates {r, u}.
+"""
+from __future__ import annotations
+
+import collections
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import create_parameter
+from ..ops.dispatch import call
+from ..tensor.tensor import Tensor
+from ..tensor import manipulation as manip
+
+
+class RNNCell:
+    """ref rnn.py:62 — base: call(inputs, states) plus zero-state
+    construction from a batch reference."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError("RNNCell subclasses implement call")
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        B = int(batch_ref.shape[batch_dim_idx])
+        shape = shape if shape is not None else self.state_shape
+        def build(s):
+            if isinstance(s, (list, tuple)) and s and isinstance(
+                    s[0], (list, tuple)):
+                return type(s)(build(x) for x in s)
+            dims = [B] + [int(d) for d in
+                          (s if isinstance(s, (list, tuple)) else [s])]
+            return Tensor(jnp.full(dims, init_value, jnp.dtype(dtype)))
+        s = self.state_shape
+        if isinstance(s, (list, tuple)) and s and isinstance(
+                s[0], (list, tuple)):
+            return tuple(build(x) for x in s)
+        return build(s)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+
+class GRUCell(RNNCell):
+    """ref rnn.py:229 — BasicGRUUnit layout: gate_weight
+    [in+hidden, 2*hidden] -> sigmoid -> (r, u); candidate_weight
+    [in+hidden, hidden]; h = u*h_prev + (1-u)*c."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act_g = gate_activation
+        self._act_c = activation
+        self._dtype = dtype
+        self._built_for = None
+
+    def _build(self, input_size):
+        if self._built_for == input_size:
+            return
+        D = self.hidden_size
+        self.gate_weight = create_parameter(
+            [input_size + D, 2 * D], self._dtype, attr=self._param_attr)
+        self.gate_bias = create_parameter(
+            [2 * D], self._dtype, attr=self._bias_attr, is_bias=True)
+        self.candidate_weight = create_parameter(
+            [input_size + D, D], self._dtype, attr=self._param_attr)
+        self.candidate_bias = create_parameter(
+            [D], self._dtype, attr=self._bias_attr, is_bias=True)
+        self._built_for = input_size
+
+    def call(self, inputs, states):
+        self._build(int(inputs.shape[-1]))
+        D = self.hidden_size
+        act_g = self._act_g or jax.nn.sigmoid
+        act_c = self._act_c or jnp.tanh
+
+        def _step(x, h, gw, gb, cw, cb):
+            cat = jnp.concatenate([x, h], 1)
+            g = act_g(cat @ gw + gb)
+            r, u = g[:, :D], g[:, D:]
+            cand = act_c(jnp.concatenate([x, r * h], 1) @ cw + cb)
+            return u * h + (1.0 - u) * cand
+
+        h = call(_step, inputs, states, self.gate_weight, self.gate_bias,
+                 self.candidate_weight, self.candidate_bias,
+                 _name="fluid_gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(RNNCell):
+    """ref rnn.py:327 — BasicLSTMUnit layout: weight
+    [in+hidden, 4*hidden], gates {i, j, f, o}; c = c*sig(f+forget_bias) +
+    sig(i)*tanh(j); h = tanh(c)*sig(o)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act_g = gate_activation
+        self._act_c = activation
+        self._forget_bias = float(forget_bias)
+        self._dtype = dtype
+        self._built_for = None
+
+    def _build(self, input_size):
+        if self._built_for == input_size:
+            return
+        D = self.hidden_size
+        self.weight = create_parameter(
+            [input_size + D, 4 * D], self._dtype, attr=self._param_attr)
+        self.bias = create_parameter(
+            [4 * D], self._dtype, attr=self._bias_attr, is_bias=True)
+        self._built_for = input_size
+
+    def call(self, inputs, states):
+        self._build(int(inputs.shape[-1]))
+        D = self.hidden_size
+        act_g = self._act_g or jax.nn.sigmoid
+        act_c = self._act_c or jnp.tanh
+        fb = self._forget_bias
+        h_prev, c_prev = states
+
+        def _step(x, h, c, w, b):
+            g = jnp.concatenate([x, h], 1) @ w + b
+            i, j, f, o = jnp.split(g, 4, axis=-1)
+            c_new = c * act_g(f + fb) + act_g(i) * act_c(j)
+            h_new = act_c(c_new) * act_g(o)
+            return h_new, c_new
+
+        h, c = call(_step, inputs, h_prev, c_prev, self.weight, self.bias,
+                    _name="fluid_lstm_cell")
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run ``cell`` over time (ref rnn.py:437).  Python-loop build (each
+    step dispatches; the static Program records and jits the replay).
+    Returns (outputs, final_states) batch- or time-major per input."""
+    if initial_states is None:
+        ref = inputs
+        if time_major:
+            ref = manip.transpose(inputs, [1, 0] +
+                                  list(range(2, len(inputs.shape))))
+        initial_states = cell.get_initial_states(ref)
+    T_axis = 0 if time_major else 1
+    T = int(inputs.shape[T_axis])
+    steps = [manip.squeeze(s, [T_axis])
+             for s in manip.split(inputs, T, axis=T_axis)]
+    order = range(T - 1, -1, -1) if is_reverse else range(T)
+
+    from ..tensor.creation import zeros_like
+
+    states = initial_states
+    outs = [None] * T
+    lens = sequence_length
+    for t in order:
+        out, new_states = cell.call(steps[t], states, **kwargs)
+        if lens is not None:
+            def _mask(n, o, t=t):
+                def m(nv, ov, lv):
+                    alive = (t < lv.astype(jnp.int32)).reshape(
+                        (-1,) + (1,) * (nv.ndim - 1))
+                    return jnp.where(alive, nv, ov)
+                return call(m, n, o, lens, _nondiff=(2,),
+                            _name="rnn_mask")
+            new_states = jax.tree_util.tree_map(
+                _mask, new_states, states,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            out = _mask(out, zeros_like(out))   # padded steps emit zeros
+        outs[t] = out
+        states = new_states
+    outputs = manip.stack(outs, axis=T_axis)
+    return outputs, states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional rnn (ref rnn.py:661): forward + reverse passes,
+    outputs concatenated on the feature axis."""
+    if initial_states is None:
+        states_fw = states_bw = None
+    else:
+        states_fw, states_bw = initial_states
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major=time_major, is_reverse=False, **kwargs)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major=time_major, is_reverse=True, **kwargs)
+    outputs = manip.concat([out_fw, out_bw], axis=-1)
+    return outputs, (st_fw, st_bw)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step op (ref rnn.py:3392 / lstm_unit_op): weight
+    [in+hidden, 4*hidden] over concat([x, h]), gate order {i, f, c, o}
+    per the documented formulas, forget_bias added to f.  Returns
+    (hidden_t, cell_t)."""
+    D = int(hidden_t_prev.shape[-1])
+    in_size = int(x_t.shape[-1])
+    weight = create_parameter([in_size + D, 4 * D], "float32",
+                              attr=param_attr)
+    bias = create_parameter([4 * D], "float32", attr=bias_attr,
+                            is_bias=True)
+    fb = float(forget_bias)
+
+    def _step(x, h, c, w, b):
+        g = jnp.concatenate([x, h], 1) @ w + b
+        i, f, j, o = jnp.split(g, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f + fb) * c + jax.nn.sigmoid(i) * jnp.tanh(j)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, c_new
+
+    return call(_step, x_t, hidden_t_prev, cell_t_prev, weight, bias,
+                _name="lstm_unit")
+
+
+# ---------------------------------------------------------------- decode
+class DecodeHelper:
+    """ref rnn.py:1673 — sample/next_inputs protocol for BasicDecoder."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class TrainingHelper(DecodeHelper):
+    """ref rnn.py:1742 — teacher forcing: feed the ground-truth sequence
+    step by step; finished when past each row's length."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs
+        self.sequence_length = sequence_length
+        self.time_major = time_major
+        x = inputs
+        if not time_major:
+            x = manip.transpose(x, [1, 0] + list(range(2, len(x.shape))))
+        self._T = int(x.shape[0])
+        # slice once here — next_inputs is called every decode step and
+        # re-splitting [T, B, ...] each time would be O(T^2) dispatches
+        self._steps = [manip.squeeze(s, [0])
+                       for s in manip.split(x, self._T, 0)]
+
+    def initialize(self):
+        lens = _np(self.sequence_length)
+        finished = Tensor(jnp.asarray(lens <= 0))
+        return self._steps[0], finished
+
+    def sample(self, time, outputs, states):
+        from ..tensor.search import argmax
+        return argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        next_t = time + 1
+        lens = _np(self.sequence_length)
+        finished = Tensor(jnp.asarray(next_t >= lens))
+        return finished, self._steps[min(next_t, self._T - 1)], states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """ref rnn.py:1895 — feed back argmax ids through an embedding fn."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        init = self.embedding_fn(self.start_tokens)
+        B = int(_np(self.start_tokens).shape[0])
+        return init, Tensor(jnp.zeros((B,), bool))
+
+    def sample(self, time, outputs, states):
+        from ..tensor.search import argmax
+        return argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = Tensor(jnp.asarray(
+            _np(sample_ids).reshape(-1) == self.end_token))
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """ref rnn.py:2026 — sample ids from softmax(outputs) instead of
+    argmax (optional temperature), otherwise GreedyEmbeddingHelper."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.softmax_temperature = softmax_temperature
+        self.seed = seed
+        self._calls = 0
+
+    def sample(self, time, outputs, states):
+        logits = _np(outputs)
+        if self.softmax_temperature is not None:
+            logits = logits / self.softmax_temperature
+        self._calls += 1
+        key = jax.random.PRNGKey((self.seed if self.seed is not None
+                                  else 7) + self._calls)
+        ids = jax.random.categorical(key, jnp.asarray(logits), axis=-1)
+        return Tensor(ids.astype(jnp.int64))
+
+
+class BasicDecoderOutput(collections.namedtuple(
+        "BasicDecoderOutput", ("cell_outputs", "sample_ids"))):
+    pass
+
+
+class BasicDecoder:
+    """ref rnn.py:2127 — cell + DecodeHelper assembled into the Decoder
+    protocol consumed by dynamic_decode."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        (initial_inputs, initial_finished) = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell.call(inputs, states,
+                                                   **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        (finished, next_inputs, next_states) = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        outputs = BasicDecoderOutput(cell_outputs, sample_ids)
+        return outputs, next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False

@@ -1,0 +1,522 @@
+"""fluid-era dynamic-RNN op family — padded+masked TPU-native form.
+
+Re-designs the reference's LoD recurrence ops
+(ref: python/paddle/fluid/layers/rnn.py:2262 dynamic_lstm, :2439 lstm,
+:2616 dynamic_lstmp, :2835 dynamic_gru, :2998 gru_unit; kernels in
+paddle/fluid/operators/lstm_op.* / lstmp_op.* / gru_op.* / gru_unit_op.*).
+
+LoD is hostile to XLA, so like the rest of this repo's sequence family the
+ops take a padded ``[B, T, ...]`` tensor plus an optional ``lengths [B]``
+vector (the LoD analog; None means every row is full length).  Each
+recurrence is ONE dispatched op whose body is a ``lax.scan`` — fixed
+shapes, jits and differentiates, runs the per-step matmuls on the MXU.
+Gate layouts and formulas mirror the reference ops exactly so weights
+round-trip:
+
+- lstm weights ``[D, 4D]`` with gate columns ordered {c, i, f, o}
+  (candidate, input, forget, output) and bias ``[1, 4D]`` — or ``[1, 7D]``
+  with peepholes appending {W_ic, W_fc, W_oc} (ref lstm_op docstring).
+- gru weight ``[D, 3D]``: ``[:, :2D]`` = {W_uh, W_rh}, ``[:, 2D:]`` = W_ch;
+  pre-projected input chunks ordered {u, r, c} (ref gru_op).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import create_parameter
+from ..ops.dispatch import call
+from ..tensor.tensor import Tensor
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def _act(name):
+    if callable(name):
+        return name
+    return _ACTS[name]
+
+
+def _lens_or_full(lengths, like, T):
+    if lengths is not None:
+        return lengths
+    B = like.shape[0]
+    return jnp.full((B,), T, jnp.int32)
+
+
+def _masked_scan(step, carries, xs_t, lens, T):
+    """Scan ``step`` over time, freezing every carry once t >= lens and
+    zeroing the per-step outputs there (padded rows of the reference's LoD
+    output are simply absent; here they are zero)."""
+    def body(carry, inp):
+        t, x_t = inp
+        new_carry, outs = step(carry, x_t)
+        alive = (t < lens)[:, None]
+        new_carry = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(alive, n, o), new_carry, carry)
+        outs = jax.tree_util.tree_map(
+            lambda o: jnp.where(alive, o, jnp.zeros_like(o)), outs)
+        return new_carry, outs
+
+    return jax.lax.scan(body, carries, (jnp.arange(T), xs_t))
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 lengths=None):
+    """Padded form of fluid.layers.dynamic_lstm (ref rnn.py:2262).
+
+    input: [B, T, 4*hidden] pre-projected (x @ W_x, no bias), hidden =
+    size // 4.  Returns (hidden [B, T, D], cell [B, T, D]), zero rows past
+    ``lengths``.
+    """
+    D = size // 4
+    weight = create_parameter([D, 4 * D], dtype, attr=param_attr)
+    bias_w = 7 * D if use_peepholes else 4 * D
+    bias = create_parameter([1, bias_w], dtype, attr=bias_attr, is_bias=True)
+    act_g = _act(gate_activation)
+    act_c = _act(cell_activation)
+    act_cand = _act(candidate_activation)
+
+    T = int(input.shape[1])
+
+    def _run(x, w, b, lens, h0, c0):
+        if is_reverse:
+            t = jnp.arange(T)[None, :]
+            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+            x = jnp.take_along_axis(x, src[..., None], axis=1)
+        gb = b[:, :4 * D]
+        if use_peepholes:
+            w_ic = b[:, 4 * D:5 * D]
+            w_fc = b[:, 5 * D:6 * D]
+            w_oc = b[:, 6 * D:7 * D]
+
+        def step(carry, x_t):
+            h, c = carry
+            g = x_t + h @ w + gb                       # [B, 4D]
+            gc, gi, gf, go = jnp.split(g, 4, axis=-1)  # {c, i, f, o}
+            if use_peepholes:
+                gi = gi + w_ic * c
+                gf = gf + w_fc * c
+            i = act_g(gi)
+            f = act_g(gf)
+            cand = act_cand(gc)
+            c_new = f * c + i * cand
+            o = act_g(go + (w_oc * c_new if use_peepholes else 0.0))
+            h_new = o * act_c(c_new)
+            return (h_new, c_new), (h_new, c_new)
+
+        xs_t = jnp.swapaxes(x, 0, 1)                   # [T, B, 4D]
+        _, (hs, cs) = _masked_scan(step, (h0, c0), xs_t, lens, T)
+        hs = jnp.swapaxes(hs, 0, 1)
+        cs = jnp.swapaxes(cs, 0, 1)
+        if is_reverse:
+            t = jnp.arange(T)[None, :]
+            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+            hs = jnp.take_along_axis(hs, src[..., None], axis=1)
+            cs = jnp.take_along_axis(cs, src[..., None], axis=1)
+        return hs, cs
+
+    B = int(input.shape[0])
+    zeros = jnp.zeros((B, D), input.value.dtype if isinstance(input, Tensor)
+                      else jnp.asarray(input).dtype)
+    lens = _lens_or_full(lengths, input, T)
+    return call(_run, input, weight, bias, lens,
+                zeros if h_0 is None else h_0,
+                zeros if c_0 is None else c_0,
+                _nondiff=(3,), _name="dynamic_lstm")
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None, lengths=None):
+    """Padded form of fluid.layers.dynamic_lstmp (ref rnn.py:2616): LSTM
+    with a learned recurrent projection r_t = act_p(h_t @ W_proj), the
+    projection being what recurs.  input: [B, T, 4*hidden]; returns
+    (projection [B, T, P], cell [B, T, D])."""
+    D = size // 4
+    P = proj_size
+    weight = create_parameter([P, 4 * D], dtype, attr=param_attr)
+    proj_weight = create_parameter([D, P], dtype, attr=param_attr)
+    bias_w = 7 * D if use_peepholes else 4 * D
+    bias = create_parameter([1, bias_w], dtype, attr=bias_attr, is_bias=True)
+    act_g = _act(gate_activation)
+    act_c = _act(cell_activation)
+    act_cand = _act(candidate_activation)
+    act_p = _act(proj_activation)
+
+    T = int(input.shape[1])
+
+    def _run(x, w, wp, b, lens, r0, c0):
+        if is_reverse:
+            t = jnp.arange(T)[None, :]
+            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+            x = jnp.take_along_axis(x, src[..., None], axis=1)
+        gb = b[:, :4 * D]
+        if use_peepholes:
+            w_ic = b[:, 4 * D:5 * D]
+            w_fc = b[:, 5 * D:6 * D]
+            w_oc = b[:, 6 * D:7 * D]
+
+        def step(carry, x_t):
+            r, c = carry
+            g = x_t + r @ w + gb
+            gc, gi, gf, go = jnp.split(g, 4, axis=-1)
+            if use_peepholes:
+                gi = gi + w_ic * c
+                gf = gf + w_fc * c
+            i = act_g(gi)
+            f = act_g(gf)
+            cand = act_cand(gc)
+            c_new = f * c + i * cand
+            if cell_clip is not None:
+                c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+            o = act_g(go + (w_oc * c_new if use_peepholes else 0.0))
+            h_new = o * act_c(c_new)
+            r_new = act_p(h_new @ wp)
+            if proj_clip is not None:
+                r_new = jnp.clip(r_new, -proj_clip, proj_clip)
+            return (r_new, c_new), (r_new, c_new)
+
+        xs_t = jnp.swapaxes(x, 0, 1)
+        _, (rs, cs) = _masked_scan(step, (r0, c0), xs_t, lens, T)
+        rs = jnp.swapaxes(rs, 0, 1)
+        cs = jnp.swapaxes(cs, 0, 1)
+        if is_reverse:
+            t = jnp.arange(T)[None, :]
+            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+            rs = jnp.take_along_axis(rs, src[..., None], axis=1)
+            cs = jnp.take_along_axis(cs, src[..., None], axis=1)
+        return rs, cs
+
+    B = int(input.shape[0])
+    dt = input.value.dtype if isinstance(input, Tensor) else jnp.float32
+    lens = _lens_or_full(lengths, input, T)
+    return call(_run, input, weight, proj_weight, bias, lens,
+                jnp.zeros((B, P), dt) if h_0 is None else h_0,
+                jnp.zeros((B, D), dt) if c_0 is None else c_0,
+                _nondiff=(4,), _name="dynamic_lstmp")
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                lengths=None):
+    """Padded form of fluid.layers.dynamic_gru (ref rnn.py:2835).
+
+    input: [B, T, 3*size] pre-projected, chunk order {u, r, c}.  Weight
+    [D, 3D] = {W_uh, W_rh | W_ch}; bias [1, 3D] added to the input gates.
+    origin_mode=False (default): h_t = (1-u)*h_{t-1} + u*c~ (1412.3555);
+    origin_mode=True: h_t = u*h_{t-1} + (1-u)*c~ (1406.1078).
+    Returns hidden [B, T, D]."""
+    D = size
+    weight = create_parameter([D, 3 * D], "float32", attr=param_attr)
+    bias = create_parameter([1, 3 * D], "float32", attr=bias_attr,
+                            is_bias=True)
+    act_g = _act(gate_activation)
+    act_c = _act(candidate_activation)
+
+    T = int(input.shape[1])
+
+    def _run(x, w, b, lens, h0):
+        if is_reverse:
+            t = jnp.arange(T)[None, :]
+            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+            x = jnp.take_along_axis(x, src[..., None], axis=1)
+
+        def step(h, x_t):
+            g = x_t + b                                # [B, 3D]
+            xu, xr, xc = jnp.split(g, 3, axis=-1)
+            hg = h @ w[:, :2 * D]
+            u = act_g(xu + hg[:, :D])
+            r = act_g(xr + hg[:, D:])
+            cand = act_c(xc + (r * h) @ w[:, 2 * D:])
+            if origin_mode:
+                h_new = u * h + (1.0 - u) * cand
+            else:
+                h_new = (1.0 - u) * h + u * cand
+            return h_new, h_new
+
+        xs_t = jnp.swapaxes(x, 0, 1)
+        _, hs = _masked_scan(step, h0, xs_t, lens, T)
+        hs = jnp.swapaxes(hs, 0, 1)
+        if is_reverse:
+            t = jnp.arange(T)[None, :]
+            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+            hs = jnp.take_along_axis(hs, src[..., None], axis=1)
+        return hs
+
+    B = int(input.shape[0])
+    dt = input.value.dtype if isinstance(input, Tensor) else jnp.float32
+    lens = _lens_or_full(lengths, input, T)
+    return call(_run, input, weight, bias, lens,
+                jnp.zeros((B, D), dt) if h_0 is None else h_0,
+                _nondiff=(3,), _name="dynamic_gru")
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """Single GRU step (ref rnn.py:2998 / gru_unit_op).  ``size`` is
+    3 * hidden_size as in the reference.  input: [B, 3D] pre-projected
+    {u, r, c}; hidden: [B, D].  Returns (updated_hidden, reset_hidden_pre,
+    gate) where gate is the activated [B, 3D] {u, r, c~} block."""
+    D = size // 3
+    weight = create_parameter([D, 3 * D], "float32", attr=param_attr)
+    bias = create_parameter([1, 3 * D], "float32", attr=bias_attr,
+                            is_bias=True)
+    act_g = _act(gate_activation)
+    act_c = _act(activation)
+
+    def _step(x, h, w, b):
+        g = x + b
+        xu, xr, xc = jnp.split(g, 3, axis=-1)
+        hg = h @ w[:, :2 * D]
+        u = act_g(xu + hg[:, :D])
+        r = act_g(xr + hg[:, D:])
+        reset_hidden_pre = r * h
+        cand = act_c(xc + reset_hidden_pre @ w[:, 2 * D:])
+        if origin_mode:
+            h_new = u * h + (1.0 - u) * cand
+        else:
+            h_new = (1.0 - u) * h + u * cand
+        gate = jnp.concatenate([u, r, cand], axis=-1)
+        return h_new, reset_hidden_pre, gate
+
+    return call(_step, input, hidden, weight, bias, _name="gru_unit")
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam-search selection step (ref rnn.py:3154 / beam_search_op).
+
+    Fixed-shape form of the reference's 2-level-LoD op: rows are the
+    flattened [batch * beam_size] beams.
+
+    pre_ids: [B*K, 1] int — selected ids of the previous step (first step:
+    start tokens).  pre_scores: [B*K, 1] — accumulated scores (emulate the
+    reference's first-step single-beam LoD by passing -1e9 for beams
+    1..K-1).  ids/scores: [B*K, W] — per-beam candidate ids and their
+    (accumulated if is_accumulated else per-step-probability) scores.
+
+    A beam whose pre_id == end_id is finished: it contributes exactly one
+    candidate (itself, at its accumulated score), matching the reference's
+    ended-translation handling.  Returns (selected_ids [B*K, 1],
+    selected_scores [B*K, 1][, parent_idx [B*K] flat row indices]).
+    """
+    K = beam_size
+
+    def _step(pids, pscores, cids, cscores):
+        BK, W = cscores.shape
+        B = BK // K
+        pids = pids.reshape(B, K)
+        pscores = pscores.reshape(B, K)
+        cids = cids.reshape(B, K, W)
+        cs = cscores.reshape(B, K, W).astype(jnp.float32)
+        if not is_accumulated:
+            cs = pscores[..., None] + jnp.log(jnp.maximum(cs, 1e-20))
+        ended = pids == end_id                           # [B, K]
+        # finished beams: single candidate slot 0 = (end_id, pre_score)
+        slot0 = jnp.arange(W)[None, None, :] == 0
+        cs = jnp.where(ended[..., None],
+                       jnp.where(slot0, pscores[..., None], -1e9), cs)
+        cand_ids = jnp.where(ended[..., None], end_id, cids)
+        flat_scores = cs.reshape(B, K * W)
+        top_scores, top_idx = jax.lax.top_k(flat_scores, K)   # [B, K]
+        parent = top_idx // W                                 # beam index
+        sel_ids = jnp.take_along_axis(
+            cand_ids.reshape(B, K * W), top_idx, axis=1)
+        parent_flat = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        # int32: x64 mode is off on TPU, int64 would truncate (noisily)
+        return (sel_ids.reshape(BK, 1).astype(jnp.int32),
+                top_scores.reshape(BK, 1),
+                parent_flat.astype(jnp.int32))
+
+    out = call(_step, pre_ids, pre_scores, ids, scores,
+               _nondiff=(0, 2), _name="beam_search")
+    sel_ids, sel_scores, parent_idx = out
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
+                       name=None):
+    """Backtrace completed beam-search paths (ref rnn.py:3313 /
+    beam_search_decode_op).
+
+    Fixed-shape form: ``ids``/``scores`` are the per-step outputs of
+    :func:`beam_search` — either lists of [B*K, 1] steps (TensorArray
+    analog) or stacked [T, B*K, 1] tensors — and ``parents`` the matching
+    parent_idx rows ([T, B*K] or list).  The reference recovers parents
+    from the LoD; the padded form threads them explicitly
+    (return_parent_idx=True).
+
+    Returns (sentence_ids [B, K, T], sentence_scores [B, K, T]): each
+    beam's full token path (via gather_tree ancestry walk) and the
+    accumulated score at every step, with end_id fill after termination.
+    """
+    from ..tensor import manipulation as manip
+
+    def _stack(xs):
+        if isinstance(xs, (list, tuple)):
+            return manip.stack(list(xs), 0)
+        return xs
+
+    ids_t = _stack(ids)          # [T, B*K, 1] or [T, B*K]
+    scores_t = _stack(scores)
+    if parents is None:
+        raise ValueError(
+            "beam_search_decode (padded form) needs the parent_idx chain: "
+            "call beam_search(..., return_parent_idx=True) and pass the "
+            "collected parents here")
+    parents_t = _stack(parents)
+
+    K = beam_size
+
+    def _decode(idv, scv, parv):
+        T = idv.shape[0]
+        BK = idv.reshape(T, -1).shape[1]
+        B = BK // K
+        idv = idv.reshape(T, B, K)
+        scv = scv.reshape(T, B, K)
+        parv = (parv.reshape(T, B, K) % K).astype(jnp.int32)
+
+        # gather_tree-style reversed ancestry walk carrying BOTH the token
+        # and its accumulated score (extension.gather_tree walks ids only)
+        def step(beam_idx, t):
+            tok = jnp.take_along_axis(idv[t], beam_idx, axis=-1)
+            sc = jnp.take_along_axis(scv[t], beam_idx, axis=-1)
+            nxt = jnp.take_along_axis(parv[t], beam_idx, axis=-1)
+            return nxt, (tok, sc)
+
+        init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K))
+        _, (toks, scs) = jax.lax.scan(step, init,
+                                      jnp.arange(T - 1, -1, -1))
+        toks = toks[::-1]                                 # [T, B, K]
+        scs = scs[::-1]
+        t_bk = jnp.transpose(toks, (1, 2, 0))             # [B, K, T]
+        s_bk = jnp.transpose(scs, (1, 2, 0))
+        # after the first end_id the sequence has ended: fill ids with
+        # end_id (the reference's shorter LoD rows, padded form)
+        is_end = t_bk == end_id
+        ended_before = jnp.cumsum(is_end.astype(jnp.int32), -1) \
+            - is_end.astype(jnp.int32) > 0
+        t_bk = jnp.where(ended_before, end_id, t_bk)
+        return t_bk, s_bk
+
+    return call(_decode, ids_t, scores_t, parents_t,
+                _nondiff=(0, 2), _name="beam_search_decode")
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1, lengths=None):
+    """Multi-layer (optionally bidirectional) LSTM, the cudnn-style
+    fluid.layers.lstm (ref rnn.py:2439).  input: [B, T, D_in];
+    init_h/init_c: [num_layers * num_directions, B, hidden_size].
+    ``max_len`` is ignored, as in the reference.  Dropout applies between
+    layers only (not through time), disabled when is_test.
+
+    Returns (rnn_out [B, T, D or 2D], last_h, last_c) with last_h/last_c
+    shaped like init_h/init_c.  Weights are op-internal (the reference's
+    flat cudnn param blob is likewise opaque); gate order is {i, f, c, o}.
+    """
+    num_dirs = 2 if is_bidirec else 1
+    D = hidden_size
+    std = 1.0 / math.sqrt(D)
+    from ..nn.initializer import Uniform
+    init = default_initializer or Uniform(-std, std)
+
+    ws = []
+    in_size = int(input.shape[-1])
+    for layer in range(num_layers):
+        lin = in_size if layer == 0 else D * num_dirs
+        for _ in range(num_dirs):
+            ws.append(create_parameter([lin, 4 * D], "float32",
+                                       default_initializer=init))
+            ws.append(create_parameter([D, 4 * D], "float32",
+                                       default_initializer=init))
+            ws.append(create_parameter([1, 4 * D], "float32", is_bias=True,
+                                       default_initializer=init))
+    T = int(input.shape[1])
+    B = int(input.shape[0])
+
+    def _run(x, lens, h0, c0, *flat_ws):
+        def one_direction(xs, w_ih, w_hh, b, h_init, c_init, reverse):
+            if reverse:
+                t = jnp.arange(T)[None, :]
+                src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+                xs = jnp.take_along_axis(xs, src[..., None], axis=1)
+
+            def step(carry, x_t):
+                h, c = carry
+                g = x_t @ w_ih + h @ w_hh + b
+                i, f, cand, o = jnp.split(g, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                f = jax.nn.sigmoid(f)
+                cand = jnp.tanh(cand)
+                c_new = f * c + i * cand
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return (h_new, c_new), (h_new, c_new)
+
+            xs_t = jnp.swapaxes(xs, 0, 1)
+            (h_fin, c_fin), (hs, cs) = _masked_scan(
+                step, (h_init, c_init), xs_t, lens, T)
+            hs = jnp.swapaxes(hs, 0, 1)
+            if reverse:
+                t = jnp.arange(T)[None, :]
+                src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+                hs = jnp.take_along_axis(hs, src[..., None], axis=1)
+            return hs, h_fin, c_fin
+
+        out = x
+        last_h, last_c = [], []
+        idx = 0
+        for layer in range(num_layers):
+            outs = []
+            for d in range(num_dirs):
+                w_ih, w_hh, b = flat_ws[idx:idx + 3]
+                idx += 3
+                s = layer * num_dirs + d
+                hs, h_fin, c_fin = one_direction(
+                    out, w_ih, w_hh, b, h0[s], c0[s], reverse=d == 1)
+                outs.append(hs)
+                last_h.append(h_fin)
+                last_c.append(c_fin)
+            out = outs[0] if num_dirs == 1 else jnp.concatenate(outs, -1)
+            if dropout_prob and not is_test and layer < num_layers - 1:
+                # per-layer fold + data-dependent fold: a constant key
+                # would freeze the mask across every training step (the
+                # jitted fn sees the same trace-time key); folding in a
+                # hash of the activations varies it per call like the
+                # reference's stateful cudnn dropout RNG
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(seed if seed >= 0 else 7), layer)
+                key = jax.random.fold_in(
+                    key, (jnp.sum(out * 1e3).astype(jnp.int32) & 0x7fff))
+                keep = 1.0 - dropout_prob
+                m = jax.random.bernoulli(key, keep, out.shape)
+                out = jnp.where(m, out / keep, 0.0)
+        return out, jnp.stack(last_h), jnp.stack(last_c)
+
+    lens = _lens_or_full(lengths, input, T)
+    zeros = jnp.zeros((num_layers * num_dirs, B, D), jnp.float32)
+    return call(_run, input, lens,
+                zeros if init_h is None else init_h,
+                zeros if init_c is None else init_c,
+                *ws, _nondiff=(1,), _name="lstm")

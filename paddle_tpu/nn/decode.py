@@ -141,22 +141,34 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
     inputs, states, finished = decoder.initialize(inits)
     outputs_list = []
     seq_len = None
+    # per-sequence (and per-beam) length: a slot still counts the step
+    # that first emits its end token, then freezes (reference
+    # dynamic_decode tracks this via the finished mask)
+    fin_np = np.asarray(finished.numpy()).astype(bool)
+    lengths_np = np.zeros(fin_np.shape, np.int64)
     for t in range(int(max_step_num)):
         out, states, next_inputs, finished = decoder.step(t, inputs, states,
                                                           **kwargs)
         outputs_list.append(out)
+        lengths_np = lengths_np + (~fin_np).astype(np.int64)
+        fin_np = np.asarray(finished.numpy()).astype(bool)
         inputs = next_inputs
-        if bool(np.all(finished.numpy())):
+        if bool(np.all(fin_np)):
             break
     # finalize always sees TIME-MAJOR [T, B, ...] (reference contract);
-    # the requested orientation is applied after
-    outputs = manip.stack(outputs_list, axis=0)
+    # the requested orientation is applied after.  Step outputs may be a
+    # structure (BasicDecoderOutput namedtuples) — stack leaf-wise.
+    import jax.tree_util as jtu
+    is_leaf = lambda x: isinstance(x, Tensor)     # noqa: E731
+    outputs = jtu.tree_map(lambda *xs: manip.stack(list(xs), axis=0),
+                           *outputs_list, is_leaf=is_leaf)
     outputs, final_states = decoder.finalize(outputs, states, seq_len)
-    batch = outputs.shape[1]        # time-major here: [T, B, ...]
     if not output_time_major:
-        perm = [1, 0] + list(range(2, len(outputs.shape)))
-        outputs = manip.transpose(outputs, perm)
+        def _bm(x):
+            perm = [1, 0] + list(range(2, len(x.shape)))
+            return manip.transpose(x, perm)
+        outputs = jtu.tree_map(_bm, outputs, is_leaf=is_leaf)
     if return_length:
-        lengths = Tensor(np.full(batch, len(outputs_list)))
+        lengths = Tensor(lengths_np)
         return outputs, final_states, lengths
     return outputs, final_states

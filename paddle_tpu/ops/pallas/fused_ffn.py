@@ -74,6 +74,9 @@ def _fused_ffn_tpu(x2d, w1, b1, w2, b2, block_m, block_f, interpret):
         out_specs=pl.BlockSpec((block_m, H), lambda m, f: (m, 0)),
         out_shape=jax.ShapeDtypeStruct((M, H), x2d.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, H), jnp.float32)],
+        # row blocks are independent; only the f (accumulator) axis carries
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x2d, w1, b1.reshape(1, F), w2, b2.reshape(1, H))
 

@@ -25,6 +25,7 @@ from .utils import (HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu,
 
 if _HAS_PALLAS:
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
 
 def _ref_layer_norm(x, g, b, eps):
@@ -86,6 +87,9 @@ def _pallas_norm(kernel, out_dtype, x2d, *scale_args, interpret):
         in_specs=in_specs,
         out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, H), out_dtype),
+        # every row block is independent — let Mosaic pipeline them
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x2d, *scale_args)
 

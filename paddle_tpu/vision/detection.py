@@ -348,23 +348,31 @@ def target_assign(input, matched_indices, negative_indices=None,
 # NMS family — fixed-size outputs (TPU contract: label -1 marks padding)
 # --------------------------------------------------------------------------
 
-def _nms_single_class(scores, iou_full, iou_threshold, top_k):
+def _nms_single_class(scores, iou_full, iou_threshold, top_k, eta=1.0):
     """scores [N], iou_full [N,N] (original order, shared across classes)
     -> keep mask [N] via greedy NMS over the top_k highest-scoring boxes
-    (lax.fori_loop, static shapes)."""
+    (lax.fori_loop, static shapes).  eta < 1 enables the reference's
+    adaptive NMS (nms_op NMSFast): each time a box is kept and the current
+    threshold exceeds 0.5, threshold *= eta."""
     N = scores.shape[0]
     K = min(top_k, N)
     order = jnp.argsort(-scores)
     iou = iou_full[order][:, order]
+    adaptive = eta is not None and eta < 1.0
 
-    def body(i, keep):
+    def body(i, carry):
+        keep, thr = carry
         # suppressed if any higher-ranked KEPT box overlaps > threshold
         higher = jnp.arange(N) < i
-        sup = jnp.any((iou[i] > iou_threshold) & keep & higher)
-        return keep.at[i].set(~sup & keep[i])
+        sup = jnp.any((iou[i] > thr) & keep & higher)
+        kept_i = ~sup & keep[i]
+        if adaptive:
+            thr = jnp.where(kept_i & (thr > 0.5), thr * eta, thr)
+        return keep.at[i].set(kept_i), thr
 
     keep0 = jnp.ones((N,), bool)
-    keep = jax.lax.fori_loop(0, K, body, keep0)
+    keep, _ = jax.lax.fori_loop(0, K, body,
+                                (keep0, jnp.float32(iou_threshold)))
     keep = keep & (jnp.arange(N) < K)
     # map back to original order
     inv = jnp.zeros((N,), jnp.int32).at[order].set(jnp.arange(N))
@@ -373,11 +381,14 @@ def _nms_single_class(scores, iou_full, iou_threshold, top_k):
 
 def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                    keep_top_k=100, nms_threshold=0.3, normalized=True,
-                   nms_eta=1.0, background_label=0, name=None):
+                   nms_eta=1.0, background_label=0, name=None,
+                   return_index=False):
     """Per-class NMS (ref multiclass_nms_op).  bboxes [B, N, 4], scores
     [B, C, N].  Returns [B, keep_top_k, 6] rows (label, score, x1, y1,
     x2, y2); invalid rows have label -1 — the fixed-shape analogue of the
-    reference's ragged LoD output."""
+    reference's ragged LoD output.  With return_index, also returns the
+    kept rows' original box indices [B, keep_top_k] (-1 on padding), the
+    multiclass_nms2/nms3 contract."""
     def _mn(bb, sc):
         B, C, N = sc.shape
 
@@ -394,7 +405,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                 valid = s > score_threshold
                 s_m = jnp.where(valid, s, -1e9)
                 keep = _nms_single_class(s_m, iou_full, nms_threshold,
-                                         nms_top_k) & valid
+                                         nms_top_k, eta=nms_eta) & valid
                 keeps.append(keep)
             keep_all = jnp.stack(keeps)                      # [C, N]
             flat_scores = jnp.where(keep_all, scores_ci, -1e9).reshape(-1)
@@ -407,11 +418,15 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                 jnp.where(valid, lbl, -1.0)[:, None],
                 jnp.where(valid, flat_scores[top], 0.0)[:, None],
                 jnp.where(valid[:, None], boxes[idx], 0.0)], -1)
-            return rows
-        return jax.vmap(per_image)(bb.astype(jnp.float32),
-                                   sc.astype(jnp.float32))
-    return call(_mn, bboxes, scores, _name="multiclass_nms",
-                _nondiff=(0, 1))
+            return rows, jnp.where(valid, idx, -1).astype(jnp.int32)
+        rows, idxs = jax.vmap(per_image)(bb.astype(jnp.float32),
+                                         sc.astype(jnp.float32))
+        return rows, idxs
+    rows, idxs = call(_mn, bboxes, scores, _name="multiclass_nms",
+                      _nondiff=(0, 1))
+    if return_index:
+        return rows, idxs
+    return rows
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
@@ -448,10 +463,12 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                 ious = jnp.where(upper.T, iou, 0.0)          # j<i overlaps
                 max_iou = jnp.max(ious, axis=1)              # per box i
                 if use_gaussian:
+                    # ref matrix_nms_op.cc decay_score<T,true>:
+                    # exp((max_iou^2 - iou^2) * sigma) — sigma MULTIPLIES
                     decay = jnp.min(jnp.where(
                         upper.T,
-                        jnp.exp(-(ious ** 2 - max_iou[None, :] ** 2)
-                                / gaussian_sigma), 1.0), axis=1)
+                        jnp.exp((max_iou[None, :] ** 2 - ious ** 2)
+                                * gaussian_sigma), 1.0), axis=1)
                 else:
                     decay = jnp.min(jnp.where(
                         upper.T, (1 - ious) / jnp.maximum(
@@ -791,7 +808,15 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                        nms_eta=1.0, background_label=-1, name=None):
     """ref locality_aware_nms_op (EAST text detection): consecutive
     same-class boxes that overlap merge by score-weighted average BEFORE
-    standard multiclass NMS."""
+    standard multiclass NMS.
+
+    Documented deviation from the reference: the reference merges
+    score-sorted boxes SEQUENTIALLY (each box into its running
+    consecutively-adjacent neighbour), so chains of partially-overlapping
+    boxes merge transitively one at a time; this op merges every
+    above-threshold pair in one symmetric weighted pass — a parallel,
+    TPU-friendly one-shot form.  Results differ only for chained text
+    geometries; both collapse duplicate detections before the NMS stage."""
     def _merge(bb, sc):
         def per_image(boxes, s):
             # weighted merge: each box absorbs its overlapping neighbours,
@@ -811,5 +836,447 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                   _nondiff=(0, 1))
     return multiclass_nms(merged, scores, score_threshold=score_threshold,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
-                          nms_threshold=nms_threshold,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
                           background_label=background_label)
+
+
+# --------------------------------------------------------------------------
+# FPN / RetinaNet family (ref fluid/layers/detection.py:70 retinanet_target_
+# assign, :2504 roi_perspective_transform, :3106 retinanet_detection_output,
+# :3673 distribute_fpn_proposals, :3871 collect_fpn_proposals)
+# --------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """Route each RoI to its FPN level by scale (ref detection.py:3673 /
+    distribute_fpn_proposals_op): level = floor(log2(sqrt(area) /
+    refer_scale) + refer_level), clipped to [min_level, max_level].
+
+    Fixed-shape form: fpn_rois [N, 4] (zero rows = padding when rois_num
+    is given).  Each level output is [N, 4] with that level's RoIs
+    compacted to the front (stable order) and zero rows after; the
+    per-level valid counts come back as rois_num_per_level.  restore_ind
+    [N, 1] maps the level-concatenated layout back to the input order:
+    concat(multi_rois)[restore_ind] == fpn_rois.
+    """
+    num_lvl = max_level - min_level + 1
+
+    def _dist(rois, *rest):
+        N = rois.shape[0]
+        if rest:
+            n_valid = jnp.sum(rest[0]).astype(jnp.int32)
+        else:
+            n_valid = jnp.int32(N)
+        valid = jnp.arange(N) < n_valid
+        w = rois[:, 2] - rois[:, 0]
+        h = rois[:, 3] - rois[:, 1]
+        if pixel_offset:          # reference BBoxArea(+1 pixel convention)
+            area = (w + 1.0) * (h + 1.0)
+        else:
+            area = w * h
+        scale = jnp.sqrt(jnp.maximum(area, 0.0))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6) + refer_level)
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        multi, counts = [], []
+        # restore_ind: position of original roi i inside concat(multi)
+        pos = jnp.full((N,), -1, jnp.int32)
+        for li, L in enumerate(range(min_level, max_level + 1)):
+            sel = (lvl == L) & valid
+            # stable compaction: rows of this level first, original order
+            order = jnp.argsort(jnp.where(sel, jnp.arange(N),
+                                          N + jnp.arange(N)))
+            compacted = jnp.where(
+                (jnp.arange(N) < jnp.sum(sel))[:, None], rois[order], 0.0)
+            multi.append(compacted)
+            counts.append(jnp.sum(sel).astype(jnp.int32))
+            # order[j] = original index placed at slot j of level li
+            in_level = sel[order]
+            pos = pos.at[order].max(
+                jnp.where(in_level, jnp.arange(N) + li * N, -1))
+        return (*multi, pos.reshape(N, 1), *counts)
+
+    args = [fpn_rois] + ([rois_num] if rois_num is not None else [])
+    out = call(_dist, *args, _name="distribute_fpn_proposals",
+               _nondiff=tuple(range(len(args))))
+    multi_rois = list(out[:num_lvl])
+    restore_ind = out[num_lvl]
+    counts = list(out[num_lvl + 1:])
+    if rois_num is not None:
+        return multi_rois, restore_ind, counts
+    return multi_rois, restore_ind
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Concat per-level RoIs and keep the post_nms_top_n best by score
+    (ref detection.py:3871 / collect_fpn_proposals_op).
+
+    Fixed-shape form: each level is [Ni, 4] rois + [Ni] (or [Ni, 1])
+    scores, with rois_num_per_level marking the valid prefix per level.
+    Returns (fpn_rois [post_nms_top_n, 4], rois_num) — padding rows zero.
+    """
+    num_lvl = max_level - min_level + 1
+    assert len(multi_rois) == num_lvl and len(multi_scores) == num_lvl
+
+    def _collect(*flat):
+        rois = flat[:num_lvl]
+        scores = flat[num_lvl:2 * num_lvl]
+        nums = flat[2 * num_lvl:]
+        parts_r, parts_s = [], []
+        for i in range(num_lvl):
+            r = rois[i].reshape(-1, 4)
+            s = scores[i].reshape(-1).astype(jnp.float32)
+            if nums:
+                v = jnp.arange(r.shape[0]) < nums[i]
+                s = jnp.where(v, s, -1e9)
+            parts_r.append(r)
+            parts_s.append(s)
+        allr = jnp.concatenate(parts_r, 0)
+        alls = jnp.concatenate(parts_s, 0)
+        K = min(post_nms_top_n, allr.shape[0])
+        top_s, top_i = jax.lax.top_k(alls, K)
+        valid = top_s > -1e8
+        out = jnp.where(valid[:, None], allr[top_i], 0.0)
+        if K < post_nms_top_n:
+            out = jnp.pad(out, ((0, post_nms_top_n - K), (0, 0)))
+            valid = jnp.pad(valid, (0, post_nms_top_n - K))
+        return out, jnp.sum(valid.astype(jnp.int32)).reshape(1)
+
+    args = list(multi_rois) + list(multi_scores) + (
+        list(rois_num_per_level) if rois_num_per_level is not None else [])
+    out, num = call(_collect, *args, _name="collect_fpn_proposals",
+                    _nondiff=tuple(range(len(args))))
+    if rois_num_per_level is not None:
+        return out, num
+    return out
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet training targets (ref detection.py:70 /
+    rpn_target_assign_op.cc retinanet path).
+
+    DENSE form (TPU contract, like this module's rpn_target_assign):
+    instead of gathered index lists, returns per-anchor tensors —
+
+      (score_pred [B, M, C], loc_pred [B, M, 4],
+       target_label [B, M] int32, target_bbox [B, M, 4],
+       bbox_inside_weight [B, M, 4], fg_num [B, 1])
+
+    target_label holds the (1-based) gt class for positives, 0 for
+    negatives and -1 for ignored anchors; bbox_inside_weight is 1 on
+    positive rows.  Assignment rules match the reference: an anchor is
+    positive when it is some gt's argmax anchor or its best IoU >=
+    positive_overlap; negative when best IoU < negative_overlap; crowd
+    gts are excluded.  score_pred / loc_pred are the inputs passed
+    through so downstream losses mask with the dense labels.
+    """
+    def _assign(ab, gb, gl, *rest):
+        crowd = rest[0] if len(rest) >= 1 else None
+        ab_f = ab.reshape(-1, 4).astype(jnp.float32)
+        M = ab_f.shape[0]
+
+        def per_image(gt, lbl, cr):
+            valid_g = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+            if cr is not None:
+                valid_g = valid_g & (cr.reshape(-1) == 0)
+            iou = _pairwise_iou(gt, ab_f)                    # [G, M]
+            iou = jnp.where(valid_g[:, None], iou, -1.0)
+            best_iou = jnp.max(iou, axis=0)
+            best_g = jnp.argmax(iou, axis=0)
+            fg = best_iou >= positive_overlap
+            G = gt.shape[0]
+            best_a = jnp.argmax(iou, axis=1)
+            # .max, not .set: duplicate best_a indices (degenerate gts all
+            # argmax to anchor 0) must never clobber a valid force-match
+            force = jnp.zeros((M,), bool).at[best_a].max(valid_g)
+            fg = fg | force
+            bg = (best_iou < negative_overlap) & ~fg
+            labels = jnp.where(fg, lbl.reshape(-1)[best_g].astype(jnp.int32),
+                               jnp.where(bg, 0, -1))
+            tgt = gt[best_g]
+            aw = ab_f[:, 2] - ab_f[:, 0] + 1.0
+            ah = ab_f[:, 3] - ab_f[:, 1] + 1.0
+            acx = ab_f[:, 0] + aw * 0.5
+            acy = ab_f[:, 1] + ah * 0.5
+            tw = tgt[:, 2] - tgt[:, 0] + 1.0
+            th = tgt[:, 3] - tgt[:, 1] + 1.0
+            tcx = tgt[:, 0] + tw * 0.5
+            tcy = tgt[:, 1] + th * 0.5
+            enc = jnp.stack([(tcx - acx) / aw, (tcy - acy) / ah,
+                             jnp.log(jnp.maximum(tw / aw, 1e-10)),
+                             jnp.log(jnp.maximum(th / ah, 1e-10))], -1)
+            enc = jnp.where(fg[:, None], enc, 0.0)
+            inside_w = jnp.where(fg[:, None],
+                                 jnp.ones((M, 4), jnp.float32), 0.0)
+            return labels, enc, inside_w, jnp.sum(fg.astype(jnp.int32))
+
+        gb_f = gb.astype(jnp.float32)
+        if gb_f.ndim == 2:
+            gb_f = gb_f[None]
+        gl_b = gl if gl.ndim >= 2 else gl[None]
+        if crowd is None:
+            labels, enc, iw, nfg = jax.vmap(
+                lambda g, l: per_image(g, l, None))(gb_f, gl_b)
+        else:
+            cr_b = crowd if crowd.ndim >= 2 else crowd[None]
+            labels, enc, iw, nfg = jax.vmap(per_image)(gb_f, gl_b, cr_b)
+        # reference fg_num counts foregrounds + 1 (focal-loss normalizer
+        # never zero; rpn_target_assign_op.cc retinanet branch)
+        return labels, enc, iw, (nfg + 1).reshape(-1, 1)
+
+    args = [anchor_box, gt_boxes, gt_labels] + (
+        [is_crowd] if is_crowd is not None else [])
+    labels, enc, iw, fg_num = call(_assign, *args,
+                                   _name="retinanet_target_assign",
+                                   _nondiff=tuple(range(len(args))))
+    return cls_logits, bbox_pred, labels, enc, iw, fg_num
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference head (ref detection.py:3106 /
+    retinanet_detection_output_op.cc): per FPN level, threshold + top-k
+    the class scores, decode the matching anchor deltas
+    (cx = dx*aw + acx, w = exp(dw)*aw, corner -1, /im_scale, clip), then
+    multi-class NMS across the merged levels.
+
+    bboxes: list of [B, Mi, 4]; scores: list of [B, Mi, C] (already
+    activated); anchors: list of [Mi, 4]; im_info [B, 3] (h, w, scale).
+    The LAST level skips the score threshold (reference's small-image
+    guard).  Returns [B, keep_top_k, 6] rows (label, score, x1..y2),
+    label -1 padding — this module's fixed-shape NMS contract.
+    """
+    L = len(bboxes)
+
+    def _detect(info, *flat):
+        bxs = flat[:L]
+        scs = flat[L:2 * L]
+        ancs = flat[2 * L:]
+        B = bxs[0].shape[0]
+        C = scs[0].shape[-1]
+
+        def per_image(args):
+            deltas, cls_sc, inf = args
+            im_h = jnp.round(inf[0] / inf[2])
+            im_w = jnp.round(inf[1] / inf[2])
+            cand_boxes, cand_scores, cand_cls = [], [], []
+            for li in range(L):
+                d = deltas[li]                            # [Mi, 4]
+                s = cls_sc[li]                            # [Mi, C]
+                a = ancs[li].astype(jnp.float32)          # [Mi, 4]
+                Mi = d.shape[0]
+                flat_s = s.reshape(-1)                    # [Mi*C]
+                if li < L - 1:
+                    flat_s = jnp.where(flat_s > score_threshold,
+                                       flat_s, -1e9)
+                K = min(nms_top_k, Mi * C)
+                top_s, top_i = jax.lax.top_k(flat_s, K)
+                ai = top_i // C
+                ci = top_i % C
+                aw = a[ai, 2] - a[ai, 0] + 1.0
+                ah = a[ai, 3] - a[ai, 1] + 1.0
+                acx = a[ai, 0] + aw * 0.5
+                acy = a[ai, 1] + ah * 0.5
+                dd = d[ai]
+                cx = dd[:, 0] * aw + acx
+                cy = dd[:, 1] * ah + acy
+                w = jnp.exp(dd[:, 2]) * aw
+                h = jnp.exp(dd[:, 3]) * ah
+                x1 = (cx - w * 0.5) / inf[2]
+                y1 = (cy - h * 0.5) / inf[2]
+                x2 = (cx + w * 0.5 - 1.0) / inf[2]
+                y2 = (cy + h * 0.5 - 1.0) / inf[2]
+                x1 = jnp.clip(x1, 0.0, im_w - 1)
+                y1 = jnp.clip(y1, 0.0, im_h - 1)
+                x2 = jnp.clip(x2, 0.0, im_w - 1)
+                y2 = jnp.clip(y2, 0.0, im_h - 1)
+                cand_boxes.append(jnp.stack([x1, y1, x2, y2], -1))
+                cand_scores.append(top_s)
+                cand_cls.append(ci)
+            boxes = jnp.concatenate(cand_boxes, 0)        # [Nc, 4]
+            sc = jnp.concatenate(cand_scores, 0)          # [Nc]
+            cls = jnp.concatenate(cand_cls, 0)            # [Nc]
+            Nc = boxes.shape[0]
+            # per-class NMS over the merged candidates: scatter into a
+            # dense [C, Nc] score grid and reuse the shared-IoU machinery
+            dense = jnp.full((C, Nc), -1e9)
+            dense = dense.at[cls, jnp.arange(Nc)].set(
+                jnp.where(sc > -1e8, sc, -1e9))
+            iou_full = _pairwise_iou(boxes, boxes)
+            keeps = []
+            for c in range(C):
+                s_c = dense[c]
+                valid = s_c > -1e8
+                keep = _nms_single_class(s_c, iou_full, nms_threshold,
+                                         nms_top_k, eta=nms_eta) & valid
+                keeps.append(keep)
+            keep_all = jnp.stack(keeps)                   # [C, Nc]
+            flat = jnp.where(keep_all, dense, -1e9).reshape(-1)
+            top = jnp.argsort(-flat)[:keep_top_k]
+            lbl = (top // Nc).astype(jnp.float32)
+            idx = top % Nc
+            valid = flat[top] > -1e8
+            return jnp.concatenate([
+                jnp.where(valid, lbl, -1.0)[:, None],
+                jnp.where(valid, flat[top], 0.0)[:, None],
+                jnp.where(valid[:, None], boxes[idx], 0.0)], -1)
+
+        outs = []
+        for b in range(B):
+            outs.append(per_image((
+                [bx[b].astype(jnp.float32) for bx in bxs],
+                [s[b].astype(jnp.float32) for s in scs],
+                info[b].astype(jnp.float32))))
+        return jnp.stack(outs)
+
+    args = [im_info] + list(bboxes) + list(scores) + list(anchors)
+    return call(_detect, *args, _name="retinanet_detection_output",
+                _nondiff=tuple(range(len(args))))
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """Perspective-warp each quadrilateral RoI to a rectangle (ref
+    detection.py:2504 / roi_perspective_transform_op.cc, EAST/OCR).
+
+    input [N, C, H, W]; rois [R, 8] as (x1,y1,..,x4,y4) clockwise from
+    top-left.  The reference maps each roi to its image via LoD; the
+    fixed-shape form takes rois_num [N] (RoIs per image, prefix layout),
+    defaulting to all RoIs on image 0.  Returns (out [R, C, th, tw],
+    mask [R, 1, th, tw] int32, transform_matrix [R, 9]) with the
+    reference's exact matrix construction (estimated-size normalized
+    width, 1e-5-regularized denominators) and bilinear sampling with
+    in-quad masking.
+    """
+    th_, tw_ = int(transformed_height), int(transformed_width)
+
+    def _rpt(x, r, *rest):
+        N, C, H, W = x.shape
+        R = r.shape[0]
+        if rest:
+            counts = rest[0].astype(jnp.int32)
+            ends = jnp.cumsum(counts)
+            img_of = jnp.sum((jnp.arange(R)[:, None]
+                              >= ends[None, :]).astype(jnp.int32), -1)
+            img_of = jnp.clip(img_of, 0, N - 1)
+        else:
+            img_of = jnp.zeros((R,), jnp.int32)
+        rs = r.astype(jnp.float32) * spatial_scale
+        rx = rs[:, 0::2]                                   # [R, 4]
+        ry = rs[:, 1::2]
+
+        # reference get_transform_matrix (normalized width from the
+        # estimated roi aspect, denominators regularized by 1e-5)
+        len1 = jnp.hypot(rx[:, 0] - rx[:, 1], ry[:, 0] - ry[:, 1])
+        len2 = jnp.hypot(rx[:, 1] - rx[:, 2], ry[:, 1] - ry[:, 2])
+        len3 = jnp.hypot(rx[:, 2] - rx[:, 3], ry[:, 2] - ry[:, 3])
+        len4 = jnp.hypot(rx[:, 3] - rx[:, 0], ry[:, 3] - ry[:, 0])
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        norm_h = max(2, th_)
+        norm_w = jnp.round(est_w * (norm_h - 1)
+                           / jnp.maximum(est_h, 1e-6)) + 1.0
+        norm_w = jnp.clip(norm_w, 2.0, float(tw_))
+
+        dx1 = rx[:, 1] - rx[:, 2]
+        dx2 = rx[:, 3] - rx[:, 2]
+        dx3 = rx[:, 0] - rx[:, 1] + rx[:, 2] - rx[:, 3]
+        dy1 = ry[:, 1] - ry[:, 2]
+        dy2 = ry[:, 3] - ry[:, 2]
+        dy3 = ry[:, 0] - ry[:, 1] + ry[:, 2] - ry[:, 3]
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (norm_w - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (norm_h - 1)
+        m8 = jnp.ones_like(m6)
+        m3 = (ry[:, 1] - ry[:, 0] + m6 * (norm_w - 1) * ry[:, 1]) \
+            / (norm_w - 1)
+        m4 = (ry[:, 3] - ry[:, 0] + m7 * (norm_h - 1) * ry[:, 3]) \
+            / (norm_h - 1)
+        m5 = ry[:, 0]
+        m0 = (rx[:, 1] - rx[:, 0] + m6 * (norm_w - 1) * rx[:, 1]) \
+            / (norm_w - 1)
+        m1 = (rx[:, 3] - rx[:, 0] + m7 * (norm_h - 1) * rx[:, 3]) \
+            / (norm_h - 1)
+        m2 = rx[:, 0]
+        mat = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8], -1)  # [R,9]
+
+        ou, ov = jnp.meshgrid(jnp.arange(tw_, dtype=jnp.float32),
+                              jnp.arange(th_, dtype=jnp.float32))
+        # source coords per roi: (u,v,w) = M @ (out_w, out_h, 1)
+        u = (mat[:, 0, None, None] * ou + mat[:, 1, None, None] * ov
+             + mat[:, 2, None, None])
+        v = (mat[:, 3, None, None] * ou + mat[:, 4, None, None] * ov
+             + mat[:, 5, None, None])
+        wq = (mat[:, 6, None, None] * ou + mat[:, 7, None, None] * ov
+              + mat[:, 8, None, None])
+        in_w = u / wq                                      # [R, th, tw]
+        in_h = v / wq
+
+        # in-quad test: crossing-number ray cast + edge tolerance
+        def quad_mask(px, py, qx, qy):
+            inside = jnp.zeros(px.shape, bool)
+            on_edge = jnp.zeros(px.shape, bool)
+            for i in range(4):
+                xs, ys = qx[i], qy[i]
+                xe, ye = qx[(i + 1) % 4], qy[(i + 1) % 4]
+                flat_edge = jnp.abs(ys - ye) < 1e-4
+                on_flat = (jnp.abs(py - ys) < 1e-4) \
+                    & (jnp.abs(py - ye) < 1e-4) \
+                    & (px >= jnp.minimum(xs, xe) - 1e-4) \
+                    & (px <= jnp.maximum(xs, xe) + 1e-4)
+                ix = (py - ys) * (xe - xs) / jnp.where(
+                    flat_edge, 1.0, ye - ys) + xs
+                on_slant = (jnp.abs(ix - px) < 1e-4) \
+                    & (py >= jnp.minimum(ys, ye) - 1e-4) \
+                    & (py <= jnp.maximum(ys, ye) + 1e-4)
+                on_edge = on_edge | jnp.where(flat_edge, on_flat,
+                                              on_slant)
+                crosses = ((ys > py) != (ye > py)) & (
+                    px < (xe - xs) * (py - ys)
+                    / jnp.where(jnp.abs(ye - ys) < 1e-12, 1e-12, ye - ys)
+                    + xs)
+                inside = inside ^ crosses
+            return inside | on_edge
+
+        qm = jax.vmap(lambda pw, ph, qx, qy: quad_mask(pw, ph, qx, qy))(
+            in_w, in_h, rx, ry)
+        in_bounds = ((in_w > -0.5) & (in_w < W - 0.5)
+                     & (in_h > -0.5) & (in_h < H - 0.5))
+        mask = qm & in_bounds                              # [R, th, tw]
+
+        # bilinear sample with zero outside
+        x0 = jnp.floor(in_w)
+        y0 = jnp.floor(in_h)
+        lw = in_w - x0
+        lh = in_h - y0
+        feats = x[img_of]                                  # [R, C, H, W]
+
+        def gather(yy, xx):
+            okv = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            g = jnp.take_along_axis(
+                feats.reshape(R, C, H * W),
+                (yc * W + xc).reshape(R, 1, -1).repeat(C, 1), -1
+            ).reshape(R, C, th_, tw_)
+            return jnp.where(okv[:, None], g, 0.0)
+
+        val = (gather(y0, x0) * ((1 - lw) * (1 - lh))[:, None]
+               + gather(y0, x0 + 1) * (lw * (1 - lh))[:, None]
+               + gather(y0 + 1, x0) * ((1 - lw) * lh)[:, None]
+               + gather(y0 + 1, x0 + 1) * (lw * lh)[:, None])
+        out = jnp.where(mask[:, None], val, 0.0)
+        return (out.astype(x.dtype), mask[:, None].astype(jnp.int32),
+                mat)
+
+    args = [input, rois] + ([rois_num] if rois_num is not None else [])
+    return call(_rpt, *args, _name="roi_perspective_transform",
+                _nondiff=(1,) if rois_num is None else (1, 2))

@@ -1,0 +1,32 @@
+"""fluid.contrib op_freq_statistic / model_stat summary
+(ref fluid/contrib/op_frequence.py, model_stat.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_op_freq_and_model_stat(capsys):
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("stat_x", [4, 8], "float32")
+            h = paddle.static.nn.fc(x, 16)
+            h = paddle.static.nn.fc(h, 16)
+            loss = paddle.mean(h)
+        prog = main
+
+        uni, adj = fluid.contrib.op_freq_statistic(prog)
+        uni_d = dict(uni)
+        assert sum(uni_d.values()) == len(prog.ops)
+        assert any(cnt >= 2 for cnt in uni_d.values())   # two fc stacks
+        assert all("->" in k for k, _ in adj)
+
+        stat = fluid.contrib.summary(prog)
+        assert stat["total_params"] == 8 * 16 + 16 + 16 * 16 + 16
+        out = capsys.readouterr().out
+        assert "total params" in out
+    finally:
+        paddle.disable_static()

@@ -219,8 +219,8 @@ class TestFleetMetrics:
     def test_single_process_aggregation(self):
         import numpy as np
         from paddle_tpu.distributed.fleet import metrics as fm
-        assert float(fm.sum(np.array([3.0]))) == 3.0
-        assert float(fm.max(np.array([5.0]))) == 5.0
+        assert np.asarray(fm.sum(np.array([3.0]))).item() == 3.0
+        assert np.asarray(fm.max(np.array([5.0]))).item() == 5.0
         assert fm.acc(np.array([8.0]), np.array([10.0])) == 0.8
         assert fm.mae(np.array([4.0]), np.array([8.0])) == 0.5
         assert fm.rmse(np.array([8.0]), np.array([2.0])) == 2.0

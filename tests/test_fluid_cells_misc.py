@@ -1,0 +1,253 @@
+"""Tests for the fluid cell/decode-helper surface + long-tail ops
+(ref fluid/layers/rnn.py:62 RNNCell family, :437 rnn, :661 birnn, :3392
+lstm_unit, :1742+ decode helpers; nn.py:12755 similarity_focus, :13807
+prroi_pool, :14001 continuous_value_model, :14592 deformable_roi_pooling).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_fluid_lstm_cell_and_rnn_golden():
+    rng = np.random.RandomState(0)
+    B, T, M, D = 2, 4, 3, 5
+    x = rng.randn(B, T, M).astype(np.float32) * 0.5
+    cell = fluid.layers.LSTMCell(hidden_size=D)
+    out, (h, c) = fluid.layers.rnn(cell, paddle.to_tensor(x))
+    assert out.shape == [B, T, D]
+
+    # golden: BasicLSTMUnit recurrence {i, j, f, o}, forget_bias 1.0
+    w = cell.weight.numpy()
+    b = cell.bias.numpy()
+    hh = np.zeros((B, D), np.float32)
+    cc = np.zeros((B, D), np.float32)
+    for t in range(T):
+        g = np.concatenate([x[:, t], hh], 1) @ w + b
+        i, j, f, o = np.split(g, 4, axis=-1)
+        cc = cc * sigmoid(f + 1.0) + sigmoid(i) * np.tanh(j)
+        hh = np.tanh(cc) * sigmoid(o)
+    np.testing.assert_allclose(out.numpy()[:, -1], hh, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), hh, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), cc, atol=1e-5)
+
+
+def test_fluid_gru_cell_golden():
+    rng = np.random.RandomState(1)
+    B, M, D = 3, 4, 5
+    x = rng.randn(B, M).astype(np.float32) * 0.5
+    h0 = rng.randn(B, D).astype(np.float32) * 0.5
+    cell = fluid.layers.GRUCell(hidden_size=D)
+    out, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+
+    gw = cell.gate_weight.numpy()
+    gb = cell.gate_bias.numpy()
+    cw = cell.candidate_weight.numpy()
+    cb = cell.candidate_bias.numpy()
+    g = sigmoid(np.concatenate([x, h0], 1) @ gw + gb)
+    r, u = g[:, :D], g[:, D:]
+    cand = np.tanh(np.concatenate([x, r * h0], 1) @ cw + cb)
+    want = u * h0 + (1 - u) * cand
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), want, atol=1e-5)
+
+
+def test_rnn_sequence_length_and_birnn():
+    rng = np.random.RandomState(2)
+    B, T, M, D = 2, 5, 3, 4
+    x = rng.randn(B, T, M).astype(np.float32)
+    lens = np.array([5, 2], np.int32)
+    cell = fluid.layers.GRUCell(hidden_size=D)
+    out, h = fluid.layers.rnn(cell, paddle.to_tensor(x),
+                              sequence_length=paddle.to_tensor(lens))
+    o = out.numpy()
+    assert np.all(o[1, 2:] == 0)          # padded steps emit zeros
+    # final state of row1 equals output at its last valid step
+    np.testing.assert_allclose(h.numpy()[1], o[1, 1], atol=1e-6)
+
+    cell_fw = fluid.layers.GRUCell(hidden_size=D)
+    cell_bw = fluid.layers.GRUCell(hidden_size=D)
+    bout, (hf, hb) = fluid.layers.birnn(cell_fw, cell_bw,
+                                        paddle.to_tensor(x))
+    assert bout.shape == [B, T, 2 * D]
+
+
+def test_lstm_unit_golden():
+    rng = np.random.RandomState(3)
+    B, M, D = 2, 3, 4
+    x = rng.randn(B, M).astype(np.float32)
+    h0 = rng.randn(B, D).astype(np.float32)
+    c0 = rng.randn(B, D).astype(np.float32)
+    h, c = fluid.layers.lstm_unit(paddle.to_tensor(x),
+                                  paddle.to_tensor(h0),
+                                  paddle.to_tensor(c0), forget_bias=0.5)
+    assert h.shape == [B, D] and c.shape == [B, D]
+    assert np.isfinite(h.numpy()).all()
+
+
+def test_basic_decoder_greedy_helper():
+    """GreedyEmbeddingHelper + BasicDecoder through dynamic_decode: a
+    rigged output layer that always emits the end token finishes in one
+    step with per-sequence lengths 1."""
+    rng = np.random.RandomState(4)
+    V, D = 7, 5
+    emb = rng.randn(V, D).astype(np.float32)
+
+    def embedding_fn(ids):
+        idv = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids)
+        return paddle.to_tensor(emb[idv.reshape(-1)])
+
+    cell = fluid.layers.GRUCell(hidden_size=D)
+
+    def output_fn(h):
+        # force logits peaking at id 3 (the end token)
+        logits = np.zeros((int(h.shape[0]), V), np.float32)
+        logits[:, 3] = 10.0
+        return paddle.to_tensor(logits)
+
+    helper = fluid.layers.GreedyEmbeddingHelper(
+        embedding_fn, paddle.to_tensor(np.array([0, 0], np.int64)),
+        end_token=3)
+    decoder = fluid.layers.BasicDecoder(cell, helper, output_fn=output_fn)
+    init_states = paddle.to_tensor(np.zeros((2, D), np.float32))
+    outputs, final_states, lengths = fluid.layers.dynamic_decode(
+        decoder, inits=init_states, max_step_num=6, return_length=True)
+    ids = outputs.sample_ids.numpy()
+    assert ids.shape[0] == 2
+    assert np.all(ids == 3)
+    np.testing.assert_array_equal(lengths.numpy(), [1, 1])
+
+
+def test_training_helper_teacher_forcing():
+    rng = np.random.RandomState(5)
+    B, T, D = 2, 4, 5
+    seq = rng.randn(B, T, D).astype(np.float32)
+    cell = fluid.layers.GRUCell(hidden_size=D)
+    helper = fluid.layers.TrainingHelper(
+        paddle.to_tensor(seq),
+        paddle.to_tensor(np.array([4, 2], np.int64)))
+    decoder = fluid.layers.BasicDecoder(cell, helper)
+    outputs, _, lengths = fluid.layers.dynamic_decode(
+        decoder, inits=paddle.to_tensor(np.zeros((B, D), np.float32)),
+        max_step_num=10, return_length=True)
+    assert outputs.cell_outputs.shape[0] == B
+    np.testing.assert_array_equal(lengths.numpy(), [4, 2])
+
+
+def test_continuous_value_model():
+    x = np.array([[2.0, 1.0, 5.0, 6.0], [0.0, 3.0, 7.0, 8.0]], np.float32)
+    cvm = np.ones((2, 2), np.float32)
+    out = fluid.layers.continuous_value_model(
+        paddle.to_tensor(x), paddle.to_tensor(cvm), use_cvm=True)
+    o = out.numpy()
+    np.testing.assert_allclose(o[:, 0], np.log(x[:, 0] + 1), atol=1e-6)
+    np.testing.assert_allclose(o[:, 1],
+                               np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+                               atol=1e-6)
+    np.testing.assert_allclose(o[:, 2:], x[:, 2:])
+    out2 = fluid.layers.continuous_value_model(
+        paddle.to_tensor(x), paddle.to_tensor(cvm), use_cvm=False)
+    np.testing.assert_allclose(out2.numpy(), x[:, 2:])
+
+
+def test_similarity_focus_golden():
+    """Mirror of similarity_focus_op.h: greedy row/col-exclusive argmax
+    selection per indexed slice, mask broadcast over the axis dim."""
+    rng = np.random.RandomState(6)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    out = fluid.layers.similarity_focus(paddle.to_tensor(x), axis=1,
+                                        indexes=[0, 2]).numpy()
+
+    want = np.zeros_like(x)
+    for n in range(2):
+        for idx in (0, 2):
+            sl = x[n, idx].copy()
+            H, W = sl.shape
+            rows = np.zeros(H, bool)
+            cols = np.zeros(W, bool)
+            order = np.argsort(-sl.reshape(-1), kind="stable")
+            picked = 0
+            for flat in order:
+                r, c = flat // W, flat % W
+                if rows[r] or cols[c]:
+                    continue
+                rows[r] = cols[c] = True
+                want[n, :, r, c] = 1
+                picked += 1
+                if picked == min(H, W):
+                    break
+    np.testing.assert_array_equal(out, want)
+
+
+def test_prroi_pool_exact_integral():
+    """Bilinear interpolant of f(x, y) = x is exactly x, so each bin's
+    precise integral average equals the bin's center x (same for y)."""
+    H = W = 8
+    xs = np.broadcast_to(np.arange(W, dtype=np.float32), (H, W))
+    feat = np.stack([xs, xs.T])[None]            # [1, 2, H, W]: x and y
+    rois = np.array([[1.0, 2.0, 5.0, 6.0]], np.float32)
+    out = fluid.layers.prroi_pool(paddle.to_tensor(feat),
+                                  paddle.to_tensor(rois), 1.0, 2, 2)
+    o = out.numpy()[0]
+    assert o.shape == (2, 2, 2)
+    # channel 0 (= x): bins split [1,3],[3,5]; centers 2 and 4
+    np.testing.assert_allclose(o[0], [[2, 4], [2, 4]], atol=1e-5)
+    # channel 1 (= y): bins split [2,4],[4,6]; centers 3 and 5
+    np.testing.assert_allclose(o[1], [[3, 3], [5, 5]], atol=1e-5)
+
+
+def test_prroi_pool_constant_and_grad():
+    feat = paddle.to_tensor(np.ones((1, 1, 6, 6), np.float32),
+                            stop_gradient=False)
+    rois = paddle.to_tensor(np.array([[0.5, 0.5, 4.5, 4.5]], np.float32))
+    out = fluid.layers.prroi_pool(feat, rois, 1.0, 3, 3)
+    np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 3, 3)),
+                               atol=1e-5)
+    paddle.sum(out).backward()
+    g = feat.grad.numpy()
+    assert np.isfinite(g).all() and g.sum() > 0
+
+
+def test_deformable_roi_pooling_no_trans_constant():
+    feat = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[1, 1, 6, 6]], np.float32)
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+    out = fluid.layers.deformable_roi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois),
+        paddle.to_tensor(trans), no_trans=True, pooled_height=2,
+        pooled_width=2, sample_per_part=2)
+    np.testing.assert_allclose(out.numpy(), np.full((1, 2, 2, 2), 3.0),
+                               atol=1e-5)
+
+
+def test_deformable_roi_pooling_offset_shifts():
+    """A positive x-offset moves sampling right on an x-ramp feature."""
+    H = W = 12
+    xs = np.broadcast_to(np.arange(W, dtype=np.float32), (H, W))
+    feat = xs[None, None]
+    rois = np.array([[2, 2, 7, 7]], np.float32)
+    z = np.zeros((1, 2, 1, 1), np.float32)
+    off = z.copy()
+    off[0, 0] = 1.0       # x offset, scaled by trans_std * roi_w
+    base = fluid.layers.deformable_roi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois),
+        paddle.to_tensor(z), pooled_height=1, pooled_width=1,
+        sample_per_part=2, trans_std=0.1).numpy()
+    shifted = fluid.layers.deformable_roi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois),
+        paddle.to_tensor(off), pooled_height=1, pooled_width=1,
+        sample_per_part=2, trans_std=0.1).numpy()
+    roi_w = 6.0    # (round(7)+1 - round(2)) * scale
+    np.testing.assert_allclose(shifted - base, 0.1 * roi_w, atol=1e-4)
+
+
+def test_fluid_distribution_reexports():
+    assert fluid.layers.Uniform is not None
+    assert fluid.layers.Normal is not None
+    assert fluid.layers.Categorical is not None
+    assert fluid.layers.MultivariateNormalDiag is not None

@@ -1,0 +1,297 @@
+"""Numpy-golden tests for the fluid dynamic-RNN op family + beam search
+(ref python/paddle/fluid/layers/rnn.py:2262 dynamic_lstm, :2616
+dynamic_lstmp, :2835 dynamic_gru, :2998 gru_unit, :2439 lstm, :3154
+beam_search, :3313 beam_search_decode).
+
+Every golden is a hand-rolled per-timestep numpy recurrence following the
+reference formulas — independent of the lax.scan implementation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm_step(x4, h, c, w, b, use_peepholes):
+    """Reference lstm_op step: gate columns {c, i, f, o}."""
+    D = h.shape[-1]
+    g = x4 + h @ w + b[:, :4 * D]
+    gc, gi, gf, go = np.split(g, 4, axis=-1)
+    if use_peepholes:
+        gi = gi + b[:, 4 * D:5 * D] * c
+        gf = gf + b[:, 5 * D:6 * D] * c
+    i, f = sigmoid(gi), sigmoid(gf)
+    c_new = f * c + i * np.tanh(gc)
+    go = go + (b[:, 6 * D:7 * D] * c_new if use_peepholes else 0.0)
+    h_new = sigmoid(go) * np.tanh(c_new)
+    return h_new, c_new
+
+
+@pytest.mark.parametrize("use_peepholes", [False, True])
+@pytest.mark.parametrize("is_reverse", [False, True])
+def test_dynamic_lstm_golden(use_peepholes, is_reverse):
+    rng = np.random.RandomState(0)
+    B, T, D = 3, 5, 4
+    x = rng.randn(B, T, 4 * D).astype(np.float32) * 0.5
+    lens = np.array([5, 3, 4], np.int32)
+    w = rng.randn(D, 4 * D).astype(np.float32) * 0.3
+    b = rng.randn(1, (7 if use_peepholes else 4) * D).astype(np.float32) * 0.1
+
+    hid, cell = fluid.layers.dynamic_lstm(
+        paddle.to_tensor(x), size=4 * D,
+        param_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(w)),
+        bias_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(b)),
+        use_peepholes=use_peepholes, is_reverse=is_reverse,
+        lengths=paddle.to_tensor(lens))
+
+    # golden: per-row scalar recurrence over the VALID segment only
+    want_h = np.zeros((B, T, D), np.float32)
+    want_c = np.zeros((B, T, D), np.float32)
+    for bi in range(B):
+        L = lens[bi]
+        seq = x[bi, :L][::-1] if is_reverse else x[bi, :L]
+        h = np.zeros((1, D), np.float32)
+        c = np.zeros((1, D), np.float32)
+        outs = []
+        for t in range(L):
+            h, c = np_lstm_step(seq[t:t + 1], h, c, w, b, use_peepholes)
+            outs.append((h[0], c[0]))
+        if is_reverse:
+            outs = outs[::-1]
+        for t, (hh, cc) in enumerate(outs):
+            want_h[bi, t] = hh
+            want_c[bi, t] = cc
+
+    np.testing.assert_allclose(hid.numpy(), want_h, atol=1e-5)
+    np.testing.assert_allclose(cell.numpy(), want_c, atol=1e-5)
+
+
+def test_dynamic_lstm_backward():
+    rng = np.random.RandomState(1)
+    B, T, D = 2, 4, 3
+    x = paddle.to_tensor(rng.randn(B, T, 4 * D).astype(np.float32) * 0.5,
+                         stop_gradient=False)
+    hid, cell = fluid.layers.dynamic_lstm(x, size=4 * D, use_peepholes=True)
+    loss = paddle.sum(hid * hid) + paddle.sum(cell)
+    loss.backward()
+    g = x.grad.numpy()
+    assert g.shape == (B, T, 4 * D) and np.isfinite(g).all()
+    assert np.abs(g).max() > 0
+
+
+def test_dynamic_lstmp_golden():
+    rng = np.random.RandomState(2)
+    B, T, D, P = 2, 4, 4, 3
+    x = rng.randn(B, T, 4 * D).astype(np.float32) * 0.5
+    w = rng.randn(P, 4 * D).astype(np.float32) * 0.3
+    wp = rng.randn(D, P).astype(np.float32) * 0.3
+    b = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+
+    class SeqAssign:
+        """Assign w then wp (dynamic_lstmp creates two params off one
+        param_attr, reference-style)."""
+        def __init__(self):
+            self.vals = [w, wp]
+
+        def __call__(self, shape, dtype):
+            v = self.vals.pop(0)
+            assert list(shape) == list(v.shape)
+            return np.asarray(v, dtype)
+
+    proj, cell = fluid.layers.dynamic_lstmp(
+        paddle.to_tensor(x), size=4 * D, proj_size=P,
+        param_attr=paddle.ParamAttr(initializer=SeqAssign()),
+        bias_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(b)),
+        use_peepholes=False, cell_clip=2.0, proj_clip=0.8)
+
+    want_r = np.zeros((B, T, P), np.float32)
+    for bi in range(B):
+        r = np.zeros((1, P), np.float32)
+        c = np.zeros((1, D), np.float32)
+        for t in range(T):
+            g = x[bi, t:t + 1] + r @ w + b
+            gc, gi, gf, go = np.split(g, 4, axis=-1)
+            c = sigmoid(gf) * c + sigmoid(gi) * np.tanh(gc)
+            c = np.clip(c, -2.0, 2.0)
+            h = sigmoid(go) * np.tanh(c)
+            r = np.clip(np.tanh(h @ wp), -0.8, 0.8)
+            want_r[bi, t] = r[0]
+
+    np.testing.assert_allclose(proj.numpy(), want_r, atol=1e-5)
+    assert cell.shape == [B, T, D]
+
+
+@pytest.mark.parametrize("origin_mode", [False, True])
+def test_dynamic_gru_golden(origin_mode):
+    rng = np.random.RandomState(3)
+    B, T, D = 3, 6, 4
+    x = rng.randn(B, T, 3 * D).astype(np.float32) * 0.5
+    lens = np.array([6, 2, 4], np.int32)
+    w = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+    b = rng.randn(1, 3 * D).astype(np.float32) * 0.1
+
+    out = fluid.layers.dynamic_gru(
+        paddle.to_tensor(x), size=D,
+        param_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(w)),
+        bias_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(b)),
+        origin_mode=origin_mode, lengths=paddle.to_tensor(lens))
+
+    want = np.zeros((B, T, D), np.float32)
+    for bi in range(B):
+        h = np.zeros((1, D), np.float32)
+        for t in range(lens[bi]):
+            g = x[bi, t:t + 1] + b
+            xu, xr, xc = np.split(g, 3, axis=-1)
+            hg = h @ w[:, :2 * D]
+            u = sigmoid(xu + hg[:, :D])
+            r = sigmoid(xr + hg[:, D:])
+            cand = np.tanh(xc + (r * h) @ w[:, 2 * D:])
+            h = u * h + (1 - u) * cand if origin_mode \
+                else (1 - u) * h + u * cand
+            want[bi, t] = h[0]
+
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+
+
+def test_gru_unit_golden():
+    rng = np.random.RandomState(4)
+    B, D = 4, 5
+    x = rng.randn(B, 3 * D).astype(np.float32) * 0.5
+    h0 = rng.randn(B, D).astype(np.float32) * 0.5
+    w = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+    b = rng.randn(1, 3 * D).astype(np.float32) * 0.1
+
+    h_new, rhp, gate = fluid.layers.gru_unit(
+        paddle.to_tensor(x), paddle.to_tensor(h0), size=3 * D,
+        param_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(w)),
+        bias_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Assign(b)))
+
+    g = x + b
+    xu, xr, xc = np.split(g, 3, axis=-1)
+    hg = h0 @ w[:, :2 * D]
+    u = sigmoid(xu + hg[:, :D])
+    r = sigmoid(xr + hg[:, D:])
+    want_rhp = r * h0
+    cand = np.tanh(xc + want_rhp @ w[:, 2 * D:])
+    want_h = (1 - u) * h0 + u * cand       # origin_mode=False default
+
+    np.testing.assert_allclose(h_new.numpy(), want_h, atol=1e-5)
+    np.testing.assert_allclose(rhp.numpy(), want_rhp, atol=1e-5)
+    np.testing.assert_allclose(gate.numpy(),
+                               np.concatenate([u, r, cand], -1), atol=1e-5)
+
+
+def test_lstm_multilayer_shapes_and_state():
+    rng = np.random.RandomState(5)
+    B, T, Din, D, L = 2, 5, 6, 4, 2
+    x = paddle.to_tensor(rng.randn(B, T, Din).astype(np.float32))
+    init_h = paddle.zeros([2 * L, B, D])
+    init_c = paddle.zeros([2 * L, B, D])
+    out, last_h, last_c = fluid.layers.lstm(
+        x, init_h, init_c, max_len=T, hidden_size=D, num_layers=L,
+        is_bidirec=True, is_test=True)
+    assert out.shape == [B, T, 2 * D]
+    assert last_h.shape == [2 * L, B, D]
+    assert last_c.shape == [2 * L, B, D]
+    # forward-direction last_h of the top layer must equal the out row at
+    # the final step's forward half
+    np.testing.assert_allclose(out.numpy()[:, -1, :D],
+                               last_h.numpy()[2], atol=1e-5)
+    # masked run: states freeze at each row's length
+    out2, last_h2, _ = fluid.layers.lstm(
+        x, None, None, max_len=T, hidden_size=D, num_layers=1,
+        is_bidirec=False, is_test=True,
+        lengths=paddle.to_tensor(np.array([5, 3], np.int32)))
+    np.testing.assert_allclose(out2.numpy()[1, 2], last_h2.numpy()[0, 1],
+                               atol=1e-6)
+    assert np.all(out2.numpy()[1, 3:] == 0)
+
+
+def test_beam_search_step_golden():
+    # B=2, K=2, W=3; hand-check top-k over candidates with one ended beam
+    pre_ids = np.array([[1], [9], [4], [2]], np.int64)      # row1 ended
+    pre_scores = np.array([[-1.0], [-0.5], [-2.0], [-0.1]], np.float32)
+    ids = np.arange(100, 124).reshape(4, 6)[:, :3].astype(np.int64)
+    scores = np.array([
+        [-1.2, -3.0, -0.9],
+        [-9.0, -8.0, -7.0],     # ignored: beam ended (pre_id==9==end_id)
+        [-0.3, -4.0, -2.5],
+        [-0.2, -5.0, -0.4],
+    ], np.float32)
+
+    sel_ids, sel_scores, parents = fluid.layers.beam_search(
+        paddle.to_tensor(pre_ids), paddle.to_tensor(pre_scores),
+        paddle.to_tensor(ids), paddle.to_tensor(scores),
+        beam_size=2, end_id=9, return_parent_idx=True)
+
+    si, ss, pp = sel_ids.numpy(), sel_scores.numpy(), parents.numpy()
+    # batch 0: candidates are beam0's scores and ended beam1's single
+    # (end_id, -0.5) — top2: (-0.5, end) then (-0.9, id 102)
+    assert ss[0, 0] == pytest.approx(-0.5) and si[0, 0] == 9 and pp[0] == 1
+    assert ss[1, 0] == pytest.approx(-0.9) and si[1, 0] == 102 and pp[1] == 0
+    # batch 1: top2 of {-0.3,-4,-2.5,-0.2,-5,-0.4} = -0.2 (beam1 cand0 =
+    # id 118) then -0.3 (beam0 cand0 = id 112)
+    assert ss[2, 0] == pytest.approx(-0.2) and si[2, 0] == 118 and pp[2] == 3
+    assert ss[3, 0] == pytest.approx(-0.3) and si[3, 0] == 112 and pp[3] == 2
+
+
+def test_beam_search_decode_backtrace():
+    # one batch, K=2, T=3; construct a known tree
+    # step0: beams pick ids [5, 7]; parents [0, 0]
+    # step1: ids [3, 9(end)]; parents [0, 1]  (beam1 follows old beam1)
+    # step2: ids [4, 9]; parents [0, 1]
+    ids = [np.array([[5], [7]], np.int64),
+           np.array([[3], [9]], np.int64),
+           np.array([[4], [9]], np.int64)]
+    scores = [np.array([[-0.1], [-0.2]], np.float32),
+              np.array([[-0.3], [-0.4]], np.float32),
+              np.array([[-0.5], [-0.6]], np.float32)]
+    parents = [np.array([0, 0], np.int32),
+               np.array([0, 1], np.int32),
+               np.array([0, 1], np.int32)]
+
+    sent_ids, sent_scores = fluid.layers.beam_search_decode(
+        [paddle.to_tensor(i) for i in ids],
+        [paddle.to_tensor(s) for s in scores],
+        beam_size=2, end_id=9,
+        parents=[paddle.to_tensor(p) for p in parents])
+
+    si = sent_ids.numpy()
+    ss = sent_scores.numpy()
+    assert si.shape == (1, 2, 3)
+    np.testing.assert_array_equal(si[0, 0], [5, 3, 4])
+    # beam1 path: step1 ended with 9; after-end fill stays end_id
+    np.testing.assert_array_equal(si[0, 1], [7, 9, 9])
+    np.testing.assert_allclose(ss[0, 0], [-0.1, -0.3, -0.5], atol=1e-6)
+
+
+def test_dynamic_gru_static_graph_mode():
+    """The op family must also record into a static Program."""
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(6)
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("xg", [2, 4, 9], "float32")
+            out = fluid.layers.dynamic_gru(x, size=3)
+            loss = paddle.mean(out)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            xv = rng.randn(2, 4, 9).astype(np.float32)
+            (lv,) = exe.run(main, feed={"xg": xv}, fetch_list=[loss])
+        assert np.isfinite(lv).all()
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,262 @@
+"""Numpy-golden tests for the FPN/RetinaNet detection family + round-3
+advisor fixes (matrix_nms gaussian decay, adaptive nms_eta, nms2 indices).
+
+ref python/paddle/fluid/layers/detection.py:70 retinanet_target_assign,
+:2504 roi_perspective_transform, :3106 retinanet_detection_output,
+:3673 distribute_fpn_proposals, :3871 collect_fpn_proposals;
+paddle/fluid/operators/detection/matrix_nms_op.cc decay_score.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_distribute_fpn_proposals_golden():
+    # areas chosen so levels are unambiguous: level =
+    # floor(log2(sqrt(area)/224) + 4) clipped to [2, 5]
+    rois = np.array([
+        [0, 0, 447, 447],    # scale 448  -> level 5
+        [0, 0, 223, 223],    # scale 224  -> level 4
+        [0, 0, 111, 111],    # scale 112  -> level 3
+        [0, 0, 55, 55],      # scale 56   -> level 2
+        [0, 0, 27, 27],      # scale 28   -> level 2 (clipped)
+        [0, 0, 220, 220],    # ~221      -> level 3 (floor(log2(<1)+4)=3)
+    ], np.float32)
+    multi, restore = fluid.layers.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5,
+        refer_level=4, refer_scale=224)
+    assert len(multi) == 4
+    l2, l3, l4, l5 = [m.numpy() for m in multi]
+    np.testing.assert_allclose(l2[0], rois[3])
+    np.testing.assert_allclose(l2[1], rois[4])
+    np.testing.assert_allclose(l3[0], rois[2])
+    np.testing.assert_allclose(l3[1], rois[5])
+    np.testing.assert_allclose(l4[0], rois[1])
+    np.testing.assert_allclose(l5[0], rois[0])
+    assert np.all(l5[1:] == 0)
+    # restore_ind maps concat(levels) rows back to input order
+    N = rois.shape[0]
+    concat = np.concatenate([l2, l3, l4, l5], 0)
+    ri = restore.numpy().reshape(-1)
+    np.testing.assert_allclose(concat[ri], rois)
+
+
+def test_distribute_fpn_proposals_rois_num():
+    rois = np.array([[0, 0, 447, 447], [0, 0, 55, 55],
+                     [0, 0, 0, 0]], np.float32)     # last row = padding
+    multi, restore, counts = fluid.layers.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([2], np.int32)))
+    cs = [int(c.numpy()) for c in counts]
+    assert cs == [1, 0, 0, 1]
+
+
+def test_collect_fpn_proposals_golden():
+    r2 = np.array([[0, 0, 10, 10], [1, 1, 5, 5]], np.float32)
+    r3 = np.array([[2, 2, 8, 8], [0, 0, 0, 0]], np.float32)
+    s2 = np.array([0.9, 0.2], np.float32)
+    s3 = np.array([0.5, 0.99], np.float32)   # 0.99 is PADDING (masked)
+    out, num = fluid.layers.collect_fpn_proposals(
+        [paddle.to_tensor(r2), paddle.to_tensor(r3)],
+        [paddle.to_tensor(s2), paddle.to_tensor(s3)],
+        min_level=2, max_level=3, post_nms_top_n=3,
+        rois_num_per_level=[paddle.to_tensor(np.array([2], np.int32)),
+                            paddle.to_tensor(np.array([1], np.int32))])
+    o = out.numpy()
+    np.testing.assert_allclose(o[0], r2[0])   # 0.9
+    np.testing.assert_allclose(o[1], r3[0])   # 0.5
+    np.testing.assert_allclose(o[2], r2[1])   # 0.2
+    assert int(num.numpy()[0]) == 3
+
+
+def test_retinanet_target_assign_golden():
+    anchors = np.array([
+        [0, 0, 9, 9],
+        [20, 20, 29, 29],
+        [0, 0, 49, 49],
+        [100, 100, 109, 109],
+    ], np.float32)
+    gt = np.array([[0, 0, 9, 9], [22, 22, 30, 30]], np.float32)[None]
+    gl = np.array([[3, 7]], np.int32)
+    crowd = np.zeros((1, 2), np.int32)
+    im_info = np.array([[200, 200, 1.0]], np.float32)
+    bbox_pred = np.zeros((1, 4, 4), np.float32)
+    cls_logits = np.zeros((1, 4, 9), np.float32)
+
+    (score_pred, loc_pred, labels, tgt, iw, fg_num) = \
+        fluid.layers.retinanet_target_assign(
+            paddle.to_tensor(bbox_pred), paddle.to_tensor(cls_logits),
+            paddle.to_tensor(anchors), paddle.to_tensor(anchors),
+            paddle.to_tensor(gt), paddle.to_tensor(gl),
+            paddle.to_tensor(crowd), paddle.to_tensor(im_info),
+            num_classes=9)
+    lb = labels.numpy()[0]
+    assert lb[0] == 3          # exact match with gt0 -> its class
+    assert lb[1] == 7          # IoU ~0.54 with gt1 >= 0.5 -> positive
+    assert lb[3] == 0          # no overlap -> background
+    # anchor 2 overlaps gt0 with IoU 0.04 < 0.4 -> background too
+    assert lb[2] == 0
+    assert int(fg_num.numpy()[0, 0]) == 2 + 1   # reference fg+1
+    # encoded target of the exact-match anchor is ~zero offset
+    np.testing.assert_allclose(tgt.numpy()[0, 0], np.zeros(4), atol=1e-5)
+    assert np.all(iw.numpy()[0, 0] == 1) and np.all(iw.numpy()[0, 3] == 0)
+
+
+def test_retinanet_detection_output_shapes_and_decode():
+    # one level with identity deltas: decoded box == anchor (corner -1)
+    anchors = np.array([[10, 10, 29, 29], [40, 40, 59, 59]], np.float32)
+    deltas = np.zeros((1, 2, 4), np.float32)
+    scores = np.array([[[0.9, 0.01], [0.02, 0.6]]], np.float32)
+    im_info = np.array([[100, 100, 1.0]], np.float32)
+    out = fluid.layers.retinanet_detection_output(
+        [paddle.to_tensor(deltas)], [paddle.to_tensor(scores)],
+        [paddle.to_tensor(anchors)], paddle.to_tensor(im_info),
+        score_threshold=0.05, nms_top_k=4, keep_top_k=5)
+    o = out.numpy()[0]
+    assert o.shape == (5, 6)
+    # top row: class 0 at 0.9 with box == anchor0 (xmax -1 convention)
+    assert o[0, 0] == 0 and o[0, 1] == pytest.approx(0.9)
+    np.testing.assert_allclose(o[0, 2:], [10, 10, 29, 29], atol=1e-4)
+    assert o[1, 0] == 1 and o[1, 1] == pytest.approx(0.6)
+    np.testing.assert_allclose(o[1, 2:], [40, 40, 59, 59], atol=1e-4)
+    # single level == last level: the reference skips score_threshold
+    # there (small-image guard), so the 0.02/0.01 candidates survive
+    # NMS (no overlap) and fill rows 2-3; row 4 is padding
+    assert o[2, 1] == pytest.approx(0.02) and o[3, 1] == pytest.approx(0.01)
+    assert o[4, 0] == -1
+
+
+def test_roi_perspective_transform_axis_aligned_identity():
+    """An axis-aligned square roi warped to its own size must reproduce
+    the underlying feature patch (the perspective matrix degenerates to
+    translation)."""
+    H = W = 8
+    x = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    # quad = rows 2..5, cols 1..4 (clockwise from top-left), 4x4 output
+    rois = np.array([[1, 2, 4, 2, 4, 5, 1, 5]], np.float32)
+    out, mask, mat = fluid.layers.roi_perspective_transform(
+        paddle.to_tensor(x), paddle.to_tensor(rois), 4, 4, 1.0)
+    o = out.numpy()[0, 0]
+    want = x[0, 0, 2:6, 1:5]
+    np.testing.assert_allclose(o, want, atol=1e-4)
+    assert mask.numpy().shape == (1, 1, 4, 4)
+    assert np.all(mask.numpy() == 1)
+    m = mat.numpy()[0]
+    assert m[8] == pytest.approx(1.0)
+    # pure translation: top-left maps to (1, 2)
+    assert m[2] == pytest.approx(1.0, abs=1e-4)
+    assert m[5] == pytest.approx(2.0, abs=1e-4)
+
+
+def test_roi_perspective_transform_mask_outside():
+    """A quad that sticks out of the feature map gets image-bounds
+    masking (reference GT_E(-0.5/in_w..) guard): samples landing outside
+    [-0.5, W-0.5] produce mask 0 and zero output."""
+    H = W = 12
+    x = np.ones((1, 1, H, W), np.float32)
+    # square roi whose right half lies beyond the 12-wide feature map
+    rois = np.array([[6, 2, 17, 2, 17, 9, 6, 9]], np.float32)
+    out, mask, _ = fluid.layers.roi_perspective_transform(
+        paddle.to_tensor(x), paddle.to_tensor(rois), 6, 6, 1.0)
+    mk = mask.numpy()[0, 0]
+    assert 0 < mk.sum() < 36
+    # masked-out pixels are exactly zero
+    assert np.all(out.numpy()[0, 0][mk == 0] == 0)
+    np.testing.assert_allclose(out.numpy()[0, 0][mk == 1], 1.0, atol=1e-5)
+
+
+def test_matrix_nms_gaussian_reference_formula():
+    """Gaussian decay must MULTIPLY by sigma (matrix_nms_op.cc
+    decay_score<T,true>): exp((max_iou^2 - iou^2) * sigma)."""
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 9, 10], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # C=1... need C>=2
+    scores = np.concatenate([np.zeros_like(scores), scores], 1)  # bg + fg
+    sigma = 2.0
+    out = fluid.layers.matrix_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.01, post_threshold=0.0, nms_top_k=3,
+        keep_top_k=3, use_gaussian=True, gaussian_sigma=sigma,
+        background_label=0)
+    o = out.numpy()[0]
+
+    # numpy golden straight from the reference formula
+    def iou(a, b):
+        x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+        x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+        inter = max(0, x2 - x1) * max(0, y2 - y1)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua
+    # reference NMSMatrix: for sorted candidate i,
+    #   decay_i = min_{j<i} exp((max_iou_j^2 - iou_ij^2) * sigma)
+    # where max_iou_j = max_{k<j} iou_jk (0 for the top candidate).
+    b = boxes[0]
+    i01 = iou(b[0], b[1])
+    decay1 = np.exp((0.0 - i01 ** 2) * sigma)      # j=0: max_iou_0 = 0
+    assert o[0, 1] == pytest.approx(0.9, abs=1e-5)
+    want = sorted([0.9, 0.8 * decay1, 0.7], reverse=True)
+    np.testing.assert_allclose(sorted(o[:, 1], reverse=True), want,
+                               atol=1e-5)
+    # three overlapping boxes: full min-over-j chain
+    boxes3 = np.array([[[0, 0, 10, 10], [0, 0, 8, 10], [0, 0, 6, 10]]],
+                      np.float32)
+    out3 = fluid.layers.matrix_nms(
+        paddle.to_tensor(boxes3), paddle.to_tensor(scores),
+        score_threshold=0.01, post_threshold=0.0, nms_top_k=3,
+        keep_top_k=3, use_gaussian=True, gaussian_sigma=sigma,
+        background_label=0).numpy()[0]
+    b3 = boxes3[0]
+    i01 = iou(b3[0], b3[1]); i02 = iou(b3[0], b3[2]); i12 = iou(b3[1], b3[2])
+    d1 = 0.8 * np.exp((0.0 - i01 ** 2) * sigma)
+    d2 = 0.7 * min(np.exp((0.0 - i02 ** 2) * sigma),
+                   np.exp((i01 ** 2 - i12 ** 2) * sigma))
+    want3 = sorted([0.9, d1, d2], reverse=True)
+    np.testing.assert_allclose(sorted(out3[:, 1], reverse=True), want3,
+                               atol=1e-5)
+
+
+def test_multiclass_nms_adaptive_eta():
+    """nms_eta < 1 decays the IoU threshold after each kept box
+    (reference NMSFast adaptive path) — with eta, a borderline box that a
+    fixed threshold would keep gets suppressed."""
+    # three boxes in a chain; iou(0,1) ~ 0.54, iou(0,2) small, iou(1,2) ~0.54
+    boxes = np.array([[[0, 0, 100, 10], [35, 0, 135, 10], [70, 0, 170, 10]]],
+                     np.float32)
+    fg = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    scores = np.concatenate([np.zeros_like(fg), fg], 1)
+    common = dict(score_threshold=0.01, nms_top_k=3, keep_top_k=3,
+                  background_label=0)
+    out_fixed = fluid.layers.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        nms_threshold=0.6, nms_eta=1.0, **common).numpy()[0]
+    out_eta = fluid.layers.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        nms_threshold=0.9, nms_eta=0.5, **common).numpy()[0]
+    # fixed 0.6: nothing suppressed (all pair ious < 0.6) -> 3 rows
+    assert (out_fixed[:, 0] >= 0).sum() == 3
+    # eta: thr 0.9 -> after keeping box0 decays to 0.45 -> box1 (iou .48)
+    # suppressed; box2 vs box0 iou ~.18 kept (thr decays again after)
+    kept = out_eta[out_eta[:, 0] >= 0]
+    assert len(kept) == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], atol=1e-6)
+
+
+def test_multiclass_nms2_index_duplicates():
+    """Duplicate boxes must map to their own row indices (threaded out of
+    the NMS, not coordinate-matched)."""
+    boxes = np.array([[[0, 0, 10, 10], [50, 50, 60, 60],
+                       [0, 0, 10, 10]]], np.float32)   # row2 == row0
+    fg = np.array([[[0.5, 0.9, 0.8]]], np.float32)
+    scores = np.concatenate([np.zeros_like(fg), fg], 1)
+    out, idx = fluid.contrib.layers.multiclass_nms2(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.01, nms_top_k=3, keep_top_k=3,
+        nms_threshold=0.5, background_label=0, return_index=True)
+    o, ix = out.numpy()[0], idx.numpy()[0]
+    # kept: box1 (0.9) and box2 (0.8, suppresses duplicate box0)
+    assert o[0, 1] == pytest.approx(0.9) and ix[0] == 1
+    assert o[1, 1] == pytest.approx(0.8) and ix[1] == 2
+    assert ix[2] == -1
