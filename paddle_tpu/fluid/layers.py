@@ -305,7 +305,7 @@ from ..vision.detection import (prior_box, density_prior_box,  # noqa: E402
     target_assign, multiclass_nms, matrix_nms, ssd_loss, multi_box_head,
     polygon_box_transform, distribute_fpn_proposals, collect_fpn_proposals,
     retinanet_target_assign, retinanet_detection_output,
-    roi_perspective_transform)
+    roi_perspective_transform, generate_proposal_labels)
 from ..vision.ops import yolo_box  # noqa: E402,F401
 from ..vision.ops import yolo_loss as yolov3_loss  # noqa: E402,F401
 

@@ -1246,3 +1246,125 @@ def load(out, file_path, load_as_fp16=None):
         arr = arr.astype("float16")
     out._rebind(Tensor(arr))
     return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """ref nn.py:10126 / filter_by_instag_op (PS-era CTR): keep the rows
+    whose tag list intersects filter_tag.
+
+    Padded fixed-shape form: ins [B, D]; ins_tag [B, K] with -1 padding
+    (the LoD grouping analog); filter_tag [F].  Returns (out [B, D] with
+    kept rows compacted to the front and out_val_if_empty after,
+    loss_weight [B, 1] marking the kept prefix).  is_lod is accepted for
+    signature parity (a flat tensor is the K=1 case)."""
+    def _fbi(x, tags, ft):
+        B = x.shape[0]
+        if tags.ndim == 1:
+            tags = tags[:, None]
+        hit = (tags[:, :, None] == ft[None, None, :]) \
+            & (tags[:, :, None] >= 0)
+        keep = jnp.any(hit, axis=(1, 2))                  # [B]
+        order = jnp.argsort(jnp.where(keep, 0, 1) * B + jnp.arange(B))
+        n_keep = jnp.sum(keep.astype(jnp.int32))
+        filled = jnp.arange(B) < n_keep
+        out = jnp.where(filled[:, None], x[order],
+                        jnp.asarray(out_val_if_empty, x.dtype))
+        w = filled.astype(jnp.float32)[:, None]
+        return out, w
+    return call(_fbi, ins, ins_tag, filter_tag,
+                _nondiff=(1, 2), _name="filter_by_instag")
+
+
+# ---------------------------------------------------------------- codegen
+# helpers (ref fluid/layers/layer_function_generator.py).  The reference
+# manufactures python wrappers from the C++ OpProto registry; here the op
+# surface is this package itself, so the generators resolve against the
+# already-implemented fluid.layers/tensor namespaces.
+
+def generate_layer_fn(op_type):
+    """ref layer_function_generator.py:137 — return the layer function
+    registered under ``op_type`` in this framework's fluid surface."""
+    from . import layers as _layers
+    from .. import tensor as _tensor_ns
+    for ns in (_layers, _tensor_ns):
+        fn = getattr(ns, op_type, None)
+        if callable(fn):
+            return fn
+    raise ValueError(
+        f"generate_layer_fn: op '{op_type}' has no TPU-native "
+        "implementation in paddle_tpu.fluid.layers")
+
+
+def generate_activation_fn(op_type):
+    """ref layer_function_generator.py:246 — activation wrapper."""
+    act = getattr(F, op_type, None)
+    if act is None:
+        import jax.nn as _jnn
+        act = getattr(_jnn, op_type, None)
+    if act is None:
+        raise ValueError(f"unknown activation '{op_type}'")
+
+    def func(x, name=None):
+        return act(x)
+    func.__name__ = op_type
+    return func
+
+
+def generate_inplace_fn(inplace_op_type):
+    """ref layer_function_generator.py:287 — the ``op_`` spelling: apply
+    the base op and rebind the input tensor in place."""
+    origin_type = inplace_op_type[:-1]
+    base = generate_activation_fn(origin_type)
+
+    def func(x, name=None):
+        out = base(x)
+        if hasattr(x, "_rebind"):
+            x._rebind(out)
+            return x
+        return out
+    func.__name__ = inplace_op_type
+    return func
+
+
+def autodoc(comment=""):
+    """ref layer_function_generator.py:316 — doc decorator."""
+    def __impl__(func):
+        func.__doc__ = (f"{func.__name__}{func.__doc__ or ''}{comment}")
+        return func
+    return __impl__
+
+
+def templatedoc(op_type=None):
+    """ref layer_function_generator.py:325 — ${comment} substitution in
+    docstrings; without an OpProto registry the placeholders are simply
+    stripped, keeping the surrounding doc intact."""
+    import re as _re
+
+    def __impl__(func):
+        if func.__doc__:
+            func.__doc__ = _re.sub(r"\$\{[^}]*\}", "", func.__doc__)
+        return func
+    return __impl__
+
+
+def lod_rank_table(x, level=0, lengths=None):
+    """ref control_flow.py lod_rank_table: rank sequences by descending
+    length (stable).  Padded form: the LoD is the ``lengths [B]`` vector;
+    returns the [B] permutation (longest first), int32."""
+    import numpy as _np2
+    lv = lengths if lengths is not None else x
+    arr = _np2.asarray(lv.numpy() if hasattr(lv, "numpy") else lv)
+    arr = arr.reshape(-1)
+    order = _np2.argsort(-arr, kind="stable").astype(_np2.int32)
+    return Tensor(jnp.asarray(order))
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """ref control_flow.py reorder_lod_tensor_by_rank: permute the batch
+    rows of ``x`` by a lod_rank_table order (padded form: a [B] int
+    permutation)."""
+    def _reorder(v, order):
+        return v[order.astype(jnp.int32)]
+    return call(_reorder, x, rank_table, _nondiff=(1,),
+                _name="reorder_lod_tensor_by_rank")

@@ -52,6 +52,14 @@ def _lens_or_full(lengths, like, T):
     return jnp.full((B,), T, jnp.int32)
 
 
+def _masked_reverse(x, lens, T):
+    """Reverse each row's valid prefix in place (padding stays put) —
+    the shared pre/post-scan gather for every is_reverse recurrence."""
+    t = jnp.arange(T)[None, :]
+    src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    return jnp.take_along_axis(x, src[..., None], axis=1)
+
+
 def _masked_scan(step, carries, xs_t, lens, T):
     """Scan ``step`` over time, freezing every carry once t >= lens and
     zeroing the per-step outputs there (padded rows of the reference's LoD
@@ -92,9 +100,7 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
 
     def _run(x, w, b, lens, h0, c0):
         if is_reverse:
-            t = jnp.arange(T)[None, :]
-            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-            x = jnp.take_along_axis(x, src[..., None], axis=1)
+            x = _masked_reverse(x, lens, T)
         gb = b[:, :4 * D]
         if use_peepholes:
             w_ic = b[:, 4 * D:5 * D]
@@ -121,10 +127,8 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
         hs = jnp.swapaxes(hs, 0, 1)
         cs = jnp.swapaxes(cs, 0, 1)
         if is_reverse:
-            t = jnp.arange(T)[None, :]
-            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-            hs = jnp.take_along_axis(hs, src[..., None], axis=1)
-            cs = jnp.take_along_axis(cs, src[..., None], axis=1)
+            hs = _masked_reverse(hs, lens, T)
+            cs = _masked_reverse(cs, lens, T)
         return hs, cs
 
     B = int(input.shape[0])
@@ -162,9 +166,7 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
 
     def _run(x, w, wp, b, lens, r0, c0):
         if is_reverse:
-            t = jnp.arange(T)[None, :]
-            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-            x = jnp.take_along_axis(x, src[..., None], axis=1)
+            x = _masked_reverse(x, lens, T)
         gb = b[:, :4 * D]
         if use_peepholes:
             w_ic = b[:, 4 * D:5 * D]
@@ -196,10 +198,8 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         rs = jnp.swapaxes(rs, 0, 1)
         cs = jnp.swapaxes(cs, 0, 1)
         if is_reverse:
-            t = jnp.arange(T)[None, :]
-            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-            rs = jnp.take_along_axis(rs, src[..., None], axis=1)
-            cs = jnp.take_along_axis(cs, src[..., None], axis=1)
+            rs = _masked_reverse(rs, lens, T)
+            cs = _masked_reverse(cs, lens, T)
         return rs, cs
 
     B = int(input.shape[0])
@@ -233,9 +233,7 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
 
     def _run(x, w, b, lens, h0):
         if is_reverse:
-            t = jnp.arange(T)[None, :]
-            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-            x = jnp.take_along_axis(x, src[..., None], axis=1)
+            x = _masked_reverse(x, lens, T)
 
         def step(h, x_t):
             g = x_t + b                                # [B, 3D]
@@ -254,9 +252,7 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
         _, hs = _masked_scan(step, h0, xs_t, lens, T)
         hs = jnp.swapaxes(hs, 0, 1)
         if is_reverse:
-            t = jnp.arange(T)[None, :]
-            src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-            hs = jnp.take_along_axis(hs, src[..., None], axis=1)
+            hs = _masked_reverse(hs, lens, T)
         return hs
 
     B = int(input.shape[0])
@@ -459,9 +455,7 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     def _run(x, lens, h0, c0, *flat_ws):
         def one_direction(xs, w_ih, w_hh, b, h_init, c_init, reverse):
             if reverse:
-                t = jnp.arange(T)[None, :]
-                src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-                xs = jnp.take_along_axis(xs, src[..., None], axis=1)
+                xs = _masked_reverse(xs, lens, T)
 
             def step(carry, x_t):
                 h, c = carry
@@ -479,9 +473,7 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
                 step, (h_init, c_init), xs_t, lens, T)
             hs = jnp.swapaxes(hs, 0, 1)
             if reverse:
-                t = jnp.arange(T)[None, :]
-                src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-                hs = jnp.take_along_axis(hs, src[..., None], axis=1)
+                hs = _masked_reverse(hs, lens, T)
             return hs, h_fin, c_fin
 
         out = x

@@ -151,7 +151,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
                                                           **kwargs)
         outputs_list.append(out)
         lengths_np = lengths_np + (~fin_np).astype(np.int64)
-        fin_np = np.asarray(finished.numpy()).astype(bool)
+        new_fin = np.asarray(finished.numpy()).astype(bool)
+        # sticky finished (ref rnn.py:1509): once a row ends it stays
+        # ended, unless the decoder manages its own mask (beam search
+        # reorders slots, so its mask must be taken as-is)
+        fin_np = new_fin if decoder.tracks_own_finished \
+            else (fin_np | new_fin)
         inputs = next_inputs
         if bool(np.all(fin_np)):
             break

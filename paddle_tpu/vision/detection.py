@@ -348,6 +348,23 @@ def target_assign(input, matched_indices, negative_indices=None,
 # NMS family — fixed-size outputs (TPU contract: label -1 marks padding)
 # --------------------------------------------------------------------------
 
+def _box_delta_encode(anchors, targets, eps=1e-10):
+    """Faster-RCNN (+1-pixel) center/size delta encode shared by
+    rpn_target_assign / retinanet_target_assign / generate_proposal_labels:
+    anchors, targets [M, 4] -> [M, 4] (dx, dy, log dw, log dh)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    tw = targets[:, 2] - targets[:, 0] + 1.0
+    th = targets[:, 3] - targets[:, 1] + 1.0
+    tcx = targets[:, 0] + tw * 0.5
+    tcy = targets[:, 1] + th * 0.5
+    return jnp.stack([(tcx - acx) / aw, (tcy - acy) / ah,
+                      jnp.log(jnp.maximum(tw / aw, eps)),
+                      jnp.log(jnp.maximum(th / ah, eps))], -1)
+
+
 def _nms_single_class(scores, iou_full, iou_threshold, top_k, eta=1.0):
     """scores [N], iou_full [N,N] (original order, shared across classes)
     -> keep mask [N] via greedy NMS over the top_k highest-scoring boxes
@@ -777,18 +794,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
 
             labels = jnp.where(fg, 1, jnp.where(bg, 0, -1))
             # encode targets against matched gts
-            tgt = gt[best_g]
-            aw = ab_f[:, 2] - ab_f[:, 0] + 1.0
-            ah = ab_f[:, 3] - ab_f[:, 1] + 1.0
-            acx = ab_f[:, 0] + aw * 0.5
-            acy = ab_f[:, 1] + ah * 0.5
-            tw = tgt[:, 2] - tgt[:, 0] + 1.0
-            th = tgt[:, 3] - tgt[:, 1] + 1.0
-            tcx = tgt[:, 0] + tw * 0.5
-            tcy = tgt[:, 1] + th * 0.5
-            enc = jnp.stack([(tcx - acx) / aw, (tcy - acy) / ah,
-                             jnp.log(jnp.maximum(tw / aw, 1e-10)),
-                             jnp.log(jnp.maximum(th / ah, 1e-10))], -1)
+            enc = _box_delta_encode(ab_f, gt[best_g])
             enc = jnp.where(fg[:, None], enc, 0.0)
             return labels, enc, fg, bg
         gb_f = gb.astype(jnp.float32)
@@ -998,18 +1004,7 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             bg = (best_iou < negative_overlap) & ~fg
             labels = jnp.where(fg, lbl.reshape(-1)[best_g].astype(jnp.int32),
                                jnp.where(bg, 0, -1))
-            tgt = gt[best_g]
-            aw = ab_f[:, 2] - ab_f[:, 0] + 1.0
-            ah = ab_f[:, 3] - ab_f[:, 1] + 1.0
-            acx = ab_f[:, 0] + aw * 0.5
-            acy = ab_f[:, 1] + ah * 0.5
-            tw = tgt[:, 2] - tgt[:, 0] + 1.0
-            th = tgt[:, 3] - tgt[:, 1] + 1.0
-            tcx = tgt[:, 0] + tw * 0.5
-            tcy = tgt[:, 1] + th * 0.5
-            enc = jnp.stack([(tcx - acx) / aw, (tcy - acy) / ah,
-                             jnp.log(jnp.maximum(tw / aw, 1e-10)),
-                             jnp.log(jnp.maximum(th / ah, 1e-10))], -1)
+            enc = _box_delta_encode(ab_f, gt[best_g])
             enc = jnp.where(fg[:, None], enc, 0.0)
             inside_w = jnp.where(fg[:, None],
                                  jnp.ones((M, 4), jnp.float32), 0.0)
@@ -1059,17 +1054,17 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
         bxs = flat[:L]
         scs = flat[L:2 * L]
         ancs = flat[2 * L:]
-        B = bxs[0].shape[0]
         C = scs[0].shape[-1]
 
-        def per_image(args):
-            deltas, cls_sc, inf = args
+        def per_image(inf, *per_level):
+            deltas = per_level[:L]
+            cls_sc = per_level[L:]
             im_h = jnp.round(inf[0] / inf[2])
             im_w = jnp.round(inf[1] / inf[2])
             cand_boxes, cand_scores, cand_cls = [], [], []
             for li in range(L):
-                d = deltas[li]                            # [Mi, 4]
-                s = cls_sc[li]                            # [Mi, C]
+                d = deltas[li].astype(jnp.float32)        # [Mi, 4]
+                s = cls_sc[li].astype(jnp.float32)        # [Mi, C]
                 a = ancs[li].astype(jnp.float32)          # [Mi, 4]
                 Mi = d.shape[0]
                 flat_s = s.reshape(-1)                    # [Mi*C]
@@ -1128,13 +1123,11 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
                 jnp.where(valid, flat[top], 0.0)[:, None],
                 jnp.where(valid[:, None], boxes[idx], 0.0)], -1)
 
-        outs = []
-        for b in range(B):
-            outs.append(per_image((
-                [bx[b].astype(jnp.float32) for bx in bxs],
-                [s[b].astype(jnp.float32) for s in scs],
-                info[b].astype(jnp.float32))))
-        return jnp.stack(outs)
+        # one traced per-image body vmapped over the batch (anchors are
+        # batch-invariant: in_axes None) — not a B-times-unrolled loop
+        return jax.vmap(per_image,
+                        in_axes=(0,) + (0,) * (2 * L))(
+            info.astype(jnp.float32), *bxs, *scs)
 
     args = [im_info] + list(bboxes) + list(scores) + list(anchors)
     return call(_detect, *args, _name="retinanet_detection_output",
@@ -1280,3 +1273,134 @@ def roi_perspective_transform(input, rois, transformed_height,
     args = [input, rois] + ([rois_num] if rois_num is not None else [])
     return call(_rpt, *args, _name="roi_perspective_transform",
                 _nondiff=(1,) if rois_num is None else (1, 2))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             max_overlap=None, return_max_overlap=False):
+    """Fast R-CNN stage-2 sampling (ref detection.py:2596 /
+    generate_proposal_labels_op): append gts to the RPN proposals, split
+    into fg (max IoU >= fg_thresh) and bg (bg_thresh_lo <= IoU <
+    bg_thresh_hi), subsample to batch_size_per_im at fg_fraction, and
+    emit per-class box-regression targets.
+
+    DENSE fixed-shape form (TPU contract, like rpn_target_assign):
+    inputs are batched — rpn_rois [B, N, 4], gt_classes [B, G],
+    is_crowd [B, G], gt_boxes [B, G, 4] (zero-area rows = padding),
+    im_info [B, 3].  Returns
+
+      (rois [B, S, 4], labels_int32 [B, S], bbox_targets [B, S, 4*C],
+       bbox_inside_weights [B, S, 4*C], bbox_outside_weights [B, S, 4*C]
+       [, max_overlap [B, S]])
+
+    with S = batch_size_per_im, fg rows compacted first, label -1 on
+    unfilled padding rows.  Subsampling is deterministic rank truncation
+    (the masked analogue of the reference's random draw; use_random is
+    accepted for signature parity).
+
+    Cascade mode (is_cascade_rcnn=True, ref op FilterRoIs +
+    SampleFgBgGt cascade branch) requires ``max_overlap`` — each RoI's
+    previous-stage overlap, [B, N]: RoIs with max_overlap >= 1 (the
+    previous stage's appended gts) or degenerate size are dropped from
+    the candidate set, and NO fg/bg subsampling applies (every fg and bg
+    fills the fixed S slots in priority order).
+    """
+    C = 2 if is_cls_agnostic else int(class_nums)
+    S = int(batch_size_per_im)
+    max_fg = int(batch_size_per_im * fg_fraction)
+    rw = jnp.asarray(bbox_reg_weights, jnp.float32)
+    if is_cascade_rcnn and max_overlap is None:
+        raise ValueError("generate_proposal_labels: max_overlap must be "
+                         "given when is_cascade_rcnn=True (reference "
+                         "contract)")
+
+    def _gpl(rois_in, gcls, crowd, gbox, *rest):
+        prev_mo = rest[0] if rest else None
+
+        def per_image(rois, cls_g, cr, gt, pmo):
+            G = gt.shape[0]
+            pad_g = ~((gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1]))
+            # candidate set: gts FIRST, then proposals (ref op line 354)
+            if is_cascade_rcnn:
+                # drop previous-stage gt rows / degenerate rois
+                roi_ok = ((rois[:, 2] - rois[:, 0] + 1 > 0)
+                          & (rois[:, 3] - rois[:, 1] + 1 > 0)
+                          & (pmo < 1.0))
+            else:
+                roi_ok = jnp.ones((rois.shape[0],), bool)
+            cand = jnp.concatenate([gt, rois], 0)          # [G+N, 4]
+            cand_ok = jnp.concatenate([~pad_g, roi_ok], 0)
+            M = cand.shape[0]
+            iou = _pairwise_iou(gt, cand)                  # [G, G+N]
+            iou = jnp.where(pad_g[:, None], -1.0, iou)     # padded gt col
+            best = jnp.max(iou, axis=0)
+            best_g = jnp.argmax(iou, axis=0)
+            # crowd/padded gts are excluded as CANDIDATE rows
+            # (ref SampleFgBgGt: rows i < gt_num with is_crowd -> -1)
+            row_is_bad_gt = jnp.concatenate(
+                [(cr.reshape(-1) != 0) | pad_g,
+                 jnp.zeros((rois.shape[0],), bool)], 0)
+            best = jnp.where(row_is_bad_gt | ~cand_ok, -1.0, best)
+            fg = best >= fg_thresh
+            bg = (best >= bg_thresh_lo) & (best < bg_thresh_hi) & ~fg
+            if not is_cascade_rcnn:     # cascade keeps every fg/bg
+                fg_rank = jnp.cumsum(fg.astype(jnp.int32)) - 1
+                fg = fg & (fg_rank < max_fg)
+            n_fg = jnp.sum(fg.astype(jnp.int32))
+            bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+            bg = bg & (bg_rank < S - jnp.minimum(n_fg, S))
+            # compact: fg rows first, then bg, stable original order;
+            # pad when the candidate count is below S (small inputs)
+            prio = jnp.where(fg, 0, jnp.where(bg, 1, 2))
+            order = jnp.argsort(prio * M + jnp.arange(M))
+            if S <= M:
+                order = order[:S]
+                real = jnp.ones((S,), bool)
+            else:
+                order = jnp.concatenate(
+                    [order, jnp.zeros((S - M,), order.dtype)])
+                real = jnp.arange(S) < M
+            sel_fg = fg[order] & real
+            sel_bg = bg[order] & real
+            filled = sel_fg | sel_bg
+            sel_rois = jnp.where(filled[:, None], cand[order], 0.0)
+            lbl_fg = cls_g.reshape(-1)[best_g[order]].astype(jnp.int32)
+            if is_cls_agnostic:
+                lbl_fg = jnp.ones_like(lbl_fg)
+            labels = jnp.where(sel_fg, lbl_fg,
+                               jnp.where(sel_bg, 0, -1))
+            # encode vs matched gt, divided by bbox_reg_weights
+            enc = _box_delta_encode(sel_rois, gt[best_g[order]]) / rw
+            # per-class expansion: slot 4*label..4*label+4 carries the
+            # target, weights 1 there (fg rows only)
+            onehot = jax.nn.one_hot(jnp.clip(labels, 0, C - 1), C,
+                                    dtype=jnp.float32)     # [S, C]
+            onehot = onehot * sel_fg[:, None].astype(jnp.float32)
+            bbox_targets = (onehot[:, :, None]
+                            * enc[:, None, :]).reshape(S, 4 * C)
+            inside_w = (onehot[:, :, None]
+                        * jnp.ones((1, 1, 4))).reshape(S, 4 * C)
+            return (sel_rois, labels, bbox_targets, inside_w, inside_w,
+                    jnp.where(filled, best[order], 0.0))
+
+        if prev_mo is None:
+            return jax.vmap(lambda a, b, c, d: per_image(a, b, c, d, None)
+                            )(rois_in.astype(jnp.float32), gcls, crowd,
+                              gbox.astype(jnp.float32))
+        return jax.vmap(per_image)(rois_in.astype(jnp.float32), gcls,
+                                   crowd, gbox.astype(jnp.float32),
+                                   prev_mo.astype(jnp.float32))
+
+    args = [rpn_rois, gt_classes, is_crowd, gt_boxes] + (
+        [max_overlap] if max_overlap is not None else [])
+    out = call(_gpl, *args, _name="generate_proposal_labels",
+               _nondiff=tuple(range(len(args))))
+    rois, labels, tgts, iw, ow, mo = out
+    if return_max_overlap:
+        return rois, labels, tgts, iw, ow, mo
+    return rois, labels, tgts, iw, ow

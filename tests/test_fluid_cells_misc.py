@@ -251,3 +251,38 @@ def test_fluid_distribution_reexports():
     assert fluid.layers.Normal is not None
     assert fluid.layers.Categorical is not None
     assert fluid.layers.MultivariateNormalDiag is not None
+
+
+def test_dynamic_decode_sticky_finished():
+    """A row that emits end early must STAY finished even if a later step
+    samples a non-end token (reference logical_or semantics)."""
+    B, D, V = 2, 4, 6
+
+    class FlipHelper(fluid.layers.DecodeHelper):
+        def initialize(self):
+            return (paddle.to_tensor(np.zeros((B, D), np.float32)),
+                    paddle.to_tensor(np.zeros((B,), bool)))
+
+        def sample(self, time, outputs, states):
+            # row 0 emits end (id 3) ONLY at t==0, then non-end forever
+            ids = np.full((B,), 1, np.int64)
+            if time == 0:
+                ids[0] = 3
+            if time == 3:
+                ids[:] = 3
+            return paddle.to_tensor(ids)
+
+        def next_inputs(self, time, outputs, states, sample_ids):
+            fin = paddle.to_tensor(
+                np.asarray(sample_ids.numpy()).reshape(-1) == 3)
+            return fin, paddle.to_tensor(np.zeros((B, D), np.float32)), \
+                states
+
+    cell = fluid.layers.GRUCell(hidden_size=D)
+    dec = fluid.layers.BasicDecoder(cell, FlipHelper())
+    _, _, lengths = fluid.layers.dynamic_decode(
+        dec, inits=paddle.to_tensor(np.zeros((B, D), np.float32)),
+        max_step_num=8, return_length=True)
+    # row 0 finished at step 0 (length 1); row 1 at step 3 (length 4);
+    # without sticky finished row 0 would wrongly count 8 steps
+    np.testing.assert_array_equal(lengths.numpy(), [1, 4])

@@ -1,0 +1,102 @@
+"""Tests for the last fluid.layers coverage wave: filter_by_instag,
+generate_proposal_labels, codegen helpers, lod reorder
+(ref fluid/layers/nn.py:10126, detection.py:2596,
+layer_function_generator.py, control_flow.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_filter_by_instag():
+    ins = np.arange(8, dtype=np.float32).reshape(4, 2)
+    tags = np.array([[0, 1], [1, 3], [0, 3], [2, 6]], np.int64)
+    out, w = fluid.layers.filter_by_instag(
+        paddle.to_tensor(ins), paddle.to_tensor(tags),
+        paddle.to_tensor(np.array([1], np.int64)), True)
+    o, wv = out.numpy(), w.numpy()
+    # rows 0 and 1 carry tag 1 -> kept, compacted to the front
+    np.testing.assert_allclose(o[0], ins[0])
+    np.testing.assert_allclose(o[1], ins[1])
+    np.testing.assert_allclose(o[2:], 0.0)     # out_val_if_empty fill
+    np.testing.assert_allclose(wv.reshape(-1), [1, 1, 0, 0])
+
+    # no row matches -> all filled with out_val_if_empty, weights 0
+    out2, w2 = fluid.layers.filter_by_instag(
+        paddle.to_tensor(ins), paddle.to_tensor(tags),
+        paddle.to_tensor(np.array([9], np.int64)), True,
+        out_val_if_empty=7)
+    np.testing.assert_allclose(out2.numpy(), 7.0)
+    np.testing.assert_allclose(w2.numpy(), 0.0)
+
+
+def test_generate_proposal_labels_dense():
+    rois = np.array([[[0, 0, 10, 10], [20, 20, 28, 28], [100, 100, 110, 110],
+                      [0, 0, 9, 9]]], np.float32)
+    gt = np.array([[[0, 0, 10, 10], [21, 21, 29, 29]]], np.float32)
+    gcls = np.array([[3, 5]], np.int32)
+    crowd = np.zeros((1, 2), np.int32)
+    im_info = np.array([[200, 200, 1.0]], np.float32)
+    S = 6
+    rois_o, labels, tgts, iw, ow = fluid.layers.generate_proposal_labels(
+        paddle.to_tensor(rois), paddle.to_tensor(gcls),
+        paddle.to_tensor(crowd), paddle.to_tensor(gt),
+        paddle.to_tensor(im_info), batch_size_per_im=S, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=6)
+    lb = labels.numpy()[0]
+    ro = rois_o.numpy()[0]
+    # fg rows first: roi0 (IoU 1 with gt0, class 3), roi1 (IoU ~.6 gt1,
+    # class 5), roi3 (IoU ~.8 gt0), plus the two appended gts themselves
+    assert lb.shape == (S,)
+    n_fg = (lb > 0).sum()
+    assert n_fg == 3                     # capped at fg_fraction * S
+    assert set(lb[:n_fg]).issubset({3, 5})
+    # bg rows follow (roi2 has IoU 0 in [0, 0.5))
+    assert (lb[n_fg:] == 0).sum() >= 1
+    # per-class target layout: weights 1 exactly in the label's 4-slot
+    t = iw.numpy()[0]
+    for i in range(n_fg):
+        c = lb[i]
+        assert t[i, 4 * c:4 * c + 4].sum() == 4
+        assert t[i].sum() == 4
+    # exact-match fg roi encodes ~zero offsets in its class slot
+    exact = np.where((ro[:, 2] - ro[:, 0] == 10) & (lb == 3))[0][0]
+    bt = tgts.numpy()[0]
+    np.testing.assert_allclose(bt[exact, 12:16], 0.0, atol=1e-4)
+
+
+def test_generate_layer_fn_and_activation_fn():
+    relu = fluid.layers.generate_activation_fn("relu")
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    np.testing.assert_allclose(relu(x).numpy(), [0, 2])
+    fn = fluid.layers.generate_layer_fn("concat")
+    out = fn([x, x], axis=0)
+    assert out.shape == [4]
+    with pytest.raises(ValueError):
+        fluid.layers.generate_layer_fn("definitely_not_an_op")
+
+
+def test_templatedoc_and_autodoc():
+    @fluid.layers.templatedoc()
+    def f(x):
+        """Computes ${comment} over x. ${another_comment}Done."""
+        return x
+    assert "${" not in f.__doc__
+    assert "Done." in f.__doc__
+
+    @fluid.layers.autodoc(" extra")
+    def g(x):
+        """doc"""
+        return x
+    assert g.__doc__.endswith("extra")
+
+
+def test_reorder_lod_tensor_by_rank():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    lens = np.array([2, 5, 1, 4], np.int64)
+    table = fluid.layers.lod_rank_table(None, lengths=paddle.to_tensor(lens))
+    np.testing.assert_array_equal(table.numpy(), [1, 3, 0, 2])
+    out = fluid.layers.reorder_lod_tensor_by_rank(paddle.to_tensor(x),
+                                                  table)
+    np.testing.assert_allclose(out.numpy(), x[[1, 3, 0, 2]])

@@ -260,3 +260,35 @@ def test_multiclass_nms2_index_duplicates():
     assert o[0, 1] == pytest.approx(0.9) and ix[0] == 1
     assert o[1, 1] == pytest.approx(0.8) and ix[1] == 2
     assert ix[2] == -1
+
+
+def test_generate_proposal_labels_cascade():
+    """Cascade mode: previous-stage gt rows (max_overlap >= 1) are
+    dropped from the candidates and no fg subsample cap applies."""
+    rois = np.array([[[0, 0, 10, 10], [0, 0, 9, 10], [50, 50, 60, 60],
+                      [0, 0, 10, 10]]], np.float32)
+    mo = np.array([[0.9, 0.8, 0.0, 1.0]], np.float32)  # row3 = prev gt
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)
+    gcls = np.array([[2]], np.int32)
+    crowd = np.zeros((1, 1), np.int32)
+    im_info = np.array([[100, 100, 1.0]], np.float32)
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        fluid.layers.generate_proposal_labels(
+            paddle.to_tensor(rois), paddle.to_tensor(gcls),
+            paddle.to_tensor(crowd), paddle.to_tensor(gt),
+            paddle.to_tensor(im_info), class_nums=3, is_cascade_rcnn=True)
+    r, lbl, tgt, iw, ow, mo_out = fluid.layers.generate_proposal_labels(
+        paddle.to_tensor(rois), paddle.to_tensor(gcls),
+        paddle.to_tensor(crowd), paddle.to_tensor(gt),
+        paddle.to_tensor(im_info), batch_size_per_im=6,
+        fg_fraction=0.25,   # cap of 1 would apply in non-cascade mode
+        fg_thresh=0.5, bg_thresh_hi=0.5, class_nums=3,
+        is_cascade_rcnn=True, max_overlap=paddle.to_tensor(mo),
+        return_max_overlap=True)
+    lb = lbl.numpy()[0]
+    # fg: the gt candidate itself + roi0 + roi1 (IoU .9/.83) — 3 rows,
+    # ABOVE the 1-row fraction cap (cascade skips subsampling); the
+    # filtered prev-gt roi (row3) contributes nothing extra
+    assert (lb == 2).sum() == 3
+    assert (lb == 0).sum() >= 1        # roi2 is background
