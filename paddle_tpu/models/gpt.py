@@ -43,6 +43,12 @@ class GPTConfig:
     initializer_range: float = 0.02
     layer_norm_eps: float = 1e-5
     use_flash: bool = True
+    # Pallas fused-FFN / fused-LayerNorm routing for the hot blocks;
+    # default off — bench.py flips them on when the committed on-chip
+    # kernel check shows the Pallas kernel beating XLA at bench shapes
+    # (same gate as use_flash; see tools/tpu_kernel_check.py)
+    use_fused_ffn: bool = False
+    use_pallas_norm: bool = False
     remat: bool = True
     # "full": recompute the whole block in the backward (min HBM, +~33%
     # FLOPs); "dots": save matmul outputs, recompute elementwise/norms only
@@ -130,6 +136,11 @@ def _layer_norm(x, g, b, eps):
     return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
 
 
+def _pallas_layer_norm(x, g, b, eps):
+    from ..ops.pallas.norms import layer_norm
+    return layer_norm(x, g, b, eps)
+
+
 def _attention(q, k, v, cfg):
     # q,k,v: [B, N, nh, hd]
     if cfg.use_flash:
@@ -154,7 +165,8 @@ def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
     B, N, H = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
 
-    h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"], cfg.layer_norm_eps)
+    ln = _pallas_layer_norm if cfg.use_pallas_norm else _layer_norm
+    h = ln(x, blk["ln1_g"], blk["ln1_b"], cfg.layer_norm_eps)
     qkv = jnp.einsum("bnh,hcd->bncd", h, blk["qkv_w"].astype(cd))
     qkv = qkv + blk["qkv_b"].astype(cd)
     q, k, v = [qkv[:, :, i].reshape(B, N, nh, hd) for i in range(3)]
@@ -166,10 +178,15 @@ def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
     a = a @ blk["proj_w"].astype(cd) + blk["proj_b"].astype(cd)
     x = x + a
 
-    h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"], cfg.layer_norm_eps)
-    h = jax.nn.gelu(h @ blk["fc1_w"].astype(cd) + blk["fc1_b"].astype(cd),
-                    approximate=True)
-    h = h @ blk["fc2_w"].astype(cd) + blk["fc2_b"].astype(cd)
+    h = ln(x, blk["ln2_g"], blk["ln2_b"], cfg.layer_norm_eps)
+    if cfg.use_fused_ffn:
+        from ..ops.pallas.fused_ffn import fused_ffn
+        h = fused_ffn(h, blk["fc1_w"].astype(cd), blk["fc1_b"].astype(cd),
+                      blk["fc2_w"].astype(cd), blk["fc2_b"].astype(cd))
+    else:
+        h = jax.nn.gelu(h @ blk["fc1_w"].astype(cd)
+                        + blk["fc1_b"].astype(cd), approximate=True)
+        h = h @ blk["fc2_w"].astype(cd) + blk["fc2_b"].astype(cd)
     x = x + h
     return x if attn_fn is None else (x, aux)
 
