@@ -42,13 +42,14 @@ def _require_static(what):
 
 
 def _tensor_objects():
-    """Every live Tensor — ONE gc heap scan per block construct (build
-    time only).  gc enumeration is needed because tensors made by creation
-    ops (fill_constant & co) have no var id until first READ, which may
-    happen inside the block being captured, so no id-keyed registry can
-    enumerate them."""
-    import gc
-    return [o for o in gc.get_objects() if isinstance(o, Tensor)]
+    """Every live Tensor, from the WeakSet registry Tensor.__init__
+    maintains (tensor/tensor.py).  A registry — not a gc heap scan —
+    because creation-op results (fill_constant & co) have no var id until
+    first READ, which may happen inside the block being captured, so the
+    id-keyed ``_var_tensors`` map alone can't enumerate them; and a heap
+    scan is O(whole heap) per block build and GC-order dependent."""
+    from ..tensor.tensor import _live_tensors
+    return list(_live_tensors)
 
 
 def _snapshot_from(objs):

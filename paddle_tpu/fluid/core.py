@@ -4,6 +4,7 @@ core module; these are the names user code actually touches."""
 from ..framework.core import (CPUPlace, TPUPlace, CUDAPlace,  # noqa: F401
                               CUDAPinnedPlace, Place)
 from ..static.graph import Scope, global_scope  # noqa: F401
+from .reader_compat import EOFException  # noqa: F401
 from ..tensor.tensor import Tensor as VarBase  # noqa: F401
 from ..tensor.tensor import Tensor as LoDTensor  # noqa: F401
 

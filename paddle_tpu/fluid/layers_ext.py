@@ -44,7 +44,8 @@ from .. import create_parameter  # noqa: F401
 from ..static.nn import (crf_decoding, data_norm, nce, row_conv,  # noqa
                          conv3d_transpose, sparse_embedding)
 from ..vision.ops import deform_conv2d as deformable_conv  # noqa: F401
-from ..vision.ops import read_file  # noqa: F401
+from .reader_compat import (py_reader, create_py_reader_by_data,  # noqa
+                            double_buffer, read_file)
 from ..distribution import sampling_id  # noqa: F401
 
 sum = _T.sum          # noqa: A001  (fluid.layers.sum is elementwise list-sum)
@@ -978,6 +979,7 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
 
 from ..vision.detection import (generate_proposals,  # noqa: E402,F401
                                 rpn_target_assign, locality_aware_nms)
+from ..vision.mask_labels import generate_mask_labels  # noqa: E402,F401
 
 
 def continuous_value_model(input, cvm, use_cvm=True):

@@ -1,0 +1,329 @@
+"""fluid py_reader compat — the classic async feed idiom over the
+TPU-native input path.
+
+ref: python/paddle/fluid/layers/io.py:561 (py_reader), :732
+(create_py_reader_by_data), :843 (double_buffer), :876 (read_file).
+
+The reference builds a C++ reader-op chain (create_py_reader →
+create_double_buffer_reader) whose `read` ops the executor drains from a
+LoDTensorBlockingQueue filled by a Python thread.  Here the record-replay
+Program has no reader ops: py_reader() mints ordinary feed placeholders
+(static.data) and registers itself as their owner; a prefetch thread
+stages batches into the native C++ ring (runtime/ptpu_runtime.cc — the
+double-buffer analogue: bounded, GIL-released memcpy, backpressure) or a
+plain Queue; `Executor.run` fills any un-fed placeholder owned by a
+started reader via the feed hook below, and raises
+``fluid.core.EOFException`` when the pass is exhausted — so the classic
+
+    reader.start()
+    try:
+        while True: exe.run(fetch_list=[loss])
+    except fluid.core.EOFException:
+        reader.reset()
+
+loop runs verbatim.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import weakref
+
+import numpy as np
+
+from ..framework import core as _core
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a started py_reader's pass is exhausted
+    (ref: paddle/fluid/framework/reader.h EOFException, surfaced as
+    fluid.core.EOFException)."""
+
+
+_name_counter = itertools.count()
+# feed-var name -> weakref(PyReader): Executor feed hook resolves owners.
+_slot_owner: dict = {}
+
+_EOF = object()
+
+
+def _per_sample_shape(shape):
+    """Declared slot shape minus the leading (batch) dim; -1s survive and
+    np.reshape resolves them per field (DataFeeder reshape semantics)."""
+    return [int(s) for s in list(shape)[1:]]
+
+
+class PyReader:
+    """The Reader variable py_reader() returns: decorate_* to attach a
+    source, start()/reset() around each pass, read_file() to get the data
+    vars."""
+
+    def __init__(self, capacity, shapes=None, dtypes=None, lod_levels=None,
+                 name=None, use_double_buffer=True, feed_vars=None):
+        from ..static.graph import data as _static_data
+
+        self.capacity = int(capacity)
+        self.use_double_buffer = bool(use_double_buffer)
+        self.name = name or f"py_reader_{next(_name_counter)}"
+        if feed_vars is not None:
+            from ..static.graph import _feed_declared_shapes
+            self._slots = list(feed_vars)
+            self._dtypes = [np.dtype(t.value.dtype) for t in self._slots]
+            # the placeholder materializes -1 dims as 1; recover the
+            # user-declared shape so unknown dims stay unknown
+            self._sample_shapes = [
+                _per_sample_shape(_feed_declared_shapes.get(
+                    t.name, list(t.shape)))
+                for t in self._slots]
+        else:
+            if shapes is None or dtypes is None:
+                raise ValueError("py_reader needs shapes and dtypes")
+            self._slots = []
+            self._dtypes = []
+            self._sample_shapes = []
+            for i, (shp, dt) in enumerate(zip(shapes, dtypes)):
+                t = _static_data(f"{self.name}_slot_{i}", list(shp), dt)
+                self._slots.append(t)
+                self._dtypes.append(np.dtype(_core.convert_dtype(dt)))
+                self._sample_shapes.append(_per_sample_shape(shp))
+        for t in self._slots:
+            _slot_owner[t.name] = weakref.ref(self)
+
+        self._source = None          # ("sample" | "batch", callable)
+        self._thread = None
+        self._ring = None
+        self._queue = None
+        self._stop = threading.Event()
+        self._error = None
+        self._started = False
+
+    # -- source decoration (ref io.py: decorate_paddle_reader /
+    #    decorate_tensor_provider; 2.0 PyReader spells them
+    #    decorate_sample_list_generator / decorate_batch_generator) -------
+    def decorate_paddle_reader(self, reader, places=None):
+        """`reader()` yields lists of per-sample field tuples (a
+        paddle.batch-style batched reader)."""
+        self._source = ("sample", reader)
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        """`reader()` yields already-batched array tuples."""
+        self._source = ("batch", reader)
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- batch assembly ---------------------------------------------------
+    def _assemble(self, item, mode):
+        out = []
+        if mode == "sample":
+            for i, (dt, sshape) in enumerate(
+                    zip(self._dtypes, self._sample_shapes)):
+                fields = [np.asarray(s[i]) for s in item]
+                if sshape and sshape.count(-1) <= 1:
+                    fields = [f.reshape(sshape) for f in fields]
+                out.append(np.stack(fields).astype(dt, copy=False))
+        else:
+            for f, dt in zip(item, self._dtypes):
+                a = f.numpy() if hasattr(f, "numpy") else np.asarray(f)
+                out.append(np.ascontiguousarray(a).astype(dt, copy=False))
+        return out
+
+    # -- pass lifecycle ---------------------------------------------------
+    def start(self):
+        if self._source is None:
+            raise RuntimeError(
+                f"py_reader {self.name!r}: no data source; call "
+                "decorate_paddle_reader/decorate_tensor_provider first")
+        if self._started:
+            raise RuntimeError(
+                f"py_reader {self.name!r} already started; reset() first")
+        self._stop.clear()
+        self._error = None
+        if self.use_double_buffer:
+            from .. import runtime
+            if runtime.is_available():
+                self._ring = runtime.DataRing(capacity=self.capacity)
+        if self._ring is None:
+            self._queue = queue.Queue(maxsize=self.capacity)
+        mode, src = self._source
+        self._thread = threading.Thread(
+            target=self._fill, args=(mode, src), daemon=True,
+            name=f"{self.name}_prefetch")
+        self._started = True
+        self._thread.start()
+
+    def _fill(self, mode, src):
+        try:
+            for tag, item in enumerate(src()):
+                if self._stop.is_set():
+                    return
+                batch = self._assemble(item, mode)
+                if self._ring is not None:
+                    # blocks while full (backpressure); CLOSED on reset
+                    if self._ring.push(batch, tag) != 0:
+                        return
+                else:
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+        except Exception as e:  # surfaced on the consumer side
+            self._error = e
+        finally:
+            if self._ring is not None:
+                self._ring.close()
+            elif self._queue is not None:
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(_EOF, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+    def _next_batch(self):
+        """Next staged batch as numpy arrays; EOFException when the pass
+        is done (or the reader was never started)."""
+        if not self._started:
+            raise EOFException(
+                f"py_reader {self.name!r} not started (or already "
+                "exhausted); call start()")
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._finish()
+            raise err
+        if self._ring is not None:
+            got = self._ring.pop()        # None == closed + drained
+            if got is None:
+                # the filler closes the ring on error too — a consumer
+                # already blocked in pop() sees the close before it could
+                # see self._error, so re-check before declaring a clean EOF
+                self._raise_error_or_eof()
+            views, _tag = got
+            # views alias ring memory recycled on the NEXT pop — copy out
+            return [np.array(v) for v in views]
+        item = self._queue.get()
+        if item is _EOF:
+            self._raise_error_or_eof()
+        return item
+
+    def _raise_error_or_eof(self):
+        self._finish()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        raise EOFException(f"py_reader {self.name!r} pass finished")
+
+    def _finish(self):
+        self._started = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._ring is not None:
+            self._ring.destroy()
+            self._ring = None
+        self._queue = None
+
+    def reset(self):
+        """End the pass: stop the prefetch thread and drop staged batches.
+        start() begins a fresh pass (the source callable is re-invoked)."""
+        self._stop.set()
+        if self._ring is not None:
+            self._ring.close()
+            # drain so a push blocked on a full ring unblocks
+            try:
+                while self._ring.pop(timeout_ms=100) is not None:
+                    pass
+            except Exception:
+                pass
+        elif self._queue is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        self._finish()
+
+    shutdown = reset
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """ref: fluid/layers/io.py:561 — async Python-fed reader variable."""
+    return PyReader(capacity, shapes=shapes, dtypes=dtypes,
+                    lod_levels=lod_levels, name=name,
+                    use_double_buffer=use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """ref: fluid/layers/io.py:732 — py_reader over existing fluid.data
+    vars (their names/shapes/dtypes define the slots)."""
+    return PyReader(capacity, name=name,
+                    use_double_buffer=use_double_buffer,
+                    feed_vars=feed_list)
+
+
+def double_buffer(reader, place=None, name=None):
+    """ref: fluid/layers/io.py:843 — wrap a reader with host double
+    buffering.  Here buffering is the native C++ staging ring; this just
+    switches it on for a reader created with use_double_buffer=False."""
+    if not isinstance(reader, PyReader):
+        raise TypeError("double_buffer expects a py_reader Reader variable")
+    reader.use_double_buffer = True
+    return reader
+
+
+def read_file(reader):
+    """ref: fluid/layers/io.py:876 — unpack a reader variable's data vars.
+    (paddle.vision read_file — byte-reading a path — keeps its own name in
+    vision.ops; fluid.layers.read_file dispatches on the argument.)"""
+    if isinstance(reader, PyReader):
+        slots = list(reader._slots)
+        return slots if len(slots) > 1 else slots[0]
+    from ..vision.ops import read_file as _vision_read_file
+    return _vision_read_file(reader)
+
+
+def _install_feed_hook():
+    from ..static import graph as _graph
+    if fill_feed_from_readers not in _graph._executor_feed_hooks:
+        _graph._executor_feed_hooks.append(fill_feed_from_readers)
+
+
+def fill_feed_from_readers(program, feed):
+    """Executor feed hook: any feed placeholder registered to a started
+    PyReader and absent from `feed` pulls the next staged batch (one batch
+    per reader per run)."""
+    pending = {}
+    for fname in program.feed_ids:
+        if fname in feed:
+            continue
+        ref = _slot_owner.get(fname)
+        rd = ref() if ref is not None else None
+        if rd is not None and rd._started:
+            pending.setdefault(id(rd), rd)
+    if not pending:
+        return feed
+    feed = dict(feed)
+    for rd in pending.values():
+        fed = [t.name for t in rd._slots if t.name in feed]
+        if fed:
+            # feeding SOME of a started reader's slots while pulling the
+            # rest from its queue would pair fields from different batches
+            # — reject, like the reference's feed-vs-reader ownership check
+            raise RuntimeError(
+                f"py_reader {rd.name!r} is started but {fed} were passed "
+                "in feed= — feed all of its slots explicitly or none")
+        arrays = rd._next_batch()
+        for t, a in zip(rd._slots, arrays):
+            feed[t.name] = a
+    return feed
+
+
+_install_feed_hook()

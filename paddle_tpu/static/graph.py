@@ -35,6 +35,15 @@ _live_var_ids = set()
 # var-id -> weakref(Tensor): lets control flow recover build-time values
 # for const baking without scanning the heap.
 _var_tensors = {}
+# hooks run by Executor.run to complete the feed dict before compile/replay
+# — fluid's py_reader compat registers here (fluid/reader_compat.py) so a
+# started reader's placeholders auto-pull staged batches.  Kept as a hook
+# list (not an import) to avoid a static -> fluid dependency.
+_executor_feed_hooks = []
+# feed name -> shape as the USER declared it (-1 for unknown dims) — the
+# placeholder tensor materializes unknowns as 1, so consumers needing the
+# ragged contract (py_reader sample reshape) read it from here.
+_feed_declared_shapes = {}
 
 
 def in_static_mode():
@@ -222,7 +231,9 @@ def record_call(fn, leaves, treedef, out_tensors, name):
 def data(name, shape, dtype="float32", lod_level=0):
     """Feed placeholder (ref: python/paddle/fluid/data.py).  Dummy batch dim 1
     for unknown dims during build; real shapes come from the feed."""
-    shape = [1 if (s is None or s < 0) else int(s) for s in shape]
+    declared = [-1 if (s is None or s < 0) else int(s) for s in shape]
+    _feed_declared_shapes[name] = declared
+    shape = [1 if s < 0 else s for s in declared]
     t = Tensor(np.zeros(shape, np.dtype(core.convert_dtype(dtype))))
     t.stop_gradient = True
     prog = default_main_program()
@@ -257,6 +268,8 @@ class Executor:
         if getattr(program, "_is_startup", False) or not program.ops:
             return []  # startup: params already initialized eagerly
         feed = feed or {}
+        for hook in _executor_feed_hooks:
+            feed = hook(program, feed)
         fetch_list = fetch_list or []
 
         fetch_ids = []

@@ -12,6 +12,8 @@ how the reference binds ``python/paddle/tensor/*`` onto VarBase.
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 import jax
@@ -20,6 +22,14 @@ import jax.numpy as jnp
 from ..framework import core
 from ..autograd import tape
 from ..ops import dispatch
+
+# Registry of every live Tensor (weak refs; entries vanish on collection).
+# Static-graph control-flow blocks enumerate this to snapshot entry values /
+# detect in-block mutation — the alternative is a gc.get_objects() heap
+# scan, which is O(whole heap) per block build and GC-order dependent.
+# Kept here (not in static/graph.py) so id-less tensors — creation-op
+# results that get a var id only on first read — are enumerable too.
+_live_tensors = weakref.WeakSet()
 
 
 def _to_jax_value(data, dtype=None, place=None):
@@ -68,6 +78,7 @@ class Tensor:
             name = f"tensor_{Tensor._next_id[0]}"
         self.name = name
         self.persistable = False
+        _live_tensors.add(self)
 
     # -- metadata ----------------------------------------------------------
     @property

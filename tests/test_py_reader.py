@@ -1,0 +1,322 @@
+"""py_reader compat trio + generate_mask_labels (the last 4 fluid.layers
+names; ref fluid/layers/io.py:561,732,843, detection.py:2748)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def _batched_reader(n_batches=5, bs=4):
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(n_batches):
+            yield [(rng.rand(784).astype("float32"),
+                    np.array([rng.randint(10)], "int64"))
+                   for _ in range(bs)]
+    return reader
+
+
+def _build_net():
+    img = fluid.layers.py_reader(capacity=8,
+                                 shapes=[(-1, 1, 28, 28), (-1, 1)],
+                                 dtypes=["float32", "int64"],
+                                 use_double_buffer=False)
+    x, lbl = fluid.layers.read_file(img)
+    flat = fluid.layers.reshape(x, [-1, 784])
+    logits = fluid.layers.fc(flat, 10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, lbl))
+    return img, loss
+
+
+class TestPyReader:
+    def test_classic_loop_runs_verbatim(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                reader, loss = _build_net()
+                reader.decorate_paddle_reader(_batched_reader(n_batches=5))
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                for _epoch in range(2):     # restartable across passes
+                    reader.start()
+                    losses = []
+                    try:
+                        while True:
+                            lv, = exe.run(main, fetch_list=[loss])
+                            losses.append(float(lv))
+                    except fluid.core.EOFException:
+                        reader.reset()
+                    assert len(losses) == 5
+                    assert all(np.isfinite(l) for l in losses)
+        finally:
+            paddle.disable_static()
+
+    def test_sample_fields_reshaped_to_slot_shape(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=4, shapes=[(-1, 2, 3)], dtypes=["float32"],
+                    use_double_buffer=False)
+                x = fluid.layers.read_file(rd)
+                y = fluid.layers.reduce_sum(x)
+
+                def src():
+                    yield [(np.arange(6, dtype="float32"),)]  # flat field
+                rd.decorate_paddle_reader(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                val, = exe.run(main, fetch_list=[y])
+                assert float(val) == 15.0
+                with pytest.raises(fluid.core.EOFException):
+                    while True:
+                        exe.run(main, fetch_list=[y])
+                rd.reset()
+        finally:
+            paddle.disable_static()
+
+    def test_tensor_provider_and_double_buffer(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=4, shapes=[(-1, 3), (-1, 1)],
+                    dtypes=["float32", "int64"], use_double_buffer=False)
+                rd = fluid.layers.double_buffer(rd)
+                assert rd.use_double_buffer
+                a, b = fluid.layers.read_file(rd)
+                out = fluid.layers.reduce_sum(a) + fluid.layers.cast(
+                    fluid.layers.reduce_sum(b), "float32")
+
+                def src():
+                    for i in range(3):
+                        yield (np.full((2, 3), i, "float32"),
+                               np.full((2, 1), i, "int64"))
+                rd.decorate_tensor_provider(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                vals = []
+                try:
+                    while True:
+                        v, = exe.run(main, fetch_list=[out])
+                        vals.append(float(v))
+                except fluid.core.EOFException:
+                    rd.reset()
+                assert vals == [0.0, 8.0, 16.0]
+        finally:
+            paddle.disable_static()
+
+    def test_create_py_reader_by_data(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                img = fluid.layers.data("img_byd", [4], dtype="float32")
+                lbl = fluid.layers.data("lbl_byd", [1], dtype="int64")
+                rd = fluid.layers.create_py_reader_by_data(
+                    capacity=4, feed_list=[img, lbl],
+                    use_double_buffer=False)
+                got = fluid.layers.read_file(rd)
+                assert [t.name for t in got] == ["img_byd", "lbl_byd"]
+                s = fluid.layers.reduce_sum(img)
+
+                def src():
+                    yield [(np.ones(4, "float32"), np.array([7], "int64"))]
+                rd.decorate_paddle_reader(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                v, = exe.run(main, fetch_list=[s])
+                assert float(v) == 4.0
+                rd.reset()
+        finally:
+            paddle.disable_static()
+
+    def test_source_error_beats_eof_when_consumer_blocked(self):
+        # the filler closes the queue/ring on error too — a consumer that
+        # was already waiting must see the error, not a clean EOF
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=2, shapes=[(-1, 2)], dtypes=["float32"],
+                    use_double_buffer=False)
+                x = fluid.layers.read_file(rd)
+                y = fluid.layers.reduce_sum(x)
+
+                def src():
+                    import time
+                    yield (np.ones((1, 2), "float32"),)
+                    time.sleep(0.5)      # consumer blocks on the queue
+                    raise ValueError("late source crash")
+                rd.decorate_batch_generator(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                exe.run(main, fetch_list=[y])
+                with pytest.raises(ValueError, match="late source crash"):
+                    exe.run(main, fetch_list=[y])
+        finally:
+            paddle.disable_static()
+
+    def test_by_data_preserves_unknown_dims(self):
+        # fluid.data with -1 non-batch dims: samples keep their real size
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                seq = fluid.layers.data("seq_byd", [-1, 8],
+                                        dtype="float32",
+                                        append_batch_size=True)
+                rd = fluid.layers.create_py_reader_by_data(
+                    capacity=2, feed_list=[seq], use_double_buffer=False)
+                s = fluid.layers.reduce_sum(seq)
+
+                def src():
+                    yield [(np.ones((5, 8), "float32"),)]
+                rd.decorate_paddle_reader(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                v, = exe.run(main, fetch_list=[s])
+                assert float(v) == 40.0
+                rd.reset()
+        finally:
+            paddle.disable_static()
+
+    def test_partial_manual_feed_rejected(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=2, shapes=[(-1, 2), (-1, 1)],
+                    dtypes=["float32", "float32"], use_double_buffer=False)
+                a, b = fluid.layers.read_file(rd)
+                y = fluid.layers.reduce_sum(a) + fluid.layers.reduce_sum(b)
+
+                def src():
+                    yield (np.ones((1, 2), "float32"),
+                           np.ones((1, 1), "float32"))
+                rd.decorate_batch_generator(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                with pytest.raises(RuntimeError, match="feed all"):
+                    exe.run(main,
+                            feed={rd._slots[0].name:
+                                  np.zeros((1, 2), "float32")},
+                            fetch_list=[y])
+                rd.reset()
+        finally:
+            paddle.disable_static()
+
+    def test_source_error_surfaces_on_consumer(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=2, shapes=[(-1, 2)], dtypes=["float32"],
+                    use_double_buffer=False)
+                x = fluid.layers.read_file(rd)
+                y = fluid.layers.reduce_sum(x)
+
+                def src():
+                    raise RuntimeError("boom in source")
+                    yield  # pragma: no cover
+                rd.decorate_batch_generator(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                import time
+                time.sleep(0.3)   # let the filler thread hit the error
+                with pytest.raises(RuntimeError, match="boom in source"):
+                    exe.run(main, fetch_list=[y])
+        finally:
+            paddle.disable_static()
+
+
+class TestGenerateMaskLabels:
+    """Golden tests against hand-computable rectangle polygons
+    (ref generate_mask_labels_op.cc / mask_util.cc)."""
+
+    def _run(self, rois, labels, polys, gt_cls, crowd=None, K=3, M=4,
+             scale=1.0):
+        crowd = crowd if crowd is not None else [0] * len(gt_cls)
+        return fluid.layers.generate_mask_labels(
+            im_info=np.array([[32.0, 32.0, scale]], "float32"),
+            gt_classes=[np.asarray(gt_cls)],
+            is_crowd=[np.asarray(crowd)],
+            gt_segms=[polys],
+            rois=[np.asarray(rois, "float32")],
+            labels_int32=[np.asarray(labels, "int32")],
+            num_classes=K, resolution=M)
+
+    def test_full_rectangle_gives_all_ones_in_class_slice(self):
+        # one gt (class 1) whose polygon exactly covers the single fg roi
+        rect = [0.0, 0.0, 8.0, 0.0, 8.0, 8.0, 0.0, 8.0]
+        mask_rois, has_mask, mask_int32, lod = self._run(
+            rois=[[0, 0, 8, 8]], labels=[1], polys=[[rect]], gt_cls=[1],
+            K=3, M=4)
+        assert mask_rois.shape == (1, 4)
+        assert has_mask.tolist() == [[0]]
+        assert lod.tolist() == [1]
+        m = mask_int32.reshape(3, 4, 4)
+        assert (m[0] == -1).all()               # background slice ignored
+        assert (m[1] == 1).all()                # fg class slice: full mask
+        assert (m[2] == -1).all()
+    def test_half_rectangle(self):
+        # polygon covers the left half of the roi -> left half columns set
+        rect = [0.0, 0.0, 4.0, 0.0, 4.0, 8.0, 0.0, 8.0]
+        _, _, mask_int32, _ = self._run(
+            rois=[[0, 0, 8, 8]], labels=[2], polys=[[rect]], gt_cls=[2],
+            K=3, M=4)
+        m = mask_int32.reshape(3, 4, 4)[2]
+        assert (m[:, :2] == 1).all() and (m[:, 2:] == 0).all()
+
+    def test_best_overlap_gt_chosen_and_crowd_skipped(self):
+        # two gts; roi overlaps gt1 (right side). gt0 is crowd -> skipped,
+        # so only gt1 participates regardless of overlap.
+        left = [0.0, 0.0, 8.0, 0.0, 8.0, 16.0, 0.0, 16.0]
+        right = [8.0, 0.0, 16.0, 0.0, 16.0, 16.0, 8.0, 16.0]
+        _, _, mask_int32, _ = self._run(
+            rois=[[8, 0, 16, 16]], labels=[1],
+            polys=[[left], [right]], gt_cls=[1, 1], crowd=[1, 0],
+            K=2, M=4)
+        m = mask_int32.reshape(2, 4, 4)[1]
+        assert (m == 1).all()   # right polygon fully covers the roi
+
+    def test_no_fg_falls_back_to_ignore_mask(self):
+        rect = [0.0, 0.0, 8.0, 0.0, 8.0, 8.0, 0.0, 8.0]
+        mask_rois, has_mask, mask_int32, lod = self._run(
+            rois=[[0, 0, 8, 8], [8, 8, 16, 16]], labels=[0, 0],
+            polys=[[rect]], gt_cls=[1], K=3, M=4)
+        assert mask_rois.shape == (1, 4)
+        assert (mask_int32 == -1).all()
+        assert lod.tolist() == [1]
+
+    def test_multi_image_lod(self):
+        rect = [0.0, 0.0, 8.0, 0.0, 8.0, 8.0, 0.0, 8.0]
+        out = fluid.layers.generate_mask_labels(
+            im_info=np.array([[32, 32, 1.0], [32, 32, 1.0]], "float32"),
+            gt_classes=[np.array([1]), np.array([1])],
+            is_crowd=[np.array([0]), np.array([0])],
+            gt_segms=[[[rect]], [[rect]]],
+            rois=[np.array([[0, 0, 8, 8]], "float32"),
+                  np.array([[0, 0, 8, 8], [1, 1, 7, 7]], "float32")],
+            labels_int32=[np.array([1], "int32"),
+                          np.array([1, 1], "int32")],
+            num_classes=2, resolution=4)
+        mask_rois, has_mask, mask_int32, lod = out
+        assert lod.tolist() == [1, 2]
+        assert mask_rois.shape == (3, 4)
+        assert mask_int32.shape == (3, 2 * 16)

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Watch for the axon relay coming alive: poll listening TCP ports every 30s,
+# log any change to tools/relay_watch.log. The relay (outer-driver-spawned
+# stdio bridge) listens on localhost high ports (8082-range historically);
+# when a new port appears, it's the signal to run bench.py immediately.
+LOG=/root/repo/tools/relay_watch.log
+prev=""
+while true; do
+  cur=$(python3 - <<'EOF'
+ports = set()
+for f in ("/proc/net/tcp", "/proc/net/tcp6"):
+    try:
+        with open(f) as fh:
+            for line in fh.readlines()[1:]:
+                parts = line.split()
+                if parts[3] == "0A":
+                    ports.add(int(parts[1].rsplit(":", 1)[1], 16))
+    except OSError:
+        pass
+print(" ".join(str(p) for p in sorted(ports)))
+EOF
+)
+  if [ "$cur" != "$prev" ]; then
+    echo "$(date -u +%FT%TZ) listening: $cur" >> "$LOG"
+    prev="$cur"
+  fi
+  sleep 30
+done
