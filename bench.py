@@ -1,9 +1,11 @@
-"""Flagship benchmark: GPT + ERNIE train-step throughput on one chip.
+"""Flagship benchmark: GPT + ERNIE + ResNet50 train-step throughput on one
+chip.
 
-Measures tokens/sec/chip for fully fused jitted train steps (bf16 compute on
+Measures throughput for fully fused jitted train steps (bf16 compute on
 the MXU, remat, fused AdamW) and reports MFU against the reference's 35%-MFU
-north star (BASELINE.json).  Prints one JSON line per metric (GPT flagship
-first, ERNIE-3.0-Base second — BASELINE.json's named metric).
+north star (BASELINE.json).  Prints one JSON line per metric, in
+BASELINE.json order of importance: GPT-1.3B flagship tokens/sec/chip,
+ERNIE-3.0-Base pretrain tokens/sec/chip, ResNet50 static-DP imgs/sec/chip.
 
 Process architecture (round-4 redesign): the axon TPU tunnel in this
 container can wedge so hard that ``jax.devices()`` blocks forever inside
@@ -13,8 +15,13 @@ wedged benchmark.  The only reliable preemption is SIGKILL from *outside*.
 Therefore this file is three programs in one:
 
   bench.py            orchestrator — never touches the jax backend; spawns
-                      the probe and run phases as SIGKILL-able children
+                      the probe, kernel-check and run phases as
+                      SIGKILL-able children (strictly sequential: never
+                      two TPU clients at once)
   bench.py --probe    child: touch the device, print platform JSON, exit
+  tools/tpu_kernel_check.py   child: on-chip Pallas compile+parity+timing
+                      gate; refreshes tools/tpu_kernel_check.json so the
+                      gate artifact is the same age as the run
   bench.py --run      child: the actual timed benchmarks (one process, one
                       client) streaming metric JSON lines to stdout
 
@@ -132,18 +139,20 @@ def _run_gpt_config(cfg, batch, steps, mesh, moment_dtype):
     return batch * N * steps / dt, final_loss
 
 
-def _run_ernie(on_tpu, peak, sweep):
-    """ERNIE-3.0-Base pretrain throughput — BASELINE.json's named metric."""
+def _ernie_state_gib(cfg):
+    """fp32 params + AdamW moments + one grad tree — the deterministic
+    part of the ERNIE footprint (VERDICT r4 item 10: de-risk the one
+    timed shot against a 16GB chip before spending budget on it)."""
+    return cfg.num_params() * 4 * 4 / 2**30
+
+
+def _time_ernie_batch(cfg, batch, steps):
     import numpy as np
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import bert
 
-    cfg = bert.ernie_3_base() if on_tpu else bert.bert_tiny()
-    batch = 64 if on_tpu else 4
-    steps = 10 if on_tpu else 2
     N = cfg.max_seq_len
-
     params, m, v = bert.init_pretrain_state(cfg, jax.random.PRNGKey(0))
     step = bert.make_train_step(cfg)
 
@@ -164,21 +173,127 @@ def _run_ernie(on_tpu, peak, sweep):
     final_loss = float(loss)          # host fetch closes the region
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
+    return batch * N * steps / dt, final_loss
 
-    tokens_per_sec = batch * N * steps / dt
-    mfu = tokens_per_sec * cfg.flops_per_token() / peak
-    assert 0.0 < mfu <= 1.0 or not on_tpu, mfu
-    print(json.dumps({
-        "metric": "ernie3_base_pretrain_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / TARGET_MFU, 4),
-    }), flush=True)
-    print(f"# model=ERNIE-{cfg.num_params()/1e6:.0f}M seq={N} batch={batch} "
-          f"loss={final_loss:.4f} mfu={mfu:.3f}", file=sys.stderr)
-    sweep["ernie"] = {"batch": batch, "seq": N, "steps": steps,
-                      "tokens_per_sec": round(tokens_per_sec, 1),
-                      "mfu": round(mfu, 4), "loss": round(final_loss, 4)}
+
+def _emit_over_batches(name, batches, time_fn, flops_per_unit, unit,
+                       on_tpu, peak, sweep, sweep_key, extra):
+    """Shared batch-fallback chain for the ERNIE/ResNet metric lines: try
+    each batch, emit the metric JSON for the first success (MFU over the
+    35% north star as vs_baseline), record every attempt in the sweep.
+    A single OOM must cost one retry, not the round's only timed shot."""
+    last_err = None
+    for batch in batches:
+        try:
+            rate, final_loss = time_fn(batch)
+        except Exception as e:                             # noqa: BLE001
+            last_err = e
+            print(f"# {sweep_key} batch={batch} failed ({type(e).__name__}"
+                  f": {e}); trying fallback", file=sys.stderr)
+            sweep.setdefault(f"{sweep_key}_attempts", []).append(
+                {"batch": batch, "error": f"{type(e).__name__}: {e}"})
+            continue
+        mfu = rate * flops_per_unit / peak
+        assert 0.0 < mfu <= 1.0 or not on_tpu, mfu
+        print(json.dumps({
+            "metric": name,
+            "value": round(rate, 1),
+            "unit": unit,
+            "vs_baseline": round(mfu / TARGET_MFU, 4),
+        }), flush=True)
+        print(f"# {extra} batch={batch} loss={final_loss:.4f} "
+              f"mfu={mfu:.3f}", file=sys.stderr)
+        sweep[sweep_key] = dict(extra=extra, batch=batch,
+                                rate=round(rate, 1), unit=unit,
+                                mfu=round(mfu, 4),
+                                loss=round(final_loss, 4))
+        return
+    raise RuntimeError(f"all {sweep_key} batches failed: {last_err}")
+
+
+def _run_ernie(on_tpu, peak, sweep):
+    """ERNIE-3.0-Base pretrain throughput — BASELINE.json's named metric."""
+    from paddle_tpu.models import bert
+
+    cfg = bert.ernie_3_base() if on_tpu else bert.bert_tiny()
+    state_gib = _ernie_state_gib(cfg)
+    assert state_gib < 8.0, (
+        f"ERNIE optimizer state alone is {state_gib:.1f}GiB — leaves no "
+        "headroom for activations on a 16GB chip; shrink the config")
+    steps = 10 if on_tpu else 2
+    _emit_over_batches(
+        "ernie3_base_pretrain_tokens_per_sec_per_chip",
+        [64, 32, 16] if on_tpu else [4],
+        lambda b: _time_ernie_batch(cfg, b, steps),
+        cfg.flops_per_token(), "tokens/s/chip", on_tpu, peak, sweep,
+        "ernie",
+        f"model=ERNIE-{cfg.num_params()/1e6:.0f}M seq={cfg.max_seq_len} "
+        f"steps={steps}")
+
+
+# ResNet50 train FLOPs/img at 224x224: ~4.09 GFLOP forward (public
+# conv-by-conv count), x3 for the backward's two conv passes.
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+
+
+def _time_resnet_batch(batch, steps, image_size=224, classes=1000):
+    """One jitted static-graph DP train step (examples/resnet50_static_dp
+    program) timed with device-resident feeds — host->device transfer of
+    the 38MB image batch through the tunnel must not pollute the step
+    time, so the batch is converted once and re-fed by handle."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.vision.models import resnet50
+    import paddle_tpu.nn.functional as F
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("image", [None, 3, image_size, image_size],
+                              "float32")
+            label = static.data("label", [None, 1], "int64")
+            logits = resnet50(num_classes=classes)(img)
+            loss = F.cross_entropy(logits, label).mean()
+            opt = paddle.optimizer.Momentum(learning_rate=0.002,
+                                            momentum=0.9, weight_decay=1e-4)
+            opt.minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randn(
+                batch, 3, image_size, image_size).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(
+                0, classes, (batch, 1)).astype(np.int64))
+            feed = {"image": x, "label": y}
+
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])  # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            final_loss = float(np.asarray(lv))  # fetched every step anyway
+            dt = time.perf_counter() - t0
+            assert np.isfinite(final_loss)
+            return batch * steps / dt, final_loss
+    finally:
+        paddle.disable_static()
+
+
+def _run_resnet(on_tpu, peak, sweep):
+    """ResNet50 imgs/sec/chip — BASELINE.json configs[1] (static-graph DP).
+    vs_baseline uses the same MFU-over-0.35 yardstick as the other lines."""
+    steps = 10 if on_tpu else 2
+    image_size = 224 if on_tpu else 32
+    classes = 1000 if on_tpu else 10
+    flops = RESNET50_TRAIN_FLOPS_PER_IMG if on_tpu else 1e9
+    _emit_over_batches(
+        "resnet50_imgs_per_sec_per_chip",
+        [128, 64, 32] if on_tpu else [4],
+        lambda b: _time_resnet_batch(b, steps, image_size, classes),
+        flops, "imgs/s/chip", on_tpu, peak, sweep, "resnet50",
+        f"model=ResNet50 image={image_size} steps={steps}")
 
 
 def run():
@@ -265,6 +380,15 @@ def run():
         print(f"# ernie bench failed ({type(e).__name__}: {e}); "
               "GPT line already emitted", file=sys.stderr)
         sweep["ernie"] = {"error": f"{type(e).__name__}: {e}"}
+    _dump_sweep(sweep)   # persist incrementally: a later wedge keeps these
+
+    # third metric line: ResNet50 imgs/sec/chip (BASELINE.json configs[1])
+    try:
+        _run_resnet(on_tpu, peak, sweep)
+    except Exception as e:                                 # noqa: BLE001
+        print(f"# resnet bench failed ({type(e).__name__}: {e}); "
+              "GPT/ERNIE lines already emitted", file=sys.stderr)
+        sweep["resnet50"] = {"error": f"{type(e).__name__}: {e}"}
     _dump_sweep(sweep)
 
 
@@ -308,14 +432,16 @@ def _dump_sweep(sweep):
 # parent: orchestrator — never touches the jax backend
 # --------------------------------------------------------------------------
 
-def _spawn(arg, timeout_s, capture):
-    """Run ``python -u bench.py <arg>`` with a HARD kill-timeout.
+def _spawn(arg, timeout_s, capture, script=None):
+    """Run ``python -u <script> <arg>`` with a HARD kill-timeout.
 
     SIGKILL (never SIGTERM — wedged axon clients ignore it) after
     ``timeout_s``.  Returns (rc, stdout_text or None).  With
     ``capture=False`` the child inherits our stdout so metric lines reach
     the driver even if the child later wedges and dies."""
-    cmd = [sys.executable, "-u", os.path.abspath(__file__), arg]
+    cmd = [sys.executable, "-u", script or os.path.abspath(__file__)]
+    if arg:
+        cmd.append(arg)
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE if capture else None)
     try:
@@ -363,7 +489,34 @@ def orchestrate():
         return 3
     print(f"# probe ok: {probe_info}", file=sys.stderr)
 
-    # Phase 2: the timed run, with every remaining second as its budget.
+    # Phase 2: on-chip kernel check — the gate artifact must be the same
+    # age as the bench run (VERDICT r4 item 5: a stale green or a Mosaic
+    # lowering regression must never ride along silently).  The check
+    # child overwrites tools/tpu_kernel_check.json itself; a compile
+    # failure (rc=1) still lets the timed run proceed but fails the
+    # round's exit code loudly.  A wedge here only costs its own budget.
+    kernel_rc = None
+    on_tpu = probe_info.get("platform") not in ("cpu",)
+    if on_tpu and remaining() > 600:
+        kc_script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "tools", "tpu_kernel_check.py")
+        kc_budget = min(420, remaining() - 480)
+        kernel_rc, _ = _spawn(None, kc_budget, capture=False,
+                              script=kc_script)
+        if kernel_rc is None:
+            print(f"# kernel check wedged after {kc_budget:.0f}s — "
+                  "SIGKILLed; proceeding to the timed run (gate artifact "
+                  "NOT refreshed)", file=sys.stderr)
+        elif kernel_rc != 0:
+            print("# KERNEL CHECK FAILED: a Pallas kernel no longer "
+                  "compiles/passes on-chip — bench will degrade to XLA "
+                  "paths and this run exits nonzero (see "
+                  "tools/tpu_kernel_check.json)", file=sys.stderr)
+        else:
+            print("# kernel check ok — tools/tpu_kernel_check.json "
+                  "refreshed", file=sys.stderr)
+
+    # Phase 3: the timed run, with every remaining second as its budget.
     run_budget = max(remaining() - 15, 60)
     rc, _ = _spawn("--run", run_budget, capture=False)
     if rc is None:
@@ -371,6 +524,8 @@ def orchestrate():
               "Any metric lines above were captured before the wedge.",
               file=sys.stderr)
         return 3
+    if rc == 0 and kernel_rc not in (None, 0):
+        return 4     # metrics emitted, but the kernel gate regressed
     return rc
 
 

@@ -42,10 +42,26 @@ class EOFException(Exception):
 
 
 _name_counter = itertools.count()
-# feed-var name -> weakref(PyReader): Executor feed hook resolves owners.
+# (id(program), feed-var name) -> weakref(PyReader): the Executor feed
+# hook resolves owners per program — train and eval Programs may both
+# declare a same-named fluid.data var with their own readers.
 _slot_owner: dict = {}
 
 _EOF = object()
+
+
+class _PassState:
+    """One start()..EOF/reset() pass's plumbing.  Pass-local (not reader
+    attributes) so a filler thread that outlives the join timeout can only
+    ever touch ITS OWN pass's ring/queue/flags — never the next pass's."""
+
+    __slots__ = ("ring", "queue", "stop", "error")
+
+    def __init__(self, ring, q):
+        self.ring = ring
+        self.queue = q
+        self.stop = threading.Event()
+        self.error = None
 
 
 def _per_sample_shape(shape):
@@ -87,15 +103,14 @@ class PyReader:
                 self._slots.append(t)
                 self._dtypes.append(np.dtype(_core.convert_dtype(dt)))
                 self._sample_shapes.append(_per_sample_shape(shp))
+        from ..static.graph import default_main_program
+        self._program_id = id(default_main_program())
         for t in self._slots:
-            _slot_owner[t.name] = weakref.ref(self)
+            _slot_owner[(self._program_id, t.name)] = weakref.ref(self)
 
         self._source = None          # ("sample" | "batch", callable)
         self._thread = None
-        self._ring = None
-        self._queue = None
-        self._stop = threading.Event()
-        self._error = None
+        self._pass = None            # _PassState while a pass is live
         self._started = False
 
     # -- source decoration (ref io.py: decorate_paddle_reader /
@@ -141,47 +156,47 @@ class PyReader:
         if self._started:
             raise RuntimeError(
                 f"py_reader {self.name!r} already started; reset() first")
-        self._stop.clear()
-        self._error = None
+        ring = None
         if self.use_double_buffer:
             from .. import runtime
             if runtime.is_available():
-                self._ring = runtime.DataRing(capacity=self.capacity)
-        if self._ring is None:
-            self._queue = queue.Queue(maxsize=self.capacity)
+                ring = runtime.DataRing(capacity=self.capacity)
+        q = None if ring is not None else queue.Queue(maxsize=self.capacity)
+        st = _PassState(ring, q)
         mode, src = self._source
         self._thread = threading.Thread(
-            target=self._fill, args=(mode, src), daemon=True,
+            target=self._fill, args=(mode, src, st), daemon=True,
             name=f"{self.name}_prefetch")
+        self._pass = st
         self._started = True
         self._thread.start()
 
-    def _fill(self, mode, src):
+    def _fill(self, mode, src, st):
         try:
             for tag, item in enumerate(src()):
-                if self._stop.is_set():
+                if st.stop.is_set():
                     return
                 batch = self._assemble(item, mode)
-                if self._ring is not None:
+                if st.ring is not None:
                     # blocks while full (backpressure); CLOSED on reset
-                    if self._ring.push(batch, tag) != 0:
+                    if st.ring.push(batch, tag) != 0:
                         return
                 else:
-                    while not self._stop.is_set():
+                    while not st.stop.is_set():
                         try:
-                            self._queue.put(batch, timeout=0.1)
+                            st.queue.put(batch, timeout=0.1)
                             break
                         except queue.Full:
                             continue
         except Exception as e:  # surfaced on the consumer side
-            self._error = e
+            st.error = e
         finally:
-            if self._ring is not None:
-                self._ring.close()
-            elif self._queue is not None:
-                while not self._stop.is_set():
+            if st.ring is not None:
+                st.ring.close()
+            else:
+                while not st.stop.is_set():
                     try:
-                        self._queue.put(_EOF, timeout=0.1)
+                        st.queue.put(_EOF, timeout=0.1)
                         break
                     except queue.Full:
                         continue
@@ -189,64 +204,73 @@ class PyReader:
     def _next_batch(self):
         """Next staged batch as numpy arrays; EOFException when the pass
         is done (or the reader was never started)."""
-        if not self._started:
+        st = self._pass
+        if not self._started or st is None:
             raise EOFException(
                 f"py_reader {self.name!r} not started (or already "
                 "exhausted); call start()")
-        if self._error is not None:
-            err, self._error = self._error, None
-            self._finish()
-            raise err
-        if self._ring is not None:
-            got = self._ring.pop()        # None == closed + drained
+        if st.error is not None:
+            self._raise_error_or_eof(st)
+        if st.ring is not None:
+            got = st.ring.pop()           # None == closed + drained
             if got is None:
                 # the filler closes the ring on error too — a consumer
                 # already blocked in pop() sees the close before it could
-                # see self._error, so re-check before declaring a clean EOF
-                self._raise_error_or_eof()
+                # see st.error, so re-check before declaring a clean EOF
+                self._raise_error_or_eof(st)
             views, _tag = got
             # views alias ring memory recycled on the NEXT pop — copy out
             return [np.array(v) for v in views]
-        item = self._queue.get()
+        item = st.queue.get()
         if item is _EOF:
-            self._raise_error_or_eof()
+            self._raise_error_or_eof(st)
         return item
 
-    def _raise_error_or_eof(self):
+    def _raise_error_or_eof(self, st):
         self._finish()
-        if self._error is not None:
-            err, self._error = self._error, None
+        if st.error is not None:
+            err, st.error = st.error, None
             raise err
         raise EOFException(f"py_reader {self.name!r} pass finished")
 
     def _finish(self):
         self._started = False
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
-        if self._ring is not None:
-            self._ring.destroy()
-            self._ring = None
-        self._queue = None
+        st, self._pass = self._pass, None
+        th, self._thread = self._thread, None
+        if st is None:
+            return
+        if st.ring is not None:
+            st.ring.close()               # wakes a blocked push -> CLOSED
+        else:
+            st.stop.set()                 # unblocks queue puts
+        if th is not None:
+            th.join(timeout=5)
+            if not th.is_alive() and st.ring is not None:
+                st.ring.destroy()
+            # a straggler thread still holds st: its ring is closed (every
+            # push returns CLOSED) and freed by GC when the thread exits —
+            # it can never touch a later pass's plumbing
 
     def reset(self):
         """End the pass: stop the prefetch thread and drop staged batches.
         start() begins a fresh pass (the source callable is re-invoked)."""
-        self._stop.set()
-        if self._ring is not None:
-            self._ring.close()
-            # drain so a push blocked on a full ring unblocks
-            try:
-                while self._ring.pop(timeout_ms=100) is not None:
+        st = self._pass
+        if st is not None:
+            st.stop.set()
+            if st.ring is not None:
+                st.ring.close()
+                # drain so a push blocked on a full ring unblocks
+                try:
+                    while st.ring.pop(timeout_ms=100) is not None:
+                        pass
+                except Exception:
                     pass
-            except Exception:
-                pass
-        elif self._queue is not None:
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
+            else:
+                try:
+                    while True:
+                        st.queue.get_nowait()
+                except queue.Empty:
+                    pass
         self._finish()
 
     shutdown = reset
@@ -297,17 +321,25 @@ def _install_feed_hook():
 
 
 def fill_feed_from_readers(program, feed):
-    """Executor feed hook: any feed placeholder registered to a started
-    PyReader and absent from `feed` pulls the next staged batch (one batch
-    per reader per run)."""
+    """Executor feed hook: any feed placeholder registered to THIS
+    program's PyReader and absent from `feed` pulls the next staged batch
+    (one batch per reader per run).  A reader-owned slot with no started
+    reader is an error — silently replaying the build-time zero
+    placeholder would train on garbage."""
     pending = {}
     for fname in program.feed_ids:
         if fname in feed:
             continue
-        ref = _slot_owner.get(fname)
+        ref = _slot_owner.get((id(program), fname))
         rd = ref() if ref is not None else None
-        if rd is not None and rd._started:
-            pending.setdefault(id(rd), rd)
+        if rd is None:
+            continue
+        if not rd._started:
+            raise RuntimeError(
+                f"py_reader {rd.name!r} owns feed var {fname!r} but is "
+                "not started — call reader.start() before Executor.run "
+                "(or feed all of its slots explicitly)")
+        pending.setdefault(id(rd), rd)
     if not pending:
         return feed
     feed = dict(feed)

@@ -159,6 +159,12 @@ def _sample_one_image(im_scale, gt_classes, is_crowd, gt_segms, rois,
                 if gt_classes[i] > 0 and is_crowd[i] == 0]
     fg_inds = np.flatnonzero(labels > 0)
 
+    if rois.shape[0] == 0:
+        # zero proposals for this image: emit zero rows consistently
+        # (the reference's bg fallback would desync rois vs masks here)
+        return (np.zeros((0, 4), np.float32), np.zeros((0, 1), np.int32),
+                np.zeros((0, num_classes * M * M), np.int32))
+
     if fg_inds.size > 0 and gt_polys:
         poly_boxes = np.stack([_poly_bbox(p) for p in gt_polys])
         rois_fg = rois[fg_inds] / im_scale

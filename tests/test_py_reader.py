@@ -192,6 +192,58 @@ class TestPyReader:
         finally:
             paddle.disable_static()
 
+    def test_unstarted_reader_slot_raises_not_silent_zeros(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=2, shapes=[(-1, 2)], dtypes=["float32"],
+                    use_double_buffer=False)
+                x = fluid.layers.read_file(rd)
+                y = fluid.layers.reduce_sum(x)
+                rd.decorate_batch_generator(
+                    lambda: iter([(np.ones((1, 2), "float32"),)]))
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                with pytest.raises(RuntimeError, match="not started"):
+                    exe.run(main, fetch_list=[y])   # forgot rd.start()
+        finally:
+            paddle.disable_static()
+
+    def test_ownership_scoped_per_program(self):
+        # train and eval programs each declare fluid.data('shared_img')
+        # with their own reader — the hook must resolve per program
+        paddle.enable_static()
+        try:
+            readers, progs, losses = [], [], []
+            for fill in (1.0, 2.0):
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    v = fluid.layers.data("shared_img", [2],
+                                          dtype="float32")
+                    rd = fluid.layers.create_py_reader_by_data(
+                        capacity=2, feed_list=[v],
+                        use_double_buffer=False)
+                    rd.decorate_batch_generator(
+                        lambda fill=fill: iter(
+                            [(np.full((1, 2), fill, "float32"),)]))
+                    losses.append(fluid.layers.reduce_sum(v))
+                    progs.append(main)
+                    readers.append(rd)
+                    fluid.Executor(fluid.CPUPlace()).run(startup)
+            exe = fluid.Executor(fluid.CPUPlace())
+            readers[0].start()
+            readers[1].start()
+            v0, = exe.run(progs[0], fetch_list=[losses[0]])
+            v1, = exe.run(progs[1], fetch_list=[losses[1]])
+            assert float(v0) == 2.0     # batch of 1.0s from reader 0
+            assert float(v1) == 4.0     # batch of 2.0s from reader 1
+            readers[0].reset()
+            readers[1].reset()
+        finally:
+            paddle.disable_static()
+
     def test_partial_manual_feed_rejected(self):
         paddle.enable_static()
         try:

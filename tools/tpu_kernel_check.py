@@ -115,6 +115,13 @@ def check_flash_bench_shape(results):
     entry["best_fwd_ms"] = best
     entry["best_fwd_blocks"] = best_cfg
 
+    # Install the winning forward tiling BEFORE sweeping the backward:
+    # bench.py installs best_fwd_blocks AND best_bwd_blocks together, so
+    # the pair the gate approves must be the pair that was measured
+    # (the probe's forward runs on the module defaults).
+    if best_cfg is not None:
+        fa.set_default_blocks(fwd=best_cfg)
+
     # backward sweep (full custom-vjp path vs XLA autodiff of the dense ref)
     def make_grad(f):
         return jax.jit(jax.grad(lambda q: jnp.sum(
