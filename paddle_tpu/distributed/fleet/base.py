@@ -30,7 +30,10 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.dgc = False
+        self.dgc_configs = {}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.fp16_allreduce = False
         self.fuse_all_reduce_ops = True
         self.nccl_comm_num = 1
         self.find_unused_parameters = False
@@ -158,13 +161,33 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         optimizer._is_fleet_distributed = True
         strategy = strategy or self._strategy
-        if strategy is not None and getattr(strategy, "gradient_merge",
-                                            False):
+        if strategy is None:
+            return optimizer
+        # GPU-interconnect compression tricks have no TPU counterpart —
+        # grads ride ICI psum at full rate and XLA already fuses the
+        # collectives.  Warn (never silently ignore) so a user porting a
+        # dgc/fp16_allreduce config knows the flag does nothing here
+        # (MIGRATING.md "deviations" table).
+        import warnings
+        for flag in ("dgc", "fp16_allreduce"):
+            if getattr(strategy, flag, False):
+                warnings.warn(
+                    f"DistributedStrategy.{flag} is N/A on TPU (gradient "
+                    "compression targets slow GPU interconnects; ICI "
+                    "psum is already cheap and bf16) — proceeding with "
+                    "plain collectives", UserWarning, stacklevel=2)
+        if getattr(strategy, "gradient_merge", False):
             from ...optimizer.gradient_merge import GradientMergeOptimizer
             cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
             optimizer = GradientMergeOptimizer(
                 optimizer, k_steps=cfg.get("k_steps", 1),
                 avg=cfg.get("avg", True))
+        if getattr(strategy, "localsgd", False):
+            from ...parallel.localsgd import LocalSGDOptimizer
+            cfg = getattr(strategy, "localsgd_configs", {}) or {}
+            optimizer = LocalSGDOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                begin_step=cfg.get("begin_step", 1))
         return optimizer
 
     def state_dict(self):

@@ -1,0 +1,415 @@
+"""fluid.contrib.decoder — the contrib-era seq2seq decoder API.
+
+ref: python/paddle/fluid/contrib/decoder/beam_search_decoder.py
+(InitState :43, StateCell :159, TrainingDecoder :384,
+BeamSearchDecoder :525).
+
+The reference builds these on DynamicRNN LoD stepping and a While loop
+over LoDTensorArrays.  The TPU-native forms ride this package's
+record-replay composites instead:
+
+- ``TrainingDecoder`` lowers onto the block-style :class:`DynamicRNN`
+  (one ``lax.scan`` composite; batch-major padded sequences + lengths
+  instead of LoD).
+- ``BeamSearchDecoder`` records its block once and compiles the whole
+  decode loop into ONE ``lax.scan`` composite with fixed [batch*beam]
+  rows: arrays become scan carries, per-step selections are stacked and
+  back-traced by :func:`fluid.layers.beam_search_decode`'s gather-tree.
+  Deviations from the reference, forced by static shapes: the loop always
+  runs ``max_len`` steps with finished beams masked (``early_stop`` is a
+  recorded no-op — the reference breaks the While early), and every
+  carried state/array is re-gathered along the step's parent indices
+  (the reference got the same effect implicitly via sequence_expand on
+  LoD).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..static import graph as G
+from ..static.control_flow import (_split_externals, _in_spec,
+                                   _args_treedef, _mark_live)
+from .control_blocks import (_slice_program, _slice_reads, DynamicRNN,
+                             _require_static)
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """Initial decoder state (ref beam_search_decoder.py:43): either a
+    concrete ``init`` tensor (e.g. the encoder's last hidden) or a
+    ``shape``+``value`` fill, where ``shape`` INCLUDES the batch dim and
+    its shape[0] (usually -1) is replaced by ``init_boot``'s batch size —
+    the reference lowers exactly this way via
+    fill_constant_batch_size_like."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the init batch size")
+        else:
+            from .layers import fill_constant_batch_size_like
+            self._init = fill_constant_batch_size_like(
+                input=init_boot, shape=list(shape), dtype=dtype,
+                value=value)
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """State container + per-step updater (ref :159).
+
+    ``inputs``: dict name -> build-time placeholder (or None; the decoder
+    feeds it per step).  ``states``: dict name -> InitState.  The
+    ``@state_cell.state_updater`` function reads ``get_input``/
+    ``get_state`` and writes ``set_state``; ``out_state`` names the state
+    exposed as the step output.
+    """
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._state_names = list(states)
+        self._out_state_name = out_state
+        self._updater = None
+        self._cur_states = {}
+        self._cur_inputs = dict(inputs)
+
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    def get_state(self, name):
+        if name not in self._cur_states:
+            raise ValueError(f"state {name!r} not set; decode/training "
+                             "block not entered")
+        return self._cur_states[name]
+
+    def get_input(self, name):
+        v = self._cur_inputs.get(name)
+        if v is None:
+            raise ValueError(f"input {name!r} has not been provided")
+        return v
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def compute_state(self, inputs):
+        if self._updater is None:
+            raise RuntimeError("no @state_updater registered")
+        self._cur_inputs.update(inputs)
+        self._updater(self)
+
+    def update_states(self):
+        """The reference flushes ArrayState writes here; in the composite
+        form the enclosing decoder reads ``_cur_states`` at block exit, so
+        this is a recorded no-op kept for script parity."""
+
+    def out_state(self):
+        return self.get_state(self._out_state_name)
+
+
+class TrainingDecoder:
+    """Teacher-forced decoding over :class:`DynamicRNN` (ref :384).
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            w = decoder.step_input(tgt_emb)       # [B, T, D] (+lengths)
+            cell.compute_state({'x': w})
+            cell.update_states()
+            decoder.output(cell.out_state())
+        out = decoder()                            # [B, T, H]
+    """
+
+    def __init__(self, state_cell, name=None):
+        _require_static("TrainingDecoder")
+        self._cell = state_cell
+        self._rnn = DynamicRNN(name)
+        self._slots = {}
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.block():
+            for name in self._cell._state_names:
+                init = self._cell._init_states[name].value
+                slot = self._rnn.memory(init=init)
+                self._slots[name] = slot
+                self._cell._cur_states[name] = slot
+            yield self
+            for name, slot in self._slots.items():
+                self._rnn.update_memory(slot, self._cell._cur_states[name])
+
+    def step_input(self, x, lengths=None):
+        return self._rnn.step_input(x, lengths)
+
+    def static_input(self, x):
+        """Non-stepped input: the composite captures it whole (the padded
+        form needs no sequence_expand)."""
+        return x
+
+    def output(self, *outputs):
+        for o in outputs:
+            self._rnn.output(o)
+
+    def __call__(self):
+        return self._rnn()
+
+    @property
+    def state_cell(self):
+        return self._cell
+
+
+class BeamSearchDecoder:
+    """Inference beam search compiled to one lax.scan composite (ref :525).
+
+    Documented usage runs verbatim::
+
+        decoder = BeamSearchDecoder(state_cell, init_ids, init_scores,
+                                    target_dict_dim, word_dim,
+                                    beam_size=K, end_id=1, max_len=T)
+        decoder.decode()
+        translation_ids, translation_scores = decoder()
+
+    Rows are the flattened [batch*beam] beams (pass init_scores of -1e9
+    for beams 1..K-1 to emulate the reference's first-step single-beam
+    LoD).  ``decoder()`` returns ([B, K, T] ids, [B, K, T] scores) from
+    the gather-tree backtrace.
+
+    Custom blocks are supported with one addition to the reference
+    contract: call ``layers.beam_search(..., return_parent_idx=True)``
+    and hand the parent rows to ``decoder.set_parents(parents)`` — the
+    padded form threads beam ancestry explicitly where the reference
+    recovered it from LoD.
+    """
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        _require_static("BeamSearchDecoder")
+        self._cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = int(topk_size)
+        self._sparse_emb = sparse_emb
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+
+        self._prog = G.default_main_program()
+        self._carries = []      # (slot, init_tensor, reorder: bool)
+        self._updates = {}      # id(slot) -> new tensor
+        self._ids_slot = None
+        self._scores_slot = None
+        self._parents = None
+        self._in_block = False
+        self._done = False
+        self._result = None
+
+    # -- block recording --------------------------------------------------
+    @contextlib.contextmanager
+    def block(self):
+        if self._done or self._in_block:
+            raise ValueError("block() can only be entered once")
+        self._in_block = True
+        start = len(self._prog.ops)
+        # states enter as carries initialized from their InitState
+        self._state_slots = {}
+        for name in self._cell._state_names:
+            init = self._cell._init_states[name].value
+            slot = Tensor(init.value)
+            self._carries.append((slot, init, True))
+            self._state_slots[name] = slot
+            self._cell._cur_states[name] = slot
+        try:
+            yield self
+        finally:
+            self._in_block = False
+        sub = _slice_program(self._prog, start)
+        self._finalize(sub)
+        self._done = True
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        if not self._in_block:
+            raise ValueError("read_array must be called inside block()")
+        if is_ids and is_scores:
+            raise ValueError("an array cannot be both ids and scores")
+        slot = Tensor(init.value)
+        # ids/scores come out of beam_search already in selected-beam
+        # order; every other array follows its beam via parent gather
+        self._carries.append((slot, init, not (is_ids or is_scores)))
+        if is_ids:
+            self._ids_slot = slot
+        if is_scores:
+            self._scores_slot = slot
+        return slot
+
+    def update_array(self, array, value):
+        if not self._in_block:
+            raise ValueError("update_array must be called inside block()")
+        if not any(array is s for s, _, _ in self._carries):
+            raise ValueError("update_array target must come from "
+                             "read_array")
+        self._updates[id(array)] = value
+
+    def set_parents(self, parents):
+        """Register this step's parent rows ([batch*beam] int32 from
+        ``beam_search(..., return_parent_idx=True)``) — the padded form's
+        replacement for LoD ancestry."""
+        self._parents = parents
+
+    def early_stop(self):
+        """Recorded no-op: the fixed-shape loop always runs max_len steps;
+        finished beams are masked by beam_search's end_id handling (the
+        extra steps are dead lanes XLA runs for free)."""
+
+    @property
+    def state_cell(self):
+        if not self._in_block:
+            raise ValueError("state_cell is only visible inside block()")
+        return self._cell
+
+    # -- the default decode program (ref :655) ----------------------------
+    def decode(self):
+        from . import layers
+
+        with self.block():
+            prev_ids = self.read_array(init=self._init_ids, is_ids=True)
+            prev_scores = self.read_array(init=self._init_scores,
+                                          is_scores=True)
+            emb = layers.embedding(
+                prev_ids,
+                size=[self._target_dict_dim, self._word_dim],
+                is_sparse=self._sparse_emb)
+            emb = layers.reshape(emb, [-1, self._word_dim])
+
+            feed_dict = {}
+            update_dict = {}
+            for name, var in self._input_var_dict.items():
+                if name not in self._cell._inputs:
+                    raise ValueError(f"Variable {name} not found in "
+                                     "StateCell")
+                read_var = self.read_array(init=var)
+                update_dict[name] = read_var
+                feed_dict[name] = read_var
+            for name in self._cell._inputs:
+                if name not in feed_dict:
+                    feed_dict[name] = emb
+
+            self._cell.compute_state(inputs=feed_dict)
+            current_state = self._cell.out_state()
+            scores = layers.fc(current_state, self._target_dict_dim,
+                               activation="softmax")
+            topk_scores, topk_indices = layers.topk(scores,
+                                                    self._topk_size)
+            accu_scores = layers.elementwise_add(
+                layers.log(topk_scores),
+                layers.reshape(prev_scores, [-1]), axis=0)
+            sel_ids, sel_scores, parents = layers.beam_search(
+                prev_ids, prev_scores, topk_indices, accu_scores,
+                self._beam_size, end_id=self._end_id,
+                return_parent_idx=True)
+            self._cell.update_states()
+            self.update_array(prev_ids, sel_ids)
+            self.update_array(prev_scores, sel_scores)
+            for name, var in update_dict.items():
+                self.update_array(var, feed_dict[name])
+            self.set_parents(parents)
+
+    # -- composite construction -------------------------------------------
+    def _finalize(self, sub):
+        if self._ids_slot is None or self._scores_slot is None:
+            raise ValueError("decode block must read_array an ids array "
+                             "and a scores array")
+        if self._parents is None:
+            raise ValueError(
+                "the padded beam decoder needs parent rows: use "
+                "beam_search(..., return_parent_idx=True) and call "
+                "decoder.set_parents(parents) in the block")
+        prog = self._prog
+        # a state slot's new value is whatever the cell holds for that
+        # state at block exit (set via set_state in the updater); an array
+        # slot's comes from update_array; an untouched carry keeps itself
+        state_of_slot = {id(s): n for n, s in self._state_slots.items()}
+        carry_vids = [G._ensure_var_id(s, sub) for s, _, _ in self._carries]
+        upd_vids = []
+        for slot, _, _ in self._carries:
+            new = self._updates.get(id(slot))
+            if new is None:
+                name = state_of_slot.get(id(slot))
+                new = self._cell._cur_states[name] if name else slot
+            upd_vids.append(G._ensure_var_id(new, sub))
+        parent_vid = G._ensure_var_id(self._parents, sub)
+        ids_vid = G._ensure_var_id(
+            self._updates[id(self._ids_slot)], sub)
+        scores_vid = G._ensure_var_id(
+            self._updates[id(self._scores_slot)], sub)
+
+        ext, _ = _slice_reads(sub, exclude=set(carry_vids))
+        live, const_env = _split_externals(ext)
+        reorder_flags = [r for _, _, r in self._carries]
+        T = self._max_len
+        K = self._beam_size
+        end_id = self._end_id
+
+        def composite(*vals):
+            inits = vals[:len(carry_vids)]
+            ext_vals = vals[len(carry_vids):]
+
+            def body(carry, _):
+                env = dict(zip(carry_vids, carry))
+                env.update(dict(zip(live, ext_vals)))
+                env.update(const_env)
+                sub.replay(env)
+                parents = env[parent_vid]
+                new_carry = []
+                for vid, reorder in zip(upd_vids, reorder_flags):
+                    v = env[vid]
+                    if reorder:
+                        v = jnp.take(v, parents, axis=0)
+                    new_carry.append(v)
+                return (tuple(new_carry),
+                        (env[ids_vid], env[scores_vid], parents))
+
+            _, (ids_t, scores_t, parents_t) = jax.lax.scan(
+                body, tuple(inits), None, length=T)
+            return ids_t, scores_t, parents_t
+
+        in_specs = [_in_spec(i, prog) for _, i, _ in self._carries]
+        in_specs += [("var", v) for v in live]
+        BK = self._init_ids.shape[0]
+        ids_res = Tensor(jnp.zeros((T, BK, 1), jnp.int32))
+        scores_res = Tensor(jnp.zeros((T, BK, 1), jnp.float32))
+        parents_res = Tensor(jnp.zeros((T, BK), jnp.int32))
+        out_ids = [G._ensure_var_id(r, prog)
+                   for r in (ids_res, scores_res, parents_res)]
+        prog.record(composite, _args_treedef(len(in_specs)), in_specs,
+                    out_ids, "contrib_beam_search")
+        _mark_live(out_ids)
+        self._step_outputs = (ids_res, scores_res, parents_res)
+
+    def __call__(self):
+        if not self._done:
+            raise ValueError("call decode() (or record a block) first")
+        from .rnn_ops import beam_search_decode
+        ids_t, scores_t, parents_t = self._step_outputs
+        return beam_search_decode(ids_t, scores_t, self._beam_size,
+                                  self._end_id, parents=parents_t)

@@ -8,3 +8,5 @@ from .zero import (make_zero_train_step, init_zero_state, gather_params,
                    state_bytes_per_device)
 from . import moe
 from .moe import moe_ffn, init_moe_params
+from . import localsgd
+from .localsgd import localsgd_param_sync, LocalSGDOptimizer

@@ -1,0 +1,122 @@
+"""LocalSGD: periodic param averaging over the dp axis (VERDICT r4 item 7;
+ref fleet/meta_optimizers/localsgd_optimizer.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.parallel.localsgd import (localsgd_param_sync,
+                                          LocalSGDOptimizer)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+
+class TestSPMDParamSync:
+    def test_ranks_diverge_then_equalize_on_boundary(self):
+        """Per-rank params drift for k-1 local steps, snap to the global
+        mean exactly on each k-step boundary — the whole loop jitted."""
+        mesh = _mesh()
+        k = 3
+
+        # per-rank param copy [dp, 2]; per-rank grads differ by rank
+        w0 = jnp.zeros((8, 2), jnp.float32)
+
+        @jax.jit
+        def run_step(w, step):
+            def body(w):
+                rank = jax.lax.axis_index("dp").astype(jnp.float32)
+                g = jnp.stack([rank + 1.0, -(rank + 1.0)])  # rank-specific
+                w = w - 0.1 * g[None, :]                    # local SGD
+                w = localsgd_param_sync(w, step, k_steps=k, begin_step=k)
+                return w
+            return shard_map(body, mesh=mesh, in_specs=P("dp"),
+                             out_specs=P("dp"))(w)
+
+        w = w0
+        for step in range(1, 8):
+            w = run_step(w, jnp.int32(step))
+            host = np.asarray(w)
+            spread = np.abs(host - host.mean(0, keepdims=True)).max()
+            if step % k == 0:
+                assert spread < 1e-6, f"step {step}: not averaged"
+            else:
+                assert spread > 1e-3, f"step {step}: averaged too early"
+
+    def test_average_value_is_global_mean(self):
+        mesh = _mesh()
+        w = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+
+        def body(w):
+            return localsgd_param_sync(w, jnp.int32(4), k_steps=2,
+                                       begin_step=2)
+        out = shard_map(body, mesh=mesh, in_specs=P("dp"),
+                        out_specs=P("dp"))(w)
+        np.testing.assert_allclose(np.asarray(out), 3.5)
+
+
+class TestFleetWrapper:
+    def test_wrapper_steps_and_converges(self):
+        import paddle_tpu as paddle
+
+        w = paddle.to_tensor(np.array([4.0], "float32"),
+                             stop_gradient=False)
+        inner = paddle.optimizer.SGD(learning_rate=0.3, parameters=[w])
+        opt = LocalSGDOptimizer(inner, k_steps=2)
+        for _ in range(20):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(w.numpy())) < 1e-2
+
+    def test_static_minimize_warns_not_silent(self):
+        import warnings
+        import paddle_tpu as paddle
+        from paddle_tpu import fluid
+
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("ls_x", [2], dtype="float32")
+                loss = fluid.layers.reduce_mean(fluid.layers.fc(x, 1))
+                inner = paddle.optimizer.SGD(learning_rate=0.1)
+                opt = LocalSGDOptimizer(inner, k_steps=2)
+                with warnings.catch_warnings(record=True) as rec:
+                    warnings.simplefilter("always")
+                    opt.minimize(loss)
+                assert any("localsgd_param_sync" in str(r.message)
+                           for r in rec)
+        finally:
+            paddle.disable_static()
+
+    def test_fleet_strategy_wires_localsgd_and_warns_na_flags(self):
+        import warnings
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import fleet
+
+        strat = fleet.DistributedStrategy()
+        strat.localsgd = True
+        strat.localsgd_configs = {"k_steps": 4, "begin_step": 2}
+        strat.dgc = True
+        strat.fp16_allreduce = True
+
+        w = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+        inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        f = fleet.fleet
+        f._strategy = strat       # bypass init (no mesh needed here)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            opt = f.distributed_optimizer(inner)
+        msgs = "".join(str(r.message) for r in rec)
+        assert "dgc" in msgs and "fp16_allreduce" in msgs
+        assert isinstance(opt, LocalSGDOptimizer)
+        assert opt._k == 4 and opt._begin == 2
