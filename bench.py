@@ -405,11 +405,12 @@ def _flash_wins_per_kernel_check():
         rec = data["flash_attn_bench_shape"]
         if not rec["pallas_beats_xla"]:
             return False
-        # install the sweep-winning tilings so the executed configuration
-        # is exactly the one the gate approved
+        # install the sweep-winning tilings AND backward strategy so the
+        # executed configuration is exactly the one the gate approved
         from paddle_tpu.ops.pallas import flash_attn as fa
         fa.set_default_blocks(fwd=rec.get("best_fwd_blocks"),
-                              bwd=rec.get("best_bwd_blocks"))
+                              bwd=rec.get("best_bwd_blocks"),
+                              bwd_fused=rec.get("best_bwd_fused", False))
         return True
     except Exception:                                      # noqa: BLE001
         return False

@@ -176,18 +176,22 @@ class Fleet:
                     "compression targets slow GPU interconnects; ICI "
                     "psum is already cheap and bf16) — proceeding with "
                     "plain collectives", UserWarning, stacklevel=2)
-        if getattr(strategy, "gradient_merge", False):
-            from ...optimizer.gradient_merge import GradientMergeOptimizer
-            cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
-            optimizer = GradientMergeOptimizer(
-                optimizer, k_steps=cfg.get("k_steps", 1),
-                avg=cfg.get("avg", True))
+        # wrap order matters when both are set: gradient merge OUTSIDE
+        # localsgd, so LocalSGD.step() fires only on real optimizer
+        # updates (merge boundaries) and its k_steps counts parameter
+        # updates, not micro-batches
         if getattr(strategy, "localsgd", False):
             from ...parallel.localsgd import LocalSGDOptimizer
             cfg = getattr(strategy, "localsgd_configs", {}) or {}
             optimizer = LocalSGDOptimizer(
                 optimizer, k_steps=cfg.get("k_steps", 1),
                 begin_step=cfg.get("begin_step", 1))
+        if getattr(strategy, "gradient_merge", False):
+            from ...optimizer.gradient_merge import GradientMergeOptimizer
+            cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                avg=cfg.get("avg", True))
         return optimizer
 
     def state_dict(self):

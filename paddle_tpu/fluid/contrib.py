@@ -193,10 +193,21 @@ def extend_with_decoupled_weight_decay(base_optimizer):
         # once; static programs register this optimizer as train_spec and
         # the Executor drives apply_updates_pytree below
 
+        def minimize(self, loss, **kwargs):
+            from ..static.graph import in_static_mode
+            if (in_static_mode() and self._wd_coeff
+                    and self._wd_filter is not None):
+                import warnings
+                warnings.warn(
+                    "extend_with_decoupled_weight_decay: "
+                    "apply_decay_param_fun is ignored on the static "
+                    "Executor path (the jitted update sees raw values, "
+                    "not named Parameters) — every parameter is decayed",
+                    UserWarning, stacklevel=2)
+            return super().minimize(loss, **kwargs)
+
         def apply_updates_pytree(self, param_vals, grads, states, lr, t):
             # static-Executor path: decay folded into the jitted update
-            # (apply_decay_param_fun is a dygraph-only refinement here —
-            # the jitted step sees raw values, not named Parameters)
             if self._wd_coeff:
                 c = self._wd_coeff
                 param_vals = [v - v * c for v in param_vals]

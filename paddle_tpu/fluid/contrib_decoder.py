@@ -49,7 +49,12 @@ class InitState:
     fill_constant_batch_size_like."""
 
     def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
-                 need_reorder=False, dtype="float32"):
+                 need_reorder=True, dtype="float32"):
+        # need_reorder deviation: the reference defaults False because
+        # beam reordering happened implicitly via sequence_expand in
+        # decode(); here the flag DIRECTLY controls the per-step parent
+        # gather of this state, and following the selected beams is the
+        # correct default — pass False to opt a state out.
         if init is not None:
             self._init = init
         elif init_boot is None:
@@ -234,9 +239,10 @@ class BeamSearchDecoder:
         # states enter as carries initialized from their InitState
         self._state_slots = {}
         for name in self._cell._state_names:
-            init = self._cell._init_states[name].value
+            st = self._cell._init_states[name]
+            init = st.value
             slot = Tensor(init.value)
-            self._carries.append((slot, init, True))
+            self._carries.append((slot, init, st.need_reorder))
             self._state_slots[name] = slot
             self._cell._cur_states[name] = slot
         try:
@@ -358,6 +364,13 @@ class BeamSearchDecoder:
                 new = self._cell._cur_states[name] if name else slot
             upd_vids.append(G._ensure_var_id(new, sub))
         parent_vid = G._ensure_var_id(self._parents, sub)
+        for slot, what in ((self._ids_slot, "ids"),
+                           (self._scores_slot, "scores")):
+            if id(slot) not in self._updates:
+                raise ValueError(
+                    f"the {what} array was read (read_array) but never "
+                    "updated — call update_array(prev_"
+                    f"{what}, selected_{what}) inside the block")
         ids_vid = G._ensure_var_id(
             self._updates[id(self._ids_slot)], sub)
         scores_vid = G._ensure_var_id(

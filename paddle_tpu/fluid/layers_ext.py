@@ -508,7 +508,15 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
     """ref roi_align_op (Mask R-CNN): average of bilinear samples per bin.
     input [N, C, H, W]; rois [R, 4] xyxy in input-image coords (all rois
     on batch image 0 unless rois_num maps them); returns
-    [R, C, ph, pw]."""
+    [R, C, ph, pw].
+
+    Fixed-shape deviation (like the other ops in this module): with
+    ``sampling_ratio=-1`` the reference samples ceil(roi_size /
+    pooled_size) points per bin PER ROI — a data-dependent count XLA
+    cannot tile — so the padded form uses a fixed 2x2 lattice (Detectron2
+    default).  Outputs diverge from the reference for RoIs much larger
+    than the output grid; pass an explicit sampling_ratio to pin the
+    lattice on both sides."""
     nsr = sampling_ratio if sampling_ratio > 0 else 2
 
     def _ra(x, r, *rest):

@@ -496,11 +496,19 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
                 # would freeze the mask across every training step (the
                 # jitted fn sees the same trace-time key); folding in a
                 # hash of the activations varies it per call like the
-                # reference's stateful cudnn dropout RNG
+                # reference's stateful cudnn dropout RNG.  The statistic
+                # is modulo-folded and nan/inf-guarded BEFORE the int32
+                # cast — large activations must perturb the key, never
+                # hit the undefined inf->int cast (advisor r4); the
+                # residual data-correlation of the mask is the accepted
+                # trade for stateless-PRNG jit friendliness
                 key = jax.random.fold_in(
                     jax.random.PRNGKey(seed if seed >= 0 else 7), layer)
+                stat = jnp.nan_to_num(
+                    jnp.abs(jnp.sum(out * 1e3)) % 8191.0,
+                    nan=0.0, posinf=0.0, neginf=0.0)
                 key = jax.random.fold_in(
-                    key, (jnp.sum(out * 1e3).astype(jnp.int32) & 0x7fff))
+                    key, stat.astype(jnp.int32) & 0x7fff)
                 keep = 1.0 - dropout_prob
                 m = jax.random.bernoulli(key, keep, out.shape)
                 out = jnp.where(m, out / keep, 0.0)

@@ -150,6 +150,16 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
         out, states, next_inputs, finished = decoder.step(t, inputs, states,
                                                           **kwargs)
         outputs_list.append(out)
+        if decoder.tracks_own_finished and getattr(decoder, "_parents",
+                                                   None):
+            # beam slots were reordered by ancestry this step: slot j now
+            # descends from old slot parents[j], so its running length
+            # (and pre-step finished flag) must follow the reorder
+            # (advisor r4: lengths previously tracked the slot position,
+            # not the hypothesis)
+            par = np.asarray(decoder._parents[-1].numpy()).astype(np.int64)
+            lengths_np = np.take_along_axis(lengths_np, par, axis=-1)
+            fin_np = np.take_along_axis(fin_np, par, axis=-1)
         lengths_np = lengths_np + (~fin_np).astype(np.int64)
         new_fin = np.asarray(finished.numpy()).astype(bool)
         # sticky finished (ref rnn.py:1509): once a row ends it stays

@@ -32,15 +32,28 @@ NEG_INF = -1e30
 # ever approves the configuration that actually executes.
 _FWD_BLOCKS = (512, 1024)
 _BWD_BLOCKS = (512, 512)
+# Backward strategy: False = split dq / dkv kernels (each recomputes the
+# probability block); True = one fused kernel that recomputes p/ds ONCE,
+# accumulates dk/dv in scratch and emits per-K-block dq partials reduced
+# by XLA (trades ~2/7 of the backward matmul FLOPs for one f32 partial
+# write per K block).  The on-chip sweep decides which wins.
+_BWD_FUSED = False
+# Fused-mode HBM guard: the dq-partials buffer is O(N^2 * D / block_k);
+# past this cap the backward silently uses the split kernels instead
+# (2 GiB leaves the 1.3B-flagship working set comfortable on a 16 GB v5e).
+_FUSED_DQP_BYTES_CAP = 2 << 30
 
 
-def set_default_blocks(fwd=None, bwd=None):
-    """Install (block_q, block_k) tilings for the fwd/bwd kernels."""
-    global _FWD_BLOCKS, _BWD_BLOCKS
+def set_default_blocks(fwd=None, bwd=None, bwd_fused=None):
+    """Install (block_q, block_k) tilings — and the backward strategy —
+    for the fwd/bwd kernels."""
+    global _FWD_BLOCKS, _BWD_BLOCKS, _BWD_FUSED
     if fwd is not None:
         _FWD_BLOCKS = tuple(fwd)
     if bwd is not None:
         _BWD_BLOCKS = tuple(bwd)
+    if bwd_fused is not None:
+        _BWD_FUSED = bool(bwd_fused)
 
 
 def _valid_mask(qi, ki, shape, causal, mask_tail, block_q, block_k,
@@ -309,10 +322,64 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _fa_fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                         causal, sm_scale, block_q, block_k, kv_len,
+                         q_offset, mask_tail):
+    """Fused backward: grid (B, H, ki, qi), K/V block stationary.
+
+    The probability/ds block is recomputed ONCE per (ki, qi) tile (the
+    split kernels each recompute it — the r4 VERDICT lever): dk/dv
+    accumulate in scratch as before, and this tile's dq contribution
+    ``ds @ k`` is written to a per-K-block partial slot that XLA sums
+    afterwards.  5 MXU matmuls per tile instead of the split scheme's 7,
+    at the cost of one fp32 dq-partial write per K block."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (_bwd_causal_skip(qi, ki, block_q, block_k, q_offset)
+           if causal else jnp.asarray(True))
+
+    @pl.when(run)
+    def _body():
+        p, ds, q, k, _, do = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            causal, sm_scale, block_q, block_k, kv_len, q_offset, mask_tail)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dqp_ref[:] = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_not(run))
+    def _skip():
+        # this (ki, qi) partial slot is a distinct output block: it must
+        # be written even when the causal skip fires
+        dqp_ref[:] = jnp.zeros_like(dqp_ref)
+
+    @pl.when(qi == pl.num_programs(3) - 1)
+    def _finish():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
-                             block_q=None, block_k=None, interpret=False):
-    """dq, dk, dv via tiled recompute from the saved logsumexp — O(N) memory
-    (the [N,N] score matrix never materializes), all matmuls on the MXU."""
+                             block_q=None, block_k=None, interpret=False,
+                             fused=None):
+    """dq, dk, dv via tiled recompute from the saved logsumexp; the [N,N]
+    score matrix never materializes, all matmuls on the MXU.  The split
+    path is O(N) memory; the fused path additionally writes the
+    O(N^2*D/block_k) dq-partials buffer and is capped by
+    _FUSED_DQP_BYTES_CAP (falling back to split beyond it)."""
     if block_q is None:
         block_q = _BWD_BLOCKS[0]
     if block_k is None:
@@ -350,6 +417,56 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
     common = dict(causal=causal, sm_scale=sm_scale, block_q=block_q,
                   block_k=block_k, kv_len=Nk, q_offset=Nk - N,
                   mask_tail=Nkp != Nk)
+    if fused is None:
+        fused = _BWD_FUSED
+    if fused:
+        # the fused path trades FLOPs for a (B, H, Kb, Np, D) fp32
+        # dq-partials buffer — NOT O(N): at long sequence / large batch
+        # it can dwarf the tensors themselves.  The sweep only validates
+        # speed at the bench shape, so guard memory here and fall back
+        # to the split kernels (dq accumulated in VMEM scratch) when the
+        # partials would exceed the cap.
+        dqp_bytes = B * H * (Nkp // block_k) * Np * D * 4
+        if dqp_bytes > _FUSED_DQP_BYTES_CAP:
+            fused = False
+    if fused:
+        Kb = Nkp // block_k
+        k_spec = pl.BlockSpec((None, None, block_k, D),
+                              lambda b, h, i, j: (b, h, i, 0))
+        dqp, dk, dv = pl.pallas_call(
+            functools.partial(_fa_fused_bwd_kernel, **common),
+            grid=(B, H, Kb, Np // block_q),
+            in_specs=[
+                pl.BlockSpec((None, None, block_q, D),
+                             lambda b, h, i, j: (b, h, j, 0)),
+                k_spec, k_spec,
+                pl.BlockSpec((None, None, block_q, D),
+                             lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((None, None, block_q, 128),
+                             lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((None, None, block_q, 128),
+                             lambda b, h, i, j: (b, h, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, None, None, block_q, D),
+                             lambda b, h, i, j: (b, h, i, j, 0)),
+                k_spec, k_spec,
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Kb, Np, D), jnp.float32),
+                jax.ShapeDtypeStruct(kh.shape, k.dtype),
+                jax.ShapeDtypeStruct(vh.shape, v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), jnp.float32)],
+            compiler_params=_COMPILER_PARAMS,
+            interpret=interpret,
+        )(qh, kh, vh, doh, lse, delta)
+        dq = jnp.sum(dqp, axis=2).astype(q.dtype)   # reduce K partials
+        return (jnp.swapaxes(dq[:, :, :N], 1, 2),
+                jnp.swapaxes(dk[:, :, :Nk], 1, 2),
+                jnp.swapaxes(dv[:, :, :Nk], 1, 2))
+
     q_spec = pl.BlockSpec((None, None, block_q, D),
                           lambda b, h, i, j: (b, h, i, 0))
     row_spec = pl.BlockSpec((None, None, block_q, 128),
@@ -403,10 +520,11 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
             jnp.swapaxes(dv[:, :, :Nk], 1, 2))
 
 
-def _flash_fwd_bwd_probe(q, bwd_block_q, bwd_block_k):
+def _flash_fwd_bwd_probe(q, bwd_block_q, bwd_block_k, fused=False):
     """Kernel-check helper: self-attention fwd+bwd with EXPLICIT backward
-    block sizes (forward keeps its defaults) so tools/tpu_kernel_check.py
-    can sweep the backward tiling on-chip."""
+    block sizes and strategy (forward keeps its defaults) so
+    tools/tpu_kernel_check.py can sweep the backward configuration
+    on-chip."""
     @jax.custom_vjp
     def f(q):
         return _flash_attention_tpu(q, q, q, True)
@@ -419,7 +537,7 @@ def _flash_fwd_bwd_probe(q, bwd_block_q, bwd_block_k):
         q, out, lse = res
         dq, dk, dv = _flash_attention_bwd_tpu(
             q, q, q, out, lse, g, True,
-            block_q=bwd_block_q, block_k=bwd_block_k)
+            block_q=bwd_block_q, block_k=bwd_block_k, fused=fused)
         return (dq + dk + dv,)
 
     f.defvjp(fwd, bwd)

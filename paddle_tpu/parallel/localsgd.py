@@ -73,6 +73,14 @@ class LocalSGDOptimizer:
     def _parameters(self):
         return self._inner._parameters
 
+    def __getattr__(self, name):
+        # full optimizer surface (set_lr, _learning_rate, flags set on the
+        # inner optimizer before wrapping, ...) delegates to the inner —
+        # same contract as GradientMergeOptimizer
+        if name == "_inner":         # pre-__init__ lookups must not recurse
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
     def step(self):
         self._inner.step()
         self._t += 1

@@ -864,7 +864,9 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     compacted to the front (stable order) and zero rows after; the
     per-level valid counts come back as rois_num_per_level.  restore_ind
     [N, 1] maps the level-concatenated layout back to the input order:
-    concat(multi_rois)[restore_ind] == fpn_rois.
+    concat(multi_rois)[restore_ind] == fpn_rois for the first n_valid
+    rows; padding rows point at a guaranteed-zero slot (the last slot of
+    the last level), so an unmasked gather reproduces their zero rows.
     """
     num_lvl = max_level - min_level + 1
 
@@ -900,6 +902,11 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
             in_level = sel[order]
             pos = pos.at[order].max(
                 jnp.where(in_level, jnp.arange(N) + li * N, -1))
+        # padding (invalid) rois point at the LAST slot of the last
+        # level: whenever any padding roi exists, the levels cannot all
+        # be full, so that slot is a guaranteed-zero row — a jnp gather
+        # with -1 would wrap to the last REAL roi instead (advisor r4)
+        pos = jnp.where(pos < 0, num_lvl * N - 1, pos)
         return (*multi, pos.reshape(N, 1), *counts)
 
     args = [fpn_rois] + ([rois_num] if rois_num is not None else [])

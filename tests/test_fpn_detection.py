@@ -51,6 +51,12 @@ def test_distribute_fpn_proposals_rois_num():
         rois_num=paddle.to_tensor(np.array([2], np.int32)))
     cs = [int(c.numpy()) for c in counts]
     assert cs == [1, 0, 0, 1]
+    # padding rows gather a guaranteed-zero slot: an UNMASKED
+    # concat(multi)[restore_ind] reproduces the input including its
+    # zero padding rows (advisor r4: -1 would wrap to a real roi)
+    cat = np.concatenate([m.numpy() for m in multi], 0)
+    back = cat[restore.numpy().reshape(-1)]
+    np.testing.assert_allclose(back, rois)
 
 
 def test_collect_fpn_proposals_golden():

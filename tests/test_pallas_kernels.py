@@ -209,3 +209,46 @@ def test_flash_attention_backward_kernel_interpret(B, N, Nk, H, D, causal):
     for got, want in zip((dq, dk, dv), vjp(do)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-4)
+
+
+@pytest.mark.parametrize("B,N,Nk,H,D,causal", [
+    (2, 256, 256, 2, 64, False),
+    (2, 256, 256, 2, 64, True),
+    (1, 384, 384, 2, 64, True),      # uneven tail blocks
+    (1, 128, 320, 2, 64, True),      # cross-length (prefix-cache)
+    (1, 512, 512, 1, 128, False),
+])
+def test_flash_attention_fused_backward_interpret(B, N, Nk, H, D, causal):
+    """FUSED backward (one kernel: dk/dv scratch + per-K-block dq
+    partials) must match both the split kernels and the dense reference."""
+    from paddle_tpu.ops.pallas.flash_attn import (_flash_attention_bwd_tpu,
+                                                  _flash_attention_tpu)
+
+    rng = np.random.RandomState(12)
+    q = jnp.asarray(rng.randn(B, N, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Nk, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Nk, H, D), jnp.float32)
+    do = jnp.asarray(rng.randn(B, N, H, D), jnp.float32)
+    out, lse = _flash_attention_tpu(q, k, v, causal, interpret=True,
+                                    return_lse=True)
+    fused = _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
+                                     interpret=True, fused=True)
+    split = _flash_attention_bwd_tpu(q, k, v, out, lse, do, causal,
+                                     interpret=True, fused=False)
+    _, vjp = jax.vjp(lambda a, b, c: _ref_attention(a, b, c, causal),
+                     q, k, v)
+    ref = vjp(do)
+    for got, via_split, want in zip(fused, split, ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(via_split),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4)
+
+
+def test_set_default_blocks_bwd_fused_flag():
+    from paddle_tpu.ops.pallas import flash_attn as fa
+    try:
+        fa.set_default_blocks(bwd_fused=True)
+        assert fa._BWD_FUSED is True
+    finally:
+        fa.set_default_blocks(bwd_fused=False)

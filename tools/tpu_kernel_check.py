@@ -131,18 +131,26 @@ def check_flash_bench_shape(results):
     entry["xla_bwd_ms"] = tr_b * 1e3
     entry["bwd_blocks"] = {}
     best_b = best_b_cfg = None
-    for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512)):
-        try:
-            g_fn = make_grad(lambda q, bq=bq, bk=bk: fa._flash_fwd_bwd_probe(
-                q, bq, bk))
-            tb, _ = timeit(g_fn, q, iters=10)
-            entry["bwd_blocks"][f"{bq}x{bk}"] = tb * 1e3
-            if best_b is None or tb * 1e3 < best_b:
-                best_b, best_b_cfg = tb * 1e3, (bq, bk)
-        except Exception as e:                      # noqa: BLE001
-            entry["bwd_blocks"][f"{bq}x{bk}"] = f"{type(e).__name__}: {e}"
+    # sweep both backward strategies: split (dq + dkv kernels, each
+    # recomputing the probability block) and fused (one kernel, p/ds
+    # computed once, per-K-block dq partials reduced by XLA)
+    for fused in (False, True):
+        tag = "fused" if fused else "split"
+        for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512)):
+            try:
+                g_fn = make_grad(
+                    lambda q, bq=bq, bk=bk, fused=fused:
+                    fa._flash_fwd_bwd_probe(q, bq, bk, fused=fused))
+                tb, _ = timeit(g_fn, q, iters=10)
+                entry["bwd_blocks"][f"{tag}:{bq}x{bk}"] = tb * 1e3
+                if best_b is None or tb * 1e3 < best_b:
+                    best_b, best_b_cfg = tb * 1e3, (bq, bk, fused)
+            except Exception as e:                  # noqa: BLE001
+                entry["bwd_blocks"][f"{tag}:{bq}x{bk}"] = (
+                    f"{type(e).__name__}: {e}")
     entry["best_bwd_ms"] = best_b
-    entry["best_bwd_blocks"] = best_b_cfg
+    entry["best_bwd_blocks"] = best_b_cfg[:2] if best_b_cfg else None
+    entry["best_bwd_fused"] = bool(best_b_cfg[2]) if best_b_cfg else False
     entry["pallas_beats_xla"] = bool(
         best is not None and best < entry["xla_fwd_ms"]
         and best_b is not None and best_b < entry["xla_bwd_ms"])
