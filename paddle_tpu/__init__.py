@@ -47,6 +47,12 @@ import sys as _sys  # noqa: E402
 tensor = _sys.modules[__name__ + ".tensor"]
 from .tensor.logic import is_tensor  # noqa: E402
 from .tensor.attribute import shape as shape  # noqa: E402,F811
+# paddle.dtype — the dtype class (ref: paddle/framework/dtype.py exports
+# its VarType wrapper; here dtypes ARE numpy/jax dtypes, so the class is
+# np.dtype: paddle.dtype('float32'), isinstance(x.dtype, paddle.dtype),
+# and paddle.dtype == type(t.numpy().dtype) all behave)
+import numpy as _np  # noqa: E402
+dtype = _np.dtype
 
 from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: E402
 from .framework.core import Generator  # noqa: E402

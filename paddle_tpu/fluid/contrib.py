@@ -193,7 +193,7 @@ def extend_with_decoupled_weight_decay(base_optimizer):
         # once; static programs register this optimizer as train_spec and
         # the Executor drives apply_updates_pytree below
 
-        def minimize(self, loss, **kwargs):
+        def minimize(self, loss, *args, **kwargs):
             from ..static.graph import in_static_mode
             if (in_static_mode() and self._wd_coeff
                     and self._wd_filter is not None):
@@ -204,7 +204,7 @@ def extend_with_decoupled_weight_decay(base_optimizer):
                     "Executor path (the jitted update sees raw values, "
                     "not named Parameters) — every parameter is decayed",
                     UserWarning, stacklevel=2)
-            return super().minimize(loss, **kwargs)
+            return super().minimize(loss, *args, **kwargs)
 
         def apply_updates_pytree(self, param_vals, grads, states, lr, t):
             # static-Executor path: decay folded into the jitted update

@@ -10,6 +10,6 @@ from .stat import std, var, median, nanmedian, quantile, numel  # noqa: F401
 from .linalg import *  # noqa: F401,F403
 from .random import (rand, randn, normal, uniform, randint, randint_like,  # noqa: F401
                      randperm, bernoulli, poisson, multinomial, shuffle,
-                     standard_normal)
+                     standard_normal, check_shape)
 from .attribute import shape as shape_op, rank as rank_op  # noqa: F401
 from .attribute import is_complex, is_floating_point, is_integer  # noqa: F401

@@ -140,3 +140,23 @@ _install()
 def _i64():
     from ..framework import core as _c
     return _c.convert_dtype("int64")
+
+
+def check_shape(shape):
+    """Validate a shape argument before a fill/creation op (ref:
+    python/paddle/fluid/layers/utils.py:364, re-exported at top level via
+    tensor/random.py in the reference)."""
+    if isinstance(shape, Tensor):
+        if jnp.dtype(shape.value.dtype) not in (jnp.dtype("int32"),
+                                                jnp.dtype("int64")):
+            raise TypeError("shape tensor must be int32 or int64")
+        return
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            continue
+        if not isinstance(ele, (int, np.integer)):
+            raise TypeError("All elements in ``shape`` must be integers "
+                            "when it's a list or tuple")
+        if ele < 0:
+            raise ValueError("All elements in ``shape`` must be positive "
+                             "when it's a list or tuple")

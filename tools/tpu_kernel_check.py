@@ -19,6 +19,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# Wall-clock budget for the block sweeps (the bench orchestrator runs this
+# as a SIGKILL-bounded phase — a partially-swept artifact beats a killed
+# process that never wrote one).
+_T0 = time.perf_counter()
+SWEEP_BUDGET_S = float(os.environ.get("PALLAS_CHECK_BUDGET_S", "330"))
+
+
+def _budget_left():
+    return SWEEP_BUDGET_S - (time.perf_counter() - _T0)
+
 
 def fetch(x):
     """Host-sync: reduce to a scalar and pull it to the host."""
@@ -103,6 +113,9 @@ def check_flash_bench_shape(results):
     best = best_cfg = None
     for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 1024),
                    (2048, 512), (1024, 2048)):
+        if _budget_left() < 30:
+            entry["fwd_blocks"][f"{bq}x{bk}"] = "skipped: budget"
+            continue
         try:
             p_fn = jax.jit(lambda q, bq=bq, bk=bk: fa._flash_attention_tpu(
                 q, q, q, True, block_q=bq, block_k=bk))
@@ -137,6 +150,9 @@ def check_flash_bench_shape(results):
     for fused in (False, True):
         tag = "fused" if fused else "split"
         for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512)):
+            if _budget_left() < 30:
+                entry["bwd_blocks"][f"{tag}:{bq}x{bk}"] = "skipped: budget"
+                continue
             try:
                 g_fn = make_grad(
                     lambda q, bq=bq, bk=bk, fused=fused:
@@ -218,22 +234,28 @@ def main():
         print("WARNING: no TPU — kernels will run their XLA fallbacks only",
               file=sys.stderr)
 
+    # CPU runs only exercise fallbacks — never clobber the committed
+    # on-chip results
+    suffix = ".json" if dev.platform != "cpu" else "_cpu.json"
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tpu_kernel_check" + suffix)
+
     results = {"device": str(dev.device_kind)}
-    for check in (check_flash_attention, check_flash_bench_shape,
+    # Most-important check first (the bench-shape sweep drives the
+    # use_flash gate) and the artifact is rewritten after EVERY check —
+    # if the orchestrator SIGKILLs us mid-run, the completed checks
+    # survive on disk instead of vanishing with the process.
+    for check in (check_flash_bench_shape, check_flash_attention,
                   check_fused_ffn, check_norms):
         try:
             check(results)
         except Exception as e:                      # noqa: BLE001
             results[check.__name__] = {"ok": False,
                                        "error": f"{type(e).__name__}: {e}"}
-
-    # CPU runs only exercise fallbacks — never clobber the committed
-    # on-chip results
-    suffix = ".json" if dev.platform != "cpu" else "_cpu.json"
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "tpu_kernel_check" + suffix)
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2, default=str)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:       # atomic replace: a SIGKILL mid-
+            json.dump(results, f, indent=2, default=str)
+        os.replace(tmp, out_path)       # write can't corrupt the artifact
     ok = all(v.get("ok", True) for v in results.values()
              if isinstance(v, dict))
     for k, v in results.items():
