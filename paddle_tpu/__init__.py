@@ -183,13 +183,21 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     """Standalone learnable parameter (ref: paddle.create_parameter /
     fluid layer_helper_base.create_parameter).  Same precedence as
-    Layer.create_parameter: attr.initializer > default_initializer >
-    Constant(0) for biases / XavierUniform for weights."""
+    Layer.create_parameter: attr.initializer > set_global_initializer >
+    default_initializer > Constant(0) for biases / XavierUniform for
+    weights — fluid static layers build through here, the global's
+    primary reference use case."""
     from .nn import initializer as _I
     from .framework.param_attr import ParamAttr as _PA
     attr = _PA._to_attr(attr)
-    init = (attr.initializer if attr is not None and attr.initializer
-            is not None else default_initializer)
+    glob = (_I._global_bias_init[0] if is_bias
+            else _I._global_weight_init[0])
+    if attr is not None and attr.initializer is not None:
+        init = attr.initializer
+    elif glob is not None:
+        init = glob
+    else:
+        init = default_initializer
     if init is None:
         init = _I.Constant(0.0) if is_bias else _I.XavierUniform()
     dt = _core.convert_dtype(dtype)

@@ -8,6 +8,8 @@ from .collective import (ReduceOp, Group, new_group, get_group, barrier, wait,
 from . import fleet
 from .data_parallel import DataParallel
 from . import sharding
+from .ps_compat import (EntryAttr, ProbabilityEntry,  # noqa: F401
+                        CountFilterEntry, InMemoryDataset, QueueDataset)
 
 
 def launch():

@@ -16,3 +16,11 @@ from . import meta_parallel
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                             VocabParallelEmbedding, get_rng_state_tracker)
 from . import metrics  # noqa: E402
+from .util import Role, UtilBase, CommunicateTopology  # noqa: E402
+from ..ps_compat import (DataGenerator,  # noqa: E402,F401
+                         MultiSlotDataGenerator,
+                         MultiSlotStringDataGenerator)
+
+# fleet.util singleton (ref fleet_base.py exposes fleet.util after init)
+util = UtilBase()
+fleet.util = util

@@ -1,0 +1,143 @@
+"""fleet.util + topology + data-generator exports.
+
+ref: python/paddle/distributed/fleet/base/util_factory.py (UtilBase),
+base/role_maker.py:28 (Role), base/topology.py:35 (CommunicateTopology),
+fleet/data_generator/.
+
+UtilBase's collective helpers operate on HOST values (numpy/python) —
+the reference routes them over gloo between trainer processes; in the
+single-controller SPMD runtime every process sees the whole mesh, so
+world size comes from the launch env and the collectives are the
+world-of-one identity unless a multi-process launch is active."""
+from __future__ import annotations
+
+import collections
+import functools
+import itertools
+import operator
+
+import numpy as np
+
+
+class Role:
+    """ref role_maker.py:28."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class CommunicateTopology:
+    """Rank <-> hybrid-coordinate bookkeeping (ref topology.py:35).
+    Pure coordinate math — the mesh itself lives in
+    HybridCommunicateGroup; this is the standalone helper scripts use."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "model"),
+                 dims=(1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple(
+            "Coordinate", self._parallel_names)
+        self._world_size = functools.reduce(operator.mul, self._dims)
+        coords = [self.coordinate(*c) for c in
+                  itertools.product(*[range(d) for d in self._dims])]
+        self._coord2rank = {c: r for r, c in enumerate(coords)}
+        self._rank2coord = {r: c for c, r in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        assert len(kwargs) == len(self._dims)
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[ax] == index)
+
+    def get_comm_list(self, axis_name):
+        """All rank groups that communicate along ``axis_name``."""
+        others = [n for n in self._parallel_names if n != axis_name]
+        groups = []
+        for fixed in itertools.product(
+                *[range(self.get_dim(n)) for n in others]):
+            coord = dict(zip(others, fixed))
+            groups.append([
+                self._coord2rank[self.coordinate(
+                    **{**coord, axis_name: i})]
+                for i in range(self.get_dim(axis_name))])
+        return groups
+
+
+class UtilBase:
+    """ref util_factory.py:44 — host-side helpers for trainer scripts."""
+
+    def __init__(self):
+        self.role_maker = None
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def _set_strategy(self, dist_strategy):
+        self._strategy = dist_strategy
+
+    @staticmethod
+    def _world():
+        from ..parallel import get_rank, get_world_size
+        return get_rank(), max(get_world_size(), 1)
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        arr = np.asarray(input)
+        _, n = self._world()
+        if n <= 1:
+            return arr
+        from .. import collective
+        from ...tensor.tensor import Tensor
+        t = Tensor(arr)
+        op = {"sum": collective.ReduceOp.SUM,
+              "max": collective.ReduceOp.MAX,
+              "min": collective.ReduceOp.MIN}[mode]
+        collective.all_reduce(t, op=op)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective
+        collective.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        _, n = self._world()
+        if n <= 1:
+            return [input]
+        from .. import collective
+        from ...tensor.tensor import Tensor
+        out = []
+        collective.all_gather(out, Tensor(np.asarray(input)))
+        return [np.asarray(t.numpy()) for t in out]
+
+    def get_file_shard(self, files):
+        """Split ``files`` contiguously over trainers (ref :207: first
+        ``len % trainers`` trainers take one extra)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be "
+                            "read.")
+        rank, n = self._world()
+        base, extra = divmod(len(files), n)
+        blocks = [base + (1 if i < extra else 0) for i in range(n)]
+        start = sum(blocks[:rank])
+        return files[start:start + blocks[rank]]
+
+    def print_on_rank(self, message, rank_id):
+        if self._world()[0] == rank_id:
+            print(message)

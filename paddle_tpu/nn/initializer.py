@@ -143,6 +143,51 @@ class Assign(Initializer):
         return arr.reshape(tuple(shape)).astype(dt)
 
 
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel weights for transposed convs (ref:
+    fluid/initializer.py:733 BilinearInitializer): weight[.., y, x] =
+    (1 - |x/f - c|)(1 - |y/f - c|) with f = ceil(k/2), c = (2f-1-f%2)/2f
+    — a Conv2DTranspose initialized this way upsamples like classic
+    bilinear interpolation."""
+
+    def __call__(self, shape, dtype=None):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("the length of shape must be 4.")
+        if shape[2] != shape[3]:
+            raise ValueError("shape[2] must be equal to shape[3].")
+        k = shape[3]
+        f = math.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = np.arange(k, dtype=np.float64)
+        w1d = 1 - np.abs(x / f - c)
+        kernel = np.outer(w1d, w1d)          # [k, k], (y, x) separable
+        w = np.broadcast_to(kernel, shape)
+        dt = core.convert_dtype(dtype) or core.get_default_dtype()
+        return jnp.asarray(w, dt)
+
+
+# global defaults installed by set_global_initializer: used when neither
+# the ParamAttr nor the layer's own default supplies an initializer --
+# priority attr.initializer > global > layer default (ref
+# fluid/initializer.py:959, layer_helper_base create_parameter).
+_global_weight_init = [None]
+_global_bias_init = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref fluid/initializer.py:959 — install process-wide default
+    weight/bias initializers (None resets)."""
+    for which, init in (("weight_init", weight_init),
+                        ("bias_init", bias_init)):
+        if init is not None and not isinstance(init, Initializer):
+            raise TypeError(
+                f"{which} must be an Initializer instance or None, got "
+                f"{type(init)}")
+    _global_weight_init[0] = weight_init
+    _global_bias_init[0] = bias_init
+
+
 class Dirac(Initializer):
     def __init__(self, groups=1):
         self.groups = groups

@@ -52,9 +52,15 @@ class Layer:
             return None
         dtype = core.convert_dtype(dtype) or self._dtype or core.get_default_dtype()
         attr = ParamAttr._to_attr(attr)
+        from ..initializer import _global_bias_init, _global_weight_init
+        glob = _global_bias_init[0] if is_bias else _global_weight_init[0]
         init = None
         if attr is not None and attr.initializer is not None:
             init = attr.initializer
+        elif glob is not None:
+            # set_global_initializer overrides the layer's own default
+            # (reference layer_helper_base.create_parameter order)
+            init = glob
         elif default_initializer is not None:
             init = default_initializer
         elif is_bias:

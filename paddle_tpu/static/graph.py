@@ -383,6 +383,46 @@ class Executor:
                          for i in fetch_ids)
         return jax.jit(infer)
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive an epoch from a fleet Dataset (ref fluid/executor.py::
+        train_from_dataset).  The reference hands the dataset to C++
+        trainer threads; here each parsed MultiSlot batch is an ordinary
+        feed into the jitted replay — one compiled step, batches
+        streamed through it."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        program = program or default_main_program()
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [f"fetch_{i}"
+                                    for i in range(len(fetch_list))]
+        for step, feed in enumerate(dataset.iter_batches()):
+            vals = self.run(program, feed=feed, fetch_list=fetch_list)
+            # the reference prints fetch vars every print_period without
+            # needing debug (debug toggles extra profiling there)
+            if fetch_list and step % max(print_period, 1) == 0:
+                msg = " ".join(f"{n}={np.asarray(v).ravel()[:1]}"
+                               for n, v in zip(fetch_info, vals))
+                print(f"step {step}: {msg}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset: the program's train_spec
+        (if any) is suspended so evaluating a TRAIN program never applies
+        optimizer updates (the reference's infer trainer is forward-only
+        by construction)."""
+        program = program or default_main_program()
+        saved, program.train_spec = program.train_spec, None
+        try:
+            return self.train_from_dataset(program, dataset, scope,
+                                           thread, debug, fetch_list,
+                                           fetch_info, print_period)
+        finally:
+            program.train_spec = saved
+
     def close(self):
         self._cache.clear()
 

@@ -1,0 +1,257 @@
+"""PS-era data plumbing + fleet util + initializer long tail
+(ref distributed/entry_attr.py, fleet/data_generator/, fleet/dataset/,
+fleet/base/util_factory.py, fluid/initializer.py:733,959)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.distributed import fleet
+
+
+class TestEntries:
+    def test_entry_attrs(self):
+        import paddle_tpu.distributed as dist
+        assert dist.ProbabilityEntry(0.5)._to_attr() == \
+            "probability_entry:0.5"
+        assert dist.CountFilterEntry(10)._to_attr() == \
+            "count_filter_entry:10"
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(-1)
+
+
+class TestDataGenerator:
+    def test_multislot_protocol_golden(self):
+        g = fleet.MultiSlotDataGenerator()
+        line = g._gen_str([("words", [1926, 8, 17]), ("label", [1])])
+        assert line == "3 1926 8 17 1 1\n"
+        assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+        # float promotes the slot kind
+        g._gen_str([("words", [1.5, 2.0, 3.0]), ("label", [0])])
+        assert g._proto_info[0] == ("words", "float")
+        with pytest.raises(ValueError):       # field-count mismatch
+            g._gen_str([("words", [1])])
+
+    def test_multislot_string_protocol(self):
+        g = fleet.MultiSlotStringDataGenerator()
+        assert g._gen_str([("w", ["a", "b"]), ("l", ["1"])]) == \
+            "2 a b 1 1\n"
+
+    def test_run_from_memory(self, capsys):
+        class G(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    for i in range(3):
+                        yield [("ids", [i, i + 1]), ("label", [i % 2])]
+                return it
+        G().run_from_memory()
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out == ["2 0 1 1 0", "2 1 2 1 1", "2 2 3 1 0"]
+
+
+def _write_protocol(tmp_path, rows):
+    p = os.path.join(str(tmp_path), "part-0")
+    with open(p, "w") as f:
+        for ids, label in rows:
+            f.write(f"{len(ids)} {' '.join(map(str, ids))} 1 {label}\n")
+    return p
+
+
+class TestDatasets:
+    def _vars(self):
+        paddle.enable_static()
+        ids = fluid.layers.data("ds_ids", [-1], dtype="int64")
+        lbl = fluid.layers.data("ds_label", [1], dtype="int64")
+        return ids, lbl
+
+    def test_in_memory_dataset(self, tmp_path):
+        try:
+            ids, lbl = self._vars()
+            import paddle_tpu.distributed as dist
+            ds = dist.InMemoryDataset()
+            ds.init(batch_size=2, use_var=[ids, lbl])
+            p = _write_protocol(tmp_path,
+                                [([1, 2], 0), ([3], 1), ([4, 5, 6], 0)])
+            ds.set_filelist([p])
+            ds.load_into_memory()
+            assert ds.get_memory_data_size() == 3
+            ds._seed = 0
+            ds.local_shuffle()
+            batches = list(ds.iter_batches())
+            assert len(batches) == 2            # 2 + 1
+            b0 = batches[0]
+            assert set(b0) == {"ds_ids", "ds_label"}
+            assert b0["ds_ids"].dtype == np.int64
+            # padded to batch max
+            assert b0["ds_ids"].shape[0] == 2
+            ds.release_memory()
+            assert ds.get_memory_data_size() == 0
+        finally:
+            paddle.disable_static()
+
+    def test_queue_dataset_and_pipe_command(self, tmp_path):
+        try:
+            ids, lbl = self._vars()
+            import paddle_tpu.distributed as dist
+            raw = os.path.join(str(tmp_path), "raw.txt")
+            with open(raw, "w") as f:
+                f.write("7 8\n9 10\n")
+            ds = dist.QueueDataset()
+            # pipe turns "a b" into "2 a b 1 0" protocol rows
+            ds.init(batch_size=1, use_var=[ids, lbl],
+                    pipe_command=(
+                        "awk '{print 2, $1, $2, 1, 0}'"))
+            ds.set_filelist([raw])
+            batches = list(ds.iter_batches())
+            assert len(batches) == 2
+            np.testing.assert_array_equal(batches[0]["ds_ids"],
+                                          [[7, 8]])
+        finally:
+            paddle.disable_static()
+
+    def test_train_from_dataset(self, tmp_path):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("tfd_x", [2], dtype="float32")
+                y = fluid.layers.data("tfd_y", [1], dtype="float32")
+                pred = fluid.layers.fc(x, 1)
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square(pred - y))
+                opt = fluid.optimizer.SGD(learning_rate=0.1)
+                opt.minimize(loss)
+
+                import paddle_tpu.distributed as dist
+                ds = dist.InMemoryDataset()
+                ds.init(batch_size=2, use_var=[x, y])
+                p = os.path.join(str(tmp_path), "train.txt")
+                with open(p, "w") as f:
+                    for _ in range(8):
+                        f.write("2 1.0 2.0 1 3.0\n")
+                ds.set_filelist([p])
+                ds.load_into_memory()
+
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                w0 = np.asarray(main.all_parameters()[0].numpy()).copy()
+                exe.train_from_dataset(main, ds, fetch_list=[loss])
+                w1 = np.asarray(main.all_parameters()[0].numpy())
+                assert not np.allclose(w0, w1)   # it trained
+        finally:
+            paddle.disable_static()
+
+
+    def test_infer_from_dataset_never_trains(self, tmp_path):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("ifd_x", [2], dtype="float32")
+                y = fluid.layers.data("ifd_y", [1], dtype="float32")
+                loss = fluid.layers.reduce_mean(fluid.layers.square(
+                    fluid.layers.fc(x, 1) - y))
+                fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+
+                import paddle_tpu.distributed as dist
+                ds = dist.InMemoryDataset()
+                ds.init(batch_size=2, use_var=[x, y])
+                p = os.path.join(str(tmp_path), "eval.txt")
+                with open(p, "w") as f:
+                    f.write("2 1.0 2.0 1 3.0\n" * 4)
+                ds.set_filelist([p])
+                ds.load_into_memory()
+
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                w0 = np.asarray(main.all_parameters()[0].numpy()).copy()
+                exe.infer_from_dataset(main, ds, fetch_list=[loss])
+                w1 = np.asarray(main.all_parameters()[0].numpy())
+                np.testing.assert_array_equal(w0, w1)   # no updates
+                assert main.train_spec is not None      # spec restored
+        finally:
+            paddle.disable_static()
+
+    def test_trailing_tokens_rejected(self, tmp_path):
+        try:
+            ids, lbl = self._vars()
+            import paddle_tpu.distributed as dist
+            ds = dist.InMemoryDataset()
+            ds.init(batch_size=1, use_var=[ids, lbl])
+            p = os.path.join(str(tmp_path), "bad.txt")
+            with open(p, "w") as f:
+                f.write("1 5 1 0 99 99\n")       # stray trailing tokens
+            ds.set_filelist([p])
+            with pytest.raises(ValueError, match="trailing"):
+                ds.load_into_memory()
+        finally:
+            paddle.disable_static()
+
+
+class TestFleetUtil:
+    def test_get_file_shard_and_topology(self):
+        u = fleet.UtilBase()
+        files = [f"f{i}" for i in range(5)]
+        assert u.get_file_shard(files) == files   # world of one
+        assert u.all_reduce(np.array([2.0]), "sum") == 2.0
+        assert u.all_gather(3) == [3]
+
+        topo = fleet.CommunicateTopology(["data", "pipe", "model"],
+                                         [2, 2, 2])
+        assert topo.world_size() == 8
+        assert topo.get_rank(data=0, pipe=0, model=1) == 1
+        assert topo.get_rank(data=1, pipe=0, model=0) == 4
+        assert topo.get_coord(5) == topo.coordinate(1, 0, 1)
+        assert topo.get_axis_list("model", 0) == [0, 2, 4, 6]
+        assert [0, 1] in topo.get_comm_list("model")
+        assert fleet.Role.WORKER == 1 and fleet.Role.SERVER == 2
+
+    def test_fleet_util_singleton(self):
+        assert isinstance(fleet.util, fleet.UtilBase)
+
+
+class TestInitializerLongTail:
+    def test_bilinear_golden(self):
+        init = paddle.nn.initializer.Bilinear()
+        w = np.asarray(init([1, 1, 4, 4], "float32"))
+        row = np.array([0.25, 0.75, 0.75, 0.25], np.float32)
+        np.testing.assert_allclose(w[0, 0], np.outer(row, row), rtol=1e-6)
+        with pytest.raises(ValueError):
+            init([1, 1, 3, 4], "float32")
+
+    def test_bilinear_conv_transpose_upsamples(self):
+        # factor-2 upsampling of a constant map stays constant (interior)
+        init = paddle.nn.initializer.Bilinear()
+        conv = paddle.nn.Conv2DTranspose(
+            1, 1, 4, stride=2, padding=1,
+            weight_attr=paddle.ParamAttr(initializer=init),
+            bias_attr=False)
+        x = paddle.to_tensor(np.ones((1, 1, 8, 8), "float32"))
+        y = np.asarray(conv(x).numpy())
+        assert y.shape == (1, 1, 16, 16)
+        np.testing.assert_allclose(y[0, 0, 4:12, 4:12], 1.0, rtol=1e-5)
+
+    def test_set_global_initializer(self):
+        from paddle_tpu.nn.initializer import set_global_initializer
+        try:
+            set_global_initializer(paddle.nn.initializer.Constant(3.0),
+                                   paddle.nn.initializer.Constant(-1.0))
+            lin = paddle.nn.Linear(2, 2)
+            np.testing.assert_allclose(np.asarray(lin.weight.numpy()), 3.0)
+            np.testing.assert_allclose(np.asarray(lin.bias.numpy()), -1.0)
+            # explicit ParamAttr initializer still wins
+            lin2 = paddle.nn.Linear(
+                2, 2, weight_attr=paddle.ParamAttr(
+                    initializer=paddle.nn.initializer.Constant(7.0)))
+            np.testing.assert_allclose(np.asarray(lin2.weight.numpy()),
+                                       7.0)
+            with pytest.raises(TypeError):
+                set_global_initializer("not an initializer")
+        finally:
+            set_global_initializer(None, None)
+        lin3 = paddle.nn.Linear(2, 2)     # defaults restored
+        assert np.asarray(lin3.weight.numpy()).std() > 0

@@ -1,0 +1,117 @@
+"""Namespace-by-namespace API coverage vs the reference.
+
+AST-reads each reference module's ``__all__`` (no reference import — it
+needs the fluid C++ core) and hasattr-checks the same dotted path on
+paddle_tpu.  The fluid.layers variant of this sweep lives in
+fluid_coverage.py; this is the same method for every other user-facing
+namespace.
+
+Run: PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/api_coverage.py
+Exit 0 when nothing is missing.
+"""
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REF = "/root/reference/python/paddle"
+
+# reference module (relative .py path) -> paddle_tpu dotted namespace
+MODULES = {
+    "__init__.py": "",
+    "nn/__init__.py": "nn",
+    "nn/functional/__init__.py": "nn.functional",
+    "nn/initializer/__init__.py": "nn.initializer",
+    "nn/utils/__init__.py": "nn.utils",
+    "optimizer/__init__.py": "optimizer",
+    "optimizer/lr.py": "optimizer.lr",
+    "static/__init__.py": "static",
+    "static/nn/__init__.py": "static.nn",
+    "io/__init__.py": "io",
+    "amp/__init__.py": "amp",
+    "metric/__init__.py": "metric",
+    "vision/__init__.py": "vision",
+    "vision/ops.py": "vision.ops",
+    "vision/transforms/__init__.py": "vision.transforms",
+    "vision/datasets/__init__.py": "vision.datasets",
+    "vision/models/__init__.py": "vision.models",
+    "text/__init__.py": "text",
+    "distributed/__init__.py": "distributed",
+    "distributed/fleet/__init__.py": "distributed.fleet",
+    "tensor/__init__.py": "tensor",
+    "jit/__init__.py": "jit",
+    "autograd/__init__.py": "autograd",
+    "regularizer.py": "regularizer",
+    "distribution.py": "distribution",
+    "utils/__init__.py": "utils",
+    "device/__init__.py": "device",
+    "hub.py": "hub",
+    "onnx/__init__.py": "onnx",
+    "inference/__init__.py": "inference",
+}
+
+
+def ref_all(path):
+    """Names in the module's ``__all__`` (assignments and += extends)."""
+    full = os.path.join(REF, path)
+    if not os.path.exists(full):
+        return None
+    names = []
+    tree = ast.parse(open(full, encoding="utf-8").read())
+    for node in ast.walk(tree):
+        tgt = None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    tgt = node.value
+        elif (isinstance(node, ast.AugAssign)
+              and getattr(node.target, "id", "") == "__all__"):
+            tgt = node.value
+        if tgt is not None:
+            try:
+                names += list(ast.literal_eval(tgt))
+            except (ValueError, SyntaxError):
+                pass
+    return names
+
+
+def resolve(ns):
+    import paddle_tpu
+    obj = paddle_tpu
+    for part in [p for p in ns.split(".") if p]:
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return None
+    return obj
+
+
+def main():
+    total = have = 0
+    report = []
+    for path, ns in sorted(MODULES.items()):
+        names = ref_all(path)
+        if not names:
+            continue
+        names = sorted(set(names))
+        obj = resolve(ns)
+        missing = ([n for n in names if not hasattr(obj, n)]
+                   if obj is not None else list(names))
+        total += len(names)
+        have += len(names) - len(missing)
+        label = ns or "paddle"
+        report.append((label, len(names) - len(missing), len(names),
+                       missing))
+    width = max(len(r[0]) for r in report)
+    any_missing = False
+    for label, h, t, missing in report:
+        mark = "" if not missing else "   MISSING: " + ", ".join(missing)
+        if missing:
+            any_missing = True
+        print(f"{label:<{width}}  {h}/{t}{mark}")
+    print(f"\nTOTAL {have}/{total}")
+    return 1 if any_missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -167,9 +167,19 @@ def check_flash_bench_shape(results):
     entry["best_bwd_ms"] = best_b
     entry["best_bwd_blocks"] = best_b_cfg[:2] if best_b_cfg else None
     entry["best_bwd_fused"] = bool(best_b_cfg[2]) if best_b_cfg else False
-    entry["pallas_beats_xla"] = bool(
-        best is not None and best < entry["xla_fwd_ms"]
-        and best_b is not None and best_b < entry["xla_bwd_ms"])
+    starved = any(str(v).startswith("skipped: budget")
+                  for blocks in (entry["fwd_blocks"], entry["bwd_blocks"])
+                  for v in blocks.values())
+    entry["budget_starved"] = starved
+    if starved and (best is None or best_b is None):
+        # zero measured configs is NOT an "XLA wins" verdict — record
+        # null so a starved run is distinguishable from a measured loss
+        # (the bench gate treats anything non-True as flash-off anyway)
+        entry["pallas_beats_xla"] = None
+    else:
+        entry["pallas_beats_xla"] = bool(
+            best is not None and best < entry["xla_fwd_ms"]
+            and best_b is not None and best_b < entry["xla_bwd_ms"])
     results["flash_attn_bench_shape"] = entry
 
 
