@@ -254,8 +254,12 @@ def _time_resnet_batch(batch, steps, image_size=224, classes=1000):
             img = static.data("image", [None, 3, image_size, image_size],
                               "float32")
             label = static.data("label", [None, 1], "int64")
-            logits = resnet50(num_classes=classes)(img)
-            loss = F.cross_entropy(logits, label).mean()
+            # bf16 convs on the MXU (amp O1: conv/matmul cast, norms and
+            # the loss stay fp32) — the auto_cast wrappers are recorded
+            # into the program, so the jitted replay keeps them
+            with paddle.amp.auto_cast():
+                logits = resnet50(num_classes=classes)(img)
+                loss = F.cross_entropy(logits, label).mean()
             opt = paddle.optimizer.Momentum(learning_rate=0.002,
                                             momentum=0.9, weight_decay=1e-4)
             opt.minimize(loss)

@@ -113,6 +113,41 @@ class TestPyReader:
         finally:
             paddle.disable_static()
 
+    def test_double_buffer_uses_native_ring(self):
+        # use_double_buffer=True stages batches through the C++ ring
+        # when the native runtime is built
+        from paddle_tpu import runtime
+        if not runtime.is_available():
+            pytest.skip("native runtime not built")
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                rd = fluid.layers.py_reader(
+                    capacity=4, shapes=[(-1, 8)], dtypes=["float32"],
+                    use_double_buffer=True)
+                x = fluid.layers.read_file(rd)
+                y = fluid.layers.reduce_sum(x)
+
+                def src():
+                    for i in range(6):
+                        yield (np.full((2, 8), float(i), "float32"),)
+                rd.decorate_batch_generator(src)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rd.start()
+                assert rd._pass.ring is not None     # the C++ ring path
+                vals = []
+                try:
+                    while True:
+                        v, = exe.run(main, fetch_list=[y])
+                        vals.append(float(v))
+                except fluid.core.EOFException:
+                    rd.reset()
+                assert vals == [i * 16.0 for i in range(6)]
+        finally:
+            paddle.disable_static()
+
     def test_create_py_reader_by_data(self):
         paddle.enable_static()
         try:
