@@ -1,0 +1,61 @@
+"""Drop-in ``paddle`` alias for paddle_tpu.
+
+Reference-era scripts start with ``import paddle`` / ``import
+paddle.fluid as fluid`` — this shim makes those statements resolve to
+paddle_tpu with ZERO edits: after import, ``paddle`` IS the paddle_tpu
+module (sys.modules alias, so module identity, isinstance checks and
+monkey-patches all agree), and every ``paddle.X[.Y]`` submodule import
+aliases the matching ``paddle_tpu.X[.Y]`` module — eagerly for the tree
+paddle_tpu already imported, lazily via a meta-path finder for anything
+else — never a second module instance (duplicate registries would
+corrupt the static-graph and autograd state).
+"""
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+import paddle_tpu as _pt
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Loader that 'creates' the already-imported paddle_tpu module."""
+
+    def __init__(self, real):
+        self._real = real
+        self._orig_spec = None
+
+    def create_module(self, spec):
+        mod = importlib.import_module(self._real)
+        # module_from_spec will overwrite the REAL module's __spec__ with
+        # the alias spec; remember the original so identity stays clean
+        self._orig_spec = getattr(mod, "__spec__", None)
+        return mod
+
+    def exec_module(self, module):
+        if self._orig_spec is not None:
+            module.__spec__ = self._orig_spec
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("paddle."):
+            return None
+        real = "paddle_tpu." + fullname[len("paddle."):]
+        try:
+            if importlib.util.find_spec(real) is None:
+                return None
+        except (ImportError, ValueError):
+            return None
+        return importlib.util.spec_from_loader(fullname,
+                                               _AliasLoader(real))
+
+
+# alias every already-imported paddle_tpu submodule, then the root itself:
+# ``import paddle`` after this returns paddle_tpu (identity, not a copy)
+for _name, _mod in list(sys.modules.items()):
+    if _name == "paddle_tpu" or _name.startswith("paddle_tpu."):
+        sys.modules["paddle" + _name[len("paddle_tpu"):]] = _mod
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
