@@ -223,3 +223,8 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
 
 # fluid facade imports create_parameter & friends — must come last
 from . import fluid  # noqa: E402
+
+# late Tensor method bindings that need the full package namespace
+from .tensor import _bind_longtail as _blt  # noqa: E402
+_blt()
+del _blt

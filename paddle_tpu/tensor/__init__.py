@@ -13,3 +13,22 @@ from .random import (rand, randn, normal, uniform, randint, randint_like,  # noq
                      standard_normal, check_shape)
 from .attribute import shape as shape_op, rank as rank_op  # noqa: F401
 from .attribute import is_complex, is_floating_point, is_integer  # noqa: F401
+
+
+def _bind_longtail():
+    """Bind the remaining reference tensor_method_func names onto Tensor
+    (ref python/paddle/tensor/__init__.py:198) — the sibling modules'
+    _install() loops cover the bulk; these live across several modules,
+    so they bind here after everything is imported (deferred to
+    paddle_tpu.__init__, which calls this once the package exists)."""
+    import paddle_tpu as _p
+    T = Tensor
+    for nm in ("add_n broadcast_shape is_empty is_tensor reverse "
+               "scatter_nd shard_index slice stack strided_slice "
+               "inverse floor_mod").split():
+        setattr(T, nm, getattr(_p, nm))
+    T.mul = math.multiply                     # ref alias
+    T.ceil_ = lambda s: s._rebind(math.ceil(s))
+    T.floor_ = lambda s: s._rebind(math.floor(s))
+    T.round_ = lambda s: s._rebind(math.round(s))
+    T.rsqrt_ = lambda s: s._rebind(math.rsqrt(s))

@@ -287,3 +287,52 @@ def test_check_shape_and_dtype_exports():
         paddle.check_shape([2, -3])
     with pytest.raises(TypeError):
         paddle.check_shape([2, 3.5])
+
+
+def test_tensor_method_surface():
+    """Every name in the reference's tensor_method_func list is bound as
+    a Tensor METHOD (ref python/paddle/tensor/__init__.py:198)."""
+    import ast
+    import os
+    import pytest
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree unavailable")
+    names = []
+    for node in ast.walk(ast.parse(open(ref).read())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "tensor_method_func":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    t = paddle.to_tensor([1.0, 2.0])
+    missing = [n for n in names if not hasattr(t, n)]
+    assert not missing, f"Tensor methods missing: {missing}"
+
+
+def test_tensor_method_longtail_behavior():
+    t = paddle.to_tensor([4.0, 9.0])
+    np.testing.assert_allclose(t.mul(t).numpy(), [16.0, 81.0])
+    r = t.rsqrt_()                       # in place, returns self
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               [0.5, 1.0 / 3.0], rtol=1e-6)
+    assert r is t
+    t2 = paddle.to_tensor([1.4, 2.6])
+    t2.round_()
+    np.testing.assert_allclose(t2.numpy(), [1.0, 3.0])
+    t3 = paddle.to_tensor([2.5])
+    t3.ceil_()
+    np.testing.assert_allclose(t3.numpy(), [3.0])
+    t3.floor_()
+    np.testing.assert_allclose(t3.numpy(), [3.0])
+    s = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(s.slice([0], [0], [1]).numpy(),
+                               [[1.0, 2.0]])
+    np.testing.assert_allclose(s.inverse().numpy(),
+                               np.linalg.inv([[1.0, 2.0], [3.0, 4.0]]),
+                               rtol=2e-5)
+    assert s.is_tensor()
+    empty = paddle.to_tensor(np.zeros((0, 3), "float32"))
+    assert bool(empty.is_empty().numpy())
+    assert not bool(s.is_empty().numpy())
+    st = s.stack  # bound
+    assert callable(st)
