@@ -1,0 +1,8 @@
+"""fleet.data_generator — the submodule spelling classic scripts use
+(``import paddle.distributed.fleet.data_generator as dg``; ref:
+python/paddle/distributed/fleet/data_generator/)."""
+from ..ps_compat import (DataGenerator, MultiSlotDataGenerator,  # noqa: F401
+                         MultiSlotStringDataGenerator)
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]

@@ -67,3 +67,19 @@ _flags = {}
 GradientClipByValue = ClipGradByValue
 GradientClipByNorm = ClipGradByNorm
 GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+# fluid.DatasetFactory / dataset classes (ref fluid/dataset.py:20) — the
+# classic PS-era spelling over the same MultiSlot pipeline
+from ..distributed.ps_compat import InMemoryDataset, QueueDataset  # noqa: E402,F401
+
+
+class DatasetFactory:
+    """ref fluid/dataset.py::DatasetFactory — create_dataset by name."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        kinds = {"InMemoryDataset": InMemoryDataset,
+                 "QueueDataset": QueueDataset}
+        if datafeed_class not in kinds:
+            raise ValueError(f"unknown dataset class {datafeed_class!r}; "
+                             f"choose from {sorted(kinds)}")
+        return kinds[datafeed_class]()

@@ -304,6 +304,7 @@ def test_tensor_method_surface():
             for t in node.targets:
                 if getattr(t, "id", None) == "tensor_method_func":
                     names = [ast.literal_eval(e) for e in node.value.elts]
+    assert names, "tensor_method_func not found in the reference"
     t = paddle.to_tensor([1.0, 2.0])
     missing = [n for n in names if not hasattr(t, n)]
     assert not missing, f"Tensor methods missing: {missing}"
