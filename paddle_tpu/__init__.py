@@ -5,7 +5,9 @@ performance path stages whole train steps through jax.jit, and distribution
 rides jax.sharding over TPU meshes.  API mirrors the reference
 (python/paddle/__init__.py) so Paddle users can switch directly.
 """
-__version__ = "0.1.0"
+# the Paddle API level implemented (reference era) — scripts gate on
+# paddle.__version__; the package's own build id is version.tpu_native_version
+__version__ = "2.0.0"
 
 # Multi-host bootstrap must beat any XLA backend touch, and importing this
 # package initializes backends — so when the launcher env is present

@@ -83,3 +83,112 @@ class DatasetFactory:
             raise ValueError(f"unknown dataset class {datafeed_class!r}; "
                              f"choose from {sorted(kinds)}")
         return kinds[datafeed_class]()
+from . import profiler  # noqa: E402,F401
+
+# ---- fluid top-level long tail (ref fluid/__init__.py aggregates the
+# component modules' __all__ into its own namespace) ----
+from .metrics import (ChunkEvaluator, DetectionMAP,  # noqa: E402,F401
+                      EditDistance)
+from ..regularizer import L1Decay, L2Decay  # noqa: E402,F401
+L1DecayRegularizer = L1Decay   # pre-2.0 spellings (ref regularizer.py)
+L2DecayRegularizer = L2Decay
+from ..utils.unique_name import generate, guard, switch  # noqa: E402,F401
+from .. import is_compiled_with_xpu  # noqa: E402,F401
+from ..static.misc import cuda_places as _cuda_places  # noqa: E402
+
+
+def cuda_pinned_places(device_count=None):
+    """ref framework.py::cuda_pinned_places — pinned host staging places;
+    the C++ ring owns host staging here, so these are CPU places."""
+    from ..framework.core import CPUPlace
+    return [CPUPlace()] * (device_count or 1)
+
+
+def xpu_places(device_ids=None):
+    """ref framework.py::xpu_places — every accelerator place maps to the
+    TPU chips (same policy as the NPUPlace/XPUPlace aliases)."""
+    return _cuda_places(device_ids)
+
+
+import contextlib as _ctx  # noqa: E402
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    """ref framework.py::device_guard — pins ops to a device in the
+    program desc.  XLA owns placement here (one fused program), so the
+    guard is accepted and recorded as a no-op; "cpu" pinning for IO ops
+    has no meaning when the host pipeline is already host-side."""
+    yield
+
+
+def require_version(min_version, max_version=None):
+    """ref framework.py::require_version — version gate for scripts
+    (delegates to paddle.utils.require_version, which zero-pads version
+    components so "2.0" == "2.0.0")."""
+    from ..utils import require_version as _rv
+    return _rv(min_version, max_version)
+
+
+class WeightedAverage:
+    """ref average.py::WeightedAverage — streaming weighted mean."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total = 0.0
+        self._weight = 0.0
+
+    def add(self, value, weight=1):
+        import numpy as _n
+        v = _n.asarray(value.numpy() if hasattr(value, "numpy") else value,
+                       dtype=_n.float64)
+        self._total += float(v.sum()) * (weight / max(v.size, 1))
+        self._weight += float(weight)
+
+    def eval(self):
+        if self._weight == 0:
+            raise ValueError(
+                "There is no data in WeightedAverage. Please check "
+                "layers.assign is called before WeightedAverage.eval.")
+        return self._total / self._weight
+
+
+class DataFeeder:
+    """ref data_feeder.py::DataFeeder — convert lists of per-sample
+    field tuples into the feed dict Executor.run takes, reshaping each
+    field to its feed var's declared shape (the same semantics
+    py_reader's sample mode uses)."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        from ..static.graph import _feed_declared_shapes
+        self._names, self._shapes, self._dtypes = [], [], []
+        import numpy as _n
+        for v in feed_list:
+            name = getattr(v, "name", str(v))
+            self._names.append(name)
+            decl = _feed_declared_shapes.get(name, list(v.shape))
+            self._shapes.append([int(s) if (s is not None and s >= 0)
+                                 else -1 for s in decl])
+            self._dtypes.append(_n.dtype(v.value.dtype))
+
+    def feed(self, iterable):
+        import numpy as _n
+        samples = list(iterable)
+        out = {}
+        for i, (name, decl, dt) in enumerate(
+                zip(self._names, self._shapes, self._dtypes)):
+            arr = _n.array([_n.asarray(s[i]) for s in samples], dtype=dt)
+            # reference converter semantics (data_feeder.py::done): the
+            # STACKED batch reshapes to the declared shape (batch dim -1
+            # resolves) only when the ranks disagree
+            if decl and len(arr.shape) != len(decl)                     and decl.count(-1) <= 1:
+                try:
+                    arr = arr.reshape(decl)
+                except ValueError:
+                    raise ValueError(
+                        "Reshape error. What is defined in data layer "
+                        f"is {decl}, but receive {list(arr.shape)}")
+            out[name] = arr
+        return out

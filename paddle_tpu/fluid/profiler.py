@@ -1,0 +1,20 @@
+"""fluid.profiler — the legacy profiler spelling (ref:
+python/paddle/fluid/profiler.py:22).  Delegates to paddle_tpu.profiler
+(jax.profiler + op timers); cuda_profiler maps to the same device
+profiler (there is no separate nvprof on TPU)."""
+import contextlib
+
+from ..profiler import (profiler, start_profiler,  # noqa: F401
+                        stop_profiler, reset_profiler)
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler",
+           "start_profiler", "stop_profiler"]
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """ref profiler.py::cuda_profiler — an nvprof session; on TPU the
+    device profiler is the same one `profiler()` drives, so this is that
+    context with the chrome trace written to ``output_file``."""
+    with profiler(profile_path=output_file):
+        yield

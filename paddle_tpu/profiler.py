@@ -26,15 +26,23 @@ _enabled = False
 _t0 = 0.0
 
 
+def reset_profiler():
+    """Drop collected op records (ref fluid/profiler.py::reset_profiler).
+    Takes the events lock — record_op appends under it from worker
+    threads."""
+    _op_times.clear()
+    _op_counts.clear()
+    with _events_lock:
+        del _events[:]
+
+
 def start_profiler(state="All", tracer_option="Default", log_dir=None):
     global _enabled, _t0
     _enabled = True
     _t0 = time.perf_counter()
     if log_dir:
         jax.profiler.start_trace(log_dir)
-    _op_times.clear()
-    _op_counts.clear()
-    del _events[:]
+    reset_profiler()
 
 
 def stop_profiler(sorted_key="total", profile_path=None):

@@ -370,3 +370,65 @@ class TestDygraphReviewRegressions:
                 np.ones((2, 4), np.float32))).mean()
             loss.backward()
             opt.minimize(loss)
+
+
+class TestFluidTopLevelLongTail:
+    """fluid.__init__ aggregates the component modules' __all__ (ref
+    fluid/framework.py, data_feeder.py, evaluator.py, average.py,
+    unique_name.py, profiler.py)."""
+
+    def test_names_resolve(self):
+        for n in ("ChunkEvaluator DataFeeder DetectionMAP EditDistance "
+                  "L1Decay L1DecayRegularizer L2Decay L2DecayRegularizer "
+                  "WeightedAverage cuda_pinned_places device_guard "
+                  "generate guard is_compiled_with_xpu require_version "
+                  "switch xpu_places profiler DatasetFactory").split():
+            assert hasattr(fluid, n), n
+        for n in ("cuda_profiler reset_profiler profiler start_profiler "
+                  "stop_profiler").split():
+            assert hasattr(fluid.profiler, n), n
+
+    def test_weighted_average(self):
+        wa = fluid.WeightedAverage()
+        wa.add(2.0, 1)
+        wa.add(4.0, 3)
+        assert abs(wa.eval() - 3.5) < 1e-9
+        wa.reset()
+        with pytest.raises(ValueError):
+            wa.eval()
+
+    def test_require_version(self):
+        fluid.require_version("1.8.0")
+        with pytest.raises(Exception):
+            fluid.require_version("9.0.0")
+
+    def test_data_feeder(self):
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                img = fluid.layers.data("dfc_img", [4])
+                lbl = fluid.layers.data("dfc_lbl", [1], dtype="int64")
+                s = fluid.layers.reduce_sum(img)
+                feeder = fluid.DataFeeder(feed_list=[img, lbl],
+                                          place=fluid.CPUPlace())
+                fd = feeder.feed([
+                    (np.ones(4, "float32"), np.array([1])),
+                    (np.full(4, 2.0, "float32"), np.array([0]))])
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                v, = exe.run(main, feed=fd, fetch_list=[s])
+                assert float(v) == 12.0
+        finally:
+            paddle.disable_static()
+
+    def test_profiler_contexts(self):
+        with fluid.profiler.profiler():
+            (paddle.to_tensor([1.0]) * 2).numpy()
+        fluid.profiler.reset_profiler()
+        import os
+        import tempfile
+        p = os.path.join(tempfile.mkdtemp(), "trace.json")
+        with fluid.profiler.cuda_profiler(p):
+            (paddle.to_tensor([1.0]) * 2).numpy()
+        assert os.path.exists(p)
