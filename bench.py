@@ -321,9 +321,11 @@ def run():
         # attention beat the r3 Pallas kernel at this shape, so default off
         # unless the fresh kernel check says the rewritten kernel wins.
         use_flash = _flash_wins_per_kernel_check()
+        use_ffn = _fused_ffn_wins_per_kernel_check()
         cfg_13b = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
                        num_heads=16, max_seq_len=2048,
-                       param_dtype="bfloat16", use_flash=use_flash)
+                       param_dtype="bfloat16", use_flash=use_flash,
+                       use_fused_ffn=use_ffn)
         configs = [
             # batch 6 first (deeper MXU utilization); falls back to the
             # r3-measured batch-4 config (0.474 MFU) on OOM/failure
@@ -369,6 +371,7 @@ def run():
         sweep["gpt_configs"].append(
             {"hidden": cfg.hidden_size, "batch": batch, "steps": steps,
              "seq": cfg.max_seq_len, "use_flash": bool(cfg.use_flash),
+             "use_fused_ffn": bool(getattr(cfg, "use_fused_ffn", False)),
              "tokens_per_sec": round(tokens_per_sec, 1),
              "mfu": round(mfu, 4), "loss": round(loss, 4)})
         emitted = True
@@ -396,28 +399,46 @@ def run():
     _dump_sweep(sweep)
 
 
-def _flash_wins_per_kernel_check():
-    """Honor the committed on-chip kernel sweep: enable the Pallas flash
-    path only when the fresh check shows it beating XLA at the bench shape
-    (VERDICT r3 item 2/9 — never route the flagship through a losing
-    kernel, never trust a stale green)."""
+def _kernel_check_record(key):
+    """The named record from the committed on-chip kernel sweep, but ONLY
+    when its gate is a measured True (VERDICT r3 item 2/9: never route
+    the flagship through a losing kernel, never trust a stale green or a
+    budget-starved null).  Returns None otherwise."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tools", "tpu_kernel_check.json")
     try:
         with open(path) as f:
-            data = json.load(f)
-        rec = data["flash_attn_bench_shape"]
-        if not rec["pallas_beats_xla"]:
-            return False
-        # install the sweep-winning tilings AND backward strategy so the
-        # executed configuration is exactly the one the gate approved
-        from paddle_tpu.ops.pallas import flash_attn as fa
-        fa.set_default_blocks(fwd=rec.get("best_fwd_blocks"),
-                              bwd=rec.get("best_bwd_blocks"),
-                              bwd_fused=rec.get("best_bwd_fused", False))
-        return True
+            rec = json.load(f)[key]
+        return rec if rec["pallas_beats_xla"] is True else None
     except Exception:                                      # noqa: BLE001
+        return None
+
+
+def _fused_ffn_wins_per_kernel_check():
+    """Enable the Pallas fused FFN only when the fresh sweep shows its
+    grad step beating XLA at the flagship shape — installing the
+    measured (and parity-checked) winning tiling."""
+    rec = _kernel_check_record("fused_ffn_bench_shape")
+    if rec is None:
         return False
+    from paddle_tpu.ops.pallas import fused_ffn as ff
+    ff.set_default_blocks(rec.get("best_blocks"))
+    return True
+
+
+def _flash_wins_per_kernel_check():
+    """Enable the Pallas flash path only when the fresh sweep shows it
+    beating XLA at the bench shape — installing the winning tilings AND
+    backward strategy so the executed configuration is exactly the one
+    the gate approved."""
+    rec = _kernel_check_record("flash_attn_bench_shape")
+    if rec is None:
+        return False
+    from paddle_tpu.ops.pallas import flash_attn as fa
+    fa.set_default_blocks(fwd=rec.get("best_fwd_blocks"),
+                          bwd=rec.get("best_bwd_blocks"),
+                          bwd_fused=rec.get("best_bwd_fused", False))
+    return True
 
 
 def _dump_sweep(sweep):

@@ -252,3 +252,41 @@ def test_set_default_blocks_bwd_fused_flag():
         assert fa._BWD_FUSED is True
     finally:
         fa.set_default_blocks(bwd_fused=False)
+
+
+def test_fused_ffn_block_override():
+    """set_default_blocks installs a sweep-chosen tiling; shapes it does
+    not divide fall back to the automatic choice (the kernel has no tail
+    masking, so an invalid override must never reach pallas_call)."""
+    from paddle_tpu.ops.pallas import fused_ffn as ff
+
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.randn(256, 256) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.randn(256, 512) * 0.05, jnp.float32)
+    b1 = jnp.asarray(rng.randn(512) * 0.01, jnp.float32)
+    w2 = jnp.asarray(rng.randn(512, 256) * 0.05, jnp.float32)
+    b2 = jnp.asarray(rng.randn(256) * 0.01, jnp.float32)
+    want = np.asarray(ff._ref_ffn(x, w1, b1, w2, b2))
+    seen = []
+    real_tpu = ff._fused_ffn_tpu
+
+    def spy(x2d, w1, b1, w2, b2, block_m, block_f, interpret):
+        seen.append((block_m, block_f))
+        return real_tpu(x2d, w1, b1, w2, b2, block_m, block_f, interpret)
+
+    try:
+        ff._fused_ffn_tpu = spy
+        ff.set_default_blocks((128, 256))        # divides exactly
+        got = np.asarray(ff.fused_ffn(x, w1, b1, w2, b2, interpret=True))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+        assert seen[-1] == (128, 256)
+        ff.set_default_blocks((96, 640))         # divides nothing
+        got2 = np.asarray(ff.fused_ffn(x, w1, b1, w2, b2, interpret=True))
+        np.testing.assert_allclose(got2, want, atol=2e-3)
+        # the invalid override must have fallen back to the automatic
+        # choice, never reaching pallas_call (the kernel has no masking)
+        auto = ff._pick_blocks(256, 256, 512, 4)
+        assert seen[-1] == auto and auto != (96, 640)
+    finally:
+        ff._fused_ffn_tpu = real_tpu
+        ff.set_default_blocks(None)

@@ -207,6 +207,78 @@ def check_fused_ffn(results):
                                 "pallas_ms": tp * 1e3, "xla_ms": tr * 1e3}
 
 
+def check_fused_ffn_bench_shape(results):
+    """Fused FFN at the FLAGSHIP shape (1.3B config: tokens 6*2048 rows,
+    hidden 2048, ffn 8192, bf16) with a tiling sweep — decides whether
+    bench.py flips use_fused_ffn on.
+
+    Times the full VALUE+GRAD step, not the forward alone: fused_ffn's
+    custom vjp recomputes the forward inside the backward, so a forward
+    win can still lose end-to-end (the flash gate learned this in r3).
+    The winning config's FORWARD output is also parity-checked — the
+    installed tiling must be the validated tiling."""
+    from paddle_tpu.ops.pallas import fused_ffn as ff
+    if jax.devices()[0].platform == "cpu":
+        return
+    M, Hd, F = 6 * 2048, 2048, 8192
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(M, Hd) * 0.1, jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(Hd, F) * 0.02, jnp.bfloat16)
+    b1 = jnp.asarray(rng.randn(F) * 0.01, jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(F, Hd) * 0.02, jnp.bfloat16)
+    b2 = jnp.asarray(rng.randn(Hd) * 0.01, jnp.bfloat16)
+
+    def make_step(fn):
+        return jax.jit(jax.grad(
+            lambda x, w1, b1, w2, b2: jnp.sum(
+                fn(x, w1, b1, w2, b2).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 3)))
+
+    tr, _ = timeit(make_step(ff._ref_ffn), x, w1, b1, w2, b2, iters=10)
+    entry = {"xla_ms": tr * 1e3, "blocks": {}}
+    best = best_cfg = None
+    try:
+        for bm in (128, 256, 512):
+            for bf in (512, 256, 1024):
+                if M % bm or F % bf:
+                    continue
+                if _budget_left() < 30:
+                    entry["blocks"][f"{bm}x{bf}"] = "skipped: budget"
+                    continue
+                try:
+                    ff.set_default_blocks((bm, bf))
+                    step = make_step(
+                        lambda *a: ff.fused_ffn(*a, interpret=False))
+                    tp, _ = timeit(step, x, w1, b1, w2, b2, iters=10)
+                    entry["blocks"][f"{bm}x{bf}"] = tp * 1e3
+                    if best is None or tp * 1e3 < best:
+                        best, best_cfg = tp * 1e3, (bm, bf)
+                except Exception as e:              # noqa: BLE001
+                    entry["blocks"][f"{bm}x{bf}"] = (
+                        f"{type(e).__name__}: {e}")
+        parity_ok = False
+        if best_cfg is not None:
+            # parity of the EXACT config the gate would install
+            ff.set_default_blocks(best_cfg)
+            md = maxdiff(ff.fused_ffn(x, w1, b1, w2, b2),
+                         ff._ref_ffn(x, w1, b1, w2, b2))
+            entry["best_maxdiff"] = md
+            parity_ok = md < 3e-2
+    finally:
+        ff.set_default_blocks(None)
+    entry["best_ms"] = best
+    entry["best_blocks"] = best_cfg
+    starved = any(str(v).startswith("skipped: budget")
+                  for v in entry["blocks"].values())
+    entry["budget_starved"] = starved
+    if starved and best is None:
+        entry["pallas_beats_xla"] = None
+    else:
+        entry["pallas_beats_xla"] = bool(
+            best is not None and best < entry["xla_ms"] and parity_ok)
+    results["fused_ffn_bench_shape"] = entry
+
+
 def check_norms(results):
     from paddle_tpu.ops.pallas import norms
     M, Hd = 4096, 1024
@@ -255,8 +327,8 @@ def main():
     # use_flash gate) and the artifact is rewritten after EVERY check —
     # if the orchestrator SIGKILLs us mid-run, the completed checks
     # survive on disk instead of vanishing with the process.
-    for check in (check_flash_bench_shape, check_flash_attention,
-                  check_fused_ffn, check_norms):
+    for check in (check_flash_bench_shape, check_fused_ffn_bench_shape,
+                  check_flash_attention, check_fused_ffn, check_norms):
         try:
             check(results)
         except Exception as e:                      # noqa: BLE001
