@@ -1,0 +1,164 @@
+"""RNN stack vs torch-cpu goldens.
+
+The gate layouts are identical to torch's (LSTM {i,f,g,o}, GRU {r,z,n},
+SimpleRNN single-gate), so torch module weights copy verbatim into the
+matching paddle cells — a strong external oracle for the whole
+lax.scan-based recurrence stack (cells, multi-layer stacking,
+bidirectional concat, final-state packing)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+
+def _copy_cell(pcell, tmod, suffix=""):
+    with torch.no_grad():
+        pcell.weight_ih.set_value(
+            np.asarray(getattr(tmod, f"weight_ih{suffix}").numpy()))
+        pcell.weight_hh.set_value(
+            np.asarray(getattr(tmod, f"weight_hh{suffix}").numpy()))
+        pcell.bias_ih.set_value(
+            np.asarray(getattr(tmod, f"bias_ih{suffix}").numpy()))
+        pcell.bias_hh.set_value(
+            np.asarray(getattr(tmod, f"bias_hh{suffix}").numpy()))
+
+
+class TestCellsVsTorch:
+    def test_lstm_cell(self):
+        tc = torch.nn.LSTMCell(5, 7)
+        pc = nn.LSTMCell(5, 7)
+        _copy_cell(pc, tc)
+        x = np.random.RandomState(0).randn(3, 5).astype("float32")
+        h0 = np.random.RandomState(1).randn(3, 7).astype("float32")
+        c0 = np.random.RandomState(2).randn(3, 7).astype("float32")
+        th, tc_ = tc(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+        ph, (ph2, pc2) = pc(paddle.to_tensor(x),
+                            (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        np.testing.assert_allclose(ph.numpy(), th.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(pc2.numpy(), tc_.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_gru_cell(self):
+        tc = torch.nn.GRUCell(4, 6)
+        pc = nn.GRUCell(4, 6)
+        _copy_cell(pc, tc)
+        x = np.random.RandomState(3).randn(2, 4).astype("float32")
+        h0 = np.random.RandomState(4).randn(2, 6).astype("float32")
+        th = tc(torch.tensor(x), torch.tensor(h0))
+        ph, _ = pc(paddle.to_tensor(x), paddle.to_tensor(h0))
+        np.testing.assert_allclose(ph.numpy(), th.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_simple_rnn_cell(self):
+        tc = torch.nn.RNNCell(4, 6, nonlinearity="tanh")
+        pc = nn.SimpleRNNCell(4, 6, activation="tanh")
+        _copy_cell(pc, tc)
+        x = np.random.RandomState(5).randn(2, 4).astype("float32")
+        h0 = np.random.RandomState(6).randn(2, 6).astype("float32")
+        th = tc(torch.tensor(x), torch.tensor(h0))
+        ph, _ = pc(paddle.to_tensor(x), paddle.to_tensor(h0))
+        np.testing.assert_allclose(ph.numpy(), th.detach().numpy(),
+                                   atol=1e-5)
+
+
+def _copy_rnn(player, tmod, num_layers, bidirectional, mode):
+    """Copy torch RNN module weights into the paddle layer's cells."""
+    for li in range(num_layers):
+        wrap = player.layer_list[li]
+        if bidirectional:
+            _copy_cell(wrap.cell_fw, tmod, f"_l{li}")
+            _copy_cell(wrap.cell_bw, tmod, f"_l{li}_reverse")
+        else:
+            _copy_cell(wrap.cell, tmod, f"_l{li}")
+
+
+@pytest.mark.parametrize("mode", ["LSTM", "GRU", "RNN"])
+@pytest.mark.parametrize("layers,bidi", [(1, False), (2, False), (2, True)])
+def test_full_rnn_vs_torch(mode, layers, bidi):
+    B, T, I, H = 3, 6, 5, 8
+    tcls = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+            "RNN": torch.nn.RNN}[mode]
+    tmod = tcls(I, H, num_layers=layers, bidirectional=bidi,
+                batch_first=True)
+    pcls = {"LSTM": nn.LSTM, "GRU": nn.GRU, "RNN": nn.SimpleRNN}[mode]
+    pmod = pcls(I, H, num_layers=layers,
+                direction="bidirect" if bidi else "forward")
+    _copy_rnn(pmod, tmod, layers, bidi, mode)
+
+    x = np.random.RandomState(7).randn(B, T, I).astype("float32")
+    tout, tfin = tmod(torch.tensor(x))
+    pout, pfin = pmod(paddle.to_tensor(x))
+    np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                               atol=2e-5)
+    # final states: torch h is [layers*dirs, B, H]
+    if mode == "LSTM":
+        th, tc_ = tfin
+        ph, pc_ = pfin
+        np.testing.assert_allclose(ph.numpy(), th.detach().numpy(),
+                                   atol=2e-5)
+        np.testing.assert_allclose(pc_.numpy(), tc_.detach().numpy(),
+                                   atol=2e-5)
+    else:
+        np.testing.assert_allclose(pfin.numpy(), tfin.detach().numpy(),
+                                   atol=2e-5)
+
+
+class TestTransformerVsTorch:
+    """MultiHeadAttention / TransformerEncoderLayer vs torch-cpu: torch's
+    packed in_proj [3E, E] splits into paddle's q/k/v projections (paddle
+    Linear stores [in, out] — transpose)."""
+
+    def _copy_mha(self, pmha, tmha, E):
+        with torch.no_grad():
+            wq, wk, wv = tmha.in_proj_weight.numpy().reshape(3, E, E)
+            bq, bk, bv = tmha.in_proj_bias.numpy().reshape(3, E)
+            pmha.q_proj.weight.set_value(wq.T.copy())
+            pmha.k_proj.weight.set_value(wk.T.copy())
+            pmha.v_proj.weight.set_value(wv.T.copy())
+            pmha.q_proj.bias.set_value(bq.copy())
+            pmha.k_proj.bias.set_value(bk.copy())
+            pmha.v_proj.bias.set_value(bv.copy())
+            pmha.out_proj.weight.set_value(
+                tmha.out_proj.weight.numpy().T.copy())
+            pmha.out_proj.bias.set_value(tmha.out_proj.bias.numpy().copy())
+
+    def test_multi_head_attention(self):
+        E, H, B, T = 16, 4, 2, 5
+        tmha = torch.nn.MultiheadAttention(E, H, batch_first=True)
+        pmha = nn.MultiHeadAttention(E, H)
+        self._copy_mha(pmha, tmha, E)
+        x = np.random.RandomState(0).randn(B, T, E).astype("float32")
+        tout, _ = tmha(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+        pout = pmha(paddle.to_tensor(x), paddle.to_tensor(x),
+                    paddle.to_tensor(x))
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=2e-5)
+
+    def test_encoder_layer(self):
+        E, H, F, B, T = 16, 4, 32, 2, 5
+        tl = torch.nn.TransformerEncoderLayer(
+            E, H, dim_feedforward=F, dropout=0.0, activation="relu",
+            batch_first=True)
+        tl.eval()
+        pl_ = nn.TransformerEncoderLayer(E, H, F, dropout=0.0,
+                                         activation="relu")
+        pl_.eval()
+        self._copy_mha(pl_.self_attn, tl.self_attn, E)
+        with torch.no_grad():
+            pl_.linear1.weight.set_value(tl.linear1.weight.numpy().T.copy())
+            pl_.linear1.bias.set_value(tl.linear1.bias.numpy().copy())
+            pl_.linear2.weight.set_value(tl.linear2.weight.numpy().T.copy())
+            pl_.linear2.bias.set_value(tl.linear2.bias.numpy().copy())
+            pl_.norm1.weight.set_value(tl.norm1.weight.numpy().copy())
+            pl_.norm1.bias.set_value(tl.norm1.bias.numpy().copy())
+            pl_.norm2.weight.set_value(tl.norm2.weight.numpy().copy())
+            pl_.norm2.bias.set_value(tl.norm2.bias.numpy().copy())
+        x = np.random.RandomState(1).randn(B, T, E).astype("float32")
+        tout = tl(torch.tensor(x))
+        pout = pl_(paddle.to_tensor(x))
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=3e-5)

@@ -220,6 +220,12 @@ def check_fused_ffn_bench_shape(results):
     from paddle_tpu.ops.pallas import fused_ffn as ff
     if jax.devices()[0].platform == "cpu":
         return
+    if _budget_left() < 60:
+        # no sweep budget: don't burn SIGKILL-bounded time compiling the
+        # XLA baseline for a verdict that would be null anyway
+        results["fused_ffn_bench_shape"] = {
+            "budget_starved": True, "pallas_beats_xla": None}
+        return
     M, Hd, F = 6 * 2048, 2048, 8192
     rng = np.random.RandomState(5)
     x = jnp.asarray(rng.randn(M, Hd) * 0.1, jnp.bfloat16)
