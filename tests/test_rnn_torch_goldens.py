@@ -65,7 +65,7 @@ class TestCellsVsTorch:
                                    atol=1e-5)
 
 
-def _copy_rnn(player, tmod, num_layers, bidirectional, mode):
+def _copy_rnn(player, tmod, num_layers, bidirectional):
     """Copy torch RNN module weights into the paddle layer's cells."""
     for li in range(num_layers):
         wrap = player.layer_list[li]
@@ -87,7 +87,7 @@ def test_full_rnn_vs_torch(mode, layers, bidi):
     pcls = {"LSTM": nn.LSTM, "GRU": nn.GRU, "RNN": nn.SimpleRNN}[mode]
     pmod = pcls(I, H, num_layers=layers,
                 direction="bidirect" if bidi else "forward")
-    _copy_rnn(pmod, tmod, layers, bidi, mode)
+    _copy_rnn(pmod, tmod, layers, bidi)
 
     x = np.random.RandomState(7).randn(B, T, I).astype("float32")
     tout, tfin = tmod(torch.tensor(x))
@@ -162,3 +162,153 @@ class TestTransformerVsTorch:
         pout = pl_(paddle.to_tensor(x))
         np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
                                    atol=3e-5)
+
+
+class TestCTCLossVsTorch:
+    """paddle ctc_loss takes LOGITS (log_softmax applied internally);
+    torch takes log-probs — composing torch's with log_softmax gives the
+    same function, values AND gradients."""
+
+    def _case(self, reduction, T=12, B=3, C=6, L=4):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(11)
+        logits = rng.randn(T, B, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int32")
+        in_len = np.array([T, T - 2, T - 5], "int64")
+        lab_len = np.array([L, L - 1, 2], "int64")
+
+        tl = torch.tensor(logits, requires_grad=True)
+        tloss = torch.nn.functional.ctc_loss(
+            torch.log_softmax(tl, dim=-1), torch.tensor(labels.astype("int64")),
+            torch.tensor(in_len), torch.tensor(lab_len),
+            blank=0, reduction=reduction, zero_infinity=False)
+        tloss.sum().backward()
+
+        pl_ = paddle.to_tensor(logits)
+        pl_.stop_gradient = False
+        ploss = F.ctc_loss(pl_, paddle.to_tensor(labels),
+                           paddle.to_tensor(in_len),
+                           paddle.to_tensor(lab_len), blank=0,
+                           reduction=reduction)
+        ploss.sum().backward()
+        np.testing.assert_allclose(np.asarray(ploss.numpy()).ravel(),
+                                   tloss.detach().numpy().ravel(),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(pl_.grad.numpy()),
+                                   tl.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_mean(self):
+        self._case("mean")
+
+    def test_sum_and_none(self):
+        self._case("sum")
+        self._case("none")
+
+
+class TestLossFamilyVsTorch:
+    """The intricate losses vs torch (values + input grads where the
+    semantics align 1:1)."""
+
+    def _both(self, pf, tf, *shapes, seed=0, grad_idx=0, **kw):
+        rng = np.random.RandomState(seed)
+        arrs = [rng.randn(*s).astype("float32") for s in shapes]
+        tts = [torch.tensor(a, requires_grad=(i == grad_idx))
+               for i, a in enumerate(arrs)]
+        pts = []
+        for i, a in enumerate(arrs):
+            t = paddle.to_tensor(a)
+            t.stop_gradient = i != grad_idx
+            pts.append(t)
+        tl = tf(*tts, **kw)
+        tl.sum().backward()
+        pl_ = pf(*pts, **kw)
+        pl_.sum().backward()
+        np.testing.assert_allclose(np.asarray(pl_.numpy()).ravel(),
+                                   tl.detach().numpy().ravel(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pts[grad_idx].grad.numpy()),
+                                   tts[grad_idx].grad.numpy(),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_smooth_l1(self):
+        import paddle_tpu.nn.functional as F
+        # paddle smooth_l1_loss(delta) == torch (beta) for delta=1
+        self._both(F.smooth_l1_loss,
+                   torch.nn.functional.smooth_l1_loss,
+                   (4, 5), (4, 5))
+
+    def test_kl_div(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        logp = np.log(rng.dirichlet(np.ones(5), size=4)).astype("float32")
+        tgt = rng.dirichlet(np.ones(5), size=4).astype("float32")
+        tin = torch.tensor(logp, requires_grad=True)
+        tl = torch.nn.functional.kl_div(tin, torch.tensor(tgt),
+                                        reduction="mean")
+        tl.backward()
+        pin = paddle.to_tensor(logp)
+        pin.stop_gradient = False
+        pl_ = F.kl_div(pin, paddle.to_tensor(tgt), reduction="mean")
+        pl_.backward()
+        np.testing.assert_allclose(float(pl_.numpy()),
+                                   float(tl.detach()), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(pin.grad.numpy()),
+                                   tin.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_margin_ranking(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(3)
+        a = rng.randn(6).astype("float32")
+        b = rng.randn(6).astype("float32")
+        y = np.sign(rng.randn(6)).astype("float32")
+        ta = torch.tensor(a, requires_grad=True)
+        tl = torch.nn.functional.margin_ranking_loss(
+            ta, torch.tensor(b), torch.tensor(y), margin=0.3)
+        tl.backward()
+        pa = paddle.to_tensor(a)
+        pa.stop_gradient = False
+        pl_ = F.margin_ranking_loss(pa, paddle.to_tensor(b),
+                                    paddle.to_tensor(y), margin=0.3)
+        pl_.backward()
+        np.testing.assert_allclose(float(pl_.numpy()), float(tl.detach()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(pa.grad.numpy()),
+                                   ta.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_bce_with_logits(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(4)
+        x = rng.randn(4, 3).astype("float32")
+        y = (rng.rand(4, 3) > 0.5).astype("float32")
+        w = rng.rand(3).astype("float32") + 0.5
+        tx = torch.tensor(x, requires_grad=True)
+        tl = torch.nn.functional.binary_cross_entropy_with_logits(
+            tx, torch.tensor(y), pos_weight=torch.tensor(w))
+        tl.backward()
+        px = paddle.to_tensor(x)
+        px.stop_gradient = False
+        pl_ = F.binary_cross_entropy_with_logits(
+            px, paddle.to_tensor(y), pos_weight=paddle.to_tensor(w))
+        pl_.backward()
+        np.testing.assert_allclose(float(pl_.numpy()), float(tl.detach()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                                   tx.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_nll_2d(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(5)
+        x = rng.randn(3, 5, 4, 4).astype("float32")
+        logp = torch.log_softmax(torch.tensor(x), dim=1).numpy()
+        y = rng.randint(0, 5, (3, 4, 4)).astype("int64")
+        tin = torch.tensor(logp, requires_grad=True)
+        tl = torch.nn.functional.nll_loss(tin, torch.tensor(y))
+        tl.backward()
+        pin = paddle.to_tensor(logp)
+        pin.stop_gradient = False
+        pl_ = F.nll_loss(pin, paddle.to_tensor(y))
+        pl_.backward()
+        np.testing.assert_allclose(float(pl_.numpy()), float(tl.detach()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(pin.grad.numpy()),
+                                   tin.grad.numpy(), rtol=1e-4, atol=1e-6)
