@@ -1,0 +1,76 @@
+"""Mask R-CNN training targets, end to end.
+
+The reference's two-stage target pipeline (ref fluid/layers/detection.py:
+generate_proposal_labels :2596 -> generate_mask_labels :2748) on the
+TPU-native stack: RPN proposals are sampled into fg/bg RoIs with box
+targets (fixed-shape device op), then the fg RoIs get class-specific
+M x M binary mask targets rasterized host-side with COCO RLE parity —
+and a tiny mask head consumes them to show the shapes line up for the
+loss.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/mask_rcnn_targets.py
+"""
+import numpy as np
+
+import paddle
+import paddle.nn.functional as F
+from paddle.fluid import layers
+
+B, G, N, K, M = 1, 2, 8, 3, 14      # images, gts, proposals, classes, res
+
+# ground truth: two boxes with rectangle polygons (class 1 and 2)
+gt_boxes = np.array([[[10, 10, 60, 60], [70, 20, 120, 90]]], "float32")
+gt_classes = np.array([[1, 2]], "int64")
+is_crowd = np.array([[0, 0]], "int64")
+im_info = np.array([[128.0, 128.0, 1.0]], "float32")
+rect = lambda x0, y0, x1, y1: [x0, y0, x1, y0, x1, y1, x0, y1]  # noqa: E731
+gt_polys = [[[rect(10, 10, 60, 60)], [rect(70, 20, 120, 90)]]]
+
+# noisy RPN proposals around the gts + background
+rng = np.random.RandomState(0)
+props = np.concatenate([
+    gt_boxes[0] + rng.randn(2, 4) * 2.0,
+    rng.rand(N - 2, 4) * 40 + np.array([0, 0, 20, 20]),
+]).astype("float32")[None]
+
+# stage 1: sample fg/bg RoIs + box-regression targets (device op)
+rois, labels, btgt, bin_w, bout_w = layers.generate_proposal_labels(
+    paddle.to_tensor(props), paddle.to_tensor(gt_classes),
+    paddle.to_tensor(is_crowd), paddle.to_tensor(gt_boxes),
+    paddle.to_tensor(im_info), batch_size_per_im=8, fg_fraction=0.5,
+    fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=K)
+rois_np = np.asarray(rois.numpy())[0]
+labels_np = np.asarray(labels.numpy())[0]
+n_fg = int((labels_np > 0).sum())
+print(f"sampled RoIs: {rois_np.shape[0]} rows, {n_fg} foreground")
+
+# stage 2: mask targets for the fg RoIs (host-side rasterizer)
+mask_rois, roi_has_mask, mask_int32, lod = layers.generate_mask_labels(
+    im_info=im_info, gt_classes=[gt_classes[0]], is_crowd=[is_crowd[0]],
+    gt_segms=gt_polys, rois=[rois_np], labels_int32=[labels_np],
+    num_classes=K, resolution=M)
+print(f"mask targets: {mask_int32.shape} (P x K*M*M), lod={lod.tolist()}")
+assert mask_rois.shape[0] == n_fg
+
+# a tiny mask head consuming the targets: per-class M x M logits
+P = mask_rois.shape[0]
+feat = paddle.to_tensor(rng.randn(P, 16).astype("float32"))
+head = paddle.nn.Linear(16, K * M * M)
+logits = head(feat)
+targets = paddle.to_tensor(mask_int32.astype("float32"))
+valid = paddle.to_tensor((mask_int32 >= 0).astype("float32"))
+loss = (F.binary_cross_entropy_with_logits(
+    logits, paddle.clip(targets, 0.0, 1.0), reduction="none")
+    * valid).sum() / valid.sum()
+print(f"mask head loss over {int(np.asarray(valid.numpy()).sum())} "
+      f"supervised cells: {float(loss.numpy()):.4f}")
+assert np.isfinite(float(loss.numpy()))
+
+# sanity: each fg target's own-class slice has real mask pixels
+m = mask_int32.reshape(P, K, M, M)
+for p in range(P):
+    own = [c for c in range(1, K)
+           if not (m[p, c] == -1).all()]
+    assert len(own) == 1, "exactly one supervised class slice per fg roi"
+    assert m[p, own[0]].sum() > 0, "mask has foreground pixels"
+print("Mask R-CNN target pipeline on the TPU-native core: OK")
