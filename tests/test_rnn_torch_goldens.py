@@ -365,3 +365,138 @@ class TestOptimizersVsTorch:
         np.testing.assert_allclose(np.asarray(pw.numpy()),
                                    tw.detach().numpy(), rtol=2e-5,
                                    atol=2e-6)
+
+
+class TestLRSchedulersVsTorch:
+    """LR schedules vs torch.optim.lr_scheduler over 25 epochs."""
+
+    def _run(self, psched, tsched_factory, epochs=25, metric=None):
+        tw = torch.tensor([1.0], requires_grad=True)
+        topt = torch.optim.SGD([tw], lr=psched.base_lr)
+        tsched = tsched_factory(topt)
+        ours, theirs = [], []
+        for ep in range(epochs):
+            ours.append(float(psched()))
+            theirs.append(topt.param_groups[0]["lr"])
+            if metric is not None:
+                psched.step(metrics=metric[ep])
+                tsched.step(metric[ep])
+            else:
+                psched.step()
+                tsched.step()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+
+    def test_step_decay(self):
+        import paddle_tpu.optimizer.lr as lr
+        self._run(lr.StepDecay(learning_rate=0.1, step_size=7, gamma=0.5),
+                  lambda o: torch.optim.lr_scheduler.StepLR(
+                      o, step_size=7, gamma=0.5))
+
+    def test_multistep_decay(self):
+        import paddle_tpu.optimizer.lr as lr
+        self._run(lr.MultiStepDecay(learning_rate=0.1,
+                                    milestones=[5, 9, 20], gamma=0.3),
+                  lambda o: torch.optim.lr_scheduler.MultiStepLR(
+                      o, milestones=[5, 9, 20], gamma=0.3))
+
+    def test_exponential_decay(self):
+        import paddle_tpu.optimizer.lr as lr
+        self._run(lr.ExponentialDecay(learning_rate=0.1, gamma=0.9),
+                  lambda o: torch.optim.lr_scheduler.ExponentialLR(
+                      o, gamma=0.9))
+
+    def test_cosine_annealing(self):
+        import paddle_tpu.optimizer.lr as lr
+        self._run(lr.CosineAnnealingDecay(learning_rate=0.1, T_max=10),
+                  lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(
+                      o, T_max=10))
+
+    def test_reduce_on_plateau(self):
+        import paddle_tpu.optimizer.lr as lr
+        metric = [3.0, 2.5, 2.4, 2.4, 2.4, 2.4, 2.4, 2.39, 2.39, 2.39,
+                  2.39, 2.39, 2.39, 2.38, 2.0, 1.5, 1.5, 1.5, 1.5, 1.5,
+                  1.5, 1.5, 1.5, 1.5, 1.5]
+        self._run(lr.ReduceOnPlateau(learning_rate=0.1, factor=0.5,
+                                     patience=3, threshold=1e-3),
+                  lambda o: torch.optim.lr_scheduler.ReduceLROnPlateau(
+                      o, factor=0.5, patience=3, threshold=1e-3),
+                  metric=metric)
+
+
+class TestConvGradsVsTorch:
+    """conv2d / conv2d_transpose input+weight gradients vs torch."""
+
+    @pytest.mark.parametrize("stride,padding,groups", [
+        (1, 0, 1), (2, 1, 1), (1, 2, 2)])
+    def test_conv2d_grads(self, stride, padding, groups):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 9, 9).astype("float32")
+        w = rng.randn(6, 4 // groups, 3, 3).astype("float32")
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        tout = torch.nn.functional.conv2d(tx, tw, stride=stride,
+                                          padding=padding, groups=groups)
+        tout.square().sum().backward()
+        px = paddle.to_tensor(x)
+        pw = paddle.to_tensor(w)
+        px.stop_gradient = pw.stop_gradient = False
+        pout = F.conv2d(px, pw, stride=stride, padding=padding,
+                        groups=groups)
+        (pout.square()).sum().backward()
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                                   tx.grad.numpy(), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(pw.grad.numpy()),
+                                   tw.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+    def test_conv2d_transpose_grads(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4, 7, 7).astype("float32")
+        w = rng.randn(4, 5, 3, 3).astype("float32")   # [in, out, kh, kw]
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        tout = torch.nn.functional.conv_transpose2d(tx, tw, stride=2,
+                                                    padding=1)
+        tout.square().sum().backward()
+        px = paddle.to_tensor(x)
+        pw = paddle.to_tensor(w)
+        px.stop_gradient = pw.stop_gradient = False
+        pout = F.conv2d_transpose(px, pw, stride=2, padding=1)
+        pout.square().sum().backward()
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                                   tx.grad.numpy(), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(pw.grad.numpy()),
+                                   tw.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_embedding_padding_idx_grad_vs_torch():
+    """Paddle's embedding ZEROES the padded OUTPUT rows (ref
+    nn/functional/input.py:153 'output all-zero padding data'), unlike
+    torch which returns the stored row — so compare non-padding rows to
+    torch and assert the paddle zero-output/zero-grad contract on the
+    padding id."""
+    rng = np.random.RandomState(2)
+    w = rng.randn(10, 4).astype("float32")
+    ids = np.array([[1, 0, 3], [0, 2, 9]], "int64")
+    tw = torch.tensor(w, requires_grad=True)
+    tout = torch.nn.functional.embedding(torch.tensor(ids), tw,
+                                         padding_idx=0)
+    tout.square().sum().backward()
+    pw = paddle.to_tensor(w)
+    pw.stop_gradient = False
+    import paddle_tpu.nn.functional as F
+    pout = F.embedding(paddle.to_tensor(ids), pw, padding_idx=0)
+    pout.square().sum().backward()
+    pad = ids == 0
+    np.testing.assert_allclose(np.asarray(pout.numpy())[~pad],
+                               tout.detach().numpy()[~pad], atol=1e-5)
+    assert (np.asarray(pout.numpy())[pad] == 0).all()   # paddle contract
+    pg = np.asarray(pw.grad.numpy())
+    np.testing.assert_allclose(pg[1:], tw.grad.numpy()[1:],
+                               rtol=1e-4, atol=1e-5)
+    assert (pg[0] == 0).all()        # padding row never updates
