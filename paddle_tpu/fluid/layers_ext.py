@@ -323,9 +323,12 @@ def image_resize(input, out_shape=None, scale=None, name=None,
                  resample="BILINEAR", actual_shape=None, align_corners=True,
                  align_mode=1, data_format="NCHW"):
     mode = resample.lower()
+    # fluid defaults align_mode=1 (asymmetric dst*scale coords when
+    # align_corners=False) — forward it so the legacy kernels' values
+    # reproduce, not the 2.x half-pixel convention
     return F.interpolate(input, size=out_shape, scale_factor=scale,
                          mode=mode, align_corners=align_corners,
-                         data_format=data_format)
+                         align_mode=align_mode, data_format=data_format)
 
 
 def resize_bilinear(input, out_shape=None, scale=None, **kw):

@@ -102,10 +102,113 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
 
 
+def _interp_coords(s_in, s_out, align_corners, align_mode, cubic=False):
+    """Fractional source coordinate per output index, matching the
+    reference conventions: align_corners linspace; else half-pixel
+    (align_mode=0, the 2.x default, == torch) or asymmetric dst*scale
+    (align_mode=1, the fluid legacy).  The half-pixel coordinate clamps
+    at 0 for linear but NOT for cubic — the cubic kernel handles
+    negative coords via its border-replicated taps (same rule as the
+    reference kernels)."""
+    if align_corners:
+        if s_out == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.linspace(0.0, s_in - 1, s_out)
+    scale = s_in / s_out
+    if align_mode == 1 and not cubic:
+        # fluid-legacy asymmetric coords — the reference bicubic kernel
+        # branches only on align_corners and ignores align_mode
+        return jnp.arange(s_out, dtype=jnp.float32) * scale
+    x = (jnp.arange(s_out, dtype=jnp.float32) + 0.5) * scale - 0.5
+    return x if cubic else jnp.maximum(x, 0.0)
+
+
+def _resize_axis(out, ax, s_out, mode, align_corners, align_mode):
+    """Separable per-axis resize as explicit gathers (NOT
+    jax.image.resize, whose default antialiasing on downscale and
+    half-pixel 'nearest' both diverge from the reference kernels)."""
+    s_in = out.shape[ax]
+    if s_in == s_out:
+        return out
+
+    def bcast(w):
+        shape = [1] * out.ndim
+        shape[ax] = s_out
+        return w.reshape(shape).astype(jnp.float32)
+
+    if mode == "nearest":
+        if align_corners:
+            # round-half-UP, the reference's static_cast<int>(x + 0.5)
+            # (jnp.round would round half to even)
+            idx = jnp.floor(jnp.linspace(0.0, s_in - 1, max(s_out, 1))
+                            + 0.5)
+        else:
+            # floor(dst * scale): the reference/torch 'nearest' kernel
+            idx = jnp.floor(jnp.arange(s_out) * (s_in / s_out))
+        return jnp.take(out, jnp.clip(idx, 0, s_in - 1).astype(jnp.int32),
+                        axis=ax)
+
+    if mode == "area":
+        # adaptive-average boundaries: [floor(i*in/out), ceil((i+1)*in/out))
+        i = jnp.arange(s_out)
+        start = jnp.floor(i * s_in / s_out).astype(jnp.int32)
+        end = jnp.ceil((i + 1) * s_in / s_out).astype(jnp.int32)
+        if s_in * s_out <= 1 << 22:
+            # membership matmul: direct per-region summation (exact
+            # f32 accumulation, MXU-friendly); boundaries may overlap
+            # by one element, which a segment-sum could not express
+            j = jnp.arange(s_in)
+            member = ((j[None, :] >= start[:, None])
+                      & (j[None, :] < end[:, None])).astype(jnp.float32)
+            total = jnp.moveaxis(
+                jnp.tensordot(member, out.astype(jnp.float32),
+                              axes=([1], [ax])), 0, ax)
+        else:
+            # huge axes: cumsum difference (documented precision trade)
+            csum = jnp.cumsum(out.astype(jnp.float32), axis=ax)
+            zero = jnp.zeros_like(jnp.take(csum, jnp.array([0]), axis=ax))
+            csum = jnp.concatenate([zero, csum], axis=ax)
+            total = (jnp.take(csum, end, axis=ax)
+                     - jnp.take(csum, start, axis=ax))
+        return total / bcast((end - start).astype(jnp.float32))
+
+    x = _interp_coords(s_in, s_out, align_corners, align_mode,
+                       cubic=(mode == "cubic"))
+    if mode == "linear":
+        lo = jnp.clip(jnp.floor(x), 0, s_in - 1).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, s_in - 1)
+        w = bcast(x - lo)
+        return (jnp.take(out, lo, axis=ax).astype(jnp.float32) * (1 - w)
+                + jnp.take(out, hi, axis=ax).astype(jnp.float32) * w)
+
+    # cubic: 4-tap Keys kernel with a=-0.75 (the reference/torch bicubic
+    # coefficient), border-replicated taps
+    a_ = -0.75
+
+    def kern(d):
+        d = jnp.abs(d)
+        return jnp.where(
+            d <= 1, (a_ + 2) * d ** 3 - (a_ + 3) * d ** 2 + 1,
+            jnp.where(d < 2,
+                      a_ * d ** 3 - 5 * a_ * d ** 2 + 8 * a_ * d - 4 * a_,
+                      0.0))
+
+    x0 = jnp.floor(x)
+    t = x - x0
+    acc = None
+    for off in (-1, 0, 1, 2):
+        idx = jnp.clip(x0 + off, 0, s_in - 1).astype(jnp.int32)
+        w = bcast(kern(t - off))
+        term = jnp.take(out, idx, axis=ax).astype(jnp.float32) * w
+        acc = term if acc is None else acc + term
+    return acc
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
     mode = mode.lower()
+
     def _interp(a):
         cf = data_format.startswith("NC")
         spatial_in = a.shape[2:] if cf else a.shape[1:-1]
@@ -117,34 +220,14 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
                 else [scale_factor] * len(spatial_in)
             sz = [int(s * f) for s, f in zip(spatial_in, sf)]
-        if cf:
-            out_shape = a.shape[:2] + tuple(sz)
-        else:
-            out_shape = (a.shape[0],) + tuple(sz) + (a.shape[-1],)
-        jmode = {"nearest": "nearest", "bilinear": "linear",
-                 "trilinear": "linear", "linear": "linear",
-                 "bicubic": "cubic", "area": "linear"}[mode]
-        if jmode == "nearest" or not align_corners:
-            return jax.image.resize(a, out_shape, method=jmode).astype(a.dtype)
-        # align_corners=True linear: gather-based implementation
+        base = {"nearest": "nearest", "bilinear": "linear",
+                "trilinear": "linear", "linear": "linear",
+                "bicubic": "cubic", "area": "area"}[mode]
         out = a
         sp_axes = list(range(2, a.ndim)) if cf else list(range(1, a.ndim - 1))
         for ax, s_out in zip(sp_axes, sz):
-            s_in = out.shape[ax]
-            if s_out == s_in:
-                continue
-            if s_out == 1 or s_in == 1:
-                idx = jnp.zeros(s_out)
-            else:
-                idx = jnp.linspace(0.0, s_in - 1, s_out)
-            lo = jnp.floor(idx).astype(jnp.int32)
-            hi = jnp.minimum(lo + 1, s_in - 1)
-            w = (idx - lo).astype(a.dtype)
-            shape = [1] * out.ndim
-            shape[ax] = s_out
-            w = w.reshape(shape)
-            out = (jnp.take(out, lo, axis=ax) * (1 - w)
-                   + jnp.take(out, hi, axis=ax) * w)
+            out = _resize_axis(out, ax, int(s_out), base, align_corners,
+                               align_mode)
         return out.astype(a.dtype)
     return call(_interp, x, _name="interpolate")
 

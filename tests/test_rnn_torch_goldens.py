@@ -500,3 +500,88 @@ def test_embedding_padding_idx_grad_vs_torch():
     np.testing.assert_allclose(pg[1:], tw.grad.numpy()[1:],
                                rtol=1e-4, atol=1e-5)
     assert (pg[0] == 0).all()        # padding row never updates
+
+
+class TestInterpolateVsTorch:
+    """F.interpolate across modes/align_corners — the classic
+    divergence minefield (pixel-center conventions)."""
+
+    @pytest.mark.parametrize("mode,ac", [
+        ("nearest", None), ("bilinear", False), ("bilinear", True),
+        ("bicubic", False), ("bicubic", True), ("area", None)])
+    def test_2d_size(self, mode, ac):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(0).randn(2, 3, 7, 9).astype("float32")
+        kw = {} if ac is None else {"align_corners": ac}
+        tout = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(13, 5), mode=mode, **kw)
+        pout = F.interpolate(paddle.to_tensor(x), size=[13, 5],
+                             mode=mode, **kw)
+        np.testing.assert_allclose(pout.numpy(), tout.numpy(), atol=2e-5)
+
+    @pytest.mark.parametrize("mode,ac", [
+        ("nearest", None), ("bilinear", False), ("bilinear", True)])
+    def test_2d_scale_factor(self, mode, ac):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(1).randn(1, 2, 6, 6).astype("float32")
+        kw = {} if ac is None else {"align_corners": ac}
+        tout = torch.nn.functional.interpolate(
+            torch.tensor(x), scale_factor=2.0, mode=mode, **kw)
+        pout = F.interpolate(paddle.to_tensor(x), scale_factor=2.0,
+                             mode=mode, **kw)
+        np.testing.assert_allclose(pout.numpy(), tout.numpy(), atol=2e-5)
+
+    @pytest.mark.parametrize("mode,ac", [
+        ("linear", False), ("linear", True)])
+    def test_1d(self, mode, ac):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(2).randn(2, 3, 11).astype("float32")
+        tout = torch.nn.functional.interpolate(
+            torch.tensor(x), size=7, mode=mode, align_corners=ac)
+        pout = F.interpolate(paddle.to_tensor(x), size=[7], mode=mode,
+                             align_corners=ac,
+                             data_format="NCW")
+        np.testing.assert_allclose(pout.numpy(), tout.numpy(), atol=2e-5)
+
+    @pytest.mark.parametrize("ac", [False, True])
+    def test_3d_trilinear(self, ac):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(3).randn(1, 2, 4, 5, 6).astype(
+            "float32")
+        tout = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(7, 3, 8), mode="trilinear",
+            align_corners=ac)
+        pout = F.interpolate(paddle.to_tensor(x), size=[7, 3, 8],
+                             mode="trilinear", align_corners=ac,
+                             data_format="NCDHW")
+        np.testing.assert_allclose(pout.numpy(), tout.numpy(), atol=2e-5)
+
+
+def test_interpolate_align_mode_and_nearest_rounding():
+    """fluid-legacy conventions: align_mode=1 asymmetric coords (forwarded
+    by fluid.image_resize), round-half-UP nearest for align_corners."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import fluid
+    x = np.arange(8, dtype="float32").reshape(1, 1, 1, 8)
+    # align_mode=1: src = dst * (8/4) = {0,2,4,6}; weight 0 -> exact picks
+    out = F.interpolate(paddle.to_tensor(x), size=[1, 4], mode="bilinear",
+                        align_corners=False, align_mode=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()).ravel(),
+                               [0, 2, 4, 6])
+    # half-pixel (align_mode=0): src = (dst+0.5)*2-0.5 = {0.5,2.5,4.5,6.5}
+    out0 = F.interpolate(paddle.to_tensor(x), size=[1, 4], mode="bilinear",
+                         align_corners=False, align_mode=0)
+    np.testing.assert_allclose(np.asarray(out0.numpy()).ravel(),
+                               [0.5, 2.5, 4.5, 6.5])
+    # fluid facade forwards its align_mode=1 default
+    fr = fluid.layers.resize_bilinear(paddle.to_tensor(x),
+                                      out_shape=[1, 4],
+                                      align_corners=False)
+    np.testing.assert_allclose(np.asarray(fr.numpy()).ravel(),
+                               [0, 2, 4, 6])
+    # nearest align_corners rounds .5 UP: s_in=6 -> linspace {0,2.5,5}
+    x6 = np.arange(6, dtype="float32").reshape(1, 1, 1, 6)
+    nn_ = F.interpolate(paddle.to_tensor(x6), size=[1, 3], mode="nearest",
+                        align_corners=True)
+    np.testing.assert_allclose(np.asarray(nn_.numpy()).ravel(),
+                               [0, 3, 5])
