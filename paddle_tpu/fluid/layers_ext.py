@@ -37,7 +37,8 @@ from ..nn.functional import (maxout, mish, selu, unfold,  # noqa: F401
                              log_loss, dice_loss, npair_loss,
                              sigmoid_focal_loss,
                              margin_ranking_loss as margin_rank_loss,
-                             local_response_norm as lrn)
+                             local_response_norm as _lrn_avg)
+
 from ..nn.functional.activation import (hardshrink as hard_shrink,  # noqa
                                         softshrink, thresholded_relu)
 from .. import create_parameter  # noqa: F401
@@ -47,6 +48,16 @@ from ..vision.ops import deform_conv2d as deformable_conv  # noqa: F401
 from .reader_compat import (py_reader, create_py_reader_by_data,  # noqa
                             double_buffer, read_file)
 from ..distribution import sampling_id  # noqa: F401
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    """fluid lrn (ref nn.py:6527 / lrn_op): plain channel-window SUM —
+    the 2.x local_response_norm is the avg form, so scale alpha by n to
+    recover sum semantics."""
+    return _lrn_avg(input, size=n, alpha=alpha * n, beta=beta, k=k,
+                    data_format=data_format)
+
 
 sum = _T.sum          # noqa: A001  (fluid.layers.sum is elementwise list-sum)
 size = _T.numel

@@ -150,9 +150,11 @@ def _resize_axis(out, ax, s_out, mode, align_corners, align_mode):
 
     if mode == "area":
         # adaptive-average boundaries: [floor(i*in/out), ceil((i+1)*in/out))
-        i = jnp.arange(s_out)
-        start = jnp.floor(i * s_in / s_out).astype(jnp.int32)
-        end = jnp.ceil((i + 1) * s_in / s_out).astype(jnp.int32)
+        # in EXACT integer arithmetic (float32 i*s_in/s_out loses
+        # exactness past 2^24 and can land bins one element off)
+        i = jnp.arange(s_out, dtype=jnp.int32)
+        start = (i * s_in) // s_out
+        end = -((-(i + 1) * s_in) // s_out)
         if s_in * s_out <= 1 << 22:
             # membership matmul: direct per-region summation (exact
             # f32 accumulation, MXU-friendly); boundaries may overlap

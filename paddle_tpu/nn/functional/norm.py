@@ -159,15 +159,15 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
     def _lrn(a):
         ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
         sq = jnp.square(a)
-        # sum over a window along channel axis
-        pad_lo = (size - 1) // 2
-        pad_hi = size - 1 - pad_lo
+        # AVG over the channel window — the reference zero-pads then
+        # avg-pools (kernel=size, stride=1), i.e. alpha scales sum/size,
+        # with size//2 leading pad (matters for even sizes)
         pads = [(0, 0)] * a.ndim
-        pads[ch_axis] = (pad_lo, pad_hi)
+        pads[ch_axis] = (size // 2, (size - 1) // 2)
         sq = jnp.pad(sq, pads)
         windows = [jax.lax.slice_in_dim(sq, i, i + a.shape[ch_axis],
                                         axis=ch_axis) for i in range(size)]
-        s = sum(windows)
+        s = sum(windows) / size
         return a / jnp.power(k + alpha * s, beta)
     return call(_lrn, x, _name="local_response_norm")
 

@@ -142,21 +142,25 @@ def _adaptive_pool_nd(nd, x, output_size, mode, opname, return_mask=False):
             ax = 2 + i
             osz = out_sz[i] if out_sz[i] is not None else out.shape[ax]
             isz = out.shape[ax]
-            if isz % osz == 0:
+            if mode == "avg":
+                # ONE source of truth with interpolate(mode='area'):
+                # both are adaptive averaging over the same integer bins
+                from .common import _resize_axis
+                out = _resize_axis(out, ax, int(osz), "area",
+                                   False, 0).astype(a.dtype)
+            elif isz % osz == 0:
                 k = isz // osz
                 shape = (out.shape[:ax] + (osz, k) + out.shape[ax + 1:])
                 r = out.reshape(shape)
-                out = (jnp.max(r, axis=ax + 1) if mode == "max"
-                       else jnp.mean(r, axis=ax + 1))
+                out = jnp.max(r, axis=ax + 1)
             else:
-                # general adaptive: per-output-bin start/end (torch formula)
+                # general adaptive max: per-output-bin start/end
                 starts = (np.arange(osz) * isz) // osz
                 ends = -(-((np.arange(osz) + 1) * isz) // osz)
                 slices = [
-                    (jnp.max(jax.lax.slice_in_dim(out, int(st), int(en), axis=ax),
-                             axis=ax, keepdims=True) if mode == "max" else
-                     jnp.mean(jax.lax.slice_in_dim(out, int(st), int(en), axis=ax),
-                              axis=ax, keepdims=True))
+                    jnp.max(jax.lax.slice_in_dim(out, int(st), int(en),
+                                                 axis=ax),
+                            axis=ax, keepdims=True)
                     for st, en in zip(starts, ends)]
                 out = jnp.concatenate(slices, axis=ax)
         return out

@@ -585,3 +585,75 @@ def test_interpolate_align_mode_and_nearest_rounding():
                         align_corners=True)
     np.testing.assert_allclose(np.asarray(nn_.numpy()).ravel(),
                                [0, 3, 5])
+
+
+class TestStructuralOpsVsTorch:
+    """Layout-sensitive ops where index ordering silently diverges."""
+
+    def test_pixel_shuffle(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(0).randn(2, 12, 3, 4).astype("float32")
+        t = torch.nn.functional.pixel_shuffle(torch.tensor(x), 2)
+        p = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+    def test_unfold(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(1).randn(2, 3, 8, 9).astype("float32")
+        t = torch.nn.functional.unfold(torch.tensor(x), (3, 2),
+                                       stride=(2, 1), padding=(1, 0),
+                                       dilation=(1, 2))
+        p = F.unfold(paddle.to_tensor(x), [3, 2], strides=[2, 1],
+                     paddings=[1, 0], dilations=[1, 2])
+        np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("mode", ["reflect", "replicate", "circular"])
+    def test_pad_partial_form(self, mode):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(2).randn(2, 3, 5, 6).astype("float32")
+        # partial form [l, r, t, b]: applies LAST dim first (both APIs)
+        t = torch.nn.functional.pad(torch.tensor(x), (1, 2, 2, 1),
+                                    mode=mode)
+        p = F.pad(paddle.to_tensor(x), [1, 2, 2, 1], mode=mode)
+        np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+    def test_max_pool2d_indices(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(3).randn(2, 3, 6, 8).astype("float32")
+        tv, ti = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, stride=2, return_indices=True)
+        pv, pi = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                              return_mask=True)
+        np.testing.assert_allclose(pv.numpy(), tv.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(pi.numpy()),
+                                      ti.numpy())
+
+    def test_normalize_and_similarity(self):
+        import paddle_tpu.nn.functional as F
+        a = np.random.RandomState(4).randn(4, 7).astype("float32")
+        b = np.random.RandomState(5).randn(4, 7).astype("float32")
+        np.testing.assert_allclose(
+            F.normalize(paddle.to_tensor(a), p=2, axis=1).numpy(),
+            torch.nn.functional.normalize(torch.tensor(a), p=2,
+                                          dim=1).numpy(), atol=1e-6)
+        np.testing.assert_allclose(
+            F.cosine_similarity(paddle.to_tensor(a), paddle.to_tensor(b),
+                                axis=1).numpy(),
+            torch.nn.functional.cosine_similarity(
+                torch.tensor(a), torch.tensor(b), dim=1).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(paddle.nn.PairwiseDistance(p=2)(
+                paddle.to_tensor(a), paddle.to_tensor(b)).numpy()).ravel(),
+            torch.nn.PairwiseDistance(p=2)(
+                torch.tensor(a), torch.tensor(b)).numpy().ravel(),
+            atol=1e-5)
+
+    def test_local_response_norm(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(6).randn(2, 8, 5, 5).astype("float32")
+        t = torch.nn.functional.local_response_norm(
+            torch.tensor(x), size=5, alpha=1e-4, beta=0.75, k=1.0)
+        p = F.local_response_norm(paddle.to_tensor(x), size=5,
+                                  alpha=1e-4, beta=0.75, k=1.0)
+        np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
