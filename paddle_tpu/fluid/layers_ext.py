@@ -54,9 +54,16 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
         data_format="NCHW"):
     """fluid lrn (ref nn.py:6527 / lrn_op): plain channel-window SUM —
     the 2.x local_response_norm is the avg form, so scale alpha by n to
-    recover sum semantics."""
-    return _lrn_avg(input, size=n, alpha=alpha * n, beta=beta, k=k,
-                    data_format=data_format)
+    recover sum semantics.  lrn_op's window leads with (n-1)//2 channels
+    while the 2.x kernel leads with n//2 — identical for odd n; for even
+    n the channel axis is flipped around the op so the pad asymmetry
+    lands on the reference side."""
+    flip_c = n % 2 == 0
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    x = _T.flip(input, axis=ch_axis) if flip_c else input
+    out = _lrn_avg(x, size=n, alpha=alpha * n, beta=beta, k=k,
+                   data_format=data_format)
+    return _T.flip(out, axis=ch_axis) if flip_c else out
 
 
 sum = _T.sum          # noqa: A001  (fluid.layers.sum is elementwise list-sum)

@@ -149,12 +149,19 @@ def _resize_axis(out, ax, s_out, mode, align_corners, align_mode):
                         axis=ax)
 
     if mode == "area":
+        if s_in % s_out == 0:
+            # divisible fast path: reshape + mean, O(in)
+            k = s_in // s_out
+            shape = out.shape[:ax] + (s_out, k) + out.shape[ax + 1:]
+            return jnp.mean(out.astype(jnp.float32).reshape(shape),
+                            axis=ax + 1)
         # adaptive-average boundaries: [floor(i*in/out), ceil((i+1)*in/out))
-        # in EXACT integer arithmetic (float32 i*s_in/s_out loses
-        # exactness past 2^24 and can land bins one element off)
-        i = jnp.arange(s_out, dtype=jnp.int32)
-        start = (i * s_in) // s_out
-        end = -((-(i + 1) * s_in) // s_out)
+        # computed HOST-side in numpy int64 — exact for any size (float32
+        # loses exactness past 2^24; device int32 products would wrap at
+        # 2^31 on exactly the huge axes this matters for)
+        i = np.arange(s_out, dtype=np.int64)
+        start = jnp.asarray((i * s_in) // s_out, jnp.int32)
+        end = jnp.asarray(-((-(i + 1) * s_in) // s_out), jnp.int32)
         if s_in * s_out <= 1 << 22:
             # membership matmul: direct per-region summation (exact
             # f32 accumulation, MXU-friendly); boundaries may overlap

@@ -657,3 +657,142 @@ class TestStructuralOpsVsTorch:
         p = F.local_response_norm(paddle.to_tensor(x), size=5,
                                   alpha=1e-4, beta=0.75, k=1.0)
         np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+
+class TestNormTrainingVsTorch:
+    """Training-mode statistics and gradients — momentum conventions
+    differ by name between frameworks (paddle momentum=0.9 keeps 90% of
+    the running stat, torch momentum=0.1 mixes 10% new: same update)."""
+
+    def test_batch_norm_running_stats_and_grads(self):
+        tbn = torch.nn.BatchNorm2d(3, momentum=0.1)
+        pbn = paddle.nn.BatchNorm2D(3, momentum=0.9)
+        tbn.train()
+        pbn.train()
+        rng = np.random.RandomState(0)
+        for step in range(3):
+            x = rng.randn(4, 3, 5, 5).astype("float32") * (step + 1)
+            tx = torch.tensor(x, requires_grad=True)
+            tout = tbn(tx)
+            tout.square().sum().backward()
+            px = paddle.to_tensor(x)
+            px.stop_gradient = False
+            pout = pbn(px)
+            pout.square().sum().backward()
+            np.testing.assert_allclose(pout.numpy(),
+                                       tout.detach().numpy(), atol=2e-4)
+            np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                                       tx.grad.numpy(), rtol=1e-2,
+                                       atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(pbn._mean.numpy()),
+            tbn.running_mean.numpy(), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(pbn._variance.numpy()),
+            tbn.running_var.numpy(), rtol=1e-4, atol=1e-4)
+        # eval mode consumes the running stats identically
+        tbn.eval()
+        pbn.eval()
+        x = rng.randn(2, 3, 5, 5).astype("float32")
+        np.testing.assert_allclose(
+            pbn(paddle.to_tensor(x)).numpy(),
+            tbn(torch.tensor(x)).detach().numpy(), atol=2e-5)
+
+    def test_group_norm_grads(self):
+        tgn = torch.nn.GroupNorm(2, 6)
+        pgn = paddle.nn.GroupNorm(num_groups=2, num_channels=6)
+        with torch.no_grad():
+            w = np.random.RandomState(1).rand(6).astype("float32") + 0.5
+            b = np.random.RandomState(2).randn(6).astype("float32")
+            tgn.weight.copy_(torch.tensor(w))
+            tgn.bias.copy_(torch.tensor(b))
+        pgn.weight.set_value(w)
+        pgn.bias.set_value(b)
+        x = np.random.RandomState(3).randn(2, 6, 4, 4).astype("float32")
+        tx = torch.tensor(x, requires_grad=True)
+        tout = tgn(tx)
+        tout.square().sum().backward()
+        px = paddle.to_tensor(x)
+        px.stop_gradient = False
+        pout = pgn(px)
+        pout.square().sum().backward()
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                                   tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(pgn.weight.grad.numpy()),
+                                   tgn.weight.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_clip_grad_by_global_norm_vs_torch():
+    """ClipGradByGlobalNorm through the optimizer == torch
+    clip_grad_norm_ applied before SGD."""
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4, 3).astype("float32")
+    b0 = rng.randn(3).astype("float32")
+    x = rng.randn(8, 4).astype("float32")
+    y = rng.randn(8, 3).astype("float32") * 10  # big grads -> clip active
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    tb = torch.tensor(b0.copy(), requires_grad=True)
+    ((torch.tensor(x) @ tw + tb - torch.tensor(y)) ** 2).sum().backward()
+    torch.nn.utils.clip_grad_norm_([tw, tb], max_norm=1.0)
+    with torch.no_grad():
+        tw -= 0.1 * tw.grad
+        tb -= 0.1 * tb.grad
+
+    pw = paddle.to_tensor(w0.copy())
+    pb = paddle.to_tensor(b0.copy())
+    pw.stop_gradient = pb.stop_gradient = False
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=[pw, pb],
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    ((paddle.to_tensor(x) @ pw + pb - paddle.to_tensor(y)) ** 2).sum() \
+        .backward()
+    opt.step()
+    np.testing.assert_allclose(np.asarray(pw.numpy()),
+                               tw.detach().numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pb.numpy()),
+                               tb.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_prelu_channel_grads_vs_torch():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 3, 3).astype("float32")
+    a = (rng.rand(4).astype("float32") * 0.4).astype("float32")
+    tx = torch.tensor(x, requires_grad=True)
+    ta = torch.tensor(a.copy(), requires_grad=True)
+    tout = torch.nn.functional.prelu(tx, ta)
+    tout.square().sum().backward()
+    px = paddle.to_tensor(x)
+    pa = paddle.to_tensor(a.copy())
+    px.stop_gradient = pa.stop_gradient = False
+    pout = F.prelu(px, pa)
+    pout.square().sum().backward()
+    np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                               tx.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pa.grad.numpy()),
+                               ta.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("n", [4, 5])
+def test_fluid_lrn_window_vs_bruteforce(n):
+    """fluid lrn_op window: [c-(n-1)//2, c+n//2], plain sum — checked
+    against direct enumeration for even AND odd n (the 2.x kernel leads
+    with n//2, so even n needs the flip trick in the facade)."""
+    from paddle_tpu import fluid
+    x = np.random.RandomState(0).randn(1, 6, 2, 2).astype("float32")
+    alpha, beta, k = 1e-2, 0.75, 1.0
+    C = 6
+    ref = np.empty_like(x)
+    for c in range(C):
+        lo, hi = max(0, c - (n - 1) // 2), min(C - 1, c + n // 2)
+        s = (x[:, lo:hi + 1] ** 2).sum(axis=1)
+        ref[:, c] = x[:, c] / (k + alpha * s) ** beta
+    got = np.asarray(fluid.layers.lrn(paddle.to_tensor(x), n=n,
+                                      alpha=alpha).numpy())
+    np.testing.assert_allclose(got, ref, atol=1e-5)
