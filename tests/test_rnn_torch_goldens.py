@@ -796,3 +796,45 @@ def test_fluid_lrn_window_vs_bruteforce(n):
     got = np.asarray(fluid.layers.lrn(paddle.to_tensor(x), n=n,
                                       alpha=alpha).numpy())
     np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+class TestPoolingPaddingVsTorch:
+    """avg-pool divisor semantics: paddle exclusive=True excludes pad
+    cells from the mean (== torch count_include_pad=False), and the
+    default conventions differ between the two APIs."""
+
+    @pytest.mark.parametrize("exclusive", [True, False])
+    def test_avg_pool2d_padding_divisor(self, exclusive):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(0).randn(2, 3, 7, 7).astype("float32")
+        t = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), 3, stride=2, padding=1,
+            count_include_pad=not exclusive)
+        p = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                         exclusive=exclusive)
+        np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+    def test_max_pool1d_3d(self):
+        import paddle_tpu.nn.functional as F
+        x1 = np.random.RandomState(1).randn(2, 3, 11).astype("float32")
+        np.testing.assert_allclose(
+            F.max_pool1d(paddle.to_tensor(x1), 3, stride=2,
+                         padding=1).numpy(),
+            torch.nn.functional.max_pool1d(torch.tensor(x1), 3, stride=2,
+                                           padding=1).numpy(), atol=1e-6)
+        x3 = np.random.RandomState(2).randn(1, 2, 5, 6, 7).astype(
+            "float32")
+        np.testing.assert_allclose(
+            F.max_pool3d(paddle.to_tensor(x3), 2, stride=2).numpy(),
+            torch.nn.functional.max_pool3d(torch.tensor(x3), 2,
+                                           stride=2).numpy(), atol=1e-6)
+
+    def test_avg_pool2d_ceil_mode(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(3).randn(1, 2, 7, 7).astype("float32")
+        t = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), 3, stride=2, ceil_mode=True,
+            count_include_pad=False)
+        p = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2,
+                         ceil_mode=True, exclusive=True)
+        np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
