@@ -1,0 +1,103 @@
+"""Randomized three-way mode parity: the SAME net (shared parameters)
+must produce identical outputs in dygraph, under jit.to_static, and
+through the static record-replay Executor — the framework's most
+original machinery, fuzzed across random layer stacks and shapes."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+def _random_net(rng, c_in):
+    """Random feedforward stack over [B, C, H, W] images."""
+    layers, c = [], c_in
+    for _ in range(rng.randint(2, 5)):
+        kind = rng.choice(["conv", "bn", "act", "pool", "gn"])
+        if kind == "conv":
+            c_out = int(rng.choice([4, 8]))
+            layers.append(nn.Conv2D(c, c_out, 3, padding=1))
+            c = c_out
+        elif kind == "bn":
+            layers.append(nn.BatchNorm2D(c))
+        elif kind == "gn" and c % 2 == 0:
+            layers.append(nn.GroupNorm(num_groups=2, num_channels=c))
+        elif kind == "pool":
+            layers.append(nn.AvgPool2D(2, stride=1, padding=1))
+        else:
+            layers.append(rng.choice([nn.ReLU, nn.GELU, nn.Tanh,
+                                      nn.Hardswish])())
+    layers += [nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(c, 5)]
+    return nn.Sequential(*layers)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_three_mode_parity(seed):
+    rng = np.random.RandomState(seed)
+    c_in = int(rng.choice([2, 3]))
+    B, H = int(rng.choice([2, 3])), int(rng.choice([6, 8]))
+    net = _random_net(rng, c_in)
+    net.eval()                       # BN uses running stats in all modes
+    x = rng.randn(B, c_in, H, H).astype("float32")
+
+    eager = np.asarray(net(paddle.to_tensor(x)).numpy())
+
+    st_fn = paddle.jit.to_static(net)
+    jitted = np.asarray(st_fn(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(jitted, eager, rtol=1e-4, atol=1e-5)
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            inp = static.data("fuzz_x", [None, c_in, H, H], "float32")
+            out = net(inp)
+            exe = static.Executor()
+            exe.run(startup)
+            replayed, = exe.run(main, feed={"fuzz_x": x},
+                                fetch_list=[out])
+        np.testing.assert_allclose(replayed, eager, rtol=1e-4, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_train_step_parity_dygraph_vs_static(seed):
+    """One SGD step on identical nets/data must move the parameters
+    identically in dygraph and through the static train_spec Executor."""
+    rng = np.random.RandomState(100 + seed)
+    x = rng.randn(8, 6).astype("float32")
+    y = rng.randn(8, 2).astype("float32")
+    w0 = rng.randn(6, 2).astype("float32")
+
+    # dygraph step
+    lin_d = nn.Linear(6, 2, bias_attr=False)
+    lin_d.weight.set_value(w0.copy())
+    opt_d = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin_d.parameters())
+    loss = ((lin_d(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+    loss.backward()
+    opt_d.step()
+    w_dy = np.asarray(lin_d.weight.numpy())
+
+    # static step
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            inp = static.data("ts_x", [None, 6], "float32")
+            tgt = static.data("ts_y", [None, 2], "float32")
+            lin_s = nn.Linear(6, 2, bias_attr=False)
+            lin_s.weight.set_value(w0.copy())
+            sloss = ((lin_s(inp) - tgt) ** 2).mean()
+            opt_s = paddle.optimizer.SGD(learning_rate=0.1)
+            opt_s.minimize(sloss)
+            exe = static.Executor()
+            exe.run(startup)
+            exe.run(main, feed={"ts_x": x, "ts_y": y},
+                    fetch_list=[sloss])
+        w_st = np.asarray(lin_s.weight.numpy())
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(w_st, w_dy, rtol=1e-5, atol=1e-6)

@@ -804,10 +804,12 @@ class TestPoolingPaddingVsTorch:
     cells from the mean (== torch count_include_pad=False), and the
     default conventions differ between the two APIs."""
 
-    @pytest.mark.parametrize("exclusive", [False])
+    @pytest.mark.parametrize("exclusive", [True, False])
     def test_avg_pool2d_padding_divisor(self, exclusive):
-        # exclusive=True at this exact shape is already asserted in
-        # test_nn_layers.py; the False (count_include_pad) case is new
+        # 7x7 with k=3,s=2,pad=1 makes the TRAILING window overlap pad
+        # (padded coord 8), so exclusive=True here checks the divisor at
+        # a trailing-edge pad window — coverage the 8x8 variant in
+        # test_nn_layers.py does not have
         import paddle_tpu.nn.functional as F
         x = np.random.RandomState(0).randn(2, 3, 7, 7).astype("float32")
         t = torch.nn.functional.avg_pool2d(
