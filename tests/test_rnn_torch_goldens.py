@@ -845,3 +845,72 @@ class TestPoolingPaddingVsTorch:
         p = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2,
                          ceil_mode=True, exclusive=True)
         np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+
+class TestAttentionMaskConventions:
+    """paddle bool masks keep True / exclude False — the OPPOSITE of
+    torch's bool masks (True = masked).  Locked against torch with the
+    inversion applied, plus the additive float-mask path."""
+
+    def _pair(self, E=8, H=2, B=2, T=4):
+        tmha = torch.nn.MultiheadAttention(E, H, batch_first=True)
+        pmha = nn.MultiHeadAttention(E, H)
+        TestTransformerVsTorch()._copy_mha(pmha, tmha, E)
+        x = np.random.RandomState(0).randn(B, T, E).astype("float32")
+        return tmha, pmha, x
+
+    def test_bool_mask_inverted_conventions(self):
+        tmha, pmha, x = self._pair()
+        B, T = x.shape[:2]
+        keep = np.random.RandomState(1).rand(T, T) > 0.3
+        keep |= np.eye(T, dtype=bool)        # keep diagonal: rows valid
+        tout, _ = tmha(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                       attn_mask=torch.tensor(~keep))   # torch: True=drop
+        pout = pmha(paddle.to_tensor(x), paddle.to_tensor(x),
+                    paddle.to_tensor(x),
+                    attn_mask=paddle.to_tensor(keep))   # paddle: True=keep
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=2e-5)
+
+    def test_float_mask_additive(self):
+        tmha, pmha, x = self._pair()
+        T = x.shape[1]
+        fmask = np.where(np.random.RandomState(2).rand(T, T) > 0.3,
+                         0.0, -1e9).astype("float32")
+        tout, _ = tmha(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                       attn_mask=torch.tensor(fmask))
+        pout = pmha(paddle.to_tensor(x), paddle.to_tensor(x),
+                    paddle.to_tensor(x),
+                    attn_mask=paddle.to_tensor(fmask))
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=2e-5)
+
+    def test_decoder_layer_cross_attention(self):
+        E, H, F, B, Tq, Tk = 8, 2, 16, 2, 3, 5
+        tl = torch.nn.TransformerDecoderLayer(
+            E, H, dim_feedforward=F, dropout=0.0, activation="relu",
+            batch_first=True)
+        tl.eval()
+        pl_ = nn.TransformerDecoderLayer(E, H, F, dropout=0.0,
+                                         activation="relu")
+        pl_.eval()
+        cp = TestTransformerVsTorch()._copy_mha
+        cp(pl_.self_attn, tl.self_attn, E)
+        cp(pl_.cross_attn, tl.multihead_attn, E)
+        with torch.no_grad():
+            pl_.linear1.weight.set_value(tl.linear1.weight.numpy().T.copy())
+            pl_.linear1.bias.set_value(tl.linear1.bias.numpy().copy())
+            pl_.linear2.weight.set_value(tl.linear2.weight.numpy().T.copy())
+            pl_.linear2.bias.set_value(tl.linear2.bias.numpy().copy())
+            for pn, tn in (("norm1", "norm1"), ("norm2", "norm2"),
+                           ("norm3", "norm3")):
+                getattr(pl_, pn).weight.set_value(
+                    getattr(tl, tn).weight.numpy().copy())
+                getattr(pl_, pn).bias.set_value(
+                    getattr(tl, tn).bias.numpy().copy())
+        tgt = np.random.RandomState(3).randn(B, Tq, E).astype("float32")
+        mem = np.random.RandomState(4).randn(B, Tk, E).astype("float32")
+        tout = tl(torch.tensor(tgt), torch.tensor(mem))
+        pout = pl_(paddle.to_tensor(tgt), paddle.to_tensor(mem))
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   atol=3e-5)
