@@ -914,3 +914,32 @@ class TestAttentionMaskConventions:
         pout = pl_(paddle.to_tensor(tgt), paddle.to_tensor(mem))
         np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
                                    atol=3e-5)
+
+
+def test_weight_norm_vs_torch():
+    """weight_norm reparameterization (g * v/||v||, dim semantics) and
+    its gradient must match torch's."""
+    w0 = np.random.RandomState(0).randn(4, 3).astype("float32")
+    x = np.random.RandomState(1).randn(2, 3).astype("float32")
+
+    tlin = torch.nn.Linear(3, 4, bias=False)
+    with torch.no_grad():
+        tlin.weight.copy_(torch.tensor(w0))
+    tlin = torch.nn.utils.weight_norm(tlin, dim=0)
+    tout = tlin(torch.tensor(x))
+    tout.square().sum().backward()
+
+    plin = nn.Linear(3, 4, bias_attr=False)
+    plin.weight.set_value(w0.T.copy())        # paddle stores [in, out]
+    plin = paddle.nn.utils.weight_norm(plin, dim=1)  # out-dim in [in,out]
+    pout = plin(paddle.to_tensor(x))
+    pout.square().sum().backward()
+    np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                               atol=2e-5)
+    # g grads: paddle g is per-output (dim=1 of [in,out]); torch per-row
+    np.testing.assert_allclose(
+        np.asarray(plin.weight_g.grad.numpy()).ravel(),
+        tlin.weight_g.grad.numpy().ravel(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(plin.weight_v.grad.numpy()).T,
+        tlin.weight_v.grad.numpy(), rtol=1e-3, atol=1e-4)
