@@ -56,18 +56,71 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     args = ([weight, bias] if weight is not None else [])
     if use_batch_stats:
+        # the eval twin (same signature/arity: running stats pass
+        # through the mean/var outputs) lets Program.clone(for_test=True)
+        # swap the recorded op to running-stat normalization, the
+        # reference's test-mode flip
+        def _bn_eval(a, rm, rv, *wb):
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (a - rm.reshape(shape)) * jax.lax.rsqrt(
+                rv.reshape(shape) + epsilon)
+            if wb:
+                w, b = wb
+                out = out * w.reshape(shape) + b.reshape(shape)
+            return out, rm, rv
+
+        _bn.__test_variant__ = _bn_eval
         out, mean_t, var_t = call(_bn, x, running_mean, running_var, *args,
                                   _name="batch_norm")
         if isinstance(running_mean, Tensor):
-            n = 1
-            for i, s in enumerate(x.shape):
-                if i != (ch_axis % x.ndim):
-                    n *= s
-            unbiased = var_t.value * n / max(n - 1, 1)
-            running_mean.value = (momentum * running_mean.value
-                                  + (1 - momentum) * mean_t.value)
-            running_var.value = (momentum * running_var.value
-                                 + (1 - momentum) * unbiased)
+            # the running-stat update is a DISPATCHED op + _rebind — not a
+            # raw .value assignment — so the static recorder sees it as a
+            # buffer mutation (Executor.run writes persistable captures
+            # back after each step) and jit functionalization collects it.
+            # The unbiased n/(n-1) correction computes INSIDE the op from
+            # the input's runtime shape — the recorder builds on a dummy
+            # batch, so a closure-baked n would be the build batch size.
+            def _upd(rm, rv, m, v, a):
+                n_ = 1
+                for i, s in enumerate(a.shape):
+                    if i != (ch_axis % a.ndim):
+                        n_ *= s
+                corr_ = n_ / max(n_ - 1, 1)
+                return (momentum * rm + (1 - momentum) * m,
+                        momentum * rv + (1 - momentum) * (v * corr_))
+
+            from ...framework import core as _core
+            from ...static.graph import in_static_mode
+            keep = in_static_mode() and not _core.in_tracing()
+            old_m, old_v = running_mean.value, running_var.value
+            # the update never belongs on the autograd tape: grads must
+            # not flow into running statistics, and a taped _rebind would
+            # chain node->node across steps, pinning every batch's
+            # residuals forever
+            prev_grad = _core.grad_enabled()
+            _core.set_grad_enabled_flag(False)
+            try:
+                new_m, new_v = call(_upd, running_mean, running_var,
+                                    mean_t, var_t, x,
+                                    _name="bn_stats_update")
+            finally:
+                _core.set_grad_enabled_flag(prev_grad)
+            running_mean._rebind(new_m)
+            running_var._rebind(new_v)
+            running_mean.stop_gradient = True
+            running_var.stop_gradient = True
+            if keep:
+                # static BUILD executes the update once on the dummy
+                # batch: keep the recorded mutation (the adopted var id)
+                # but restore the real values — the Executor's first run
+                # must read the true initial statistics
+                running_mean.value = old_m
+                running_var.value = old_v
+                from ...static.graph import default_main_program
+                prog = default_main_program()
+                prog.note_mutation(running_mean)
+                prog.note_mutation(running_var)
     else:
         out = call(_bn, x, running_mean, running_var, *args,
                    _name="batch_norm")

@@ -97,6 +97,12 @@ class Layer:
     def register_buffer(self, name, tensor, persistable=True):
         if tensor is not None and not isinstance(tensor, Tensor):
             tensor = Tensor(tensor)
+        if tensor is not None:
+            # persistable marks the tensor itself too: the static
+            # Executor writes back mutated persistable captures (BN
+            # running stats) after each run, like the reference's
+            # persistable-var scope semantics
+            tensor.persistable = bool(persistable)
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names_set.add(name)

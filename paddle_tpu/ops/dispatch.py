@@ -79,7 +79,14 @@ def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
 
     if core._state.amp_state is not None:
         from ..amp.auto_cast import maybe_autocast_fn
-        fn = maybe_autocast_fn(fn, _name or getattr(fn, "__name__", "op"))
+        nm = _name or getattr(fn, "__name__", "op")
+        wrapped = maybe_autocast_fn(fn, nm)
+        tv = getattr(fn, "__test_variant__", None)
+        if tv is not None and wrapped is not fn:
+            # clone(for_test) swaps recorded fns: the variant rides (and
+            # gets the same amp treatment)
+            wrapped.__test_variant__ = maybe_autocast_fn(tv, nm)
+        fn = wrapped
 
     leaves, treedef = tree_util.tree_flatten(
         (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))

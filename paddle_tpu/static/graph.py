@@ -75,6 +75,16 @@ class Program:
         self.train_spec = None  # (loss_var_id, optimizer)
         self.fetch_cache = {}
         self.random_seed = None
+        # id(tensor) -> (weakref(tensor), produced var id): persistable
+        # captures this program MUTATES (BN running stats); the Executor
+        # fetches the produced value and writes it back after each run.
+        # Registered explicitly at record time — the tensor's live slot
+        # can't be trusted, a later program's build may rebind it.
+        self.mutated = {}
+        # var ids this program's replay env can supply (feeds, params,
+        # op outputs) — maintained incrementally so record_call's
+        # capture decision is O(1), not an O(ops) rescan per op
+        self._avail = set()
         # grad_vid -> (target_vid, wrt_vid, seed_or_None): placeholders
         # minted by append_backward/gradients, realized at fetch time by
         # differentiating the replay (backward.py)
@@ -82,16 +92,41 @@ class Program:
 
     def record(self, fn, treedef, leaf_specs, out_ids, name):
         self.ops.append(OpRecord(fn, treedef, leaf_specs, out_ids, name))
+        self._avail.update(out_ids)
+
+    def note_mutation(self, t):
+        """Register a persistable capture the program just mutated (the
+        tensor's current slot is the mutation's produced id)."""
+        import weakref
+        self.mutated[id(t)] = (weakref.ref(t), t._weakref_slot)
 
     def clone(self, for_test=False):
-        import copy
         p = Program()
         p.ops = list(self.ops)
+        if for_test:
+            # the reference's clone(for_test=True) flips ops to test
+            # mode: drop the recorded buffer-mutation ops (their out_ids
+            # are read by nothing downstream — the forward consumed the
+            # PRE-update buffer ids) and swap train-mode BN onto its
+            # eval twin (running-stat normalization, same signature)
+            ops = []
+            for op in p.ops:
+                if op.name == "bn_stats_update":
+                    continue
+                tv = getattr(op.fn, "__test_variant__", None)
+                if tv is not None:
+                    op = OpRecord(tv, op.treedef, op.leaf_specs,
+                                  op.out_ids, op.name)
+                ops.append(op)
+            p.ops = ops
         p.feed_ids = dict(self.feed_ids)
         p.params = dict(self.params)
         p.var_meta = dict(self.var_meta)
         p.captured = dict(self.captured)
         p.grad_map = dict(self.grad_map)
+        # a test clone dropped its mutation ops, so it writes nothing back
+        p.mutated = {} if for_test else dict(self.mutated)
+        p._avail = set(self._avail)
         if not for_test:
             p.train_spec = self.train_spec
         return p
@@ -195,11 +230,13 @@ def _ensure_var_id(t: Tensor, program: Program):
         program.var_meta[vid] = (tuple(t.shape), t.dtype)
         if isinstance(t, Parameter):
             program.params[vid] = t
+            program._avail.add(vid)
             _live_var_ids.add(vid)
     elif vid not in program.var_meta:
         program.var_meta[vid] = (tuple(t.shape), t.dtype)
         if isinstance(t, Parameter):
             program.params[vid] = t
+            program._avail.add(vid)
             _live_var_ids.add(vid)
     try:
         _var_tensors[vid] = weakref.ref(t)
@@ -219,6 +256,13 @@ def record_call(fn, leaves, treedef, out_tensors, name):
                 # external capture (layer buffer, eager tensor): keep it
                 # alive so replay can read its value after the builder's
                 # locals are gone
+                prog.captured[vid] = l
+            elif vid not in prog._avail:
+                # the id is live GLOBALLY but belongs to ANOTHER program
+                # (a layer reused across programs after a mutation-
+                # tracked update): capture per-program so THIS replay
+                # reads the tensor's live value instead of baking a
+                # stale constant through the weakref fallback
                 prog.captured[vid] = l
             specs.append(("var", vid))
         else:
@@ -241,6 +285,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     prog.feed_ids[name] = vid
     _live_var_ids.add(vid)
     t.name = name
+    prog._avail.add(vid)
     return t
 
 
@@ -299,7 +344,8 @@ class Executor:
         if key not in self._cache:
             self._cache[key] = self._compile(program, feed_names, fetch_ids,
                                              param_ids)
-        step_fn = self._cache[key]
+        step_fn, buf_updates, cap_ids = self._cache[key]
+        cap_vals = tuple(program.captured[v].value for v in cap_ids)
 
         if program.train_spec is not None:
             loss_id, opt = program.train_spec
@@ -308,8 +354,8 @@ class Executor:
                     id(p), opt._init_accumulator(nm, p))
                  for nm in opt._accum_names} for p in params]
             opt._step_count += 1
-            fetches, new_params, new_states = step_fn(
-                tuple(feed_vals), tuple(param_vals), states,
+            fetches, new_params, new_states, buf_vals = step_fn(
+                tuple(feed_vals), tuple(param_vals), cap_vals, states,
                 opt.get_lr(), opt._step_count)
             for p, nv in zip(params, new_params):
                 p.value = nv
@@ -317,22 +363,51 @@ class Executor:
                 for nm, sv in ns.items():
                     opt._accumulators[nm][id(p)] = sv
         else:
-            fetches = step_fn(tuple(feed_vals), tuple(param_vals))
+            fetches, buf_vals = step_fn(tuple(feed_vals),
+                                        tuple(param_vals), cap_vals)
+        # mutated persistable captures (BN running stats & co) flow back
+        for (wr, _vid), bv in zip(buf_updates, buf_vals):
+            t = wr()
+            if t is not None:
+                t.value = bv
 
         if return_numpy:
             return [np.asarray(jax.device_get(f)) for f in fetches]
         return [Tensor(f) for f in fetches]
 
+    @staticmethod
+    def _buffer_writebacks(program):
+        """Mutated persistable captures (BN running stats & co), from the
+        program's explicit mutation notes — the recorded mutation's final
+        value must flow back into the tensor after each run (the
+        reference's persistable-var scope semantics).  Keyed by the
+        PRODUCED id noted at record time, never by the tensor's live slot
+        (a later program's build may have rebound it)."""
+        return [(wr, vid) for wr, vid in program.mutated.values()
+                if wr() is not None]
+
     def _compile(self, program, feed_names, fetch_ids, param_ids):
         feed_var_ids = [program.feed_ids[n] for n in feed_names]
+        buf_updates = self._buffer_writebacks(program)
+        buf_vids = [v for _, v in buf_updates]
+        # EVERY persistable non-Parameter capture rides as a runtime
+        # ARGUMENT — a captured .value read inside jit is baked at trace
+        # time as a constant, which would freeze BN running stats (and,
+        # for a test clone whose mutation ops were stripped, freeze eval
+        # normalization at whatever the stats were at first compile)
+        from ..tensor.tensor import Parameter as _Param
+        cap_ids = [vid for vid, t in program.captured.items()
+                   if getattr(t, "persistable", False)
+                   and not isinstance(t, _Param)]
 
-        def forward(feed_vals, param_vals):
+        def forward(feed_vals, param_vals, cap_vals):
             env = dict(zip(feed_var_ids, feed_vals))
             env.update(dict(zip(param_ids, param_vals)))
+            env.update(dict(zip(cap_ids, cap_vals)))
             program.replay(env)
             return env
 
-        def eval_fetch(env, fid, feed_vals, param_vals):
+        def eval_fetch(env, fid, feed_vals, param_vals, cap_vals):
             """A fetch id minted by append_backward/gradients resolves to
             d(target)/d(wrt): re-replay with the wrt var cut and let XLA
             differentiate (the two replays CSE away under jit)."""
@@ -343,6 +418,7 @@ class Executor:
             def scalar_of(wv):
                 env2 = dict(zip(feed_var_ids, feed_vals))
                 env2.update(dict(zip(param_ids, param_vals)))
+                env2.update(dict(zip(cap_ids, cap_vals)))
                 program.replay_cut(env2, wrt_id, wv)
                 t = env2[tgt_id]
                 return jnp.sum(t) if seed is None else jnp.sum(t * seed)
@@ -351,7 +427,7 @@ class Executor:
         if program.train_spec is not None:
             loss_id, opt = program.train_spec
 
-            def train_step(feed_vals, param_vals, states, lr, t):
+            def train_step(feed_vals, param_vals, cap_vals, states, lr, t):
                 if getattr(opt, "_recompute", False):
                     # fluid RecomputeOptimizer: rematerialize the forward
                     # in the backward (activation memory -> FLOPs).  Only
@@ -360,28 +436,33 @@ class Executor:
                     # and defeat the remat; fetches re-run a forward-only
                     # pass (no residuals) outside it.
                     loss_fn = jax.checkpoint(
-                        lambda pv: forward(feed_vals, pv)[loss_id])
+                        lambda pv: forward(feed_vals, pv,
+                                           cap_vals)[loss_id])
                     grads = jax.grad(loss_fn)(list(param_vals))
-                    env = forward(feed_vals, list(param_vals))
+                    env = forward(feed_vals, list(param_vals), cap_vals)
                 else:
                     def loss_of(pv):
-                        env = forward(feed_vals, pv)
+                        env = forward(feed_vals, pv, cap_vals)
                         return env[loss_id], env
                     grads, env = jax.grad(
                         loss_of, has_aux=True)(list(param_vals))
                 new_params, new_states = opt.apply_updates_pytree(
                     list(param_vals), grads, states, lr, t)
-                fetches = tuple(eval_fetch(env, i, feed_vals, param_vals)
-                                for i in fetch_ids)
-                return fetches, new_params, new_states
+                fetches = tuple(
+                    eval_fetch(env, i, feed_vals, param_vals, cap_vals)
+                    for i in fetch_ids)
+                bufs = tuple(env[v] for v in buf_vids)
+                return fetches, new_params, new_states, bufs
 
-            return jax.jit(train_step)
+            return jax.jit(train_step), buf_updates, cap_ids
 
-        def infer(feed_vals, param_vals):
-            env = forward(feed_vals, param_vals)
-            return tuple(eval_fetch(env, i, feed_vals, param_vals)
-                         for i in fetch_ids)
-        return jax.jit(infer)
+        def infer(feed_vals, param_vals, cap_vals):
+            env = forward(feed_vals, param_vals, cap_vals)
+            return (tuple(
+                eval_fetch(env, i, feed_vals, param_vals, cap_vals)
+                for i in fetch_ids),
+                tuple(env[v] for v in buf_vids))
+        return jax.jit(infer), buf_updates, cap_ids
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

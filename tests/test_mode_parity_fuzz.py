@@ -101,3 +101,156 @@ def test_train_step_parity_dygraph_vs_static(seed):
     finally:
         paddle.disable_static()
     np.testing.assert_allclose(w_st, w_dy, rtol=1e-5, atol=1e-6)
+
+
+def test_static_bn_running_stats_accumulate():
+    """BN running statistics must accumulate across Executor runs exactly
+    like dygraph (mutated persistable captures ride as runtime args and
+    write back — a trace-time-baked capture would freeze them)."""
+    rng = np.random.RandomState(0)
+    data = [rng.randn(16, 4).astype("float32") + 3.0 for _ in range(5)]
+
+    bn_d = nn.BatchNorm1D(4)
+    bn_d.train()
+    for d in data:
+        bn_d(paddle.to_tensor(d))
+    dy_mean = np.asarray(bn_d._mean.numpy())
+    dy_var = np.asarray(bn_d._variance.numpy())
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("bnacc_x", [None, 4], "float32")
+            bn_s = nn.BatchNorm1D(4)
+            bn_s.train()
+            loss = (bn_s(x) ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            for d in data:
+                exe.run(main, feed={"bnacc_x": d}, fetch_list=[loss])
+        st_mean = np.asarray(bn_s._mean.numpy())
+        st_var = np.asarray(bn_s._variance.numpy())
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(st_mean, dy_mean, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(st_var, dy_var, rtol=1e-5, atol=1e-6)
+
+
+def test_clone_for_test_freezes_and_flips_bn():
+    """clone(for_test=True): eval runs must (a) NOT touch the training
+    running stats and (b) normalize WITH them (the reference's test-mode
+    op flip), not with batch statistics."""
+    rng = np.random.RandomState(1)
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("cft_x", [None, 4], "float32")
+            bn = nn.BatchNorm1D(4)
+            bn.train()
+            out = bn(x)
+            loss = (out ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)
+            test_prog = main.clone(for_test=True)
+            exe = static.Executor()
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed={
+                    "cft_x": rng.randn(16, 4).astype("float32") + 2.0},
+                    fetch_list=[loss])
+            m_after_train = np.asarray(bn._mean.numpy()).copy()
+            assert np.abs(m_after_train).max() > 0.1   # stats learned
+
+            # eval on a SHIFTED batch: stats must stay untouched...
+            ev_in = rng.randn(16, 4).astype("float32") - 5.0
+            ev_out, = exe.run(test_prog, feed={"cft_x": ev_in},
+                              fetch_list=[out])
+            np.testing.assert_array_equal(
+                np.asarray(bn._mean.numpy()), m_after_train)
+            # ...and the output must be normalized by the RUNNING stats
+            rm = m_after_train
+            rv = np.asarray(bn._variance.numpy())
+            want = (ev_in - rm) / np.sqrt(rv + 1e-5)
+            np.testing.assert_allclose(ev_out, want, rtol=1e-4, atol=1e-4)
+    finally:
+        paddle.disable_static()
+
+
+def test_bn_layer_reused_across_programs():
+    """A BN layer built into TWO programs must keep accumulating stats
+    through whichever program runs (per-program captures — a stale
+    baked constant would freeze them)."""
+    rng = np.random.RandomState(2)
+    paddle.enable_static()
+    try:
+        bn = nn.BatchNorm1D(4)
+        bn.train()
+        progs = []
+        exe = static.Executor()
+        for tag in ("a", "b"):
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data(f"re_{tag}", [None, 4], "float32")
+                loss = (bn(x) ** 2).mean()
+                paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)
+                exe.run(startup)
+            progs.append((main, f"re_{tag}"))
+        vals = []
+        for i in range(4):
+            main, name = progs[i % 2]       # alternate programs
+            exe.run(main, feed={
+                name: rng.randn(16, 4).astype("float32") + 3.0},
+                fetch_list=[])
+            vals.append(float(np.asarray(bn._mean.numpy())[0]))
+        # strictly increasing toward ~3: every run accumulated
+        assert all(b > a for a, b in zip(vals, vals[1:])), vals
+        assert vals[-1] > 0.8, vals
+    finally:
+        paddle.disable_static()
+
+
+def test_clone_eval_sees_fresh_stats_after_more_training():
+    """Train, eval (compiles the test clone), train MORE, eval again —
+    the second eval must normalize with the NEWER stats (runtime-arg
+    captures; a trace-time-baked read would reuse the first-compile
+    values)."""
+    rng = np.random.RandomState(3)
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("fresh_x", [None, 4], "float32")
+            bn = nn.BatchNorm1D(4)
+            bn.train()
+            out = bn(x)
+            loss = (out ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)
+            test_prog = main.clone(for_test=True)
+            exe = static.Executor()
+            exe.run(startup)
+
+            def train(n):
+                for _ in range(n):
+                    exe.run(main, feed={
+                        "fresh_x":
+                        rng.randn(16, 4).astype("float32") + 2.0},
+                        fetch_list=[loss])
+
+            ev = rng.randn(8, 4).astype("float32")
+            train(3)
+            out1, = exe.run(test_prog, feed={"fresh_x": ev},
+                            fetch_list=[out])
+            stats1 = np.asarray(bn._mean.numpy()).copy()
+            train(5)
+            out2, = exe.run(test_prog, feed={"fresh_x": ev},
+                            fetch_list=[out])
+            stats2 = np.asarray(bn._mean.numpy())
+            assert not np.allclose(stats1, stats2)
+            rv = np.asarray(bn._variance.numpy())
+            want = (ev - stats2) / np.sqrt(rv + 1e-5)
+            np.testing.assert_allclose(out2, want, rtol=1e-4, atol=1e-4)
+            assert not np.allclose(out1, out2)
+    finally:
+        paddle.disable_static()
