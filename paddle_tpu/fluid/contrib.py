@@ -229,3 +229,13 @@ from .contrib_decoder import (InitState, StateCell,  # noqa: E402,F401
 # TPU-native Momentum, which already fuses decay into the jitted update
 from .. import optimizer as _opt_mod  # noqa: E402
 optimizer = SimpleNamespace(Momentum=_opt_mod.Momentum)
+
+# Module-style spellings (ref contrib/__init__.py:17-34 does
+# ``from . import model_stat`` AND ``from .model_stat import *`` — both
+# ``contrib.summary(prog)`` and ``contrib.model_stat.summary(prog)`` must
+# resolve for reference-era scripts)
+model_stat = SimpleNamespace(summary=model_stat_summary)
+op_frequence = SimpleNamespace(op_freq_statistic=op_freq_statistic)
+memory_usage_calc = SimpleNamespace(memory_usage=memory_usage)
+extend_optimizer = SimpleNamespace(
+    extend_with_decoupled_weight_decay=extend_with_decoupled_weight_decay)

@@ -105,17 +105,33 @@ class Program:
         p.ops = list(self.ops)
         if for_test:
             # the reference's clone(for_test=True) flips ops to test
-            # mode: drop the recorded buffer-mutation ops (their out_ids
-            # are read by nothing downstream — the forward consumed the
-            # PRE-update buffer ids) and swap train-mode BN onto its
-            # eval twin (running-stat normalization, same signature)
+            # mode: drop the recorded buffer-mutation ops and swap
+            # train-mode BN onto its eval twin (running-stat
+            # normalization, same signature).  A layer applied TWICE in
+            # one program reads the first update's out_ids (the buffer
+            # slot was rebound), so dropping an update must remap later
+            # reads of its out_ids back to its rm/rv INPUT refs —
+            # transitively, landing on the original captured buffer ids,
+            # which the Executor feeds as runtime args (fresh every run)
+            # instead of the weakref fallback baking a trace-time
+            # constant.
+            subst = {}
             ops = []
             for op in p.ops:
+                specs = op.leaf_specs
+                if subst and any(k == "var" and r in subst
+                                 for k, r in specs):
+                    specs = [subst[r] if k == "var" and r in subst
+                             else (k, r) for k, r in specs]
                 if op.name == "bn_stats_update":
+                    # _upd(rm, rv, mean, var, x): leaves 0/1 are the
+                    # running-stat refs this update consumed
+                    subst[op.out_ids[0]] = specs[0]
+                    subst[op.out_ids[1]] = specs[1]
                     continue
                 tv = getattr(op.fn, "__test_variant__", None)
-                if tv is not None:
-                    op = OpRecord(tv, op.treedef, op.leaf_specs,
+                if tv is not None or specs is not op.leaf_specs:
+                    op = OpRecord(tv or op.fn, op.treedef, specs,
                                   op.out_ids, op.name)
                 ops.append(op)
             p.ops = ops
@@ -252,17 +268,15 @@ def record_call(fn, leaves, treedef, out_tensors, name):
     for l in leaves:
         if isinstance(l, Tensor):
             vid = _ensure_var_id(l, prog)
-            if vid not in _live_var_ids:
-                # external capture (layer buffer, eager tensor): keep it
-                # alive so replay can read its value after the builder's
-                # locals are gone
-                prog.captured[vid] = l
-            elif vid not in prog._avail:
-                # the id is live GLOBALLY but belongs to ANOTHER program
-                # (a layer reused across programs after a mutation-
-                # tracked update): capture per-program so THIS replay
-                # reads the tensor's live value instead of baking a
-                # stale constant through the weakref fallback
+            if vid not in _live_var_ids or vid not in prog._avail:
+                # capture anything THIS program's replay can't supply:
+                # external tensors (layer buffers, eager values — keep
+                # them alive past the builder's locals) and ids live
+                # globally but produced by ANOTHER program (a layer
+                # reused across programs after a mutation-tracked
+                # update).  Captures ride the jitted step as runtime
+                # args, so replay reads the live value instead of
+                # baking a stale constant through the weakref fallback.
                 prog.captured[vid] = l
             specs.append(("var", vid))
         else:

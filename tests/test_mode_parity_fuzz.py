@@ -254,3 +254,50 @@ def test_clone_eval_sees_fresh_stats_after_more_training():
             assert not np.allclose(out1, out2)
     finally:
         paddle.disable_static()
+
+
+def test_clone_eval_bn_applied_twice():
+    """One BatchNorm layer applied TWICE in one program: the second
+    application's recorded rm/rv refs are the first bn_stats_update's
+    out_ids (the buffer slot was rebound).  clone(for_test=True) drops
+    that update, so it must remap those reads back to the original
+    captured buffer ids — otherwise the second application resolves
+    through the weakref fallback and bakes first-compile statistics as a
+    jit constant, frozen across later training."""
+    rng = np.random.RandomState(7)
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("twice_x", [None, 4], "float32")
+            bn = nn.BatchNorm1D(4)
+            bn.train()
+            out = bn(bn(x))
+            loss = (out ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)
+            test_prog = main.clone(for_test=True)
+            exe = static.Executor()
+            exe.run(startup)
+
+            def train(n):
+                for _ in range(n):
+                    exe.run(main, feed={
+                        "twice_x":
+                        rng.randn(16, 4).astype("float32") + 2.0},
+                        fetch_list=[loss])
+
+            ev = rng.randn(8, 4).astype("float32")
+            train(3)
+            out1, = exe.run(test_prog, feed={"twice_x": ev},
+                            fetch_list=[out])   # compiles the test clone
+            train(5)
+            out2, = exe.run(test_prog, feed={"twice_x": ev},
+                            fetch_list=[out])
+            rm = np.asarray(bn._mean.numpy())
+            rv = np.asarray(bn._variance.numpy())
+            h = (ev - rm) / np.sqrt(rv + 1e-5)
+            want = (h - rm) / np.sqrt(rv + 1e-5)   # BOTH applications fresh
+            np.testing.assert_allclose(out2, want, rtol=1e-4, atol=1e-4)
+            assert not np.allclose(out1, out2)
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Hunt for a live axon tunnel without wedging it further.
+#
+# The relay in this container supports ONE client at a time; a SIGKILLed
+# client leaves it draining for many minutes (r5 observation: 10 min of
+# quiet was not always enough).  So: one gentle probe per CYCLE seconds,
+# SIGTERM-first kill, and on the first successful probe immediately run
+# the full bench orchestrator (kernel-check gate + timed runs) with a
+# generous envelope.  Exits after one successful bench, or when
+# /tmp/stop_hunt exists.  Log: tools/bench_hunt.log
+cd /root/repo || exit 1
+LOG=tools/bench_hunt.log
+CYCLE=${CYCLE:-1200}
+touch "$LOG"
+while true; do
+  [ -f /tmp/stop_hunt ] && { echo "$(date -u +%FT%TZ) stop flag — exiting" >>"$LOG"; exit 0; }
+  echo "$(date -u +%FT%TZ) probe..." >>"$LOG"
+  if timeout -k 15 240 python -u bench.py --probe >>"$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) PROBE OK — launching full bench" >>"$LOG"
+    sleep 45    # let the probe client's session drain before the next client
+    BENCH_BUDGET_S=${BENCH_BUDGET_S:-2400} BENCH_KC_BUDGET_S=700 \
+    BENCH_PROBE_TIMEOUT_S=180 BENCH_PROBE_COOLDOWN_S=240 \
+      python -u bench.py >>"$LOG" 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench rc=$rc" >>"$LOG"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) bench SUCCEEDED — artifacts fresh" >>"$LOG"
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe failed/wedged (rc=$?)" >>"$LOG"
+  fi
+  sleep "$CYCLE"
+done
