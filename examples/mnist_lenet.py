@@ -12,7 +12,9 @@ from paddle_tpu.vision.transforms import Normalize
 
 
 def main(epochs=1, batch_size=256, steps=None):
-    transform = Normalize(mean=[0.1307], std=[0.3081], data_format="CHW")
+    # transforms see the RAW uint8 image (reference semantics), so the
+    # classic fluid-era constants: (x - 127.5) / 127.5 -> [-1, 1]
+    transform = Normalize(mean=[127.5], std=[127.5], data_format="CHW")
     train = MNIST(mode="train", transform=transform)
     test = MNIST(mode="test", transform=transform)
 
