@@ -236,9 +236,11 @@ def _run_ernie(on_tpu, peak, sweep):
         f"steps={steps}")
 
 
-# ResNet50 train FLOPs/img at 224x224: ~4.09 GFLOP forward (public
-# conv-by-conv count), x3 for the backward's two conv passes.
-RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+# ResNet50 train FLOPs/img at 224x224: the public "4.09G" figure counts
+# multiply-accumulates; PEAK_FLOPS (and the GPT/ERNIE 6N convention)
+# count multiply and add separately, so x2 — then x3 for the backward's
+# two conv passes.
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9
 
 
 def _time_resnet_batch(batch, steps, image_size=224, classes=1000):
@@ -544,10 +546,10 @@ def orchestrate():
         kc_cap = int(os.environ.get("BENCH_KC_BUDGET_S", 420))
         kc_budget = min(kc_cap, remaining() - 480)
         # scale the check's internal sweep budget to the SIGKILL cap,
-        # never below its 330s default and always leaving >=90s of
-        # headroom for the check's fixed-cost (non-sweep) work
+        # always leaving >=90s of headroom for the check's fixed-cost
+        # (non-sweep) work — even when probe retries shrank the cap
         os.environ.setdefault("PALLAS_CHECK_BUDGET_S",
-                              str(int(max(330, kc_budget - 90))))
+                              str(int(max(60, kc_budget - 90))))
         kernel_rc, _ = _spawn(None, kc_budget, capture=False,
                               script=kc_script)
         if kernel_rc is None:

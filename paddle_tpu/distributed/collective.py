@@ -3,8 +3,20 @@ paddle/fluid/operators/collective/c_allreduce_op.h etc.).
 
 TPU-native: inside a mapped region (shard_map / fleet parallel step) each op
 lowers to the XLA collective (psum / all_gather / ppermute / all_to_all)
-over the named mesh axis, riding ICI.  Outside a mapped region (pure eager,
-world size 1) they are identities — matching single-process semantics.
+over the named mesh axis, riding ICI.  Outside a mapped region there are
+two cases: a single-process world, where they are identities; and a
+multi-process launch (``jax.distributed`` initialized — the reference's
+gloo control-plane case), where they aggregate host values across
+processes via ``jax.experimental.multihost_utils``.  The eager cross-
+process path is control-plane machinery (metrics, LocalSGD parameter
+averaging, file sharding); the data plane stays inside mapped regions.
+
+Subset-``group`` eager collectives still require EVERY live process to
+make the call (the underlying gather is global); only member rows enter
+the reduction and non-members get their input back.  send/recv keep the
+single-process buffer emulation — a true cross-process p2p pair would
+deadlock a global collective, matching the reference's restriction of
+gloo send/recv to in-graph ops.
 
 The active axis name is provided by the surrounding parallel context
 (fleet sets it when entering tensor/data-parallel regions).
@@ -79,6 +91,38 @@ def _current_axis(group=None):
     return _axis_stack[-1] if _axis_stack else None
 
 
+def _process_count():
+    try:
+        return jax.process_count()
+    except Exception:                                      # noqa: BLE001
+        return 1
+
+
+def _eager_rows(value):
+    """Host-level cross-process allgather: every live process contributes
+    its local value; returns a [process_count, ...] numpy stack."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
+
+
+def _member_rows(rows, group):
+    """(member?, member rows) for a possibly-subset group."""
+    if (group is not None and group.ranks
+            and len(group.ranks) < rows.shape[0]):
+        return group.rank >= 0, rows[np.asarray(group.ranks)]
+    return True, rows
+
+
+def _adopt(tensor, value):
+    """Rebind ``tensor`` to a host value, preserving trainability (a bare
+    Tensor defaults to stop_gradient=True — adopting that would silently
+    freeze a Parameter)."""
+    sg = tensor.stop_gradient
+    tensor._rebind(Tensor(value))
+    tensor.stop_gradient = sg
+    return tensor
+
+
 def _get_global_group():
     global _default_group
     if _default_group is None:
@@ -104,7 +148,16 @@ def new_group(ranks=None, backend=None, axis_name=None):
     return g
 
 
+_barrier_counter = [0]
+
+
 def barrier(group=None):
+    if _process_count() > 1:
+        from jax.experimental import multihost_utils
+        _barrier_counter[0] += 1
+        multihost_utils.sync_global_devices(
+            f"paddle_tpu_barrier_{_barrier_counter[0]}")
+        return
     jnp.zeros(()).block_until_ready()
 
 
@@ -117,6 +170,15 @@ def wait(tensor, group=None, use_calc_stream=True):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
+        if _process_count() > 1:
+            member, rows = _member_rows(_eager_rows(tensor.numpy()), group)
+            if not member:
+                return tensor
+            red = {ReduceOp.SUM: rows.sum(0), ReduceOp.MAX: rows.max(0),
+                   ReduceOp.MIN: rows.min(0), ReduceOp.PROD: rows.prod(0),
+                   ReduceOp.AVG: rows.mean(0)}[op]
+            _adopt(tensor, red.astype(rows.dtype))
+            return tensor
         return tensor  # world of one: identity
 
     def _ar(x):
@@ -145,6 +207,11 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
+        if _process_count() > 1:
+            member, rows = _member_rows(_eager_rows(tensor.numpy()), group)
+            if member:
+                tensor_list.extend(Tensor(r) for r in rows)
+            return tensor_list
         tensor_list.append(tensor.clone())
         return tensor_list
 
@@ -158,6 +225,20 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def all_gather_object(obj_list, obj, group=None):
+    if _process_count() > 1:
+        import pickle
+        buf = np.frombuffer(pickle.dumps(obj), np.uint8)
+        # two rounds: agree on the max payload size, then gather padded
+        sizes = _eager_rows(np.asarray([buf.size], np.int64))[:, 0]
+        padded = np.zeros(int(sizes.max()), np.uint8)
+        padded[:buf.size] = buf
+        rows = _eager_rows(padded)
+        member, rows = _member_rows(rows, group)
+        if member:
+            msizes = _member_rows(sizes[:, None], group)[1][:, 0]
+            obj_list.extend(pickle.loads(r[:int(n)].tobytes())
+                            for r, n in zip(rows, msizes))
+        return obj_list
     obj_list.append(obj)
     return obj_list
 
@@ -165,6 +246,12 @@ def all_gather_object(obj_list, obj, group=None):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
+        if _process_count() > 1:
+            # src is a GLOBAL rank (reference semantics): gather
+            # unfiltered and adopt src's row
+            rows = _eager_rows(tensor.numpy())
+            _adopt(tensor, rows[src])
+            return tensor
         return tensor
 
     def _bc(x):
@@ -178,6 +265,20 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
+        if _process_count() > 1:
+            # non-src processes may have no list; contribute zeros of the
+            # right shape so the global gather stays shape-uniform
+            me = jax.process_index()
+            if tensor_list:
+                local = np.stack([np.asarray(t.numpy())
+                                  for t in tensor_list])
+            else:
+                local = np.zeros((_process_count(),)
+                                 + tuple(np.asarray(tensor.numpy()).shape),
+                                 np.asarray(tensor.numpy()).dtype)
+            rows = _eager_rows(local)          # [nproc, nranks, ...]
+            _adopt(tensor, rows[src, me])
+            return tensor
         if tensor_list:
             tensor._rebind(tensor_list[0].clone())
         return tensor
@@ -194,6 +295,15 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
+        if _process_count() > 1:
+            me = jax.process_index()
+            local = np.stack([np.asarray(t.numpy())
+                              for t in in_tensor_list])
+            rows = _eager_rows(local)          # [nproc, nproc, ...]
+            # process j's slot-`me` entry is my j-th output
+            out_tensor_list.extend(Tensor(rows[j, me])
+                                   for j in range(rows.shape[0]))
+            return out_tensor_list
         out_tensor_list.extend(t.clone() for t in in_tensor_list)
         return out_tensor_list
     from ..tensor.manipulation import stack, unstack
@@ -273,6 +383,17 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     ax = _current_axis(group)
     if ax is None:
+        if _process_count() > 1:
+            member, rows = _member_rows(_eager_rows(tensor.numpy()), group)
+            if member:
+                red = rows.mean(0) if op == ReduceOp.AVG else rows.sum(0)
+                n = rows.shape[0]
+                me = jax.process_index()
+                if group is not None and group.ranks and n < _process_count():
+                    me = group.rank           # subset group: scatter by
+                sz = red.shape[0] // n        # group rank, not global
+                _adopt(tensor, red[me * sz:(me + 1) * sz])
+            return tensor
         return tensor
 
     def _rs(x):

@@ -102,43 +102,36 @@ class DataGenerator:
         raise NotImplementedError(
             "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
 
-    def run_from_stdin(self):
-        """stdin lines -> protocol lines on stdout (the pipe_command
-        contract)."""
+    def _run_samples(self, sample_iters):
+        """Shared buffering core: accumulate parsed samples to batch_size_,
+        flush each full batch (and the trailing partial one) through
+        generate_batch -> _gen_str -> stdout."""
         batch_samples = []
-        for line in sys.stdin:
-            line_iter = self.generate_sample(line)
+
+        def _flush():
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+        for line_iter in sample_iters:
             for user_parsed_line in line_iter():
                 if user_parsed_line is None:
                     continue
                 batch_samples.append(user_parsed_line)
                 if len(batch_samples) == self.batch_size_:
-                    batch_iter = self.generate_batch(batch_samples)
-                    for sample in batch_iter():
-                        sys.stdout.write(self._gen_str(sample))
+                    _flush()
                     batch_samples = []
         if batch_samples:
-            batch_iter = self.generate_batch(batch_samples)
-            for sample in batch_iter():
-                sys.stdout.write(self._gen_str(sample))
+            _flush()
+
+    def run_from_stdin(self):
+        """stdin lines -> protocol lines on stdout (the pipe_command
+        contract)."""
+        self._run_samples(self.generate_sample(line) for line in sys.stdin)
 
     def run_from_memory(self):
         """Debug path: generate without input lines, write to stdout."""
-        batch_samples = []
-        line_iter = self.generate_sample(None)
-        for user_parsed_line in line_iter():
-            if user_parsed_line is None:
-                continue
-            batch_samples.append(user_parsed_line)
-            if len(batch_samples) == self.batch_size_:
-                batch_iter = self.generate_batch(batch_samples)
-                for sample in batch_iter():
-                    sys.stdout.write(self._gen_str(sample))
-                batch_samples = []
-        if batch_samples:
-            batch_iter = self.generate_batch(batch_samples)
-            for sample in batch_iter():
-                sys.stdout.write(self._gen_str(sample))
+        self._run_samples([self.generate_sample(None)])
 
 
 class MultiSlotStringDataGenerator(DataGenerator):
@@ -243,6 +236,7 @@ class DatasetBase:
         self._pipe_command = ""
         self._input_type = 0
         self._filelist = []
+        self._pad_lens = {}    # slot idx -> stable padded length
 
     def init(self, batch_size=1, thread_num=1, use_var=None,
              pipe_command="", input_type=0, fs_name="", fs_ugi="",
@@ -305,8 +299,23 @@ class DatasetBase:
                 samples.append(_parse_multislot_line(ln, n_slots))
         return samples
 
+    def _slot_pad_len(self, si, batch_max):
+        """Stable per-slot padded length.  Padding each batch to ITS max
+        would hand the Executor a different feed shape per batch — one
+        full XLA recompile each.  Lengths grow monotonically and round up
+        to powers of two, so ragged data converges to a handful of
+        shapes (InMemoryDataset pins the exact dataset max at load)."""
+        cur = self._pad_lens.get(si, 0)
+        if batch_max <= cur:
+            return cur
+        t = 1
+        while t < batch_max:
+            t *= 2
+        self._pad_lens[si] = max(t, cur)
+        return self._pad_lens[si]
+
     def _batches(self, samples):
-        """Pad each slot to the batch max length -> {name: [B, L] array}
+        """Pad each slot to a stable length -> {name: [B, L] array}
         (the fixed-shape analogue of the reference's LoD batches)."""
         dtypes = self._slot_dtypes()
         names = [getattr(v, "name", f"slot_{i}")
@@ -319,7 +328,7 @@ class DatasetBase:
             feed = {}
             for si, (name, dt) in enumerate(zip(names, dtypes)):
                 rows = [np.asarray(s[si], dt) for s in chunk]
-                L = max(r.shape[0] for r in rows)
+                L = self._slot_pad_len(si, max(r.shape[0] for r in rows))
                 arr = np.zeros((len(rows), L), dt)
                 for ri, r in enumerate(rows):
                     arr[ri, :r.shape[0]] = r
@@ -357,6 +366,13 @@ class InMemoryDataset(DatasetBase):
 
     def load_into_memory(self):
         self._memory = self._samples_from_files()
+        # the whole dataset is in hand: pin each slot's padded length to
+        # the exact dataset-wide max so every batch shares ONE feed shape
+        for si in range(len(self._use_vars)):
+            if self._memory:
+                self._pad_lens[si] = max(
+                    len(np.asarray(s[si]).reshape(-1))
+                    for s in self._memory)
 
     def preload_into_memory(self, thread_num=None):
         self.load_into_memory()

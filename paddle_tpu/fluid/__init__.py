@@ -168,7 +168,8 @@ class DataFeeder:
         for v in feed_list:
             name = getattr(v, "name", str(v))
             self._names.append(name)
-            decl = _feed_declared_shapes.get(name, list(v.shape))
+            decl = (getattr(v, "_declared_shape", None)
+                    or _feed_declared_shapes.get(name, list(v.shape)))
             self._shapes.append([int(s) if (s is not None and s >= 0)
                                  else -1 for s in decl])
             self._dtypes.append(_n.dtype(v.value.dtype))

@@ -129,7 +129,8 @@ def memory_usage(program, batch_size):
         t = ref() if ref is not None else None
         if t is None:
             continue
-        decl = _feed_declared_shapes.get(name, list(t.shape))
+        decl = (getattr(t, "_declared_shape", None)
+                or _feed_declared_shapes.get(name, list(t.shape)))
         shape = tuple(batch_size if (s is None or s < 0) else int(s)
                       for s in decl)
         feed_ids.append(vid)

@@ -89,8 +89,9 @@ class PyReader:
             # the placeholder materializes -1 dims as 1; recover the
             # user-declared shape so unknown dims stay unknown
             self._sample_shapes = [
-                _per_sample_shape(_feed_declared_shapes.get(
-                    t.name, list(t.shape)))
+                _per_sample_shape(getattr(t, "_declared_shape", None)
+                                  or _feed_declared_shapes.get(
+                                      t.name, list(t.shape)))
                 for t in self._slots]
         else:
             if shapes is None or dtypes is None:

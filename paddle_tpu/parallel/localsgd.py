@@ -88,11 +88,28 @@ class LocalSGDOptimizer:
             self._sync_params()
 
     def _sync_params(self):
+        import numpy as np
         from ..distributed import collective
-        for p in self._inner._parameters:
-            if p is None:
-                continue
-            collective.all_reduce(p, op=collective.ReduceOp.AVG)
+        params = [p for p in self._inner._parameters if p is not None]
+        if not params:
+            return
+        if (collective._current_axis(None) is None
+                and collective._process_count() > 1):
+            # one flat cross-process gather for the whole parameter tree —
+            # a per-param all_reduce would pay one global barrier per
+            # parameter, defeating the point of syncing every k steps
+            flat = np.concatenate([
+                np.asarray(p.numpy(), np.float32).ravel() for p in params])
+            mean = collective._eager_rows(flat).mean(0)
+            off = 0
+            for p in params:
+                n = int(np.prod(p.shape)) if p.shape else 1
+                collective._adopt(p, mean[off:off + n].reshape(p.shape)
+                                  .astype(np.asarray(p.numpy()).dtype))
+                off += n
+        else:
+            for p in params:
+                collective.all_reduce(p, op=collective.ReduceOp.AVG)
 
     def clear_grad(self, set_to_zero=False):
         self._inner.clear_grad(set_to_zero)

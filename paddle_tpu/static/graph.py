@@ -290,7 +290,9 @@ def data(name, shape, dtype="float32", lod_level=0):
     """Feed placeholder (ref: python/paddle/fluid/data.py).  Dummy batch dim 1
     for unknown dims during build; real shapes come from the feed."""
     declared = [-1 if (s is None or s < 0) else int(s) for s in shape]
-    _feed_declared_shapes[name] = declared
+    _feed_declared_shapes[name] = declared  # name-keyed fallback only:
+    # a later program redeclaring the same feed name overwrites it, so
+    # consumers prefer the per-var stamp below
     shape = [1 if s < 0 else s for s in declared]
     t = Tensor(np.zeros(shape, np.dtype(core.convert_dtype(dtype))))
     t.stop_gradient = True
@@ -299,6 +301,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     prog.feed_ids[name] = vid
     _live_var_ids.add(vid)
     t.name = name
+    t._declared_shape = declared
     prog._avail.add(vid)
     return t
 

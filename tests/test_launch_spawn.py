@@ -215,3 +215,81 @@ class TestDistributedUtils:
                     break
                 time.sleep(0.2)
         U.terminate_local_procs(procs)
+
+
+def test_launch_eager_cross_process_collectives(tmp_path):
+    """Host-level collectives in a REAL 2-process jax.distributed world:
+    outside any mapped axis they must aggregate across processes (the
+    reference's gloo control-plane), not return the local value — the
+    LocalSGD fleet wrapper and fleet.util metrics depend on it."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "coll.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.parallel.localsgd import LocalSGDOptimizer
+
+        dist.init_parallel_env()
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+        # all_reduce SUM over distinct per-rank values
+        t = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+        collective.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.full((3,), 3.0))
+
+        # all_gather returns every rank's row, in rank order
+        out = []
+        collective.all_gather(out, paddle.to_tensor(
+            np.asarray([float(rank)], np.float32)))
+        assert len(out) == 2, len(out)
+        np.testing.assert_allclose(
+            np.concatenate([o.numpy() for o in out]), [0.0, 1.0])
+
+        # broadcast adopts src's value everywhere
+        b = paddle.to_tensor(np.asarray([10.0 * (rank + 1)], np.float32))
+        collective.broadcast(b, src=1)
+        np.testing.assert_allclose(b.numpy(), [20.0])
+
+        # object gather with different payload sizes per rank
+        objs = []
+        collective.all_gather_object(objs, {{"rank": rank,
+                                             "pad": "x" * (rank * 17)}})
+        assert [o["rank"] for o in objs] == [0, 1]
+
+        collective.barrier()
+
+        # fleet.util metric aggregation
+        from paddle_tpu.distributed import fleet
+        fleet.init(is_collective=True)
+        total = fleet.util.all_reduce(np.asarray([rank + 1.0]), mode="sum")
+        np.testing.assert_allclose(total, [3.0])
+
+        # LocalSGD: per-rank params diverge, one synced step averages them
+        lin = paddle.nn.Linear(2, 2)
+        w = np.full((2, 2), float(rank), np.float32)
+        lin.weight.set_value(paddle.to_tensor(w))
+        lin.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        opt = LocalSGDOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.0,
+                                 parameters=lin.parameters()),
+            k_steps=1, begin_step=1)
+        loss = (lin(paddle.to_tensor(np.ones((1, 2), np.float32))) ** 2
+                ).mean()
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   np.full((2, 2), 0.5), atol=1e-6)
+        assert not lin.weight.stop_gradient   # sync must not freeze params
+
+        with open(os.path.join({str(tmp_path)!r}, f"cok_{{rank}}"), "w"):
+            pass
+    """))
+    rc = launch_procs([str(script)], nprocs=2,
+                      master=f"127.0.0.1:{port}", env_base=_env_base())
+    assert rc == 0
+    assert (tmp_path / "cok_0").exists() and (tmp_path / "cok_1").exists()

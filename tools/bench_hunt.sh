@@ -11,9 +11,16 @@
 cd /root/repo || exit 1
 LOG=tools/bench_hunt.log
 CYCLE=${CYCLE:-1200}
+# Hard deadline (epoch seconds): stop probing well before the round's
+# driver runs its own bench — a SIGKILLed probe client leaves the relay
+# draining, which would poison the driver's probes.
+DEADLINE=${DEADLINE:-0}
 touch "$LOG"
 while true; do
   [ -f /tmp/stop_hunt ] && { echo "$(date -u +%FT%TZ) stop flag — exiting" >>"$LOG"; exit 0; }
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "$(date -u +%FT%TZ) deadline reached — exiting" >>"$LOG"; exit 0
+  fi
   echo "$(date -u +%FT%TZ) probe..." >>"$LOG"
   if timeout -k 15 240 python -u bench.py --probe >>"$LOG" 2>&1; then
     echo "$(date -u +%FT%TZ) PROBE OK — launching full bench" >>"$LOG"
