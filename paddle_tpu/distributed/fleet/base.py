@@ -34,6 +34,12 @@ class DistributedStrategy:
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fp16_allreduce = False
+        # async / geo-SGD parameter-server training (ref
+        # fleet/base/distributed_strategy.py a_sync + a_sync_configs):
+        # mapped onto LocalSGD periodic averaging, the TPU-native
+        # analogue — see distributed_optimizer
+        self.a_sync = False
+        self.a_sync_configs = {}
         self.fuse_all_reduce_ops = True
         self.nccl_comm_num = 1
         self.find_unused_parameters = False
@@ -176,16 +182,33 @@ class Fleet:
                     "compression targets slow GPU interconnects; ICI "
                     "psum is already cheap and bf16) — proceeding with "
                     "plain collectives", UserWarning, stacklevel=2)
+        # a_sync (geo-SGD parameter-server mode, ref distribute_transpiler
+        # geo_sgd): no parameter server exists on TPU, but geo-SGD's sync
+        # model IS periodic local-step averaging — map it onto LocalSGD
+        # with geo's k_steps and say so out loud (MIGRATING.md deviations)
+        use_localsgd = getattr(strategy, "localsgd", False)
+        localsgd_cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        if getattr(strategy, "a_sync", False) and not use_localsgd:
+            geo = getattr(strategy, "a_sync_configs", {}) or {}
+            warnings.warn(
+                "DistributedStrategy.a_sync (async/geo-SGD parameter "
+                "server) has no PS on TPU; mapping to LocalSGD periodic "
+                "parameter averaging every k_steps="
+                f"{geo.get('k_steps', 100)} local updates — the same "
+                "staleness/throughput trade geo-SGD makes",
+                UserWarning, stacklevel=2)
+            use_localsgd = True
+            localsgd_cfg = {"k_steps": geo.get("k_steps", 100),
+                            "begin_step": 1}
         # wrap order matters when both are set: gradient merge OUTSIDE
         # localsgd, so LocalSGD.step() fires only on real optimizer
         # updates (merge boundaries) and its k_steps counts parameter
         # updates, not micro-batches
-        if getattr(strategy, "localsgd", False):
+        if use_localsgd:
             from ...parallel.localsgd import LocalSGDOptimizer
-            cfg = getattr(strategy, "localsgd_configs", {}) or {}
             optimizer = LocalSGDOptimizer(
-                optimizer, k_steps=cfg.get("k_steps", 1),
-                begin_step=cfg.get("begin_step", 1))
+                optimizer, k_steps=localsgd_cfg.get("k_steps", 1),
+                begin_step=localsgd_cfg.get("begin_step", 1))
         if getattr(strategy, "gradient_merge", False):
             from ...optimizer.gradient_merge import GradientMergeOptimizer
             cfg = getattr(strategy, "gradient_merge_configs", {}) or {}

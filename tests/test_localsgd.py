@@ -120,3 +120,26 @@ class TestFleetWrapper:
         assert "dgc" in msgs and "fp16_allreduce" in msgs
         assert isinstance(opt, LocalSGDOptimizer)
         assert opt._k == 4 and opt._begin == 2
+
+
+def test_a_sync_maps_to_localsgd_with_warning():
+    """strategy.a_sync (the reference's geo-SGD PS mode) must map onto
+    LocalSGD periodic averaging — loudly, never silently ignored."""
+    import warnings
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.parallel.localsgd import LocalSGDOptimizer
+
+    strat = fleet.DistributedStrategy()
+    strat.a_sync = True
+    strat.a_sync_configs = {"k_steps": 37}
+    fleet.init(is_collective=True, strategy=strat)
+    lin = paddle.nn.Linear(2, 2)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters()))
+    assert any("a_sync" in str(x.message) for x in w)
+    assert isinstance(opt, LocalSGDOptimizer)
+    assert opt._k == 37
