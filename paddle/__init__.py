@@ -36,6 +36,24 @@ class _AliasLoader(importlib.abc.Loader):
         if self._orig_spec is not None:
             module.__spec__ = self._orig_spec
 
+    # runpy (``python -m paddle.distributed.launch``) requires the loader
+    # to expose the module's code object — delegate to the real loader
+    def get_code(self, fullname):
+        spec = importlib.util.find_spec(self._real)
+        if spec is not None and spec.loader is not None:
+            return spec.loader.get_code(self._real)
+        return None
+
+    def get_source(self, fullname):
+        spec = importlib.util.find_spec(self._real)
+        if spec is not None and spec.loader is not None:
+            return spec.loader.get_source(self._real)
+        return None
+
+    def is_package(self, fullname):
+        spec = importlib.util.find_spec(self._real)
+        return bool(spec is not None and spec.submodule_search_locations)
+
 
 class _AliasFinder(importlib.abc.MetaPathFinder):
     def find_spec(self, fullname, path=None, target=None):

@@ -88,3 +88,25 @@ print("OK")
         env=env, capture_output=True, timeout=300)
     assert out.returncode == 0, out.stderr.decode()[-2000:]
     assert b"OK" in out.stdout
+
+
+def test_python_dash_m_launch_through_alias():
+    """``python -m paddle.distributed.launch`` — the reference CLI
+    spelling — must work through the alias package: runpy requires the
+    alias loader to expose get_code for the real module."""
+    worker = ("import os; print('rank', os.environ"
+              "['PADDLE_TRAINER_ID'], 'ok', flush=True)")
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "PADDLE_TPU_TEST_MODE": "1"})
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        w = os.path.join(td, "w.py")
+        with open(w, "w") as f:
+            f.write(worker)
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle.distributed.launch",
+             "--nproc_per_node", "2", w],
+            env=env, capture_output=True, timeout=300)
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    assert out.stdout.count(b"ok") == 2, out.stdout
