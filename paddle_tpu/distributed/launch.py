@@ -83,8 +83,16 @@ def main(argv=None):
                         default=1, dest="nproc_per_node",
                         help="local process fan-out (testing; on TPU one "
                              "process per host drives every chip)")
-    parser.add_argument("--gpus", default=None, help="ignored on TPU")
+    parser.add_argument("--gpus", "--selected_gpus", default=None,
+                        dest="gpus",
+                        help="reference-era device list; on TPU it only "
+                             "sets the per-node fan-out")
     parser.add_argument("--devices", default=None)
+    parser.add_argument("--log_dir", "--log-dir", default=None,
+                        dest="log_dir", help="accepted for reference "
+                        "compatibility (workers inherit stdout/stderr)")
+    parser.add_argument("--started_port", type=int, default=None,
+                        help="accepted for reference compatibility")
     parser.add_argument("script", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -98,6 +106,9 @@ def main(argv=None):
     # coordinator bootstrap can only fire in a clean child where the env
     # is set before `import paddle_tpu`.
     npp = max(args.nproc_per_node, 1)
+    if npp == 1 and args.gpus:
+        # reference behavior: one worker per listed device
+        npp = len([g for g in args.gpus.split(",") if g.strip()])
     sys.exit(launch_procs(
         args.script, npp, args.master,
         rank_base=args.rank * npp,

@@ -22,7 +22,9 @@ while true; do
     echo "$(date -u +%FT%TZ) deadline reached — exiting" >>"$LOG"; exit 0
   fi
   echo "$(date -u +%FT%TZ) probe..." >>"$LOG"
-  if timeout -k 15 240 python -u bench.py --probe >>"$LOG" 2>&1; then
+  timeout -k 15 240 python -u bench.py --probe >>"$LOG" 2>&1
+  prc=$?
+  if [ "$prc" -eq 0 ]; then
     echo "$(date -u +%FT%TZ) PROBE OK — launching full bench" >>"$LOG"
     sleep 45    # let the probe client's session drain before the next client
     BENCH_BUDGET_S=${BENCH_BUDGET_S:-2400} BENCH_KC_BUDGET_S=700 \
@@ -35,7 +37,7 @@ while true; do
       exit 0
     fi
   else
-    echo "$(date -u +%FT%TZ) probe failed/wedged (rc=$?)" >>"$LOG"
+    echo "$(date -u +%FT%TZ) probe failed/wedged (rc=$prc)" >>"$LOG"
   fi
   sleep "$CYCLE"
 done
