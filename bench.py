@@ -301,11 +301,17 @@ def _time_resnet_batch(batch, steps, image_size=224, classes=1000):
                 0, classes, (batch, 1)).astype(np.int64))
             feed = {"image": x, "label": y}
 
-            lv, = exe.run(main, feed=feed, fetch_list=[loss])  # compile
+            # compile+warm BOTH variants: the steady loop runs fetchless
+            # (each loss fetch is a host round-trip through the remote
+            # tunnel — fetching every step would time the tunnel, not
+            # the chip), and one final fetch closes the timed region
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            exe.run(main, feed=feed, fetch_list=[])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                lv, = exe.run(main, feed=feed, fetch_list=[loss])
-            final_loss = float(np.asarray(lv))  # fetched every step anyway
+            for _ in range(steps - 1):
+                exe.run(main, feed=feed, fetch_list=[])
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            final_loss = float(np.asarray(lv))  # host fetch = sync point
             dt = time.perf_counter() - t0
             assert np.isfinite(final_loss)
             return batch * steps / dt, final_loss
