@@ -111,8 +111,11 @@ def check_flash_bench_shape(results):
     tr, _ = timeit(ref_fn, q, iters=10)
     entry = {"xla_fwd_ms": tr * 1e3, "fwd_blocks": {}}
     best = best_cfg = None
+    # ordered by prior: the likely winners first, extras last so a
+    # budget-starved (driver-default) run still measures the core set
     for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 1024),
-                   (2048, 512), (1024, 2048)):
+                   (2048, 512), (1024, 2048), (256, 1024), (2048, 1024),
+                   (128, 512), (512, 2048)):
         if _budget_left() < 30:
             entry["fwd_blocks"][f"{bq}x{bk}"] = "skipped: budget"
             continue
@@ -149,7 +152,8 @@ def check_flash_bench_shape(results):
     # computed once, per-K-block dq partials reduced by XLA)
     for fused in (False, True):
         tag = "fused" if fused else "split"
-        for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512)):
+        for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512),
+                       (256, 512), (1024, 1024)):
             if _budget_left() < 30:
                 entry["bwd_blocks"][f"{tag}:{bq}x{bk}"] = "skipped: budget"
                 continue
