@@ -16,6 +16,8 @@ from . import meta_parallel
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                             VocabParallelEmbedding, get_rng_state_tracker)
 from . import metrics  # noqa: E402
+from . import utils  # noqa: E402  (recompute, LocalFS, HDFSClient)
+from .utils import recompute  # noqa: E402,F401
 from .util import Role, UtilBase, CommunicateTopology  # noqa: E402
 from . import data_generator  # noqa: E402
 from ..ps_compat import (DataGenerator,  # noqa: E402,F401

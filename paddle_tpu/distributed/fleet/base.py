@@ -182,6 +182,23 @@ class Fleet:
                     "compression targets slow GPU interconnects; ICI "
                     "psum is already cheap and bf16) — proceeding with "
                     "plain collectives", UserWarning, stacklevel=2)
+        # lamb/lars meta-optimizers (ref fleet/meta_optimizers/
+        # lamb_optimizer.py, lars_optimizer.py): the reference swaps the
+        # inner optimizer class keeping its hyperparameters; same here
+        if getattr(strategy, "lamb", False):
+            from ...optimizer import Lamb
+            if not isinstance(optimizer, Lamb):
+                optimizer = Lamb(
+                    learning_rate=optimizer._learning_rate,
+                    parameters=optimizer._parameters,
+                    grad_clip=getattr(optimizer, "_grad_clip", None))
+        elif getattr(strategy, "lars", False):
+            from ...optimizer.optimizers import LarsMomentum
+            if not isinstance(optimizer, LarsMomentum):
+                optimizer = LarsMomentum(
+                    learning_rate=optimizer._learning_rate,
+                    parameters=optimizer._parameters,
+                    grad_clip=getattr(optimizer, "_grad_clip", None))
         # a_sync (geo-SGD parameter-server mode, ref distribute_transpiler
         # geo_sgd): no parameter server exists on TPU, but geo-SGD's sync
         # model IS periodic local-step averaging — map it onto LocalSGD

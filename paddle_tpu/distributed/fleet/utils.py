@@ -1,0 +1,161 @@
+"""fleet.utils — activation recomputation + filesystem helpers
+(ref: python/paddle/distributed/fleet/utils/recompute.py, fs.py).
+
+``recompute`` is the dygraph spelling of activation checkpointing: run the
+wrapped block's forward WITHOUT storing its intermediates and rerun it
+during backward.  TPU-native form: ``jax.checkpoint`` —
+  * inside a jit / to_static trace it marks the sub-computation for XLA
+    rematerialization (the real memory saver);
+  * in eager dygraph it collapses the block into ONE tape node whose
+    saved residuals are the block INPUTS (params + args), with the
+    checkpointed forward rerun by the node's vjp — the reference's
+    "stash inputs, replay forward" contract (recompute.py:90) without
+    the RNG-state bookkeeping (paddle_tpu op seeds derive from
+    ``paddle.seed``, so replayed dropout masks match by construction).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+import jax
+
+from ...framework import core
+from ...ops import dispatch
+from ...tensor.tensor import Tensor
+
+__all__ = ["recompute", "LocalFS", "HDFSClient"]
+
+
+def _wrap(v):
+    t = Tensor(v)
+    t.stop_gradient = True
+    return t
+
+
+def _strip(out):
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def recompute(function, *args, **kwargs):
+    """Forward ``function(*args)`` now; rerun it during backward instead
+    of keeping its activations (ref fleet/utils/recompute.py:90
+    ``RecomputeFunction``).  ``function`` may be an ``nn.Layer`` (its
+    parameters receive gradients) or any callable over Tensors."""
+    kwargs.pop("preserve_rng_state", None)   # deterministic op seeds
+    from ...nn import Layer
+
+    if core.in_tracing():
+        # already under a jax trace (to_static / static build): params are
+        # tracers in closure; jax.checkpoint closure-converts them and XLA
+        # rematerializes the block in the backward pass
+        def inner(*avals):
+            return _strip(function(*[_wrap(a) for a in avals], **kwargs))
+        vals = [a.value if isinstance(a, Tensor) else a for a in args]
+        out = jax.checkpoint(inner)(*vals)
+        return jax.tree_util.tree_map(_wrap, out)
+
+    # eager: one tape node over (params, buffers, args)
+    if isinstance(function, Layer):
+        from ...jit.functional import collect_state, swapped_state, trace_mode
+        params, buffers = collect_state(function)
+        pkeys, bkeys = list(params), list(buffers)
+
+        def pure(pvals, bvals, *avals):
+            with trace_mode():
+                with swapped_state(function, dict(zip(pkeys, pvals)),
+                                   dict(zip(bkeys, bvals))):
+                    return _strip(function(
+                        *[_wrap(a) for a in avals], **kwargs))
+
+        return dispatch.call(jax.checkpoint(pure),
+                             [params[k] for k in pkeys],
+                             [buffers[k] for k in bkeys],
+                             *args, _name="recompute")
+
+    from ...jit.functional import trace_mode
+
+    def pure_fn(*avals):
+        with trace_mode():
+            return _strip(function(*[_wrap(a) for a in avals], **kwargs))
+
+    return dispatch.call(jax.checkpoint(pure_fn), *args, _name="recompute")
+
+
+# ---------------------------------------------------------------- fs ----
+class LocalFS:
+    """ref fleet/utils/fs.py::LocalFS — local filesystem with the fleet
+    checkpoint helpers' method names."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        else:
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return
+        open(fs_path, "a").close()
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and self.is_exist(dst_path):
+            raise FileExistsError(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(LocalFS):
+    """ref fleet/utils/fs.py::HDFSClient.  No hadoop client ships in the
+    TPU image; constructing one raises unless ``hadoop`` is on PATH, in
+    which case paths are still handled locally (the checkpoint helpers
+    only need the LocalFS surface)."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **kw):
+        if hadoop_home is None and shutil.which("hadoop") is None:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop client, which the TPU image "
+                "does not ship — use LocalFS (same surface) or mount the "
+                "data locally")
+        self._hadoop_home = hadoop_home

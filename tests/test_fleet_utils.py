@@ -1,0 +1,120 @@
+"""fleet.utils: recompute (activation checkpointing) + fs helpers +
+lamb/lars strategy swaps (ref fleet/utils/recompute.py, fs.py,
+meta_optimizers/lamb_optimizer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+
+
+def _mlp(seed=0):
+    rng = np.random.RandomState(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+    for p in net.parameters():
+        p.set_value(paddle.to_tensor(
+            rng.randn(*p.shape).astype("float32") * 0.3))
+    return net
+
+
+class TestRecompute:
+    def test_eager_forward_and_grads_match(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 8).astype("float32"))
+
+        net_a, net_b = _mlp(), _mlp()
+        loss_a = (net_a(x) ** 2).mean()
+        loss_a.backward()
+        out_b = fleet.utils.recompute(net_b, x)
+        loss_b = (out_b ** 2).mean()
+        loss_b.backward()
+
+        np.testing.assert_allclose(float(loss_a.numpy()),
+                                   float(loss_b.numpy()), rtol=1e-6)
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            assert pb.grad is not None, "recompute dropped a param grad"
+            np.testing.assert_allclose(pa.grad.numpy(), pb.grad.numpy(),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_eager_trains(self):
+        net = _mlp(3)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(16, 8).astype("float32"))
+        losses = []
+        for _ in range(12):
+            loss = (fleet.utils.recompute(net, x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_under_to_static_matches_eager(self):
+        net = _mlp(5)
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(4, 8).astype("float32"))
+        eager = net(x).numpy()
+
+        class Wrapped(nn.Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+
+            def forward(self, x):
+                return fleet.utils.recompute(self.inner, x)
+
+        sfn = paddle.jit.to_static(Wrapped(net))
+        np.testing.assert_allclose(sfn(x).numpy(), eager,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_plain_callable(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = fleet.utils.recompute(lambda t: t * 2.0 + 1.0, x)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 3), 3.0))
+
+
+class TestFS:
+    def test_localfs_roundtrip(self, tmp_path):
+        fs = fleet.utils.LocalFS()
+        d = str(tmp_path / "ckpt")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "ckpt" / "meta")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(d)
+        assert files == ["meta"] and dirs == []
+        fs.mv(f, f + "2")
+        assert fs.is_file(f + "2") and not fs.is_exist(f)
+        fs.delete(d)
+        assert not fs.is_exist(d)
+        assert fs.need_upload_download() is False
+
+    def test_hdfs_requires_hadoop(self):
+        with pytest.raises(RuntimeError, match="hadoop"):
+            fleet.utils.HDFSClient()
+
+
+class TestStrategySwaps:
+    def test_lamb_swap(self):
+        strat = fleet.DistributedStrategy()
+        strat.lamb = True
+        fleet.init(is_collective=True, strategy=strat)
+        net = nn.Linear(2, 2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(0.001, parameters=net.parameters()))
+        from paddle_tpu.optimizer import Lamb
+        assert isinstance(opt, Lamb)
+
+    def test_lars_swap(self):
+        strat = fleet.DistributedStrategy()
+        strat.lars = True
+        fleet.init(is_collective=True, strategy=strat)
+        net = nn.Linear(2, 2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.Momentum(0.1, parameters=net.parameters()))
+        from paddle_tpu.optimizer.optimizers import LarsMomentum
+        assert isinstance(opt, LarsMomentum)
