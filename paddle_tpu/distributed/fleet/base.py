@@ -232,6 +232,22 @@ class Fleet:
             optimizer = GradientMergeOptimizer(
                 optimizer, k_steps=cfg.get("k_steps", 1),
                 avg=cfg.get("avg", True))
+        if getattr(strategy, "recompute", False):
+            # ref meta_optimizers/recompute_optimizer.py: the static
+            # Executor honors _recompute by wrapping the replayed forward
+            # in jax.checkpoint (segments are XLA's choice); dygraph
+            # blocks opt in via fleet.utils.recompute
+            optimizer._recompute = True
+        if getattr(strategy, "amp", False):
+            # ref meta_optimizers/amp_optimizer.py: decorate with the
+            # loss-scaling minimize flow (bf16-first under auto_cast)
+            from ...fluid.contrib import mixed_precision
+            cfg = getattr(strategy, "amp_configs", {}) or {}
+            optimizer = mixed_precision.decorate(
+                optimizer,
+                init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15),
+                use_dynamic_loss_scaling=cfg.get(
+                    "use_dynamic_loss_scaling", True))
         return optimizer
 
     def state_dict(self):

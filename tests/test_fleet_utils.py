@@ -118,3 +118,33 @@ class TestStrategySwaps:
             paddle.optimizer.Momentum(0.1, parameters=net.parameters()))
         from paddle_tpu.optimizer.optimizers import LarsMomentum
         assert isinstance(opt, LarsMomentum)
+
+
+class TestStrategyAmpRecompute:
+    def test_amp_decorates_minimize_flow(self):
+        strat = fleet.DistributedStrategy()
+        strat.amp = True
+        strat.amp_configs = {"init_loss_scaling": 2.0 ** 10}
+        fleet.init(is_collective=True, strategy=strat)
+        net = _mlp(7)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net.parameters()))
+        x = paddle.to_tensor(
+            np.random.RandomState(8).randn(16, 8).astype("float32"))
+        losses = []
+        for _ in range(10):
+            loss = (net(x) ** 2).mean()
+            opt.minimize(loss)
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_recompute_flag_reaches_optimizer(self):
+        strat = fleet.DistributedStrategy()
+        strat.recompute = True
+        fleet.init(is_collective=True, strategy=strat)
+        net = nn.Linear(2, 2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()))
+        assert getattr(opt, "_recompute", False) is True
