@@ -24,7 +24,9 @@ from ...framework import core
 from ...ops import dispatch
 from ...tensor.tensor import Tensor
 
-__all__ = ["recompute", "LocalFS", "HDFSClient"]
+__all__ = ["recompute", "LocalFS", "HDFSClient", "DistributedInfer",
+           "fused_allreduce_gradients", "broadcast_dp_parameters",
+           "broadcast_mp_parameters", "broadcast_input_data"]
 
 
 def _wrap(v):
@@ -82,6 +84,65 @@ def recompute(function, *args, **kwargs):
             return _strip(function(*[_wrap(a) for a in avals], **kwargs))
 
     return dispatch.call(jax.checkpoint(pure_fn), *args, _name="recompute")
+
+
+# ---------------------------------------- hybrid_parallel_util -----------
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """ref fleet/utils/hybrid_parallel_util.py:117 — average gradients
+    across data-parallel ranks after a manual backward.  Inside a mapped
+    region this rides the dp mesh axis; in a multi-process launch the
+    eager cross-process path aggregates host values."""
+    from .. import collective
+    for p in parameter_list:
+        g = p.grad          # Tensor view of _grad, or None
+        if g is not None:
+            collective.all_reduce(g, op=collective.ReduceOp.AVG)
+            p.grad = g      # write the reduced value back into _grad
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """ref :110 — rank 0's parameters win (post-init sync)."""
+    from .. import collective
+    for p in model.parameters():
+        collective.broadcast(p, src=0)
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    broadcast_dp_parameters(model, hcg)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """ref :85 — share rank 0's batch with the model-parallel group."""
+    from .. import collective
+    for t in inputs:
+        if isinstance(t, Tensor):
+            collective.broadcast(t, src=0)
+    for t in kwargs.values():
+        if isinstance(t, Tensor):
+            collective.broadcast(t, src=0)
+    return inputs, kwargs
+
+
+class DistributedInfer:
+    """ref fleet/utils/ps_util.py::DistributedInfer — rewrites a
+    PS-distributed lookup program back into a locally-runnable inference
+    program.  TPU-native programs never split lookups across parameter
+    servers (embeddings are mesh-sharded inside the compiled step), so
+    the recorded program is already locally runnable and is returned
+    as-is; the class keeps the reference call sequence working."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        from ...static.graph import (default_main_program,
+                                     default_startup_program)
+        self._main = main_program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        return None
+
+    def get_dist_infer_program(self):
+        return self._main
 
 
 # ---------------------------------------------------------------- fs ----

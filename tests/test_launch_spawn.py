@@ -286,6 +286,21 @@ def test_launch_eager_cross_process_collectives(tmp_path):
                                    np.full((2, 2), 0.5), atol=1e-6)
         assert not lin.weight.stop_gradient   # sync must not freeze params
 
+        # fleet.utils.fused_allreduce_gradients averages grads cross-rank
+        from paddle_tpu.distributed.fleet import utils as fu
+        lin2 = paddle.nn.Linear(2, 2)
+        lin2.weight.set_value(paddle.to_tensor(
+            np.eye(2, dtype=np.float32)))
+        lin2.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        scale = float(rank + 1)     # rank-dependent loss scale
+        loss2 = (lin2(paddle.to_tensor(
+            np.ones((1, 2), np.float32))) ** 2).sum() * scale
+        loss2.backward()
+        g_own = lin2.weight.grad.numpy().copy()
+        fu.fused_allreduce_gradients(list(lin2.parameters()))
+        np.testing.assert_allclose(lin2.weight.grad.numpy(),
+                                   g_own / scale * 1.5, atol=1e-5)
+
         with open(os.path.join({str(tmp_path)!r}, f"cok_{{rank}}"), "w"):
             pass
     """))
