@@ -39,6 +39,7 @@ MODULES = {
     "text/__init__.py": "text",
     "distributed/__init__.py": "distributed",
     "distributed/fleet/__init__.py": "distributed.fleet",
+    "distributed/fleet/utils/__init__.py": "distributed.fleet.utils",
     "tensor/__init__.py": "tensor",
     "jit/__init__.py": "jit",
     "autograd/__init__.py": "autograd",
