@@ -158,6 +158,11 @@ class LRScheduler(Callback):
 
 
 class EarlyStopping(Callback):
+    """Stop when the monitored quantity stops improving ON EVALUATION
+    data (ref hapi/callbacks.py::EarlyStopping monitors in on_eval_end —
+    train-epoch logs are never consulted; fit() warns when no eval data
+    is supplied)."""
+
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
         super().__init__()
@@ -166,6 +171,7 @@ class EarlyStopping(Callback):
         self.min_delta = abs(min_delta)
         self.baseline = baseline
         self.save_best_model = save_best_model
+        self.save_dir = None          # fit() points this at its save_dir
         if mode == "auto":
             mode = "min" if "loss" in monitor else "max"
         self.mode = mode
@@ -174,19 +180,25 @@ class EarlyStopping(Callback):
 
     def _better(self, cur):
         if self.best is None:
-            return True
+            return (self.baseline is None
+                    or (cur < self.baseline if self.mode == "min"
+                        else cur > self.baseline))
         if self.mode == "min":
             return cur < self.best - self.min_delta
         return cur > self.best + self.min_delta
 
-    def on_epoch_end(self, epoch, logs=None):
+    def on_eval_end(self, logs=None):
         logs = logs or {}
         cur = logs.get(self.monitor)
         if cur is None:
             return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
         if self._better(cur):
             self.best = cur
             self.wait = 0
+            if self.save_best_model and self.save_dir is not None:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
@@ -231,6 +243,10 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
     if not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        # ref callbacks.py:53 — schedulers advance PER STEP by default;
+        # pass LRScheduler(by_step=False, by_epoch=True) to override
+        cbks = cbks + [LRScheduler()]
     cbk_list = CallbackList(cbks)
     cbk_list.set_model(model)
     params = {"batch_size": batch_size, "epochs": epochs, "steps": steps,

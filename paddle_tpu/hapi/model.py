@@ -19,7 +19,6 @@ from ..framework import core
 from ..tensor.tensor import Tensor
 from ..metric import Metric
 from ..jit import functional as fx
-from ..optimizer.lr import LRScheduler
 from . import callbacks as cbks_mod
 
 
@@ -202,18 +201,33 @@ class Model:
         else:
             eval_loader = eval_data
 
+        try:
+            steps = len(train_loader)      # IterableDataset loaders have
+        except TypeError:                  # __len__ but raise (ref
+            steps = None                   # _len_data_loader)
         cbks = cbks_mod.config_callbacks(
-            callbacks, model=self, epochs=epochs,
-            steps=len(train_loader) if hasattr(train_loader, "__len__")
-            else None,
+            callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose,
             metrics=["loss"] + [n for m in self._metrics
                                 for n in (m.name() if isinstance(m.name(),
                                                                  list)
                                           else [m.name()])])
+        if eval_loader is None and any(
+                isinstance(c, cbks_mod.EarlyStopping)
+                for c in cbks.callbacks):
+            import warnings
+            warnings.warn("EarlyStopping needs validation data "
+                          "(it monitors eval logs)", UserWarning,
+                          stacklevel=2)
+        if save_dir is not None:
+            for c in cbks.callbacks:      # best-model target for
+                if isinstance(c, cbks_mod.EarlyStopping) \
+                        and c.save_dir is None:
+                    c.save_dir = save_dir
         cbks.on_begin("train")
         total_iters = 0
+        done = False
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -226,25 +240,36 @@ class Model:
                 logs = self._make_logs(result)
                 logs["step"] = step
                 logs["batch_size"] = batch_size
+                # per-step LR schedule rides the auto-added LRScheduler
+                # callback (ref callbacks.py:53), not an epoch-end step
                 cbks.on_batch_end("train", step, logs)
                 total_iters += 1
                 if num_iters is not None and total_iters >= num_iters:
-                    break
-            if isinstance(self._optimizer._lr, LRScheduler):
-                self._optimizer._lr.step()
+                    done = True           # num_iters bounds TOTAL steps,
+                    break                 # not steps-per-epoch
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size,
-                              verbose=0, num_workers=num_workers)
-            if self.stop_training:
+                # eval flows through the callback list so EarlyStopping /
+                # best-model logic sees the eval metrics (ref fit:1718)
+                cbks.on_begin("eval", {"metrics": [
+                    n for m in self._metrics
+                    for n in (m.name() if isinstance(m.name(), list)
+                              else [m.name()])]})
+                eval_logs = self.evaluate(eval_loader,
+                                          batch_size=batch_size,
+                                          verbose=0,
+                                          num_workers=num_workers)
+                cbks.on_end("eval", eval_logs)
+            if self.stop_training or done:
                 break
         cbks.on_end("train", logs)
         return self
 
     def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)):
-            if len(batch) == 2:
-                return [batch[0]], [batch[1]]
+            # the declared inputs spec is authoritative (ref hapi splits
+            # strictly by len(self._inputs)); without one, assume one
+            # input and the rest labels
             n_in = len(self._inputs) if self._inputs else 1
             return list(batch[:n_in]), list(batch[n_in:])
         return [batch], []

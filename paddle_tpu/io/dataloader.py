@@ -87,6 +87,14 @@ class DataLoader:
         self.num_workers = num_workers
         self.use_native_ring = use_native_ring
         self.prefetch_factor = max(prefetch_factor, 2)
+        # reference contract args: timeout bounds each batch wait (0 =
+        # wait forever), worker_init_fn runs once in every worker with
+        # its id.  persistent_workers is accepted for API parity; workers
+        # here are threads (re-created per epoch at negligible cost), so
+        # persistence has nothing to buy.
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -141,6 +149,12 @@ class DataLoader:
         def worker(wid):
             _worker_tls.info = WorkerInfo(wid, self.num_workers,
                                           self.dataset)
+            if self.worker_init_fn is not None:
+                try:
+                    self.worker_init_fn(wid)
+                except Exception as e:                     # noqa: BLE001
+                    out_q.put((-1, e))
+                    return
             while True:
                 item = work_q.get()
                 if item is done:
@@ -163,7 +177,13 @@ class DataLoader:
         received = 0
         try:
             while received < len(batches):
-                item = out_q.get()
+                try:
+                    item = out_q.get(
+                        timeout=self.timeout if self.timeout else None)
+                except queue.Empty:
+                    raise RuntimeError(
+                        f"DataLoader worker produced no batch within "
+                        f"timeout={self.timeout}s")
                 if item is done:
                     finished_workers += 1
                     continue
@@ -224,6 +244,13 @@ class DataLoader:
         def worker(wid):
             _worker_tls.info = WorkerInfo(wid, self.num_workers,
                                           self.dataset)
+            if self.worker_init_fn is not None:
+                try:
+                    self.worker_init_fn(wid)
+                except Exception as e:                     # noqa: BLE001
+                    errors.append(e)
+                    ring.close()
+                    return
             while True:
                 try:
                     i, idxs = work_q.get_nowait()
@@ -257,7 +284,14 @@ class DataLoader:
                     yield pending.pop(want)
                     want += 1
                     continue
-                got = ring.pop()
+                try:
+                    got = ring.pop(
+                        timeout_ms=int(self.timeout * 1000)
+                        if self.timeout else -1)
+                except TimeoutError:
+                    raise RuntimeError(
+                        f"DataLoader worker produced no batch within "
+                        f"timeout={self.timeout}s")
                 if got is None:        # closed: error or all done
                     if errors:
                         raise errors[0]
@@ -276,13 +310,13 @@ class DataLoader:
         finally:
             ring.close()
             for t in threads:
-                t.join(timeout=30.0)
-            if any(t.is_alive() for t in threads):
-                # never free the native ring under a live producer; leak it
-                # (daemon threads will see closed on their next push)
-                pass
-            else:
-                ring.destroy()
+                t.join(timeout=2.0)
+            # destroy is race-safe even under a live producer: the C
+            # handle is erased (later ops fail cleanly as closed) and the
+            # native object parks in a graveyard with its queued slabs
+            # released and pool trimmed — a stuck worker costs at most
+            # its one in-flight slab, not a 30s shutdown stall
+            ring.destroy()
 
     def _iter_iterable_workers(self):
         """Multi-worker IterableDataset: each worker thread iterates the

@@ -211,10 +211,14 @@ class DistributedBatchSampler(BatchSampler):
             rng = np.random.RandomState(self.epoch)
             rng.shuffle(indices)
             self.epoch += 1
-        # pad to be evenly divisible
+        # pad to be evenly divisible; TILE when pad > n (tiny datasets on
+        # many ranks) so every rank gets num_samples entries — unequal
+        # counts would deadlock the data-parallel collectives
         pad = self.total_size - n
         if pad > 0:
-            indices = np.concatenate([indices, indices[:pad]])
+            reps = int(np.ceil(pad / max(n, 1)))
+            indices = np.concatenate([indices] + [indices] * reps)[
+                :self.total_size]
         indices = indices[self.local_rank:self.total_size:self.nranks]
         batch = []
         for idx in indices:

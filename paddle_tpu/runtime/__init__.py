@@ -155,7 +155,13 @@ class DataRing:
         self._h = lib.ptpu_ring_create(capacity)
         self._meta = {}           # tag -> per-array (shape, dtype, nbytes)
         self._meta_lock = threading.Lock()
+        # deferred slab free: serialized by _pop_lock — the C++ ring is
+        # MPMC, but the zero-copy views contract ("valid until the next
+        # pop") forces one pop at a time through THIS wrapper, else a
+        # second consumer's pop would recycle a slab whose views the
+        # first consumer is still reading
         self._pending_release = None
+        self._pop_lock = threading.Lock()
 
     def push(self, arrays, tag: int, timeout_ms: int = -1) -> int:
         arrs = [np.ascontiguousarray(a) for a in arrays]
@@ -176,31 +182,34 @@ class DataRing:
         """Returns (list_of_array_views, tag) or None when closed+drained.
 
         The views alias native memory that is recycled on the NEXT pop();
-        copy (or device-put) before then.
+        copy (or device-put) before then.  Pops through this wrapper are
+        serialized (see _pop_lock) so that contract is enforceable.
         """
-        if self._pending_release is not None:
-            self._lib.ptpu_ring_release(self._h, self._pending_release)
-            self._pending_release = None
-        ptr = ctypes.c_void_p()
-        ln = ctypes.c_uint64()
-        tag = ctypes.c_uint64()
-        rc = self._lib.ptpu_ring_pop(self._h, ctypes.byref(ptr),
-                                     ctypes.byref(ln), ctypes.byref(tag),
-                                     timeout_ms)
-        if rc == self.CLOSED:
-            return None
-        if rc == self.TIMEOUT:
-            raise TimeoutError("DataRing.pop timed out")
-        with self._meta_lock:
-            meta = self._meta.pop(int(tag.value))
-        buf = (ctypes.c_char * ln.value).from_address(ptr.value)
-        flat = np.frombuffer(buf, dtype=np.uint8)
-        views, off = [], 0
-        for shape, dtype, nbytes in meta:
-            views.append(flat[off:off + nbytes].view(dtype).reshape(shape))
-            off += nbytes
-        self._pending_release = ptr.value
-        return views, int(tag.value)
+        with self._pop_lock:
+            if self._pending_release is not None:
+                self._lib.ptpu_ring_release(self._h, self._pending_release)
+                self._pending_release = None
+            ptr = ctypes.c_void_p()
+            ln = ctypes.c_uint64()
+            tag = ctypes.c_uint64()
+            rc = self._lib.ptpu_ring_pop(self._h, ctypes.byref(ptr),
+                                         ctypes.byref(ln),
+                                         ctypes.byref(tag), timeout_ms)
+            if rc == self.CLOSED:
+                return None
+            if rc == self.TIMEOUT:
+                raise TimeoutError("DataRing.pop timed out")
+            with self._meta_lock:
+                meta = self._meta.pop(int(tag.value))
+            buf = (ctypes.c_char * ln.value).from_address(ptr.value)
+            flat = np.frombuffer(buf, dtype=np.uint8)
+            views, off = [], 0
+            for shape, dtype, nbytes in meta:
+                views.append(
+                    flat[off:off + nbytes].view(dtype).reshape(shape))
+                off += nbytes
+            self._pending_release = ptr.value
+            return views, int(tag.value)
 
     def close(self):
         self._lib.ptpu_ring_close(self._h)

@@ -95,6 +95,21 @@ struct Pool {
     free_count++;
     free_lists[cls].push_back(static_cast<char*>(p));
   }
+
+  // Return every cached (free-list) block to the OS.  Blocks still
+  // handed out are untouched — their release() later just re-caches
+  // them.  Used by the graveyard path so a destroyed-but-unreclaimable
+  // object pins only its shell, not its slabs.
+  void trim() {
+    std::lock_guard<std::mutex> g(mu);
+    for (auto& kv : free_lists) {
+      for (char* p : kv.second) {
+        ::operator delete[](p, std::nothrow);
+        reserved -= kv.first;
+      }
+      kv.second.clear();
+    }
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -142,6 +157,10 @@ struct Ring {
     if (slab == nullptr) {
       lk.lock();
       inflight--;
+      lk.unlock();
+      // the freed reservation may be the room another producer waits
+      // for: without this wake it can sleep forever (missed wakeup)
+      not_full.notify_one();
       return -3;
     }
     uint64_t off = 0;
@@ -154,6 +173,7 @@ struct Ring {
     if (closed) {  // closed while copying
       lk.unlock();
       pool.release(slab);
+      not_full.notify_one();
       return -1;
     }
     q.push_back(Slab{slab, total, tag});
@@ -224,6 +244,11 @@ int64_t ptpu_pool_create() {
   return h;
 }
 
+std::vector<Pool*>& pool_graveyard() {
+  static std::vector<Pool*> g;
+  return g;
+}
+
 void ptpu_pool_destroy(int64_t h) {
   Pool* p;
   {
@@ -232,8 +257,9 @@ void ptpu_pool_destroy(int64_t h) {
     if (it == g_pools.end()) return;
     p = it->second;
     g_pools.erase(it);
+    pool_graveyard().push_back(p);  // see ring_graveyard rationale
   }
-  delete p;
+  p->trim();  // cached blocks back to the OS; only the shell is pinned
 }
 
 void* ptpu_pool_alloc(int64_t h, uint64_t n) {
@@ -269,6 +295,18 @@ int64_t ptpu_ring_create(int capacity) {
   return h;
 }
 
+// Destroyed objects go to a graveyard instead of delete: another thread
+// may still hold a raw pointer from get_ring()/get_pool() or be blocked
+// on the ring's condvars — deleting under it is a use-after-free.  close()
+// wakes every waiter and all later ops fail cleanly via the erased handle;
+// the object itself (a few hundred bytes + its pool, whose slabs ARE
+// freed by close/release) lives until process exit.  Rings are created
+// per-DataLoader-epoch at most — the leak is bounded and tiny.
+std::vector<Ring*>& ring_graveyard() {
+  static std::vector<Ring*> g;
+  return g;
+}
+
 void ptpu_ring_destroy(int64_t h) {
   Ring* r;
   {
@@ -279,7 +317,20 @@ void ptpu_ring_destroy(int64_t h) {
     g_rings.erase(it);
   }
   r->close();
-  delete r;
+  // reclaim the actual memory: drain queued slabs into the pool's free
+  // lists, then trim those to the OS.  A racing producer mid-copy still
+  // holds its own slab; its release() lands in the (trimmed-later-never)
+  // free list — bytes bounded by in-flight batches at destroy time.
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    for (auto& s : r->q) r->pool.release(s.data);
+    r->q.clear();
+  }
+  r->pool.trim();
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    ring_graveyard().push_back(r);
+  }
 }
 
 int ptpu_ring_push_gather(int64_t h, const void* const* srcs,
@@ -473,6 +524,11 @@ int64_t ptpu_wp_create(const char* vocab_data, int64_t len,
   return h;
 }
 
+std::vector<wp::Tok*>& tok_graveyard() {
+  static std::vector<wp::Tok*> g;
+  return g;
+}
+
 void ptpu_wp_destroy(int64_t h) {
   wp::Tok* t;
   {
@@ -481,8 +537,8 @@ void ptpu_wp_destroy(int64_t h) {
     if (it == wp::g_toks.end()) return;
     t = it->second;
     wp::g_toks.erase(it);
+    tok_graveyard().push_back(t);  // see ring_graveyard rationale
   }
-  delete t;
 }
 
 int64_t ptpu_wp_encode(int64_t h, const char* text, int64_t text_len,

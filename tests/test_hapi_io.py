@@ -172,3 +172,153 @@ def test_dataloader_threaded_order_preserved():
                            use_native_ring=False)]
     for s, t in zip(single, threaded):
         np.testing.assert_allclose(s, t)
+
+
+class TestFitContract:
+    """Regressions for the reference hapi fit() contract (ref
+    python/paddle/hapi/model.py:1713, callbacks.py:53)."""
+
+    def _model(self):
+        net = paddle.nn.Sequential(paddle.nn.Linear(2, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(
+            1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        return model
+
+    def test_fit_iterable_dataset_loader(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                rng = np.random.RandomState(0)
+                for _ in range(8):
+                    x = rng.randn(16, 2).astype(np.float32)
+                    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+                    yield x, y.reshape(-1, 1)
+
+        loader = DataLoader(Stream(), batch_size=None)
+        self._model().fit(loader, epochs=1, verbose=0)   # must not raise
+
+    def test_num_iters_bounds_total_steps(self):
+        seen = []
+        class Counter(paddle.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(step)
+
+        model = self._model()
+        model.fit(XorDataset(256), epochs=5, batch_size=32, num_iters=3,
+                  verbose=0, callbacks=[Counter()])
+        assert len(seen) == 3, seen
+
+    def test_lr_scheduler_steps_per_batch(self):
+        net = paddle.nn.Linear(2, 2)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=4, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        model = paddle.Model(net)
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        model.fit(XorDataset(256), epochs=1, batch_size=32, verbose=0)
+        # 8 batches / step_size 4 -> two decays: 0.1 -> 0.05 -> 0.025
+        assert abs(opt.get_lr() - 0.025) < 1e-9, opt.get_lr()
+
+    def test_two_input_model_split_by_spec(self):
+        class TwoIn(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.lin(paddle.concat([a, b], axis=-1))
+
+        class PairDs(Dataset):
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                a = rng.randn(2).astype(np.float32)
+                b = rng.randn(2).astype(np.float32)
+                return a, b   # two inputs, NO label
+
+        specs = [paddle.static.InputSpec([None, 2], "float32", "a"),
+                 paddle.static.InputSpec([None, 2], "float32", "b")]
+        model = paddle.Model(TwoIn(), inputs=specs)
+        model.prepare()
+        out = model.predict(PairDs(), batch_size=16, verbose=0)
+        assert np.asarray(out[0][0]).shape == (16, 2)
+
+    def test_early_stopping_monitors_eval_and_saves_best(self, tmp_path):
+        model = self._model()
+        es = paddle.callbacks.EarlyStopping(monitor="acc", patience=0,
+                                            verbose=0)
+        model.fit(XorDataset(256), eval_data=XorDataset(64, seed=9),
+                  epochs=6, batch_size=32, verbose=0,
+                  save_dir=str(tmp_path), callbacks=[es])
+        assert es.best is not None          # saw eval metrics
+        assert os.path.exists(str(tmp_path))
+
+    def test_early_stopping_warns_without_eval_data(self):
+        import warnings
+        model = self._model()
+        es = paddle.callbacks.EarlyStopping(monitor="acc", verbose=0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            model.fit(XorDataset(64), epochs=1, batch_size=32, verbose=0,
+                      callbacks=[es])
+        assert any("validation data" in str(x.message) for x in w)
+
+
+class TestLoaderContractArgs:
+    def test_worker_init_fn_called_per_worker(self):
+        import threading
+        seen = []
+        lock = threading.Lock()
+
+        def init_fn(wid):
+            with lock:
+                seen.append(wid)
+
+        ds = TensorDataset([paddle.to_tensor(
+            np.arange(32, dtype=np.float32).reshape(32, 1))])
+        loader = DataLoader(ds, batch_size=4, num_workers=2,
+                            worker_init_fn=init_fn)
+        list(loader)
+        assert sorted(seen) == [0, 1], seen
+
+    @pytest.mark.parametrize("native", [False, True])
+    def test_timeout_raises_on_stuck_dataset(self, native):
+        import time
+
+        class Stuck(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                time.sleep(8)
+                return np.zeros(2, np.float32)
+
+        loader = DataLoader(Stuck(), batch_size=2, num_workers=1,
+                            timeout=1, use_native_ring=native)
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="timeout"):
+            list(loader)
+        assert time.time() - t0 < 6   # raised at ~1s, not after the sleep
+
+    def test_distributed_sampler_tiles_tiny_dataset(self):
+        class Tiny(Dataset):
+            def __len__(self):
+                return 3
+
+            def __getitem__(self, i):
+                return i
+
+        counts = []
+        for rank in range(8):
+            s = DistributedBatchSampler(Tiny(), batch_size=1,
+                                        num_replicas=8, rank=rank)
+            counts.append(sum(len(b) for b in s))
+        # every rank must see the same number of samples or dp
+        # collectives deadlock
+        assert len(set(counts)) == 1 and counts[0] == 1, counts
