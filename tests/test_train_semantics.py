@@ -1,0 +1,234 @@
+"""Training-semantics regressions: the eager and compiled paths must
+apply identical update rules (clip, decay, per-param lr), and the
+autograd/amp contracts must match the reference (ref
+python/paddle/optimizer/optimizer.py:449, amp/grad_scaler.py,
+autograd/py_layer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_pylayer_grad_mapping_with_leading_stop_gradient():
+    from paddle_tpu.autograd import PyLayer
+
+    class Mul(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b
+
+        @staticmethod
+        def backward(ctx, g):
+            a, b = ctx.saved_tensor()
+            return g * b, g * a        # one grad per tensor input
+
+    a = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+    a.stop_gradient = True
+    b = paddle.to_tensor(np.full((3,), 5.0, np.float32))
+    b.stop_gradient = False
+    out = Mul.apply(a, b)
+    out.backward(paddle.to_tensor(np.ones(3, np.float32)))
+    # b's grad is dout * a == 2, NOT dout * b == 5 (the misassignment)
+    np.testing.assert_allclose(b.grad.numpy(), np.full((3,), 2.0))
+
+
+def test_grad_scaler_no_double_unscale():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+    loss = (lin(paddle.to_tensor(np.ones((1, 2), np.float32)))).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)                     # clipping recipe
+    g1 = lin.weight.grad.numpy().copy()
+    scaler.step(opt)                         # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g1)
+    assert np.abs(g1).max() > 0.5            # unscaled ~1.0, not 1/1024
+
+
+def test_grad_scaler_step_does_not_advance_counters():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   incr_every_n_steps=1)
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=lin.parameters())
+    loss = (lin(paddle.to_tensor(np.ones((1, 2), np.float32)))).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    assert scaler.get_init_loss_scaling() == 8.0   # update() not called
+    scaler.update()
+    assert scaler.get_init_loss_scaling() == 16.0  # one good step
+
+
+def test_adamw_weight_decay_zero_int_disables_decay():
+    w0 = np.full((2, 2), 3.0, np.float32)
+    lin = nn.Linear(2, 2)
+    lin.weight.set_value(paddle.to_tensor(w0))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1,
+                                 parameters=[lin.weight],
+                                 weight_decay=0)
+    lin.weight.grad = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    opt.step()
+    # zero grad + zero decay -> parameter unchanged
+    np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-7)
+
+
+def test_lamb_exclude_from_weight_decay_fn():
+    wd = 0.5
+    p_dec = nn.Linear(2, 2, bias_attr=False).weight
+    p_exc = nn.Linear(2, 2, bias_attr=False).weight
+    p_exc.name = "layer_norm_scale"
+    v0 = np.full((2, 2), 1.0, np.float32)
+    for p in (p_dec, p_exc):
+        p.set_value(paddle.to_tensor(v0))
+    opt = paddle.optimizer.Lamb(
+        learning_rate=0.1, lamb_weight_decay=wd,
+        parameters=[p_dec, p_exc],
+        exclude_from_weight_decay_fn=lambda p: "norm" in p.name)
+    for p in (p_dec, p_exc):
+        p.grad = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    opt.step()
+    # excluded param: zero grad + zero decay -> trust ratio update is 0
+    np.testing.assert_allclose(p_exc.numpy(), v0, atol=1e-7)
+    assert not np.allclose(p_dec.numpy(), v0)     # decayed
+
+
+def test_static_executor_applies_clip_decay_and_param_lr():
+    """The compiled static path must train EXACTLY like the eager step:
+    same clip, same weight decay, same ParamAttr lr multiplier."""
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(8, 4).astype(np.float32) * 10.0   # big grads -> clip
+    y_np = rng.randn(8, 2).astype(np.float32)
+
+    def eager_result():
+        lin = nn.Linear(4, 2)
+        lin.weight.set_value(paddle.to_tensor(np.ones((4, 2), np.float32)))
+        lin.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        lin.weight.optimize_attr["learning_rate"] = 0.1
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9, weight_decay=0.01,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
+            parameters=lin.parameters())
+        for _ in range(3):
+            loss = ((lin(paddle.to_tensor(x_np))
+                     - paddle.to_tensor(y_np)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return lin.weight.numpy().copy(), lin.bias.numpy().copy()
+
+    def static_result():
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                xd = static.data("ts_x", [None, 4], "float32")
+                yd = static.data("ts_y", [None, 2], "float32")
+                lin = nn.Linear(4, 2)
+                lin.weight.set_value(
+                    paddle.to_tensor(np.ones((4, 2), np.float32)))
+                lin.bias.set_value(
+                    paddle.to_tensor(np.zeros(2, np.float32)))
+                lin.weight.optimize_attr["learning_rate"] = 0.1
+                loss = ((lin(xd) - yd) ** 2).mean()
+                opt = paddle.optimizer.Momentum(
+                    learning_rate=0.05, momentum=0.9, weight_decay=0.01,
+                    grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+                opt.minimize(loss)
+                exe = static.Executor()
+                exe.run(startup)
+                for _ in range(3):
+                    exe.run(main, feed={"ts_x": x_np, "ts_y": y_np},
+                            fetch_list=[loss])
+            return lin.weight.numpy().copy(), lin.bias.numpy().copy()
+        finally:
+            paddle.disable_static()
+
+    we, be = eager_result()
+    ws, bs = static_result()
+    np.testing.assert_allclose(ws, we, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(bs, be, rtol=1e-5, atol=1e-6)
+    assert not np.allclose(we, np.ones((4, 2)))    # something trained
+
+
+def test_param_groups_lr_multiplier_and_wd():
+    slow = nn.Linear(2, 2, bias_attr=False).weight
+    fast = nn.Linear(2, 2, bias_attr=False).weight
+    v0 = np.full((2, 2), 1.0, np.float32)
+    slow.set_value(paddle.to_tensor(v0))
+    fast.set_value(paddle.to_tensor(v0))
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=[{"params": [slow], "learning_rate": 0.1},
+                    {"params": [fast]}])
+    g = np.full((2, 2), 1.0, np.float32)
+    slow.grad = paddle.to_tensor(g)
+    fast.grad = paddle.to_tensor(g)
+    opt.step()
+    np.testing.assert_allclose(fast.numpy(), v0 - 0.1, atol=1e-6)
+    np.testing.assert_allclose(slow.numpy(), v0 - 0.01, atol=1e-6)
+
+
+def test_to_static_forwards_kwargs():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(2, 2)
+
+        def forward(self, x, mask=None, double=False):
+            out = self.lin(x)
+            if mask is not None:
+                out = out * mask
+            if double:
+                out = out * 2.0
+            return out
+
+    net = Net()
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    mask = paddle.to_tensor(np.asarray([[1.0, 0.0], [0.0, 1.0]],
+                                       np.float32))
+    eager = net(x, mask=mask, double=True).numpy()
+    sfn = paddle.jit.to_static(net)
+    np.testing.assert_allclose(sfn(x, mask=mask, double=True).numpy(),
+                               eager, rtol=1e-6)
+    # and the static-kwarg variant retraces correctly
+    np.testing.assert_allclose(sfn(x, mask=mask).numpy(),
+                               net(x, mask=mask).numpy(), rtol=1e-6)
+
+
+def test_dispatch_nondiff_blocks_tape():
+    from paddle_tpu.ops import dispatch
+    import jax.numpy as jnp
+
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    t.stop_gradient = False
+    out = dispatch.call(lambda a: jnp.sum(a * a), t, _nondiff=(0,))
+    assert out._node is None        # declared non-differentiable: no tape
+    out2 = dispatch.call(lambda a: jnp.sum(a * a), t)
+    assert out2._node is not None   # sanity: same call without _nondiff
+
+
+def test_grad_allow_unused_raises():
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    x.stop_gradient = False
+    w = paddle.to_tensor(np.ones(3, np.float32))
+    w.stop_gradient = False
+    loss = (x * 2.0).sum()          # w unused
+    with pytest.raises(RuntimeError, match="unused"):
+        paddle.grad([loss], [w])
+    g, = paddle.grad([(x * 3.0).sum()], [x])   # reachable still works
+    np.testing.assert_allclose(g.numpy(), np.full(3, 3.0))
+
+
+def test_amp_decorate_exported():
+    net = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    model, opt2 = paddle.amp.decorate(models=net, optimizers=opt,
+                                      level="O2")
+    assert model is not None and opt2 is not None
