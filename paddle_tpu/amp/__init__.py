@@ -5,5 +5,6 @@ scaling needed on TPU — bf16 has fp32's exponent range), fp16 only if the
 user insists.  GradScaler exists for API parity and is a near-no-op for
 bf16.
 """
-from .auto_cast import auto_cast, amp_guard, white_list, black_list
+from .auto_cast import (auto_cast, amp_guard, decorate, amp_state,
+                        white_list, black_list)
 from .grad_scaler import GradScaler, AmpScaler

@@ -26,6 +26,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = set()   # optimizer ids already unscaled this step
 
     def scale(self, var):
         if not self._enable:
@@ -38,6 +39,13 @@ class GradScaler:
         fetched to the host (one round-trip per step, not per param)."""
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled:
+            # the standard clipping recipe calls unscale_ before step();
+            # dividing by the scale twice would shrink every update by
+            # 1/scale (ref grad_scaler.py tracks the same per-optimizer
+            # state via OptimizerState.UNSCALED)
+            return
+        self._unscaled.add(id(optimizer))
         inv = 1.0 / self._scale
         grads = []
         for p in optimizer._parameters:
@@ -54,20 +62,25 @@ class GradScaler:
             self._found_inf = False
 
     def step(self, optimizer):
+        """Unscale (if the user hasn't already) and step when finite.
+        Like the reference, step() does NOT advance the dynamic-scaling
+        counters — call update() after (minimize() does both)."""
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._unscaled.clear()
         if not self._dynamic:
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

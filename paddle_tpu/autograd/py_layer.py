@@ -40,8 +40,13 @@ class PyLayer:
         outs_t = (outs,) if single else tuple(outs)
 
         if record:
-            diff_parents = [t for t in tensors
-                            if not t.stop_gradient]
+            # the user's backward returns ONE grad per TENSOR input (the
+            # reference contract) — remember each diff parent's position
+            # in that tuple, so a stop_gradient tensor ahead of a
+            # trainable one doesn't shift the mapping
+            diff_slots = [i for i, t in enumerate(tensors)
+                          if not t.stop_gradient]
+            diff_parents = [tensors[i] for i in diff_slots]
 
             def vjp_fn(cts):
                 if not isinstance(cts, tuple):
@@ -51,7 +56,10 @@ class PyLayer:
                 if not isinstance(grads, (tuple, list)):
                     grads = (grads,)
                 vals = [g.value if isinstance(g, T) else g for g in grads]
-                # map returned grads positionally onto diff parents
+                if len(vals) == len(tensors):
+                    return tuple(vals[i] for i in diff_slots)
+                # short form: user returned grads for the trainable
+                # inputs only
                 return tuple(vals[:len(diff_parents)])
 
             node = Node(vjp_fn=vjp_fn, parents=diff_parents,

@@ -370,7 +370,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for t in inputs:
             g = merged.get(id(t))
             if g is None and not allow_unused:
-                g = Tensor(jnp.zeros(t.shape, t.dtype))
+                raise RuntimeError(
+                    "paddle.grad: one of the inputs is unused in the "
+                    "graph of outputs (no gradient path); pass "
+                    "allow_unused=True to get None for it instead")
             results.append(g)
         return results
 
@@ -386,7 +389,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for t, s in zip(inputs, saved):
             g = t._grad
             if g is None and not allow_unused:
-                g = jnp.zeros(t.shape, t.dtype)
+                raise RuntimeError(
+                    "paddle.grad: one of the inputs is unused in the "
+                    "graph of outputs (no gradient path); pass "
+                    "allow_unused=True to get None for it instead")
             results.append(Tensor(g) if g is not None else None)
     finally:
         for t, s in zip(inputs, saved):

@@ -207,13 +207,14 @@ def extend_with_decoupled_weight_decay(base_optimizer):
                     UserWarning, stacklevel=2)
             return super().minimize(loss, *args, **kwargs)
 
-        def apply_updates_pytree(self, param_vals, grads, states, lr, t):
+        def apply_updates_pytree(self, param_vals, grads, states, lr, t,
+                                 params=None):
             # static-Executor path: decay folded into the jitted update
             if self._wd_coeff:
                 c = self._wd_coeff
                 param_vals = [v - v * c for v in param_vals]
             return super().apply_updates_pytree(param_vals, grads, states,
-                                                lr, t)
+                                                lr, t, params=params)
 
     OptimizerWithDecoupledWeightDecay.__name__ = (
         f"{base_optimizer.__name__}WithDecoupledWeightDecay")

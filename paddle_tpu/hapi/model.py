@@ -90,7 +90,8 @@ class Model:
             new_p, new_s = opt.apply_updates_pytree(
                 [pv[n] for n in trainable],
                 [grads[n] for n in trainable],
-                states, lr, t)
+                states, lr, t,
+                params=[params[n] for n in trainable])
             pv2 = dict(pv)
             for n, v in zip(trainable, new_p):
                 pv2[n] = v

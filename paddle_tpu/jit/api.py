@@ -47,6 +47,10 @@ def _to_tensors(vals):
     return jax.tree_util.tree_map(wrap, vals)
 
 
+def _to_tensors_kw(kw_vals):
+    return {k: Tensor(v) for k, v in kw_vals.items()}
+
+
 class TracedLayer:
     """jit-compiled callable around a Layer or plain function."""
 
@@ -56,37 +60,49 @@ class TracedLayer:
         self._input_spec = input_spec
         self._jitted = {}
 
-    def _get_jitted(self, training):
-        if training not in self._jitted:
+    def _get_jitted(self, training, static_kw=()):
+        key = (training, static_kw)
+        if key not in self._jitted:
             layer = self._layer
+            skw = dict(static_kw)
 
             if layer is not None:
-                def staged(param_vals, buffer_vals, rng, arg_vals):
+                def staged(param_vals, buffer_vals, rng, arg_vals, kw_vals):
                     out, new_buf = fx.functional_call(
                         layer, param_vals, buffer_vals, arg_vals,
+                        kwargs={**_to_tensors_kw(kw_vals), **skw},
                         rng_key=rng)
                     return out, new_buf
-                self._jitted[training] = jax.jit(staged)
+                self._jitted[key] = jax.jit(staged)
             else:
-                def staged(rng, arg_vals):
+                def staged(rng, arg_vals, kw_vals):
                     with fx.trace_mode(rng):
                         args = _to_tensors(arg_vals)
-                        out = self._fn(*args)
+                        out = self._fn(*args, **_to_tensors_kw(kw_vals),
+                                       **skw)
                     return _to_vals(out)
-                self._jitted[training] = jax.jit(staged)
-        return self._jitted[training]
+                self._jitted[key] = jax.jit(staged)
+        return self._jitted[key]
 
     def __call__(self, *args, **kwargs):
+        from ..tensor.tensor import Tensor as _T
+        # tensor kwargs are traced values; everything else is a static
+        # compile-time constant folded into the cache key (a traced bool
+        # would break `if flag:` python control flow in the forward)
+        kw_vals = {k: v.value for k, v in kwargs.items()
+                   if isinstance(v, _T)}
+        static_kw = tuple(sorted(
+            (k, v) for k, v in kwargs.items() if not isinstance(v, _T)))
         arg_vals = _to_vals(args)
         rng = core.next_rng_key()
         if self._layer is not None:
             pv, bv = fx.param_arrays(self._layer)
-            jfn = self._get_jitted(self._layer.training)
-            out, new_buf = jfn(pv, bv, rng, arg_vals)
+            jfn = self._get_jitted(self._layer.training, static_kw)
+            out, new_buf = jfn(pv, bv, rng, arg_vals, kw_vals)
             fx.write_back(self._layer, buffer_vals=new_buf)
         else:
-            jfn = self._get_jitted(True)
-            out = jfn(rng, arg_vals)
+            jfn = self._get_jitted(True, static_kw)
+            out = jfn(rng, arg_vals, kw_vals)
         return _to_tensors(out)
 
     # pass-throughs so a wrapped layer still acts like one

@@ -97,10 +97,25 @@ def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
               and any(not leaves[i].stop_gradient for i in tensor_pos))
 
     if record:
-        # positions of differentiable operands: require grad + inexact dtype
+        # leaf positions excluded by _nondiff (declared per POSITIONAL
+        # arg): args flatten ahead of kwargs, so per-arg leaf spans are
+        # a running prefix of `leaves`
+        nd_leaves = set()
+        if _nondiff:
+            off = 0
+            for ai, a in enumerate(args):
+                cnt = len(tree_util.tree_flatten(
+                    a, is_leaf=lambda x: isinstance(x, Tensor))[0])
+                if ai in _nondiff:
+                    nd_leaves.update(range(off, off + cnt))
+                off += cnt
+        # positions of differentiable operands: require grad + inexact
+        # dtype + not declared non-differentiable (index operands,
+        # decoded paths through argmax/sort the author excluded)
         diff_pos = [i for i in tensor_pos
                     if not leaves[i].stop_gradient
-                    and jnp.issubdtype(leaves[i].dtype, jnp.inexact)]
+                    and jnp.issubdtype(leaves[i].dtype, jnp.inexact)
+                    and i not in nd_leaves]
     if not record or not diff_pos:
         vals = [l.value if isinstance(l, Tensor) else l for l in leaves]
         a, k = tree_util.tree_unflatten(treedef, vals)

@@ -30,11 +30,23 @@ class Optimizer:
         self._accumulators = collections.defaultdict(dict)  # name -> {pid: arr}
         self._step_count = 0
         self._param_groups = None
+        self._param_wd = {}       # id(p) -> per-group weight_decay override
         if (self._parameters and isinstance(self._parameters[0], dict)):
             self._param_groups = self._parameters
             self._parameters = []
             for g in self._param_groups:
-                self._parameters.extend(g["params"])
+                ps = list(g["params"])
+                self._parameters.extend(ps)
+                # per-group options ride the per-param mechanisms: the
+                # group lr is a multiplier on the base lr (the ParamAttr
+                # convention, ref optimizer.py:449 optimize_attr) and
+                # weight_decay overrides the global one for these params
+                for p in ps:
+                    if "learning_rate" in g and isinstance(p, Parameter):
+                        p.optimize_attr["learning_rate"] = float(
+                            g["learning_rate"])
+                    if "weight_decay" in g:
+                        self._param_wd[id(p)] = g["weight_decay"]
 
     # ------------------------------------------------------------------ lr
     def get_lr(self):
@@ -76,19 +88,35 @@ class Optimizer:
         raise NotImplementedError
 
     # ---------------------------------------------------------------- step
-    def _apply_decay(self, p, g):
-        wd = self._weight_decay
+    def _decay_term(self, p, pv):
+        """Coupled weight-decay gradient term for parameter ``p`` at value
+        ``pv`` (the TRACED value under jit — reading p.value there would
+        bake a stale constant).  None when no decay applies.  Decoupled
+        optimizers (AdamW) override this to None and decay in _update."""
+        wd = self._param_wd.get(id(p), self._weight_decay) \
+            if p is not None else self._weight_decay
         if wd is None:
-            return g
+            return None
         from ..regularizer import L1Decay, L2Decay
-        reg = p.regularizer if getattr(p, "regularizer", None) is not None \
+        reg = p.regularizer if (p is not None and
+                                getattr(p, "regularizer", None) is not None) \
             else wd
-        if isinstance(reg, float):
-            reg = L2Decay(reg)
+        if isinstance(reg, (int, float)):
+            reg = L2Decay(float(reg))
         if isinstance(reg, (L1Decay, L2Decay)):
-            # decoupled optimizers (AdamW) override this
-            return g + reg.grad_term(p.value)
-        return g
+            return reg.grad_term(pv)
+        return None
+
+    def _apply_decay(self, p, g):
+        term = self._decay_term(p, p.value)
+        return g if term is None else g + term
+
+    def _update_with_param(self, p, pv, g, state, lr, t):
+        """Update rule with the Parameter in hand — the single funnel for
+        BOTH the eager step and the compiled pytree path, so per-param
+        behavior (AdamW/Lamb decay exclusion) can't diverge between
+        them.  ``p`` may be None (pytree path without metadata)."""
+        return self._update(pv, g, state, lr, t)
 
     def step(self):
         params_grads = []
@@ -110,8 +138,8 @@ class Optimizer:
             lr = lr_global * p.optimize_attr.get("learning_rate", 1.0) \
                 if isinstance(p, Parameter) else lr_global
             state = self._state_for(p)
-            new_val, new_state = self._update(p.value, g, state, lr,
-                                              self._step_count)
+            new_val, new_state = self._update_with_param(
+                p, p.value, g, state, lr, self._step_count)
             p.value = new_val
             place = getattr(self, "_accumulator_placement", None)
             for nm, sv in new_state.items():
@@ -198,12 +226,34 @@ class Optimizer:
             for p in params
         ]
 
-    def apply_updates_pytree(self, param_vals, grads, states, lr, step=1):
-        """Pure function: apply the update rule across lists of arrays.
-        Used inside jax.jit train steps (see hapi/model.py, jit/api.py)."""
+    def apply_updates_pytree(self, param_vals, grads, states, lr, step=1,
+                             params=None):
+        """Pure function: apply the FULL update semantics — grad clip,
+        weight decay/regularizers, per-param lr multipliers — across
+        lists of arrays, exactly like the eager step (the compiled and
+        eager paths must train identically).  Used inside jax.jit train
+        steps (see hapi/model.py, static/graph.py).  ``params`` carries
+        the Parameter objects aligned with param_vals; without it the
+        per-param attrs are skipped (no fallback to self._parameters —
+        its ordering is registration order, not the caller's)."""
+        if self._grad_clip is not None:
+            # clip classes are pure jnp over (param, raw-grad) pairs —
+            # exactly what the eager step feeds them
+            ps = params if params is not None else param_vals
+            pairs = self._grad_clip(list(zip(ps, grads)))
+            grads = [g for _, g in pairs]
         new_ps, new_ss = [], []
-        for pv, g, st in zip(param_vals, grads, states):
-            np_, ns_ = self._update(pv, g, st, lr, step)
+        for i, (pv, g, st) in enumerate(zip(param_vals, grads, states)):
+            p = params[i] if params is not None else None
+            term = self._decay_term(p, pv)
+            if term is not None:
+                g = g + term
+            lr_i = lr
+            if isinstance(p, Parameter):
+                mult = p.optimize_attr.get("learning_rate", 1.0)
+                if mult != 1.0:
+                    lr_i = lr * mult
+            np_, ns_ = self._update_with_param(p, pv, g, st, lr_i, step)
             new_ps.append(np_)
             new_ss.append(ns_)
         return new_ps, new_ss

@@ -110,11 +110,18 @@ class AdamW(Adam):
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip)
-        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        # numeric 0 (incl. the int spelling) must DISABLE decay — only an
+        # omitted value falls back to the reference default 0.01
+        if weight_decay is None:
+            self._coeff = 0.01
+        elif isinstance(weight_decay, (int, float)):
+            self._coeff = float(weight_decay)
+        else:
+            self._coeff = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
 
-    def _apply_decay(self, p, g):
-        return g  # decoupled: applied inside _update
+    def _decay_term(self, p, pv):
+        return None  # decoupled: applied inside _update
 
     def _update(self, p, g, state, lr, t=1):
         decay = self._coeff
@@ -124,27 +131,17 @@ class AdamW(Adam):
             new_p = new_p.astype(jnp.float32) - lr * decay * pf
         return new_p.astype(p.dtype), new_state
 
-    def _apply_gradients(self, params_grads):
-        if self._apply_decay_param_fun is not None:
-            # temporarily zero the coeff for excluded params
-            coeff = self._coeff
-            out = []
-            if self._grad_clip is not None:
-                params_grads = self._grad_clip(params_grads)
-                clip, self._grad_clip = self._grad_clip, None
-            else:
-                clip = None
-            for p, g in params_grads:
-                self._coeff = coeff if self._apply_decay_param_fun(p.name) \
-                    else 0.0
-                super()._apply_gradients([(p, g)])
-                self._step_count -= 1
-            self._step_count += 1
-            self._coeff = coeff
-            if clip is not None:
-                self._grad_clip = clip
-            return
-        super()._apply_gradients(params_grads)
+    def _update_with_param(self, p, pv, g, state, lr, t):
+        # honor apply_decay_param_fun on BOTH the eager and compiled
+        # paths: zero the coeff for excluded params around the update
+        if (self._apply_decay_param_fun is not None and p is not None
+                and not self._apply_decay_param_fun(p.name)):
+            coeff, self._coeff = self._coeff, 0.0
+            try:
+                return self._update(pv, g, state, lr, t)
+            finally:
+                self._coeff = coeff
+        return self._update(pv, g, state, lr, t)
 
 
 class Adamax(Optimizer):
@@ -203,6 +200,18 @@ class Lamb(Optimizer):
 
     def _init_accumulator(self, name, p):
         return jnp.zeros(p.value.shape, jnp.float32)
+
+    def _update_with_param(self, p, pv, g, state, lr, t):
+        # the LAMB recipe excludes norm/bias params from decay via
+        # exclude_from_weight_decay_fn — honored on both step paths
+        if (self._exclude_fn is not None and p is not None
+                and self._exclude_fn(p)):
+            wd, self._lamb_wd = self._lamb_wd, 0.0
+            try:
+                return self._update(pv, g, state, lr, t)
+            finally:
+                self._lamb_wd = wd
+        return self._update(pv, g, state, lr, t)
 
     def _update(self, p, g, state, lr, t=1):
         gf = g.astype(jnp.float32)

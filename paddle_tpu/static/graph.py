@@ -443,6 +443,10 @@ class Executor:
 
         if program.train_spec is not None:
             loss_id, opt = program.train_spec
+            # Parameter objects aligned with param_vals: per-param attrs
+            # (optimize_attr lr, regularizer, need_clip, decay-exclusion
+            # names) must reach the compiled update like the eager step
+            param_objs = [program.params[i] for i in param_ids]
 
             def train_step(feed_vals, param_vals, cap_vals, states, lr, t):
                 if getattr(opt, "_recompute", False):
@@ -464,7 +468,8 @@ class Executor:
                     grads, env = jax.grad(
                         loss_of, has_aux=True)(list(param_vals))
                 new_params, new_states = opt.apply_updates_pytree(
-                    list(param_vals), grads, states, lr, t)
+                    list(param_vals), grads, states, lr, t,
+                    params=param_objs)
                 fetches = tuple(
                     eval_fetch(env, i, feed_vals, param_vals, cap_vals)
                     for i in fetch_ids)
