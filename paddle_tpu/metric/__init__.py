@@ -147,9 +147,12 @@ class Auc(Metric):
         tot_neg = self._stat_neg.sum()
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
-        # trapezoid over thresholds descending
-        tp = np.cumsum(self._stat_pos[::-1])
-        fp = np.cumsum(self._stat_neg[::-1])
+        # trapezoid over thresholds descending, anchored at (0,0) like
+        # the reference's loop starting from tot_pos=tot_neg=0 — without
+        # the anchor the first trapezoid's area is dropped (degenerate
+        # one-bucket distributions returned 0.0 instead of 0.5)
+        tp = np.concatenate([[0.0], np.cumsum(self._stat_pos[::-1])])
+        fp = np.concatenate([[0.0], np.cumsum(self._stat_neg[::-1])])
         tpr = tp / tot_pos
         fpr = fp / tot_neg
         return float(np.trapezoid(tpr, fpr))

@@ -377,19 +377,27 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
     r = downscale_factor
     def _pu(a):
-        if data_format == "NCHW":
-            n, c, h, w = a.shape
-            a = a.reshape(n, c, h // r, r, w // r, r)
-            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
-            return a.reshape(n, c * r * r, h // r, w // r)
-        raise NotImplementedError
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        a = a.reshape(n, c * r * r, h // r, w // r)
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 2, 3, 1))
+        return a
     return call(_pu, x, _name="pixel_unshuffle")
 
 
 def channel_shuffle(x, groups, data_format="NCHW", name=None):
     def _csh(a):
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 3, 1, 2))
         n, c, h, w = a.shape
         a = a.reshape(n, groups, c // groups, h, w)
         a = jnp.swapaxes(a, 1, 2)
-        return a.reshape(n, c, h, w)
+        a = a.reshape(n, c, h, w)
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 2, 3, 1))
+        return a
     return call(_csh, x, _name="channel_shuffle")

@@ -51,6 +51,8 @@ def gather_tree(ids, parents):
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                    name=None):
     def _ts(a):
+        if data_format != "NCHW":
+            a = jnp.transpose(a, (0, 3, 1, 2))
         n, c, h, w = a.shape
         b = n // seg_num
         a = a.reshape(b, seg_num, c, h, w)
@@ -61,5 +63,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                                a[:, :-1, fold:2 * fold]], axis=1)
         rest = a[:, :, 2 * fold:]
         out = jnp.concatenate([left, mid, rest], axis=2)
-        return out.reshape(n, c, h, w)
+        out = out.reshape(n, c, h, w)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
     return call(_ts, x, _name="temporal_shift")

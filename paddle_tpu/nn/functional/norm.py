@@ -192,16 +192,23 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
                   data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
     def _in(a, *wb):
-        axes = tuple(range(2, a.ndim))
+        # per-(sample, channel) statistics over the SPATIAL axes only
+        if channel_last:
+            axes = tuple(range(1, a.ndim - 1))
+            cshape = [1] * (a.ndim - 1) + [a.shape[-1]]
+        else:
+            axes = tuple(range(2, a.ndim))
+            cshape = [1, a.shape[1]] + [1] * (a.ndim - 2)
         mean = jnp.mean(a, axis=axes, keepdims=True)
         var = jnp.var(a, axis=axes, keepdims=True)
         out = (a - mean) * jax.lax.rsqrt(var + eps)
         if wb:
-            shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
-            out = out * wb[0].reshape(shape)
+            out = out * wb[0].reshape(cshape)
             if len(wb) > 1:
-                out = out + wb[1].reshape(shape)
+                out = out + wb[1].reshape(cshape)
         return out
     args = [a for a in (weight, bias) if a is not None]
     return call(_in, x, *args, _name="instance_norm")

@@ -10,42 +10,55 @@ from ...ops.dispatch import call
 from .conv import _tup, _padding
 
 
+def _window_geometry(nd, a_shape, k, s, pad, ceil_mode, channel_last):
+    """(dims, strides, pads) for reduce_window — ONE source of truth for
+    layout + ceil_mode so the value and argmax-mask paths can't drift."""
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ([(0, 0)] + list(pad) + [(0, 0)]) \
+            if not isinstance(pad, str) else pad
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ([(0, 0), (0, 0)] + list(pad)) \
+            if not isinstance(pad, str) else pad
+    if isinstance(pads, str):
+        pads = jax.lax.padtype_to_pads(a_shape, dims, strides, pads)
+    if ceil_mode:
+        # extend padding on the high side so the last partial window counts
+        pads = list(pads)
+        sp_off = 1 if channel_last else 2
+        for i in range(nd):
+            ax = sp_off + i
+            eff = a_shape[ax] + pads[ax][0] + pads[ax][1]
+            rem = (eff - dims[ax]) % strides[ax]
+            if rem != 0:
+                pads[ax] = (pads[ax][0], pads[ax][1] + strides[ax] - rem)
+    return dims, strides, pads
+
+
 def _pool_nd(nd, x, kernel, stride, padding, mode, ceil_mode, exclusive,
-             data_format, opname):
+             data_format, opname, divisor_override=None):
     channel_last = not data_format.startswith("NC")
     k = _tup(kernel, nd)
     s = _tup(stride if stride is not None else kernel, nd)
     pad = _padding(padding, nd)
 
     def _pool(a):
-        if channel_last:
-            dims = (1,) + k + (1,)
-            strides = (1,) + s + (1,)
-            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
-        else:
-            dims = (1, 1) + k
-            strides = (1, 1) + s
-            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
-        if isinstance(pads, str):
-            pads = jax.lax.padtype_to_pads(a.shape, dims, strides, pads)
-        if ceil_mode:
-            # extend padding on the high side so the last partial window counts
-            pads = list(pads)
-            sp_off = 2 if not channel_last else 1
-            for i in range(nd):
-                ax = sp_off + i
-                eff = a.shape[ax] + pads[ax][0] + pads[ax][1]
-                rem = (eff - dims[ax]) % strides[ax]
-                if rem != 0:
-                    pads[ax] = (pads[ax][0], pads[ax][1] + strides[ax] - rem)
+        dims, strides, pads = _window_geometry(nd, a.shape, k, s, pad,
+                                               ceil_mode, channel_last)
         if mode == "max":
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
                 jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, dims, strides,
                                          pads)
-        ones = jnp.ones_like(a)
-        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
-        if exclusive:
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        if divisor_override:
+            counts = float(divisor_override)
+        elif exclusive:
+            ones = jnp.ones_like(a)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
                                            strides, pads)
         else:
@@ -85,20 +98,22 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 def _pool_mask(nd, x, kernel, stride, padding, ceil_mode, data_format):
-    """argmax indices within each window (flattened spatial index)."""
+    """argmax indices within each window (flattened spatial index) —
+    same geometry as the value path via _window_geometry, so ceil_mode
+    and channel-last layouts index correctly."""
+    channel_last = not data_format.startswith("NC")
     k = _tup(kernel, nd)
     s = _tup(stride if stride is not None else kernel, nd)
     pad = _padding(padding, nd)
 
     def _mask(a):
-        spatial = a.shape[2:]
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
         flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        if channel_last:
+            flat_idx = flat_idx[None, ..., None]
         flat_idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.float32)
-        dims = (1, 1) + k
-        strides = (1, 1) + s
-        pads = [(0, 0), (0, 0)] + (list(pad) if not isinstance(pad, str) else
-                                   jax.lax.padtype_to_pads(a.shape, dims,
-                                                           strides, pad)[2:])
+        dims, strides, pads = _window_geometry(nd, a.shape, k, s, pad,
+                                               ceil_mode, channel_last)
 
         def reducer(l, r):
             lv, li = l
@@ -123,14 +138,16 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool_nd(2, x, kernel_size, stride, padding, "avg", ceil_mode,
-                    exclusive, data_format, "avg_pool2d")
+                    exclusive, data_format, "avg_pool2d",
+                    divisor_override=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return _pool_nd(3, x, kernel_size, stride, padding, "avg", ceil_mode,
-                    exclusive, data_format, "avg_pool3d")
+                    exclusive, data_format, "avg_pool3d",
+                    divisor_override=divisor_override)
 
 
 def _adaptive_pool_nd(nd, x, output_size, mode, opname, return_mask=False):
@@ -179,16 +196,51 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool_nd(3, x, output_size, "avg", "adaptive_avg_pool3d")
 
 
+def _adaptive_max_mask(nd, x, output_size):
+    """Flattened-spatial argmax index per adaptive bin (the reference's
+    return_mask contract — indices, not values)."""
+    out_sz = _tup(output_size, nd)
+
+    def _m(a):
+        spatial = a.shape[2:]
+        osz = [int(out_sz[i]) if out_sz[i] is not None else spatial[i]
+               for i in range(nd)]
+        flat = jnp.broadcast_to(
+            jnp.arange(int(np.prod(spatial))).reshape(spatial), a.shape)
+
+        def bin_argmax(pos):
+            sl = tuple(
+                slice((p * spatial[i]) // osz[i],
+                      -(-((p + 1) * spatial[i]) // osz[i]))
+                for i, p in enumerate(pos))
+            lead = (slice(None), slice(None))
+            w2 = a[lead + sl].reshape(a.shape[:2] + (-1,))
+            f2 = flat[lead + sl].reshape(a.shape[:2] + (-1,))
+            am = jnp.argmax(w2, -1)
+            return jnp.take_along_axis(f2, am[..., None], -1)[..., 0]
+
+        idxs = [bin_argmax(pos) for pos in np.ndindex(*osz)]
+        return (jnp.stack(idxs, -1)
+                .reshape(a.shape[:2] + tuple(osz)).astype(jnp.int32))
+    return call(_m, x, _name="adaptive_max_mask")
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     out = _adaptive_pool_nd(1, x, output_size, "max", "adaptive_max_pool1d")
-    return (out, out) if return_mask else out
+    if return_mask:
+        return out, _adaptive_max_mask(1, x, output_size)
+    return out
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out = _adaptive_pool_nd(2, x, output_size, "max", "adaptive_max_pool2d")
-    return (out, out) if return_mask else out
+    if return_mask:
+        return out, _adaptive_max_mask(2, x, output_size)
+    return out
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     out = _adaptive_pool_nd(3, x, output_size, "max", "adaptive_max_pool3d")
-    return (out, out) if return_mask else out
+    if return_mask:
+        return out, _adaptive_max_mask(3, x, output_size)
+    return out

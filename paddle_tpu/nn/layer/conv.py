@@ -47,6 +47,24 @@ class _ConvNd(Layer):
         return (f"{self._in_channels}, {self._out_channels}, "
                 f"kernel_size={list(self._kernel_size)}, stride={self._stride}")
 
+    def _prepad(self, x):
+        """Non-zero padding modes (reflect/replicate/circular) pre-pad the
+        input explicitly, then the conv runs unpadded — lax convs only
+        zero-pad (ref nn/layer/conv.py applies F.pad the same way)."""
+        if self._padding_mode == "zeros":
+            return x, self._padding
+        pad = self._padding
+        if isinstance(pad, int):
+            pad = [pad] * self._nd
+        pad = [int(p) for p in pad]
+        # partial trailing-spatial form, last dim first
+        flat = []
+        for p in pad[::-1]:
+            flat += [p, p]
+        x = F.pad(x, flat, mode=self._padding_mode,
+                  data_format=self._data_format)
+        return x, 0
+
 
 class Conv1D(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
@@ -57,8 +75,9 @@ class Conv1D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
+        x, padding = self._prepad(x)
         return F.conv1d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
+                        padding, self._dilation, self._groups,
                         self._data_format)
 
 
@@ -71,8 +90,9 @@ class Conv2D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
+        x, padding = self._prepad(x)
         return F.conv2d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
+                        padding, self._dilation, self._groups,
                         self._data_format)
 
 
@@ -85,8 +105,9 @@ class Conv3D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
+        x, padding = self._prepad(x)
         return F.conv3d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
+                        padding, self._dilation, self._groups,
                         self._data_format)
 
 

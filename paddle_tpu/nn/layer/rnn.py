@@ -162,13 +162,22 @@ class GRUCell(RNNCellBase):
         return h, h
 
 
-def _scan_cell(cell, inputs, initial_states, time_major, reverse=False):
-    """Run a cell over time with lax.scan as ONE dispatched primitive."""
+def _scan_cell(cell, inputs, initial_states, time_major, reverse=False,
+               sequence_length=None):
+    """Run a cell over time with lax.scan as ONE dispatched primitive.
+
+    ``sequence_length`` follows the reference contract (ref
+    fluid/layers/rnn.py::rnn _maybe_copy): STATES freeze once a row's
+    valid length is consumed (so final states are the states at
+    lengths[b]-1), while outputs stay the raw per-step cell product.
+    For a reverse scan the flipped mask means padding steps run first on
+    the frozen initial state."""
     params = {k: v for k, v in cell.named_parameters()}
     names = list(params.keys())
     is_lstm = isinstance(cell, LSTMCell)
+    masked = sequence_length is not None
 
-    def _run(x, states, *pvals):
+    def _run(x, states, lens, *pvals):
         pd = dict(zip(names, pvals))
         wi, wh = pd["weight_ih"], pd["weight_hh"]
         bi, bh = pd["bias_ih"], pd["bias_hh"]
@@ -176,9 +185,21 @@ def _scan_cell(cell, inputs, initial_states, time_major, reverse=False):
             x = jnp.swapaxes(x, 0, 1)  # [T,B,I]
         if reverse:
             x = jnp.flip(x, 0)
+        T = x.shape[0]
+        if masked:
+            mask = (jnp.arange(T)[:, None]
+                    < jnp.asarray(lens, jnp.int32)[None, :])  # [T,B]
+            if reverse:
+                mask = jnp.flip(mask, 0)
+        else:
+            mask = jnp.ones((T, x.shape[1]), bool)
+
+        def keep(new, old, m):
+            return jnp.where(m[:, None], new, old)
 
         if is_lstm:
-            def step(carry, xt):
+            def step(carry, inp):
+                xt, m = inp
                 h, c = carry
                 gates = xt @ wi.T + bi + h @ wh.T + bh
                 i, f, g, o = jnp.split(gates, 4, axis=-1)
@@ -186,10 +207,11 @@ def _scan_cell(cell, inputs, initial_states, time_major, reverse=False):
                 g = jnp.tanh(g); o = jax.nn.sigmoid(o)
                 c2 = f * c + i * g
                 h2 = o * jnp.tanh(c2)
-                return (h2, c2), h2
-            carry, ys = jax.lax.scan(step, states, x)
+                return (keep(h2, h, m), keep(c2, c, m)), h2
+            carry, ys = jax.lax.scan(step, states, (x, mask))
         elif isinstance(cell, GRUCell):
-            def step(h, xt):
+            def step(h, inp):
+                xt, m = inp
                 xg = xt @ wi.T + bi
                 hg = h @ wh.T + bh
                 xr, xz, xn = jnp.split(xg, 3, axis=-1)
@@ -198,15 +220,16 @@ def _scan_cell(cell, inputs, initial_states, time_major, reverse=False):
                 z = jax.nn.sigmoid(xz + hz)
                 n = jnp.tanh(xn + r * hn)
                 h2 = (1 - z) * n + z * h
-                return h2, h2
-            carry, ys = jax.lax.scan(step, states, x)
+                return keep(h2, h, m), h2
+            carry, ys = jax.lax.scan(step, states, (x, mask))
         else:
             act = jnp.tanh if cell.activation == "tanh" else jax.nn.relu
 
-            def step(h, xt):
+            def step(h, inp):
+                xt, m = inp
                 h2 = act(xt @ wi.T + bi + h @ wh.T + bh)
-                return h2, h2
-            carry, ys = jax.lax.scan(step, states, x)
+                return keep(h2, h, m), h2
+            carry, ys = jax.lax.scan(step, states, (x, mask))
         if reverse:
             ys = jnp.flip(ys, 0)
         if not time_major:
@@ -214,7 +237,13 @@ def _scan_cell(cell, inputs, initial_states, time_major, reverse=False):
         return (ys,) + (tuple(carry) if isinstance(carry, tuple) else (carry,))
 
     pvals = [params[n] for n in names]
-    outs = call(_run, inputs, initial_states, *pvals, _name="rnn_scan")
+    if sequence_length is None:
+        batch = inputs.shape[0 if not time_major else 1]
+        sequence_length = jnp.full((int(batch),),
+                                   inputs.shape[1 if not time_major else 0],
+                                   jnp.int32)
+    outs = call(_run, inputs, initial_states, sequence_length, *pvals,
+                _nondiff=(2,), _name="rnn_scan")
     ys = outs[0]
     final = outs[1:] if len(outs) > 2 else outs[1]
     return ys, final
@@ -234,7 +263,7 @@ class RNN(Layer):
             initial_states = self.cell.get_initial_states(
                 inputs, batch_dim_idx=batch_idx)
         return _scan_cell(self.cell, inputs, initial_states, self.time_major,
-                          self.is_reverse)
+                          self.is_reverse, sequence_length=sequence_length)
 
 
 class BiRNN(Layer):
@@ -254,9 +283,11 @@ class BiRNN(Layer):
                                                    batch_dim_idx=batch_idx)
         else:
             s_fw, s_bw = initial_states
-        y_fw, f_fw = _scan_cell(self.cell_fw, inputs, s_fw, self.time_major)
+        y_fw, f_fw = _scan_cell(self.cell_fw, inputs, s_fw, self.time_major,
+                                sequence_length=sequence_length)
         y_bw, f_bw = _scan_cell(self.cell_bw, inputs, s_bw, self.time_major,
-                                reverse=True)
+                                reverse=True,
+                                sequence_length=sequence_length)
         out = manip.concat([y_fw, y_bw], axis=-1)
         return out, (f_fw, f_bw)
 

@@ -88,15 +88,20 @@ class MultiHeadAttention(Layer):
         value = query if value is None else value
         q, k, v, cache = self._prepare_qkv(query, key, value, cache)
         mask = _convert_attention_mask(attn_mask, q.dtype)
-        if self.need_weights or mask is not None:
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask)
+        # the reference drops entries of the softmax WEIGHT matrix, not
+        # the projected output (ref nn/layer/transformer.py:409); the
+        # flash kernel has no dropout, so training with attention
+        # dropout routes through the dense path
+        attn_do = self.dropout if self.training else 0.0
+        if self.need_weights or mask is not None or attn_do > 0:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=attn_do,
+                training=self.training)
         else:
             out = F.flash_attention(q, k, v)
         B = out.shape[0]
         out = manip.reshape(out, [B, -1, self.embed_dim])
         out = self.out_proj(out)
-        if self.training and self.dropout > 0:
-            out = F.dropout(out, self.dropout, training=True)
         outs = [out]
         if self.need_weights:
             outs.append(None)

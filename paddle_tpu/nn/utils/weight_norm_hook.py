@@ -49,9 +49,10 @@ class WeightNorm:
         layer.add_parameter(name + "_v", v)
         layer.add_parameter(name + "_g", g)
         object.__setattr__(layer, name, fn.compute_weight(layer))
-        layer.register_forward_pre_hook(
+        hook = layer.register_forward_pre_hook(
             lambda l, inp: object.__setattr__(l, name, fn.compute_weight(l)))
         layer._weight_norm_fn = fn
+        layer._weight_norm_hook = hook
         return fn
 
 
@@ -67,7 +68,13 @@ def remove_weight_norm(layer, name="weight"):
     w = fn.compute_weight(layer)
     del layer._parameters[name + "_g"]
     del layer._parameters[name + "_v"]
-    layer._forward_pre_hooks.clear()
+    # remove ONLY this hook — the layer may carry unrelated pre-hooks
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is not None:
+        hook.remove()
+        del layer._weight_norm_hook
+    else:
+        layer._forward_pre_hooks.clear()
     layer.add_parameter(name, Parameter(w.value))
     del layer._weight_norm_fn
     return layer

@@ -35,8 +35,10 @@ def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
     if dtype is None:
-        dtype = core.get_default_dtype() if isinstance(fill_value, float) else None
-    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype) if dtype else None))
+        # ref creation.py:440 — dtype=None ALWAYS means float32, even
+        # for int/bool fill values (full([2], 7) is float, not int)
+        dtype = core.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
 
 
 def empty(shape, dtype=None, name=None):

@@ -79,7 +79,15 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 abs = _unary(jnp.abs, "abs")
 ceil = _unary(jnp.ceil, "ceil")
 floor = _unary(jnp.floor, "floor")
-round = _unary(jnp.round, "round")
+def _round_half_away(x):
+    # paddle rounds half AWAY FROM ZERO (ref round op); jnp.round is
+    # banker's rounding (half-to-even)
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return x
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+round = _unary(_round_half_away, "round")
 trunc = _unary(jnp.trunc, "trunc")
 exp = _unary(jnp.exp, "exp")
 expm1 = _unary(jnp.expm1, "expm1")

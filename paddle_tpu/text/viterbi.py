@@ -1,5 +1,10 @@
-"""Viterbi decode (ref: paddle.text.viterbi_decode in later paddle; CRF
-decoding from fluid linear_chain_crf_op) — lax.scan dynamic program."""
+"""Viterbi decode (ref: paddle.text.viterbi_decode / ViterbiDecoder; the
+phi viterbi_decode kernel semantics) — lax.scan dynamic program.
+
+Reference contract: ``lengths`` bounds each row's decode (padding steps
+neither score nor appear in the path — trailing path slots are 0), and
+``include_bos_eos_tag=True`` treats transitions row N-2 as BOS→tag and
+column N-1 as tag→EOS, added to the first and last real step."""
 from __future__ import annotations
 
 import jax
@@ -11,38 +16,66 @@ from ..tensor.tensor import Tensor
 
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
-    def _vit(emissions, trans):
-        # emissions: [B, T, N], trans: [N, N]
+    def _vit(emissions, trans, lens):
+        # emissions: [B, T, N], trans: [N, N], lens: [B]
         B, T, N = emissions.shape
-
-        def step(carry, emit_t):
-            score = carry  # [B, N]
-            # score[b, i] + trans[i, j] + emit[b, j]
-            total = score[:, :, None] + trans[None, :, :]
-            best = jnp.max(total, axis=1)
-            idx = jnp.argmax(total, axis=1)
-            return best + emit_t, idx
+        lens_ = jnp.asarray(lens, jnp.int32)
 
         init = emissions[:, 0]
-        scores, backptrs = jax.lax.scan(
-            step, init, jnp.moveaxis(emissions[:, 1:], 1, 0))
-        last = jnp.argmax(scores, axis=-1)  # [B]
+        if include_bos_eos_tag:
+            init = init + trans[N - 2][None, :]
 
-        def backtrack(carry, bp_t):
-            tag = carry
+        if T > 1:
+            t_idx = jnp.arange(1, T, dtype=jnp.int32)
+
+            def step(alpha, inp):
+                emit_t, t = inp
+                total = alpha[:, :, None] + trans[None, :, :]
+                best = jnp.max(total, axis=1) + emit_t
+                idx = jnp.argmax(total, axis=1)
+                active = (t < lens_)[:, None]
+                # frozen past each row's length: alpha stays the state
+                # at position len-1
+                return jnp.where(active, best, alpha), idx
+
+            alpha, backptrs = jax.lax.scan(
+                step, init, (jnp.moveaxis(emissions[:, 1:], 1, 0), t_idx))
+        else:
+            alpha = init
+            backptrs = jnp.zeros((0, B, N), jnp.int32)
+            t_idx = jnp.zeros((0,), jnp.int32)
+
+        final = alpha
+        if include_bos_eos_tag:
+            final = final + trans[:, N - 1][None, :]
+        last = jnp.argmax(final, axis=-1)          # tag at position len-1
+        score = jnp.max(final, axis=-1)
+
+        def backtrack(tag, inp):
+            bp_t, t = inp
             prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
-            return prev, prev
+            executed = t <= lens_ - 1              # step t ran for the row
+            out = jnp.where(executed, tag, 0)      # path slot t (0-padded)
+            new_tag = jnp.where(executed, prev, tag)
+            return new_tag, out
 
-        _, path_rev = jax.lax.scan(backtrack, last, backptrs, reverse=True)
-        path = jnp.concatenate([path_rev, last[None]], axis=0)
-        return jnp.max(scores, -1), jnp.moveaxis(path, 0, 1).astype(jnp.int32)
+        first, path_rest = jax.lax.scan(backtrack, last,
+                                        (backptrs, t_idx), reverse=True)
+        path = jnp.concatenate([first[None], path_rest], axis=0)
+        return score, jnp.moveaxis(path, 0, 1).astype(jnp.int32)
 
-    return call(_vit, potentials, transition_params, _name="viterbi_decode")
+    B, T = potentials.shape[0], potentials.shape[1]
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    return call(_vit, potentials, transition_params, lengths,
+                _nondiff=(2,), _name="viterbi_decode")
 
 
 class ViterbiDecoder:
     def __init__(self, transitions, include_bos_eos_tag=True, name=None):
         self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
 
     def __call__(self, potentials, lengths=None):
-        return viterbi_decode(potentials, self.transitions, lengths)
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -98,7 +98,10 @@ class RandomCrop(BaseTransform):
         h, w = img.shape[:2]
         th, tw = self.size
         if self.pad_if_needed and (h < th or w < tw):
-            img = F.pad(img, (0, max(th - h, 0), 0, max(tw - w, 0)),
+            # F.pad takes (left, top, right, bottom): width deficit goes
+            # on left/right, height deficit on top/bottom
+            img = F.pad(img, (max(tw - w, 0), max(th - h, 0),
+                              max(tw - w, 0), max(th - h, 0)),
                         self.fill, self.padding_mode)
             h, w = img.shape[:2]
         i = random.randint(0, h - th) if h > th else 0

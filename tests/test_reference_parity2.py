@@ -1,0 +1,181 @@
+"""Reference-parity regressions (round-5 review batch): viterbi lengths
+and BOS/EOS, RNN sequence_length, conv padding_mode, pooling masks,
+Auc anchor, RandomCrop pad_if_needed, full() dtype, round semantics,
+MultiHeadAttention dropout placement."""
+import itertools
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_viterbi_lengths_and_bos_eos_brute_force():
+    from paddle_tpu.text.viterbi import viterbi_decode
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 5
+    em = rng.randn(B, T, N).astype(np.float32)
+    tr = rng.randn(N, N).astype(np.float32)
+    lens = np.asarray([5, 3, 1], np.int32)
+
+    def brute(b, bos_eos):
+        L = lens[b]
+        best, path = -1e30, None
+        for tags in itertools.product(range(N), repeat=int(L)):
+            s = em[b, 0, tags[0]]
+            if bos_eos:
+                s += tr[N - 2, tags[0]]
+            for t in range(1, L):
+                s += tr[tags[t - 1], tags[t]] + em[b, t, tags[t]]
+            if bos_eos:
+                s += tr[tags[L - 1], N - 1]
+            if s > best:
+                best, path = s, tags
+        return best, list(path) + [0] * (T - L)
+
+    for bos_eos in (False, True):
+        sc, pa = viterbi_decode(paddle.to_tensor(em), paddle.to_tensor(tr),
+                                paddle.to_tensor(lens), bos_eos)
+        sc, pa = np.asarray(sc.numpy()), np.asarray(pa.numpy())
+        for b in range(B):
+            ws, wp = brute(b, bos_eos)
+            assert abs(sc[b] - ws) < 1e-4
+            assert list(pa[b]) == wp
+
+
+def test_lstm_sequence_length_final_state_vs_torch_packed():
+    rng = np.random.RandomState(0)
+    B, T, I, H = 3, 6, 4, 5
+    x = rng.randn(B, T, I).astype(np.float32)
+    lens = np.asarray([6, 3, 1], np.int64)
+
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    pl = paddle.nn.LSTM(I, H)
+    sd = pl.state_dict()
+    names = set(sd)
+    with torch.no_grad():
+        for tn, suffix in (("weight_ih_l0", "weight_ih"),
+                           ("weight_hh_l0", "weight_hh"),
+                           ("bias_ih_l0", "bias_ih"),
+                           ("bias_hh_l0", "bias_hh")):
+            cand = [k for k in names if k.endswith(suffix)]
+            assert len(cand) == 1
+            sd[cand[0]] = paddle.to_tensor(getattr(tl, tn).detach().numpy())
+    pl.set_state_dict(sd)
+
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.tensor(x), torch.tensor(lens), batch_first=True,
+        enforce_sorted=False)
+    _, (hn, cn) = tl(packed)
+    _, (hp, cp) = pl(paddle.to_tensor(x),
+                     sequence_length=paddle.to_tensor(
+                         lens.astype(np.int32)))
+    np.testing.assert_allclose(hp.numpy()[0], hn.detach().numpy()[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cp.numpy()[0], cn.detach().numpy()[0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_padding_mode_reflect_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    tc = torch.nn.Conv2d(3, 4, 3, padding=1, padding_mode="reflect")
+    pc = nn.Conv2D(3, 4, 3, padding=1, padding_mode="reflect")
+    pc.weight.set_value(paddle.to_tensor(tc.weight.detach().numpy()))
+    pc.bias.set_value(paddle.to_tensor(tc.bias.detach().numpy()))
+    np.testing.assert_allclose(pc(paddle.to_tensor(x)).numpy(),
+                               tc(torch.tensor(x)).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_mask_ceil_mode_vs_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    tout, tmask = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, 2, 0, ceil_mode=True, return_indices=True)
+    pout, pmask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                               ceil_mode=True, return_mask=True)
+    np.testing.assert_allclose(pout.numpy(), tout.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(pmask.numpy(), tmask.numpy())
+
+
+def test_adaptive_max_pool_mask_vs_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 7, 9).astype(np.float32)
+    tout, tmask = torch.nn.functional.adaptive_max_pool2d(
+        torch.tensor(x), (2, 3), return_indices=True)
+    pout, pmask = F.adaptive_max_pool2d(paddle.to_tensor(x), (2, 3),
+                                        return_mask=True)
+    np.testing.assert_allclose(pout.numpy(), tout.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(pmask.numpy(), tmask.numpy())
+
+
+def test_avg_pool_divisor_override_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    t = torch.nn.functional.avg_pool2d(torch.tensor(x), 2, 2,
+                                       divisor_override=3)
+    p = F.avg_pool2d(paddle.to_tensor(x), 2, stride=2, divisor_override=3)
+    np.testing.assert_allclose(p.numpy(), t.numpy(), atol=1e-6)
+
+
+def test_auc_includes_origin_anchor():
+    m = paddle.metric.Auc()
+    # every prediction lands in the top bucket with mixed labels:
+    # random ranking -> AUC must be 0.5, not 0.0
+    preds = np.asarray([[0.0, 1.0]] * 10, np.float32)
+    labels = np.asarray([[1], [0]] * 5, np.int64)
+    m.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_random_crop_pad_if_needed_pads_width():
+    from paddle_tpu.vision.transforms import RandomCrop
+    img = np.zeros((32, 20, 3), np.uint8)
+    out = RandomCrop(32, pad_if_needed=True)(img)
+    assert np.asarray(out).shape[:2] == (32, 32)
+
+
+def test_full_defaults_to_float32():
+    t = paddle.full([2], 7)
+    assert str(t.dtype).endswith("float32"), t.dtype
+    np.testing.assert_allclose((t / 3).numpy(), [7 / 3] * 2, rtol=1e-6)
+
+
+def test_round_half_away_from_zero():
+    r = paddle.round(paddle.to_tensor(
+        np.asarray([0.5, 1.5, 2.5, -0.5, -1.5], np.float32))).numpy()
+    np.testing.assert_array_equal(r, [1.0, 2.0, 3.0, -1.0, -2.0])
+
+
+def test_mha_dropout_on_attention_weights():
+    """Eval: no dropout anywhere.  Train with dropout=0.9: outputs must
+    DIFFER from eval (dropout active) and the zero-pattern must come
+    from attention weights, not the projected output (a post-proj
+    dropout would zero entire output entries)."""
+    rng = np.random.RandomState(0)
+    mha = nn.MultiHeadAttention(8, 2, dropout=0.9)
+    x = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    mha.eval()
+    base = mha(x).numpy()
+    out_eval2 = mha(x).numpy()
+    np.testing.assert_allclose(base, out_eval2)   # eval deterministic
+    mha.train()
+    paddle.seed(0)
+    out_tr = mha(x).numpy()
+    assert not np.allclose(out_tr, base)
+    # post-proj dropout would leave exact zeros in the output
+    assert (np.abs(out_tr) < 1e-12).mean() < 0.5
+
+
+def test_instance_norm_nhwc_matches_nchw():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 4, 6, 8).astype(np.float32)
+    a = F.instance_norm(paddle.to_tensor(x), data_format="NCHW").numpy()
+    b = F.instance_norm(paddle.to_tensor(np.transpose(x, (0, 2, 3, 1))),
+                        data_format="NHWC").numpy()
+    np.testing.assert_allclose(np.transpose(b, (0, 3, 1, 2)), a,
+                               rtol=1e-4, atol=1e-5)
