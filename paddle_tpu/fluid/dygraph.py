@@ -92,9 +92,17 @@ class Conv2D(_ActWrap):
 class BatchNorm(_ActWrap):
     def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
                  epsilon=1e-5, param_attr=None, bias_attr=None,
-                 dtype="float32", data_layout="NCHW", **kw):
-        super().__init__(_nn.BatchNorm(num_channels, momentum=momentum,
-                                       epsilon=epsilon), act)
+                 dtype="float32", data_layout="NCHW",
+                 use_global_stats=False, **kw):
+        bn = _nn.BatchNorm(num_channels, momentum=momentum,
+                           epsilon=epsilon, param_attr=param_attr,
+                           bias_attr=bias_attr, data_layout=data_layout,
+                           use_global_stats=use_global_stats)
+        if is_test:
+            # fluid inference construction: normalize with global stats
+            # and never mutate them (no .eval() call needed)
+            bn.eval()
+        super().__init__(bn, act)
 
 
 class Embedding(_nn.Layer):
@@ -151,8 +159,13 @@ class Dropout(_nn.Dropout):
 
 def save_dygraph(state_dict, model_path):
     from ..io.serialization import save
+    # optimizer state dicts are recognizable by the bookkeeping keys the
+    # optimizer always writes ("@step"/"@param_names"/"LR_Scheduler") —
+    # keying on LR_Scheduler alone misfiled plain-float-lr optimizer
+    # state into .pdparams, overwriting the model weights
+    opt_markers = ("LR_Scheduler", "@step", "@param_names")
     suffix = ".pdopt" if any(
-        isinstance(k, str) and k in ("LR_Scheduler",) for k in state_dict
+        isinstance(k, str) and k in opt_markers for k in state_dict
     ) else ".pdparams"
     save(state_dict, model_path + suffix)
 

@@ -9,5 +9,6 @@ NormalInitializer = Normal
 TruncatedNormalInitializer = TruncatedNormal
 UniformInitializer = Uniform
 XavierInitializer = XavierUniform
-MSRAInitializer = KaimingNormal
+# fluid MSRAInitializer defaults uniform=True (ref initializer.py::MSRA)
+MSRAInitializer = KaimingUniform
 NumpyArrayInitializer = Assign

@@ -24,7 +24,16 @@ from ..static.backward import append_backward, gradients  # noqa: F401
 from ..framework import core as _core
 
 # ---- builders shared with paddle.static.nn ----
-fc = _snn.fc
+def fc(input=None, size=None, num_flatten_dims=1, param_attr=None,
+       bias_attr=None, act=None, name=None, **kw):
+    """Fluid-era spelling of static.nn.fc (ref fluid/layers/nn.py::fc):
+    input=/param_attr=/act= keywords, with the 2.x names accepted too."""
+    x = kw.pop("x", input)
+    weight_attr = kw.pop("weight_attr", param_attr)
+    activation = kw.pop("activation", act)
+    return _snn.fc(x, size, num_flatten_dims=num_flatten_dims,
+                   weight_attr=weight_attr, bias_attr=bias_attr,
+                   activation=activation, name=name)
 conv2d = _snn.conv2d
 conv2d_transpose = _snn.conv2d_transpose
 conv3d = _snn.conv3d
@@ -150,8 +159,26 @@ topk = _T.topk
 argmax = _T.argmax
 argmin = _T.argmin
 argsort = _T.argsort
-one_hot = F.one_hot
-unique = _T.unique
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """ref fluid one_hot_op: consumes [N, 1] (or [N]) int labels and
+    returns [N, depth] — the 2.x F.one_hot appends the depth axis
+    without squeezing the trailing 1."""
+    out = F.one_hot(input, depth)
+    if len(out.shape) >= 2 and out.shape[-2] == 1:
+        out = _T.squeeze(out, axis=-2)
+    return out
+
+
+def unique(x, dtype="int32"):
+    """ref unique_op: (out, index) with FIRST-APPEARANCE order and the
+    [N] inverse id map (see layers_ext._unique_first_appearance)."""
+    from .layers_ext import _unique_first_appearance
+    out, index, _ = _unique_first_appearance(x, dtype)
+    return out, index
+
+
 crop_tensor = _T.manipulation.crop
 
 

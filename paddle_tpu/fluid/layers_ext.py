@@ -66,7 +66,15 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
     return _T.flip(out, axis=ch_axis) if flip_c else out
 
 
-sum = _T.sum          # noqa: A001  (fluid.layers.sum is elementwise list-sum)
+def sum(x):           # noqa: A001
+    """ref sum_op (add_n): ELEMENTWISE sum of a tensor list; a single
+    tensor passes through unchanged — NOT a reduction."""
+    if isinstance(x, (list, tuple)):
+        from ..tensor.math import add_n
+        return add_n(list(x))
+    return x
+
+
 size = _T.numel
 
 
@@ -106,8 +114,26 @@ def has_nan(x):
     return _T.any(_T.isnan(x))
 
 
+def _unique_first_appearance(x, dtype):
+    """FIRST-APPEARANCE-ordered uniques + [N] inverse ids + counts (the
+    fluid unique/unique_with_counts contract — np.unique's value-sorted
+    order with first-occurrence positions is a different thing).  Host
+    round-trip, like tensor.unique: the output shape is data-dependent."""
+    import numpy as np
+    from ..tensor.tensor import Tensor as _Ten
+
+    flat = np.asarray(x.numpy()).reshape(-1)
+    vals, first, inv, counts = np.unique(
+        flat, return_index=True, return_inverse=True, return_counts=True)
+    order = np.argsort(first)            # sorted-id -> appearance order
+    rank = np.argsort(order)             # sorted-id -> appearance-id
+    return (_Ten(vals[order]),
+            _Ten(rank[inv].astype(np.dtype(dtype))),
+            _Ten(counts[order].astype(np.int64)))
+
+
 def unique_with_counts(x, dtype="int32"):
-    return _T.unique(x, return_index=True, return_counts=True)
+    return _unique_first_appearance(x, dtype)
 
 
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
@@ -227,16 +253,39 @@ def mean_iou(input, label, num_classes):
         iou = jnp.where(present, inter / jnp.maximum(union, 1e-10), 0.0)
         miou = jnp.sum(iou) / jnp.maximum(
             jnp.sum(present.astype(jnp.float32)), 1.0)
-        return miou, inter.astype(jnp.int64), union.astype(jnp.int64)
+        # ref outputs: (mean_iou, out_wrong, out_correct) — per-class
+        # WRONG counts (union minus intersection) and CORRECT counts
+        # (the intersection), not raw intersect/union
+        wrong = (union - inter).astype(jnp.int64)
+        correct = inter.astype(jnp.int64)
+        return miou, wrong, correct
     return call(_mi, input, label, _name="mean_iou", _nondiff=(0, 1))
 
 
+_auc_accumulators = {}
+
+
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
-        slide_steps=1):
+        slide_steps=1, name=None):
+    """ref fluid auc op: a STREAMING metric — state lives in persistable
+    variables across batches.  Here one persistent accumulator per call
+    site (keyed by name, else by the caller's file:line) accumulates on
+    every call; returns (auc_so_far, stat_pos, stat_neg)."""
+    import sys
     from ..metric import Auc
-    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    if name is None:
+        f = sys._getframe(1)
+        key = (f.f_code.co_filename, f.f_lineno)
+    else:
+        key = name
+    m = _auc_accumulators.get(key)
+    if m is None:
+        m = Auc(curve=curve, num_thresholds=num_thresholds)
+        _auc_accumulators[key] = m
     m.update(input, label)
-    return Tensor(np.asarray(m.accumulate(), np.float32)), None, None
+    return (Tensor(np.asarray(m.accumulate(), np.float32)),
+            Tensor(np.asarray(m._stat_pos, np.int64)),
+            Tensor(np.asarray(m._stat_neg, np.int64)))
 
 
 def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,

@@ -44,8 +44,21 @@ class CheckpointManager:
                 out.append((int(m.group(1)), os.path.join(self.root, name)))
         return sorted(out)
 
+    def _dirs_by_save_time(self):
+        """Step dirs ordered by when they were SAVED (publish mtime), not
+        by step number: after an operator rewinds to an earlier step and
+        trains on, the new lower-numbered checkpoints are the live run —
+        numeric ordering would reap them and auto-resume from the stale
+        high-numbered leftovers of the abandoned run."""
+        def mtime(sp):
+            try:
+                return os.path.getmtime(sp[1])
+            except OSError:
+                return 0.0
+        return sorted(self._step_dirs(), key=mtime)
+
     def latest_step(self):
-        dirs = self._step_dirs()
+        dirs = self._dirs_by_save_time()
         return dirs[-1][0] if dirs else None
 
     # ------------------------------------------------------------ save
@@ -74,7 +87,7 @@ class CheckpointManager:
         return final
 
     def _retain(self):
-        dirs = self._step_dirs()
+        dirs = self._dirs_by_save_time()
         for _, path in dirs[:-self.keep] if self.keep else []:
             shutil.rmtree(path, ignore_errors=True)
 

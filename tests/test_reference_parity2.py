@@ -179,3 +179,85 @@ def test_instance_norm_nhwc_matches_nchw():
                         data_format="NHWC").numpy()
     np.testing.assert_allclose(np.transpose(b, (0, 3, 1, 2)), a,
                                rtol=1e-4, atol=1e-5)
+
+
+class TestFluidContracts:
+    def test_save_dygraph_routes_optimizer_state_to_pdopt(self, tmp_path):
+        from paddle_tpu.fluid.dygraph import save_dygraph, load_dygraph
+        lin = nn.Linear(2, 2)
+        opt = paddle.optimizer.Adam(0.001, parameters=lin.parameters())
+        loss = (lin(paddle.to_tensor(np.ones((1, 2), np.float32)))).sum()
+        loss.backward()
+        opt.step()
+        base = str(tmp_path / "ckpt")
+        save_dygraph(lin.state_dict(), base)
+        save_dygraph(opt.state_dict(), base)   # float lr: still .pdopt
+        params, optd = load_dygraph(base)
+        assert optd is not None
+        assert any(k.endswith("weight") or "w_" in k for k in params), \
+            list(params)[:4]
+        w0 = lin.weight.numpy().copy()
+        lin2 = nn.Linear(2, 2)
+        lin2.set_state_dict(params)
+        np.testing.assert_allclose(lin2.weight.numpy(), w0)
+
+    def test_fluid_fc_era_keywords(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+            from paddle_tpu.fluid import layers
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("fcx", [None, 4], "float32")
+                out = layers.fc(input=x, size=3, act="softmax")
+                exe = static.Executor()
+                exe.run(startup)
+                r, = exe.run(main, feed={
+                    "fcx": np.ones((2, 4), np.float32)},
+                    fetch_list=[out])
+            assert np.asarray(r).shape == (2, 3)
+            np.testing.assert_allclose(np.asarray(r).sum(-1), [1.0, 1.0],
+                                       rtol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_mean_iou_wrong_correct_counts(self):
+        from paddle_tpu.fluid import layers
+        pred = paddle.to_tensor(np.asarray([0, 0, 1, 1], np.int64))
+        lbl = paddle.to_tensor(np.asarray([0, 1, 1, 1], np.int64))
+        _, wrong, correct = layers.mean_iou(pred, lbl, 2)
+        # class0: inter 1, union 2 -> wrong 1, correct 1
+        # class1: inter 2, union 3 -> wrong 1, correct 2
+        np.testing.assert_array_equal(wrong.numpy(), [1, 1])
+        np.testing.assert_array_equal(correct.numpy(), [1, 2])
+
+    def test_fluid_auc_streams_across_calls(self):
+        from paddle_tpu.fluid import layers
+        rng = np.random.RandomState(0)
+        vals = []
+        for i in range(3):
+            preds = rng.rand(16, 2).astype(np.float32)
+            labels = (rng.rand(16, 1) > 0.5).astype(np.int64)
+            a, pos, neg = layers.auc(paddle.to_tensor(preds),
+                                     paddle.to_tensor(labels),
+                                     name="stream_test")
+            vals.append(float(a.numpy()))
+            assert pos is not None and neg is not None
+        # 48 accumulated samples: stat buckets must keep growing
+        assert int(np.asarray(pos.numpy()).sum()
+                   + np.asarray(neg.numpy()).sum()) == 48
+
+    def test_checkpoint_rewind_keeps_live_run(self, tmp_path):
+        import time
+        from paddle_tpu.utils.checkpoint import CheckpointManager
+        lin = nn.Linear(2, 2)
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for step in (180, 190, 200):
+            mgr.save(step, model=lin)
+            time.sleep(0.01)
+        # rewind: operator retrains from an earlier step
+        mgr.save(110, model=lin)
+        time.sleep(0.01)
+        mgr.save(120, model=lin)
+        # the live run's checkpoints survive; auto-resume picks 120
+        assert mgr.latest_step() == 120
