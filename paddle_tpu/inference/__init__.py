@@ -129,6 +129,11 @@ class Predictor:
             out = self._traced(*args)
             outs = out if isinstance(out, (list, tuple)) else [out]
             self._outputs = [o.numpy() for o in outs]
+        if len(self._outputs) != len(self._out_names):
+            # jit-pickle artifacts don't record the output arity; grow
+            # the handle names to one per REAL output on first run
+            self._out_names = [f"out{i}"
+                               for i in range(len(self._outputs))]
         return self._outputs
 
 

@@ -131,20 +131,17 @@ class DataLoader:
 
     def _iter_threaded(self):
         """Thread-pool prefetch: workers collate batches ahead of consumption
-        (GIL released during numpy/jax host work)."""
+        (GIL released during numpy/jax host work).
+
+        Work is SUBMITTED lazily — at most prefetch_factor*num_workers
+        batches outstanding — so one slow batch cannot make the ordered-
+        yield reorder buffer absorb the whole epoch (pending is bounded
+        by the outstanding window)."""
         work_q: queue.Queue = queue.Queue()
         done = object()
-        out_q: queue.Queue = queue.Queue(
-            maxsize=self.prefetch_factor * self.num_workers)
+        out_q: queue.Queue = queue.Queue()
         batches = list(self.batch_sampler)
-        order = {}
-        lock = threading.Lock()
-        next_out = [0]
-
-        for i, b in enumerate(batches):
-            work_q.put((i, b))
-        for _ in range(self.num_workers):
-            work_q.put(done)
+        window = self.prefetch_factor * self.num_workers
 
         def worker(wid):
             _worker_tls.info = WorkerInfo(wid, self.num_workers,
@@ -171,11 +168,26 @@ class DataLoader:
         for t in threads:
             t.start()
 
-        finished_workers = 0
+        submitted = 0
+
+        def refill():
+            nonlocal submitted
+            while (submitted < len(batches)
+                   and submitted - want - len(pending) < window):
+                work_q.put((submitted, batches[submitted]))
+                submitted += 1
+            if submitted == len(batches):
+                for _ in range(self.num_workers):
+                    work_q.put(done)
+
         pending = {}
         want = 0
         received = 0
+        filled_done = False
         try:
+            refill()
+            if submitted == len(batches):
+                filled_done = True
             while received < len(batches):
                 try:
                     item = out_q.get(
@@ -185,7 +197,6 @@ class DataLoader:
                         f"DataLoader worker produced no batch within "
                         f"timeout={self.timeout}s")
                 if item is done:
-                    finished_workers += 1
                     continue
                 i, data = item
                 if isinstance(data, Exception):
@@ -195,10 +206,17 @@ class DataLoader:
                 while want in pending:
                     yield pending.pop(want)
                     want += 1
+                if not filled_done:
+                    refill()
+                    if submitted == len(batches):
+                        filled_done = True
             while want in pending:
                 yield pending.pop(want)
                 want += 1
         finally:
+            if not filled_done:
+                for _ in range(self.num_workers):
+                    work_q.put(done)
             for t in threads:
                 t.join(timeout=0.1)
 
@@ -220,9 +238,8 @@ class DataLoader:
         treedefs = {}
         td_lock = threading.Lock()
         errors = []
+        done = object()
         work_q: queue.Queue = queue.Queue()
-        for i, b in enumerate(batches):
-            work_q.put((i, b))
 
         def collate(idxs):
             samples = [self.dataset[i] for i in idxs]
@@ -252,10 +269,10 @@ class DataLoader:
                     ring.close()
                     return
             while True:
-                try:
-                    i, idxs = work_q.get_nowait()
-                except queue.Empty:
+                item = work_q.get()
+                if item is done:
                     return
+                i, idxs = item
                 try:
                     leaves, td = collate(idxs)
                     with td_lock:
@@ -276,13 +293,34 @@ class DataLoader:
         for t in threads:
             t.start()
 
+        # lazy submission bounds the reorder buffer: the ring caps how
+        # far producers run ahead, but the consumer must keep draining
+        # it (a full ring would block the straggler batch's producer),
+        # so `pending` is bounded by capping OUTSTANDING work instead
+        window = self.prefetch_factor * self.num_workers
         pending = {}
         want = 0
+        submitted = 0
+        sent_done = False
+
+        def refill():
+            nonlocal submitted, sent_done
+            while (submitted < len(batches)
+                   and submitted - want - len(pending) < window):
+                work_q.put((submitted, batches[submitted]))
+                submitted += 1
+            if submitted == len(batches) and not sent_done:
+                sent_done = True
+                for _ in range(self.num_workers):
+                    work_q.put(done)
+
         try:
+            refill()
             while want < len(batches):
                 if want in pending:
                     yield pending.pop(want)
                     want += 1
+                    refill()
                     continue
                 try:
                     got = ring.pop(
@@ -309,6 +347,9 @@ class DataLoader:
                 raise errors[0]
         finally:
             ring.close()
+            if not sent_done:          # unblock workers parked on get()
+                for _ in range(self.num_workers):
+                    work_q.put(done)
             for t in threads:
                 t.join(timeout=2.0)
             # destroy is race-safe even under a live producer: the C

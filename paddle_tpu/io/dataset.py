@@ -72,6 +72,10 @@ class ConcatDataset(Dataset):
         return int(self.cum[-1])
 
     def __getitem__(self, idx):
+        if idx < 0:                      # torch/reference semantics
+            if idx < -len(self):
+                raise IndexError(idx)
+            idx += len(self)
         ds = int(np.searchsorted(self.cum, idx, side="right"))
         prev = 0 if ds == 0 else int(self.cum[ds - 1])
         return self.datasets[ds][idx - prev]
@@ -89,12 +93,32 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+def _rng_from_generator(generator):
+    """A numpy RandomState honoring an explicit generator: an int seed, a
+    numpy Generator/RandomState, or a paddle-style generator exposing
+    initial_seed()/get_state(); None falls back to global np.random."""
+    if generator is None:
+        return np.random
+    if isinstance(generator, (int, np.integer)):
+        return np.random.RandomState(int(generator))
+    if isinstance(generator, (np.random.RandomState, np.random.Generator)):
+        return generator
+    for attr in ("initial_seed", "seed"):
+        fn = getattr(generator, attr, None)
+        if callable(fn):
+            try:
+                return np.random.RandomState(int(fn()) % (2 ** 32))
+            except Exception:                              # noqa: BLE001
+                break
+    return np.random
+
+
 def random_split(dataset, lengths, generator=None):
     total = len(dataset)
     if sum(lengths) != total:
         # paddle 2.x also supports fractions; keep ints strict
         raise ValueError("sum of lengths != dataset size")
-    perm = np.random.permutation(total)
+    perm = _rng_from_generator(generator).permutation(total)
     out = []
     off = 0
     for n in lengths:
@@ -125,6 +149,7 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self.generator = generator
 
     @property
     def num_samples(self):
@@ -132,9 +157,10 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = _rng_from_generator(self.generator)
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples

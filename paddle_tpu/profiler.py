@@ -71,9 +71,12 @@ def summary():
 
 def record_op(name, seconds, t_start=None):
     if _enabled:
-        _op_times[name] += seconds
-        _op_counts[name] += 1
+        # the totals must be updated under the lock too: DataLoader
+        # worker threads dispatch ops concurrently and an unlocked
+        # read-add-write drops increments
         with _events_lock:
+            _op_times[name] += seconds
+            _op_counts[name] += 1
             _events.append((name, (t_start if t_start is not None
                                    else time.perf_counter() - seconds)
                             - _t0, seconds))

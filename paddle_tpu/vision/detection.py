@@ -158,6 +158,23 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variance=(
 # box math
 # --------------------------------------------------------------------------
 
+def _topk_padded(scores, K):
+    """Top-K indices over a 1-D masked-score array, PADDED to exactly K
+    rows when fewer candidates exist (the fixed-shape [*, K, ...] output
+    contract must hold even for tiny candidate sets).  Returns
+    (idx [K], valid [K]); padded slots point at row 0 with valid=False."""
+    order = jnp.argsort(-scores)
+    n = scores.shape[0]
+    if n >= K:
+        idx = order[:K]
+        valid = scores[idx] > -1e8
+    else:
+        idx = jnp.concatenate([order, jnp.zeros((K - n,), order.dtype)])
+        valid = jnp.concatenate([scores[order] > -1e8,
+                                 jnp.zeros((K - n,), bool)])
+    return idx, valid
+
+
 def _pairwise_iou(a, b):
     """a [N,4], b [M,4] xyxy -> [N, M] IoU."""
     ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
@@ -426,11 +443,9 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                 keeps.append(keep)
             keep_all = jnp.stack(keeps)                      # [C, N]
             flat_scores = jnp.where(keep_all, scores_ci, -1e9).reshape(-1)
-            K = keep_top_k
-            top = jnp.argsort(-flat_scores)[:K]
+            top, valid = _topk_padded(flat_scores, keep_top_k)
             lbl = (top // N).astype(jnp.float32)
             idx = top % N
-            valid = flat_scores[top] > -1e8
             rows = jnp.concatenate([
                 jnp.where(valid, lbl, -1.0)[:, None],
                 jnp.where(valid, flat_scores[top], 0.0)[:, None],
@@ -496,8 +511,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             scs = jnp.concatenate([r[1] for r in rows])
             bxs = jnp.concatenate([r[2] for r in rows])
             scs = jnp.where(scs > post_threshold, scs, -1e9)
-            top = jnp.argsort(-scs)[:keep_top_k]
-            valid = scs[top] > -1e8
+            top, valid = _topk_padded(scs, keep_top_k)
             return jnp.concatenate([
                 jnp.where(valid, lbls[top], -1.0)[:, None],
                 jnp.where(valid, scs[top], 0.0)[:, None],
@@ -575,10 +589,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
             thresh = jnp.sort(neg_ce)[::-1][jnp.maximum(n_neg - 1, 0)]
             hard_neg = minable & (neg_ce >= thresh) & (n_neg > 0)
             conf_l = jnp.sum(ce * (pos | hard_neg))
-            denom = jnp.maximum(n_pos.astype(jnp.float32), 1.0) \
-                if normalize else 1.0
-            return (loc_loss_weight * loc_l
-                    + conf_loss_weight * conf_l) / denom
+            return (loc_loss_weight * loc_l + conf_loss_weight * conf_l,
+                    n_pos.astype(jnp.float32))
 
         def _encode(pb_, pv_, tb):
             pw = pb_[:, 2] - pb_[:, 0]
@@ -599,10 +611,15 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                 out = out / pv_
             return out
 
-        per = jax.vmap(per_image)(loc.astype(jnp.float32),
-                                  conf.astype(jnp.float32),
-                                  gb.astype(jnp.float32), gl)
-        return jnp.mean(per)
+        per, npos = jax.vmap(per_image)(loc.astype(jnp.float32),
+                                        conf.astype(jnp.float32),
+                                        gb.astype(jnp.float32), gl)
+        if normalize:
+            # reference weighting: the SUMMED loss over the batch divides
+            # by the TOTAL matched-prior count — normalizing per image
+            # then averaging lets a 1-match image dominate gradients
+            return jnp.sum(per) / jnp.maximum(jnp.sum(npos), 1.0)
+        return jnp.sum(per)
     args = [location, confidence, gt_box, gt_label, prior_box]
     if prior_box_var is not None:
         args.append(prior_box_var)
@@ -720,9 +737,7 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
             iou = _pairwise_iou(boxes, boxes)
             nkeep = _nms_single_class(st, iou, nms_thresh, K)
             s_f = jnp.where(nkeep & (st > -1e8), st, -1e9)
-            P = post_nms_top_n
-            sel = jnp.argsort(-s_f)[:P]
-            valid = s_f[sel] > -1e8
+            sel, valid = _topk_padded(s_f, post_nms_top_n)
             out_b = jnp.where(valid[:, None], boxes[sel], 0.0)
             out_s = jnp.where(valid, s_f[sel], 0.0)[:, None]
             return out_b, out_s, jnp.sum(valid.astype(jnp.int32))
@@ -754,12 +769,22 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     rpn_batch_size_per_im uses score-free deterministic truncation (the
     masked-top-k analogue of the reference's random draw)."""
     def _rta(ab, gb, *rest):
+        rest = list(rest)
+        crowd = None
+        if is_crowd is not None:
+            crowd = rest.pop(0)
         info = rest[0].astype(jnp.float32) if rest else None
         M = ab.shape[0]
         ab_f = ab.reshape(-1, 4).astype(jnp.float32)
 
-        def per_image(gt, inf):
+        def per_image(gt, cr, inf):
             valid_g = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+            if cr is not None:
+                # crowd gt boxes are excluded from matching entirely
+                # (ref rpn_target_assign filters is_crowd before the
+                # overlap computation — retinanet_target_assign below
+                # follows the same contract)
+                valid_g = valid_g & (cr.reshape(-1) == 0)
             # straddle filter: anchors outside the image (beyond the
             # threshold) take no part in training (label -1, reference
             # rpn_straddle_thresh semantics); inf None disables it
@@ -800,11 +825,21 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         gb_f = gb.astype(jnp.float32)
         if gb_f.ndim == 2:
             gb_f = gb_f[None]
+        cr_b = None
+        if crowd is not None:
+            cr_b = crowd.reshape(gb_f.shape[0], -1)
+        if info is None and cr_b is None:
+            return jax.vmap(lambda g: per_image(g, None, None))(gb_f)
         if info is None:
-            return jax.vmap(lambda g: per_image(g, None))(gb_f)
-        return jax.vmap(per_image)(gb_f, info)
-    args = [anchor_box, gt_boxes] + ([im_info] if im_info is not None
-                                     else [])
+            return jax.vmap(
+                lambda g, c: per_image(g, c, None))(gb_f, cr_b)
+        if cr_b is None:
+            return jax.vmap(
+                lambda g, i: per_image(g, None, i))(gb_f, info)
+        return jax.vmap(per_image)(gb_f, cr_b, info)
+    args = ([anchor_box, gt_boxes]
+            + ([is_crowd] if is_crowd is not None else [])
+            + ([im_info] if im_info is not None else []))
     return call(_rta, *args, _name="rpn_target_assign",
                 _nondiff=tuple(range(len(args))))
 
@@ -1353,6 +1388,11 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                 [(cr.reshape(-1) != 0) | pad_g,
                  jnp.zeros((rois.shape[0],), bool)], 0)
             best = jnp.where(row_is_bad_gt | ~cand_ok, -1.0, best)
+            # an image with ZERO valid gts: every good candidate's max
+            # overlap is the padding -1; the reference (gt_num=0) treats
+            # it as overlap 0 so such proposals sample as BACKGROUND
+            best = jnp.where(~(row_is_bad_gt | ~cand_ok) & (best < 0),
+                             0.0, best)
             fg = best >= fg_thresh
             bg = (best >= bg_thresh_lo) & (best < bg_thresh_hi) & ~fg
             if not is_cascade_rcnn:     # cascade keeps every fg/bg

@@ -261,3 +261,88 @@ class TestFluidContracts:
         mgr.save(120, model=lin)
         # the live run's checkpoints survive; auto-resume picks 120
         assert mgr.latest_step() == 120
+
+
+class TestDetectionIoContracts:
+    def test_nms_pads_to_keep_top_k(self):
+        from paddle_tpu.vision.detection import multiclass_nms
+        rng = np.random.RandomState(0)
+        bb = paddle.to_tensor(rng.rand(1, 40, 4).astype(np.float32))
+        sc = paddle.to_tensor(rng.rand(1, 2, 40).astype(np.float32))
+        out = multiclass_nms(bb, sc, score_threshold=0.0, nms_top_k=50,
+                             keep_top_k=100, nms_threshold=0.5)
+        assert tuple(out.shape) == (1, 100, 6)
+        assert (np.asarray(out.numpy())[0, -1, 0] == -1.0)  # padded row
+
+    def test_generate_proposal_labels_empty_gt_samples_background(self):
+        from paddle_tpu.vision.detection import generate_proposal_labels
+        rng = np.random.RandomState(0)
+        rois = paddle.to_tensor(
+            (rng.rand(1, 16, 4) * 50).astype(np.float32))
+        gt = paddle.to_tensor(np.zeros((1, 3, 4), np.float32))  # padding
+        gt_cls = paddle.to_tensor(np.zeros((1, 3, 1), np.int32))
+        crowd = paddle.to_tensor(np.zeros((1, 3, 1), np.int32))
+        im_info = paddle.to_tensor(
+            np.asarray([[64.0, 64.0, 1.0]], np.float32))
+        outs = generate_proposal_labels(
+            rois, gt_cls, crowd, gt, im_info,
+            batch_size_per_im=8, fg_fraction=0.25, fg_thresh=0.5,
+            bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=3)
+        labels = np.asarray(outs[1].numpy()).reshape(-1)
+        assert (labels == 0).sum() > 0, labels  # backgrounds sampled
+
+    def test_concat_dataset_negative_index(self):
+        from paddle_tpu.io import ConcatDataset, Dataset
+
+        class R(Dataset):
+            def __init__(self, lo, n):
+                self.lo, self.n = lo, n
+
+            def __len__(self):
+                return self.n
+
+            def __getitem__(self, i):
+                if i < 0:
+                    i += self.n
+                return self.lo + i
+
+        ds = ConcatDataset([R(0, 3), R(100, 2)])
+        assert ds[-1] == 101 and ds[-5] == 0 and ds[4] == 101
+        with pytest.raises(IndexError):
+            ds[-6]
+
+    def test_random_split_generator_reproducible(self):
+        from paddle_tpu.io import random_split, TensorDataset
+        ds = TensorDataset([paddle.to_tensor(
+            np.arange(20, dtype=np.float32).reshape(20, 1))])
+        a1, _ = random_split(ds, [15, 5], generator=123)
+        a2, _ = random_split(ds, [15, 5], generator=123)
+        assert a1.indices == a2.indices
+
+    def test_loader_backpressure_bounds_pending(self):
+        import threading
+        import time
+        from paddle_tpu.io import DataLoader, Dataset as Ds
+        peak = [0]
+        inflight = [0]
+        lock = threading.Lock()
+
+        class Slow0(Ds):
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                with lock:
+                    inflight[0] += 1
+                    peak[0] = max(peak[0], inflight[0])
+                if i == 0:
+                    time.sleep(1.0)     # straggler batch 0
+                with lock:
+                    inflight[0] -= 1
+                return np.full(2, float(i), np.float32)
+
+        loader = DataLoader(Slow0(), batch_size=1, num_workers=2,
+                            prefetch_factor=2, use_native_ring=False)
+        out = [b for b in loader]
+        assert len(out) == 64
+        np.testing.assert_allclose(np.asarray(out[0].numpy())[0], [0, 0])
