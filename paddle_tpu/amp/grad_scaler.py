@@ -73,7 +73,12 @@ class GradScaler:
             optimizer.step()
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        # the documented recipe calls scaled.backward() BEFORE minimize;
+        # only run backward here when the user hasn't (re-running would
+        # raise on the freed graph or double every gradient)
+        if not any(p is not None and p._grad is not None
+                   for p in optimizer._parameters):
+            scaled_loss.backward()
         self.step(optimizer)
         self.update()
 

@@ -20,13 +20,22 @@ from ..framework import core
 class Node:
     """One recorded primitive application."""
 
-    __slots__ = ("vjp_fn", "parents", "n_outputs", "out_shapes", "out_dtypes",
-                 "_accum", "name", "out_hooks", "fwd_closure")
+    __slots__ = ("vjp_fn", "parents", "parent_links", "n_outputs",
+                 "out_shapes", "out_dtypes", "_accum", "name", "out_hooks",
+                 "fwd_closure")
 
     def __init__(self, vjp_fn, parents, n_outputs, out_shapes, out_dtypes,
                  name=""):
         self.vjp_fn = vjp_fn
         self.parents = parents        # list[Tensor] — diff inputs only
+        # SNAPSHOT each parent's producing (node, output index) at record
+        # time: an in-place op later REBINDS the tensor object onto its
+        # own new node, and resolving parents through the live tensor
+        # would then seed the cotangent into that new node (a self-loop),
+        # silently severing every upstream gradient
+        self.parent_links = [(getattr(p, "_node", None),
+                              getattr(p, "_node_index", 0))
+                             for p in parents]
         self.n_outputs = n_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
@@ -136,8 +145,7 @@ def _topo_order(root_node) -> List[Node]:
             continue
         visited.add(id(node))
         stack.append((node, True))
-        for p in node.parents:
-            pn = p._node
+        for pn, _ in node.parent_links:
             if pn is not None and id(pn) not in visited:
                 stack.append((pn, False))
     return order  # post-order: parents before children; reverse for backward
@@ -212,17 +220,18 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
             in_grads = node.vjp_fn(cts[0])
         else:
             in_grads = node.vjp_fn(cts)
-        for parent, g in zip(node.parents, in_grads):
+        for parent, (pn, pidx), g in zip(node.parents, node.parent_links,
+                                         in_grads):
             if g is None:
                 continue
             if watch:
                 # paddle.grad mode: accumulate ONLY into requested tensors
                 if id(parent) in watch:
                     _add(parent, g)
-                if parent._node is not None:
-                    parent._node.seed(parent._node_index, g)
-            elif parent._node is not None:
-                parent._node.seed(parent._node_index, g)
+                if pn is not None:
+                    pn.seed(pidx, g)
+            elif pn is not None:
+                pn.seed(pidx, g)
             else:
                 _add(parent, g)
         node._accum = None
@@ -238,6 +247,7 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
         # break links so the graph is freed and cannot be reused
         for node in order:
             node.parents = ()
+            node.parent_links = ()
 
 
 def _backward_create_graph(tensor, grad, watch):
@@ -327,11 +337,12 @@ def _backward_create_graph(tensor, grad, watch):
                               _name=f"grad_{node.name}")
         if not isinstance(grads, tuple):
             grads = (grads,)
-        for parent, g in zip(node.parents, grads):
+        for parent, (p_n, p_i), g in zip(node.parents, node.parent_links,
+                                         grads):
             if id(parent) in watch:
                 add_out(parent, g)
-            if parent._node is not None:
-                seed(parent._node, parent._node_index, g)
+            if p_n is not None:
+                seed(p_n, p_i, g)
     return out_grads
 
 

@@ -323,11 +323,15 @@ def send(tensor, dst=0, group=None, sync_op=True):
         _p2p_buf.append(tensor.clone())
         return
 
-    def _send(x):
-        # point-to-point over ICI: ppermute to dst
-        n = jax.lax.axis_size(ax)
-        return jax.lax.ppermute(x, ax, [(i, dst) for i in range(n)])
-    call(_send, tensor, _name="send")
+    # a single-program SPMD region cannot express "whoever calls send
+    # owns the payload" — a ppermute with every source targeting dst is
+    # an invalid collective (duplicate destinations).  Point-to-point
+    # inside mapped code is spelled as an explicit shift/permutation
+    # (jax.lax.ppermute), which the pipeline/ring APIs already use.
+    raise NotImplementedError(
+        "send() inside a mapped region has no SPMD meaning; use "
+        "jax.lax.ppermute with an explicit (src, dst) permutation (see "
+        "parallel/pipeline.py) or the eager cross-process collectives")
 
 
 _p2p_buf = []
@@ -340,12 +344,10 @@ def recv(tensor, src=0, group=None, sync_op=True):
             tensor._rebind(_p2p_buf.pop(0))
         return tensor
 
-    def _recv(x):
-        n = jax.lax.axis_size(ax)
-        return jax.lax.ppermute(x, ax, [(src, i) for i in range(n)])
-    out = call(_recv, tensor, _name="recv")
-    tensor._rebind(out)
-    return tensor
+    raise NotImplementedError(
+        "recv() inside a mapped region has no SPMD meaning; use "
+        "jax.lax.ppermute with an explicit (src, dst) permutation (see "
+        "parallel/pipeline.py) or the eager cross-process collectives")
 
 
 def _c_identity(tensor, group=None):
@@ -381,10 +383,16 @@ def _mp_allreduce(tensor, group=None):
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    """With ``tensor_list`` (the reference contract), each rank
+    contributes its list and receives the reduction of everyone's
+    rank-th entry into ``tensor``; without it, ``tensor`` itself is
+    reduced and scattered along axis 0."""
+    from ..tensor.manipulation import stack
+    src = stack(tensor_list, 0) if tensor_list else tensor
     ax = _current_axis(group)
     if ax is None:
         if _process_count() > 1:
-            member, rows = _member_rows(_eager_rows(tensor.numpy()), group)
+            member, rows = _member_rows(_eager_rows(src.numpy()), group)
             if member:
                 red = rows.mean(0) if op == ReduceOp.AVG else rows.sum(0)
                 n = rows.shape[0]
@@ -394,11 +402,13 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                 sz = red.shape[0] // n        # group rank, not global
                 _adopt(tensor, red[me * sz:(me + 1) * sz])
             return tensor
+        if tensor_list:
+            _adopt(tensor, src.numpy()[0])    # world of one: first slot
         return tensor
 
     def _rs(x):
-        return jax.lax.psum_scatter(x, ax, tiled=True)
-    out = call(_rs, tensor, _name="c_reduce_scatter")
+        return jax.lax.psum_scatter(x, ax, tiled=not bool(tensor_list))
+    out = call(_rs, src, _name="c_reduce_scatter")
     tensor._rebind(out)
     return tensor
 

@@ -3,11 +3,16 @@
 The reference hooks NCCL allreduce onto gradient buckets.  Under the SPMD
 model gradients are synced by the compiler: when the train step runs under
 pjit with batch sharded over 'dp', grads of replicated params ARE the summed
-grads.  Eager single-process training needs no sync at all, so this wrapper
-is semantically transparent while keeping the reference API (scale_loss,
-no_sync, state_dict passthrough).
+grads.  Eager single-process training needs no sync.  In a MULTI-PROCESS
+launch (jax.distributed initialized), grads are averaged across processes:
+automatically after each param's grad finalizes in backward (per-param
+hooks, the reference reducer's semantics), batched through ONE flat
+cross-process gather per backward via apply_collective_grads() when called
+explicitly (the fluid-era recipe), with no_sync() suppressing both.
 """
 from __future__ import annotations
+
+import contextlib
 
 from ..nn.layer.layers import Layer
 
@@ -19,6 +24,31 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
+        self._sync_enabled = True
+        self._group = group
+        from . import collective
+        self._collective = collective
+        if collective._process_count() > 1:
+            self._install_grad_sync_hooks()
+
+    def _install_grad_sync_hooks(self):
+        coll = self._collective
+
+        def make_hook(p):
+            def hook(g):
+                if not self._sync_enabled:
+                    return None
+                member, rows = coll._member_rows(
+                    coll._eager_rows(g.numpy()), self._group)
+                if not member:
+                    return None
+                from ..tensor.tensor import Tensor
+                return Tensor(rows.mean(0))
+            return hook
+
+        for p in self._layers.parameters():
+            if p is not None and not p.stop_gradient:
+                p.register_hook(make_hook(p))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -27,13 +57,23 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
-
-    import contextlib
+        """Fluid-era explicit sync: average every param grad across
+        processes in one flat gather (a no-op world of one)."""
+        if (not self._sync_enabled
+                or self._collective._process_count() <= 1):
+            return
+        from .fleet.utils import fused_allreduce_gradients
+        fused_allreduce_gradients(
+            [p for p in self._layers.parameters() if p is not None])
 
     @contextlib.contextmanager
     def no_sync(self):
-        yield
+        prev = self._sync_enabled
+        self._sync_enabled = False
+        try:
+            yield
+        finally:
+            self._sync_enabled = prev
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

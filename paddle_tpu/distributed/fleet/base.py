@@ -236,8 +236,17 @@ class Fleet:
             # ref meta_optimizers/recompute_optimizer.py: the static
             # Executor honors _recompute by wrapping the replayed forward
             # in jax.checkpoint (segments are XLA's choice); dygraph
-            # blocks opt in via fleet.utils.recompute
-            optimizer._recompute = True
+            # blocks opt in via fleet.utils.recompute.  Stamp the WHOLE
+            # wrapper chain: static-mode minimize of the localsgd/
+            # gradient-merge wrappers registers the INNER optimizer in
+            # train_spec, and the Executor reads the flag off that one
+            inner = optimizer
+            while True:
+                inner._recompute = True
+                nxt = getattr(inner, "_inner", None)
+                if nxt is None or nxt is inner:
+                    break
+                inner = nxt
         if getattr(strategy, "amp", False):
             # ref meta_optimizers/amp_optimizer.py: decorate with the
             # loss-scaling minimize flow (bf16-first under auto_cast)

@@ -45,9 +45,18 @@ class mixed_precision(SimpleNamespace):
                 self._scaler.scale(loss).backward()
 
             def minimize(self, loss, **kwargs):
-                with auto_cast():
-                    pass   # forward already ran; kept for API shape
-                self._scaler.scale(loss).backward()
+                from ..static.graph import in_static_mode
+                if in_static_mode():
+                    # static program: the recorded auto_cast ops already
+                    # carry the mixed-precision semantics and bf16 needs
+                    # no loss scaling — register the train spec through
+                    # the inner optimizer (scale+backward would crash on
+                    # a no-tape static tensor)
+                    return self._inner.minimize(loss, **kwargs)
+                scaled = self._scaler.scale(loss)
+                if not any(p is not None and p._grad is not None
+                           for p in self._inner._parameters):
+                    scaled.backward()
                 self._scaler.step(self._inner)
                 self._scaler.update()
                 self._inner.clear_grad()

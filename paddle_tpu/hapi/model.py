@@ -99,6 +99,38 @@ class Model:
 
         self._jit_train = jax.jit(train_step, donate_argnums=(0, 2))
 
+        # gradient-accumulation pair: grad_step computes WITHOUT updating,
+        # apply_step folds the accumulated mean grad into one update —
+        # fit(accumulate_grad_batches=k) chains k-1 grad_steps + 1 apply
+        def grad_step(pv, bv, rng, inputs, labels):
+            def loss_of(pv_):
+                out, new_bv = fx.functional_call(
+                    network, pv_, bv, inputs, rng_key=rng)
+                loss = compute_loss(out, labels)
+                return loss, (out, new_bv)
+            (loss, (out, new_bv)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(pv)
+            return loss, out, grads, new_bv
+
+        self._jit_grads = jax.jit(grad_step)
+
+        def apply_step(pv, states, grads, lr, t):
+            trainable = [n for n in self._param_names
+                         if not params[n].stop_gradient]
+            new_p, new_s = opt.apply_updates_pytree(
+                [pv[n] for n in trainable],
+                [grads[n] for n in trainable],
+                states, lr, t,
+                params=[params[n] for n in trainable])
+            pv2 = dict(pv)
+            for n, v in zip(trainable, new_p):
+                pv2[n] = v
+            return pv2, new_s
+
+        self._jit_apply = jax.jit(apply_step, donate_argnums=(0, 1))
+        self._accum_grads = None
+        self._accum_count = 0
+
         def eval_step(pv, bv, inputs, labels):
             out, _ = fx.functional_call(network, pv, bv, inputs)
             loss = compute_loss(out, labels) if loss_fn is not None else None
@@ -124,19 +156,53 @@ class Model:
         return trainable, states
 
     def train_batch(self, inputs, labels=None, update=True):
+        """One training step.  ``update=False`` (gradient accumulation)
+        computes and ACCUMULATES grads without touching the parameters;
+        the next update=True call applies one optimizer step with the
+        mean of the accumulated micro-batch grads (ref hapi semantics)."""
         network = self.network
         network.train()
         opt = self._optimizer
         params, buffers = fx.collect_state(network)
         pv = {k: p.value for k, p in params.items()}
         bv = {k: b.value for k, b in buffers.items()}
-        trainable, states = self._opt_states(params)
-        opt._step_count += 1
         rng = core.next_rng_key()
         in_vals = _wrap_batch(inputs if isinstance(inputs, (list, tuple))
                               else [inputs])
         lab_vals = _wrap_batch(labels if isinstance(labels, (list, tuple))
                                else [labels])
+
+        if not update or self._accum_grads is not None:
+            # micro-batch path: grads only, params untouched
+            loss, out, grads, new_bv = self._jit_grads(
+                pv, bv, rng, in_vals, lab_vals)
+            if self._accum_grads is None:
+                self._accum_grads = grads
+            else:
+                self._accum_grads = {n: self._accum_grads[n] + grads[n]
+                                     for n in grads}
+            self._accum_count += 1
+            fx.write_back(network, buffer_vals=new_bv)
+            if update:
+                trainable, states = self._opt_states(params)
+                k = float(self._accum_count)
+                mean_g = {n: g / k for n, g in self._accum_grads.items()}
+                opt._step_count += 1
+                new_pv, new_s = self._jit_apply(
+                    pv, states, mean_g, opt.get_lr(), opt._step_count)
+                fx.write_back(network, new_pv)
+                for p, s in zip(trainable, new_s):
+                    for nm, sv in s.items():
+                        opt._accumulators[nm][id(p)] = sv
+                self._accum_grads = None
+                self._accum_count = 0
+            metrics_out = self._update_metrics(out, lab_vals)
+            loss_np = np.asarray(jax.device_get(loss))
+            return ([loss_np], metrics_out) if self._metrics \
+                else [loss_np]
+
+        trainable, states = self._opt_states(params)
+        opt._step_count += 1
         loss, out, new_pv, new_bv, new_s = self._jit_train(
             pv, bv, states, opt.get_lr(), opt._step_count, rng,
             in_vals, lab_vals)
@@ -237,7 +303,13 @@ class Model:
             for step, batch in enumerate(train_loader):
                 cbks.on_batch_begin("train", step, logs)
                 inputs, labels = self._split_batch(batch)
-                result = self.train_batch(inputs, labels)
+                # ref hapi: update on every accumulate_grad_batches-th
+                # batch AND on the epoch's last batch (partial window
+                # still applies with the mean of what it has)
+                do_update = ((step + 1) % accumulate_grad_batches == 0
+                             or (steps is not None and step == steps - 1))
+                result = self.train_batch(inputs, labels,
+                                          update=do_update)
                 logs = self._make_logs(result)
                 logs["step"] = step
                 logs["batch_size"] = batch_size

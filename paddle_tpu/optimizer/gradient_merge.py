@@ -54,12 +54,26 @@ class GradientMergeOptimizer:
         from ..static.graph import in_static_mode
         if in_static_mode():
             # static programs register train_spec through the inner
-            # optimizer (Executor owns the step loop there; feed k_steps
-            # micro-batches per logical step for the same effect)
+            # optimizer — accumulation does NOT happen there; warn out
+            # loud (the silent k_steps-ignored case changes effective
+            # batch size and update frequency 1:1)
+            if self._k > 1:
+                import warnings
+                warnings.warn(
+                    "GradientMergeOptimizer on the static Executor path "
+                    f"applies a FULL update every run (k_steps={self._k} "
+                    "is not accumulated there); feed k_steps micro-"
+                    "batches per logical step yourself or use the "
+                    "dygraph/hapi accumulation paths",
+                    UserWarning, stacklevel=2)
             return self._inner.minimize(loss, **kwargs)
-        loss.backward()
+        if not any(p is not None and p._grad is not None
+                   for p in self._inner._parameters):
+            loss.backward()
         self.step()
 
     # delegate the rest of the optimizer surface
     def __getattr__(self, name):
+        if name == "_inner":         # pre-__init__ lookups must not recurse
+            raise AttributeError(name)
         return getattr(self._inner, name)

@@ -161,7 +161,13 @@ class Optimizer:
             if not self._parameters:
                 self._parameters = prog.all_parameters()
             return None, None
-        loss.backward()
+        # classic recipe: loss.backward() THEN minimize(loss) — the
+        # reference dygraph minimize HARVESTS existing grads and never
+        # re-runs backward (a second backward raises or doubles grads);
+        # when no grads exist yet, run the whole backward+step here
+        if not any(p is not None and p._grad is not None
+                   for p in self._parameters):
+            loss.backward()
         self.step()
         self.clear_grad()
         return None, None

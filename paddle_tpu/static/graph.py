@@ -355,9 +355,14 @@ class Executor:
         params = [program.params[i] for i in param_ids]
         param_vals = [self._place_param(p.value) for p in params]
 
-        key = (id(program), tuple(feed_names),
+        # len(ops) + the optimizer's identity make the key sensitive to
+        # a program extended (or re-minimized) AFTER its first run — a
+        # content-blind key would silently replay the stale compilation
+        key = (id(program), len(program.ops), tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
-               tuple(fetch_ids), program.train_spec is not None)
+               tuple(fetch_ids),
+               id(program.train_spec[1])
+               if program.train_spec is not None else None)
         if key not in self._cache:
             self._cache[key] = self._compile(program, feed_names, fetch_ids,
                                              param_ids)
@@ -373,7 +378,8 @@ class Executor:
             opt._step_count += 1
             fetches, new_params, new_states, buf_vals = step_fn(
                 tuple(feed_vals), tuple(param_vals), cap_vals, states,
-                opt.get_lr(), opt._step_count)
+                opt.get_lr(), opt._step_count,
+                core.default_generator().split())
             for p, nv in zip(params, new_params):
                 p.value = nv
             for p, ns in zip(params, new_states):
@@ -381,7 +387,8 @@ class Executor:
                     opt._accumulators[nm][id(p)] = sv
         else:
             fetches, buf_vals = step_fn(tuple(feed_vals),
-                                        tuple(param_vals), cap_vals)
+                                        tuple(param_vals), cap_vals,
+                                        core.default_generator().split())
         # mutated persistable captures (BN running stats & co) flow back
         for (wr, _vid), bv in zip(buf_updates, buf_vals):
             t = wr()
@@ -448,7 +455,20 @@ class Executor:
             # names) must reach the compiled update like the eager step
             param_objs = [program.params[i] for i in param_ids]
 
-            def train_step(feed_vals, param_vals, cap_vals, states, lr, t):
+            def train_step(feed_vals, param_vals, cap_vals, states, lr,
+                           t, rng):
+                # install the TRACED rng so recorded random ops (dropout,
+                # noise) split from a per-run key instead of baking the
+                # build-time draw into the compiled HLO as a constant
+                prev_key = core.get_trace_key()
+                core.set_trace_key(rng)
+                try:
+                    return _train_body(feed_vals, param_vals, cap_vals,
+                                       states, lr, t)
+                finally:
+                    core.set_trace_key(prev_key)
+
+            def _train_body(feed_vals, param_vals, cap_vals, states, lr, t):
                 if getattr(opt, "_recompute", False):
                     # fluid RecomputeOptimizer: rematerialize the forward
                     # in the backward (activation memory -> FLOPs).  Only
@@ -478,12 +498,17 @@ class Executor:
 
             return jax.jit(train_step), buf_updates, cap_ids
 
-        def infer(feed_vals, param_vals, cap_vals):
-            env = forward(feed_vals, param_vals, cap_vals)
-            return (tuple(
-                eval_fetch(env, i, feed_vals, param_vals, cap_vals)
-                for i in fetch_ids),
-                tuple(env[v] for v in buf_vids))
+        def infer(feed_vals, param_vals, cap_vals, rng):
+            prev_key = core.get_trace_key()
+            core.set_trace_key(rng)
+            try:
+                env = forward(feed_vals, param_vals, cap_vals)
+                return (tuple(
+                    eval_fetch(env, i, feed_vals, param_vals, cap_vals)
+                    for i in fetch_ids),
+                    tuple(env[v] for v in buf_vids))
+            finally:
+                core.set_trace_key(prev_key)
         return jax.jit(infer), buf_updates, cap_ids
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,

@@ -3,6 +3,13 @@
 Functional JAX PRNG under the hood: each eager call consumes a fresh subkey
 from the global Generator (framework/core.py), so the API looks stateful like
 the reference's Philox generator but stays reproducible via paddle.seed().
+
+Each draw goes through ``dispatch.call`` with the key taken INSIDE the op
+fn: in static mode the op is recorded and replays under the Executor's
+per-run traced key, so every ``Executor.run`` re-draws — a bare
+``Tensor(jax.random...)`` here would bake the build-time draw into the
+compiled program as a constant (the reference's uniform_random op draws
+per run).
 """
 from __future__ import annotations
 
@@ -25,14 +32,22 @@ def _shape(shape):
     return s(shape)
 
 
+def _draw(fn, *args, _name="random"):
+    from ..ops.dispatch import call
+    return call(fn, *args, _name=_name)
+
+
 def rand(shape, dtype=None, name=None):
-    return Tensor(jax.random.uniform(core.next_rng_key(), _shape(shape),
-                                     dtype=_dt(dtype)))
+    shp, dt = _shape(shape), _dt(dtype)
+    return _draw(lambda: jax.random.uniform(core.next_rng_key(), shp,
+                                            dtype=dt), _name="uniform_random")
 
 
 def randn(shape, dtype=None, name=None):
-    return Tensor(jax.random.normal(core.next_rng_key(), _shape(shape),
-                                    dtype=_dt(dtype)))
+    shp, dt = _shape(shape), _dt(dtype)
+    return _draw(lambda: jax.random.normal(core.next_rng_key(), shp,
+                                           dtype=dt),
+                 _name="gaussian_random")
 
 
 def standard_normal(shape, dtype=None, name=None):
@@ -41,27 +56,37 @@ def standard_normal(shape, dtype=None, name=None):
 
 def normal(mean=0.0, std=1.0, shape=None, name=None):
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
-        m = mean.value if isinstance(mean, Tensor) else mean
-        s = std.value if isinstance(std, Tensor) else std
-        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
-        return Tensor(jax.random.normal(core.next_rng_key(), shp,
-                                        core.get_default_dtype()) * s + m)
+        return _draw(
+            lambda m, s2: jax.random.normal(
+                core.next_rng_key(),
+                jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s2)),
+                core.get_default_dtype()) * s2 + m,
+            mean, std, _name="gaussian_random")
     shp = _shape(shape) if shape is not None else ()
-    return Tensor(jax.random.normal(core.next_rng_key(), shp,
-                                    core.get_default_dtype()) * std + mean)
+    return _draw(lambda: jax.random.normal(core.next_rng_key(), shp,
+                                           core.get_default_dtype())
+                 * std + mean, _name="gaussian_random")
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    key = jax.random.PRNGKey(seed) if seed else core.next_rng_key()
-    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
-                                     minval=min, maxval=max))
+    shp, dt = _shape(shape), _dt(dtype)
+    if seed:
+        return _draw(lambda: jax.random.uniform(
+            jax.random.PRNGKey(seed), shp, dt, minval=min, maxval=max),
+            _name="uniform_random")
+    return _draw(lambda: jax.random.uniform(
+        core.next_rng_key(), shp, dt, minval=min, maxval=max),
+        _name="uniform_random")
 
 
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
-    return Tensor(jax.random.randint(core.next_rng_key(), _shape(shape),
-                                     low, high, dtype=_dt(dtype or "int64")))
+    shp, dt = _shape(shape), _dt(dtype or "int64")
+    lo, hi = low, high
+    return _draw(lambda: jax.random.randint(core.next_rng_key(), shp,
+                                            lo, hi, dtype=dt),
+                 _name="randint")
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
@@ -77,9 +102,11 @@ def randperm(n, dtype="int64", name=None):
 
 
 def bernoulli(x, name=None):
-    p = x.value if isinstance(x, Tensor) else jnp.asarray(x)
-    return Tensor(jax.random.bernoulli(core.next_rng_key(), p).astype(
-        p.dtype if jnp.issubdtype(p.dtype, jnp.floating) else jnp.float32))
+    def _bern(p):
+        return jax.random.bernoulli(core.next_rng_key(), p).astype(
+            p.dtype if jnp.issubdtype(p.dtype, jnp.floating)
+            else jnp.float32)
+    return _draw(_bern, x, _name="bernoulli")
 
 
 def poisson(x, name=None):

@@ -322,3 +322,33 @@ class TestLoaderContractArgs:
         # every rank must see the same number of samples or dp
         # collectives deadlock
         assert len(set(counts)) == 1 and counts[0] == 1, counts
+
+
+def test_accumulate_grad_batches_matches_big_batch():
+    """fit(accumulate_grad_batches=k) must train like one big batch: one
+    optimizer update per k micro-batches with the MEAN micro-grad."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    w = rng.randn(4, 2).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    def make_model():
+        net = paddle.nn.Linear(4, 2)
+        net.weight.set_value(paddle.to_tensor(np.ones((4, 2), np.float32)))
+        net.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                  paddle.nn.MSELoss())
+        return m, net
+
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    m_acc, net_acc = make_model()
+    m_acc.fit(ds, epochs=1, batch_size=16, shuffle=False, verbose=0,
+              accumulate_grad_batches=4)
+    m_big, net_big = make_model()
+    m_big.fit(ds, epochs=1, batch_size=64, shuffle=False, verbose=0)
+    np.testing.assert_allclose(net_acc.weight.numpy(),
+                               net_big.weight.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    assert m_acc._optimizer._step_count == 1   # ONE update for 4 batches

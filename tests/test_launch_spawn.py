@@ -301,6 +301,26 @@ def test_launch_eager_cross_process_collectives(tmp_path):
         np.testing.assert_allclose(lin2.weight.grad.numpy(),
                                    g_own / scale * 1.5, atol=1e-5)
 
+        # DataParallel auto-syncs grads across processes during backward
+        lin3 = paddle.nn.Linear(2, 2)
+        lin3.weight.set_value(paddle.to_tensor(
+            np.eye(2, dtype=np.float32)))
+        lin3.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        dp = paddle.DataParallel(lin3)
+        loss3 = (dp(paddle.to_tensor(
+            np.ones((1, 2), np.float32))) ** 2).sum() * scale
+        loss3.backward()
+        g3 = lin3.weight.grad.numpy()
+        np.testing.assert_allclose(g3, g3[...] * 0 + g_own / scale * 1.5,
+                                   atol=1e-5)   # averaged, rank-identical
+        with dp.no_sync():
+            loss4 = (dp(paddle.to_tensor(
+                np.ones((1, 2), np.float32))) ** 2).sum() * scale
+            lin3.clear_gradients()
+            loss4.backward()
+        g4 = lin3.weight.grad.numpy()
+        np.testing.assert_allclose(g4, g_own, atol=1e-5)  # local only
+
         with open(os.path.join({str(tmp_path)!r}, f"cok_{{rank}}"), "w"):
             pass
     """))

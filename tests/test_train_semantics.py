@@ -232,3 +232,76 @@ def test_amp_decorate_exported():
     model, opt2 = paddle.amp.decorate(models=net, optimizers=opt,
                                       level="O2")
     assert model is not None and opt2 is not None
+
+
+def test_inplace_ops_keep_gradient_chain():
+    """In-place ops (+=, setitem) must NOT sever upstream gradients: the
+    tape snapshots each parent's producing node at record time, so the
+    rebind cannot create a self-loop."""
+    w = paddle.to_tensor(np.ones((2, 2), np.float32))
+    w.stop_gradient = False
+    b = paddle.to_tensor(np.ones((2,), np.float32))
+    b.stop_gradient = False
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    h = x @ w
+    h += b
+    h.sum().backward()
+    assert w.grad is not None
+    np.testing.assert_allclose(w.grad.numpy(), np.ones((2, 2)))
+    np.testing.assert_allclose(b.grad.numpy(), np.ones(2))
+
+    w2 = paddle.to_tensor(np.ones((2, 2), np.float32))
+    w2.stop_gradient = False
+    h2 = (x @ w2) * 3.0
+    h2[0, 0] = 0.0
+    h2.sum().backward()
+    np.testing.assert_allclose(w2.grad.numpy(),
+                               [[0.0, 3.0], [0.0, 3.0]])
+
+    a = paddle.to_tensor(np.full(3, 2.0, np.float32))
+    a.stop_gradient = False
+    y = a * 4.0
+    y += 1.0
+    y *= 2.0
+    y.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.full(3, 8.0))
+
+
+def test_static_randomness_redraws_per_run():
+    """Dropout masks and random creation ops in a static program must
+    differ across Executor.run calls (the build-time draw must not bake
+    into the compiled HLO as a constant)."""
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = paddle.static.data("rr_x", [None, 64], "float32")
+            h = F.dropout(x, 0.5, training=True)
+            noise = paddle.rand([4, 64])
+            exe = static.Executor()
+            exe.run(startup)
+            feed = {"rr_x": np.ones((4, 64), np.float32)}
+            h1, n1 = exe.run(main, feed=feed, fetch_list=[h, noise])
+            h2, n2 = exe.run(main, feed=feed, fetch_list=[h, noise])
+        assert not np.array_equal(np.asarray(h1) != 0,
+                                  np.asarray(h2) != 0)
+        assert not np.allclose(np.asarray(n1), np.asarray(n2))
+    finally:
+        paddle.disable_static()
+
+
+def test_minimize_harvests_existing_grads():
+    """Classic recipe loss.backward(); opt.minimize(loss) must apply ONE
+    update from the existing grads, not run a second backward."""
+    lin = nn.Linear(2, 2)
+    lin.weight.set_value(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    loss = lin(x).sum()
+    loss.backward()
+    g = lin.weight.grad.numpy().copy()
+    opt.minimize(loss)          # must not raise / double
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.ones((2, 2)) - 0.1 * g, atol=1e-6)
