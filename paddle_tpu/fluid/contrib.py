@@ -44,6 +44,12 @@ class mixed_precision(SimpleNamespace):
             def backward(self, loss, **kwargs):
                 self._scaler.scale(loss).backward()
 
+            def step(self):
+                # grads were produced from the SCALED loss: unscale
+                # through the scaler before the inner update
+                self._scaler.step(self._inner)
+                self._scaler.update()
+
             def minimize(self, loss, **kwargs):
                 from ..static.graph import in_static_mode
                 if in_static_mode():
