@@ -248,9 +248,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     if ax is None:
         if _process_count() > 1:
             # src is a GLOBAL rank (reference semantics): gather
-            # unfiltered and adopt src's row
+            # unfiltered; only group MEMBERS adopt src's row
             rows = _eager_rows(tensor.numpy())
-            _adopt(tensor, rows[src])
+            if group is None or not group.ranks \
+                    or len(group.ranks) >= rows.shape[0] \
+                    or group.rank >= 0:
+                _adopt(tensor, rows[src])
             return tensor
         return tensor
 
@@ -266,17 +269,31 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
         if _process_count() > 1:
-            # non-src processes may have no list; contribute zeros of the
-            # right shape so the global gather stays shape-uniform
+            # every process must contribute the SAME shape: n_slots is
+            # the group size (the number of scatter destinations), and
+            # each member's slot is its group rank
+            n_slots = (len(group.ranks)
+                       if group is not None and group.ranks
+                       and len(group.ranks) < _process_count()
+                       else _process_count())
             me = jax.process_index()
+            if (group is not None and group.ranks
+                    and len(group.ranks) < _process_count()):
+                if group.rank < 0:
+                    _eager_rows(np.zeros(
+                        (n_slots,) + tuple(np.asarray(
+                            tensor.numpy()).shape),
+                        np.asarray(tensor.numpy()).dtype))
+                    return tensor     # non-member: participate, no adopt
+                me = group.rank
             if tensor_list:
                 local = np.stack([np.asarray(t.numpy())
                                   for t in tensor_list])
             else:
-                local = np.zeros((_process_count(),)
-                                 + tuple(np.asarray(tensor.numpy()).shape),
-                                 np.asarray(tensor.numpy()).dtype)
-            rows = _eager_rows(local)          # [nproc, nranks, ...]
+                local = np.zeros(
+                    (n_slots,) + tuple(np.asarray(tensor.numpy()).shape),
+                    np.asarray(tensor.numpy()).dtype)
+            rows = _eager_rows(local)          # [nproc, n_slots, ...]
             _adopt(tensor, rows[src, me])
             return tensor
         if tensor_list:
@@ -394,13 +411,20 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         if _process_count() > 1:
             member, rows = _member_rows(_eager_rows(src.numpy()), group)
             if member:
-                red = rows.mean(0) if op == ReduceOp.AVG else rows.sum(0)
+                red = {ReduceOp.SUM: rows.sum(0),
+                       ReduceOp.AVG: rows.mean(0),
+                       ReduceOp.MAX: rows.max(0),
+                       ReduceOp.MIN: rows.min(0),
+                       ReduceOp.PROD: rows.prod(0)}[op]
                 n = rows.shape[0]
                 me = jax.process_index()
                 if group is not None and group.ranks and n < _process_count():
                     me = group.rank           # subset group: scatter by
-                sz = red.shape[0] // n        # group rank, not global
-                _adopt(tensor, red[me * sz:(me + 1) * sz])
+                if tensor_list:               # group rank, not global
+                    _adopt(tensor, red[me])   # slot per rank, no extra dim
+                else:
+                    sz = red.shape[0] // n
+                    _adopt(tensor, red[me * sz:(me + 1) * sz])
             return tensor
         if tensor_list:
             _adopt(tensor, src.numpy()[0])    # world of one: first slot

@@ -28,7 +28,15 @@ class DataParallel(Layer):
         self._group = group
         from . import collective
         self._collective = collective
-        if collective._process_count() > 1:
+        # per-param backward hooks require every process to reach every
+        # param (static graphs) — the reference's default contract.  With
+        # find_unused_parameters=True, auto-sync switches to the flat
+        # all-params gather at apply_collective_grads() time instead
+        # (grad-less params contribute zeros), because a hook that fires
+        # on only SOME processes would desynchronize the collective
+        # sequence and hang the job.
+        if (collective._process_count() > 1
+                and not find_unused_parameters):
             self._install_grad_sync_hooks()
 
     def _install_grad_sync_hooks(self):

@@ -90,10 +90,33 @@ def recompute(function, *args, **kwargs):
 def fused_allreduce_gradients(parameter_list, hcg=None):
     """ref fleet/utils/hybrid_parallel_util.py:117 — average gradients
     across data-parallel ranks after a manual backward.  Inside a mapped
-    region this rides the dp mesh axis; in a multi-process launch the
-    eager cross-process path aggregates host values."""
+    region this rides the dp mesh axis; in a multi-process launch ALL
+    grads travel in ONE flat cross-process gather (per-param collectives
+    would pay one global barrier each), with grad-less params
+    contributing zeros so processes with divergent graphs still agree on
+    the collective sequence."""
+    import numpy as np
     from .. import collective
-    for p in parameter_list:
+    params = [p for p in parameter_list if p is not None]
+    if not params:
+        return
+    if (collective._current_axis(None) is None
+            and collective._process_count() > 1):
+        flat = np.concatenate([
+            (np.asarray(p._grad, np.float32).ravel()
+             if p._grad is not None
+             else np.zeros(int(np.prod(p.shape)) or 1, np.float32))
+            for p in params])
+        mean = collective._eager_rows(flat).mean(0)
+        off = 0
+        for p in params:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            if p._grad is not None:
+                p.grad = mean[off:off + n].reshape(p.shape).astype(
+                    np.asarray(p._grad).dtype)
+            off += n
+        return
+    for p in params:
         g = p.grad          # Tensor view of _grad, or None
         if g is not None:
             collective.all_reduce(g, op=collective.ReduceOp.AVG)

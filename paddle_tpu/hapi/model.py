@@ -131,6 +131,28 @@ class Model:
         self._accum_grads = None
         self._accum_count = 0
 
+        def _apply_accumulated():
+            """Flush a pending accumulation window: one optimizer update
+            with the mean of the accumulated micro-grads."""
+            if self._accum_grads is None:
+                return
+            prms, _ = fx.collect_state(network)
+            pv = {k: p.value for k, p in prms.items()}
+            trainable, states = self._opt_states(prms)
+            k = float(self._accum_count)
+            mean_g = {n: g / k for n, g in self._accum_grads.items()}
+            opt._step_count += 1
+            new_pv, new_s = self._jit_apply(pv, states, mean_g,
+                                            opt.get_lr(), opt._step_count)
+            fx.write_back(network, new_pv)
+            for p, st in zip(trainable, new_s):
+                for nm, sv in st.items():
+                    opt._accumulators[nm][id(p)] = sv
+            self._accum_grads = None
+            self._accum_count = 0
+
+        self._apply_accumulated = _apply_accumulated
+
         def eval_step(pv, bv, inputs, labels):
             out, _ = fx.functional_call(network, pv, bv, inputs)
             loss = compute_loss(out, labels) if loss_fn is not None else None
@@ -295,6 +317,10 @@ class Model:
         cbks.on_begin("train")
         total_iters = 0
         done = False
+        # a previous fit/num_iters break must not leak half-accumulated
+        # grads into this run's first update
+        self._accum_grads = None
+        self._accum_count = 0
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -320,6 +346,11 @@ class Model:
                 if num_iters is not None and total_iters >= num_iters:
                     done = True           # num_iters bounds TOTAL steps,
                     break                 # not steps-per-epoch
+            if self._accum_grads is not None:
+                # unknown-length loaders (steps=None) or a num_iters
+                # break can leave a partial window: apply it now so
+                # micro-grads never leak across epoch boundaries
+                self._apply_accumulated()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 # eval flows through the callback list so EarlyStopping /

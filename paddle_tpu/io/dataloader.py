@@ -172,8 +172,12 @@ class DataLoader:
 
         def refill():
             nonlocal submitted
+            # submitted - want counts BOTH in-flight and reorder-buffered
+            # batches: subtracting len(pending) here would re-open the
+            # window as completions buffer up behind a straggler, letting
+            # `pending` absorb the epoch
             while (submitted < len(batches)
-                   and submitted - want - len(pending) < window):
+                   and submitted - want < window):
                 work_q.put((submitted, batches[submitted]))
                 submitted += 1
             if submitted == len(batches):
@@ -306,7 +310,7 @@ class DataLoader:
         def refill():
             nonlocal submitted, sent_done
             while (submitted < len(batches)
-                   and submitted - want - len(pending) < window):
+                   and submitted - want < window):
                 work_q.put((submitted, batches[submitted]))
                 submitted += 1
             if submitted == len(batches) and not sent_done:

@@ -60,11 +60,11 @@ class TracedLayer:
         self._input_spec = input_spec
         self._jitted = {}
 
-    def _get_jitted(self, training, static_kw=()):
-        key = (training, static_kw)
+    def _get_jitted(self, training, kw_key=(), skw=None):
+        key = (training, kw_key)
         if key not in self._jitted:
             layer = self._layer
-            skw = dict(static_kw)
+            skw = dict(skw or {})
 
             if layer is not None:
                 def staged(param_vals, buffer_vals, rng, arg_vals, kw_vals):
@@ -91,17 +91,19 @@ class TracedLayer:
         # would break `if flag:` python control flow in the forward)
         kw_vals = {k: v.value for k, v in kwargs.items()
                    if isinstance(v, _T)}
-        static_kw = tuple(sorted(
-            (k, v) for k, v in kwargs.items() if not isinstance(v, _T)))
+        skw = {k: v for k, v in kwargs.items() if not isinstance(v, _T)}
+        # hashable-by-repr cache key (lists/arrays appear in shape-like
+        # kwargs); the ACTUAL values close over the compiled fn
+        kw_key = tuple(sorted((k, repr(v)) for k, v in skw.items()))
         arg_vals = _to_vals(args)
         rng = core.next_rng_key()
         if self._layer is not None:
             pv, bv = fx.param_arrays(self._layer)
-            jfn = self._get_jitted(self._layer.training, static_kw)
+            jfn = self._get_jitted(self._layer.training, kw_key, skw)
             out, new_buf = jfn(pv, bv, rng, arg_vals, kw_vals)
             fx.write_back(self._layer, buffer_vals=new_buf)
         else:
-            jfn = self._get_jitted(True, static_kw)
+            jfn = self._get_jitted(True, kw_key, skw)
             out = jfn(rng, arg_vals, kw_vals)
         return _to_tensors(out)
 

@@ -132,15 +132,23 @@ class AdamW(Adam):
         return new_p.astype(p.dtype), new_state
 
     def _update_with_param(self, p, pv, g, state, lr, t):
-        # honor apply_decay_param_fun on BOTH the eager and compiled
-        # paths: zero the coeff for excluded params around the update
-        if (self._apply_decay_param_fun is not None and p is not None
-                and not self._apply_decay_param_fun(p.name)):
-            coeff, self._coeff = self._coeff, 0.0
+        # per-param decay coefficient: apply_decay_param_fun exclusion
+        # and per-group weight_decay overrides (optimizer.py _param_wd),
+        # honored identically on the eager and compiled paths
+        coeff = self._coeff
+        if p is not None:
+            if (self._apply_decay_param_fun is not None
+                    and not self._apply_decay_param_fun(p.name)):
+                coeff = 0.0
+            elif id(p) in self._param_wd:
+                wd = self._param_wd[id(p)]
+                coeff = float(wd) if isinstance(wd, (int, float)) else wd
+        if coeff != self._coeff:
+            saved, self._coeff = self._coeff, coeff
             try:
                 return self._update(pv, g, state, lr, t)
             finally:
-                self._coeff = coeff
+                self._coeff = saved
         return self._update(pv, g, state, lr, t)
 
 
@@ -203,14 +211,21 @@ class Lamb(Optimizer):
 
     def _update_with_param(self, p, pv, g, state, lr, t):
         # the LAMB recipe excludes norm/bias params from decay via
-        # exclude_from_weight_decay_fn — honored on both step paths
-        if (self._exclude_fn is not None and p is not None
-                and self._exclude_fn(p)):
-            wd, self._lamb_wd = self._lamb_wd, 0.0
+        # exclude_from_weight_decay_fn; per-group weight_decay overrides
+        # apply too — honored on both step paths
+        wd = self._lamb_wd
+        if p is not None:
+            if self._exclude_fn is not None and self._exclude_fn(p):
+                wd = 0.0
+            elif id(p) in self._param_wd:
+                ov = self._param_wd[id(p)]
+                wd = float(ov) if isinstance(ov, (int, float)) else wd
+        if wd != self._lamb_wd:
+            saved, self._lamb_wd = self._lamb_wd, wd
             try:
                 return self._update(pv, g, state, lr, t)
             finally:
-                self._lamb_wd = wd
+                self._lamb_wd = saved
         return self._update(pv, g, state, lr, t)
 
     def _update(self, p, g, state, lr, t=1):

@@ -361,7 +361,7 @@ class Executor:
         key = (id(program), len(program.ops), tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
                tuple(fetch_ids),
-               id(program.train_spec[1])
+               (program.train_spec[0], id(program.train_spec[1]))
                if program.train_spec is not None else None)
         if key not in self._cache:
             self._cache[key] = self._compile(program, feed_names, fetch_ids,
