@@ -35,6 +35,11 @@ class _AliasLoader(importlib.abc.Loader):
     def exec_module(self, module):
         if self._orig_spec is not None:
             module.__spec__ = self._orig_spec
+        if not hasattr(module, "__path__"):
+            # package-like so `import paddle.x.y` consults the finders
+            # for pseudo-submodules (attribute-only children like
+            # fluid.contrib.layers) instead of refusing at the parent
+            module.__path__ = []
 
     # runpy (``python -m paddle.distributed.launch``) requires the loader
     # to expose the module's code object — delegate to the real loader
@@ -55,18 +60,53 @@ class _AliasLoader(importlib.abc.Loader):
         return bool(spec is not None and spec.submodule_search_locations)
 
 
+class _NamespaceLoader(importlib.abc.Loader):
+    """Materialize an attribute-only pseudo-submodule (a SimpleNamespace
+    or plain object on the parent module — e.g. fluid.contrib.layers,
+    fluid.dygraph.base) as an importable module."""
+
+    def __init__(self, obj):
+        self._obj = obj
+
+    def create_module(self, spec):
+        import types
+        if isinstance(self._obj, types.ModuleType):
+            return self._obj
+        mod = types.ModuleType(spec.name)
+        src = self._obj
+        ns = vars(src) if hasattr(src, "__dict__") else {
+            k: getattr(src, k) for k in dir(src) if not k.startswith("_")}
+        mod.__dict__.update(ns)
+        return mod
+
+    def exec_module(self, module):
+        pass
+
+
 class _AliasFinder(importlib.abc.MetaPathFinder):
     def find_spec(self, fullname, path=None, target=None):
         if not fullname.startswith("paddle."):
             return None
         real = "paddle_tpu." + fullname[len("paddle."):]
         try:
-            if importlib.util.find_spec(real) is None:
-                return None
+            if importlib.util.find_spec(real) is not None:
+                return importlib.util.spec_from_loader(
+                    fullname, _AliasLoader(real))
         except (ImportError, ValueError):
+            pass
+        # pseudo-submodule: an attribute of the parent real module
+        parent, _, tail = real.rpartition(".")
+        if not parent:
+            return None
+        try:
+            pmod = importlib.import_module(parent)
+        except ImportError:
+            return None
+        obj = getattr(pmod, tail, None)
+        if obj is None or isinstance(obj, (int, float, str, bytes)):
             return None
         return importlib.util.spec_from_loader(fullname,
-                                               _AliasLoader(real))
+                                               _NamespaceLoader(obj))
 
 
 # alias every already-imported paddle_tpu submodule, then the root itself:
@@ -74,6 +114,10 @@ class _AliasFinder(importlib.abc.MetaPathFinder):
 for _name, _mod in list(sys.modules.items()):
     if _name == "paddle_tpu" or _name.startswith("paddle_tpu."):
         sys.modules["paddle" + _name[len("paddle_tpu"):]] = _mod
+        if not hasattr(_mod, "__path__"):
+            # package-like so pseudo-submodule imports (attribute-only
+            # children like fluid.contrib.layers) reach the finder
+            _mod.__path__ = []
 
 if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
     sys.meta_path.insert(0, _AliasFinder())

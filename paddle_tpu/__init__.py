@@ -59,6 +59,7 @@ dtype = _np.dtype
 from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: E402
 from .framework.core import Generator  # noqa: E402
 from . import debug  # noqa: E402
+from . import compat  # noqa: E402
 
 
 def get_rng_state():

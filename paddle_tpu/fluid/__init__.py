@@ -78,7 +78,10 @@ class DatasetFactory:
 
     def create_dataset(self, datafeed_class="QueueDataset"):
         kinds = {"InMemoryDataset": InMemoryDataset,
-                 "QueueDataset": QueueDataset}
+                 "QueueDataset": QueueDataset,
+                 # boxps is a GPU-PS accelerator dataset; the in-memory
+                 # pipeline serves its API here
+                 "BoxPSDataset": InMemoryDataset}
         if datafeed_class not in kinds:
             raise ValueError(f"unknown dataset class {datafeed_class!r}; "
                              f"choose from {sorted(kinds)}")
@@ -193,3 +196,27 @@ class DataFeeder:
                         f"is {decl}, but receive {list(arr.shape)}")
             out[name] = arr
         return out
+
+# PS-era communicator (ref fluid/communicator.py): sync-mode no-ops on
+# TPU (there is no parameter server; collectives live in the step)
+from types import SimpleNamespace as _SNS
+
+
+class Communicator:
+    def __init__(self, program=None, *args, **kwargs):
+        self._running = False
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running
+
+
+communicator = _SNS(Communicator=Communicator)
+
+# fluid-era spelling: fluid.Linear is the dygraph Linear
+from .dygraph import Linear  # noqa: E402,F401

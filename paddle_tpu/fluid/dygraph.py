@@ -443,3 +443,19 @@ class TreeConv(_nn.Layer):
         out = call(_tc, nodes_vector, edge_set, self.W, self.bias,
                    _name="tree_conv", _nondiff=(1,))
         return self._act(out) if self._act else out
+
+
+# fluid.dygraph.base (ref fluid/dygraph/base.py): guard/to_variable/grad
+from types import SimpleNamespace as _SNS_b
+
+
+def _dygraph_grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+                  create_graph=False, only_inputs=True, allow_unused=False,
+                  no_grad_vars=None):
+    from ..autograd.tape import grad as _g
+    return _g(outputs, inputs, grad_outputs, retain_graph, create_graph,
+              only_inputs, allow_unused)
+
+
+base = _SNS_b(guard=guard, to_variable=to_variable, grad=_dygraph_grad,
+              no_grad=None)

@@ -59,3 +59,9 @@ def load_params(executor, dirname, main_program=None, filename=None):
 
 
 load_persistables = load_params
+
+
+# ref fluid/reader.py::PyReader — the class spelling of the py_reader
+# machinery; reader_compat implements the full contract (decorate_*,
+# start/reset, EOF loop)
+from .reader_compat import PyReader  # noqa: E402,F401

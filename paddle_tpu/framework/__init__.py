@@ -13,3 +13,6 @@ def in_dygraph_mode():
 
 def in_dynamic_mode():
     return not core.in_tracing()
+
+# ref python/paddle/framework/__init__.py re-exports ParamAttr
+from .param_attr import ParamAttr  # noqa: E402,F401

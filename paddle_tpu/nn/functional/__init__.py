@@ -37,3 +37,8 @@ from .sequence import (sequence_pad, sequence_unpad, sequence_pool,
                        sequence_conv, sequence_first_step,
                        sequence_last_step, sequence_reshape,
                        sequence_expand_as, sequence_slice, sequence_scatter)
+
+# fluid-era long-form spellings
+adaptive_average_pool1d = adaptive_avg_pool1d
+adaptive_average_pool2d = adaptive_avg_pool2d
+adaptive_average_pool3d = adaptive_avg_pool3d

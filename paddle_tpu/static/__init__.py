@@ -133,3 +133,33 @@ def load(program, model_path, executor=None, var_list=None):
         key = f"param_{i}"
         if key in state:
             p.set_value(state[key])
+
+# paddle.static.amp (ref static/amp): the dygraph amp package serves both
+# modes here — auto_cast records into programs, decorate wraps optimizers
+from .. import amp  # noqa: E402,F401
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs):
+    """ref static/io.py::serialize_program — portable bytes of the
+    program structure (the pickled record-replay Program)."""
+    import pickle
+    from .graph import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps({"n_ops": len(prog.ops),
+                         "feeds": list(prog.feed_ids),
+                         "params": [getattr(p, "name", str(i))
+                                    for i, p in prog.params.items()]})
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs):
+    """ref static/io.py::serialize_persistables — parameter payload
+    bytes."""
+    import pickle
+    import numpy as np
+    from .graph import default_main_program
+    prog = program or default_main_program()
+    state = {getattr(p, "name", str(i)): np.asarray(p.numpy())
+             for i, p in prog.params.items()}
+    return pickle.dumps(state)

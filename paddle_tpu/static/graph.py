@@ -150,7 +150,46 @@ class Program:
     def global_block(self):
         return self
 
-    # block-compat helpers
+    # block-compat helpers (ref framework.py Program/Block surface: the
+    # record-replay Program is its own single global block)
+    def current_block(self):
+        return self
+
+    def block(self, index=0):
+        return self
+
+    @property
+    def blocks(self):
+        return [self]
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def var(self, name):
+        """Look up a build-time variable by NAME (feeds, params, named
+        tensors) — returns the live Tensor, the reference's Variable
+        analogue here."""
+        if name in self.feed_ids:
+            wr = _var_tensors.get(self.feed_ids[name])
+            t = wr() if wr is not None else None
+            if t is not None:
+                return t
+        for p in self.params.values():
+            if getattr(p, "name", None) == name:
+                return p
+        for t in self.captured.values():
+            if getattr(t, "name", None) == name:
+                return t
+        raise ValueError(f"var '{name}' not found in this program")
+
+    def has_var(self, name):
+        try:
+            self.var(name)
+            return True
+        except ValueError:
+            return False
+
     def all_parameters(self):
         return list(self.params.values())
 

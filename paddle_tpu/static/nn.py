@@ -12,11 +12,26 @@ from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     from ..tensor.manipulation import reshape
+    if isinstance(x, (list, tuple)):
+        # ref static/nn/common.py::fc — multiple inputs each get their
+        # own weight and the projections SUM before bias/activation
+        outs = [fc(xi, size, num_flatten_dims, weight_attr,
+                   False if i else bias_attr, None, name)
+                for i, xi in enumerate(x)]
+        out = outs[0]
+        for o in outs[1:]:
+            out = out + o
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
     in_features = 1
     for s in x.shape[num_flatten_dims:]:
         in_features *= s
     if num_flatten_dims != 1 or len(x.shape) > 2:
-        flat = reshape(x, list(x.shape[:num_flatten_dims]) + [-1])
+        # leading dims stay SYMBOLIC (paddle's reshape-0 convention):
+        # baking the build-time placeholder batch would wedge any
+        # replay at a different batch size
+        flat = reshape(x, [0] * num_flatten_dims + [-1])
     else:
         flat = x
     layer = _nn.Linear(in_features, size, weight_attr, bias_attr)

@@ -34,6 +34,8 @@ def ones(shape, dtype=None, name=None):
 def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
+    elif isinstance(fill_value, str):
+        fill_value = float(fill_value)   # ref fill_constant: str accepted
     if dtype is None:
         # ref creation.py:440 — dtype=None ALWAYS means float32, even
         # for int/bool fill values (full([2], 7) is float, not int)

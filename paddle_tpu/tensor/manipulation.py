@@ -22,6 +22,15 @@ def _ints(seq):
 
 def reshape(x, shape, name=None):
     shp = _ints(shape)
+    if any(s == 0 for s in shp):
+        # paddle convention: 0 copies the input's dim at that index —
+        # resolved INSIDE the op from the runtime shape, so a recorded
+        # reshape keeps symbolic batch dims instead of baking the
+        # build-time placeholder size
+        def _r0(a):
+            tgt = [a.shape[i] if s == 0 else s for i, s in enumerate(shp)]
+            return jnp.reshape(a, tgt)
+        return call(_r0, x, _name="reshape")
     return call(lambda a: jnp.reshape(a, shp), x, _name="reshape")
 
 

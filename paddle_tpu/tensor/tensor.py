@@ -267,6 +267,28 @@ class Tensor:
         self.value = jnp.zeros_like(self.value)
         return self
 
+    def get_tensor(self):
+        """ref VarBase.get_tensor() — the LoDTensor handle: np.array()
+        reads it, .set(array, place) writes it back."""
+        owner = self
+
+        class _LoDTensorView:
+            def __array__(self, dtype=None):
+                import numpy as _np
+                a = _np.asarray(owner.numpy())
+                return a.astype(dtype) if dtype is not None else a
+
+            def set(self, array, place=None):
+                owner.set_value(array)
+
+            def shape(self):
+                return list(owner.shape)
+
+            def _dtype(self):
+                return owner.dtype
+
+        return _LoDTensorView()
+
     def _rebind(self, other: "Tensor"):
         """Adopt another tensor's value and autograd linkage (for in-place
         style APIs implemented out-of-place)."""
