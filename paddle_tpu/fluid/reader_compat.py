@@ -75,8 +75,12 @@ class PyReader:
     source, start()/reset() around each pass, read_file() to get the data
     vars."""
 
-    def __init__(self, capacity, shapes=None, dtypes=None, lod_levels=None,
-                 name=None, use_double_buffer=True, feed_vars=None):
+    def __init__(self, capacity=64, shapes=None, dtypes=None,
+                 lod_levels=None, name=None, use_double_buffer=True,
+                 feed_vars=None, feed_list=None, iterable=True,
+                 return_list=False):
+        if feed_list is not None:       # ref fluid.io.PyReader spelling
+            feed_vars = feed_list
         from ..static.graph import data as _static_data
 
         self.capacity = int(capacity)

@@ -83,9 +83,12 @@ class Adam(Optimizer):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
-        self._beta1 = beta1
-        self._beta2 = beta2
-        self._epsilon = epsilon
+
+        def _scalar(b):
+            return float(b.numpy()) if hasattr(b, "numpy") else b
+        self._beta1 = _scalar(beta1)   # ref: Tensor betas accepted
+        self._beta2 = _scalar(beta2)
+        self._epsilon = _scalar(epsilon)
 
     def _update(self, p, g, state, lr, t=1):
         gf = g.astype(jnp.float32)

@@ -163,3 +163,19 @@ def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
     state = {getattr(p, "name", str(i)): np.asarray(p.numpy())
              for i, p in prog.params.items()}
     return pickle.dumps(state)
+
+
+def deserialize_program(data):
+    """ref static/io.py::deserialize_program — inverse of
+    serialize_program (structure summary; the executable itself is
+    rebuilt by the Executor)."""
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """Load serialized parameter payloads back into the program."""
+    import pickle
+    state = pickle.loads(data)
+    program.set_state_dict(state)
+    return state

@@ -181,7 +181,57 @@ class Program:
         for t in self.captured.values():
             if getattr(t, "name", None) == name:
                 return t
+        for vid in self._avail:          # recorded op outputs, by name
+            wr = _var_tensors.get(vid)
+            t = wr() if wr is not None else None
+            if t is not None and getattr(t, "name", None) == name:
+                return t
         raise ValueError(f"var '{name}' not found in this program")
+
+    def create_var(self, name=None, shape=None, dtype="float32",
+                   persistable=False, **kwargs):
+        """Block.create_var — a fresh build-time variable (plain Tensor
+        here; ops give it a var id on first use)."""
+        t = Tensor(np.zeros([1 if (s is None or s < 0) else int(s)
+                             for s in (shape or [1])],
+                            np.dtype(core.convert_dtype(dtype))))
+        if name:
+            t.name = name
+        t.persistable = bool(persistable)
+        _ensure_var_id(t, self)
+        return t
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = [f"Program(ops={len(self.ops)}, feeds={list(self.feed_ids)},"
+                 f" params={len(self.params)})"]
+        for op in self.ops:
+            lines.append(f"  {op.name}({len(op.leaf_specs)} in -> "
+                         f"{len(op.out_ids)} out)")
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def state_dict(self, mode="all", scope=None):
+        """ref static Program.state_dict — parameter (and persistable
+        buffer) tensors by name."""
+        out = {}
+        for p in self.params.values():
+            out[getattr(p, "name", "")] = p
+        if mode in ("all", "opt"):
+            for t in self.captured.values():
+                if getattr(t, "persistable", False):
+                    out[getattr(t, "name", "")] = t
+        return out
+
+    def set_state_dict(self, state_dict, scope=None):
+        by_name = {getattr(p, "name", None): p
+                   for p in self.params.values()}
+        for t in self.captured.values():
+            by_name.setdefault(getattr(t, "name", None), t)
+        for k, v in state_dict.items():
+            if k in by_name and by_name[k] is not None:
+                by_name[k].set_value(
+                    v.value if isinstance(v, Tensor) else v)
 
     def has_var(self, name):
         try:
@@ -372,11 +422,16 @@ class Executor:
         for hook in _executor_feed_hooks:
             feed = hook(program, feed)
         fetch_list = fetch_list or []
+        if isinstance(fetch_list, (str, Tensor)):
+            fetch_list = [fetch_list]   # ref: bare fetch accepted
 
         fetch_ids = []
         for f in fetch_list:
             if isinstance(f, Tensor):
                 fetch_ids.append(_ensure_var_id(f, program))
+            elif isinstance(f, str):
+                # fetch by NAME (the reference's fetch_list=[z.name])
+                fetch_ids.append(_ensure_var_id(program.var(f), program))
             else:
                 fetch_ids.append(f)
 
