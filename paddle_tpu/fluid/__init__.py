@@ -40,8 +40,11 @@ from . import nets
 from . import backward
 from ..utils import unique_name  # fluid.unique_name.guard()
 
-# fluid.data / fluid.embedding are module-level in the reference
-from .layers import data, embedding
+# fluid.data / fluid.embedding are module-level in the reference.
+# fluid.data (ref fluid/data.py) does NOT prepend a batch dim — only
+# fluid.layers.data (io.py, append_batch_size=True) does
+from .layers import embedding
+from ..static.graph import data
 
 
 def is_compiled_with_cuda():
@@ -220,3 +223,45 @@ communicator = _SNS(Communicator=Communicator)
 
 # fluid-era spelling: fluid.Linear is the dygraph Linear
 from .dygraph import Linear  # noqa: E402,F401
+
+from .dygraph import save_dygraph, load_dygraph  # noqa: E402,F401
+
+
+class DistributeTranspilerConfig:
+    """ref fluid/transpiler/distribute_transpiler.py — config holder."""
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    sync_mode = True
+
+
+class DistributeTranspiler:
+    """ref transpiler — rewrites programs for parameter-server training.
+    TPU-native programs keep sparse tables mesh-sharded inside the
+    compiled step (MIGRATING.md deviations #8): transpile() is a sync-
+    mode identity, and the trainer/pserver getters return the original
+    program so reference startup scripts run."""
+
+    def __init__(self, config=None):
+        self._config = config or DistributeTranspilerConfig()
+        self._program = None
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        from ..static.graph import default_main_program
+        self._program = program or default_main_program()
+
+    def get_trainer_program(self, wait_port=True):
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        return self._program
+
+    def get_pserver_programs(self, endpoint):
+        return self._program, self._program
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        from ..static.graph import default_startup_program
+        return startup_program or default_startup_program()

@@ -459,3 +459,10 @@ def _dygraph_grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
 base = _SNS_b(guard=guard, to_variable=to_variable, grad=_dygraph_grad,
               no_grad=None)
+
+
+# fluid.dygraph.nn — the layer-class submodule spelling (this module IS
+# the flat namespace; expose itself)
+import sys as _sys
+nn = _sys.modules[__name__]
+from ..amp.grad_scaler import AmpScaler  # noqa: E402,F401

@@ -12,3 +12,11 @@ XavierInitializer = XavierUniform
 # fluid MSRAInitializer defaults uniform=True (ref initializer.py::MSRA)
 MSRAInitializer = KaimingUniform
 NumpyArrayInitializer = Assign
+
+# short aliases (ref fluid/initializer.py bottom: Xavier = XavierInitializer
+# etc.)
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Normal_ = Normal
+TruncatedNormal_ = TruncatedNormal
+Bilinear = None  # bilinear-upsample init: use nn.initializer on 2.x path

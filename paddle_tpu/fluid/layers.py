@@ -45,7 +45,17 @@ group_norm = _snn.group_norm
 instance_norm = _snn.instance_norm
 spectral_norm = _snn.spectral_norm
 bilinear_tensor_product = _snn.bilinear_tensor_product
-embedding = _snn.embedding
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """ref fluid/input.py::embedding — the LoD-era contract: an input
+    whose LAST dim is 1 holds one id per position, and the output drops
+    that dim (out = input_shape[:-1] + [emb_dim])."""
+    from ..tensor.manipulation import squeeze
+    x = input
+    if len(x.shape) > 1 and x.shape[-1] == 1:
+        x = squeeze(x, axis=-1)
+    return _snn.embedding(x, size, is_sparse, padding_idx, param_attr,
+                          dtype)
 
 
 def data(name, shape, dtype="float32", append_batch_size=True,

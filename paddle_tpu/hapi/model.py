@@ -33,6 +33,11 @@ def _wrap_batch(x):
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        # a single InputSpec is accepted (ref hapi _verify_spec wraps it)
+        if inputs is not None and not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if labels is not None and not isinstance(labels, (list, tuple)):
+            labels = [labels]
         self._inputs = inputs
         self._labels = labels
         self._optimizer = None

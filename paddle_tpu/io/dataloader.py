@@ -112,6 +112,11 @@ class DataLoader:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
 
+    def __call__(self):
+        # fluid-era loops spell `for batch in loader():` (the reader-
+        # factory convention) — calling yields the same iterator
+        return iter(self)
+
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 

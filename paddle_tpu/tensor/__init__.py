@@ -32,3 +32,29 @@ def _bind_longtail():
     T.floor_ = lambda s: s._rebind(math.floor(s))
     T.round_ = lambda s: s._rebind(math.round(s))
     T.rsqrt_ = lambda s: s._rebind(math.rsqrt(s))
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """ref fluid/layers/control_flow.py::create_array — the LoDTensorArray
+    analogue is a plain python list of Tensors."""
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    idx = int(i.item() if hasattr(i, "item") else i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i.item() if hasattr(i, "item") else i)]
+
+
+def array_length(array):
+    from .tensor import Tensor
+    import numpy as _np
+    return Tensor(_np.asarray(len(array), _np.int64))
