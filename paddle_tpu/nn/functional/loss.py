@@ -86,6 +86,9 @@ def _nll(input, label, weight, ignore_index, reduction):
     def _f(logp, lbl, *w):
         ax = 1 if logp.ndim > 1 else 0
         lbl = lbl.astype(jnp.int32)
+        if lbl.ndim == logp.ndim and lbl.shape[-1] == 1:
+            # fluid-era [N, 1] labels (LoD convention) — squeeze to [N]
+            lbl = lbl.reshape(lbl.shape[:-1])
         valid = lbl != ignore_index
         safe = jnp.where(valid, lbl, 0)
         picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)

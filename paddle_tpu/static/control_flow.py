@@ -381,6 +381,57 @@ def case(pred_fn_pairs, default=None, name=None):
         # ref semantics: the last fn doubles as the default
         default = pairs[-1][1]
 
+    # Build-time-CONSTANT predicates (computed from fixed tensors — the
+    # reference's own examples) resolve eagerly: branches may then have
+    # heterogeneous shapes/dtypes, which a lax.cond chain cannot carry.
+    # A predicate only counts as constant when its value is concrete AND
+    # the replay cannot change it — nothing in its transitive recorded
+    # inputs is a feed, parameter, or mutated buffer.
+    def _replay_dependent(p):
+        from .graph import in_static_mode, default_main_program
+        if not in_static_mode():
+            return False
+        vid = getattr(p, "_weakref_slot", None)
+        if vid is None:
+            return False               # plain build tensor
+        prog = default_main_program()
+        sources = set(prog.feed_ids.values()) | set(prog.params)
+        sources |= {v for _, v in prog.mutated.values()}
+        producers = {}
+        for op in prog.ops:
+            ins = [r for k, r in op.leaf_specs if k == "var"]
+            for o in op.out_ids:
+                producers[o] = ins
+        seen, stack = set(), [vid]
+        while stack:
+            v = stack.pop()
+            if v in sources:
+                return True
+            if v in seen:
+                continue
+            seen.add(v)
+            stack.extend(producers.get(v, ()))
+        return False
+
+    def _const_bool(p):
+        from ..tensor.tensor import Tensor
+        import jax as _jax
+        v = p.value if isinstance(p, Tensor) else p
+        if isinstance(v, _jax.core.Tracer):
+            return None
+        if isinstance(p, Tensor) and _replay_dependent(p):
+            return None
+        try:
+            return bool(v)
+        except Exception:                                  # noqa: BLE001
+            return None
+    consts = [_const_bool(p) for p, _ in pairs]
+    if all(c is not None for c in consts):
+        for c, (_, fn) in zip(consts, pairs):
+            if c:
+                return fn()
+        return default()
+
     chain = default
     for pred, fn in reversed(pairs):
         chain = (lambda p=pred, f=fn, nxt=chain: lambda: cond(p, f, nxt))()

@@ -530,7 +530,17 @@ class Executor:
             d(target)/d(wrt): re-replay with the wrt var cut and let XLA
             differentiate (the two replays CSE away under jit)."""
             if fid not in program.grad_map:
-                return env[fid]
+                if fid in env:
+                    return env[fid]
+                # not produced by any recorded op: a build-time value
+                # (eagerly-resolved control flow, plain constants).  An
+                # UNFED feed placeholder must still error clearly rather
+                # than bake its dummy build value.
+                for nm, fvid in program.feed_ids.items():
+                    if fvid == fid:
+                        raise KeyError(
+                            f"feed '{nm}' was not provided to run()")
+                return program.lookup(env, fid)
             tgt_id, wrt_id, seed = program.grad_map[fid]
 
             def scalar_of(wv):

@@ -543,6 +543,13 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 
     def _loss(loc, conf, gb, gl, pb, *rest):
         pv = rest[0] if rest else None
+        if loc.ndim == 2:
+            # LoD-form inputs (no batch dim, ragged gt): treat as one
+            # image — the padded dense contract's degenerate case
+            loc = loc[None]
+            conf = conf[None]
+            gb = gb.reshape(1, -1, 4)
+            gl = gl.reshape(1, -1)
         B, N, _ = loc.shape
         G = gb.shape[1]
         C = conf.shape[-1]
