@@ -299,6 +299,42 @@ class DatasetBase:
                 samples.append(_parse_multislot_line(ln, n_slots))
         return samples
 
+    # ---- PS-era knobs (ref dataset.py): accepted for API shape; the
+    # TPU pipeline has no distributed instance-id plumbing to configure
+    def preprocess_instance(self):
+        pass
+
+    def postprocess_instance(self):
+        pass
+
+    def set_parse_ins_id(self, parse_ins_id):
+        pass
+
+    def set_parse_content(self, parse_content):
+        pass
+
+    def _init_distributed_settings(self, **kwargs):
+        pass
+
+    def update_settings(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, "_" + k, v)
+
+    def set_queue_num(self, queue_num):
+        self._queue_num = queue_num
+
+    def set_fleet_send_batch_size(self, n=1024):
+        pass
+
+    def set_fleet_send_sleep_seconds(self, n=0):
+        pass
+
+    def set_merge_by_lineid(self, merge_size=2):
+        pass
+
+    def slots_shuffle(self, slots):
+        pass
+
     def _slot_pad_len(self, si, batch_max):
         """Stable per-slot padded length.  Padding each batch to ITS max
         would hand the Executor a different feed shape per batch — one

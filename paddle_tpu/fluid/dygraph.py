@@ -466,3 +466,10 @@ base = _SNS_b(guard=guard, to_variable=to_variable, grad=_dygraph_grad,
 import sys as _sys
 nn = _sys.modules[__name__]
 from ..amp.grad_scaler import AmpScaler  # noqa: E402,F401
+
+# fluid.dygraph.amp (ref fluid/dygraph/amp/{auto_cast,loss_scaler}.py)
+from ..amp.auto_cast import auto_cast as amp_guard  # noqa: E402,F401
+from types import SimpleNamespace as _SNS_a
+amp = _SNS_a(amp_guard=amp_guard, AmpScaler=AmpScaler,
+             auto_cast=amp_guard)
+amp_decorate = None
