@@ -103,7 +103,12 @@ class _AliasFinder(importlib.abc.MetaPathFinder):
         except ImportError:
             return None
         obj = getattr(pmod, tail, None)
-        if obj is None or isinstance(obj, (int, float, str, bytes)):
+        import types as _types
+        from types import SimpleNamespace as _SNS
+        # ONLY module-shaped attributes materialize: importing a class
+        # or function as a module would make the import system REPLACE
+        # the real attribute on the shared parent with a junk module
+        if not isinstance(obj, (_types.ModuleType, _SNS)):
             return None
         return importlib.util.spec_from_loader(fullname,
                                                _NamespaceLoader(obj))

@@ -74,10 +74,11 @@ class GradScaler:
 
     def minimize(self, optimizer, scaled_loss):
         # the documented recipe calls scaled.backward() BEFORE minimize;
-        # only run backward here when the user hasn't (re-running would
-        # raise on the freed graph or double every gradient)
-        if not any(p is not None and p._grad is not None
-                   for p in optimizer._parameters):
+        # detect that by the loss's graph state (a consumed graph has
+        # vjp_fn freed), NOT by grad presence — stale grads from an
+        # uncleared previous step must not suppress this step's backward
+        node = scaled_loss._node
+        if node is not None and node.vjp_fn is not None:
             scaled_loss.backward()
         self.step(optimizer)
         self.update()

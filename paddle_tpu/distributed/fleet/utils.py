@@ -102,15 +102,17 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
         return
     if (collective._current_axis(None) is None
             and collective._process_count() > 1):
+        def _numel(p):
+            return int(np.prod(p.shape)) if p.shape else 1
         flat = np.concatenate([
             (np.asarray(p._grad, np.float32).ravel()
              if p._grad is not None
-             else np.zeros(int(np.prod(p.shape)) or 1, np.float32))
-            for p in params])
+             else np.zeros(_numel(p), np.float32))
+            for p in params]) if params else np.zeros(0, np.float32)
         mean = collective._eager_rows(flat).mean(0)
         off = 0
         for p in params:
-            n = int(np.prod(p.shape)) if p.shape else 1
+            n = _numel(p)               # pack and unpack use ONE count
             if p._grad is not None:
                 p.grad = mean[off:off + n].reshape(p.shape).astype(
                     np.asarray(p._grad).dtype)

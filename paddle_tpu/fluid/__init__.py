@@ -42,8 +42,11 @@ from ..utils import unique_name  # fluid.unique_name.guard()
 
 # fluid.data / fluid.embedding are module-level in the reference.
 # fluid.data (ref fluid/data.py) does NOT prepend a batch dim — only
-# fluid.layers.data (io.py, append_batch_size=True) does
-from .layers import embedding
+# fluid.layers.data (io.py, append_batch_size=True) does.  Likewise
+# fluid.embedding (input.py, lookup_table_v2) appends the emb dim with
+# NO trailing-1 squeeze; the squeeze is fluid.layers.embedding's v1
+# LoD contract
+from ..static.nn import embedding
 from ..static.graph import data
 
 

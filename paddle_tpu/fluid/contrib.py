@@ -60,8 +60,8 @@ class mixed_precision(SimpleNamespace):
                     # a no-tape static tensor)
                     return self._inner.minimize(loss, **kwargs)
                 scaled = self._scaler.scale(loss)
-                if not any(p is not None and p._grad is not None
-                           for p in self._inner._parameters):
+                node = getattr(scaled, "_node", None)
+                if node is not None and node.vjp_fn is not None:
                     scaled.backward()
                 self._scaler.step(self._inner)
                 self._scaler.update()

@@ -92,9 +92,14 @@ class TracedLayer:
         kw_vals = {k: v.value for k, v in kwargs.items()
                    if isinstance(v, _T)}
         skw = {k: v for k, v in kwargs.items() if not isinstance(v, _T)}
-        # hashable-by-repr cache key (lists/arrays appear in shape-like
-        # kwargs); the ACTUAL values close over the compiled fn
-        kw_key = tuple(sorted((k, repr(v)) for k, v in skw.items()))
+
+        # hashable cache key; numpy arrays fingerprint by full content
+        # (their summarized repr elides elements and would collide)
+        def _fp(v):
+            if isinstance(v, np.ndarray):
+                return ("nd", v.shape, str(v.dtype), hash(v.tobytes()))
+            return repr(v)
+        kw_key = tuple(sorted((k, _fp(v)) for k, v in skw.items()))
         arg_vals = _to_vals(args)
         rng = core.next_rng_key()
         if self._layer is not None:

@@ -163,10 +163,11 @@ class Optimizer:
             return None, None
         # classic recipe: loss.backward() THEN minimize(loss) — the
         # reference dygraph minimize HARVESTS existing grads and never
-        # re-runs backward (a second backward raises or doubles grads);
-        # when no grads exist yet, run the whole backward+step here
-        if not any(p is not None and p._grad is not None
-                   for p in self._parameters):
+        # re-runs backward.  Detect a prior backward by the loss's graph
+        # state (consumed graphs free their vjp closures); grad presence
+        # would let a stale uncleared step suppress this one's backward
+        node = getattr(loss, "_node", None)
+        if node is not None and node.vjp_fn is not None:
             loss.backward()
         self.step()
         self.clear_grad()

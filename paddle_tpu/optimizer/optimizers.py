@@ -83,12 +83,23 @@ class Adam(Optimizer):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        # Tensor betas are kept LIVE (ref: warmup schedules update the
+        # beta Variable in place); eager steps read the current value
+        # each step.  Compiled steps snapshot at trace time.
+        self._beta1_src = beta1
+        self._beta2_src = beta2
+        self._epsilon = (float(epsilon.numpy())
+                         if hasattr(epsilon, "numpy") else epsilon)
 
-        def _scalar(b):
-            return float(b.numpy()) if hasattr(b, "numpy") else b
-        self._beta1 = _scalar(beta1)   # ref: Tensor betas accepted
-        self._beta2 = _scalar(beta2)
-        self._epsilon = _scalar(epsilon)
+    @property
+    def _beta1(self):
+        b = self._beta1_src
+        return float(b.numpy()) if hasattr(b, "numpy") else b
+
+    @property
+    def _beta2(self):
+        b = self._beta2_src
+        return float(b.numpy()) if hasattr(b, "numpy") else b
 
     def _update(self, p, g, state, lr, t=1):
         gf = g.astype(jnp.float32)

@@ -397,6 +397,15 @@ def case(pred_fn_pairs, default=None, name=None):
         prog = default_main_program()
         sources = set(prog.feed_ids.values()) | set(prog.params)
         sources |= {v for _, v in prog.mutated.values()}
+        # persistable captures ride as runtime args (BN stats shared
+        # with other programs) and recorded RANDOM ops re-draw per run
+        sources |= {vid for vid, t in prog.captured.items()
+                    if getattr(t, "persistable", False)}
+        random_ops = {"uniform_random", "gaussian_random", "randint",
+                      "bernoulli", "dropout", "rrelu", "alpha_dropout",
+                      "gumbel_softmax", "multinomial", "randperm"}
+        sources |= {o for op in prog.ops if op.name in random_ops
+                    for o in op.out_ids}
         producers = {}
         for op in prog.ops:
             ins = [r for k, r in op.leaf_specs if k == "var"]

@@ -525,6 +525,8 @@ class Executor:
             program.replay(env)
             return env
 
+        _step_key = [None]   # the per-run rng all replays restart from
+
         def eval_fetch(env, fid, feed_vals, param_vals, cap_vals):
             """A fetch id minted by append_backward/gradients resolves to
             d(target)/d(wrt): re-replay with the wrt var cut and let XLA
@@ -544,6 +546,10 @@ class Executor:
             tgt_id, wrt_id, seed = program.grad_map[fid]
 
             def scalar_of(wv):
+                if _step_key[0] is not None:
+                    # every replay of one step restarts from the SAME
+                    # per-run key so random ops draw identical values
+                    core.set_trace_key(_step_key[0])
                 env2 = dict(zip(feed_var_ids, feed_vals))
                 env2.update(dict(zip(param_ids, param_vals)))
                 env2.update(dict(zip(cap_ids, cap_vals)))
@@ -563,16 +569,26 @@ class Executor:
                            t, rng):
                 # install the TRACED rng so recorded random ops (dropout,
                 # noise) split from a per-run key instead of baking the
-                # build-time draw into the compiled HLO as a constant
+                # build-time draw into the compiled HLO as a constant.
+                # _train_body re-installs the SAME key before every
+                # forward replay (recompute fetch pass, grad re-replays)
+                # so all replays of one step draw identical masks and
+                # CSE back together.
                 prev_key = core.get_trace_key()
                 core.set_trace_key(rng)
+                _step_key[0] = rng
                 try:
                     return _train_body(feed_vals, param_vals, cap_vals,
-                                       states, lr, t)
+                                       states, lr, t, rng)
                 finally:
+                    _step_key[0] = None
                     core.set_trace_key(prev_key)
 
-            def _train_body(feed_vals, param_vals, cap_vals, states, lr, t):
+            def _train_body(feed_vals, param_vals, cap_vals, states, lr,
+                            t, rng=None):
+                def _rekey():
+                    if rng is not None:
+                        core.set_trace_key(rng)
                 if getattr(opt, "_recompute", False):
                     # fluid RecomputeOptimizer: rematerialize the forward
                     # in the backward (activation memory -> FLOPs).  Only
@@ -580,13 +596,16 @@ class Executor:
                     # — returning the env would keep every activation live
                     # and defeat the remat; fetches re-run a forward-only
                     # pass (no residuals) outside it.
-                    loss_fn = jax.checkpoint(
-                        lambda pv: forward(feed_vals, pv,
-                                           cap_vals)[loss_id])
-                    grads = jax.grad(loss_fn)(list(param_vals))
+                    def loss_fn(pv):
+                        _rekey()
+                        return forward(feed_vals, pv, cap_vals)[loss_id]
+                    grads = jax.grad(jax.checkpoint(loss_fn))(
+                        list(param_vals))
+                    _rekey()
                     env = forward(feed_vals, list(param_vals), cap_vals)
                 else:
                     def loss_of(pv):
+                        _rekey()
                         env = forward(feed_vals, pv, cap_vals)
                         return env[loss_id], env
                     grads, env = jax.grad(
@@ -605,6 +624,7 @@ class Executor:
         def infer(feed_vals, param_vals, cap_vals, rng):
             prev_key = core.get_trace_key()
             core.set_trace_key(rng)
+            _step_key[0] = rng
             try:
                 env = forward(feed_vals, param_vals, cap_vals)
                 return (tuple(
@@ -612,6 +632,7 @@ class Executor:
                     for i in fetch_ids),
                     tuple(env[v] for v in buf_vids))
             finally:
+                _step_key[0] = None
                 core.set_trace_key(prev_key)
         return jax.jit(infer), buf_updates, cap_ids
 

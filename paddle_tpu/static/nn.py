@@ -14,8 +14,13 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     from ..tensor.manipulation import reshape
     if isinstance(x, (list, tuple)):
         # ref static/nn/common.py::fc — multiple inputs each get their
-        # own weight and the projections SUM before bias/activation
-        outs = [fc(xi, size, num_flatten_dims, weight_attr,
+        # own weight (weight_attr may be a per-input list) and the
+        # projections SUM before bias/activation
+        def _wa(i):
+            if isinstance(weight_attr, (list, tuple)):
+                return weight_attr[i]
+            return weight_attr
+        outs = [fc(xi, size, num_flatten_dims, _wa(i),
                    False if i else bias_attr, None, name)
                 for i, xi in enumerate(x)]
         out = outs[0]
