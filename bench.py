@@ -499,6 +499,103 @@ def _dump_sweep(sweep):
 
 
 # --------------------------------------------------------------------------
+# child: --eager-micro  (eager-loop dispatch/optimizer fast-path microbench)
+# --------------------------------------------------------------------------
+
+def eager_micro():
+    """Measure the jit-cached eager dispatch + fused optimizer step.
+
+    Asserts the tentpole claims instead of trusting them: steady-state
+    steps (N>2) issue ZERO new traces (dispatch cache miss counter flat),
+    the fused optimizer performs exactly 1 compiled call per step
+    regardless of parameter count, and the fast path trains numerically
+    identically (atol 1e-6 fp32) to the per-param eager loop.  Runs on any
+    backend (CPU smoke included) — the win being measured is host
+    dispatch overhead, not FLOPs.
+    """
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import profiler
+    from paddle_tpu.ops import dispatch
+    from paddle_tpu.optimizer import optimizer as opt_mod
+
+    def build(n_layers=6, width=64):
+        paddle.seed(11)
+        layers = []
+        for _ in range(n_layers):
+            layers += [nn.Linear(width, width), nn.Tanh()]
+        layers.append(nn.Linear(width, 8))
+        return nn.Sequential(*layers)
+
+    def run_loop(steps, fused, cache):
+        os.environ["PADDLE_TPU_FUSED_STEP"] = "1" if fused else "0"
+        os.environ["PADDLE_TPU_DISPATCH_CACHE"] = "1" if cache else "0"
+        # compile on the 2nd sighting so steady state is reached by step 3
+        os.environ["PADDLE_TPU_DISPATCH_CACHE_WARMUP"] = "2"
+        try:
+            net = build()
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=net.parameters(), weight_decay=0.01,
+                grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+            x = paddle.to_tensor(np.random.RandomState(0)
+                                 .randn(32, 64).astype(np.float32))
+            dispatch.clear_cache()
+            dispatch.reset_cache_stats()
+            opt_mod.reset_fused_stats()
+            per_step = []
+            t0 = time.perf_counter()
+            for i in range(steps):
+                loss = (net(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                s = dispatch.cache_stats()
+                f = dict(opt_mod._fused_stats)
+                per_step.append((s["misses"], s["hits"], f["compiles"],
+                                 f["calls"]))
+            float(loss.numpy())         # host fetch closes the region
+            dt = time.perf_counter() - t0
+            counters = profiler.fast_path_summary()
+            params = [np.asarray(p.numpy()) for p in net.parameters()]
+            return per_step, dt, params, float(loss.numpy()), counters
+        finally:
+            os.environ.pop("PADDLE_TPU_FUSED_STEP", None)
+            os.environ.pop("PADDLE_TPU_DISPATCH_CACHE", None)
+            os.environ.pop("PADDLE_TPU_DISPATCH_CACHE_WARMUP", None)
+
+    steps = 10
+    hist, dt_fast, params_fast, loss_fast, counters = run_loop(
+        steps, True, True)
+    _, dt_slow, params_slow, loss_slow, _ = run_loop(steps, False, False)
+
+    # steady state: no step after the 2nd may trace anything new
+    new_traces_late = [hist[i][0] - hist[i - 1][0]
+                       for i in range(2, steps)]
+    assert all(n == 0 for n in new_traces_late), (
+        f"steady-state retraces detected: {new_traces_late}")
+    # fused step: 1 compile total, exactly 1 compiled call per step
+    assert hist[-1][2] == 1, f"fused compiles {hist[-1][2]} != 1"
+    calls_per_step = [hist[i][3] - hist[i - 1][3] for i in range(1, steps)]
+    assert all(c == 1 for c in calls_per_step), calls_per_step
+    # numerical parity against the per-param eager loop
+    for a, b in zip(params_fast, params_slow):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    print(json.dumps({
+        "metric": "eager_micro_steps_per_sec",
+        "value": round(steps / dt_fast, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(dt_slow / dt_fast, 3),   # speedup vs uncached
+    }), flush=True)
+    print(f"# eager-micro: fast={steps / dt_fast:.2f} steps/s "
+          f"uncached={steps / dt_slow:.2f} steps/s "
+          f"speedup={dt_slow / dt_fast:.2f}x "
+          f"loss_parity={abs(loss_fast - loss_slow):.2e} "
+          f"counters={counters}", file=sys.stderr)
+
+
+# --------------------------------------------------------------------------
 # parent: orchestrator — never touches the jax backend
 # --------------------------------------------------------------------------
 
@@ -592,6 +689,15 @@ def orchestrate():
             print("# kernel check ok — tools/tpu_kernel_check.json "
                   "refreshed", file=sys.stderr)
 
+    # Phase 2.5: the eager fast-path microbench — cheap, asserts the
+    # dispatch-cache + fused-step contract and emits its own metric line.
+    # A failure here must not cost the flagship numbers.
+    if remaining() > 300:
+        mrc, _ = _spawn("--eager-micro", 180, capture=False)
+        if mrc not in (0,):
+            print(f"# eager microbench failed (rc={mrc}); continuing to "
+                  "the timed run", file=sys.stderr)
+
     # Phase 3: the timed run, with every remaining second as its budget.
     run_budget = max(remaining() - 15, 60)
     rc, _ = _spawn("--run", run_budget, capture=False)
@@ -610,5 +716,7 @@ if __name__ == "__main__":
         probe()
     elif "--run" in sys.argv:
         run()
+    elif "--eager-micro" in sys.argv:
+        eager_micro()
     else:
         sys.exit(orchestrate())

@@ -74,11 +74,13 @@ class GradScaler:
 
     def minimize(self, optimizer, scaled_loss):
         # the documented recipe calls scaled.backward() BEFORE minimize;
-        # detect that by the loss's graph state (a consumed graph has
-        # vjp_fn freed), NOT by grad presence — stale grads from an
-        # uncleared previous step must not suppress this step's backward
+        # detect that by the tape's explicit _backward_ran stamp, NOT by
+        # vjp_fn liveness (retain_graph=True keeps closures alive and
+        # grads would double) and NOT by grad presence (stale grads from
+        # an uncleared previous step must not suppress this backward)
         node = scaled_loss._node
-        if node is not None and node.vjp_fn is not None:
+        if (node is not None and node.vjp_fn is not None
+                and not getattr(scaled_loss, "_backward_ran", False)):
             scaled_loss.backward()
         self.step(optimizer)
         self.update()

@@ -243,6 +243,10 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
             t._accumulate_grad(g)
         else:
             t._finalize_grad(g)
+    # explicit "backward already ran from this root" stamp: minimize()
+    # consults it instead of inferring from vjp_fn liveness, which a
+    # retain_graph=True backward keeps alive (grads would double)
+    tensor._backward_ran = True
     if not retain_graph:
         # break links so the graph is freed and cannot be reused
         for node in order:

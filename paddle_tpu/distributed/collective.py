@@ -98,11 +98,50 @@ def _process_count():
         return 1
 
 
+_kv_seq = [0]
+_KV_TIMEOUT_MS = 60_000
+
+
+def _kv_allgather(value):
+    """Host allgather over the jax.distributed coordination service's
+    key-value store — no XLA collective involved, so it works on backends
+    whose device collectives can't span processes (CPU).  Strictly
+    control-plane: payloads ride the coordinator, so keep them small."""
+    import base64
+    import pickle
+    from jax._src import distributed
+    client = distributed.global_state.client
+    n = jax.process_count()
+    me = jax.process_index()
+    _kv_seq[0] += 1
+    key = f"paddle_tpu_eager_ag_{_kv_seq[0]}"
+    payload = base64.b64encode(
+        pickle.dumps(np.asarray(value))).decode("ascii")
+    client.key_value_set(f"{key}/{me}", payload)
+    client.wait_at_barrier(f"{key}_barrier", _KV_TIMEOUT_MS)
+    rows = [pickle.loads(base64.b64decode(client.blocking_key_value_get(
+        f"{key}/{j}", _KV_TIMEOUT_MS))) for j in range(n)]
+    # everyone has read every row — each process reclaims its own key so
+    # per-step collectives don't grow the coordinator's store unboundedly
+    client.wait_at_barrier(f"{key}_drain", _KV_TIMEOUT_MS)
+    try:
+        client.key_value_delete(f"{key}/{me}")
+    except Exception:                                      # noqa: BLE001
+        pass                       # older client without delete: best effort
+    return np.stack(rows)
+
+
 def _eager_rows(value):
     """Host-level cross-process allgather: every live process contributes
     its local value; returns a [process_count, ...] numpy stack."""
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
+    try:
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(value)))
+    except Exception:                                      # noqa: BLE001
+        # e.g. "Multiprocess computations aren't implemented on the CPU
+        # backend" — gather through the coordination service instead
+        return _kv_allgather(value)
 
 
 def _member_rows(rows, group):
@@ -155,8 +194,15 @@ def barrier(group=None):
     if _process_count() > 1:
         from jax.experimental import multihost_utils
         _barrier_counter[0] += 1
-        multihost_utils.sync_global_devices(
-            f"paddle_tpu_barrier_{_barrier_counter[0]}")
+        name = f"paddle_tpu_barrier_{_barrier_counter[0]}"
+        try:
+            multihost_utils.sync_global_devices(name)
+        except Exception:                                  # noqa: BLE001
+            # CPU backend: no cross-process device collectives — use the
+            # coordination service barrier directly
+            from jax._src import distributed
+            distributed.global_state.client.wait_at_barrier(
+                name, _KV_TIMEOUT_MS)
         return
     jnp.zeros(()).block_until_ready()
 
@@ -313,11 +359,26 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
         if _process_count() > 1:
-            me = jax.process_index()
+            # subset groups map through group ranks exactly like scatter:
+            # slots are GROUP ranks, non-members feed the global gather a
+            # zero payload and adopt nothing
+            subset = (group is not None and group.ranks
+                      and len(group.ranks) < _process_count())
+            if subset:
+                n_slots = len(group.ranks)
+                if group.rank < 0:
+                    sample = np.asarray(in_tensor_list[0].numpy())
+                    _eager_rows(np.zeros((n_slots,) + sample.shape,
+                                         sample.dtype))
+                    return out_tensor_list  # non-member: participate only
+                me = group.rank
+            else:
+                me = jax.process_index()
             local = np.stack([np.asarray(t.numpy())
                               for t in in_tensor_list])
-            rows = _eager_rows(local)          # [nproc, nproc, ...]
-            # process j's slot-`me` entry is my j-th output
+            rows = _eager_rows(local)          # [nproc, n_slots, ...]
+            member, rows = _member_rows(rows, group)
+            # group-member j's slot-`me` entry is my j-th output
             out_tensor_list.extend(Tensor(rows[j, me])
                                    for j in range(rows.shape[0]))
             return out_tensor_list
@@ -388,7 +449,8 @@ def _c_split(tensor, group=None):
 
     def _cs(x):
         idx = jax.lax.axis_index(ax)
-        n = jax.lax.axis_size(ax)
+        from ..framework.jax_compat import axis_size
+        n = axis_size(ax)
         sz = x.shape[-1] // n
         return jax.lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=x.ndim - 1)
     return call(_cs, tensor, _name="c_split")

@@ -181,6 +181,11 @@ def extend_with_decoupled_weight_decay(base_optimizer):
     inner update, ``param -= param * coeff`` (pre-update value, no lr
     scaling — the reference subtracts the scaled pre-optimize snapshot)."""
     class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        # the decay lives in step() (eager pre-decay) AND in
+        # apply_updates_pytree (static-Executor path); the fused eager
+        # step funnels through apply_updates_pytree too, which would
+        # stack BOTH decays — keep this wrapper on the per-param loop
+        _fused_supported = False
         # weight_decay is the first POSITIONAL argument, matching the
         # reference's generated class (everything else reaches the base
         # as keywords — the base must not ALSO apply coupled decay)

@@ -234,7 +234,12 @@ class Generator:
         return self._seed
 
     def split(self):
-        self._key, sub = jax.random.split(self._key)
+        # force eager evaluation even when called during a foreign trace
+        # (the dispatch jit-cache probing a primitive): with omnistaging
+        # the split would otherwise be staged and a TRACER would escape
+        # into host state, corrupting every later draw
+        with jax.ensure_compile_time_eval():
+            self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
@@ -275,8 +280,20 @@ def default_generator() -> Generator:
     return _generator
 
 
+_rng_draws = [0]
+
+
+def rng_draw_count():
+    """Total host-RNG key draws.  The dispatch jit-cache compares this
+    across a trace: a primitive that draws from the host generator inside
+    its closure is IMPURE under caching (the key would bake into the
+    compiled executable) and must stay on the eager path."""
+    return _rng_draws[0]
+
+
 def next_rng_key():
     global _trace_key
+    _rng_draws[0] += 1
     if _trace_key is not None:
         import jax
         _trace_key, sub = jax.random.split(_trace_key)

@@ -1,0 +1,56 @@
+"""Adapters for the moving jax API surface this repo targets.
+
+The codebase is written against the current stable names (``jax.shard_map``
+with ``check_vma``, ``pltpu.CompilerParams``); older jax releases spell
+them ``jax.experimental.shard_map.shard_map`` with ``check_rep`` and
+``pltpu.TPUCompilerParams``.  Import from here instead of pinning either
+spelling.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.6
+except ImportError:                                  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` rename
+    papered over (same meaning: skip per-axis replication checking)."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        v = kwargs.pop("check_vma")
+        if "check_rep" in _PARAMS:
+            kwargs["check_rep"] = v
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` (new) — older jax spells it ``psum(1, axis)``,
+    which constant-folds to a python int inside mapped code."""
+    import jax
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axis_name):
+    """``lax.pcast(..., to="varying")`` where available; older jax has no
+    varying/invariant typing on manual axes, so the cast is a no-op."""
+    import jax
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    return x
+
+
+def tpu_compiler_params(pltpu, **kwargs):
+    """``pltpu.CompilerParams`` (new) / ``pltpu.TPUCompilerParams`` (old)."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

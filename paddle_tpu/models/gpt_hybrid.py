@@ -30,7 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..framework.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .gpt import GPTConfig, init_params, _layer_norm

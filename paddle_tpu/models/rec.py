@@ -23,7 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..framework.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .common import PytreeLayer

@@ -7,8 +7,28 @@ pure jax function — XLA is the kernel library — and differentiation is
 ``jax.vjp`` recorded on the eager tape (see autograd/tape.py).  Under a
 functional trace (jit.to_static / hapi) the tape is bypassed and tracers flow
 straight through, so the whole step compiles to one fused HLO.
+
+Jit-cached eager dispatch (the per-signature executable cache): a fresh
+``jax.vjp`` trace per eager primitive is pure Python overhead on TPU —
+dispatch, not compute, dominates small/medium eager loops (the same
+amortization story as LazyTensor and the reference's per-signature kernel
+cache in imperative/tracer.cc).  ``call`` therefore keys each primitive
+application on its ABSTRACT signature — the function's code object +
+closure constants, the arg treedef, per-leaf avals, the differentiable-leaf
+mask, the amp state and grad mode — and caches one compiled executable
+(forward, or forward+linearized-vjp when recording) per signature in a
+bounded LRU.  A steady-state training loop re-traces nothing.  Anything
+the key cannot soundly describe — unhashable closure cells, tracer
+operands (shard_map bodies), host-RNG draws inside the primitive, debug
+nan-guard mode, static mode — falls back transparently to the uncached
+eager path.  Counters are surfaced through paddle_tpu.profiler.
 """
 from __future__ import annotations
+
+import collections
+import os
+import threading
+import types
 
 import numpy as np
 
@@ -74,19 +94,382 @@ def call(fn, *args, _nondiff=(), _name=None, **kwargs):
     return _call_inner(fn, args, kwargs, _nondiff, _name)
 
 
+# --------------------------------------------------------------------------
+# Signature-keyed executable cache for eager dispatch
+# --------------------------------------------------------------------------
+
+_UNHASHABLE = object()
+_MISS = object()
+
+
+def _const_token(v):
+    """Hashable token describing a STATIC (baked-into-the-executable)
+    value, or _UNHASHABLE when no sound token exists.  Stable-identity
+    objects (functions/modules/types/code) are returned verbatim: the key
+    tuple then holds a strong reference, so their id can never be reused
+    by a different object while the entry lives."""
+    if v is None or v is Ellipsis:
+        return ("v", v)
+    if isinstance(v, (bool, int, float, complex, str, bytes,
+                      np.dtype, np.generic)):
+        return ("v", type(v).__name__, v)
+    if isinstance(v, slice):            # unhashable before py3.12
+        parts = tuple(_const_token(x) for x in (v.start, v.stop, v.step))
+        if any(p is _UNHASHABLE for p in parts):
+            return _UNHASHABLE
+        return ("sl",) + parts
+    if isinstance(v, tuple):
+        toks = tuple(_const_token(x) for x in v)
+        if any(t is _UNHASHABLE for t in toks):
+            return _UNHASHABLE
+        return ("t",) + toks
+    if isinstance(v, (types.FunctionType, types.BuiltinFunctionType,
+                      types.ModuleType, type, types.CodeType)):
+        return v
+    # Tensors/arrays in closures (mutable payload), generic objects
+    # (mutable attrs), lists/dicts: no sound static token — fall back.
+    return _UNHASHABLE
+
+
+# identity-keyable module-level singletons (jnp ufunc objects, PjitFunction
+# wrappers, custom_jvp/vjp-wrapped callables like jax.nn.relu).  On jax
+# versions where jnp.add is a PLAIN python function this must not admit
+# FunctionType — that would bypass the closure screening
+_UFUNC_TYPES = tuple(
+    t for t in (np.ufunc, type(jnp.add), type(jax.jit(lambda: 0)),
+                jax.custom_jvp, jax.custom_vjp)
+    if t is not types.FunctionType)
+
+
+def _fn_token(fn):
+    """Key component identifying the primitive itself: the code object
+    plus every closure cell and default — two lambdas from the same source
+    line with different captured constants get different entries."""
+    if isinstance(fn, (types.BuiltinFunctionType,) + _UFUNC_TYPES):
+        # module-level singletons: identity IS the signature
+        return fn
+    if not isinstance(fn, types.FunctionType):
+        return None
+    toks = [fn.__code__]
+    for cell in fn.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:              # empty cell
+            return None
+        t = _const_token(v)
+        if t is _UNHASHABLE:
+            return None
+        toks.append(t)
+    for d in fn.__defaults__ or ():
+        t = _const_token(d)
+        if t is _UNHASHABLE:
+            return None
+        toks.append(t)
+    return tuple(toks)
+
+
+def _leaf_tokens(leaves, Tensor):
+    """Classify arg leaves: dynamic operands (Tensors, raw arrays, python
+    floats — traced, so value changes never retrace) vs static ones
+    (ints/strings/dtypes — part of the key, so shape-determining values
+    stay concrete).  Returns (dyn_pos, tokens) or (None, None) when a leaf
+    admits no sound key (tracers, unhashable objects)."""
+    dyn_pos, toks = [], []
+    for i, l in enumerate(leaves):
+        if isinstance(l, Tensor):
+            v = l.value
+            if isinstance(v, jax.core.Tracer):
+                return None, None
+            dyn_pos.append(i)
+            toks.append(("T", v.shape, str(v.dtype),
+                         bool(getattr(v, "weak_type", False))))
+        elif isinstance(l, jax.core.Tracer):
+            return None, None
+        elif isinstance(l, jax.Array):
+            dyn_pos.append(i)
+            toks.append(("A", l.shape, str(l.dtype),
+                         bool(getattr(l, "weak_type", False))))
+        elif isinstance(l, np.ndarray):
+            dyn_pos.append(i)
+            toks.append(("N", l.shape, str(l.dtype)))
+        elif isinstance(l, float) and not isinstance(l, bool):
+            dyn_pos.append(i)
+            toks.append(("f",))
+        else:
+            t = _const_token(l)
+            if t is _UNHASHABLE:
+                return None, None
+            toks.append(("s", t))
+    return dyn_pos, tuple(toks)
+
+
+def _amp_token(st):
+    """Value-equal token for the active auto_cast config: repeated
+    ``with auto_cast():`` blocks with the same lists share cache entries."""
+    if st is None:
+        return None
+    tok = getattr(st, "_dispatch_token", None)
+    if tok is None:
+        tok = (bool(st.enable), str(st.dtype),
+               str(getattr(st, "level", "")),
+               frozenset(getattr(st, "white_list", ())),
+               frozenset(getattr(st, "black_list", ())))
+        try:
+            st._dispatch_token = tok
+        except Exception:                                  # noqa: BLE001
+            pass
+    return tok
+
+
+class _Entry:
+    __slots__ = ("compiled", "fn2", "multi")
+
+    def __init__(self, compiled, fn2):
+        self.compiled = compiled
+        self.fn2 = fn2
+        self.multi = False
+
+
+class _DispatchCache:
+    def __init__(self):
+        self.entries = collections.OrderedDict()
+        self.lock = threading.Lock()
+        self.blacklist = set()     # fn tokens proven untraceable/impure
+        self.bad_keys = set()      # signatures whose compile attempt failed
+        self.fail_counts = {}      # fn token -> distinct failing signatures
+        self.seen = {}             # key -> sighting count below warmup
+        self.hits = 0
+        self.misses = 0
+        self.fallbacks = 0
+        self.warming = 0
+        self.evictions = 0
+
+    def maxsize(self):
+        try:
+            return int(os.environ.get(
+                "PADDLE_TPU_DISPATCH_CACHE_SIZE", "512"))
+        except ValueError:
+            return 512
+
+    def warmup(self):
+        """Sightings of a signature before it compiles: one-shot ops
+        (fuzz sweeps, long-tail calls) stay on the plain eager path —
+        compiling costs far more than one uncached dispatch; only a
+        signature seen again (a loop) buys an executable."""
+        try:
+            return int(os.environ.get(
+                "PADDLE_TPU_DISPATCH_CACHE_WARMUP", "3"))
+        except ValueError:
+            return 3
+
+    def lookup(self, key):
+        with self.lock:
+            e = self.entries.get(key)
+            if e is not None:
+                self.entries.move_to_end(key)
+                self.hits += 1
+            return e
+
+    def insert(self, key, entry):
+        with self.lock:
+            self.entries[key] = entry
+            self.entries.move_to_end(key)
+            cap = self.maxsize()
+            while len(self.entries) > cap:
+                self.entries.popitem(last=False)
+                self.evictions += 1
+
+
+_cache = _DispatchCache()
+
+
+def cache_enabled():
+    return os.environ.get("PADDLE_TPU_DISPATCH_CACHE", "1") != "0"
+
+
+def cache_stats():
+    """Hit/miss/retrace counters (a miss IS a retrace: it traces+compiles
+    a new executable).  Surfaced via paddle_tpu.profiler."""
+    with _cache.lock:
+        return {"hits": _cache.hits, "misses": _cache.misses,
+                "fallbacks": _cache.fallbacks,
+                "warming": _cache.warming,
+                "evictions": _cache.evictions,
+                "size": len(_cache.entries),
+                "blacklisted": len(_cache.blacklist)}
+
+
+def reset_cache_stats():
+    with _cache.lock:
+        _cache.hits = _cache.misses = 0
+        _cache.fallbacks = _cache.warming = _cache.evictions = 0
+
+
+def clear_cache(blacklist=False):
+    """Drop cached executables (explicit invalidation — called on
+    static-mode flips; amp changes need no invalidation because the amp
+    config is part of every key)."""
+    with _cache.lock:
+        _cache.entries.clear()
+        _cache.seen.clear()
+        if blacklist:
+            _cache.blacklist.clear()
+            _cache.bad_keys.clear()
+            _cache.fail_counts.clear()
+
+
+def _build_compiled(fn2, treedef, static_vals, dyn_pos, diff_pos, record):
+    dyn_pos_t = tuple(dyn_pos)
+    diff_t = tuple(diff_pos)
+
+    def run(dyn_vals):
+        vals = list(static_vals)
+        for p, v in zip(dyn_pos_t, dyn_vals):
+            vals[p] = v
+        if record:
+            def closure(*dv):
+                v2 = list(vals)
+                for p, v in zip(diff_t, dv):
+                    v2[p] = v
+                a, k = tree_util.tree_unflatten(treedef, v2)
+                return fn2(*a, **k)
+            return jax.vjp(closure, *[vals[p] for p in diff_t])
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        return fn2(*a, **k)
+
+    return jax.jit(run)
+
+
+def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
+                     _name, Tensor):
+    """Try the signature cache.  Returns the wrapped result, or _MISS when
+    the call must take the uncached eager path."""
+    fn_tok = _fn_token(fn)
+    if fn_tok is None or fn_tok in _cache.blacklist:
+        with _cache.lock:
+            _cache.fallbacks += 1
+        return _MISS
+    dyn_pos, leaf_toks = _leaf_tokens(leaves, Tensor)
+    if dyn_pos is None:
+        with _cache.lock:
+            _cache.fallbacks += 1
+        return _MISS
+
+    key = (fn_tok, treedef, leaf_toks, tuple(diff_pos), record, amp_tok,
+           _name)
+    try:
+        if key in _cache.bad_keys:      # known-failing signature
+            with _cache.lock:
+                _cache.fallbacks += 1
+            return _MISS
+        entry = _cache.lookup(key)
+    except TypeError:                   # unhashable despite screening
+        with _cache.lock:
+            _cache.fallbacks += 1
+        return _MISS
+
+    if entry is None:
+        # warm-up gate: don't pay a compile for a signature that may
+        # never recur — only a re-sighted signature gets an executable
+        with _cache.lock:
+            n = _cache.seen.get(key, 0) + 1
+            if n < _cache.warmup():
+                _cache.seen[key] = n
+                if len(_cache.seen) > 8 * _cache.maxsize():
+                    _cache.seen.clear()
+                _cache.warming += 1
+                return _MISS
+            _cache.seen.pop(key, None)
+
+    dyn_vals = [leaves[p].value if isinstance(leaves[p], Tensor)
+                else leaves[p] for p in dyn_pos]
+
+    if entry is None:
+        fn2 = fn
+        if amp_tok is not None:
+            from ..amp.auto_cast import maybe_autocast_fn
+            fn2 = maybe_autocast_fn(fn, _name or getattr(fn, "__name__",
+                                                         "op"))
+        dyn_set = set(dyn_pos)
+        static_vals = [None if i in dyn_set else l
+                       for i, l in enumerate(leaves)]
+        entry = _Entry(_build_compiled(fn2, treedef, static_vals, dyn_pos,
+                                       diff_pos, record), fn2)
+        rng0 = core.rng_draw_count()
+        try:
+            res = entry.compiled(dyn_vals)
+        except Exception:                                  # noqa: BLE001
+            # compile/trace failure.  Remember the failing SIGNATURE so
+            # it is never re-attempted, but only blacklist the whole
+            # primitive after several distinct signatures fail — a
+            # one-off user error or transient runtime failure must not
+            # permanently disable caching for e.g. every jnp.add call
+            with _cache.lock:
+                _cache.bad_keys.add(key)
+                if len(_cache.bad_keys) > 4 * _cache.maxsize():
+                    _cache.bad_keys.clear()
+                n_bad = _cache.fail_counts.get(fn_tok, 0) + 1
+                _cache.fail_counts[fn_tok] = n_bad
+                if n_bad >= 3:
+                    _cache.blacklist.add(fn_tok)
+                _cache.fallbacks += 1
+            return _MISS
+        if core.rng_draw_count() != rng0:
+            # the primitive drew from the HOST generator while tracing —
+            # the key is baked into this executable, so reusing it would
+            # repeat the random draw.  This one result is correct (the
+            # draw happened now, exactly once); never cache the fn again.
+            _cache.blacklist.add(fn_tok)
+        else:
+            out_probe = res[0] if record else res
+            entry.multi = isinstance(out_probe, (tuple, list))
+            _cache.insert(key, entry)
+            with _cache.lock:
+                _cache.misses += 1
+        multi = isinstance((res[0] if record else res), (tuple, list))
+    else:
+        res = entry.compiled(dyn_vals)
+        multi = entry.multi
+
+    if not record:
+        out = res
+        wrapped = (tuple(_wrap(o) for o in out) if multi
+                   else (_wrap(out),))
+        return wrapped if multi else wrapped[0]
+
+    out_vals, vjp_fn = res
+    outs = tuple(out_vals) if multi else (out_vals,)
+    diff_tensors = [leaves[i] for i in diff_pos]
+    node = Node(
+        vjp_fn=vjp_fn,
+        parents=diff_tensors,
+        n_outputs=len(outs),
+        out_shapes=[o.shape for o in outs],
+        out_dtypes=[o.dtype for o in outs],
+        name=_name or getattr(fn, "__name__", "op"),
+    )
+    # double-grad replay closure (concrete values; pure python, no trace)
+    base_vals = [l.value if isinstance(l, Tensor) else l for l in leaves]
+    fn2 = entry.fn2
+    diff_t = tuple(diff_pos)
+
+    def fwd_closure(*dv):
+        vals = list(base_vals)
+        for p, v in zip(diff_t, dv):
+            vals[p] = v
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        return fn2(*a, **k)
+
+    node.fwd_closure = fwd_closure
+    wrapped = tuple(
+        _wrap(o, stop_gradient=not jnp.issubdtype(o.dtype, jnp.inexact),
+              node=node, index=i)
+        for i, o in enumerate(outs))
+    return wrapped if multi else wrapped[0]
+
+
 def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
     from ..tensor import Tensor
-
-    if core._state.amp_state is not None:
-        from ..amp.auto_cast import maybe_autocast_fn
-        nm = _name or getattr(fn, "__name__", "op")
-        wrapped = maybe_autocast_fn(fn, nm)
-        tv = getattr(fn, "__test_variant__", None)
-        if tv is not None and wrapped is not fn:
-            # clone(for_test) swaps recorded fns: the variant rides (and
-            # gets the same amp treatment)
-            wrapped.__test_variant__ = maybe_autocast_fn(tv, nm)
-        fn = wrapped
 
     leaves, treedef = tree_util.tree_flatten(
         (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
@@ -96,6 +479,7 @@ def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
               and not _static_mode()
               and any(not leaves[i].stop_gradient for i in tensor_pos))
 
+    diff_pos = []
     if record:
         # leaf positions excluded by _nondiff (declared per POSITIONAL
         # arg): args flatten ahead of kwargs, so per-arg leaf spans are
@@ -116,6 +500,28 @@ def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
                     if not leaves[i].stop_gradient
                     and jnp.issubdtype(leaves[i].dtype, jnp.inexact)
                     and i not in nd_leaves]
+        record = bool(diff_pos)
+
+    if (cache_enabled() and tensor_pos and not core.in_tracing()
+            and not _static_mode() and not _nan_guard_on()):
+        amp_tok = _amp_token(core._state.amp_state)
+        out = _cached_dispatch(fn, leaves, treedef, diff_pos, record,
+                               amp_tok, _name, Tensor)
+        if out is not _MISS:
+            return out
+
+    # ------------------------------------------------- uncached eager path
+    if core._state.amp_state is not None:
+        from ..amp.auto_cast import maybe_autocast_fn
+        nm = _name or getattr(fn, "__name__", "op")
+        wrapped = maybe_autocast_fn(fn, nm)
+        tv = getattr(fn, "__test_variant__", None)
+        if tv is not None and wrapped is not fn:
+            # clone(for_test) swaps recorded fns: the variant rides (and
+            # gets the same amp treatment)
+            wrapped.__test_variant__ = maybe_autocast_fn(tv, nm)
+        fn = wrapped
+
     if not record or not diff_pos:
         vals = [l.value if isinstance(l, Tensor) else l for l in leaves]
         a, k = tree_util.tree_unflatten(treedef, vals)

@@ -19,10 +19,11 @@ from .utils import HAS_PALLAS, on_tpu, pallas_enabled
 if HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ...framework.jax_compat import tpu_compiler_params as _compiler_params
     # batch / head / stationary-block axes are embarrassingly parallel; only
     # the innermost (streamed) axis carries the online-softmax / accumulator
     # recurrence.  Telling Mosaic so unlocks grid reordering + pipelining.
-    _COMPILER_PARAMS = pltpu.CompilerParams(
+    _COMPILER_PARAMS = _compiler_params(pltpu, 
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 NEG_INF = -1e30

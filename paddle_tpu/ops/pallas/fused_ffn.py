@@ -26,6 +26,7 @@ from .utils import (HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu,
 if _HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ...framework.jax_compat import tpu_compiler_params as _compiler_params
 
 
 def _ref_ffn(x, w1, b1, w2, b2):
@@ -75,7 +76,7 @@ def _fused_ffn_tpu(x2d, w1, b1, w2, b2, block_m, block_f, interpret):
         out_shape=jax.ShapeDtypeStruct((M, H), x2d.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, H), jnp.float32)],
         # row blocks are independent; only the f (accumulator) axis carries
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu, 
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x2d, w1, b1.reshape(1, F), w2, b2.reshape(1, H))

@@ -26,6 +26,7 @@ from .utils import (HAS_PALLAS as _HAS_PALLAS, on_tpu as _on_tpu,
 if _HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ...framework.jax_compat import tpu_compiler_params as _compiler_params
 
 
 def _ref_layer_norm(x, g, b, eps):
@@ -88,7 +89,7 @@ def _pallas_norm(kernel, out_dtype, x2d, *scale_args, interpret):
         out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, H), out_dtype),
         # every row block is independent — let Mosaic pipeline them
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu, 
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x2d, *scale_args)

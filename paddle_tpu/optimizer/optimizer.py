@@ -5,21 +5,76 @@ Each concrete optimizer defines a PURE update rule
 ``step()`` applies it per-parameter; the jitted train-step path (hapi/jit)
 reuses the same rule inside one compiled function so the whole update fuses
 into the step's HLO — the reference instead launches one CUDA kernel per op.
+
+Fused eager step: the classic eager ``step()`` loop issues O(num_params)
+tiny XLA dispatches — on TPU that host overhead, not compute, dominates.
+``_apply_gradients`` therefore stacks all (param, grad, accumulator)
+triples into one pytree and applies ``_update_with_param`` for every
+parameter under a SINGLE ``jax.jit`` call with params and moments donated
+(buffers update in place on device) — one XLA dispatch per step regardless
+of parameter count.  The compiled executable is cached per abstract
+signature (param/grad/moment avals + hyperparameters + per-param lr/decay
+metadata); anything the signature can't soundly describe falls back to the
+per-parameter eager loop.  Knobs: ``PADDLE_TPU_FUSED_STEP=0`` disables,
+``PADDLE_TPU_FUSED_DONATE=0/1/auto`` controls donation (auto: off on CPU,
+where XLA ignores donation anyway).
 """
 from __future__ import annotations
 
 import collections
+import os
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..framework import core
 from ..tensor.tensor import Tensor, Parameter
 from .lr import LRScheduler
 
+# fused-step counters, surfaced through paddle_tpu.profiler
+_fused_stats = {"calls": 0, "compiles": 0, "eager_steps": 0}
+
+
+def reset_fused_stats():
+    _fused_stats.update(calls=0, compiles=0, eager_steps=0)
+
+
+def _donation_enabled():
+    mode = os.environ.get("PADDLE_TPU_FUSED_DONATE", "auto")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:                                      # noqa: BLE001
+        return False
+
+
+class _UnhashableSignature(Exception):
+    """Fused-step signature had an unhashable component (possibly
+    transient metadata) — retry next step instead of permanently
+    disabling the fused path."""
+
+
+
+
+def _meta_token(v):
+    """Hashable token for optimizer/param metadata that the fused trace
+    bakes in.  Objects (regularizers, callables) are returned verbatim —
+    identity-keyed, and the cache key then pins them alive so ids cannot
+    be reused."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return v      # identity-hashed object; key tuple keeps the reference
+
 
 class Optimizer:
     _accum_names: tuple = ()
+    # optimizers whose update rule cannot be soundly compiled once and
+    # replayed (e.g. param-identity-dependent RNG) opt out
+    _fused_supported = True
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -30,6 +85,8 @@ class Optimizer:
         self._accumulators = collections.defaultdict(dict)  # name -> {pid: arr}
         self._step_count = 0
         self._param_groups = None
+        self._fused_cache = collections.OrderedDict()  # signature -> jitted
+        self._fused_mutating = False
         self._param_wd = {}       # id(p) -> per-group weight_decay override
         if (self._parameters and isinstance(self._parameters[0], dict)):
             self._param_groups = self._parameters
@@ -126,7 +183,129 @@ class Optimizer:
             params_grads.append((p, p._grad))
         self._apply_gradients(params_grads)
 
+    # ------------------------------------------------------- fused step
+    def _fused_enabled(self):
+        if not getattr(self, "_fused_supported", True):
+            return False
+        return os.environ.get("PADDLE_TPU_FUSED_STEP", "1") != "0"
+
+    def _fused_hyper_token(self):
+        """Hashable snapshot of every hyperparameter the compiled step
+        bakes in.  Scalars by value; callables/objects (grad clip,
+        schedulers, decay-exclusion fns) by identity — the cache key pins
+        them alive, so id reuse cannot alias.  A live-updated Tensor beta
+        (warmup schedules) changes the value snapshot and correctly forces
+        a retrace."""
+        toks = []
+        for k in sorted(self.__dict__):
+            if k in ("_step_count", "_lr", "_parameters", "_accumulators",
+                     "_param_groups", "_param_wd", "_fused_cache",
+                     "_fused_mutating"):
+                continue
+            v = self.__dict__[k]
+            if v is None or isinstance(v, (bool, int, float, str)):
+                toks.append((k, v))
+            elif callable(v) or isinstance(v, (tuple, frozenset)):
+                toks.append((k, _meta_token(v)))
+        return tuple(toks)
+
+    def _fused_signature(self, params, grads, states):
+        per = []
+        for p, g, st in zip(params, grads, states):
+            if isinstance(p, Parameter):
+                lr_mult = p.optimize_attr.get("learning_rate", 1.0)
+                reg = _meta_token(p.regularizer)
+                need_clip = bool(getattr(p, "need_clip", True))
+            else:
+                lr_mult, reg, need_clip = 1.0, None, True
+            per.append((
+                id(p), tuple(p.value.shape), str(p.value.dtype),
+                tuple(g.shape), str(g.dtype), lr_mult, reg, need_clip,
+                _meta_token(self._param_wd.get(id(p))),
+                tuple(sorted((nm, str(a.dtype), tuple(a.shape))
+                             for nm, a in st.items())),
+            ))
+        return (type(self), self._fused_hyper_token(),
+                _meta_token(self._weight_decay),
+                getattr(self, "_accumulator_placement", None) is not None,
+                tuple(per))
+
+    def _apply_gradients_fused(self, params_grads):
+        pairs = [(p, (g.value if isinstance(g, Tensor) else g))
+                 for p, g in params_grads if g is not None]
+        if not pairs:
+            self._step_count += 1
+            return
+        params = [p for p, _ in pairs]
+        grads = [g for _, g in pairs]
+        states = [self._state_for(p) for p in params]
+        lr = self.get_lr()
+        t = self._step_count + 1
+
+        key = self._fused_signature(params, grads, states)
+        try:
+            compiled = self._fused_cache.get(key)
+        except TypeError as e:
+            raise _UnhashableSignature(str(e)) from e
+        if compiled is None:
+            def fused(param_vals, gs, sts, lr_, t_):
+                return self.apply_updates_pytree(param_vals, gs, sts, lr_,
+                                                 t_, params=params)
+            donate = (0, 2) if _donation_enabled() else ()
+            compiled = jax.jit(fused, donate_argnums=donate)
+            self._fused_cache[key] = compiled
+            while len(self._fused_cache) > 8:
+                self._fused_cache.popitem(last=False)
+            _fused_stats["compiles"] += 1
+        else:
+            self._fused_cache.move_to_end(key)
+
+        new_ps, new_ss = compiled([p.value for p in params], grads, states,
+                                  lr, t)
+        # Mutations only after the compiled call succeeded: a trace
+        # failure leaves the optimizer untouched for the eager fallback.
+        # Conversely, once mutation starts, a failure must PROPAGATE
+        # (flagged via _fused_mutating) — falling back to the eager loop
+        # here would re-apply the same grads on top of half-updated
+        # state, a silent double step.
+        self._fused_mutating = True
+        self._step_count = t
+        _fused_stats["calls"] += 1
+        place = getattr(self, "_accumulator_placement", None)
+        for p, nv, ns in zip(params, new_ps, new_ss):
+            p.value = nv
+            for nm, sv in ns.items():
+                if place is not None:
+                    # ZeRO: keep moments dp-sharded across steps,
+                    # exactly like the eager loop does
+                    sv = place(p, sv)
+                self._accumulators[nm][id(p)] = sv
+        self._fused_mutating = False
+
     def _apply_gradients(self, params_grads):
+        if self._fused_enabled():
+            try:
+                return self._apply_gradients_fused(params_grads)
+            except _UnhashableSignature:
+                # possibly transient metadata — retry next step.  NOT a
+                # bare TypeError: jax's ConcretizationTypeError and
+                # TracerBoolConversionError subclass TypeError, and those
+                # must reach the permanent-fallback branch below
+                pass
+            except Exception:                              # noqa: BLE001
+                if getattr(self, "_fused_mutating", False):
+                    # state already mutated: never re-apply (double step)
+                    self._fused_mutating = False
+                    raise
+                # untraceable update rule (host sync, value-dependent
+                # control flow): permanently fall back for this instance
+                self._fused_supported = False
+            _fused_stats["eager_steps"] += 1
+            return self._apply_gradients_eager(params_grads)
+        _fused_stats["eager_steps"] += 1
+        return self._apply_gradients_eager(params_grads)
+
+    def _apply_gradients_eager(self, params_grads):
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr_global = self.get_lr()
@@ -163,11 +342,13 @@ class Optimizer:
             return None, None
         # classic recipe: loss.backward() THEN minimize(loss) — the
         # reference dygraph minimize HARVESTS existing grads and never
-        # re-runs backward.  Detect a prior backward by the loss's graph
-        # state (consumed graphs free their vjp closures); grad presence
-        # would let a stale uncleared step suppress this one's backward
+        # re-runs backward.  The tape stamps _backward_ran on the root:
+        # testing that (not vjp_fn liveness — retain_graph=True keeps the
+        # closures alive after a backward) prevents double-running; grad
+        # presence would let a stale uncleared step suppress this one's
         node = getattr(loss, "_node", None)
-        if node is not None and node.vjp_fn is not None:
+        if (node is not None and node.vjp_fn is not None
+                and not getattr(loss, "_backward_ran", False)):
             loss.backward()
         self.step()
         self.clear_grad()
@@ -189,7 +370,10 @@ class Optimizer:
         for nm, d in self._accumulators.items():
             for pid, arr in d.items():
                 pname = name_of.get(pid, str(pid))
-                sd[f"{pname}_{nm}"] = Tensor(arr)
+                # snapshot, don't alias: the fused step DONATES moment
+                # buffers (on TPU), so a live-array reference taken here
+                # would be deleted by the next step() before a save
+                sd[f"{pname}_{nm}"] = Tensor(jnp.array(arr))
         sd["@step"] = self._step_count
         sd["@param_names"] = [p.name for p in self._parameters]
         if isinstance(self._lr, LRScheduler):

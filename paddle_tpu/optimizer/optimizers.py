@@ -9,6 +9,14 @@ import jax.numpy as jnp
 from .optimizer import Optimizer
 
 
+def _bias_correction(beta, t):
+    """``1 - beta**t`` computed in f32 ON DEVICE for both the eager loop
+    (python-int t) and compiled steps (traced t): identical arithmetic is
+    what makes fused-vs-eager parity bitwise-tight instead of drifting a
+    ulp per step through the nonlinearity."""
+    return 1.0 - jnp.power(jnp.float32(beta), jnp.asarray(t, jnp.float32))
+
+
 class SGD(Optimizer):
     _accum_names = ()
 
@@ -101,13 +109,20 @@ class Adam(Optimizer):
         b = self._beta2_src
         return float(b.numpy()) if hasattr(b, "numpy") else b
 
+    def _fused_hyper_token(self):
+        # Tensor betas are LIVE (warmup schedules mutate them in place):
+        # bake the CURRENT values into the fused-step signature so an
+        # in-place update forces a retrace instead of replaying stale
+        # constants
+        return super()._fused_hyper_token() + (self._beta1, self._beta2)
+
     def _update(self, p, g, state, lr, t=1):
         gf = g.astype(jnp.float32)
         pf = p.astype(jnp.float32)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * gf
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * gf * gf
-        mhat = m / (1 - self._beta1 ** t)
-        vhat = v / (1 - self._beta2 ** t)
+        mhat = m / _bias_correction(self._beta1, t)
+        vhat = v / _bias_correction(self._beta2, t)
         new_p = pf - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
 
@@ -179,7 +194,8 @@ class Adamax(Optimizer):
         g = g.astype(p.dtype)
         m = self._beta1 * state["moment"] + (1 - self._beta1) * g
         u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
-        new_p = p - (lr / (1 - self._beta1 ** t)) * m / (u + self._epsilon)
+        new_p = p - (lr / _bias_correction(self._beta1, t)) * m \
+            / (u + self._epsilon)
         return new_p, {"moment": m, "inf_norm": u}
 
 
@@ -247,8 +263,8 @@ class Lamb(Optimizer):
         pf = p.astype(jnp.float32)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * gf
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * gf * gf
-        mhat = m / (1 - self._beta1 ** t)
-        vhat = v / (1 - self._beta2 ** t)
+        mhat = m / _bias_correction(self._beta1, t)
+        vhat = v / _bias_correction(self._beta2, t)
         r = mhat / (jnp.sqrt(vhat) + self._epsilon)
         update = r + self._lamb_wd * pf
         w_norm = jnp.linalg.norm(pf)
@@ -310,6 +326,10 @@ class Dpsgd(Optimizer):
     """ref fluid/optimizer.py::DpsgdOptimizer — differentially private SGD:
     per-update clipping + gaussian noise (Abadi et al. 2016)."""
     _accum_names = ()
+    # the per-parameter noise stream is keyed on the param OBJECT identity
+    # (id(p) inside _update): compiling once and replaying would freeze
+    # the fold — keep DP-SGD on the per-parameter eager path
+    _fused_supported = False
 
     def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
                  sigma=1.0, parameters=None, weight_decay=None,

@@ -47,9 +47,10 @@ def localsgd_param_sync(params, step, k_steps, begin_step=1,
     def avg(ps):
         # pmean yields an axis-invariant value; pcast back to 'varying'
         # so both cond branches carry the same shard_map type
+        from ..framework.jax_compat import pcast_varying
         return jax.tree_util.tree_map(
-            lambda x: lax.pcast(lax.pmean(x, axis_name), axis_name,
-                                to="varying"), ps)
+            lambda x: pcast_varying(lax.pmean(x, axis_name), axis_name),
+            ps)
 
     return lax.cond(do, avg, lambda ps: ps, params)
 

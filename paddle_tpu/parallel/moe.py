@@ -23,6 +23,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..framework.jax_compat import axis_size as _axis_size
+
 
 def init_moe_params(key, n_experts, hidden, ffn, dtype=jnp.float32):
     """Gate + stacked expert FFN weights ([E, ...] leading expert axis —
@@ -48,7 +50,7 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=1.25,
     [E_local, ...] (expert axis sharded over ``axis_name``).
 
     Returns (out [T, H], aux_loss scalar)."""
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     T, H = x.shape
     e_local = params["w1"].shape[0]
     E = n_experts or e_local * size

@@ -12,8 +12,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..framework.jax_compat import axis_size as _axis_size
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..framework.jax_compat import shard_map
 
 
 def pipeline_forward(stage_fn, x_global, n_microbatch, axis_name="pp"):
@@ -25,7 +27,7 @@ def pipeline_forward(stage_fn, x_global, n_microbatch, axis_name="pp"):
     Returns final-stage output broadcast to all stages ([B, ...]).
     """
     idx = jax.lax.axis_index(axis_name)
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     B = x_global.shape[0]
     if B % n_microbatch:
         raise ValueError(

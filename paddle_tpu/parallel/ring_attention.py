@@ -19,8 +19,10 @@ import math
 
 import jax
 import jax.numpy as jnp
+
+from ..framework.jax_compat import axis_size as _axis_size
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..framework.jax_compat import shard_map
 
 NEG_INF = -1e30
 
@@ -51,7 +53,7 @@ def _ring_fwd_impl(q, k, v, axis_name, causal):
     scale = 1.0 / math.sqrt(q.shape[-1])
     n_local = q.shape[2]
     idx = jax.lax.axis_index(axis_name)
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     q_off = idx * n_local
 
     o, m, l = _block_attn(q, k, v, scale, causal, q_off, idx * n_local)
@@ -99,7 +101,7 @@ def _ring_bwd(axis_name, causal, res, g):
     scale = 1.0 / math.sqrt(q.shape[-1])
     n_local = q.shape[2]
     idx = jax.lax.axis_index(axis_name)
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     q_off = idx * n_local
 
     gf = g.astype(jnp.float32)

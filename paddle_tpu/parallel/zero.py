@@ -28,7 +28,7 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..framework.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..optimizer.functional import adamw_update

@@ -179,3 +179,44 @@ class Profiler:
 def trace(log_dir):
     """Device-level trace context via jax.profiler (xprof format)."""
     return jax.profiler.trace(log_dir)
+
+
+# --------------------------------------------------------------------------
+# Eager fast-path counters (dispatch jit-cache + fused optimizer step)
+# --------------------------------------------------------------------------
+
+def dispatch_cache_stats():
+    """Hit/miss/retrace counters of the eager dispatch executable cache
+    (ops.dispatch).  A miss IS a retrace — it traces and compiles a new
+    executable; steady-state training loops should show misses flat."""
+    from .ops import dispatch
+    return dispatch.cache_stats()
+
+
+def reset_dispatch_cache_stats():
+    from .ops import dispatch
+    dispatch.reset_cache_stats()
+
+
+def fused_step_stats():
+    """Counters of the fused optimizer step: ``calls`` is one per
+    optimizer.step() on the fused path (one XLA dispatch each),
+    ``compiles`` counts signature retraces."""
+    from .optimizer import optimizer as _opt
+    return dict(_opt._fused_stats)
+
+
+def reset_fused_step_stats():
+    from .optimizer import optimizer as _opt
+    _opt.reset_fused_stats()
+
+
+def fast_path_summary():
+    """One dict with both fast-path counter families — what the bench.py
+    eager microbench asserts on."""
+    out = {"dispatch_cache": dispatch_cache_stats()}
+    try:
+        out["fused_step"] = fused_step_stats()
+    except Exception:                                      # noqa: BLE001
+        out["fused_step"] = {}
+    return out

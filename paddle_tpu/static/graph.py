@@ -51,7 +51,13 @@ def in_static_mode():
 
 
 def _set_static_mode(flag):
-    _static_mode[0] = bool(flag)
+    flag = bool(flag)
+    if flag != _static_mode[0]:
+        # eager executables are useless under a program build (and vice
+        # versa): drop the dispatch jit-cache on every mode flip
+        from ..ops import dispatch
+        dispatch.clear_cache()
+    _static_mode[0] = flag
 
 
 class OpRecord:

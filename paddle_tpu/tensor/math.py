@@ -127,8 +127,8 @@ def multiplex(inputs, index, name=None):
     def _mpx(ins, idx):
         stacked = jnp.stack(ins, axis=0)            # [n, batch, ...]
         idx = idx.reshape(-1)
-        return jnp.take_along_axis(
-            stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+        sel = idx[(None, slice(None)) + (None,) * (stacked.ndim - 2)]
+        return jnp.take_along_axis(stacked, sel, axis=0)[0]
     return call(_mpx, list(inputs), index, _name="multiplex")
 
 

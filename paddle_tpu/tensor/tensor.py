@@ -60,7 +60,7 @@ def _to_jax_value(data, dtype=None, place=None):
 class Tensor:
     __slots__ = ("value", "stop_gradient", "_node", "_node_index", "_grad",
                  "name", "persistable", "_grad_hooks", "_weakref_slot",
-                 "_declared_shape", "__weakref__")
+                 "_declared_shape", "_backward_ran", "__weakref__")
 
     _next_id = [0]
 

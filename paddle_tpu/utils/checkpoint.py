@@ -19,6 +19,7 @@ from ..io.serialization import load as _load, save as _save
 from ..framework import core
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+_SEQ_FILE = "save_seq"    # monotonic publish-order counter (one int)
 
 
 class CheckpointManager:
@@ -44,21 +45,36 @@ class CheckpointManager:
                 out.append((int(m.group(1)), os.path.join(self.root, name)))
         return sorted(out)
 
-    def _dirs_by_save_time(self):
-        """Step dirs ordered by when they were SAVED (publish mtime), not
-        by step number: after an operator rewinds to an earlier step and
-        trains on, the new lower-numbered checkpoints are the live run —
-        numeric ordering would reap them and auto-resume from the stale
-        high-numbered leftovers of the abandoned run."""
-        def mtime(sp):
-            try:
-                return os.path.getmtime(sp[1])
-            except OSError:
-                return 0.0
-        return sorted(self._step_dirs(), key=mtime)
+    def _read_seq(self, path):
+        try:
+            with open(os.path.join(path, _SEQ_FILE)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _next_seq(self):
+        seqs = [s for s in (self._read_seq(p)
+                            for _, p in self._step_dirs())
+                if s is not None]
+        return (max(seqs) + 1) if seqs else 1
+
+    def _dirs_by_save_order(self):
+        """Step dirs ordered by when they were SAVED — an explicit
+        monotonic sequence number written at publish time — not by step
+        number: after an operator rewinds to an earlier step and trains
+        on, the new lower-numbered checkpoints are the live run — numeric
+        ordering would reap them and auto-resume from the stale
+        high-numbered leftovers of the abandoned run.  (Not mtime either:
+        cp without -p, git checkout and object-store syncs all rewrite
+        mtimes, after which that ordering is arbitrary.)  Dirs from
+        before the sequence file existed sort OLDEST, by step number."""
+        def key(sp):
+            seq = self._read_seq(sp[1])
+            return (0, sp[0]) if seq is None else (1, seq)
+        return sorted(self._step_dirs(), key=key)
 
     def latest_step(self):
-        dirs = self._dirs_by_save_time()
+        dirs = self._dirs_by_save_order()
         return dirs[-1][0] if dirs else None
 
     # ------------------------------------------------------------ save
@@ -69,7 +85,10 @@ class CheckpointManager:
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        state = {"step": int(step),
+        seq = self._next_seq()
+        with open(os.path.join(tmp, _SEQ_FILE), "w") as f:
+            f.write(str(seq))
+        state = {"step": int(step), "seq": seq,
                  "rng_state": core.default_generator().get_state()}
         if extra is not None:
             state["extra"] = extra
@@ -87,7 +106,7 @@ class CheckpointManager:
         return final
 
     def _retain(self):
-        dirs = self._dirs_by_save_time()
+        dirs = self._dirs_by_save_order()
         for _, path in dirs[:-self.keep] if self.keep else []:
             shutil.rmtree(path, ignore_errors=True)
 

@@ -14,6 +14,10 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight model-level tests (full pretrain steps, "
+        "pallas interpret mode) excluded from the tier-1 budget")
     if os.environ.get("PADDLE_TPU_TEST_MODE") == "1":
         return
     cap = config.pluginmanager.getplugin("capturemanager")

@@ -12,6 +12,10 @@ import paddle_tpu as paddle
 from paddle_tpu.parallel.mesh import create_mesh
 from paddle_tpu.models import bert
 
+# model-level heavyweight suite: full train steps on the CPU mesh —
+# runs in the slow tier, outside the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def setup():

@@ -7,6 +7,7 @@ restore, retention, atomicity; guard raises at the first non-finite op with
 the op name, and the jit-side check passes finite trees through.
 """
 import os
+import time
 import tempfile
 
 import numpy as np
@@ -131,3 +132,42 @@ def test_nan_guard_skips_traced_ops():
     with check_nan_inf_guard():
         out = snet(x)
     assert tuple(out.shape) == (2, 3)
+
+
+def test_checkpoint_order_survives_mtime_loss():
+    """Retention/latest must follow the explicit save-sequence number, not
+    filesystem mtime (cp/git/object-store transports rewrite mtimes): an
+    operator who rewinds to an earlier step and trains on must have the
+    NEW low-numbered checkpoints treated as the live run."""
+    net = paddle.nn.Linear(2, 2)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, keep=2)
+        mgr.save(10, model=net)
+        mgr.save(20, model=net)
+        # rewind: step 5 saved AFTER step 20 is the live run
+        mgr.save(5, model=net)
+        assert mgr.latest_step() == 5
+        kept = sorted(os.listdir(d))
+        assert "step_5" in kept and "step_10" not in kept
+        # scramble mtimes the way a cp -r without -p would
+        now = time.time()
+        for name in os.listdir(d):
+            os.utime(os.path.join(d, name), (now, now))
+        mgr2 = CheckpointManager(d, keep=2)
+        assert mgr2.latest_step() == 5
+        assert mgr2.restore(model=net) == 5
+
+
+def test_checkpoint_seq_falls_back_to_step_number():
+    """Dirs from before the sequence file existed order by step number
+    and sort OLDER than any seq-stamped dir."""
+    net = paddle.nn.Linear(2, 2)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, keep=10)
+        mgr.save(3, model=net)
+        mgr.save(7, model=net)
+        for s in (3, 7):   # simulate legacy checkpoints: no seq file
+            os.remove(os.path.join(d, f"step_{s}", "save_seq"))
+        assert mgr.latest_step() == 7
+        mgr.save(1, model=net)          # new-format save wins
+        assert mgr.latest_step() == 1

@@ -1,0 +1,285 @@
+"""The eager fast paths: jit-cached dispatch + fused optimizer step.
+
+Covers the tentpole contract: steady-state eager loops re-trace nothing
+(cache hit/miss behavior across shape/dtype/amp changes), the fused
+optimizer step is numerically identical to the per-param eager loop
+(incl. grad clip + weight decay), double-grad works through cached
+primitives, and impure primitives (host RNG) transparently fall back.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import dispatch
+from paddle_tpu.optimizer import optimizer as opt_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dispatch.clear_cache()
+    dispatch.reset_cache_stats()
+    opt_mod.reset_fused_stats()
+    # compile on first sighting so the keying tests can count misses
+    # deterministically; the warm-up default is covered by its own test
+    os.environ["PADDLE_TPU_DISPATCH_CACHE_WARMUP"] = "1"
+    yield
+    for k in ("PADDLE_TPU_FUSED_STEP", "PADDLE_TPU_DISPATCH_CACHE",
+              "PADDLE_TPU_DISPATCH_CACHE_SIZE",
+              "PADDLE_TPU_DISPATCH_CACHE_WARMUP"):
+        os.environ.pop(k, None)
+
+
+def _t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=sg)
+
+
+# ------------------------------------------------------------ hit/miss keying
+
+def test_steady_state_loop_stops_tracing():
+    x = _t(np.random.randn(8, 8).astype("float32"), sg=False)
+    w = _t(np.random.randn(8, 8).astype("float32"), sg=False)
+    for i in range(6):
+        y = (x.matmul(w) + 1.0).sum()
+        y.backward()
+        if i == 1:
+            warm = dispatch.cache_stats()["misses"]
+    s = dispatch.cache_stats()
+    assert s["misses"] == warm, "steady-state steps retraced"
+    assert s["hits"] > 0 and s["fallbacks"] == 0
+
+
+def test_shape_and_dtype_changes_each_get_one_entry():
+    a32 = _t(np.ones((4, 4), "float32"))
+    b32 = _t(np.ones((4, 4), "float32"))
+    (a32 + b32)
+    m0 = dispatch.cache_stats()["misses"]
+    (a32 + b32)
+    assert dispatch.cache_stats()["misses"] == m0          # hit
+    c = _t(np.ones((2, 8), "float32"))
+    (c + c)                                                # shape -> miss
+    assert dispatch.cache_stats()["misses"] == m0 + 1
+    d = _t(np.ones((4, 4), "int32"))
+    (d + d)                                                # dtype -> miss
+    assert dispatch.cache_stats()["misses"] == m0 + 2
+    (c + c); (d + d)                                       # both warm now
+    assert dispatch.cache_stats()["misses"] == m0 + 2
+
+
+def test_amp_state_is_part_of_the_key():
+    x = _t(np.ones((4, 4), "float32"))
+    w = _t(np.ones((4, 4), "float32"))
+    x.matmul(w)
+    m0 = dispatch.cache_stats()["misses"]
+    with paddle.amp.auto_cast():
+        out = x.matmul(w)
+        assert str(out.dtype) == "bfloat16"
+        assert dispatch.cache_stats()["misses"] == m0 + 1  # new amp entry
+        x.matmul(w)
+        assert dispatch.cache_stats()["misses"] == m0 + 1  # amp-keyed hit
+    out2 = x.matmul(w)                                     # back outside
+    assert str(out2.dtype) == "float32"
+    assert dispatch.cache_stats()["misses"] == m0 + 1
+
+
+def test_scalar_float_operand_changes_do_not_retrace():
+    x = _t(np.ones((4,), "float32"))
+    for s in (0.5, 1.5, 2.5):
+        out = x * s
+    np.testing.assert_allclose(out.numpy(), 2.5 * np.ones(4), rtol=1e-6)
+    assert dispatch.cache_stats()["misses"] == 1
+
+
+def test_grad_mode_gets_its_own_entry_and_grads_match_uncached():
+    x = _t(np.array([1.0, 2.0, 3.0], "float32"), sg=False)
+    y = (x * x).sum()
+    y.backward()
+    g_cached = np.array(x.grad.numpy())
+    x.clear_grad()
+    os.environ["PADDLE_TPU_DISPATCH_CACHE"] = "0"
+    y2 = (x * x).sum()
+    y2.backward()
+    np.testing.assert_allclose(g_cached, x.grad.numpy(), rtol=1e-6)
+
+
+def test_warmup_gates_one_shot_signatures():
+    os.environ["PADDLE_TPU_DISPATCH_CACHE_WARMUP"] = "2"
+    x = _t(np.ones((5,), "float32"))
+    (x + x)                     # 1st sighting: plain eager, no compile
+    s = dispatch.cache_stats()
+    assert s["misses"] == 0 and s["warming"] == 1
+    (x + x)                     # 2nd sighting: compiles
+    assert dispatch.cache_stats()["misses"] == 1
+    (x + x)                     # 3rd: hit
+    s = dispatch.cache_stats()
+    assert s["misses"] == 1 and s["hits"] == 1
+
+
+def test_lru_bound_evicts():
+    os.environ["PADDLE_TPU_DISPATCH_CACHE_SIZE"] = "2"
+    for n in (1, 2, 3, 4):
+        a = _t(np.ones((n, n), "float32"))
+        (a + a)
+    s = dispatch.cache_stats()
+    assert s["evictions"] >= 2 and s["size"] <= 2
+
+
+def test_host_rng_primitive_blacklists_and_stays_random():
+    a = _t(np.ones((32, 32), "float32"))
+    m1 = F.dropout(a, 0.5).numpy()
+    m2 = F.dropout(a, 0.5).numpy()
+    assert not np.array_equal(m1, m2), "cached dropout repeated its mask"
+    assert dispatch.cache_stats()["blacklisted"] >= 1
+
+
+def test_unhashable_closure_falls_back_correctly():
+    idx = np.array([2, 0, 1])
+    mask = _t(np.array([1.0, 0.0, 1.0], "float32"))
+
+    def pick(v):
+        # closure cell holds a Tensor -> no sound key -> eager fallback
+        return v * mask.value
+
+    x = _t(np.array([1.0, 2.0, 3.0], "float32"))
+    out = dispatch.call(pick, x, _name="pick")
+    np.testing.assert_allclose(out.numpy(), [1.0, 0.0, 3.0])
+    assert dispatch.cache_stats()["fallbacks"] >= 1
+    del idx
+
+
+def test_double_grad_through_cached_primitive():
+    def second_order(cache):
+        os.environ["PADDLE_TPU_DISPATCH_CACHE"] = cache
+        x = _t(np.array([1.5, -2.0, 3.0], "float32"), sg=False)
+        y = (x * x * x).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        z = (gx * gx).sum()
+        z.backward()
+        return np.array(x.grad.numpy())
+
+    np.testing.assert_allclose(second_order("1"), second_order("0"),
+                               rtol=1e-6)
+
+
+def test_static_mode_flip_invalidates():
+    a = _t(np.ones((4,), "float32"))
+    (a + a)
+    assert dispatch.cache_stats()["size"] > 0
+    paddle.enable_static()
+    try:
+        assert dispatch.cache_stats()["size"] == 0
+    finally:
+        paddle.disable_static()
+
+
+# ------------------------------------------------------------ fused optimizer
+
+def _train(opt_name, fused, steps=6):
+    os.environ["PADDLE_TPU_FUSED_STEP"] = "1" if fused else "0"
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(10, 16), nn.Tanh(), nn.Linear(16, 4))
+    kw = dict(learning_rate=0.05, parameters=net.parameters(),
+              grad_clip=paddle.nn.ClipGradByGlobalNorm(0.7))
+    if opt_name in ("Adam", "AdamW"):
+        kw["weight_decay"] = 0.02
+    opt = getattr(paddle.optimizer, opt_name)(**kw)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 10).astype("float32"))
+    for _ in range(steps):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return [np.asarray(p.numpy()) for p in net.parameters()], opt
+
+
+@pytest.mark.parametrize("opt_name", ["Adam", "AdamW", "Adadelta"])
+def test_fused_step_matches_eager_loop(opt_name):
+    fused_params, _ = _train(opt_name, True)
+    stats = dict(opt_mod._fused_stats)
+    eager_params, _ = _train(opt_name, False)
+    for a, b in zip(fused_params, eager_params):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    assert stats["compiles"] == 1, stats      # one executable total
+    assert stats["calls"] == 6, stats         # exactly 1 call per step
+
+
+def test_fused_step_one_call_regardless_of_param_count():
+    paddle.seed(0)
+    net = nn.Sequential(*[nn.Linear(6, 6) for _ in range(9)])
+    assert len(net.parameters()) == 18
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 6), "float32"))
+    for _ in range(4):
+        loss = net(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    s = dict(opt_mod._fused_stats)
+    assert s["compiles"] == 1 and s["calls"] == 4, s
+
+
+def test_fused_respects_param_groups_and_no_grad():
+    def run(fused):
+        os.environ["PADDLE_TPU_FUSED_STEP"] = "1" if fused else "0"
+        paddle.seed(1)
+        a, b = nn.Linear(5, 5), nn.Linear(5, 5)
+        opt = paddle.optimizer.Momentum(0.1, parameters=[
+            {"params": a.parameters(), "learning_rate": 0.5},
+            {"params": b.parameters(), "weight_decay": 0.01},
+        ])
+        x = paddle.to_tensor(np.ones((3, 5), "float32"))
+        for _ in range(3):
+            loss = (a(x) + b(x)).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return [np.asarray(p.numpy())
+                for p in a.parameters() + b.parameters()]
+
+    for f, e in zip(run(True), run(False)):
+        np.testing.assert_allclose(f, e, atol=1e-6)
+
+
+def test_fused_state_dict_roundtrip_matches():
+    # auto-generated param names differ between the two builds — compare
+    # accumulators positionally through each optimizer's own param list
+    _, opt_f = _train("Adam", True, steps=3)
+    _, opt_e = _train("Adam", False, steps=3)
+    assert opt_f._step_count == opt_e._step_count == 3
+    for pf, pe in zip(opt_f._parameters, opt_e._parameters):
+        for nm in opt_f._accum_names:
+            np.testing.assert_allclose(
+                np.asarray(opt_f._accumulators[nm][id(pf)]),
+                np.asarray(opt_e._accumulators[nm][id(pe)]),
+                atol=1e-6, err_msg=nm)
+
+
+def test_gradient_merge_fused_accumulation():
+    from paddle_tpu.optimizer.gradient_merge import GradientMergeOptimizer
+    paddle.seed(3)
+    net = nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    gm = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    w0 = np.array(net.weight.numpy())
+    for _ in range(4):
+        loss = net(x).sum()
+        loss.backward()
+        gm.step()
+    g = np.full((4, 4), 2.0, np.float32)       # d(sum)/dW for all-ones x
+    np.testing.assert_allclose(net.weight.numpy(),
+                               w0 - 0.1 * g - 0.1 * g, atol=1e-6)
+
+
+def test_profiler_surfaces_fast_path_counters():
+    from paddle_tpu import profiler
+    a = _t(np.ones((4,), "float32"))
+    (a + a); (a + a)
+    s = profiler.fast_path_summary()
+    assert s["dispatch_cache"]["hits"] >= 1
+    assert "calls" in s["fused_step"]

@@ -34,7 +34,7 @@ def test_world_of_one_collectives_are_identities():
 
 def test_all_reduce_inside_shard_map():
     mesh = _mesh8()
-    from jax import shard_map
+    from paddle_tpu.framework.jax_compat import shard_map
 
     def body(x):
         with dist.collective_axis("x"):
@@ -50,7 +50,7 @@ def test_all_reduce_inside_shard_map():
 
 def test_all_reduce_max_and_reduce_scatter():
     mesh = _mesh8()
-    from jax import shard_map
+    from paddle_tpu.framework.jax_compat import shard_map
 
     def body(x):
         with dist.collective_axis("x"):
@@ -167,7 +167,7 @@ def test_group_sharded_parallel_stage3_shards_params():
 
 def test_alltoall_and_allgather_shard_map():
     mesh = _mesh8()
-    from jax import shard_map
+    from paddle_tpu.framework.jax_compat import shard_map
 
     def body(x):
         with dist.collective_axis("x"):
@@ -253,3 +253,65 @@ def test_ring_attention_long_context_full_mesh():
         jnp.swapaxes(v, 1, 2), True), 1, 2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=3e-5)
+
+
+class TestEagerSubsetAlltoall:
+    """Regression: the eager multi-process alltoall must map through
+    group ranks like scatter does — a subset-group alltoall previously
+    exchanged data with non-members and returned world-sized output.
+    The 4-process world is simulated by monkeypatching the host-gather."""
+
+    def _world(self, monkeypatch, my_proc, group_ranks, nproc=4):
+        from paddle_tpu.distributed import collective as C
+
+        def fake_eager_rows(local):
+            # every process contributes rank-tagged payloads; OUR process
+            # contributes exactly what the caller handed in
+            local = np.asarray(local)
+            rows = np.stack([
+                local if j == my_proc
+                else np.full_like(local, 100.0 * j + np.arange(
+                    local.shape[0]).reshape((-1,) + (1,) * (local.ndim - 1)))
+                for j in range(nproc)])
+            return rows
+
+        monkeypatch.setattr(C, "_eager_rows", fake_eager_rows)
+        monkeypatch.setattr(C, "_process_count", lambda: nproc)
+        monkeypatch.setattr(C.jax, "process_index", lambda: my_proc)
+        return C
+
+    def test_member_gets_group_mapped_rows(self, monkeypatch):
+        C = self._world(monkeypatch, my_proc=3, group_ranks=[1, 3])
+        g = C.Group(rank=1, nranks=2, id=7, ranks=[1, 3])
+        ins = [paddle.to_tensor(np.full((2,), 7.0, np.float32)),
+               paddle.to_tensor(np.full((2,), 8.0, np.float32))]
+        out = []
+        C.alltoall(ins, out, group=g)
+        # group size outputs, NOT world size
+        assert len(out) == 2
+        # j-th output = group-member j's slot-(my group rank)=1 payload:
+        # member 0 is process 1 (tag 100*1 + slot 1), member 1 is me
+        np.testing.assert_allclose(out[0].numpy(), np.full((2,), 101.0))
+        np.testing.assert_allclose(out[1].numpy(), np.full((2,), 8.0))
+
+    def test_non_member_participates_without_output(self, monkeypatch):
+        C = self._world(monkeypatch, my_proc=0, group_ranks=[1, 3])
+        g = C.Group(rank=-1, nranks=2, id=8, ranks=[1, 3])
+        ins = [paddle.to_tensor(np.zeros((2,), np.float32)),
+               paddle.to_tensor(np.zeros((2,), np.float32))]
+        out = []
+        C.alltoall(ins, out, group=g)
+        assert out == []     # non-member: joined the gather, adopted nothing
+
+    def test_world_alltoall_unchanged(self, monkeypatch):
+        C = self._world(monkeypatch, my_proc=2, group_ranks=None)
+        ins = [paddle.to_tensor(np.full((2,), float(s), np.float32))
+               for s in range(4)]
+        out = []
+        C.alltoall(ins, out, group=None)
+        assert len(out) == 4
+        # j-th output is process j's slot-2 entry (tag 100*j + 2); ours is
+        # our own 3rd input
+        np.testing.assert_allclose(out[0].numpy(), np.full((2,), 2.0))
+        np.testing.assert_allclose(out[2].numpy(), np.full((2,), 2.0))
+        np.testing.assert_allclose(out[3].numpy(), np.full((2,), 302.0))

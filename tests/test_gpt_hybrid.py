@@ -9,12 +9,16 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.framework.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import paddle_tpu as paddle
 from paddle_tpu.parallel.mesh import create_mesh
 from paddle_tpu.models import gpt, gpt_hybrid
+
+# model-level heavyweight suite: full train steps on the CPU mesh —
+# runs in the slow tier, outside the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")

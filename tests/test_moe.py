@@ -6,11 +6,15 @@ import functools
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.framework.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import pytest
 
 from paddle_tpu.parallel import moe
+
+# model-level heavyweight suite: full train steps on the CPU mesh —
+# runs in the slow tier, outside the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
 
 
 def _mesh(n=8):

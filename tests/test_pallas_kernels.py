@@ -13,6 +13,10 @@ import jax.numpy as jnp
 from paddle_tpu.ops.pallas import norms, fused_ffn as ffn_mod
 from paddle_tpu.ops.pallas.flash_attn import flash_attention, _ref_attention
 
+# model-level heavyweight suite: full train steps on the CPU mesh —
+# runs in the slow tier, outside the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("shape", [(8, 128), (2, 16, 256), (64, 384)])
 def test_layer_norm_matches_ref(shape):

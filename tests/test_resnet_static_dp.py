@@ -10,6 +10,10 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import static
 
+# model-level heavyweight suite (full ResNet50 static step on CPU) —
+# runs in the slow tier, outside the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples"))
 
