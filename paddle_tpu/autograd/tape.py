@@ -163,11 +163,72 @@ def apply_grad_hooks(hooks, g):
     return g
 
 
+# Callbacks queued DURING a backward pass (e.g. by grad-ready hooks) that
+# must run once the pass completes — the reducer's "finalize buckets at
+# end of backward" plumbing (ref: the NCCL reducer's
+# queue_callback/finalize_backward pair in imperative/reducer.cc).  The
+# queue is drained after leaf grads finalize; on an aborted backward it is
+# cleared WITHOUT running, so a stale finalize can't fire mid-way through
+# the next pass.
+_backward_end_callbacks: List = []
+
+# depth of in-flight watch-mode (paddle.grad) reverse passes: grad-ready
+# consumers like the DataParallel reducer must NOT treat a functional
+# gradient query as a training backward (its hooks fire only for watched
+# tensors, and a bucket finalize would zero-fill every other member)
+_watch_depth = [0]
+
+# total backward nesting depth (a grad hook may itself run paddle.grad /
+# backward): end-of-backward callbacks drain only when the OUTERMOST pass
+# finishes — an inner pass draining the outer pass's queued reducer
+# finalize would reduce half-filled buckets mid-walk
+_backward_depth = [0]
+
+
+def in_watch_backward() -> bool:
+    return _watch_depth[0] > 0
+
+
+def queue_backward_end_callback(fn):
+    _backward_end_callbacks.append(fn)
+
+
+def _drain_backward_end_callbacks(run):
+    try:
+        if run:
+            while _backward_end_callbacks:
+                _backward_end_callbacks.pop(0)()
+    finally:
+        del _backward_end_callbacks[:]
+
+
 def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
     """Run reverse-mode accumulation from ``tensor`` into leaf ``.grad``s.
 
     ``watch``: ids of non-leaf tensors that should ALSO accumulate ``.grad``
     (used by paddle.grad to differentiate w.r.t. intermediates)."""
+    if watch:
+        _watch_depth[0] += 1
+    _backward_depth[0] += 1
+    try:
+        _backward_impl(tensor, grad, retain_graph, watch)
+    except BaseException:
+        # an aborted OUTERMOST pass must not leave finalize callbacks
+        # queued for the NEXT backward (they would fire over
+        # half-accumulated buckets); an inner pass leaves the outer
+        # pass's queue alone — the outer except will deal with it
+        if _backward_depth[0] == 1:
+            _drain_backward_end_callbacks(run=False)
+        raise
+    finally:
+        _backward_depth[0] -= 1
+        if watch:
+            _watch_depth[0] -= 1
+    if _backward_depth[0] == 0:
+        _drain_backward_end_callbacks(run=True)
+
+
+def _backward_impl(tensor, grad, retain_graph, watch):
     from ..tensor import Tensor
 
     if tensor._node is None:
@@ -197,6 +258,18 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
     root.seed(tensor._node_index, grad)
 
     order = _topo_order(root)
+    # Per-leaf contribution counts: a leaf's grad is COMPLETE the moment
+    # the last node referencing it has run its vjp — firing its hooks
+    # right there (instead of after the whole walk) lets grad-ready hooks
+    # (DataParallel's bucketed reducer) launch collectives asynchronously
+    # while backward is still tracing earlier layers.
+    leaf_remaining: dict = {}
+    if not watch:
+        for node in order:
+            for parent, (pn, _) in zip(node.parents, node.parent_links):
+                if pn is None:
+                    leaf_remaining[id(parent)] = \
+                        leaf_remaining.get(id(parent), 0) + 1
     for node in reversed(order):
         if node.vjp_fn is None:
             raise RuntimeError(
@@ -222,18 +295,28 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
             in_grads = node.vjp_fn(cts)
         for parent, (pn, pidx), g in zip(node.parents, node.parent_links,
                                          in_grads):
-            if g is None:
-                continue
-            if watch:
-                # paddle.grad mode: accumulate ONLY into requested tensors
-                if id(parent) in watch:
-                    _add(parent, g)
-                if pn is not None:
+            if g is not None:
+                if watch:
+                    # paddle.grad mode: accumulate ONLY into requested
+                    # tensors
+                    if id(parent) in watch:
+                        _add(parent, g)
+                    if pn is not None:
+                        pn.seed(pidx, g)
+                elif pn is not None:
                     pn.seed(pidx, g)
-            elif pn is not None:
-                pn.seed(pidx, g)
-            else:
-                _add(parent, g)
+                else:
+                    _add(parent, g)
+            if pn is None and not watch:
+                # one contribution edge consumed (g None counts too: that
+                # edge will never contribute); at zero the leaf's grad is
+                # final for this pass — fire its hooks NOW, mid-walk
+                rem = leaf_remaining[id(parent)] = \
+                    leaf_remaining[id(parent)] - 1
+                if rem == 0:
+                    ent = pending.pop(id(parent), None)
+                    if ent is not None:
+                        ent[0]._finalize_grad(ent[1])
         node._accum = None
         if not retain_graph:
             node.vjp_fn = None

@@ -6,7 +6,10 @@ from .collective import (ReduceOp, Group, new_group, get_group, barrier, wait,
                          broadcast, scatter, alltoall, send, recv,
                          reduce_scatter, split, collective_axis)
 from . import fleet
-from .data_parallel import DataParallel
+from .data_parallel import DataParallel, DistributedDataParallel
+from . import reducer
+from .reducer import (Reducer, DeviceMeshAllReduce,  # noqa: F401
+                      EagerProcessTransport)
 from . import sharding
 from .ps_compat import (EntryAttr, ProbabilityEntry,  # noqa: F401
                         CountFilterEntry, InMemoryDataset, QueueDataset)

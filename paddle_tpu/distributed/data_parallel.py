@@ -4,23 +4,45 @@ The reference hooks NCCL allreduce onto gradient buckets.  Under the SPMD
 model gradients are synced by the compiler: when the train step runs under
 pjit with batch sharded over 'dp', grads of replicated params ARE the summed
 grads.  Eager single-process training needs no sync.  In a MULTI-PROCESS
-launch (jax.distributed initialized), grads are averaged across processes:
-automatically after each param's grad finalizes in backward (per-param
-hooks, the reference reducer's semantics), batched through ONE flat
-cross-process gather per backward via apply_collective_grads() when called
-explicitly (the fluid-era recipe), with no_sync() suppressing both.
+launch (jax.distributed initialized), grads are averaged across processes
+through the overlap-scheduled bucketed reducer (distributed/reducer.py):
+size-capped buckets in reverse registration order, each bucket's all_reduce
+launched from the grad-ready hooks while backward is still walking earlier
+layers, grad-less params contributing zeros at end-of-backward finalize.
+The fluid-era explicit recipe (apply_collective_grads) and no_sync() keep
+their semantics.
+
+Knobs (see README "Pipelined data-parallel step"):
+  bucket_size_mb   cap per gradient bucket (default: comm_buffer_size,
+                   the reference's MB knob).  Smaller buckets overlap
+                   earlier but launch more collectives.
+  overlap          launch buckets from grad-ready hooks (True, default)
+                   or all at end-of-backward in deterministic bucket
+                   order (False — forced when find_unused_parameters,
+                   where completion order may diverge across processes).
+  mesh             a single-process jax Mesh: bucket reduction runs as
+                   jitted psum collectives over its first axis instead of
+                   host gathers.  This is the host-mesh bench/test
+                   transport and the single-process-per-pod path.
 """
 from __future__ import annotations
 
 import contextlib
+import weakref
 
 from ..nn.layer.layers import Layer
+
+# one live reducer per wrapped Layer: re-wrapping a model (checkpoint
+# reload, notebook re-run) must detach the previous wrapper's hooks, or
+# every backward would run TWO full bucket collective sequences
+_reducer_of_layer: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, bucket_size_mb=None, overlap=True, mesh=None,
+                 fuse_into_step=False):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
@@ -28,41 +50,58 @@ class DataParallel(Layer):
         self._group = group
         from . import collective
         self._collective = collective
-        # per-param backward hooks require every process to reach every
-        # param (static graphs) — the reference's default contract.  With
-        # find_unused_parameters=True, auto-sync switches to the flat
-        # all-params gather at apply_collective_grads() time instead
-        # (grad-less params contribute zeros), because a hook that fires
-        # on only SOME processes would desynchronize the collective
-        # sequence and hang the job.
-        if (collective._process_count() > 1
-                and not find_unused_parameters):
-            self._install_grad_sync_hooks()
+        self.bucket_size_mb = (comm_buffer_size if bucket_size_mb is None
+                               else bucket_size_mb)
+        self._reducer = None
+        from .reducer import (Reducer, DeviceMeshAllReduce,
+                              EagerProcessTransport)
+        if mesh is not None:
+            transport = DeviceMeshAllReduce(mesh=mesh)
+        elif collective._process_count() > 1:
+            transport = EagerProcessTransport(group)
+        else:
+            transport = None          # world of one: grads are already global
+        if transport is not None:
+            prev = _reducer_of_layer.get(layers)
+            if prev is not None:          # re-wrap: detach the old hooks
+                prev.enabled = False
+                prev.remove_hooks()
+            # an unused param's hook never fires, so its bucket would
+            # complete on SOME processes only — finalize-ordered launches
+            # (overlap=False) keep the collective sequence deterministic
+            # fuse_into_step=True keeps per-param .grad LOCAL and holds the
+            # reduced flats for step_fused(optimizer) — opt in only when
+            # the training loop uses step_fused, never plain opt.step()
+            self._reducer = Reducer(
+                self._layers.parameters(),
+                bucket_size_mb=self.bucket_size_mb,
+                transport=transport,
+                overlap=overlap and not find_unused_parameters,
+                fuse_into_step=fuse_into_step,
+            ).install_hooks()
+            _reducer_of_layer[layers] = self._reducer
 
-    def _install_grad_sync_hooks(self):
-        coll = self._collective
-
-        def make_hook(p):
-            def hook(g):
-                if not self._sync_enabled:
-                    return None
-                member, rows = coll._member_rows(
-                    coll._eager_rows(g.numpy()), self._group)
-                if not member:
-                    return None
-                from ..tensor.tensor import Tensor
-                return Tensor(rows.mean(0))
-            return hook
-
-        for p in self._layers.parameters():
-            if p is not None and not p.stop_gradient:
-                p.register_hook(make_hook(p))
+    @property
+    def reducer(self):
+        return self._reducer
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
         return loss
+
+    def step_fused(self, optimizer):
+        """Pipelined update: feed the reduced flat buckets straight into
+        the donated fused optimizer step — one jitted
+        scale+unflatten+update, no per-param unbucketing round-trip.
+        Falls back to ``optimizer.step()`` when nothing was reduced
+        (world of one, no_sync, subset non-member)."""
+        reduced = self._reducer.pop_reduced() if self._reducer else None
+        if reduced is None:
+            return optimizer.step()
+        flats, layout, scale = reduced
+        return optimizer.step_from_buckets(flats, layout, scale=scale)
 
     def apply_collective_grads(self):
         """Fluid-era explicit sync: average every param grad across
@@ -78,13 +117,21 @@ class DataParallel(Layer):
     def no_sync(self):
         prev = self._sync_enabled
         self._sync_enabled = False
+        if self._reducer is not None:
+            self._reducer.enabled = False
         try:
             yield
         finally:
             self._sync_enabled = prev
+            if self._reducer is not None:
+                self._reducer.enabled = prev
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)
 
     def set_state_dict(self, state_dict, *args, **kwargs):
         return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+# the reference exports both names; the 2.x spelling carries the knobs
+DistributedDataParallel = DataParallel

@@ -5,5 +5,5 @@ from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
 from .dataloader import (DataLoader, default_collate_fn, get_worker_info,
-                         WorkerInfo)
+                         WorkerInfo, prefetch_to_device)
 from .serialization import save, load

@@ -40,6 +40,114 @@ def get_worker_info():
     return getattr(_worker_tls, "info", None)
 
 
+# device-prefetch counters, surfaced through paddle_tpu.profiler
+_prefetch_stats = {"batches": 0, "hits": 0, "misses": 0, "puts": 0}
+
+
+def prefetch_stats():
+    s = dict(_prefetch_stats)
+    n = s["batches"]
+    s["hit_ratio"] = round(s["hits"] / n, 4) if n else 0.0
+    return s
+
+
+def reset_prefetch_stats():
+    for k in _prefetch_stats:
+        _prefetch_stats[k] = 0
+
+
+def _device_put_leaf(x, sharding):
+    """Async host->device transfer of one batch leaf; Tensors rewrap so
+    the consumer sees the same pytree types it fed in.  Non-numeric
+    leaves (strings, object arrays, python scalars) pass through
+    untouched — prefetch must never change the types a collate_fn
+    produced."""
+    import jax
+    if isinstance(x, Tensor):
+        v = x.value
+        out = jax.device_put(v, sharding) if sharding is not None else v
+        if out is v:
+            return x
+        t = Tensor(out)
+        t.stop_gradient = x.stop_gradient
+        return t
+    if isinstance(x, np.ndarray) and not x.dtype.hasobject \
+            and x.dtype.kind not in "USV":
+        return jax.device_put(x, sharding)
+    return x
+
+
+def _leaf_sharding(x, mesh):
+    """Shard the batch's leading axis over the mesh's dp axis when it
+    divides evenly; replicate otherwise.  No mesh: default device."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if mesh is None:
+        return jax.devices()[0]
+    shape = getattr(x, "shape", ())
+    if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 and shape \
+            and shape[0] % mesh.shape["dp"] == 0:
+        return NamedSharding(mesh, P("dp"))
+    return NamedSharding(mesh, P())
+
+
+def prefetch_to_device(iterable, depth=1, mesh=None):
+    """Wrap a batch iterator so each batch's host->device transfer is
+    launched ``depth`` batches AHEAD of consumption: step N's H2D overlaps
+    step N-1's compute instead of sitting on the critical path (ref: the
+    CUDA pinned-memory double buffer in fluid/reader/buffered_reader.cc).
+
+    Batches are pytrees of Tensors / numpy arrays.  With an active device
+    mesh (paddle_tpu.parallel mesh_scope, or ``mesh=``), leaves whose
+    leading axis divides the 'dp' axis are device_put SHARDED over it.
+    A batch whose transfer finished before the consumer asked counts as a
+    prefetch hit; one the consumer had to wait on counts as a miss
+    (profiler.fast_path_summary()['prefetch'])."""
+    import collections
+    import jax
+
+    if mesh is None:
+        from ..parallel import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+    depth = max(int(depth), 1)
+
+    def _put(batch):
+        _prefetch_stats["puts"] += 1
+        return jax.tree_util.tree_map(
+            lambda x: _device_put_leaf(x, _leaf_sharding(x, mesh)), batch,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    def _ready(batch):
+        leaves = jax.tree_util.tree_leaves(
+            batch, is_leaf=lambda x: isinstance(x, Tensor))
+        for leaf in leaves:
+            v = leaf.value if isinstance(leaf, Tensor) else leaf
+            ready = getattr(v, "is_ready", None)
+            if ready is not None and not ready():
+                return False
+        return True
+
+    def _gen():
+        it = iter(iterable)
+        buf = collections.deque()
+        try:
+            while len(buf) < depth:
+                buf.append(_put(next(it)))
+        except StopIteration:
+            pass
+        while buf:
+            batch = buf.popleft()
+            _prefetch_stats["batches"] += 1
+            _prefetch_stats["hits" if _ready(batch) else "misses"] += 1
+            try:
+                buf.append(_put(next(it)))
+            except StopIteration:
+                pass
+            yield batch
+
+    return _gen()
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (Tensor,)):
@@ -80,8 +188,13 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, use_native_ring=None):
+                 persistent_workers=False, use_native_ring=None,
+                 prefetch_to_device=False):
         self.dataset = dataset
+        # False: off.  True / int N: keep N batches device_put ahead of
+        # consumption (sharded over the active mesh's dp axis when
+        # present) so H2D overlaps the previous step's compute
+        self.prefetch_to_device = prefetch_to_device
         self.collate_fn = collate_fn or default_collate_fn
         self._default_collate = collate_fn is None
         self.num_workers = num_workers
@@ -411,15 +524,20 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers and self._iterable_mode:
-            return self._iter_iterable_workers()
-        if self.num_workers and not self._iterable_mode:
+            it = self._iter_iterable_workers()
+        elif self.num_workers and not self._iterable_mode:
             use_ring = self.use_native_ring
             if use_ring is None:
                 # auto mode must not stall the first epoch on a C++ compile:
                 # only take the native path when the library is already built
                 from .. import runtime
                 use_ring = runtime.is_prebuilt()
-            if use_ring:
-                return self._iter_native_ring()
-            return self._iter_threaded()
-        return self._iter_single()
+            it = (self._iter_native_ring() if use_ring
+                  else self._iter_threaded())
+        else:
+            it = self._iter_single()
+        if self.prefetch_to_device:
+            depth = (1 if self.prefetch_to_device is True
+                     else int(self.prefetch_to_device))
+            return prefetch_to_device(it, depth=depth)
+        return it

@@ -89,8 +89,14 @@ class GradientMergeOptimizer:
         if not boundary:
             return
         scale = 1.0 / self._k if self._avg else 1.0
-        with_acc = [p for p in self._inner._parameters
-                    if p is not None and id(p) in self._acc]
+        # dedupe by id: a shared/tied Parameter listed twice must harvest
+        # its accumulator once, not KeyError on the second pop
+        with_acc, seen = [], set()
+        for p in self._inner._parameters:
+            if p is not None and id(p) in self._acc \
+                    and id(p) not in seen:
+                with_acc.append(p)
+                seen.add(id(p))
         accs = [self._acc.pop(id(p)) for p in with_acc]
         if accs and scale != 1.0:
             accs = _tree_op("scale", _avals_key(accs))(accs, scale)

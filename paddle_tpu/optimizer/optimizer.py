@@ -230,44 +230,30 @@ class Optimizer:
                 getattr(self, "_accumulator_placement", None) is not None,
                 tuple(per))
 
-    def _apply_gradients_fused(self, params_grads):
-        pairs = [(p, (g.value if isinstance(g, Tensor) else g))
-                 for p, g in params_grads if g is not None]
-        if not pairs:
-            self._step_count += 1
-            return
-        params = [p for p, _ in pairs]
-        grads = [g for _, g in pairs]
-        states = [self._state_for(p) for p in params]
-        lr = self.get_lr()
-        t = self._step_count + 1
-
-        key = self._fused_signature(params, grads, states)
+    def _fused_lookup(self, key, build):
+        """Signature-keyed compiled-step cache (bounded LRU); ``build``
+        makes the jitted callable on a miss."""
         try:
             compiled = self._fused_cache.get(key)
         except TypeError as e:
             raise _UnhashableSignature(str(e)) from e
         if compiled is None:
-            def fused(param_vals, gs, sts, lr_, t_):
-                return self.apply_updates_pytree(param_vals, gs, sts, lr_,
-                                                 t_, params=params)
-            donate = (0, 2) if _donation_enabled() else ()
-            compiled = jax.jit(fused, donate_argnums=donate)
+            compiled = build()
             self._fused_cache[key] = compiled
             while len(self._fused_cache) > 8:
                 self._fused_cache.popitem(last=False)
             _fused_stats["compiles"] += 1
         else:
             self._fused_cache.move_to_end(key)
+        return compiled
 
-        new_ps, new_ss = compiled([p.value for p in params], grads, states,
-                                  lr, t)
-        # Mutations only after the compiled call succeeded: a trace
-        # failure leaves the optimizer untouched for the eager fallback.
-        # Conversely, once mutation starts, a failure must PROPAGATE
-        # (flagged via _fused_mutating) — falling back to the eager loop
-        # here would re-apply the same grads on top of half-updated
-        # state, a silent double step.
+    def _commit_fused(self, params, new_ps, new_ss, t):
+        """Adopt a compiled step's outputs.  Mutations only happen after
+        the compiled call succeeded: a trace failure leaves the optimizer
+        untouched for the eager fallback.  Conversely, once mutation
+        starts, a failure must PROPAGATE (flagged via _fused_mutating) —
+        falling back to the eager loop here would re-apply the same grads
+        on top of half-updated state, a silent double step."""
         self._fused_mutating = True
         self._step_count = t
         _fused_stats["calls"] += 1
@@ -281,6 +267,116 @@ class Optimizer:
                     sv = place(p, sv)
                 self._accumulators[nm][id(p)] = sv
         self._fused_mutating = False
+
+    def _apply_gradients_fused(self, params_grads):
+        pairs = [(p, (g.value if isinstance(g, Tensor) else g))
+                 for p, g in params_grads if g is not None]
+        if not pairs:
+            self._step_count += 1
+            return
+        params = [p for p, _ in pairs]
+        grads = [g for _, g in pairs]
+        states = [self._state_for(p) for p in params]
+        lr = self.get_lr()
+        t = self._step_count + 1
+
+        def build():
+            def fused(param_vals, gs, sts, lr_, t_):
+                return self.apply_updates_pytree(param_vals, gs, sts, lr_,
+                                                 t_, params=params)
+            donate = (0, 2) if _donation_enabled() else ()
+            return jax.jit(fused, donate_argnums=donate)
+
+        compiled = self._fused_lookup(
+            self._fused_signature(params, grads, states), build)
+        new_ps, new_ss = compiled([p.value for p in params], grads, states,
+                                  lr, t)
+        self._commit_fused(params, new_ps, new_ss, t)
+
+    # ------------------------------------------- fused bucketed step
+    def step_from_buckets(self, flats, layout, scale=1.0):
+        """Consume a reducer's flat reduced buckets in ONE jitted
+        scale+unflatten+update — no per-param unbucketing round-trip.
+
+        ``flats``: list of flat reduced bucket arrays (SUM over ranks);
+        ``layout``: [(param, flat_index, offset, numel, shape), ...];
+        ``scale``: applied to every sliced grad inside the compiled step
+        (1/nranks turns the reduced sum into the mean).  Params owned by
+        this optimizer but absent from the layout (stop_gradient toggles,
+        subset-group non-member buckets) ride the same compiled call with
+        their direct ``.grad``.  Any failure before state mutation falls
+        back to eager unbucketing + the normal step."""
+        in_layout = {id(p) for p, *_ in layout}
+        extras = [(p, p._grad) for p in self._parameters
+                  if p is not None and not p.stop_gradient
+                  and p._grad is not None and id(p) not in in_layout]
+        pairs = [(p, fi, off, n, shape) for p, fi, off, n, shape in layout
+                 if not p.stop_gradient]
+        if not self._fused_enabled():
+            return self._apply_gradients(
+                self._unbucket(flats, pairs, scale) + extras)
+        try:
+            return self._step_from_buckets_fused(flats, pairs, extras,
+                                                 scale)
+        except _UnhashableSignature:
+            # possibly transient metadata — retry fused next step
+            pass
+        except Exception:                                  # noqa: BLE001
+            if getattr(self, "_fused_mutating", False):
+                self._fused_mutating = False
+                raise
+            # untraceable update rule: permanently fall back for this
+            # instance, same as _apply_gradients — re-attempting the
+            # failing trace every step would pay it forever
+            self._fused_supported = False
+        _fused_stats["eager_steps"] += 1
+        return self._apply_gradients_eager(
+            self._unbucket(flats, pairs, scale) + extras)
+
+    @staticmethod
+    def _unbucket(flats, pairs, scale):
+        # raw jax arrays, exactly what step() feeds the eager loop — a
+        # Tensor-wrapped grad would propagate Tensor into p.value
+        return [(p, flats[fi][off:off + n].reshape(shape)
+                 * jnp.asarray(scale, flats[fi].dtype))
+                for p, fi, off, n, shape in pairs]
+
+    def _step_from_buckets_fused(self, flats, pairs, extras, scale):
+        params = [p for p, *_ in pairs] + [p for p, _ in extras]
+        extra_grads = [(g.value if isinstance(g, Tensor) else g)
+                       for _, g in extras]
+        if not params:
+            self._step_count += 1
+            return
+        states = [self._state_for(p) for p in params]
+        lr = self.get_lr()
+        t = self._step_count + 1
+        slots = tuple((fi, int(off), int(n), tuple(shape))
+                      for _, fi, off, n, shape in pairs)
+        key = ("buckets", slots, float(scale),
+               tuple((tuple(f.shape), str(f.dtype)) for f in flats),
+               self._fused_signature(
+                   params,
+                   [jax.ShapeDtypeStruct(tuple(p.value.shape),
+                                         p.value.dtype) for p in params],
+                   states))
+
+        def build():
+            def fused(param_vals, flat_vals, extra_gs, sts, lr_, t_):
+                grads = [flat_vals[fi][off:off + n].reshape(shape)
+                         .astype(param_vals[i].dtype)
+                         * jnp.asarray(scale, param_vals[i].dtype)
+                         for i, (fi, off, n, shape) in enumerate(slots)]
+                grads += list(extra_gs)
+                return self.apply_updates_pytree(param_vals, grads, sts,
+                                                 lr_, t_, params=params)
+            donate = (0, 3) if _donation_enabled() else ()
+            return jax.jit(fused, donate_argnums=donate)
+
+        compiled = self._fused_lookup(key, build)
+        new_ps, new_ss = compiled([p.value for p in params], list(flats),
+                                  extra_grads, states, lr, t)
+        self._commit_fused(params, new_ps, new_ss, t)
 
     def _apply_gradients(self, params_grads):
         if self._fused_enabled():

@@ -211,12 +211,43 @@ def reset_fused_step_stats():
     _opt.reset_fused_stats()
 
 
+def reducer_stats():
+    """Counters of the overlap-scheduled bucketed gradient reducer
+    (distributed/reducer.py): buckets built, collectives launched (one
+    per bucket per step), how many launched from grad-ready hooks
+    mid-backward vs at finalize (overlap_ratio), zero-filled grad-less
+    params."""
+    from .distributed import reducer as _red
+    return _red.reducer_stats()
+
+
+def reset_reducer_stats():
+    from .distributed import reducer as _red
+    _red.reset_reducer_stats()
+
+
+def prefetch_stats():
+    """Device-side input prefetch counters (io/dataloader.py
+    prefetch_to_device): a hit is a batch whose H2D transfer finished
+    before the training loop asked for it."""
+    from .io import dataloader as _dl
+    return _dl.prefetch_stats()
+
+
+def reset_prefetch_stats():
+    from .io import dataloader as _dl
+    _dl.reset_prefetch_stats()
+
+
 def fast_path_summary():
-    """One dict with both fast-path counter families — what the bench.py
-    eager microbench asserts on."""
+    """One dict with every fast-path counter family — what the bench.py
+    eager microbench and dp-overlap bench assert on."""
     out = {"dispatch_cache": dispatch_cache_stats()}
-    try:
-        out["fused_step"] = fused_step_stats()
-    except Exception:                                      # noqa: BLE001
-        out["fused_step"] = {}
+    for key, fn in (("fused_step", fused_step_stats),
+                    ("reducer", reducer_stats),
+                    ("prefetch", prefetch_stats)):
+        try:
+            out[key] = fn()
+        except Exception:                                  # noqa: BLE001
+            out[key] = {}
     return out

@@ -1,0 +1,521 @@
+"""Overlap-scheduled bucketed gradient reduction (distributed/reducer.py)
+on the 8-device virtual CPU mesh, plus the tape's grad-ready plumbing and
+the fused bucket-consuming optimizer step.
+
+Models the reference's reducer unittests (ref: test_imperative_data_parallel
+/ reducer.cc bucket assignment) with the parity contract from PyTorch-DDP
+style overlap: the overlapped-bucketed schedule must train bit-for-bit like
+the naive sync-at-end schedule."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import reducer as reducer_mod
+from paddle_tpu.distributed.reducer import (
+    Reducer, DeviceMeshAllReduce, EagerProcessTransport, build_buckets)
+
+
+def _mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+
+def _mlp(widths=(16, 32, 16, 4)):
+    paddle.seed(7)
+    layers = []
+    for a, b in zip(widths[:-1], widths[1:]):
+        layers += [nn.Linear(a, b), nn.Tanh()]
+    return nn.Sequential(*layers[:-1])
+
+
+# ------------------------------------------------------------------ buckets
+
+def test_bucket_build_reverse_order_and_cap():
+    net = _mlp()
+    params = list(net.parameters())
+    # huge cap: ONE bucket holding every param in reverse registration
+    # order (backward completes grads roughly back-to-front)
+    (b,) = build_buckets(params, bucket_size_mb=1e9)
+    assert [id(p) for p in b.params] == [id(p) for p in reversed(params)]
+    assert b.numel == sum(int(np.prod(p.shape)) if p.shape else 1
+                          for p in params)
+    # offsets tile the flat exactly (uneven tail included)
+    assert b.offsets[0] == 0
+    for off, n, nxt in zip(b.offsets, b.numels, b.offsets[1:]):
+        assert off + n == nxt
+
+
+def test_bucket_size_smaller_than_one_param():
+    net = _mlp()
+    params = list(net.parameters())
+    buckets = build_buckets(params, bucket_size_mb=1e-9)  # < any param
+    # every param gets a bucket of its own, order still reversed
+    assert len(buckets) == len(params)
+    assert all(len(b.params) == 1 for b in buckets)
+    assert [id(b.params[0]) for b in buckets] == \
+        [id(p) for p in reversed(params)]
+
+
+def test_bucket_dtype_split():
+    p1 = paddle.ones([4], dtype="float32")
+    p2 = paddle.ones([4], dtype="float16")
+    p1.stop_gradient = p2.stop_gradient = False
+    assert p1.dtype != p2.dtype
+    buckets = build_buckets([p1, p2], bucket_size_mb=1e9)
+    assert len(buckets) == 2  # mixed dtypes never share a flat bucket
+
+
+# ---------------------------------------------------- tape hook plumbing
+
+def test_grad_ready_hooks_fire_mid_backward():
+    """A late layer's param hook must fire while EARLIER layers' tape
+    nodes are still unconsumed — the property the overlap schedule rides
+    (the collective launches while backward keeps walking)."""
+    net = _mlp((8, 8, 8, 8))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    h1 = net[0](x)                       # first Linear's output
+    out = net[2](paddle.tanh(h1))
+    for lyr in (net[4],):
+        out = lyr(paddle.tanh(out))
+    first_node = h1._node
+    seen = {}
+
+    def hook(g):
+        # the first Linear's node has not been processed yet: its vjp
+        # closure is still alive mid-walk (backward() frees it on use)
+        seen["first_node_alive"] = first_node.vjp_fn is not None
+        return None
+
+    net[4].weight.register_hook(hook)
+    out.mean().backward()
+    assert seen["first_node_alive"] is True
+    # and the walk then completed normally
+    assert net[0].weight.grad is not None
+
+
+def test_backward_end_callbacks_run_once_and_clear():
+    from paddle_tpu.autograd import tape
+    calls = []
+    w = paddle.ones([3])
+    w.stop_gradient = False
+
+    def make_loss():
+        return (w * w).sum()
+
+    def hook(g):
+        tape.queue_backward_end_callback(lambda: calls.append(1))
+        return None
+
+    h = w.register_hook(hook)
+    make_loss().backward()
+    assert calls == [1]
+    make_loss().backward()
+    assert calls == [1, 1]               # re-queued per backward, not stale
+    h.remove()
+
+
+# ------------------------------------------------- parity on the host mesh
+
+def _train(mode, steps=10, bucket_mb=0.002, widths=(16, 32, 16, 4),
+           fuse=True):
+    net = _mlp(widths)
+    kwargs = dict(mesh=_mesh8())
+    if mode == "overlap":
+        kwargs.update(bucket_size_mb=bucket_mb, overlap=True,
+                      fuse_into_step=fuse)
+    elif mode == "sync":
+        kwargs.update(bucket_size_mb=1e9, overlap=False)
+    dp = dist.DataParallel(net, **kwargs) if mode != "plain" else None
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters(),
+                                 weight_decay=0.01)
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(8, widths[0]).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, widths[-1]).astype(np.float32))
+    model = dp if dp is not None else net
+    for _ in range(steps):
+        loss = paddle.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        if mode == "overlap" and fuse:
+            dp.step_fused(opt)
+        else:
+            opt.step()
+        opt.clear_grad()
+    n_buckets = len(dp.reducer.buckets) if dp is not None else 0
+    return [np.asarray(p.numpy()) for p in net.parameters()], n_buckets
+
+
+def test_overlap_matches_sync_and_plain_10_steps():
+    """The core parity contract: overlapped-bucketed DP (fused bucket
+    step) == naive sync-at-end DP (write-back + plain step) == plain
+    single-process training, to 1e-6 after 10 steps."""
+    reducer_mod.reset_reducer_stats()
+    ref, _ = _train("plain")
+    sync, _ = _train("sync")
+    stats0 = reducer_mod.reducer_stats()
+    ov, n_buckets = _train("overlap")
+    stats = reducer_mod.reducer_stats()
+    assert n_buckets > 1                  # the bucketed path was exercised
+    for a, b in zip(sync, ref):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    for a, b in zip(ov, ref):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    # exactly one collective launch per bucket per step, all from hooks
+    launched = stats["collectives_launched"] - stats0["collectives_launched"]
+    assert launched == n_buckets * 10
+    assert stats["overlap_launches"] > stats0["overlap_launches"]
+
+
+def test_overlap_writeback_without_fused_step():
+    """overlap=True without fuse_into_step: reduced grads land back in
+    p.grad and a PLAIN opt.step() trains identically."""
+    ref, _ = _train("plain")
+    ov, _ = _train("overlap", fuse=False)
+    for a, b in zip(ov, ref):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_gradless_param_contributes_zeros():
+    """A param with no grad path still occupies its bucket slot (zeros),
+    buckets still launch exactly once, and used params train exactly like
+    the no-DP run — the deterministic-membership contract."""
+
+    class Partial(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.used = nn.Linear(8, 4)
+            self.unused = nn.Linear(8, 4)   # never in the loss
+
+        def forward(self, x):
+            return self.used(x)
+
+    def run(dp_mode):
+        paddle.seed(11)
+        net = Partial()
+        dp = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9,
+                               overlap=True) if dp_mode else None
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        loss = (net(x) ** 2).mean() if dp is None \
+            else (dp(x) ** 2).mean()
+        loss.backward()
+        return net
+
+    reducer_mod.reset_reducer_stats()
+    net_dp = run(True)
+    stats = reducer_mod.reducer_stats()
+    net_ref = run(False)
+    assert stats["zero_filled_params"] == 2      # unused weight + bias
+    assert stats["collectives_launched"] == 1
+    np.testing.assert_allclose(
+        np.asarray(net_dp.used.weight.grad.numpy()),
+        np.asarray(net_ref.used.weight.grad.numpy()), atol=1e-6)
+    # the grad-less param adopted the (all-zero) reduced slice
+    g = net_dp.unused.weight.grad
+    assert g is not None and not np.asarray(g.numpy()).any()
+
+
+def test_no_sync_suppresses_collectives():
+    reducer_mod.reset_reducer_stats()
+    net = _mlp()
+    dp = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 16).astype(np.float32))
+    with dp.no_sync():
+        (dp(x) ** 2).mean().backward()
+    assert reducer_mod.reducer_stats()["collectives_launched"] == 0
+    (dp(x) ** 2).mean().backward()       # sync resumes after the context
+    assert reducer_mod.reducer_stats()["collectives_launched"] == 1
+
+
+# ------------------------------------------------- subset process groups
+
+class _FakeRows:
+    """Monkeypatched collective backend: pretends to be a 4-process world
+    whose row j is (local + j)."""
+
+    def __init__(self, nproc):
+        self.nproc = nproc
+
+    def rows(self, value):
+        v = np.asarray(value)
+        return np.stack([v + j for j in range(self.nproc)])
+
+
+def test_subset_group_maps_group_ranks(monkeypatch):
+    """EagerProcessTransport over a subset group: only MEMBER rows enter
+    the reduction (mapped through group ranks), non-members keep local
+    grads (transport returns None)."""
+    from paddle_tpu.distributed import collective
+    fake = _FakeRows(4)
+    monkeypatch.setattr(collective, "_process_count", lambda: 4)
+    monkeypatch.setattr(collective, "_eager_rows",
+                        lambda v: fake.rows(v))
+
+    member_group = collective.Group(rank=0, nranks=2, id=7, ranks=[1, 3])
+    tr = EagerProcessTransport(member_group)
+    assert tr.nranks == 2
+    flat = jnp.arange(4.0)
+    out = tr.all_reduce_flat(flat)
+    # member rows are global ranks 1 and 3: (flat+1) + (flat+3)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(flat) * 2 + 4)
+
+    non_member = collective.Group(rank=-1, nranks=2, id=8, ranks=[1, 3])
+    tr2 = EagerProcessTransport(non_member)
+    assert tr2.all_reduce_flat(flat) is None
+
+
+def test_reducer_subset_non_member_keeps_local_grads(monkeypatch):
+    from paddle_tpu.distributed import collective
+    fake = _FakeRows(4)
+    monkeypatch.setattr(collective, "_process_count", lambda: 4)
+    monkeypatch.setattr(collective, "_eager_rows",
+                        lambda v: fake.rows(v))
+    net = _mlp((8, 8, 4))
+    group = collective.Group(rank=-1, nranks=2, id=9, ranks=[1, 3])
+    red = Reducer(net.parameters(), bucket_size_mb=1e9,
+                  transport=EagerProcessTransport(group)).install_hooks()
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 8).astype(np.float32))
+    (net(x) ** 2).mean().backward()
+    # non-member: pop_reduced empty, local grads untouched by any scale
+    assert red.pop_reduced() is None
+    assert net[0].weight.grad is not None
+
+
+# ------------------------------------------------- fused bucket step unit
+
+def test_step_from_buckets_matches_manual_unbucket():
+    paddle.seed(3)
+    net_a = _mlp((8, 8, 4))
+    paddle.seed(3)
+    net_b = _mlp((8, 8, 4))
+    pa, pb = list(net_a.parameters()), list(net_b.parameters())
+    rng = np.random.RandomState(0)
+    grads = [rng.randn(*p.shape).astype(np.float32) * 8 for p in pa]
+
+    # bucket layout over net_a: two flats, reverse order, scale 1/8
+    buckets = build_buckets(pa, bucket_size_mb=1e-9)
+    flats, layout = [], []
+    for b in buckets:
+        by_id = {id(p): g for p, g in zip(pa, grads)}
+        flats.append(jnp.concatenate(
+            [jnp.asarray(by_id[id(p)]).reshape(-1) for p in b.params]))
+        for p, off, n, shape in zip(b.params, b.offsets, b.numels,
+                                    b.shapes):
+            layout.append((p, len(flats) - 1, off, n, shape))
+    opt_a = paddle.optimizer.AdamW(1e-2, parameters=pa, weight_decay=0.01)
+    opt_a.step_from_buckets(flats, layout, scale=1.0 / 8)
+
+    opt_b = paddle.optimizer.AdamW(1e-2, parameters=pb, weight_decay=0.01)
+    for p, g in zip(pb, grads):
+        p.grad = paddle.to_tensor(g / 8)
+    opt_b.step()
+
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a.numpy()),
+                                   np.asarray(b.numpy()), atol=1e-6)
+
+
+def test_step_from_buckets_extra_direct_grads():
+    """Params with a direct .grad but no bucket slot ride the same fused
+    call (subset non-member buckets, late-registered params)."""
+    paddle.seed(5)
+    net = _mlp((8, 8, 4))
+    params = list(net.parameters())
+    in_bucket, extra = params[:2], params[2:]
+    rng = np.random.RandomState(2)
+    buckets = build_buckets(in_bucket, bucket_size_mb=1e9)
+    flats, layout = [], []
+    for b in buckets:
+        gs = [rng.randn(*p.shape).astype(np.float32) for p in b.params]
+        flats.append(jnp.concatenate([jnp.asarray(g).reshape(-1)
+                                      for g in gs]))
+        for p, off, n, shape in zip(b.params, b.offsets, b.numels,
+                                    b.shapes):
+            layout.append((p, len(flats) - 1, off, n, shape))
+    before = [np.asarray(p.numpy()) for p in extra]
+    for p in extra:
+        p.grad = paddle.to_tensor(
+            rng.randn(*p.shape).astype(np.float32))
+    opt = paddle.optimizer.Momentum(0.1, parameters=params)
+    opt.step_from_buckets(flats, layout, scale=1.0)
+    for p, b0 in zip(extra, before):
+        assert not np.allclose(np.asarray(p.numpy()), b0)
+
+
+# --------------------------------------------- review-finding regressions
+
+def test_reducer_recovers_after_aborted_backward():
+    """An exception mid-backward drops the finalize callback without
+    running it; the NEXT backward must re-queue and sync normally instead
+    of silently never reducing again."""
+    reducer_mod.reset_reducer_stats()
+    net = _mlp((8, 8, 4))
+    dp = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+
+    boom = {"on": True}
+
+    def bad_hook(g):
+        if boom["on"]:
+            raise RuntimeError("injected hook failure")
+        return None
+
+    h = net[0].bias.register_hook(bad_hook)
+    with pytest.raises(RuntimeError, match="injected"):
+        (dp(x) ** 2).mean().backward()
+    boom["on"] = False
+    (dp(x) ** 2).mean().backward()       # must sync again
+    assert reducer_mod.reducer_stats()["collectives_launched"] >= 1
+    assert net[0].weight.grad is not None
+    h.remove()
+
+
+def test_paddle_grad_does_not_clobber_bucket_grads():
+    """paddle.grad (watch mode) between backward and step must not
+    trigger the reducer — a bucket finalize there would zero-fill and
+    overwrite every other member's synced grad (gradient-penalty
+    recipes)."""
+    reducer_mod.reset_reducer_stats()
+    net = _mlp((8, 8, 4))
+    dp = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    (dp(x) ** 2).mean().backward()
+    synced = np.asarray(net[2].weight.grad.numpy())
+    launched = reducer_mod.reducer_stats()["collectives_launched"]
+
+    w = net[0].weight
+    (g,) = paddle.grad((dp(x) ** 2).mean(), [w], retain_graph=False)
+    assert g is not None
+    # no new collective, and the other params' grads are untouched
+    assert reducer_mod.reducer_stats()["collectives_launched"] == launched
+    np.testing.assert_array_equal(
+        np.asarray(net[2].weight.grad.numpy()), synced)
+
+
+def test_prefetch_passes_non_numeric_leaves_through():
+    from paddle_tpu import io
+    batches = [{"x": np.ones((2, 4), np.float32), "id": "sample_%d" % i,
+                "n": 3} for i in range(3)]
+    out = list(io.prefetch_to_device(batches))
+    assert [b["id"] for b in out] == ["sample_0", "sample_1", "sample_2"]
+    assert all(b["n"] == 3 and isinstance(b["n"], int) for b in out)
+    assert all(isinstance(b["x"], jax.Array) for b in out)
+
+
+def test_nested_backward_in_hook_does_not_drain_outer_finalize():
+    """A grad hook running paddle.grad on an unrelated graph must not
+    drain the OUTER pass's queued reducer finalize mid-walk (it would
+    reduce half-filled buckets and zero already-contributed grads)."""
+    reducer_mod.reset_reducer_stats()
+    net = _mlp((8, 8, 4))
+    dp = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+
+    def nested_query(g):
+        w = paddle.to_tensor(np.ones(3, np.float32))
+        w.stop_gradient = False
+        (gw,) = paddle.grad((w * w).sum(), [w])
+        assert gw is not None
+        return None
+
+    h = net[2].weight.register_hook(nested_query)
+    (dp(x) ** 2).mean().backward()
+    h.remove()
+    stats = reducer_mod.reducer_stats()
+    assert stats["collectives_launched"] == 1        # ONE finalize, at end
+    assert stats["zero_filled_params"] == 0
+    g = np.asarray(net[2].weight.grad.numpy())
+    assert np.abs(g).sum() > 0                       # not zero-clobbered
+
+
+def test_step_from_buckets_eager_fallback_keeps_raw_values(monkeypatch):
+    """With the fused step disabled, the unbucketed eager fallback must
+    leave p.value a raw jax array (not a Tensor) and match the fused
+    result."""
+    import os
+    monkeypatch.setenv("PADDLE_TPU_FUSED_STEP", "0")
+    paddle.seed(9)
+    net = _mlp((8, 8, 4))
+    params = list(net.parameters())
+    rng = np.random.RandomState(1)
+    buckets = build_buckets(params, bucket_size_mb=1e9)
+    flats, layout = [], []
+    for b in buckets:
+        gs = [rng.randn(*p.shape).astype(np.float32) for p in b.params]
+        flats.append(jnp.concatenate([jnp.asarray(g).reshape(-1)
+                                      for g in gs]))
+        for p, off, n, shape in zip(b.params, b.offsets, b.numels,
+                                    b.shapes):
+            layout.append((p, len(flats) - 1, off, n, shape))
+    opt = paddle.optimizer.Momentum(0.1, parameters=params)
+    opt.step_from_buckets(flats, layout, scale=0.5)
+    from paddle_tpu.tensor.tensor import Tensor
+    for p in params:
+        assert not isinstance(p.value, Tensor), type(p.value)
+        assert isinstance(p.value, jax.Array)
+
+
+def test_step_from_buckets_permanent_fallback_on_trace_failure(monkeypatch):
+    paddle.seed(9)
+    net = _mlp((8, 8, 4))
+    params = list(net.parameters())
+    opt = paddle.optimizer.Momentum(0.1, parameters=params)
+
+    def boom(*a, **k):
+        raise ValueError("untraceable")
+
+    monkeypatch.setattr(opt, "_step_from_buckets_fused", boom)
+    buckets = build_buckets(params, bucket_size_mb=1e9)
+    rng = np.random.RandomState(1)
+    flats, layout = [], []
+    for b in buckets:
+        gs = [rng.randn(*p.shape).astype(np.float32) for p in b.params]
+        flats.append(jnp.concatenate([jnp.asarray(g).reshape(-1)
+                                      for g in gs]))
+        for p, off, n, shape in zip(b.params, b.offsets, b.numels,
+                                    b.shapes):
+            layout.append((p, len(flats) - 1, off, n, shape))
+    before = [np.asarray(p.numpy()) for p in params]
+    opt.step_from_buckets(flats, layout, scale=1.0)
+    assert opt._fused_supported is False       # permanent, like step()
+    for p, b0 in zip(params, before):
+        assert not np.allclose(np.asarray(p.numpy()), b0)
+
+
+def test_rewrap_detaches_previous_reducer():
+    """Re-wrapping the same layers (checkpoint reload pattern) must not
+    stack reducers — the collective sequence would double."""
+    reducer_mod.reset_reducer_stats()
+    net = _mlp((8, 8, 4))
+    dp1 = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9)
+    dp2 = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    (dp2(x) ** 2).mean().backward()
+    assert reducer_mod.reducer_stats()["collectives_launched"] == 1
+    assert dp1.reducer is not dp2.reducer
+
+
+def test_fuse_into_step_unconsumed_reduction_warns():
+    net = _mlp((8, 8, 4))
+    dp = dist.DataParallel(net, mesh=_mesh8(), bucket_size_mb=1e9,
+                           fuse_into_step=True)
+    opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    (dp(x) ** 2).mean().backward()
+    opt.step()                      # WRONG call for fuse mode — no pop
+    opt.clear_grad()
+    with pytest.warns(RuntimeWarning, match="step_fused"):
+        (dp(x) ** 2).mean().backward()

@@ -136,7 +136,11 @@ class TestStaticRNN:
             xv = rng.randn(5, 8, 2).astype("float32")
             yv = np.tanh(xv.sum(0) @ rng.randn(2, 4)).astype("float32")
             first = cur = None
-            for _ in range(60):
+            # 80 steps, not 60: the loss ratio crosses 0.5 almost exactly
+            # AT step 60 (0.5002 vs 0.5198 depending on platform rounding
+            # — ROADMAP's known marginal failure); by step 80 it is ~0.43,
+            # so the halving assertion tests convergence, not fp noise
+            for _ in range(80):
                 (lv,) = exe.run(prog, feed={"x": xv, "y": yv},
                                 fetch_list=[loss])
                 first = first if first is not None else float(lv)

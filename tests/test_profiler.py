@@ -51,3 +51,45 @@ def test_record_event_context():
         pass
     rows = profiler.stop_profiler()
     assert any(r[0] == "custom_block" for r in rows[1:])
+
+
+def test_fast_path_summary_reducer_and_prefetch_counters():
+    """fast_path_summary() carries the overlap-reducer and device-prefetch
+    counter families alongside the dispatch/fused-step ones."""
+    import jax
+    from jax.sharding import Mesh
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu import io
+
+    profiler.reset_reducer_stats()
+    profiler.reset_prefetch_stats()
+
+    net = nn.Linear(8, 4)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    dp = dist.DataParallel(net, mesh=mesh, bucket_size_mb=1e9)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    (dp(x) ** 2).mean().backward()
+
+    batches = [np.ones((4, 8), np.float32) for _ in range(3)]
+    for _ in io.prefetch_to_device(batches):
+        pass
+
+    s = profiler.fast_path_summary()
+    assert {"dispatch_cache", "fused_step", "reducer", "prefetch"} \
+        <= set(s)
+    r = s["reducer"]
+    assert r["buckets_built"] >= 1
+    assert r["collectives_launched"] == 1     # one bucket, one backward
+    assert r["finalize_launches"] + r["overlap_launches"] \
+        == r["collectives_launched"]
+    assert 0.0 <= r["overlap_ratio"] <= 1.0
+    p = s["prefetch"]
+    assert p["batches"] == 3 and p["puts"] == 3
+    assert p["hits"] + p["misses"] == p["batches"]
+
+    profiler.reset_reducer_stats()
+    profiler.reset_prefetch_stats()
+    assert profiler.reducer_stats()["collectives_launched"] == 0
+    assert profiler.prefetch_stats()["batches"] == 0

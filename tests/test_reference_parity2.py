@@ -164,11 +164,18 @@ def test_mha_dropout_on_attention_weights():
     out_eval2 = mha(x).numpy()
     np.testing.assert_allclose(base, out_eval2)   # eval deterministic
     mha.train()
-    paddle.seed(0)
-    out_tr = mha(x).numpy()
-    assert not np.allclose(out_tr, base)
-    # post-proj dropout would leave exact zeros in the output
-    assert (np.abs(out_tr) < 1e-12).mean() < 0.5
+    # post-proj dropout(0.9) would zero ~90% of output entries on EVERY
+    # seed; attention-weight dropout zeros far fewer (a row only zeroes
+    # when every kept weight for it drops).  A single seed sits near the
+    # old 0.5 threshold (exactly 0.5 on some platforms), so average the
+    # zero-fraction over several seeds and split the two regimes at 0.75.
+    fracs = []
+    for s in range(6):
+        paddle.seed(s)
+        out_tr = mha(x).numpy()
+        assert not np.allclose(out_tr, base)
+        fracs.append((np.abs(out_tr) < 1e-12).mean())
+    assert np.mean(fracs) < 0.75, fracs
 
 
 def test_instance_norm_nhwc_matches_nchw():

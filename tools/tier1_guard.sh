@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Tier-1 regression guard: run the tier-1 suite (ROADMAP.md's verify
+# command), then FAIL if the run left the worktree dirty — tests must not
+# litter artifacts into tracked paths (the PR-1 cleanup git-rm'd ~13MB of
+# accidentally-committed test outputs; this keeps them from creeping back).
+#
+# Usage: tools/tier1_guard.sh [extra pytest args...]
+# Exit:  pytest's status, or 1 if the suite passed but dirtied the tree.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+if ! git diff --quiet || ! git diff --cached --quiet \
+        || [ -n "$(git status --porcelain)" ]; then
+    echo "tier1_guard: worktree dirty BEFORE the run — commit or stash" \
+         "first so post-run litter is attributable:" >&2
+    git status --porcelain >&2
+    exit 2
+fi
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+
+dirty=$(git status --porcelain)
+if [ -n "$dirty" ]; then
+    echo "tier1_guard: FAIL — the test run dirtied the worktree:" >&2
+    echo "$dirty" >&2
+    exit 1
+fi
+exit "$rc"
