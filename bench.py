@@ -741,6 +741,113 @@ def dp_overlap():
 
 
 # --------------------------------------------------------------------------
+# child: --faults  (kill-and-recover chaos benchmark)
+# --------------------------------------------------------------------------
+
+def faults_bench():
+    """Chaos e2e: a supervised 2-process data-parallel run has one worker
+    killed mid-step by the deterministic fault registry; the launcher
+    supervisor SIGTERMs the survivor, relaunches the group on a fresh
+    coordinator port, the workers resume from the last PUBLISHED async
+    checkpoint, and the final parameters must match an uninterrupted
+    single-process run to 1e-6 (same per-step batches on every rank make
+    the DP-averaged gradient exactly the local gradient).  Emits one
+    parsed JSON metric line with the measured time-to-recover.
+
+    Never touches the jax backend itself — workers are clean re-execed
+    interpreters — so it runs under the orchestrator or standalone
+    (``--cpu-mesh N`` recommended off-TPU).  Knobs: BENCH_FAULTS_STEPS
+    (default 8), BENCH_FAULTS_KILL_STEP (default steps//2),
+    BENCH_FAULTS_NPROCS (default 2)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from paddle_tpu.distributed.launch import supervise
+
+    steps = int(os.environ.get("BENCH_FAULTS_STEPS", 8))
+    kill_step = int(os.environ.get("BENCH_FAULTS_KILL_STEP",
+                                   max(steps // 2, 2)))
+    nprocs = int(os.environ.get("BENCH_FAULTS_NPROCS", 2))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="paddle_tpu_faults_")
+
+    def env_base():
+        from paddle_tpu.testing.env import clean_cpu_env
+        # one host device per worker: the DP transport here is the
+        # cross-PROCESS eager path, extra local devices just cost memory
+        env = clean_cpu_env(repo, device_count=1)
+        env["PADDLE_COLLECTIVE_TIMEOUT"] = \
+            os.environ.get("PADDLE_COLLECTIVE_TIMEOUT", "30")
+        env.pop("PADDLE_FAULTS", None)
+        return env
+
+    def worker_argv(tag):
+        return ["-m", "paddle_tpu.testing.recovery_worker",
+                "--ckpt", os.path.join(work, tag, "ckpt"),
+                "--out", os.path.join(work, tag, "out"),
+                "--steps", str(steps)]
+
+    try:
+        # reference: uninterrupted single-process run
+        t0 = time.perf_counter()
+        ref = supervise(worker_argv("ref"), nprocs=1, env_base=env_base())
+        ref_s = time.perf_counter() - t0
+        assert ref["rc"] == 0, f"reference run failed: {ref}"
+
+        # chaos: kill one worker mid-step on the first incarnation
+        env = env_base()
+        victim = min(1, nprocs - 1)
+        env["PADDLE_FAULTS"] = \
+            f"kill:step={kill_step},rank={victim},restart=0,code=43"
+        summary = supervise(worker_argv("chaos"), nprocs=nprocs,
+                            env_base=env, log_dir=os.path.join(work, "logs"),
+                            max_restarts=2, backoff=0.5)
+        assert summary["rc"] == 0, (
+            f"supervised run did not recover: {summary}")
+        assert summary["restarts_used"] == 1, summary
+        inc = summary["incidents"][0]
+        assert inc["rank"] == victim and inc["exit_code"] == 43, inc
+
+        out = os.path.join(work, "chaos", "out")
+        resumed = [f for f in os.listdir(out) if f.startswith("resumed_1")]
+        assert resumed, "relaunched workers never wrote resume markers"
+        with open(os.path.join(out, sorted(resumed)[0])) as f:
+            marker = json.load(f)
+        # resumed from a PUBLISHED checkpoint: at least one optimizer
+        # step survived the crash, and never past the kill point
+        assert 1 <= marker["resumed_step"] < kill_step + 1, marker
+        ttr = marker["time"] - inc["time"]
+        assert ttr > 0, (marker, inc)
+
+        ref_params = np.load(os.path.join(work, "ref", "out",
+                                          "params_rank0.npz"))
+        chaos_params = np.load(os.path.join(out, "params_rank0.npz"))
+        for k in ref_params.files:
+            np.testing.assert_allclose(chaos_params[k], ref_params[k],
+                                       atol=1e-6)
+
+        print(json.dumps({
+            "metric": "fault_recovery_time_s",
+            "value": round(ttr, 3),
+            "unit": "s",
+            "vs_baseline": round(ttr / ref_s, 4),
+            "kill_step": kill_step,
+            "resumed_step": marker["resumed_step"],
+            "steps": steps,
+            "nprocs": nprocs,
+            "restarts_used": summary["restarts_used"],
+            "incident_exit_code": inc["exit_code"],
+        }), flush=True)
+        print(f"# faults: killed rank {victim} at step {kill_step}, "
+              f"resumed from step {marker['resumed_step']}, "
+              f"time-to-recover {ttr:.2f}s (clean run {ref_s:.2f}s), "
+              f"params match to 1e-6", file=sys.stderr)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
 # parent: orchestrator — never touches the jax backend
 # --------------------------------------------------------------------------
 
@@ -888,7 +995,7 @@ def _reexec_cpu_mesh():
     try:
         n = int(sys.argv[sys.argv.index("--cpu-mesh") + 1])
     except (IndexError, ValueError):
-        sys.exit("usage: bench.py [--dp-overlap] --cpu-mesh N  "
+        sys.exit("usage: bench.py [--dp-overlap|--faults] --cpu-mesh N  "
                  "(N = forced host-platform device count)")
     env = dict(os.environ)
     env["BENCH_CPU_MESH_CHILD"] = "1"
@@ -896,7 +1003,9 @@ def _reexec_cpu_mesh():
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + f" --xla_force_host_platform_device_count={n}"
                         ).strip()
-    # drop only the sitecustomize entry; keep any other PYTHONPATH deps
+    # drop only the sitecustomize entry; keep any other PYTHONPATH deps.
+    # (private copy of paddle_tpu.testing.env.clean_cpu_env: this runs
+    # BEFORE paddle_tpu is importable — keep the two in sync)
     repo = os.path.dirname(os.path.abspath(__file__))
     kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
             if p and "axon_site" not in p and "sitecustomize" not in p
@@ -919,5 +1028,7 @@ if __name__ == "__main__":
         eager_micro()
     elif "--dp-overlap" in sys.argv:
         dp_overlap()
+    elif "--faults" in sys.argv:
+        faults_bench()
     else:
         sys.exit(orchestrate())

@@ -7,36 +7,124 @@ initialize the XLA backend before initialize could run.  Both entry
 points route here: ``paddle_tpu/__init__`` (fires when the launcher env
 is present, before the package touches jax) and
 ``distributed.parallel.init_parallel_env`` (direct callers).
+
+Connection failures are RETRIED with exponential backoff: a worker
+relaunched by the supervisor (or simply racing a slow coordinator) sees
+connection-refused/deadline errors that resolve once the coordinator is
+up, so only a bounded window of them — ``PADDLE_BOOTSTRAP_TIMEOUT``
+seconds, default 120 — should be fatal.  Genuine misconfiguration (XLA
+backend already initialized) raises immediately with the actionable
+message.
 """
 from __future__ import annotations
 
 import os
+import sys
+import time
 
 _done = [False]
+
+# bootstrap counters, surfaced through profiler.fast_path_summary()
+_bootstrap_stats = {"bootstrap_retries": 0}
+
+
+def bootstrap_stats():
+    return dict(_bootstrap_stats)
+
+
+def _transient(err):
+    """Connection-shaped failures a slow/restarting coordinator emits.
+
+    Deliberately broader than collective._is_transient: at BOOTSTRAP a
+    deadline/barrier expiry usually means peers have not arrived yet and
+    IS worth retrying, whereas mid-training the collectives treat
+    deadlines as watchdog events (CollectiveTimeout), never retries —
+    keep the policy difference in mind when touching either list."""
+    msg = str(err).lower()
+    return any(s in msg for s in (
+        "connection refused", "failed to connect", "connect failed",
+        "deadline exceeded", "timed out", "timeout", "unavailable",
+        "connection reset", "broken pipe", "barrier"))
 
 
 def maybe_init_distributed():
     """Connect to the coordinator iff the launcher env asks for it.
-    Idempotent.  Raises with an actionable message if called after XLA
-    backends were already initialized."""
+    Idempotent.  Retries transient connection failures with exponential
+    backoff until PADDLE_BOOTSTRAP_TIMEOUT (default 120s) elapses, then
+    raises with the last error; raises immediately (actionable message)
+    if called after XLA backends were already initialized."""
     if _done[0]:
         return
-    _done[0] = True
     master = os.environ.get("PADDLE_MASTER")
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if not master or nprocs <= 1:
+        _done[0] = True
         return
     import jax
-    try:
-        jax.distributed.initialize(
-            coordinator_address=master,
-            num_processes=nprocs,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
-    except RuntimeError as e:
-        raise RuntimeError(
-            "paddle_tpu multi-host bootstrap failed: jax.distributed."
-            "initialize must run before any XLA backend use.  Launch "
-            "through `python -m paddle_tpu.distributed.launch` (which "
-            "re-execs the script into a clean interpreter), or set "
-            "PADDLE_MASTER/PADDLE_TRAINERS_NUM/PADDLE_TRAINER_ID before "
-            "importing paddle_tpu.") from e
+    timeout_s = float(os.environ.get("PADDLE_BOOTSTRAP_TIMEOUT", "120"))
+    delay = float(os.environ.get("PADDLE_BOOTSTRAP_BACKOFF", "1.0"))
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while True:
+        try:
+            from jax._src import distributed
+            if distributed.global_state.client is not None:
+                _done[0] = True
+                return                     # a prior attempt got through
+        except Exception:                                  # noqa: BLE001
+            pass
+        # bound each attempt so the retry loop owns the clock: jax's own
+        # initialization_timeout defaults to 300s, past our whole budget
+        attempt_budget = max(int(min(30.0, deadline - time.monotonic())), 3)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=master,
+                num_processes=nprocs,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                initialization_timeout=attempt_budget)
+            # latch ONLY on success: a raised bootstrap (timeout, bad
+            # config) must stay retryable — latching on entry would make
+            # a caught-and-retried failure silently no-op forever after,
+            # leaving a world of 1 and divergent same-host replicas
+            _done[0] = True
+            return
+        except ValueError:
+            raise                          # malformed config: never retry
+        except Exception as e:                             # noqa: BLE001
+            msg = str(e)
+            if "must be called before" in msg or "already initialized" \
+                    in msg.lower():
+                raise RuntimeError(
+                    "paddle_tpu multi-host bootstrap failed: jax."
+                    "distributed.initialize must run before any XLA "
+                    "backend use.  Launch through `python -m paddle_tpu."
+                    "distributed.launch` (which re-execs the script into "
+                    "a clean interpreter), or set PADDLE_MASTER/"
+                    "PADDLE_TRAINERS_NUM/PADDLE_TRAINER_ID before "
+                    "importing paddle_tpu.") from e
+            if not _transient(e):
+                raise RuntimeError(
+                    f"paddle_tpu multi-host bootstrap failed connecting "
+                    f"to coordinator {master}: {e}") from e
+            last = e
+            try:                           # tear down any half-open client
+                jax.distributed.shutdown()
+            except Exception:                              # noqa: BLE001
+                pass
+            if time.monotonic() + delay >= deadline:
+                raise RuntimeError(
+                    f"paddle_tpu multi-host bootstrap timed out after "
+                    f"{timeout_s:.0f}s (PADDLE_BOOTSTRAP_TIMEOUT) waiting "
+                    f"for coordinator {master} with "
+                    f"{nprocs} processes — last error: {last}.  Check "
+                    "that every rank was launched, the coordinator "
+                    "host:port is reachable, and PADDLE_TRAINERS_NUM "
+                    "matches the real world size; raise "
+                    "PADDLE_BOOTSTRAP_TIMEOUT for slow pod bring-up."
+                ) from e
+            _bootstrap_stats["bootstrap_retries"] += 1
+            print(f"# paddle_tpu bootstrap: coordinator {master} not "
+                  f"ready ({type(e).__name__}); retrying in {delay:.1f}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay)
+            delay = min(delay * 2, 15.0)

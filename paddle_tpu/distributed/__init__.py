@@ -4,7 +4,8 @@ from .parallel import (ParallelEnv, init_parallel_env, get_rank,
 from .collective import (ReduceOp, Group, new_group, get_group, barrier, wait,
                          all_reduce, reduce, all_gather, all_gather_object,
                          broadcast, scatter, alltoall, send, recv,
-                         reduce_scatter, split, collective_axis)
+                         reduce_scatter, split, collective_axis,
+                         CollectiveTimeout)
 from . import fleet
 from .data_parallel import DataParallel, DistributedDataParallel
 from . import reducer

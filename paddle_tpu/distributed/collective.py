@@ -24,6 +24,8 @@ The active axis name is provided by the surrounding parallel context
 from __future__ import annotations
 
 import contextlib
+import os
+import time
 
 import numpy as np
 import jax
@@ -31,6 +33,7 @@ import jax.numpy as jnp
 
 from ..ops.dispatch import call
 from ..tensor.tensor import Tensor
+from ..testing import faults as _faults
 
 
 class ReduceOp:
@@ -102,28 +105,178 @@ _kv_seq = [0]
 _KV_TIMEOUT_MS = 60_000
 
 
-def _kv_allgather(value):
+class CollectiveTimeout(RuntimeError):
+    """A rendezvous/transport wait expired: some rank never showed up.
+    Carries everything the operator needs to find the dead rank — the op,
+    the group, the bucket (for reducer collectives), and which ranks DID
+    contribute before the deadline."""
+
+    def __init__(self, op, timeout_ms, group=None, bucket=None,
+                 ranks_seen=None, nranks=None, detail=""):
+        self.op = op
+        self.group = group
+        self.bucket = bucket
+        self.ranks_seen = ranks_seen
+        msg = f"collective '{op}' timed out after {timeout_ms}ms"
+        if bucket is not None:
+            msg += f" (bucket {bucket})"
+        msg += f" in group {group if group is not None else 'WORLD'}"
+        if ranks_seen is not None and nranks:
+            missing = sorted(set(range(nranks)) - set(ranks_seen))
+            msg += (f"; ranks seen before the deadline: "
+                    f"{sorted(ranks_seen)} of {nranks} — missing "
+                    f"{missing}: those processes are hung or dead")
+        if detail:
+            msg += f" ({detail})"
+        msg += ("; tune PADDLE_COLLECTIVE_TIMEOUT (seconds) for slow "
+                "interconnects")
+        super().__init__(msg)
+
+
+# watchdog counters, surfaced through profiler.fast_path_summary()
+_watchdog_stats = {
+    "collective_timeouts": 0,   # waits that expired into CollectiveTimeout
+    "kv_retries": 0,            # transient KV-store op failures absorbed
+}
+
+
+def watchdog_stats():
+    return dict(_watchdog_stats)
+
+
+def reset_watchdog_stats():
+    for k in _watchdog_stats:
+        _watchdog_stats[k] = 0
+
+
+def _collective_timeout_ms():
+    """Configurable rendezvous deadline (PADDLE_COLLECTIVE_TIMEOUT,
+    seconds; default 60).  Read per call so operators and tests can tune
+    a live process."""
+    try:
+        return max(int(float(os.environ.get(
+            "PADDLE_COLLECTIVE_TIMEOUT", "60")) * 1000), 1)
+    except ValueError:
+        return _KV_TIMEOUT_MS
+
+
+def _is_deadline(err):
+    msg = str(err).lower()
+    return "deadline" in msg or "timed out" in msg or "timeout" in msg
+
+
+def _is_transient(err):
+    """Coordinator-hiccup-shaped failures worth retrying.  Anything else
+    (AttributeError on a missing client, pickling bugs, ...) is a real
+    error that retrying would only mask.  Narrower than
+    _dist_bootstrap._transient on purpose: mid-training deadlines are
+    watchdog events (CollectiveTimeout), never retries, while at
+    bootstrap a deadline just means peers have not arrived yet."""
+    if isinstance(err, _faults.InjectedFault):
+        return True
+    msg = str(err).lower()
+    return any(s in msg for s in (
+        "unavailable", "connection", "reset", "broken pipe", "aborted",
+        "internal", "try again"))
+
+
+def _kv_call(client, method, *args):
+    """One KV-store/coordination-service op with bounded retry-with-
+    backoff on transient failures (the coordinator riding a restarting
+    pod emits UNAVAILABLE-shaped errors that resolve in milliseconds).
+    Deadline expiries are NOT retried — the caller turns them into a
+    diagnosable CollectiveTimeout — and neither are non-transient
+    errors."""
+    retries = int(os.environ.get("PADDLE_KV_RETRIES", "3"))
+    delay = 0.05
+    for attempt in range(retries + 1):
+        try:
+            _faults.kv_fault(method)       # deterministic injection point
+            return getattr(client, method)(*args)
+        except Exception as e:                             # noqa: BLE001
+            if _is_deadline(e) or not _is_transient(e) \
+                    or attempt >= retries:
+                raise
+            _watchdog_stats["kv_retries"] += 1
+            time.sleep(delay)
+            delay *= 2
+
+
+def _kv_world():
+    """(client, process_count, process_index) — one seam for the
+    watchdog unit tests to stand in a fake coordination service."""
+    from jax._src import distributed
+    return distributed.global_state.client, jax.process_count(), \
+        jax.process_index()
+
+
+def _ranks_seen(client, key, n, budget_s=5.0):
+    """Post-timeout forensics: which ranks' contributions exist in the
+    store?  Direct client calls (no retry backoff) with a tiny per-key
+    deadline AND a total time budget — on a big pod the diagnosis must
+    cost seconds, not minutes; ranks not probed before the budget ran
+    out simply don't appear."""
+    seen = []
+    deadline = time.monotonic() + budget_s
+    for j in range(n):
+        if time.monotonic() > deadline:
+            break
+        try:
+            client.blocking_key_value_get(f"{key}/{j}", 200)
+            seen.append(j)
+        except Exception:                                  # noqa: BLE001
+            pass
+    return seen
+
+
+def _kv_allgather(value, op="allgather", bucket=None, group=None):
     """Host allgather over the jax.distributed coordination service's
     key-value store — no XLA collective involved, so it works on backends
     whose device collectives can't span processes (CPU).  Strictly
-    control-plane: payloads ride the coordinator, so keep them small."""
+    control-plane: payloads ride the coordinator, so keep them small.
+
+    Watchdog: every wait is bounded by PADDLE_COLLECTIVE_TIMEOUT; an
+    expired rendezvous raises CollectiveTimeout naming the op, group,
+    bucket, and the ranks whose contributions DID arrive, instead of
+    hanging the training loop forever."""
     import base64
     import pickle
-    from jax._src import distributed
-    client = distributed.global_state.client
-    n = jax.process_count()
-    me = jax.process_index()
+    client, n, me = _kv_world()
+    timeout_ms = _collective_timeout_ms()
     _kv_seq[0] += 1
     key = f"paddle_tpu_eager_ag_{_kv_seq[0]}"
+    if _faults.active():
+        _faults.collective_entry(op)       # injected straggler/vanish
     payload = base64.b64encode(
         pickle.dumps(np.asarray(value))).decode("ascii")
-    client.key_value_set(f"{key}/{me}", payload)
-    client.wait_at_barrier(f"{key}_barrier", _KV_TIMEOUT_MS)
-    rows = [pickle.loads(base64.b64decode(client.blocking_key_value_get(
-        f"{key}/{j}", _KV_TIMEOUT_MS))) for j in range(n)]
+    _kv_call(client, "key_value_set", f"{key}/{me}", payload)
+    try:
+        _kv_call(client, "wait_at_barrier", f"{key}_barrier", timeout_ms)
+        rows = [pickle.loads(base64.b64decode(_kv_call(
+            client, "blocking_key_value_get", f"{key}/{j}", timeout_ms))) for j in range(n)]
+    except Exception as e:                                 # noqa: BLE001
+        if not _is_deadline(e):
+            raise
+        _watchdog_stats["collective_timeouts"] += 1
+        raise CollectiveTimeout(
+            op, timeout_ms, group=group, bucket=bucket,
+            ranks_seen=_ranks_seen(client, key, n), nranks=n,
+            detail=str(e).splitlines()[0]) from e
     # everyone has read every row — each process reclaims its own key so
     # per-step collectives don't grow the coordinator's store unboundedly
-    client.wait_at_barrier(f"{key}_drain", _KV_TIMEOUT_MS)
+    try:
+        _kv_call(client, "wait_at_barrier", f"{key}_drain", timeout_ms)
+    except Exception as e:                                 # noqa: BLE001
+        if not _is_deadline(e):
+            raise
+        # a peer vanished AFTER contributing: the gather completed but
+        # the group is broken — same diagnosable failure, named as such
+        _watchdog_stats["collective_timeouts"] += 1
+        raise CollectiveTimeout(
+            op, timeout_ms, group=group, bucket=bucket,
+            ranks_seen=_ranks_seen(client, key, n), nranks=n,
+            detail="post-gather drain barrier: "
+                   + str(e).splitlines()[0]) from e
     try:
         client.key_value_delete(f"{key}/{me}")
     except Exception:                                      # noqa: BLE001
@@ -131,17 +284,19 @@ def _kv_allgather(value):
     return np.stack(rows)
 
 
-def _eager_rows(value):
+def _eager_rows(value, op="allgather", bucket=None, group=None):
     """Host-level cross-process allgather: every live process contributes
     its local value; returns a [process_count, ...] numpy stack."""
     from jax.experimental import multihost_utils
     try:
         return np.asarray(
             multihost_utils.process_allgather(np.asarray(value)))
+    except CollectiveTimeout:
+        raise
     except Exception:                                      # noqa: BLE001
         # e.g. "Multiprocess computations aren't implemented on the CPU
         # backend" — gather through the coordination service instead
-        return _kv_allgather(value)
+        return _kv_allgather(value, op=op, bucket=bucket, group=group)
 
 
 def _member_rows(rows, group):
@@ -195,14 +350,22 @@ def barrier(group=None):
         from jax.experimental import multihost_utils
         _barrier_counter[0] += 1
         name = f"paddle_tpu_barrier_{_barrier_counter[0]}"
+        timeout_ms = _collective_timeout_ms()
         try:
             multihost_utils.sync_global_devices(name)
         except Exception:                                  # noqa: BLE001
             # CPU backend: no cross-process device collectives — use the
-            # coordination service barrier directly
-            from jax._src import distributed
-            distributed.global_state.client.wait_at_barrier(
-                name, _KV_TIMEOUT_MS)
+            # coordination service barrier directly (watchdog-bounded)
+            client, n, _ = _kv_world()
+            try:
+                _kv_call(client, "wait_at_barrier", name, timeout_ms)
+            except Exception as e:                         # noqa: BLE001
+                if not _is_deadline(e):
+                    raise
+                _watchdog_stats["collective_timeouts"] += 1
+                raise CollectiveTimeout(
+                    "barrier", timeout_ms, group=group, nranks=n,
+                    detail=str(e).splitlines()[0]) from e
         return
     jnp.zeros(()).block_until_ready()
 
@@ -217,7 +380,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
         if _process_count() > 1:
-            member, rows = _member_rows(_eager_rows(tensor.numpy()), group)
+            member, rows = _member_rows(_eager_rows(
+                tensor.numpy(), op="all_reduce", group=group), group)
             if not member:
                 return tensor
             red = {ReduceOp.SUM: rows.sum(0), ReduceOp.MAX: rows.max(0),
@@ -254,7 +418,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     ax = _current_axis(group)
     if ax is None:
         if _process_count() > 1:
-            member, rows = _member_rows(_eager_rows(tensor.numpy()), group)
+            member, rows = _member_rows(_eager_rows(
+                tensor.numpy(), op="all_gather", group=group), group)
             if member:
                 tensor_list.extend(Tensor(r) for r in rows)
             return tensor_list
@@ -275,10 +440,11 @@ def all_gather_object(obj_list, obj, group=None):
         import pickle
         buf = np.frombuffer(pickle.dumps(obj), np.uint8)
         # two rounds: agree on the max payload size, then gather padded
-        sizes = _eager_rows(np.asarray([buf.size], np.int64))[:, 0]
+        sizes = _eager_rows(np.asarray([buf.size], np.int64),
+                            op="all_gather_object", group=group)[:, 0]
         padded = np.zeros(int(sizes.max()), np.uint8)
         padded[:buf.size] = buf
-        rows = _eager_rows(padded)
+        rows = _eager_rows(padded, op="all_gather_object", group=group)
         member, rows = _member_rows(rows, group)
         if member:
             msizes = _member_rows(sizes[:, None], group)[1][:, 0]
@@ -295,7 +461,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         if _process_count() > 1:
             # src is a GLOBAL rank (reference semantics): gather
             # unfiltered; only group MEMBERS adopt src's row
-            rows = _eager_rows(tensor.numpy())
+            rows = _eager_rows(tensor.numpy(), op="broadcast",
+                               group=group)
             if group is None or not group.ranks \
                     or len(group.ranks) >= rows.shape[0] \
                     or group.rank >= 0:
@@ -339,7 +506,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                 local = np.zeros(
                     (n_slots,) + tuple(np.asarray(tensor.numpy()).shape),
                     np.asarray(tensor.numpy()).dtype)
-            rows = _eager_rows(local)          # [nproc, n_slots, ...]
+            rows = _eager_rows(local, op="scatter",
+                               group=group)   # [nproc, n_slots, ...]
             _adopt(tensor, rows[src, me])
             return tensor
         if tensor_list:
@@ -376,7 +544,8 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
                 me = jax.process_index()
             local = np.stack([np.asarray(t.numpy())
                               for t in in_tensor_list])
-            rows = _eager_rows(local)          # [nproc, n_slots, ...]
+            rows = _eager_rows(local, op="alltoall",
+                               group=group)   # [nproc, n_slots, ...]
             member, rows = _member_rows(rows, group)
             # group-member j's slot-`me` entry is my j-th output
             out_tensor_list.extend(Tensor(rows[j, me])
@@ -471,7 +640,8 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     ax = _current_axis(group)
     if ax is None:
         if _process_count() > 1:
-            member, rows = _member_rows(_eager_rows(src.numpy()), group)
+            member, rows = _member_rows(_eager_rows(
+                src.numpy(), op="reduce_scatter", group=group), group)
             if member:
                 red = {ReduceOp.SUM: rows.sum(0),
                        ReduceOp.AVG: rows.mean(0),

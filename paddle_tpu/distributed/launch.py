@@ -2,20 +2,44 @@
 
 Single-controller SPMD: on TPU pods each HOST runs one process of the same
 script — XLA drives all local chips from one process, so the per-GPU
-process fan-out of the reference maps to a per-host fan-out here.  The
-launcher manages those processes for local testing (``--nproc-per-node``),
-wires the coordinator env (``PADDLE_MASTER`` → jax.distributed.initialize
-in init_parallel_env), waits on children, and tears the group down on the
-first failure like the reference's elastic launcher.
+process fan-out of the reference maps to a per-host fan-out here.
+
+The launcher is a SUPERVISOR (the reference's fleet elastic launcher /
+TorchElastic worker-group model): it spawns the worker group, tees each
+worker's stdout+stderr into ``--log_dir/workerN.log``, and on the first
+nonzero exit records the incident, SIGTERMs the survivors exactly once,
+and — within the ``--max-restarts`` budget, after exponential backoff —
+re-rendezvouses the WHOLE group on a fresh coordinator port with
+``PADDLE_RESTART_COUNT`` bumped so workers know their incarnation (and
+resume from their last published checkpoint).  Budget exhausted, the
+original failing exit code propagates and a machine-readable exit
+summary (JSON) names the failing rank and its log file.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
 import sys
 import time
+
+# supervision counters, surfaced through profiler.fast_path_summary()
+_launch_stats = {
+    "incidents": 0,          # worker failures observed
+    "worker_restarts": 0,    # processes re-spawned after an incident
+    "sigterms_sent": 0,      # group-teardown signals (once per survivor)
+}
+
+
+def launch_stats():
+    return dict(_launch_stats)
+
+
+def reset_launch_stats():
+    for k in _launch_stats:
+        _launch_stats[k] = 0
 
 
 def build_env(rank, nranks, master, base=None):
@@ -34,42 +58,171 @@ def _free_local_port():
         return s.getsockname()[1]
 
 
-def launch_procs(script_argv, nprocs, master, env_base=None, rank_base=0,
-                 nranks=None):
-    """Spawn nprocs copies of the script with per-rank env (global ranks
-    rank_base..rank_base+nprocs-1 of nranks total); wait; kill the group
-    on the first failure.  Returns the first nonzero exit code (0 if all
-    succeeded).  With several local workers and no master given, a free
-    local coordinator port is picked so the group really synchronizes
-    (unsynced same-host replicas would silently train divergent models)."""
+def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
+              nranks=None, log_dir=None, max_restarts=0, backoff=1.0,
+              term_grace=10.0, poll_interval=0.2):
+    """Run ``nprocs`` copies of the script under supervision (global ranks
+    rank_base..rank_base+nprocs-1 of nranks total).  Returns a summary
+    dict: ``rc`` (0, or the FIRST failing exit code of the final
+    incident), ``restarts_used``, ``incidents`` (each naming time, rank,
+    exit code, incarnation and log path), ``failed_rank``/``failed_log``
+    for the terminal failure, and per-worker ``logs``.
+
+    Restart semantics (TorchElastic worker-group model): any worker
+    failing fails the GROUP — survivors get SIGTERM exactly once, then
+    SIGKILL after ``term_grace`` — and within ``max_restarts`` the whole
+    group relaunches after ``backoff * 2**restarts_used`` seconds on a
+    FRESH coordinator port (when the port was auto-assigned; an explicit
+    ``master`` is operator-owned and reused), with PADDLE_RESTART_COUNT
+    telling workers their incarnation.  With several local workers and no
+    master given, a free local coordinator port is picked so the group
+    really synchronizes (unsynced same-host replicas would silently train
+    divergent models).
+
+    Scope: supervision is PER NODE — this process only watches the
+    workers it spawned.  In a multi-node job each node's supervisor
+    restarts independently (incarnation counters can diverge across
+    nodes, and group re-formation relies on every node's relaunch landing
+    within PADDLE_BOOTSTRAP_TIMEOUT); coordinated whole-job elasticity
+    needs an external scheduler."""
     nranks = nranks if nranks is not None else nprocs
-    if master is None and nranks > 1:
-        master = f"127.0.0.1:{_free_local_port()}"
-    procs = []
-    for i in range(nprocs):
-        env = build_env(rank_base + i, nranks, master, env_base)
-        procs.append(subprocess.Popen(
-            [sys.executable] + script_argv, env=env))
+    auto_master = master is None and nranks > 1
+    restarts_used = 0
+    incidents = []
+    log_paths = {}
+    t0 = time.time()
+
+    def spawn_group():
+        m = f"127.0.0.1:{_free_local_port()}" if auto_master else master
+        group = []
+        try:
+            _spawn_into(group, m)
+        except Exception:
+            # a mid-group failure (EMFILE, log_dir perms, ...) must not
+            # leak the workers already started — they would rendezvous
+            # forever on a coordinator that never fills, unsupervised
+            stop_group(group)
+            close_logs(group)
+            raise
+        return group
+
+    def _spawn_into(group, m):
+        for i in range(nprocs):
+            rank = rank_base + i
+            env = build_env(rank, nranks, m, env_base)
+            env["PADDLE_RESTART_COUNT"] = str(restarts_used)
+            log_f = log_path = None
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                log_path = os.path.abspath(
+                    os.path.join(log_dir, f"worker{rank}.log"))
+                # unbuffered fd + PYTHONUNBUFFERED: a killed worker's last
+                # lines (usually the diagnosis) must reach the file
+                log_f = open(log_path, "ab", buffering=0)
+                env.setdefault("PYTHONUNBUFFERED", "1")
+                log_paths[rank] = log_path
+            try:
+                proc = subprocess.Popen(
+                    [sys.executable] + script_argv, env=env,
+                    stdout=log_f,
+                    stderr=subprocess.STDOUT if log_f else None)
+            except Exception:
+                if log_f is not None:
+                    log_f.close()
+                raise
+            group.append({"rank": rank, "proc": proc,
+                          "log_f": log_f, "log_path": log_path})
+
+    def stop_group(group):
+        """Tear down survivors: SIGTERM each still-running worker exactly
+        once, then SIGKILL whatever ignored it past the grace period."""
+        for w in group:
+            if w["proc"].poll() is None:
+                w["proc"].send_signal(signal.SIGTERM)
+                _launch_stats["sigterms_sent"] += 1
+        deadline = time.time() + term_grace
+        for w in group:
+            try:
+                w["proc"].wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                w["proc"].kill()
+                w["proc"].wait()
+
+    def close_logs(group):
+        for w in group:
+            if w["log_f"] is not None and not w["log_f"].closed:
+                w["log_f"].close()
+
+    workers = spawn_group()
     rc = 0
     try:
-        remaining = set(range(nprocs))
-        while remaining:
-            for i in list(remaining):
-                r = procs[i].poll()
+        while True:
+            failed = None
+            running = 0
+            also_failed = []
+            for w in workers:
+                r = w["proc"].poll()
                 if r is None:
+                    running += 1
+                elif r != 0:
+                    if failed is None:
+                        failed = (w, r)
+                    else:
+                        # poll() can't order deaths inside one sweep —
+                        # record every failure so the root cause is
+                        # never silently dropped from the summary
+                        also_failed.append(
+                            {"rank": w["rank"], "exit_code": r})
+            if failed is not None:
+                w, r = failed
+                _launch_stats["incidents"] += 1
+                incidents.append({
+                    "time": time.time(), "rank": w["rank"],
+                    "exit_code": r, "incarnation": restarts_used,
+                    "log": w["log_path"], "also_failed": also_failed,
+                })
+                stop_group(workers)
+                close_logs(workers)
+                if restarts_used < max_restarts:
+                    delay = backoff * (2 ** restarts_used)
+                    restarts_used += 1
+                    _launch_stats["worker_restarts"] += nprocs
+                    time.sleep(delay)
+                    workers = spawn_group()   # fresh port when auto_master
                     continue
-                remaining.discard(i)
-                if r != 0 and rc == 0:
-                    rc = r
-                    for j in remaining:
-                        procs[j].send_signal(signal.SIGTERM)
-            if remaining:
-                time.sleep(0.2)
+                rc = r
+                break
+            if running == 0:
+                break
+            time.sleep(poll_interval)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return rc
+        for w in workers:
+            if w["proc"].poll() is None:
+                w["proc"].kill()
+        close_logs(workers)
+    last = incidents[-1] if rc != 0 and incidents else None
+    return {
+        "rc": rc,
+        "nprocs": nprocs,
+        "nranks": nranks,
+        "max_restarts": max_restarts,
+        "restarts_used": restarts_used,
+        "incidents": incidents,
+        "failed_rank": last["rank"] if last else None,
+        "failed_log": last["log"] if last else None,
+        "logs": dict(log_paths),
+        "duration_s": round(time.time() - t0, 3),
+    }
+
+
+def launch_procs(script_argv, nprocs, master, env_base=None, rank_base=0,
+                 nranks=None, **supervise_kwargs):
+    """Back-compat wrapper over :func:`supervise`: spawn, wait, return the
+    first nonzero exit code (0 if all succeeded; kills the group on the
+    first failure when no restart budget is given)."""
+    return supervise(script_argv, nprocs, master, env_base=env_base,
+                     rank_base=rank_base, nranks=nranks,
+                     **supervise_kwargs)["rc"]
 
 
 def main(argv=None):
@@ -89,8 +242,19 @@ def main(argv=None):
                              "sets the per-node fan-out")
     parser.add_argument("--devices", default=None)
     parser.add_argument("--log_dir", "--log-dir", default=None,
-                        dest="log_dir", help="accepted for reference "
-                        "compatibility (workers inherit stdout/stderr)")
+                        dest="log_dir",
+                        help="per-worker log directory: each worker's "
+                             "stdout+stderr tees into workerN.log")
+    parser.add_argument("--max-restarts", "--max_restarts", type=int,
+                        default=0, dest="max_restarts",
+                        help="elastic restart budget: on a worker failure "
+                             "the whole group is torn down and relaunched "
+                             "(fresh coordinator port, exponential "
+                             "backoff) up to this many times")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        dest="restart_backoff",
+                        help="base seconds of the exponential relaunch "
+                             "backoff (doubles per incident)")
     parser.add_argument("--started_port", type=int, default=None,
                         help="accepted for reference compatibility")
     parser.add_argument("script", nargs=argparse.REMAINDER)
@@ -100,6 +264,11 @@ def main(argv=None):
         parser.error("no training script given")
     if args.nnodes > 1 and not args.master:
         parser.error("--master host:port is required when --nnodes > 1")
+    if args.nnodes > 1 and args.max_restarts > 0:
+        print("paddle_tpu.launch: warning — --max-restarts supervises "
+              "THIS node only; other nodes restart on their own "
+              "schedule and incarnation counters may diverge (see "
+              "supervise() docstring)", file=sys.stderr)
 
     # Always RE-EXEC into fresh interpreters: this launcher process has
     # already imported paddle_tpu (and with it the XLA backend), so the
@@ -109,10 +278,21 @@ def main(argv=None):
     if npp == 1 and args.gpus:
         # reference behavior: one worker per listed device
         npp = len([g for g in args.gpus.split(",") if g.strip()])
-    sys.exit(launch_procs(
+    summary = supervise(
         args.script, npp, args.master,
         rank_base=args.rank * npp,
-        nranks=args.nnodes * npp))
+        nranks=args.nnodes * npp,
+        log_dir=args.log_dir,
+        max_restarts=args.max_restarts,
+        backoff=args.restart_backoff)
+    # machine-readable exit summary: one JSON line, greppable by drivers
+    print(json.dumps({"event": "paddle_tpu.launch.exit", **summary}),
+          flush=True)
+    if summary["rc"] != 0 and summary["failed_log"]:
+        print(f"paddle_tpu.launch: rank {summary['failed_rank']} failed "
+              f"with exit code {summary['rc']} — see its log: "
+              f"{summary['failed_log']}", file=sys.stderr)
+    sys.exit(summary["rc"])
 
 
 if __name__ == "__main__":

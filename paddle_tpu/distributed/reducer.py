@@ -150,8 +150,12 @@ class EagerProcessTransport:
         coll = self._coll
         if coll._process_count() <= 1:
             return flat
+        # op/bucket context rides into the watchdog: a hung bucket
+        # rendezvous raises CollectiveTimeout naming WHICH bucket and
+        # which ranks contributed, instead of blocking backward forever
         member, rows = coll._member_rows(
-            coll._eager_rows(np.asarray(flat)), self.group)
+            coll._eager_rows(np.asarray(flat), op="dp_bucket_all_reduce",
+                             bucket=tag, group=self.group), self.group)
         if not member:
             return None
         return jnp.asarray(rows.sum(0))

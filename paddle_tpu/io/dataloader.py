@@ -219,6 +219,64 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
+        # resumable iteration state (captured by CheckpointManager):
+        # epochs completed, batches handed out this epoch, and a pending
+        # skip installed by set_state_dict for the next __iter__
+        self._epoch = 0
+        self._batch_index = 0
+        self._resume_skip = 0
+        self._epoch_rng_state = None   # np RNG as of this epoch's START
+
+    # ------------------------------------------------- resumable state
+    def state_dict(self):
+        """Iteration position for crash-consistent resume: completed
+        epochs, batches already handed to the consumer this epoch, and
+        the numpy global RNG state as of the CURRENT EPOCH'S START —
+        what a shuffling sampler (RandomSampler without an explicit
+        generator) drew this epoch's permutation from, so a resumed
+        epoch re-draws the SAME order and the skip lands on the right
+        batches.  Pass the loader to ``CheckpointManager.save(...,
+        dataloader=loader)`` to capture it with the training state."""
+        rng = (self._epoch_rng_state if self._epoch_rng_state is not None
+               else np.random.get_state())
+        return {"epoch": self._epoch, "batch_index": self._batch_index,
+                "np_rng_state": rng}
+
+    def set_state_dict(self, state):
+        """Rewind to a captured position: the next ``__iter__`` skips the
+        first ``batch_index`` batches (map-style datasets skip at the
+        sampler level without fetching data; iterable datasets must
+        consume and discard) and the numpy RNG stream is restored so a
+        shuffling epoch replays the same order."""
+        self._epoch = int(state.get("epoch", 0))
+        self._resume_skip = int(state.get("batch_index", 0))
+        # reflect the restored position immediately: a state_dict taken
+        # BEFORE the next __iter__ must not report batch 0 (losing the
+        # skip and double-training the replayed batches on the next
+        # resume)
+        self._batch_index = self._resume_skip
+        # the restored stream is also this (resumed) epoch's start state:
+        # a state_dict taken before the next __iter__ must hand back the
+        # restored RNG, not a pre-restore epoch's stale capture
+        self._epoch_rng_state = state.get("np_rng_state")
+        if state.get("np_rng_state") is not None:
+            np.random.set_state(state["np_rng_state"])
+
+    def _track(self, it, skip):
+        """Count batches handed out (AFTER any device prefetch, so the
+        count is consumer truth, not prefetch depth) and roll the epoch
+        counter when the iterator drains."""
+        self._batch_index = skip
+        for batch in it:
+            self._batch_index += 1
+            yield batch
+        self._epoch += 1
+        self._batch_index = 0
+        # the epoch is over: a between-epoch state_dict must capture the
+        # CURRENT stream (next epoch draws fresh), not this epoch's start
+        # — rewinding would make the resumed epoch repeat this one's
+        # shuffle order
+        self._epoch_rng_state = None
 
     def __len__(self):
         if self._iterable_mode:
@@ -233,21 +291,26 @@ class DataLoader:
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 
-    def _iter_single(self):
+    def _iter_single(self, skip=0):
         if self._iterable_mode:
             buf = []
+            emitted = 0
             for sample in self.dataset:
                 buf.append(sample)
                 if len(buf) == self.batch_size:
-                    yield self.collate_fn(buf)
+                    emitted += 1
+                    if emitted > skip:     # resume: discard replayed ones
+                        yield self.collate_fn(buf)
                     buf = []
-            if buf and not self.drop_last:
+            if buf and not self.drop_last and emitted + 1 > skip:
                 yield self.collate_fn(buf)
             return
-        for indices in self.batch_sampler:
+        import itertools
+        for indices in itertools.islice(iter(self.batch_sampler),
+                                        skip, None):
             yield self._fetch(indices)
 
-    def _iter_threaded(self):
+    def _iter_threaded(self, skip=0):
         """Thread-pool prefetch: workers collate batches ahead of consumption
         (GIL released during numpy/jax host work).
 
@@ -258,7 +321,7 @@ class DataLoader:
         work_q: queue.Queue = queue.Queue()
         done = object()
         out_q: queue.Queue = queue.Queue()
-        batches = list(self.batch_sampler)
+        batches = list(self.batch_sampler)[skip:]
         window = self.prefetch_factor * self.num_workers
 
         def worker(wid):
@@ -342,7 +405,7 @@ class DataLoader:
             for t in threads:
                 t.join(timeout=0.1)
 
-    def _iter_native_ring(self):
+    def _iter_native_ring(self, skip=0):
         """Native staging path (ref: C++ BlockingQueue reader, paddle/fluid/
         operators/reader/blocking_queue.h): workers collate to numpy and
         gather each batch into ONE C++ pool slab (memcpy with the GIL
@@ -354,7 +417,7 @@ class DataLoader:
 
         from .. import runtime
 
-        batches = list(self.batch_sampler)
+        batches = list(self.batch_sampler)[skip:]
         ring = runtime.DataRing(
             capacity=self.prefetch_factor * self.num_workers)
         treedefs = {}
@@ -481,7 +544,7 @@ class DataLoader:
             # its one in-flight slab, not a 30s shutdown stall
             ring.destroy()
 
-    def _iter_iterable_workers(self):
+    def _iter_iterable_workers(self, skip=0):
         """Multi-worker IterableDataset: each worker thread iterates the
         dataset under its own WorkerInfo (datasets shard themselves via
         get_worker_info, reference semantics) and batches locally."""
@@ -511,6 +574,7 @@ class DataLoader:
         for t in threads:
             t.start()
         finished = 0
+        dropped = 0
         while finished < self.num_workers:
             item = out_q.get()
             if item is done:
@@ -518,13 +582,29 @@ class DataLoader:
                 continue
             if isinstance(item, Exception):
                 raise item
+            if dropped < skip:
+                dropped += 1
+                continue
             yield item
         for t in threads:
             t.join(timeout=0.1)
 
     def __iter__(self):
+        skip = self._resume_skip
+        self._resume_skip = 0
+        # position resets EAGERLY: a state_dict between iter() and the
+        # first next() must report this epoch's position (skip), not a
+        # previous abandoned epoch's batch index (_track's own reset
+        # only runs at the generator's first next())
+        self._batch_index = skip
+        # record the RNG the sampler is about to draw from: a mid-epoch
+        # state_dict must hand back THIS state (not the post-draw one) so
+        # the resumed epoch replays the same shuffled order
+        self._epoch_rng_state = np.random.get_state()
         if self.num_workers and self._iterable_mode:
-            it = self._iter_iterable_workers()
+            # worker interleaving is nondeterministic here; a resume skip
+            # drops the first `skip` produced batches, best effort
+            it = self._iter_iterable_workers(skip)
         elif self.num_workers and not self._iterable_mode:
             use_ring = self.use_native_ring
             if use_ring is None:
@@ -532,12 +612,12 @@ class DataLoader:
                 # only take the native path when the library is already built
                 from .. import runtime
                 use_ring = runtime.is_prebuilt()
-            it = (self._iter_native_ring() if use_ring
-                  else self._iter_threaded())
+            it = (self._iter_native_ring(skip) if use_ring
+                  else self._iter_threaded(skip))
         else:
-            it = self._iter_single()
+            it = self._iter_single(skip)
         if self.prefetch_to_device:
             depth = (1 if self.prefetch_to_device is True
                      else int(self.prefetch_to_device))
-            return prefetch_to_device(it, depth=depth)
-        return it
+            it = prefetch_to_device(it, depth=depth)
+        return self._track(it, skip)

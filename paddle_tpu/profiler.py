@@ -239,13 +239,40 @@ def reset_prefetch_stats():
     _dl.reset_prefetch_stats()
 
 
+def faults_stats():
+    """Fault-tolerance counter family: collective watchdog expiries and
+    absorbed KV-store retries, launcher supervision incidents/restarts,
+    checkpoint integrity events (async publishes, digest failures,
+    quarantines, restore fallbacks), bootstrap connection retries, and
+    injected-fault fires from the chaos harness
+    (paddle_tpu.testing.faults)."""
+    import importlib
+    out = {}
+    # one import per family so a single broken module can't hide every
+    # counter; "paddle_tpu.distributed.launch" is spelled out because
+    # the distributed package exports a launch() FUNCTION shadowing the
+    # submodule attribute
+    for mod, fn in (("paddle_tpu.distributed.collective", "watchdog_stats"),
+                    ("paddle_tpu.distributed.launch", "launch_stats"),
+                    ("paddle_tpu.utils.checkpoint", "checkpoint_stats"),
+                    ("paddle_tpu._dist_bootstrap", "bootstrap_stats"),
+                    ("paddle_tpu.testing.faults", "fault_stats")):
+        try:
+            out.update(getattr(importlib.import_module(mod), fn)())
+        except Exception:                                  # noqa: BLE001
+            pass
+    return out
+
+
 def fast_path_summary():
     """One dict with every fast-path counter family — what the bench.py
-    eager microbench and dp-overlap bench assert on."""
+    eager microbench and dp-overlap bench assert on — plus the ``faults``
+    family the recovery bench and chaos tests assert on."""
     out = {"dispatch_cache": dispatch_cache_stats()}
     for key, fn in (("fused_step", fused_step_stats),
                     ("reducer", reducer_stats),
-                    ("prefetch", prefetch_stats)):
+                    ("prefetch", prefetch_stats),
+                    ("faults", faults_stats)):
         try:
             out[key] = fn()
         except Exception:                                  # noqa: BLE001

@@ -1,0 +1,5 @@
+"""paddle_tpu.testing — deterministic chaos/fault-injection utilities for
+the fault-tolerant runtime (launcher supervision, collective watchdogs,
+crash-consistent checkpointing).  Import-light: nothing here touches jax,
+so workers can consult the registry before the backend exists."""
+from . import faults  # noqa: F401

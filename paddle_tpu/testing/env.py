@@ -1,0 +1,35 @@
+"""Clean child-interpreter environment for spawning CPU-backend worker
+processes (the chaos bench, the multi-process recovery tests, any script
+fanning out supervised workers on a dev box).
+
+The container's sitecustomize initializes the axon TPU backend at
+interpreter startup, so a worker that must run on the CPU backend needs
+the sitecustomize PYTHONPATH entries dropped and the host-platform
+device count forced BEFORE python starts.  This is the one shared
+implementation of that scrub — ``bench.py``'s ``_reexec_cpu_mesh`` keeps
+a private copy only because it must run before ``paddle_tpu`` (and thus
+this module) can be imported.
+
+Stdlib-only, like the rest of paddle_tpu.testing.
+"""
+from __future__ import annotations
+
+import os
+
+
+def clean_cpu_env(repo_root, device_count=1, base=None):
+    """A child env dict: repo-first PYTHONPATH with sitecustomize entries
+    dropped (other operator-provided entries kept), JAX_PLATFORMS=cpu,
+    and XLA_FLAGS rewritten to force ``device_count`` host devices
+    (foreign flags preserved)."""
+    env = dict(base if base is not None else os.environ)
+    kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p and "sitecustomize" not in p
+            and p != repo_root]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root] + kept)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={device_count}"])
+    return env

@@ -1,0 +1,202 @@
+"""Deterministic fault-injection registry (the chaos harness's control
+plane; design after TorchElastic's test fixtures and Orbax's corruption
+tests).
+
+Faults are DATA, not monkeypatches: production code calls the tiny hook
+functions below at its natural failure points, and the hooks are no-ops
+unless a fault spec was installed — programmatically via :func:`install`
+or through the ``PADDLE_FAULTS`` env var, which the launcher passes
+through to workers so a supervised multi-process scenario is reproducible
+from one string.
+
+Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
+
+    kill:step=4,rank=1,restart=0[,code=43]
+        hard-exit (os._exit) the matching rank when its training loop
+        announces step 4 of incarnation 0 — a worker dying mid-run.
+    collective_delay:nth=2[,op=all_reduce][,seconds=0.5]
+        sleep before contributing to the Nth matching collective (a slow
+        straggler; exercises watchdog margins without killing anyone).
+    collective_drop:nth=3[,op=all_reduce][,exit=41]
+        hard-exit right before contributing to the Nth matching
+        collective — peers see a vanished rank and must raise
+        CollectiveTimeout instead of hanging.
+    kv_fail:nth=2[,op=key_value_set]
+        the Nth matching KV-store/coordination-service op raises a
+        transient error (exercises the transport's retry-with-backoff).
+    ckpt_truncate:file=model.pdparams[,step=3][,publish=1]
+        truncate the matching checkpoint file to half mid-write and
+        simulate the writer crashing (save aborts, tmp dir left behind,
+        nothing published).  With ``publish=1`` the torn file IS
+        published — a non-atomic-filesystem torn write — so restore's
+        digest verify + quarantine path can be exercised end to end.
+
+Every fault fires at most once (add ``repeat=1`` to re-arm after each
+fire); ``nth`` counts only calls whose other filters matched, so the Nth
+occurrence is deterministic run to run.  ``rank``/``restart`` filters
+read ``PADDLE_TRAINER_ID``/``PADDLE_RESTART_COUNT`` at fire time, i.e.
+the identity the launcher's supervisor assigned this incarnation.
+
+Only stdlib imports: the registry must be consultable before jax (and
+paddle_tpu proper) are importable or initialized.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_registry: list[dict] = []
+_env_loaded = [False]
+
+_stats = {"faults_installed": 0, "faults_fired": 0}
+
+
+class InjectedFault(RuntimeError):
+    """Raised by hooks that simulate a recoverable (transient) failure."""
+
+
+def fault_stats():
+    return dict(_stats)
+
+
+def reset_fault_stats():
+    for k in _stats:
+        _stats[k] = 0
+
+
+# --------------------------------------------------------------- install
+def _parse_one(spec):
+    kind, _, body = spec.strip().partition(":")
+    fault = {"kind": kind.strip()}
+    if body:
+        for kv in body.split(","):
+            k, _, v = kv.partition("=")
+            fault[k.strip()] = v.strip()
+    return fault
+
+
+def install(spec):
+    """Install fault(s): a spec string (grammar above), a dict, or a list
+    of either.  Returns the installed fault dicts."""
+    if isinstance(spec, str):
+        faults = [_parse_one(s) for s in spec.split(";") if s.strip()]
+    elif isinstance(spec, dict):
+        faults = [dict(spec)]
+    else:
+        faults = [dict(s) if isinstance(s, dict) else _parse_one(s)
+                  for s in spec]
+    for f in faults:
+        f.setdefault("_matches", 0)   # calls whose filters matched
+        f.setdefault("_fired", False)
+        _registry.append(f)
+        _stats["faults_installed"] += 1
+    return faults
+
+
+def clear():
+    """Drop every installed fault (env specs included; they are NOT
+    re-read until the next interpreter)."""
+    del _registry[:]
+    _env_loaded[0] = True
+
+
+def _load_env():
+    if not _env_loaded[0]:
+        _env_loaded[0] = True
+        spec = os.environ.get("PADDLE_FAULTS")
+        if spec:
+            install(spec)
+
+
+def active():
+    _load_env()
+    return bool(_registry)
+
+
+# ----------------------------------------------------------------- match
+def _want_int(fault, key):
+    v = fault.get(key)
+    return None if v is None else int(v)
+
+
+def take(kind, step=None, op=None):
+    """The matching armed fault for this call site, or None.  A matching
+    call advances the fault's occurrence counter; the fault fires (and
+    disarms, unless ``repeat``) when the counter reaches ``nth``
+    (default 1)."""
+    _load_env()
+    if not _registry:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    for fault in _registry:
+        if fault["kind"] != kind or fault["_fired"]:
+            continue
+        if _want_int(fault, "rank") is not None \
+                and _want_int(fault, "rank") != rank:
+            continue
+        if _want_int(fault, "restart") is not None \
+                and _want_int(fault, "restart") != restart:
+            continue
+        if _want_int(fault, "step") is not None \
+                and _want_int(fault, "step") != step:
+            # a step-scoped fault never matches a call site that has no
+            # step notion (step=None) — firing "at the first occurrence"
+            # instead would silently corrupt the chaos scenario
+            continue
+        want_op = fault.get("op") or fault.get("file")
+        if want_op and want_op not in str(op or ""):
+            continue
+        fault["_matches"] += 1
+        if fault["_matches"] != (_want_int(fault, "nth") or 1):
+            continue
+        if not int(fault.get("repeat", 0)):
+            fault["_fired"] = True
+        else:
+            fault["_matches"] = 0
+        _stats["faults_fired"] += 1
+        return fault
+    return None
+
+
+# ----------------------------------------------------------------- hooks
+def kill_check(step):
+    """Training loops call this once per step; a matching ``kill`` fault
+    hard-exits the process (the supervisor sees a failed worker)."""
+    fault = take("kill", step=step)
+    if fault is not None:
+        code = int(fault.get("code", 43))
+        print(f"# faults: kill at step {step} (exit {code})",
+              file=sys.stderr, flush=True)
+        os._exit(code)
+
+
+def collective_entry(op):
+    """Called by the eager collective transport before contributing.
+    ``collective_delay`` sleeps; ``collective_drop`` hard-exits (a rank
+    vanishing mid-rendezvous)."""
+    fault = take("collective_delay", op=op)
+    if fault is not None:
+        time.sleep(float(fault.get("seconds", 0.5)))
+    fault = take("collective_drop", op=op)
+    if fault is not None:
+        code = int(fault.get("exit", 41))
+        print(f"# faults: dropping collective '{op}' (exit {code})",
+              file=sys.stderr, flush=True)
+        os._exit(code)
+
+
+def kv_fault(op):
+    """Called per KV-store op; a matching ``kv_fail`` raises a transient
+    InjectedFault the transport's retry loop must absorb."""
+    fault = take("kv_fail", op=op)
+    if fault is not None:
+        raise InjectedFault(f"injected transient kv failure on '{op}'")
+
+
+def checkpoint_truncate(step, file):
+    """The ``ckpt_truncate`` fault spec matching this save, or None.  The
+    checkpoint writer truncates the file and (unless ``publish=1``)
+    simulates the writer crashing before the atomic rename."""
+    return take("ckpt_truncate", step=step, op=file)

@@ -1,0 +1,94 @@
+"""Deterministic training worker for the fault-tolerance e2e harness
+(bench.py --faults, tools/chaos_smoke.sh, tests/test_fault_tolerance.py).
+
+Runs a tiny fixed-seed regression under the supervised launcher:
+
+* multi-process groups train data-parallel through the bucketed reducer's
+  eager cross-process transport, with every rank fed the SAME per-step
+  batch (derived from the step index) — the averaged gradient then equals
+  the local gradient bit-for-bit, so an uninterrupted single-process run
+  is an exact parity reference for the recovered run;
+* rank 0 checkpoints every step through the async CheckpointManager;
+* each step announces itself to the fault registry
+  (``faults.kill_check``), so a ``PADDLE_FAULTS=kill:step=K,...`` spec
+  makes a worker die mid-run exactly once;
+* on relaunch (PADDLE_RESTART_COUNT > 0) every rank restores from the
+  last PUBLISHED checkpoint and writes a ``resumed_<incarnation>``
+  marker (wall-clock + resumed step) the harness uses to measure
+  time-to-recover;
+* at the end each rank dumps its parameters to ``params_rank<r>.npz``.
+
+Usage (always under the launcher, which sets the PADDLE_* env):
+    python -m paddle_tpu.testing.recovery_worker \
+        --ckpt DIR --out DIR --steps N [--width W] [--lr LR]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.testing.recovery_worker")
+    parser.add_argument("--ckpt", required=True,
+                        help="shared checkpoint directory")
+    parser.add_argument("--out", required=True,
+                        help="output directory (markers + final params)")
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--width", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--sync-ckpt", action="store_true",
+                        help="blocking saves (default: async)")
+    args = parser.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu as paddle            # bootstraps jax.distributed
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.testing import faults
+    from paddle_tpu.utils import CheckpointManager
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    os.makedirs(args.out, exist_ok=True)
+
+    paddle.seed(1234)                      # identical init on every rank
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(args.width, args.width), paddle.nn.Tanh(),
+        paddle.nn.Linear(args.width, 4))
+    opt = paddle.optimizer.Momentum(args.lr, parameters=net.parameters())
+    model = dist.DataParallel(net) if nprocs > 1 else net
+
+    mgr = CheckpointManager(args.ckpt, keep=2,
+                            async_save=not args.sync_ckpt)
+    start = mgr.restore(model=net, optimizer=opt) or 0
+    if restart > 0:
+        with open(os.path.join(args.out, f"resumed_{restart}_r{rank}"),
+                  "w") as f:
+            json.dump({"time": time.time(), "resumed_step": start,
+                       "rank": rank}, f)
+
+    for step in range(start + 1, args.steps + 1):
+        faults.kill_check(step)            # chaos: die here if told to
+        rng = np.random.RandomState(9000 + step)   # same data, every rank
+        x = paddle.to_tensor(rng.randn(8, args.width).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if rank == 0:
+            mgr.save(step, model=net, optimizer=opt)
+    mgr.wait()                             # all checkpoints published
+
+    np.savez(os.path.join(args.out, f"params_rank{rank}.npz"),
+             **{f"p{i}": np.asarray(p.numpy())
+                for i, p in enumerate(net.parameters())})
+    with open(os.path.join(args.out, f"done_r{rank}"), "w") as f:
+        f.write(str(time.time()))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,16 +2,42 @@
 
 TPU-native analogue of the reference's fleet checkpoint/auto-recovery path
 (ref: python/paddle/distributed/fleet/utils/fs.py +
-incubate/checkpoint/auto_checkpoint.py): one directory per step holding
-model + optimizer + LR-scheduler + RNG + step counter, written atomically
-(tmp dir + rename) so a preempted write can never be mistaken for a valid
-checkpoint, with keep-last-k retention and latest-step discovery on resume.
+incubate/checkpoint/auto_checkpoint.py), with the Orbax-style async,
+crash-consistent write discipline: one directory per step holding model +
+optimizer + LR-scheduler + RNG + step counter (+ optional DataLoader
+iteration state), written atomically (tmp dir + rename) so a preempted
+write can never be mistaken for a valid checkpoint, with keep-last-k
+retention and latest-step discovery on resume.
+
+Fault tolerance additions:
+
+* **Async saves** (``async_save=True``): ``save()`` snapshots the state
+  on-device — an ASYNC device-to-device copy, not a bare reference,
+  because the donated fused optimizer step deletes the original buffers
+  on the next update — and returns without a host sync; a single
+  background worker thread materializes to host memory, serializes,
+  digests and atomically publishes, strictly in save order.  ``wait()``
+  drains pending saves and raises on every background failure.
+* **Integrity digests**: every file's SHA-256 is written to
+  ``digests.json`` inside the step dir at save time and verified at
+  restore — a torn write on a non-atomic filesystem (or plain disk rot)
+  is detected instead of deserialized into garbage.
+* **Quarantine-and-fall-back**: a step dir that fails digest verification
+  (or fails to load) is renamed to ``step_N.corrupt`` and restore falls
+  back to the previous checkpoint in publish order, warning loudly.
 """
 from __future__ import annotations
 
+import copy
+import hashlib
+import json
 import os
+import queue
 import re
 import shutil
+import threading
+import time
+import warnings
 
 import numpy as np
 
@@ -20,21 +46,134 @@ from ..framework import core
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 _SEQ_FILE = "save_seq"    # monotonic publish-order counter (one int)
+_DIGEST_FILE = "digests.json"
+
+# fault-tolerance counters, surfaced through profiler.fast_path_summary()
+_ckpt_stats = {
+    "async_saves": 0,            # background (non-blocking) publishes
+    "sync_saves": 0,
+    "digest_failures": 0,        # files whose content hash mismatched
+    "checkpoints_quarantined": 0,  # dirs renamed to step_N.corrupt
+    "restore_fallbacks": 0,      # restores that fell back a checkpoint
+}
+
+
+def checkpoint_stats():
+    return dict(_ckpt_stats)
+
+
+def reset_checkpoint_stats():
+    for k in _ckpt_stats:
+        _ckpt_stats[k] = 0
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _device_snapshot(x):
+    """Donation-safe on-device capture of one array.  A bare reference is
+    NOT enough: the fused optimizer step (PR 1) donates param/moment
+    buffers into the next update, which DELETES the referenced arrays
+    before the background writer reads them.  jnp.copy dispatches an
+    async device-to-device copy — the snapshot detaches from the
+    donation lifecycle without blocking the training thread (the copy
+    overlaps like any other async dispatch).  The D2H fetch still
+    happens in :func:`_materialize`, on the writer."""
+    import jax
+    import jax.numpy as jnp
+    if isinstance(x, jax.Array):
+        try:
+            return jnp.copy(x)
+        except Exception as e:                             # noqa: BLE001
+            try:
+                return np.asarray(x)   # odd array type: host copy
+            except Exception:                              # noqa: BLE001
+                raise RuntimeError(
+                    "cannot snapshot checkpoint array (already deleted "
+                    "by a donated optimizer step? checkpoint BEFORE the "
+                    f"next opt.step()): {e}") from e
+    if isinstance(x, np.ndarray):
+        return x.copy()        # host buffers mutate in place (running
+    #                            stats): the snapshot must not alias them
+    if isinstance(x, (str, bytes, int, float, bool, complex,
+                      type(None))):
+        return x               # immutable: safe by reference
+    try:
+        return copy.deepcopy(x)    # arbitrary mutable python state
+    except Exception:                                      # noqa: BLE001
+        return x               # uncopyable exotic object: best effort
+
+
+def _snapshot_storable(obj, detach):
+    """Like io.serialization._to_storable but keeps the capture ON
+    DEVICE instead of fetching to host on the training thread.
+    ``detach`` (async saves only) decouples each array via
+    _device_snapshot — blocking saves write before any donation can
+    occur, so they pass bare references and skip the D2D copy's
+    transient memory cost."""
+    from ..tensor.tensor import Tensor, Parameter
+    grab = _device_snapshot if detach else (lambda x: x)
+    if isinstance(obj, Parameter):
+        return {"__param__": grab(obj.value), "name": obj.name,
+                "trainable": obj.trainable}
+    if isinstance(obj, Tensor):
+        return {"__tensor__": grab(obj.value), "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _snapshot_storable(v, detach) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_snapshot_storable(v, detach) for v in obj)
+    return grab(obj)
+
+
+def _materialize(obj):
+    """Resolve on-device snapshot leaves to host numpy (the only blocking
+    device fetch of a save, and it runs on the writer thread)."""
+    import jax
+    if isinstance(obj, jax.Array):
+        return np.asarray(jax.device_get(obj))
+    if isinstance(obj, dict):
+        return {k: _materialize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_materialize(v) for v in obj)
+    return obj
+
+
+class _InjectedCheckpointCrash(RuntimeError):
+    """The ckpt_truncate fault's simulated writer crash (testing only)."""
+
+
+class _MissingComponent(RuntimeError):
+    """restore() asked for a component the checkpoint never contained —
+    a usage error that must NOT trigger quarantine (a file that was
+    saved but is missing fails digest verification instead)."""
 
 
 class CheckpointManager:
     """Save/restore full training state.
 
-    >>> mgr = CheckpointManager("ckpts", keep=3)
+    >>> mgr = CheckpointManager("ckpts", keep=3, async_save=True)
     >>> mgr.save(step, model=net, optimizer=opt, scheduler=sched)
+    >>> mgr.wait()                       # drain pending background saves
     >>> step = mgr.restore(model=net, optimizer=opt, scheduler=sched)
     """
 
-    def __init__(self, root, keep=3):
+    def __init__(self, root, keep=3, async_save=False):
         self.root = root
         self.keep = keep
+        self.async_save = bool(async_save)
         self.last_extra = None
         os.makedirs(root, exist_ok=True)
+        self._work: queue.Queue = queue.Queue()
+        self._worker = None
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._errors = []
+        self._seq = None               # monotonic; assigned at enqueue
 
     # ------------------------------------------------------------ helpers
     def _step_dirs(self):
@@ -53,10 +192,16 @@ class CheckpointManager:
             return None
 
     def _next_seq(self):
+        """Monotonic publish-order counter: the max of the cached counter
+        (covers queued async saves not yet on disk) and the on-disk max
+        (covers other manager instances writing the same root), plus
+        one."""
         seqs = [s for s in (self._read_seq(p)
                             for _, p in self._step_dirs())
                 if s is not None]
-        return (max(seqs) + 1) if seqs else 1
+        disk = max(seqs) if seqs else 0
+        self._seq = max(self._seq or 0, disk) + 1
+        return self._seq
 
     def _dirs_by_save_order(self):
         """Step dirs ordered by when they were SAVED — an explicit
@@ -74,36 +219,170 @@ class CheckpointManager:
         return sorted(self._step_dirs(), key=key)
 
     def latest_step(self):
+        self.wait(raise_errors=False)
         dirs = self._dirs_by_save_order()
         return dirs[-1][0] if dirs else None
 
     # ------------------------------------------------------------ save
+    def _snapshot(self, model, optimizer, scheduler, detach):
+        """Point-in-time capture: state dicts converted to storable
+        form.  ``detach=True`` (async saves) decouples device arrays
+        with an async D2D copy so the donated fused optimizer step
+        cannot delete them under the background writer; blocking saves
+        skip the copy."""
+        payload = {}
+        if model is not None:
+            payload["model.pdparams"] = _snapshot_storable(
+                model.state_dict(), detach)
+        if optimizer is not None:
+            payload["opt.pdopt"] = _snapshot_storable(
+                optimizer.state_dict(), detach)
+        if scheduler is not None:
+            payload["lr.pdstate"] = _snapshot_storable(
+                scheduler.state_dict(), detach)
+        return payload
+
     def save(self, step, model=None, optimizer=None, scheduler=None,
-             extra=None):
+             extra=None, dataloader=None, blocking=None):
+        """Checkpoint the passed objects at ``step``.  With
+        ``async_save`` (or ``blocking=False``) the state is snapshotted
+        NOW and written/published by the background worker; the returned
+        path exists only after the publish (``wait()`` to be sure)."""
+        if blocking is None:
+            blocking = not self.async_save
         final = os.path.join(self.root, f"step_{step}")
+        seq = self._next_seq()
+        state = {"step": int(step), "seq": seq,
+                 "rng_state": core.default_generator().get_state()}
+        if extra is not None:
+            # async saves must capture extra's VALUE now — the caller
+            # keeps mutating its live metrics dict while the background
+            # writer serializes, and a point-in-time checkpoint must not
+            # absorb a later step's bookkeeping
+            state["extra"] = copy.deepcopy(extra) if not blocking else extra
+        if dataloader is not None:
+            state["dataloader"] = dataloader.state_dict()
+        payload = self._snapshot(model, optimizer, scheduler,
+                                 detach=not blocking)
+        if blocking:
+            self.wait()          # publish order: drain queued async saves
+            _ckpt_stats["sync_saves"] += 1
+            self._write(final, seq, state, payload)
+            return final
+        with self._lock:
+            self._pending += 1
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, name="ckpt-writer", daemon=True)
+                self._worker.start()
+        self._work.put((final, seq, state, payload))
+        return final
+
+    def _drain(self):
+        while True:
+            try:
+                item = self._work.get(timeout=0.5)
+            except queue.Empty:
+                # retire only with no work pending: _pending and _worker
+                # share the lock, so a save() that just incremented
+                # pending either sees this thread alive or starts a new
+                # one — a queued item can never be orphaned
+                with self._lock:
+                    if self._pending == 0:
+                        self._worker = None
+                        return
+                continue
+            final, seq, state, payload = item
+            try:
+                self._write(final, seq, state, payload)
+                _ckpt_stats["async_saves"] += 1
+            except Exception as e:                         # noqa: BLE001
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+
+    def _write(self, final, seq, state, payload):
+        """Serialize + digest + atomically publish one checkpoint.  Runs
+        on the caller (blocking) or the background worker (async)."""
+        from ..testing import faults as _faults
         tmp = final + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        seq = self._next_seq()
         with open(os.path.join(tmp, _SEQ_FILE), "w") as f:
             f.write(str(seq))
-        state = {"step": int(step), "seq": seq,
-                 "rng_state": core.default_generator().get_state()}
-        if extra is not None:
-            state["extra"] = extra
-        if model is not None:
-            _save(model.state_dict(), os.path.join(tmp, "model.pdparams"))
-        if optimizer is not None:
-            _save(optimizer.state_dict(), os.path.join(tmp, "opt.pdopt"))
-        if scheduler is not None:
-            _save(scheduler.state_dict(), os.path.join(tmp, "lr.pdstate"))
-        _save(state, os.path.join(tmp, "meta.pdstate"))
+        # digests are taken as each file lands, BEFORE any injected
+        # truncation: the recorded hash is of the intended content, so a
+        # torn write (real or injected) mismatches at verify time
+        digests = {_SEQ_FILE: _sha256_file(os.path.join(tmp, _SEQ_FILE))}
+        crash = None
+        for name, obj in payload.items():
+            path = os.path.join(tmp, name)
+            _save(_materialize(obj), path)
+            digests[name] = _sha256_file(path)
+            fault = _faults.checkpoint_truncate(state["step"], name) \
+                if _faults.active() else None
+            if fault is not None:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(max(size // 2, 1))
+                if not int(fault.get("publish", 0)):
+                    crash = _InjectedCheckpointCrash(
+                        f"injected writer crash truncating {name} at "
+                        f"step {state['step']}")
+        meta_path = os.path.join(tmp, "meta.pdstate")
+        _save(state, meta_path)
+        digests["meta.pdstate"] = _sha256_file(meta_path)
+        if crash is not None:
+            raise crash          # tmp dir left behind, nothing published
+        with open(os.path.join(tmp, _DIGEST_FILE), "w") as f:
+            json.dump(digests, f)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)          # atomic publish
         self._retain()
-        return final
+
+    def wait(self, raise_errors=True):
+        """Block until every queued async save has published.  Background
+        save failures since the last drain are all reported, never
+        silently dropped: raised (a single one as itself, several as one
+        summarizing error) — or, with ``raise_errors=False`` (the
+        restore/latest_step drain, which must not let an unrelated failed
+        SAVE block an explicit rollback), surfaced as warnings."""
+        while True:
+            with self._lock:
+                if self._pending == 0:
+                    break
+            time.sleep(0.01)
+        if not raise_errors:
+            # read-only drain (latest_step/restore): warn once per error
+            # but KEEP them queued — a later explicit wait() must still
+            # raise, or the caller is told every save succeeded
+            with self._lock:
+                errs = self._errors[:]
+            for e in errs:
+                if not getattr(e, "_ckpt_warned", False):
+                    e._ckpt_warned = True
+                    warnings.warn(
+                        f"background checkpoint save failed: "
+                        f"{type(e).__name__}: {e}", RuntimeWarning,
+                        stacklevel=2)
+            return
+        with self._lock:
+            errs, self._errors = self._errors[:], []
+        if not errs:
+            return
+        if len(errs) == 1:
+            raise errs[0]
+        raise RuntimeError(
+            f"{len(errs)} background checkpoint saves failed: "
+            + "; ".join(f"{type(e).__name__}: {e}" for e in errs)
+        ) from errs[0]
+
+    # reference-style alias (Orbax: wait_until_finished)
+    wait_until_finished = wait
 
     def _retain(self):
         dirs = self._dirs_by_save_order()
@@ -111,22 +390,194 @@ class CheckpointManager:
             shutil.rmtree(path, ignore_errors=True)
 
     # ------------------------------------------------------------ restore
-    def restore(self, model=None, optimizer=None, scheduler=None, step=None):
+    def _read_digests(self, path):
+        """The digests recorded at save time; {} for legacy dirs (nothing
+        recorded to verify against)."""
+        dpath = os.path.join(path, _DIGEST_FILE)
+        if not os.path.exists(dpath):
+            return {}
+        with open(dpath) as f:
+            return json.load(f)
+
+    def _check_digest_file(self, fpath, want):
+        if not os.path.exists(fpath):
+            _ckpt_stats["digest_failures"] += 1
+            raise IOError(f"checkpoint file missing: {fpath}")
+        got = _sha256_file(fpath)
+        if got != want:
+            _ckpt_stats["digest_failures"] += 1
+            raise IOError(
+                f"checkpoint digest mismatch for {fpath}: "
+                f"recorded {want[:12]}…, on disk {got[:12]}… — "
+                "truncated or corrupted write")
+
+    def _load_verified(self, fpath, want):
+        """Read once: hash the bytes against the recorded digest (when
+        one exists) and deserialize from the same buffer — restore I/O
+        is paid once per file, not once for verify plus once for load."""
+        import pickle
+        from ..io.serialization import _from_storable
+        with open(fpath, "rb") as f:
+            data = f.read()
+        if want is not None:
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                _ckpt_stats["digest_failures"] += 1
+                raise IOError(
+                    f"checkpoint digest mismatch for {fpath}: "
+                    f"recorded {want[:12]}…, on disk {got[:12]}… — "
+                    "truncated or corrupted write")
+        return _from_storable(pickle.loads(data))
+
+    def verify(self, path):
+        """Digest-check every file recorded at save time.  Raises on the
+        first mismatch/missing file.  Legacy dirs (no digests.json) pass:
+        there is nothing recorded to verify against."""
+        for name, want in self._read_digests(path).items():
+            self._check_digest_file(os.path.join(path, name), want)
+
+    def _quarantine(self, path):
+        dst = path + ".corrupt"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{path}.corrupt{n}"
+        try:
+            os.rename(path, dst)
+        except OSError:
+            if os.path.exists(path):
+                raise
+            # several ranks restore the same shared root: a peer already
+            # moved this dir aside — same outcome, continue the fallback
+            return path + ".corrupt"
+        _ckpt_stats["checkpoints_quarantined"] += 1
+        return dst
+
+    def restore(self, model=None, optimizer=None, scheduler=None, step=None,
+                dataloader=None):
         """Load the given (or latest) step into the passed objects; returns
-        the restored step counter, or None when no checkpoint exists."""
-        if step is None:
-            step = self.latest_step()
+        the restored step counter, or None when no checkpoint exists.
+
+        Crash-consistent: digests are verified and every file is loaded
+        into memory BEFORE anything is applied to the passed objects — a
+        corrupt dir can never leave the model half-restored.  A corrupt
+        step dir is quarantined (renamed ``step_N.corrupt``) with a
+        warning and restore falls back to the previous checkpoint in
+        publish order."""
+        self.wait(raise_errors=False)
+        requested = orig_requested = step
+        # when an explicitly requested step turns out corrupt, "previous"
+        # means EARLIER IN PUBLISH ORDER than the requested dir — never a
+        # newer checkpoint the operator was rolling back from.  The
+        # candidate list is captured positionally at the first failure,
+        # so it stays correct even when the corrupt dir's own save_seq
+        # file is the unreadable one.
+        fallback = None     # steps older than the requested, oldest-first
+        while True:
             if step is None:
-                return None
-        path = os.path.join(self.root, f"step_{step}")
-        meta = _load(os.path.join(path, "meta.pdstate"))
-        if model is not None:
-            model.set_state_dict(_load(os.path.join(path, "model.pdparams")))
-        if optimizer is not None:
-            optimizer.set_state_dict(_load(os.path.join(path, "opt.pdopt")))
-        if scheduler is not None:
-            scheduler.set_state_dict(_load(os.path.join(path, "lr.pdstate")))
-        # restore the deterministic RNG stream position exactly
-        core.default_generator().set_state(meta["rng_state"])
-        self.last_extra = meta.get("extra")
-        return meta["step"]
+                if fallback is not None:
+                    if not fallback:
+                        # the EXPLICITLY requested step was corrupt and
+                        # nothing older exists: returning None here would
+                        # be indistinguishable from "no checkpoints",
+                        # sending the caller into its cold-start branch
+                        # over the run it was trying to rescue
+                        raise RuntimeError(
+                            f"requested checkpoint step_{orig_requested} "
+                            "failed verification (quarantined) and no "
+                            "earlier checkpoint exists to fall back to")
+                    step = fallback.pop()
+                else:
+                    dirs = self._dirs_by_save_order()
+                    if not dirs:
+                        return None
+                    step = dirs[-1][0]
+            path = os.path.join(self.root, f"step_{step}")
+            if not os.path.isdir(path):
+                if requested is not None:
+                    # a typo'd/reaped explicit step is a clean error,
+                    # not a quarantine candidate
+                    raise FileNotFoundError(
+                        f"no checkpoint directory {path}; available "
+                        f"steps: {[s for s, _ in self._step_dirs()]}")
+                # auto/fallback candidate vanished under us (peer rank
+                # quarantined or retention reaped it): try the next one
+                step = None
+                continue
+            try:
+                digests = self._read_digests(path)
+                components = [("meta.pdstate", True),
+                              ("model.pdparams", model),
+                              ("opt.pdopt", optimizer),
+                              ("lr.pdstate", scheduler)]
+                loading = {n for n, obj in components if obj is not None}
+                # files recorded at save time but NOT loaded below (the
+                # seq file, components the caller skips) still get their
+                # integrity check; loaded files are hashed from the same
+                # read that deserializes them — one read per file total
+                for name, want in digests.items():
+                    if name not in loading:
+                        self._check_digest_file(
+                            os.path.join(path, name), want)
+                loaded = {}
+                for name, obj in components:
+                    if obj is None:
+                        continue
+                    fpath = os.path.join(path, name)
+                    if not os.path.exists(fpath):
+                        if name in digests:     # saved, then lost: corrupt
+                            _ckpt_stats["digest_failures"] += 1
+                            raise IOError(
+                                f"checkpoint file missing: {fpath}")
+                        # a component this checkpoint NEVER contained
+                        # (saved model-only, restored with optimizer=)
+                        # is a usage error, not corruption: quarantining
+                        # would cascade through every valid checkpoint
+                        raise _MissingComponent(
+                            f"checkpoint step_{step} was saved without "
+                            f"{name}; restore only the components it "
+                            "contains")
+                    loaded[name] = self._load_verified(
+                        fpath, digests.get(name))
+                meta = loaded.pop("meta.pdstate")
+            except _MissingComponent as e:
+                raise FileNotFoundError(str(e)) from None
+            except Exception as e:                         # noqa: BLE001
+                if requested is not None and step == requested:
+                    # capture the older-than-requested candidates while
+                    # the failing dir is still listed (pre-quarantine)
+                    order = self._dirs_by_save_order()
+                    if self._read_seq(path) is not None:
+                        idx = next((i for i, (s, _) in enumerate(order)
+                                    if s == step), len(order))
+                        fallback = [s for s, _ in order[:idx]]
+                    else:
+                        # the corrupt dir's own save_seq is unreadable:
+                        # publish order is unknowable, so "previous"
+                        # falls back to step NUMBERS below the request
+                        # (the operator's rollback intent), kept in
+                        # publish order among themselves
+                        fallback = [s for s, _ in order if s < step]
+                    requested = None
+                quarantined = self._quarantine(path)
+                _ckpt_stats["restore_fallbacks"] += 1
+                warnings.warn(
+                    f"checkpoint step_{step} failed verification "
+                    f"({type(e).__name__}: {e}); quarantined to "
+                    f"{quarantined} and falling back to the previous "
+                    "valid checkpoint", RuntimeWarning, stacklevel=2)
+                step = None
+                continue
+            # verified and fully in memory: now (and only now) apply
+            if model is not None:
+                model.set_state_dict(loaded["model.pdparams"])
+            if optimizer is not None:
+                optimizer.set_state_dict(loaded["opt.pdopt"])
+            if scheduler is not None:
+                scheduler.set_state_dict(loaded["lr.pdstate"])
+            if dataloader is not None and meta.get("dataloader"):
+                dataloader.set_state_dict(meta["dataloader"])
+            # restore the deterministic RNG stream position exactly
+            core.default_generator().set_state(meta["rng_state"])
+            self.last_extra = meta.get("extra")
+            return meta["step"]

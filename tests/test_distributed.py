@@ -264,7 +264,7 @@ class TestEagerSubsetAlltoall:
     def _world(self, monkeypatch, my_proc, group_ranks, nproc=4):
         from paddle_tpu.distributed import collective as C
 
-        def fake_eager_rows(local):
+        def fake_eager_rows(local, **kw):
             # every process contributes rank-tagged payloads; OUR process
             # contributes exactly what the caller handed in
             local = np.asarray(local)

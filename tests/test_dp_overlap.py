@@ -255,7 +255,7 @@ def test_subset_group_maps_group_ranks(monkeypatch):
     fake = _FakeRows(4)
     monkeypatch.setattr(collective, "_process_count", lambda: 4)
     monkeypatch.setattr(collective, "_eager_rows",
-                        lambda v: fake.rows(v))
+                        lambda v, **kw: fake.rows(v))
 
     member_group = collective.Group(rank=0, nranks=2, id=7, ranks=[1, 3])
     tr = EagerProcessTransport(member_group)
@@ -276,7 +276,7 @@ def test_reducer_subset_non_member_keeps_local_grads(monkeypatch):
     fake = _FakeRows(4)
     monkeypatch.setattr(collective, "_process_count", lambda: 4)
     monkeypatch.setattr(collective, "_eager_rows",
-                        lambda v: fake.rows(v))
+                        lambda v, **kw: fake.rows(v))
     net = _mlp((8, 8, 4))
     group = collective.Group(rank=-1, nranks=2, id=9, ranks=[1, 3])
     red = Reducer(net.parameters(), bucket_size_mb=1e9,

@@ -1,0 +1,710 @@
+"""Fault-tolerant runtime: collective watchdogs, crash-consistent async
+checkpointing with digests + quarantine, resumable DataLoader state, the
+deterministic fault-injection registry, and the supervised multi-process
+kill-and-recover e2e (SURVEY.md §2.11; TorchElastic/Orbax design notes
+in ISSUE 3)."""
+import json
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.testing import faults
+from paddle_tpu.utils import CheckpointManager
+from paddle_tpu.utils.checkpoint import checkpoint_stats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ----------------------------------------------------------- registry ----
+
+class TestFaultRegistry:
+    def test_spec_parsing_and_one_shot(self):
+        faults.install("kill:step=4,rank=1,code=7; kv_fail:nth=2")
+        assert faults.active()
+        # rank filter: we are rank 0 (no PADDLE_TRAINER_ID in tests)
+        assert faults.take("kill", step=4) is None
+        faults.clear()
+        faults.install("kill:step=4,code=7")
+        assert faults.take("kill", step=3) is None
+        got = faults.take("kill", step=4)
+        assert got is not None and got["code"] == "7"
+        assert faults.take("kill", step=4) is None      # one-shot
+
+    def test_nth_counts_only_matching_calls(self):
+        faults.install("kv_fail:nth=3,op=key_value_set")
+        for _ in range(5):
+            assert faults.take("kv_fail", op="wait_at_barrier") is None
+        assert faults.take("kv_fail", op="key_value_set") is None   # 1st
+        assert faults.take("kv_fail", op="key_value_set") is None   # 2nd
+        assert faults.take("kv_fail", op="key_value_set") is not None
+        assert faults.take("kv_fail", op="key_value_set") is None
+
+    def test_restart_filter_reads_env(self, monkeypatch):
+        faults.install("kill:step=1,restart=1")
+        assert faults.take("kill", step=1) is None      # restart 0 now
+        faults.clear()
+        monkeypatch.setenv("PADDLE_RESTART_COUNT", "1")
+        faults.install("kill:step=1,restart=1")
+        assert faults.take("kill", step=1) is not None
+
+    def test_step_scoped_fault_never_fires_on_stepless_sites(self):
+        """A step= filter must not match call sites with no step notion
+        (collective hooks pass step=None) — firing at the first
+        occurrence would corrupt the chaos scenario."""
+        faults.install("collective_drop:step=5,op=all_reduce")
+        assert faults.take("collective_drop", op="all_reduce") is None
+        assert faults.take("collective_drop", op="all_reduce",
+                           step=4) is None
+        assert faults.take("collective_drop", op="all_reduce",
+                           step=5) is not None
+
+    def test_fired_counter_reaches_profiler(self):
+        before = profiler.faults_stats().get("faults_fired", 0)
+        faults.install("kv_fail:nth=1")
+        assert faults.take("kv_fail", op="x") is not None
+        assert profiler.faults_stats()["faults_fired"] == before + 1
+
+
+# ----------------------------------------------------- checkpointing ----
+
+def _make_state(seed=7):
+    paddle.seed(seed)
+    net = paddle.nn.Linear(4, 3)
+    opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+    return net, opt
+
+
+def _train(net, opt, steps=1, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+class TestChecksummedCheckpoints:
+    def test_digests_written_and_verified(self, tmp_path):
+        net, opt = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, model=net, optimizer=opt)
+        d = tmp_path / "step_1" / "digests.json"
+        assert d.exists()
+        digests = json.loads(d.read_text())
+        assert set(digests) == {"save_seq", "model.pdparams",
+                                "opt.pdopt", "meta.pdstate"}
+        mgr.verify(str(tmp_path / "step_1"))      # clean: no raise
+
+    def test_corrupt_latest_quarantined_falls_back(self, tmp_path):
+        """Satellite: a truncated/corrupt latest step dir is quarantined
+        (step_N.corrupt) with a warning and restore resumes from the
+        previous valid checkpoint."""
+        net, opt = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        _train(net, opt)
+        mgr.save(1, model=net, optimizer=opt)
+        w1 = np.asarray(net.weight.numpy()).copy()
+        _train(net, opt)
+        mgr.save(2, model=net, optimizer=opt)
+        # torn write: truncate the latest params file
+        victim = tmp_path / "step_2" / "model.pdparams"
+        data = victim.read_bytes()
+        victim.write_bytes(data[:len(data) // 2])
+
+        quarantined_before = checkpoint_stats()["checkpoints_quarantined"]
+        net2, opt2 = _make_state(seed=11)
+        mgr2 = CheckpointManager(str(tmp_path))
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            step = mgr2.restore(model=net2, optimizer=opt2)
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(net2.weight.numpy()), w1)
+        assert (tmp_path / "step_2.corrupt").is_dir()
+        assert not (tmp_path / "step_2").exists()
+        stats = profiler.fast_path_summary()["faults"]
+        assert stats["checkpoints_quarantined"] == quarantined_before + 1
+        assert stats["digest_failures"] >= 1
+
+    def test_explicit_corrupt_step_falls_back(self, tmp_path):
+        net, opt = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(3, model=net)
+        mgr.save(5, model=net)
+        (tmp_path / "step_5" / "meta.pdstate").write_bytes(b"garbage")
+        with pytest.warns(RuntimeWarning):
+            assert mgr.restore(model=net, step=5) == 3
+
+    def test_explicit_corrupt_step_falls_back_OLDER_never_newer(
+            self, tmp_path):
+        """Rolling back to a corrupt step must fall back to a checkpoint
+        published BEFORE it — silently restoring the newer state the
+        operator was rolling back from would be a correctness trap."""
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path), keep=10)
+        mgr.save(3, model=net)
+        mgr.save(5, model=net)
+        mgr.save(9, model=net)            # the state being rolled back
+        (tmp_path / "step_5" / "meta.pdstate").write_bytes(b"garbage")
+        with pytest.warns(RuntimeWarning):
+            assert mgr.restore(model=net, step=5) == 3    # not 9
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, model=net)
+        (tmp_path / "step_1" / "model.pdparams").write_bytes(b"x")
+        with pytest.warns(RuntimeWarning):
+            assert mgr.restore(model=net) is None
+
+    def test_async_save_parity_and_publish_order(self, tmp_path):
+        net, opt = _make_state()
+        mgr = CheckpointManager(str(tmp_path / "a"), keep=10,
+                                async_save=True)
+        snapshots = []
+        for step in (1, 2, 3):
+            _train(net, opt, seed=step)
+            mgr.save(step, model=net, optimizer=opt)
+            snapshots.append(np.asarray(net.weight.numpy()).copy())
+            _train(net, opt, seed=100 + step)   # mutate AFTER snapshot
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        for step, want in zip((1, 2, 3), snapshots):
+            net2, opt2 = _make_state(seed=3)
+            mgr2 = CheckpointManager(str(tmp_path / "a"))
+            assert mgr2.restore(model=net2, optimizer=opt2,
+                                step=step) == step
+            # point-in-time snapshot: training past save() didn't leak in
+            np.testing.assert_array_equal(
+                np.asarray(net2.weight.numpy()), want)
+        # publish order follows save order (seq strictly increasing)
+        seqs = [int((tmp_path / "a" / f"step_{s}" / "save_seq").read_text())
+                for s in (1, 2, 3)]
+        assert seqs == sorted(seqs)
+        assert checkpoint_stats()["async_saves"] >= 3
+
+    def test_async_snapshot_survives_buffer_donation(self, tmp_path):
+        """The donated fused optimizer step DELETES param/moment buffers
+        on the next update; an async snapshot must not alias them.
+        Simulated by hard-deleting every live array right after save()."""
+        net, opt = _make_state()
+        want = {i: np.asarray(p.numpy()).copy()
+                for i, p in enumerate(net.parameters())}
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(1, model=net, optimizer=opt)
+        for p in net.parameters():
+            p.value.delete()               # what donation does under jit
+        mgr.wait()                         # writer must not touch them
+        net2, opt2 = _make_state(seed=11)
+        mgr2 = CheckpointManager(str(tmp_path))
+        assert mgr2.restore(model=net2, optimizer=opt2) == 1
+        for i, p in enumerate(net2.parameters()):
+            np.testing.assert_array_equal(np.asarray(p.numpy()), want[i])
+
+    def test_explicit_corrupt_step_unreadable_seq_still_older(
+            self, tmp_path):
+        """Even when the corrupt dir's own save_seq is the unreadable
+        file, rollback falls back to a step BELOW the request — never
+        the newer state being rolled back from."""
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path), keep=10)
+        mgr.save(3, model=net)
+        mgr.save(5, model=net)
+        mgr.save(9, model=net)
+        (tmp_path / "step_5" / "save_seq").write_bytes(b"not a number")
+        with pytest.warns(RuntimeWarning):
+            assert mgr.restore(model=net, step=5) == 3    # not 9
+
+    def test_wait_reports_every_background_failure(self, tmp_path):
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        faults.install("ckpt_truncate:file=model.pdparams,step=1;"
+                       "ckpt_truncate:file=model.pdparams,step=2")
+        mgr.save(1, model=net)
+        mgr.save(2, model=net)
+        with pytest.raises(RuntimeError, match="2 background checkpoint "
+                                               "saves failed"):
+            mgr.wait()
+        mgr.wait()                        # drained: no stale re-raise
+
+    def test_missing_component_is_usage_error_not_corruption(
+            self, tmp_path):
+        """Restoring a component the checkpoints never contained must
+        raise cleanly — NOT cascade-quarantine every valid checkpoint."""
+        net, opt = _make_state()
+        mgr = CheckpointManager(str(tmp_path), keep=10)
+        for s in (1, 2, 3):
+            mgr.save(s, model=net)        # model-only checkpoints
+        with pytest.raises(FileNotFoundError, match="saved without"):
+            mgr.restore(model=net, optimizer=opt)
+        # nothing was destroyed: all three dirs intact, none quarantined
+        assert sorted(p.name for p in tmp_path.iterdir()) \
+            == ["step_1", "step_2", "step_3"]
+        assert mgr.restore(model=net) == 3    # model-only restore fine
+
+    def test_restore_not_blocked_by_unrelated_save_failure(self, tmp_path):
+        """A failed background SAVE must not abort an explicit rollback
+        restore — it surfaces as a warning there; wait() still raises."""
+        net, _ = _make_state()
+        w5 = None
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(5, model=net)
+        mgr.wait()
+        w5 = np.asarray(net.weight.numpy()).copy()
+        faults.install("ckpt_truncate:file=model.pdparams,step=9")
+        mgr.save(9, model=net)             # will fail in the background
+        net2, _ = _make_state(seed=11)
+        with pytest.warns(RuntimeWarning, match="background checkpoint "
+                                               "save failed"):
+            assert mgr.restore(model=net2, step=5) == 5
+        np.testing.assert_array_equal(np.asarray(net2.weight.numpy()), w5)
+
+    def test_explicit_corrupt_only_checkpoint_raises(self, tmp_path):
+        """Rollback to the only checkpoint, which is corrupt: raising
+        beats returning None (None reads as 'cold start' and the caller
+        would overwrite the run being rescued)."""
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(5, model=net)
+        (tmp_path / "step_5" / "meta.pdstate").write_bytes(b"garbage")
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(RuntimeError, match="no earlier"):
+                mgr.restore(model=net, step=5)
+
+    def test_readonly_drain_keeps_errors_for_wait(self, tmp_path):
+        """latest_step() warns about a failed background save but must
+        not swallow it — the user's explicit wait() still raises."""
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        faults.install("ckpt_truncate:file=model.pdparams,step=1")
+        mgr.save(1, model=net)
+        with pytest.warns(RuntimeWarning, match="background checkpoint"):
+            assert mgr.latest_step() is None
+        with pytest.raises(RuntimeError, match="injected writer crash"):
+            mgr.wait()
+
+    def test_restore_missing_explicit_step_clean_error(self, tmp_path):
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, model=net)
+        with pytest.raises(FileNotFoundError, match="available steps"):
+            mgr.restore(model=net, step=99)
+        assert (tmp_path / "step_1").is_dir()    # nothing quarantined
+
+    def test_async_snapshot_decouples_host_buffers(self, tmp_path):
+        """Non-jax mutable leaves (numpy running stats, nested dicts in
+        extra) must be value-captured at save() time, not serialized by
+        reference after the training loop mutated them."""
+        net, _ = _make_state()
+        stats = np.zeros(3, np.float32)
+        metrics = {"best_loss": 1.0}
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(1, model=net, extra={"stats": stats.copy(),
+                                      "metrics": metrics})
+        # simulate save() being handed LIVE objects instead
+        mgr.save(2, model=net, extra={"stats": stats,
+                                      "metrics": metrics})
+        stats += 99.0                     # training loop mutates in place
+        metrics["best_loss"] = 0.5
+        mgr.wait()
+        mgr.restore(model=net, step=2)
+        np.testing.assert_array_equal(mgr.last_extra["stats"],
+                                      np.zeros(3, np.float32))
+        assert mgr.last_extra["metrics"]["best_loss"] == 1.0
+
+    def test_injected_midwrite_truncation_never_publishes(self, tmp_path):
+        """ckpt_truncate without publish=1 is a writer crash: the tmp dir
+        is abandoned and the previous checkpoint stays latest."""
+        net, _ = _make_state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, model=net)
+        faults.install("ckpt_truncate:file=model.pdparams,step=2")
+        with pytest.raises(RuntimeError, match="injected writer crash"):
+            mgr.save(2, model=net)
+        assert not (tmp_path / "step_2").exists()
+        assert (tmp_path / "step_2.tmp").exists()     # the crash debris
+        assert mgr.latest_step() == 1
+        # an async manager surfaces the same crash at wait()
+        mgr2 = CheckpointManager(str(tmp_path), async_save=True)
+        faults.install("ckpt_truncate:file=model.pdparams,step=3")
+        mgr2.save(3, model=net)
+        with pytest.raises(RuntimeError, match="injected writer crash"):
+            mgr2.wait()
+        assert mgr2.latest_step() == 1
+
+    def test_injected_published_truncation_quarantined(self, tmp_path):
+        """ckpt_truncate with publish=1 models a torn write on a
+        non-atomic filesystem: the corrupt dir IS published, then caught
+        by digest verify and quarantined at restore."""
+        net, _ = _make_state()
+        w_before = np.asarray(net.weight.numpy()).copy()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, model=net)
+        faults.install("ckpt_truncate:file=model.pdparams,step=2,publish=1")
+        mgr.save(2, model=net)
+        assert (tmp_path / "step_2").exists()
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            assert mgr.restore(model=net) == 1
+        np.testing.assert_array_equal(
+            np.asarray(net.weight.numpy()), w_before)
+
+
+# ------------------------------------------------- dataloader resume ----
+
+class TestDataLoaderResume:
+    def _loader(self, n=12, batch_size=2, **kw):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import TensorDataset
+        data = paddle.to_tensor(
+            np.arange(n * 3, dtype=np.float32).reshape(n, 3))
+        return DataLoader(TensorDataset([data]), batch_size=batch_size,
+                          **kw)
+
+    def test_state_roundtrip_mid_epoch(self):
+        loader = self._loader()
+        it = iter(loader)
+        consumed = [next(it) for _ in range(3)]
+        state = loader.state_dict()
+        assert state["epoch"] == 0 and state["batch_index"] == 3
+
+        loader2 = self._loader()
+        loader2.set_state_dict(state)
+        rest = [b for b in loader2]
+        full = [b for b in self._loader()]
+        assert len(rest) == len(full) - 3
+        for got, want in zip(rest, full[3:]):
+            np.testing.assert_array_equal(np.asarray(got[0].numpy()),
+                                          np.asarray(want[0].numpy()))
+
+    def test_epoch_counter_rolls(self):
+        loader = self._loader()
+        for _ in loader:
+            pass
+        st = loader.state_dict()
+        assert st["epoch"] == 1 and st["batch_index"] == 0
+        for _ in loader:
+            pass
+        assert loader.state_dict()["epoch"] == 2
+
+    def test_threaded_loader_resumes(self):
+        loader = self._loader(num_workers=2, use_native_ring=False)
+        it = iter(loader)
+        next(it), next(it)
+        state = loader.state_dict()
+        loader2 = self._loader(num_workers=2, use_native_ring=False)
+        loader2.set_state_dict(state)
+        rest = [np.asarray(b[0].numpy()) for b in loader2]
+        full = [np.asarray(b[0].numpy()) for b in self._loader()]
+        assert len(rest) == len(full) - 2
+        np.testing.assert_array_equal(rest[0], full[2])
+
+    def test_shuffled_epoch_replays_same_order(self):
+        """Resume state carries the RNG as of EPOCH START: the resumed
+        epoch re-draws the interrupted epoch's permutation, so the skip
+        lands on exactly the batches not yet consumed."""
+        np.random.seed(77)
+        loader = self._loader(shuffle=True)
+        it = iter(loader)
+        first = [np.asarray(next(it)[0].numpy()) for _ in range(3)]
+        state = loader.state_dict()
+        # what the interrupted epoch WOULD have yielded next
+        rest_expected = [np.asarray(b[0].numpy()) for b in it]
+
+        np.random.seed(12345)            # a crash loses the live stream
+        loader2 = self._loader(shuffle=True)
+        loader2.set_state_dict(state)
+        rest = [np.asarray(b[0].numpy()) for b in loader2]
+        assert len(rest) == len(rest_expected)
+        for got, want in zip(rest, rest_expected):
+            np.testing.assert_array_equal(got, want)
+        # and nothing consumed pre-crash is replayed
+        for got in rest:
+            for seen in first:
+                assert not np.array_equal(got, seen)
+
+    def test_between_epoch_state_is_not_stale(self):
+        """state_dict() at an epoch BOUNDARY must capture the live RNG
+        stream, not the finished epoch's start — a resumed next epoch
+        draws a fresh permutation, same as an uninterrupted run."""
+        np.random.seed(5)
+        loader = self._loader(shuffle=True)
+        epoch1 = [np.asarray(b[0].numpy()) for b in loader]
+        state = loader.state_dict()
+        epoch2_uninterrupted = [np.asarray(b[0].numpy()) for b in loader]
+
+        np.random.seed(5)
+        loader2 = self._loader(shuffle=True)
+        _ = [b for b in loader2]          # replay epoch 1
+        loader2.set_state_dict(state)
+        epoch2_resumed = [np.asarray(b[0].numpy()) for b in loader2]
+        for got, want in zip(epoch2_resumed, epoch2_uninterrupted):
+            np.testing.assert_array_equal(got, want)
+        # and it is NOT a repeat of epoch 1
+        assert not all(np.array_equal(a, b)
+                       for a, b in zip(epoch2_resumed, epoch1))
+
+    def test_state_dict_between_iter_and_first_next(self):
+        """iter() resets the position eagerly: a checkpoint taken before
+        the new epoch's first batch must not report the abandoned
+        previous epoch's batch index."""
+        loader = self._loader()
+        it = iter(loader)
+        for _ in range(3):
+            next(it)
+        it2 = iter(loader)                 # abandon epoch, start fresh
+        assert loader.state_dict()["batch_index"] == 0
+        next(it2)
+        assert loader.state_dict()["batch_index"] == 1
+
+    def test_state_dict_after_set_state_dict_keeps_offset(self):
+        """A checkpoint taken right after restore (before the next batch
+        is drawn) must carry the restored position forward, not report
+        batch 0 and double-train the replayed batches on the NEXT
+        resume."""
+        loader = self._loader()
+        loader.set_state_dict({"epoch": 3, "batch_index": 4,
+                               "np_rng_state": None})
+        st = loader.state_dict()
+        assert st["epoch"] == 3 and st["batch_index"] == 4
+
+    def test_manager_captures_loader_state(self, tmp_path):
+        net, _ = _make_state()
+        loader = self._loader()
+        it = iter(loader)
+        next(it)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, model=net, dataloader=loader)
+        loader2 = self._loader()
+        assert mgr.restore(model=net, dataloader=loader2) == 1
+        assert loader2._resume_skip == 1
+
+
+# ------------------------------------------------- collective watchdog ----
+
+class _FakeKVClient:
+    """Stands in for jaxlib's DistributedRuntimeClient: rank 0's view of
+    a 2-process world where rank 1 died before contributing."""
+
+    def __init__(self, fail_sets=0):
+        self.store = {}
+        self.barrier_calls = 0
+        self.set_calls = 0
+        self._fail_sets = fail_sets
+
+    def key_value_set(self, key, val):
+        self.set_calls += 1
+        if self._fail_sets > 0:
+            self._fail_sets -= 1
+            raise RuntimeError("UNAVAILABLE: coordination service hiccup")
+        self.store[key] = val
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.store:
+            return self.store[key]
+        raise RuntimeError("DEADLINE_EXCEEDED: key not found in time")
+
+    def wait_at_barrier(self, name, timeout_ms, *a):
+        self.barrier_calls += 1
+        raise RuntimeError(
+            f"DEADLINE_EXCEEDED: barrier {name} timed out waiting for "
+            "tasks")
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+
+class TestCollectiveWatchdog:
+    def test_timeout_diagnoses_missing_ranks(self, monkeypatch):
+        from paddle_tpu.distributed import collective
+        client = _FakeKVClient()
+        monkeypatch.setattr(collective, "_kv_world",
+                            lambda: (client, 2, 0))
+        monkeypatch.setenv("PADDLE_COLLECTIVE_TIMEOUT", "1")
+        before = collective.watchdog_stats()["collective_timeouts"]
+        with pytest.raises(collective.CollectiveTimeout) as ei:
+            collective._kv_allgather(np.ones(3), op="dp_bucket_all_reduce",
+                                     bucket=2)
+        msg = str(ei.value)
+        assert "dp_bucket_all_reduce" in msg
+        assert "bucket 2" in msg
+        assert "[0]" in msg and "missing [1]" in msg   # ranks seen: us only
+        assert "PADDLE_COLLECTIVE_TIMEOUT" in msg
+        assert collective.watchdog_stats()["collective_timeouts"] \
+            == before + 1
+
+    def test_transient_kv_failures_retried(self, monkeypatch):
+        from paddle_tpu.distributed import collective
+        client = _FakeKVClient(fail_sets=2)
+        before = collective.watchdog_stats()["kv_retries"]
+        out = collective._kv_call(client, "key_value_set", "k", "v")
+        assert out is None and client.store["k"] == "v"
+        assert client.set_calls == 3
+        assert collective.watchdog_stats()["kv_retries"] == before + 2
+
+    def test_injected_kv_fault_absorbed_by_retry(self, monkeypatch):
+        from paddle_tpu.distributed import collective
+        faults.install("kv_fail:nth=1,op=key_value_set")
+        client = _FakeKVClient()
+        collective._kv_call(client, "key_value_set", "k2", "v2")
+        assert client.store["k2"] == "v2"
+        assert faults.fault_stats()["faults_fired"] >= 1
+
+    def test_kv_retries_bounded(self, monkeypatch):
+        from paddle_tpu.distributed import collective
+        monkeypatch.setenv("PADDLE_KV_RETRIES", "2")
+        client = _FakeKVClient(fail_sets=10)
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            collective._kv_call(client, "key_value_set", "k", "v")
+        assert client.set_calls == 3      # 1 try + 2 retries
+
+
+# ------------------------------------------------------ bootstrap retry ----
+
+class TestBootstrapRetry:
+    def _arm(self, monkeypatch):
+        from paddle_tpu import _dist_bootstrap as boot
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:1")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_BOOTSTRAP_BACKOFF", "0.01")
+        monkeypatch.setattr(boot, "_done", [False])
+        return boot
+
+    def test_transient_failures_retried_until_success(self, monkeypatch):
+        import jax
+        boot = self._arm(monkeypatch)
+        calls = []
+
+        def fake_init(**kw):
+            calls.append(kw)
+            if len(calls) < 3:
+                raise RuntimeError("connection refused: coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        before = boot.bootstrap_stats()["bootstrap_retries"]
+        boot.maybe_init_distributed()
+        assert len(calls) == 3
+        assert boot.bootstrap_stats()["bootstrap_retries"] == before + 2
+
+    def test_timeout_raises_actionable(self, monkeypatch):
+        import jax
+        boot = self._arm(monkeypatch)
+        monkeypatch.setenv("PADDLE_BOOTSTRAP_TIMEOUT", "0.3")
+
+        def fake_init(**kw):
+            raise RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        with pytest.raises(RuntimeError,
+                           match="PADDLE_BOOTSTRAP_TIMEOUT"):
+            boot.maybe_init_distributed()
+
+    def test_failed_bootstrap_stays_retryable(self, monkeypatch):
+        """A raised bootstrap must NOT latch the done flag: a caller that
+        catches the timeout and retries once the coordinator is up must
+        really connect — a silent no-op would leave a world of 1 and
+        divergent replicas."""
+        import jax
+        boot = self._arm(monkeypatch)
+        monkeypatch.setenv("PADDLE_BOOTSTRAP_TIMEOUT", "0.05")
+        calls = []
+
+        def failing(**kw):
+            calls.append(kw)
+            raise RuntimeError("connection refused: coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", failing)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        with pytest.raises(RuntimeError):
+            boot.maybe_init_distributed()
+        n_failed = len(calls)
+
+        def succeeding(**kw):
+            calls.append(kw)
+
+        monkeypatch.setattr(jax.distributed, "initialize", succeeding)
+        boot.maybe_init_distributed()      # retry really connects
+        assert len(calls) == n_failed + 1
+        boot.maybe_init_distributed()      # now latched: no-op
+        assert len(calls) == n_failed + 1
+
+    def test_backend_already_up_raises_immediately(self, monkeypatch):
+        import jax
+        boot = self._arm(monkeypatch)
+        calls = []
+
+        def fake_init(**kw):
+            calls.append(kw)
+            raise RuntimeError(
+                "jax.distributed.initialize() must be called before any "
+                "JAX computations are executed.")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        with pytest.raises(RuntimeError, match="clean interpreter"):
+            boot.maybe_init_distributed()
+        assert len(calls) == 1            # no retry on misconfiguration
+
+
+# ------------------------------------------------ multi-process e2e ----
+
+def test_multiprocess_kill_recovery(tmp_path):
+    """The tentpole e2e in miniature: 2 supervised DP workers, rank 1
+    killed mid-step by the fault registry, group relaunched, training
+    resumed from the last published async checkpoint, final params match
+    an uninterrupted single-process run to 1e-6."""
+    from paddle_tpu.distributed.launch import supervise
+    from paddle_tpu.testing.env import clean_cpu_env
+
+    env = clean_cpu_env(REPO, device_count=1)
+    env["PADDLE_COLLECTIVE_TIMEOUT"] = "30"
+    env.pop("PADDLE_FAULTS", None)
+    steps = 5
+
+    def argv(tag):
+        return ["-m", "paddle_tpu.testing.recovery_worker",
+                "--ckpt", str(tmp_path / tag / "ckpt"),
+                "--out", str(tmp_path / tag / "out"),
+                "--steps", str(steps)]
+
+    ref = supervise(argv("ref"), nprocs=1, env_base=env)
+    assert ref["rc"] == 0, ref
+
+    chaos_env = dict(env)
+    chaos_env["PADDLE_FAULTS"] = "kill:step=3,rank=1,restart=0,code=43"
+    summary = supervise(argv("chaos"), nprocs=2, env_base=chaos_env,
+                        log_dir=str(tmp_path / "logs"),
+                        max_restarts=2, backoff=0.2)
+    assert summary["rc"] == 0, summary
+    assert summary["restarts_used"] == 1
+    assert summary["incidents"][0]["rank"] == 1
+    assert summary["incidents"][0]["exit_code"] == 43
+
+    out = tmp_path / "chaos" / "out"
+    resumed = sorted(p.name for p in out.iterdir()
+                     if p.name.startswith("resumed_1"))
+    assert resumed, list(out.iterdir())
+    marker = json.loads((out / resumed[0]).read_text())
+    assert 1 <= marker["resumed_step"] <= 3     # from a PUBLISHED ckpt
+    assert marker["time"] >= summary["incidents"][0]["time"]
+
+    ref_p = np.load(tmp_path / "ref" / "out" / "params_rank0.npz")
+    for r in range(2):                          # both ranks converged
+        got = np.load(out / f"params_rank{r}.npz")
+        for k in ref_p.files:
+            np.testing.assert_allclose(got[k], ref_p[k], atol=1e-6)
+    # per-worker logs captured across BOTH incarnations
+    assert (tmp_path / "logs" / "worker0.log").exists()
+    assert (tmp_path / "logs" / "worker1.log").exists()

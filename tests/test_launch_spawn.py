@@ -72,6 +72,102 @@ def test_launch_jax_distributed_bootstrap(tmp_path):
     assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
 
 
+def test_supervisor_restarts_failed_worker(tmp_path):
+    """A worker killed mid-run is relaunched within the restart budget:
+    the WHOLE group restarts with PADDLE_RESTART_COUNT bumped, and the
+    run converges to rc 0 once the fault stops firing."""
+    from paddle_tpu.distributed.launch import supervise
+    script = tmp_path / "flaky.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        restart = os.environ["PADDLE_RESTART_COUNT"]
+        open(os.path.join({str(tmp_path)!r},
+                          f"ran_{{rank}}_{{restart}}"), "w").close()
+        if rank == "1" and restart == "0":
+            sys.exit(9)      # die once, first incarnation only
+    """))
+    summary = supervise([str(script)], nprocs=2, env_base=_env_base(),
+                        max_restarts=2, backoff=0.05)
+    assert summary["rc"] == 0
+    assert summary["restarts_used"] == 1
+    assert len(summary["incidents"]) == 1
+    inc = summary["incidents"][0]
+    assert inc["rank"] == 1 and inc["exit_code"] == 9 \
+        and inc["incarnation"] == 0
+    # every rank ran in BOTH incarnations (group-wide relaunch)
+    for rank in (0, 1):
+        for restart in (0, 1):
+            assert (tmp_path / f"ran_{rank}_{restart}").exists()
+
+
+def test_supervisor_budget_exhaustion_propagates_exit_code(tmp_path):
+    """Restart budget spent: the original failing exit code is the
+    launcher's, and every incident is on the record."""
+    from paddle_tpu.distributed.launch import supervise, launch_procs
+    script = tmp_path / "hopeless.py"
+    script.write_text("import sys; sys.exit(5)\n")
+    summary = supervise([str(script)], nprocs=2, env_base=_env_base(),
+                        max_restarts=1, backoff=0.05)
+    assert summary["rc"] == 5
+    assert summary["restarts_used"] == 1
+    assert len(summary["incidents"]) == 2     # original + failed retry
+    assert summary["failed_rank"] is not None
+    # the back-compat wrapper propagates the same code
+    assert launch_procs([str(script)], nprocs=1, master=None,
+                        env_base=_env_base()) == 5
+
+
+def test_supervisor_sigterms_survivors_exactly_once(tmp_path):
+    """On an incident the surviving workers get ONE SIGTERM each (then a
+    grace period), never a second."""
+    from paddle_tpu.distributed.launch import supervise
+    marker = tmp_path / "sigterms.txt"
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, signal, sys, time
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        if rank == "1":
+            time.sleep(0.3)
+            sys.exit(3)          # the failing worker
+        def onterm(sig, frame):
+            with open({str(marker)!r}, "a") as f:
+                f.write(f"TERM rank={{rank}}\\n")
+            sys.exit(0)
+        signal.signal(signal.SIGTERM, onterm)
+        time.sleep(60)           # survivor: waits to be torn down
+    """))
+    summary = supervise([str(script)], nprocs=2, env_base=_env_base(),
+                        max_restarts=0)
+    assert summary["rc"] == 3
+    lines = marker.read_text().splitlines()
+    assert lines == ["TERM rank=0"]     # exactly one signal, rank 0 only
+
+
+def test_supervisor_log_dir_and_exit_summary(tmp_path):
+    """--log_dir really writes workerN.log (stdout+stderr) and the exit
+    summary names the failing worker's log."""
+    from paddle_tpu.distributed.launch import supervise
+    script = tmp_path / "noisy.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        print(f"hello stdout from rank {rank}")
+        print(f"hello stderr from rank {rank}", file=sys.stderr)
+        sys.exit(11 if rank == "1" else 0)
+    """))
+    log_dir = tmp_path / "logs"
+    summary = supervise([str(script)], nprocs=2, env_base=_env_base(),
+                        log_dir=str(log_dir))
+    assert summary["rc"] == 11
+    assert summary["failed_rank"] == 1
+    assert summary["failed_log"].endswith("worker1.log")
+    for rank in (0, 1):
+        text = (log_dir / f"worker{rank}.log").read_text()
+        assert f"hello stdout from rank {rank}" in text
+        assert f"hello stderr from rank {rank}" in text   # merged stream
+
+
 def test_spawn_multiprocess(tmp_path):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     try:

@@ -93,3 +93,18 @@ def test_fast_path_summary_reducer_and_prefetch_counters():
     profiler.reset_prefetch_stats()
     assert profiler.reducer_stats()["collectives_launched"] == 0
     assert profiler.prefetch_stats()["batches"] == 0
+
+
+def test_fast_path_summary_faults_family():
+    """fast_path_summary() carries the fault-tolerance counter family:
+    watchdog expiries, KV retries, supervision incidents/restarts,
+    checkpoint integrity events, bootstrap retries, injected faults."""
+    s = profiler.fast_path_summary()
+    assert "faults" in s
+    f = s["faults"]
+    for key in ("collective_timeouts", "kv_retries", "incidents",
+                "worker_restarts", "async_saves",
+                "checkpoints_quarantined", "digest_failures",
+                "bootstrap_retries", "faults_fired"):
+        assert key in f, key
+        assert isinstance(f[key], int)
