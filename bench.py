@@ -517,6 +517,8 @@ def eager_micro():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu import profiler
+    from paddle_tpu.observability import StepTimer
+    from paddle_tpu.observability import metrics as obs_metrics
     from paddle_tpu.ops import dispatch
     from paddle_tpu.optimizer import optimizer as opt_mod
 
@@ -544,30 +546,43 @@ def eager_micro():
             dispatch.reset_cache_stats()
             opt_mod.reset_fused_stats()
             per_step = []
+            timer = StepTimer(
+                name=f"eager_micro_{'fast' if fused else 'ref'}",
+                publish_interval=0)
+            compiles0 = obs_metrics.counter("compile.count").value
             t0 = time.perf_counter()
-            for i in range(steps):
-                loss = (net(x) ** 2).mean()
-                loss.backward()
-                opt.step()
-                opt.clear_grad()
-                s = dispatch.cache_stats()
-                f = dict(opt_mod._fused_stats)
-                per_step.append((s["misses"], s["hits"], f["compiles"],
-                                 f["calls"]))
+            with timer:
+                for i in range(steps):
+                    with timer.step():
+                        loss = (net(x) ** 2).mean()
+                        loss.backward()
+                        opt.step()
+                        opt.clear_grad()
+                    s = dispatch.cache_stats()
+                    f = dict(opt_mod._fused_stats)
+                    per_step.append((s["misses"], s["hits"],
+                                     f["compiles"], f["calls"]))
             float(loss.numpy())         # host fetch closes the region
             dt = time.perf_counter() - t0
             counters = profiler.fast_path_summary()
+            telem = {"compiles": obs_metrics.counter("compile.count")
+                     .value - compiles0,
+                     "step_time_ms": {
+                         k: (round(v * 1e3, 3) if v is not None else None)
+                         for k, v in timer.percentiles().items()}}
             params = [np.asarray(p.numpy()) for p in net.parameters()]
-            return per_step, dt, params, float(loss.numpy()), counters
+            return (per_step, dt, params, float(loss.numpy()), counters,
+                    telem)
         finally:
             os.environ.pop("PADDLE_TPU_FUSED_STEP", None)
             os.environ.pop("PADDLE_TPU_DISPATCH_CACHE", None)
             os.environ.pop("PADDLE_TPU_DISPATCH_CACHE_WARMUP", None)
 
     steps = 10
-    hist, dt_fast, params_fast, loss_fast, counters = run_loop(
+    hist, dt_fast, params_fast, loss_fast, counters, telem = run_loop(
         steps, True, True)
-    _, dt_slow, params_slow, loss_slow, _ = run_loop(steps, False, False)
+    _, dt_slow, params_slow, loss_slow, _, _ = run_loop(
+        steps, False, False)
 
     # steady state: no step after the 2nd may trace anything new
     new_traces_late = [hist[i][0] - hist[i - 1][0]
@@ -587,6 +602,12 @@ def eager_micro():
         "value": round(steps / dt_fast, 2),
         "unit": "steps/s",
         "vs_baseline": round(dt_slow / dt_fast, 3),   # speedup vs uncached
+        # registry-backed telemetry: XLA compile count + step-time
+        # percentiles for the fast loop (the old output had means only)
+        "telemetry": {**telem,
+                      "registry": {"dispatch_cache":
+                                   counters["dispatch_cache"],
+                                   "fused_step": counters["fused_step"]}},
     }), flush=True)
     print(f"# eager-micro: fast={steps / dt_fast:.2f} steps/s "
           f"uncached={steps / dt_slow:.2f} steps/s "
@@ -630,6 +651,8 @@ def dp_overlap():
     import paddle_tpu.distributed as dist
     from paddle_tpu import io, profiler
     from paddle_tpu.distributed import reducer as reducer_mod
+    from paddle_tpu.observability import StepTimer
+    from paddle_tpu.observability import metrics as obs_metrics
 
     width = int(os.environ.get("BENCH_DP_WIDTH", 768))
     depth = int(os.environ.get("BENCH_DP_DEPTH", 8))
@@ -656,8 +679,8 @@ def dp_overlap():
                for _ in range(steps + warmup)]
 
     def run(mode):
-        reducer_mod.reset_reducer_stats()
-        profiler.reset_prefetch_stats()
+        obs_metrics.reset("reducer")
+        obs_metrics.reset("prefetch")
         net = build()
         if mode == "overlap":
             dp = dist.DataParallel(net, mesh=mesh, bucket_size_mb=bucket_mb,
@@ -692,9 +715,13 @@ def dp_overlap():
             loss = one_step()
         float(loss.numpy())               # drain warmup
         launched0 = reducer_mod.reducer_stats()["collectives_launched"]
+        timer = StepTimer(name=f"dp_{mode}", publish_interval=0)
+        compiles0 = obs_metrics.counter("compile.count").value
         t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = one_step()
+        with timer:
+            for _ in range(steps):
+                with timer.step():
+                    loss = one_step()
         for p in net.parameters():        # host sync closes the region
             p.value.block_until_ready()
         final_loss = float(loss.numpy())
@@ -706,10 +733,15 @@ def dp_overlap():
             f"{n_buckets} buckets x {steps} steps — exactly one per "
             "bucket per step is the contract")
         params = [np.asarray(p.numpy()) for p in net.parameters()]
-        return dt, params, final_loss, n_buckets, stats
+        telem = {"compiles": obs_metrics.counter("compile.count").value
+                 - compiles0,
+                 "step_time_ms": {
+                     k: (round(v * 1e3, 3) if v is not None else None)
+                     for k, v in timer.percentiles().items()}}
+        return dt, params, final_loss, n_buckets, stats, telem
 
-    dt_sync, params_sync, loss_sync, _, _ = run("sync")
-    dt_ov, params_ov, loss_ov, n_buckets, stats = run("overlap")
+    dt_sync, params_sync, loss_sync, _, _, telem_sync = run("sync")
+    dt_ov, params_ov, loss_ov, n_buckets, stats, telem_ov = run("overlap")
     prefetch = profiler.prefetch_stats()
 
     for a, b in zip(params_ov, params_sync):
@@ -727,6 +759,9 @@ def dp_overlap():
         "buckets": n_buckets,
         "steps": steps,
         "counters": {"reducer": stats, "prefetch": prefetch},
+        # step-time percentiles (p50/p95, not just means) + XLA compile
+        # counts per schedule, all served from the metrics registry
+        "telemetry": {"overlap": telem_ov, "sync": telem_sync},
     }), flush=True)
     print(f"# dp-overlap: sync={dt_sync*1e3:.1f}ms "
           f"overlap={dt_ov*1e3:.1f}ms reduction={reduction*100:.1f}% "
@@ -763,7 +798,7 @@ def faults_bench():
     import tempfile
 
     import numpy as np
-    from paddle_tpu.distributed.launch import supervise
+    from paddle_tpu.distributed.launch import supervise, launch_stats
 
     steps = int(os.environ.get("BENCH_FAULTS_STEPS", 8))
     kill_step = int(os.environ.get("BENCH_FAULTS_KILL_STEP",
@@ -772,7 +807,7 @@ def faults_bench():
     repo = os.path.dirname(os.path.abspath(__file__))
     work = tempfile.mkdtemp(prefix="paddle_tpu_faults_")
 
-    def env_base():
+    def env_base(tag):
         from paddle_tpu.testing.env import clean_cpu_env
         # one host device per worker: the DP transport here is the
         # cross-PROCESS eager path, extra local devices just cost memory
@@ -780,6 +815,9 @@ def faults_bench():
         env["PADDLE_COLLECTIVE_TIMEOUT"] = \
             os.environ.get("PADDLE_COLLECTIVE_TIMEOUT", "30")
         env.pop("PADDLE_FAULTS", None)
+        # per-scenario telemetry dir: workers write JSONL step records
+        # the parent merges into the cross-rank block below
+        env["PADDLE_TELEMETRY_DIR"] = os.path.join(work, tag, "telemetry")
         return env
 
     def worker_argv(tag):
@@ -791,12 +829,13 @@ def faults_bench():
     try:
         # reference: uninterrupted single-process run
         t0 = time.perf_counter()
-        ref = supervise(worker_argv("ref"), nprocs=1, env_base=env_base())
+        ref = supervise(worker_argv("ref"), nprocs=1,
+                        env_base=env_base("ref"))
         ref_s = time.perf_counter() - t0
         assert ref["rc"] == 0, f"reference run failed: {ref}"
 
         # chaos: kill one worker mid-step on the first incarnation
-        env = env_base()
+        env = env_base("chaos")
         victim = min(1, nprocs - 1)
         env["PADDLE_FAULTS"] = \
             f"kill:step={kill_step},rank={victim},restart=0,code=43"
@@ -827,6 +866,21 @@ def faults_bench():
             np.testing.assert_allclose(chaos_params[k], ref_params[k],
                                        atol=1e-6)
 
+        # merged cross-rank telemetry from the chaos workers' JSONL logs:
+        # per-rank step counts/times + the supervision counter family
+        telem = {"registry": {"launch": dict(launch_stats())}}
+        try:
+            from paddle_tpu.observability import aggregate
+            report = aggregate.merge_from_dir(
+                os.path.join(work, "chaos", "telemetry"))
+            telem["ranks"] = {
+                r: {"steps": v["steps"],
+                    "step_wall_p50_s": v["step_wall_p50_s"],
+                    "step_wall_p95_s": v["step_wall_p95_s"]}
+                for r, v in report["ranks"].items()}
+        except Exception as e:                             # noqa: BLE001
+            telem["ranks"] = {"error": f"{type(e).__name__}: {e}"}
+
         print(json.dumps({
             "metric": "fault_recovery_time_s",
             "value": round(ttr, 3),
@@ -838,6 +892,7 @@ def faults_bench():
             "nprocs": nprocs,
             "restarts_used": summary["restarts_used"],
             "incident_exit_code": inc["exit_code"],
+            "telemetry": telem,
         }), flush=True)
         print(f"# faults: killed rank {victim} at step {kill_step}, "
               f"resumed from step {marker['resumed_step']}, "

@@ -94,6 +94,7 @@ from . import hub  # noqa: E402
 from .reader import batch  # noqa: E402  (paddle.batch, ref batch.py)
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
+from . import observability  # noqa: E402
 from . import incubate  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

@@ -22,10 +22,14 @@ import os
 import sys
 import time
 
+from .observability import metrics as _metrics
+
 _done = [False]
 
-# bootstrap counters, surfaced through profiler.fast_path_summary()
-_bootstrap_stats = {"bootstrap_retries": 0}
+# bootstrap counters, surfaced through profiler.fast_path_summary(); a
+# VIEW over the observability registry's "bootstrap" family
+_bootstrap_stats = _metrics.stats_family(
+    "bootstrap", {"bootstrap_retries": 0})
 
 
 def bootstrap_stats():

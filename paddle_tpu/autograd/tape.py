@@ -210,8 +210,16 @@ def backward(tensor, grad=None, retain_graph: bool = False, watch=()):
     if watch:
         _watch_depth[0] += 1
     _backward_depth[0] += 1
+    # telemetry: only the OUTERMOST training backward is a "backward"
+    # phase (nested/double-grad passes ride inside it, and watch-mode
+    # passes are functional gradient queries, not training steps)
+    from ..observability import timeline as _timeline
+    _span = (_timeline.span("backward")
+             if _backward_depth[0] == 1 and not watch
+             else _timeline._NULL)
     try:
-        _backward_impl(tensor, grad, retain_graph, watch)
+        with _span:
+            _backward_impl(tensor, grad, retain_graph, watch)
     except BaseException:
         # an aborted OUTERMOST pass must not leave finalize callbacks
         # queued for the NEXT backward (they would fire over

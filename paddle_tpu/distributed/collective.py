@@ -133,11 +133,15 @@ class CollectiveTimeout(RuntimeError):
         super().__init__(msg)
 
 
-# watchdog counters, surfaced through profiler.fast_path_summary()
-_watchdog_stats = {
+# watchdog counters, surfaced through profiler.fast_path_summary(); a
+# VIEW over the observability registry's "watchdog" family (same storage)
+from ..observability import metrics as _metrics
+from ..observability import timeline as _timeline
+
+_watchdog_stats = _metrics.stats_family("watchdog", {
     "collective_timeouts": 0,   # waits that expired into CollectiveTimeout
     "kv_retries": 0,            # transient KV-store op failures absorbed
-}
+})
 
 
 def watchdog_stats():
@@ -250,10 +254,17 @@ def _kv_allgather(value, op="allgather", bucket=None, group=None):
     payload = base64.b64encode(
         pickle.dumps(np.asarray(value))).decode("ascii")
     _kv_call(client, "key_value_set", f"{key}/{me}", payload)
+    # rendezvous wait, measured AFTER this rank contributed: a straggler
+    # (slow producer) records ~zero here while its peers record the time
+    # they sat at the barrier — the asymmetry the telemetry aggregator's
+    # straggler detector keys on (observability/aggregate.py)
+    t_wait = time.perf_counter()
     try:
         _kv_call(client, "wait_at_barrier", f"{key}_barrier", timeout_ms)
         rows = [pickle.loads(base64.b64decode(_kv_call(
             client, "blocking_key_value_get", f"{key}/{j}", timeout_ms))) for j in range(n)]
+        _timeline.record_collective_wait(
+            time.perf_counter() - t_wait, op=op)
     except Exception as e:                                 # noqa: BLE001
         if not _is_deadline(e):
             raise

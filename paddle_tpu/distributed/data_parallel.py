@@ -86,7 +86,9 @@ class DataParallel(Layer):
         return self._reducer
 
     def forward(self, *inputs, **kwargs):
-        return self._layers(*inputs, **kwargs)
+        from ..observability import timeline as _timeline
+        with _timeline.span("forward"):
+            return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
         return loss

@@ -25,12 +25,15 @@ import subprocess
 import sys
 import time
 
-# supervision counters, surfaced through profiler.fast_path_summary()
-_launch_stats = {
+# supervision counters, surfaced through profiler.fast_path_summary(); a
+# VIEW over the observability registry's "launch" family (same storage)
+from ..observability import metrics as _metrics
+
+_launch_stats = _metrics.stats_family("launch", {
     "incidents": 0,          # worker failures observed
     "worker_restarts": 0,    # processes re-spawned after an incident
     "sigterms_sent": 0,      # group-teardown signals (once per survivor)
-}
+})
 
 
 def launch_stats():
@@ -60,7 +63,7 @@ def _free_local_port():
 
 def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
               nranks=None, log_dir=None, max_restarts=0, backoff=1.0,
-              term_grace=10.0, poll_interval=0.2):
+              term_grace=10.0, poll_interval=0.2, telemetry_dir=None):
     """Run ``nprocs`` copies of the script under supervision (global ranks
     rank_base..rank_base+nprocs-1 of nranks total).  Returns a summary
     dict: ``rc`` (0, or the FIRST failing exit code of the final
@@ -87,6 +90,8 @@ def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
     needs an external scheduler."""
     nranks = nranks if nranks is not None else nprocs
     auto_master = master is None and nranks > 1
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
     restarts_used = 0
     incidents = []
     log_paths = {}
@@ -111,6 +116,9 @@ def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
             rank = rank_base + i
             env = build_env(rank, nranks, m, env_base)
             env["PADDLE_RESTART_COUNT"] = str(restarts_used)
+            if telemetry_dir:
+                env["PADDLE_TELEMETRY_DIR"] = os.path.abspath(
+                    telemetry_dir)
             log_f = log_path = None
             if log_dir:
                 os.makedirs(log_dir, exist_ok=True)
@@ -211,6 +219,10 @@ def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
         "failed_rank": last["rank"] if last else None,
         "failed_log": last["log"] if last else None,
         "logs": dict(log_paths),
+        # where the workers' JSONL event logs landed, so the exit summary
+        # points straight into the step-by-step record of the failure
+        "telemetry_dir": (os.path.abspath(telemetry_dir)
+                          if telemetry_dir else None),
         "duration_s": round(time.time() - t0, 3),
     }
 
@@ -257,6 +269,13 @@ def main(argv=None):
                              "backoff (doubles per incident)")
     parser.add_argument("--started_port", type=int, default=None,
                         help="accepted for reference compatibility")
+    parser.add_argument("--telemetry", nargs="?", const="auto",
+                        default=None, metavar="DIR",
+                        help="enable worker telemetry: sets "
+                             "PADDLE_TELEMETRY_DIR for every worker "
+                             "(DIR, or <log_dir>/telemetry, or "
+                             "./telemetry) and prints the merged "
+                             "cross-rank report on exit")
     parser.add_argument("script", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -278,13 +297,28 @@ def main(argv=None):
     if npp == 1 and args.gpus:
         # reference behavior: one worker per listed device
         npp = len([g for g in args.gpus.split(",") if g.strip()])
+    telemetry_dir = args.telemetry
+    if telemetry_dir == "auto":
+        telemetry_dir = os.path.join(args.log_dir or ".", "telemetry")
     summary = supervise(
         args.script, npp, args.master,
         rank_base=args.rank * npp,
         nranks=args.nnodes * npp,
         log_dir=args.log_dir,
         max_restarts=args.max_restarts,
-        backoff=args.restart_backoff)
+        backoff=args.restart_backoff,
+        telemetry_dir=telemetry_dir)
+    if telemetry_dir:
+        # merged cross-rank view: per-rank step times, stragglers, fault
+        # counters — rendered from the telemetry dir the workers wrote
+        try:
+            from ..observability import aggregate
+            report = aggregate.merge_from_dir(telemetry_dir)
+            summary["telemetry_report"] = report
+            print(aggregate.format_report(report), file=sys.stderr)
+        except Exception as e:                             # noqa: BLE001
+            print(f"paddle_tpu.launch: telemetry report failed: {e}",
+                  file=sys.stderr)
     # machine-readable exit summary: one JSON line, greppable by drivers
     print(json.dumps({"event": "paddle_tpu.launch.exit", **summary}),
           flush=True)

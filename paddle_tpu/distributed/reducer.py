@@ -39,14 +39,19 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-# step-level counters, surfaced through paddle_tpu.profiler
-_reducer_stats = {
+from ..observability import metrics as _metrics
+from ..observability import timeline as _timeline
+
+# step-level counters, surfaced through paddle_tpu.profiler; a VIEW over
+# the observability registry's "reducer" family (same storage —
+# metrics.snapshot() and reducer_stats() read the same cells)
+_reducer_stats = _metrics.stats_family("reducer", {
     "buckets_built": 0,          # buckets partitioned at reducer build
     "collectives_launched": 0,   # one per bucket per step
     "overlap_launches": 0,       # launched from a grad-ready hook
     "finalize_launches": 0,      # launched at end-of-backward finalize
     "zero_filled_params": 0,     # grad-less params contributing zeros
-}
+})
 
 
 def reducer_stats():
@@ -324,7 +329,10 @@ class Reducer:
                 _reducer_stats["zero_filled_params"] += 1
         flat = jnp.concatenate([c.reshape(-1) for c in bucket.contribs]) \
             if len(bucket.contribs) > 1 else bucket.contribs[0].reshape(-1)
-        bucket.pending = self.transport.all_reduce_flat(flat, bucket.index)
+        with _timeline.span("allreduce", bucket=bucket.index,
+                            overlap=from_hook):
+            bucket.pending = self.transport.all_reduce_flat(flat,
+                                                            bucket.index)
         bucket.launched = True
         _reducer_stats["collectives_launched"] += 1
         _reducer_stats["overlap_launches" if from_hook
@@ -338,6 +346,10 @@ class Reducer:
         self._finalize_queued = False
         if not self.enabled:
             return
+        with _timeline.span("allreduce_finalize"):
+            self._finalize_inner()
+
+    def _finalize_inner(self):
         for b in self._buckets:
             if not b.launched:
                 self._launch(b, from_hook=False)

@@ -54,3 +54,35 @@ def tpu_compiler_params(pltpu, **kwargs):
     if cls is None:
         cls = pltpu.TPUCompilerParams
     return cls(**kwargs)
+
+
+# --------------------------------------------------------------------------
+# XLA compile hook (observability)
+# --------------------------------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_hook = [None]
+
+
+def install_compile_hook(callback):
+    """Fire ``callback(kind, seconds)`` once per XLA retrace — i.e. per
+    backend compile of a new executable; cache hits and repeat calls with
+    known signatures never fire.  Rides ``jax.monitoring``'s duration
+    listeners (stable across the jax versions this repo targets); the
+    listener stays registered for the process lifetime, so installation
+    is once-only — a second call replaces the callback rather than
+    stacking listeners.  Returns True on first install."""
+    first = _compile_hook[0] is None
+    _compile_hook[0] = callback
+    if not first:
+        return False
+    from jax import monitoring
+
+    def _listener(event, duration, **kw):
+        if event == _COMPILE_EVENT and _compile_hook[0] is not None:
+            try:
+                _compile_hook[0]("backend_compile", duration)
+            except Exception:                              # noqa: BLE001
+                pass        # telemetry must never break a compile
+    monitoring.register_event_duration_secs_listener(_listener)
+    return True

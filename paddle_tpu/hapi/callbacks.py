@@ -205,32 +205,110 @@ class EarlyStopping(Callback):
                 self.model.stop_training = True
 
 
-class VisualDL(Callback):
-    """Scalar logger writing TSV (the reference writes VisualDL records;
-    TSV keeps it dependency-free and grep-able)."""
+class TelemetryCallback(Callback):
+    """Scalar logger backed by the observability metrics registry: every
+    numeric training-log scalar lands in a registry gauge
+    (``train.<name>``) and — when telemetry is on (PADDLE_TELEMETRY_DIR)
+    — in the rolling JSONL event log as a ``scalar`` event.  With a
+    ``log_dir`` the legacy grep-able ``scalars.tsv`` keeps being written
+    for compatibility (this is what the old VisualDL callback produced)."""
 
-    def __init__(self, log_dir):
+    def __init__(self, log_dir=None):
         super().__init__()
         self.log_dir = log_dir
-        os.makedirs(log_dir, exist_ok=True)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
         self._f = None
         self._step = 0
 
     def on_begin(self, mode, logs=None):
-        if self._f is None:
+        if self._f is None and self.log_dir:
             self._f = open(os.path.join(self.log_dir, "scalars.tsv"), "a")
 
     def on_train_batch_end(self, step, logs=None):
+        from ..observability import metrics, timeline
         self._step += 1
         for k, v in (logs or {}).items():
-            if isinstance(v, (int, float)):
-                self._f.write(f"{self._step}\t{k}\t{v}\n")
-        self._f.flush()
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                metrics.gauge(f"train.{k}").set(v)
+                timeline.emit({"event": "scalar", "name": k,
+                               "value": v, "step": self._step})
+                if self._f is not None:
+                    self._f.write(f"{self._step}\t{k}\t{v}\n")
+        if self._f is not None:
+            self._f.flush()
 
     def on_end(self, mode, logs=None):
         if self._f:
             self._f.close()
             self._f = None
+
+
+class VisualDL(TelemetryCallback):
+    """Scalar logger writing TSV (the reference writes VisualDL records;
+    TSV keeps it dependency-free and grep-able).  Internals now ride the
+    TelemetryCallback registry/JSONL path — the TSV output is unchanged."""
+
+    def __init__(self, log_dir):
+        super().__init__(log_dir)
+
+
+class ProgressBarCallback(Callback):
+    """Throughput readout sourced from an observability StepTimer: wraps
+    every train batch in ``timer.step()`` and prints steps/s (and
+    tokens/s when ``tokens_per_batch`` is given) every ``log_freq``
+    batches.  The per-step records (wall time, compile counts, phase
+    breakdown) ride the StepTimer into the telemetry event log."""
+
+    def __init__(self, log_freq=10, tokens_per_batch=None, verbose=1):
+        super().__init__()
+        self.log_freq = max(int(log_freq), 1)
+        self.tokens_per_batch = tokens_per_batch
+        self.verbose = verbose
+        self._timer = None
+        self._ctx = None
+
+    def _detach(self):
+        """Drop any live step context and timer.  fit() does not notify
+        callbacks when training raises, so a stale timer from an aborted
+        run is also reaped here the next time this callback starts —
+        otherwise it would keep process-wide span instrumentation active
+        forever."""
+        if self._ctx is not None:
+            self._ctx.__exit__(RuntimeError, None, None)   # discard step
+            self._ctx = None
+        if self._timer is not None:
+            self._timer.__exit__(None, None, None)
+            self._timer = None
+
+    def on_train_begin(self, logs=None):
+        from ..observability import StepTimer
+        self._detach()
+        self._timer = StepTimer(name="hapi_train",
+                                tokens_per_step=self.tokens_per_batch)
+        self._timer.__enter__()
+
+    def on_train_batch_begin(self, step, logs=None):
+        if self._timer is not None:
+            if self._ctx is not None:       # previous batch raised
+                self._ctx.__exit__(RuntimeError, None, None)
+            self._ctx = self._timer.step()
+            self._ctx.__enter__()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._ctx is None:
+            return
+        self._ctx.__exit__(None, None, None)
+        self._ctx = None
+        if self.verbose and self._timer.steps % self.log_freq == 0:
+            sps, tps = self._timer.throughput()
+            msg = f"throughput: {sps:.2f} steps/s"
+            if tps is not None:
+                msg += f", {tps:,.0f} tokens/s"
+            print(msg)
+
+    def on_train_end(self, logs=None):
+        self._detach()
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

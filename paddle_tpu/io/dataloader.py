@@ -14,6 +14,8 @@ import threading
 import numpy as np
 
 from .dataset import BatchSampler, IterableDataset
+from ..observability import metrics as _metrics
+from ..observability import timeline as _timeline
 from ..tensor.tensor import Tensor
 
 _worker_tls = threading.local()
@@ -40,8 +42,10 @@ def get_worker_info():
     return getattr(_worker_tls, "info", None)
 
 
-# device-prefetch counters, surfaced through paddle_tpu.profiler
-_prefetch_stats = {"batches": 0, "hits": 0, "misses": 0, "puts": 0}
+# device-prefetch counters, surfaced through paddle_tpu.profiler; a VIEW
+# over the observability registry's "prefetch" family (same storage)
+_prefetch_stats = _metrics.stats_family(
+    "prefetch", {"batches": 0, "hits": 0, "misses": 0, "puts": 0})
 
 
 def prefetch_stats():
@@ -113,9 +117,10 @@ def prefetch_to_device(iterable, depth=1, mesh=None):
 
     def _put(batch):
         _prefetch_stats["puts"] += 1
-        return jax.tree_util.tree_map(
-            lambda x: _device_put_leaf(x, _leaf_sharding(x, mesh)), batch,
-            is_leaf=lambda x: isinstance(x, Tensor))
+        with _timeline.span("h2d_prefetch"):
+            return jax.tree_util.tree_map(
+                lambda x: _device_put_leaf(x, _leaf_sharding(x, mesh)),
+                batch, is_leaf=lambda x: isinstance(x, Tensor))
 
     def _ready(batch):
         leaves = jax.tree_util.tree_leaves(

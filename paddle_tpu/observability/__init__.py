@@ -1,0 +1,21 @@
+"""Unified telemetry layer (ISSUE 4): the structured metrics registry,
+the step-timeline tracer, and cross-rank aggregation.
+
+* :mod:`.metrics` — thread-safe counters/gauges/histograms with labels;
+  the single store behind every legacy ``*_stats()`` family
+  (``metrics.snapshot()``, Prometheus text, JSONL export).
+* :mod:`.timeline` — :class:`StepTimer` + nested spans feeding the
+  chrome-trace exporter and the rolling JSONL event log
+  (``PADDLE_TELEMETRY_DIR``), XLA compile events, device memory.
+* :mod:`.aggregate` — per-rank snapshot publish through the KV store /
+  telemetry dir, and the group-wide merge with straggler detection
+  (``tools/telemetry_report.py`` renders it).
+
+``metrics`` is strictly stdlib so pre-jax modules (the launcher, the
+fault registry, the bootstrap) can register families; ``timeline`` and
+``aggregate`` import jax only lazily inside functions.
+"""
+from . import metrics        # noqa: F401
+from . import timeline       # noqa: F401
+from . import aggregate      # noqa: F401
+from .timeline import StepTimer, span  # noqa: F401

@@ -1,0 +1,378 @@
+"""Cross-rank telemetry aggregation: ranks publish periodic metric
+snapshots (through the jax.distributed coordination-service KV store
+and/or per-rank files in the telemetry dir), and rank 0 — or any offline
+reader (``tools/telemetry_report.py``, ``launch.py --telemetry``) —
+merges them into a group-wide view: per-rank step progress and step-time
+stats, step skew, straggler detection, and fault counters by rank.
+
+Straggler detection keys on **collective wait asymmetry**, the MegaScale
+diagnostic: every rank's rendezvous wait is recorded by the collective
+transport (timeline.record_collective_wait), and a straggler is the rank
+everyone else waits on — its own wait is the LOWEST while the group's is
+high.  A rank whose wait-per-step undercuts the group maximum by more
+than ``PADDLE_TELEMETRY_STRAGGLER`` seconds (default 0.2) is flagged, as
+is any rank lagging the group's step frontier by more than
+``PADDLE_TELEMETRY_STEP_LAG`` steps (default 2).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+import warnings
+
+from . import metrics, timeline
+
+KV_PREFIX = "paddle_tpu_telemetry"
+
+_publish_seq = [0]
+_last_kv_key = {}          # rank -> this incarnation's last published key
+
+
+def _next_seq():
+    """Monotonic across SUPERVISED RESTARTS, not just within this
+    process: a relaunched worker's fresh counter must still outrank its
+    pre-crash publishes (gather() keeps the highest seq per rank), so
+    the sequence is wall-clock-derived with a strictly-increasing
+    fallback for publishes landing in the same millisecond."""
+    seq = max(int(time.time() * 1000), _publish_seq[0] + 1)
+    _publish_seq[0] = seq
+    return seq
+
+
+def _default_straggler_gap():
+    try:
+        return float(os.environ.get("PADDLE_TELEMETRY_STRAGGLER", "0.2"))
+    except ValueError:
+        return 0.2
+
+
+def _default_step_lag():
+    try:
+        return int(os.environ.get("PADDLE_TELEMETRY_STEP_LAG", "2"))
+    except ValueError:
+        return 2
+
+
+def _kv_client():
+    """The live coordination-service client, or None outside a
+    multi-process launch."""
+    try:
+        import jax
+        if jax.process_count() <= 1:
+            return None
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:                                      # noqa: BLE001
+        return None
+
+
+# --------------------------------------------------------------------------
+# per-rank snapshots
+# --------------------------------------------------------------------------
+
+def snapshot_record(step=None, rank=None):
+    """This rank's publishable telemetry snapshot: registry families,
+    step-time summary, compile + collective-wait totals."""
+    hist = metrics.histogram("step.wall_s")
+    return {
+        "rank": _rank() if rank is None else int(rank),
+        "time": round(time.time(), 6),
+        "step": step,
+        "steps": hist.count,
+        "step_wall": hist.summary(),
+        "compiles": metrics.counter("compile.count").value,
+        "compile_s": round(metrics.counter("compile.seconds").value, 6),
+        "collective_wait_s": round(
+            metrics.counter("collective.wait_s").value, 6),
+        "families": metrics.families(),
+    }
+
+
+def _rank():
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def publish(step=None, client=None, rank=None):
+    """Publish this rank's snapshot: atomically to
+    ``<telemetry_dir>/snapshot_rank<R>.json`` when a telemetry dir is
+    active, and to the KV store under a fresh sequence key (the previous
+    one is deleted best-effort so per-interval publishes don't grow the
+    coordinator's store).  Returns the snapshot dict."""
+    snap = snapshot_record(step=step, rank=rank)
+    d = timeline.telemetry_dir()
+    if d:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"snapshot_rank{snap['rank']}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, sort_keys=True)
+        os.replace(tmp, path)
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        key = f"{KV_PREFIX}/r{snap['rank']}/{_next_seq()}"
+        try:
+            client.key_value_set(key, json.dumps(snap, sort_keys=True))
+            prev = _last_kv_key.get(snap["rank"])
+            if prev is not None:
+                try:                 # reclaim THIS incarnation's previous
+                    client.key_value_delete(prev)   # key (bounded store);
+                except Exception:                          # noqa: BLE001
+                    pass             # a crashed incarnation leaves one
+            _last_kv_key[snap["rank"]] = key        # stale, shadowed key
+        except Exception:                                  # noqa: BLE001
+            pass            # telemetry publish must never fail training
+    return snap
+
+
+def gather(client=None):
+    """Every rank's LATEST published KV snapshot (highest sequence per
+    rank), as a list sorted by rank.  [] when no client / nothing
+    published."""
+    client = client if client is not None else _kv_client()
+    if client is None:
+        return []
+    try:
+        entries = client.key_value_dir_get(KV_PREFIX)
+    except Exception:                                      # noqa: BLE001
+        return []
+    latest = {}
+    for key, value in entries:
+        parts = key.split("/")
+        try:
+            rank = int(parts[-2].lstrip("r"))
+            seq = int(parts[-1])
+        except (IndexError, ValueError):
+            continue
+        if rank not in latest or seq > latest[rank][0]:
+            latest[rank] = (seq, value)
+    out = []
+    for rank in sorted(latest):
+        try:
+            out.append(json.loads(latest[rank][1]))
+        except ValueError:
+            continue
+    return out
+
+
+# --------------------------------------------------------------------------
+# merge
+# --------------------------------------------------------------------------
+
+def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
+    """Merge per-rank snapshots into the group-wide report.
+
+    Returns a dict with per-rank step progress and step-time stats
+    (mean/p50/p95), the group step skew, flagged ``stragglers`` (each
+    naming the rank and why), and per-rank fault counters.  With
+    ``warn=True`` every straggler also raises a RuntimeWarning — the
+    live rank-0 merge path."""
+    if straggler_gap_s is None:
+        straggler_gap_s = _default_straggler_gap()
+    if step_lag is None:
+        step_lag = _default_step_lag()
+    ranks = {}
+    for snap in snapshots:
+        r = int(snap.get("rank", 0))
+        wall = snap.get("step_wall") or {}
+        steps = snap.get("steps") or 0
+        faults = {}
+        fams = snap.get("families") or {}
+        for fam in ("faults", "watchdog", "launch", "checkpoint",
+                    "bootstrap"):
+            for k, v in (fams.get(fam) or {}).items():
+                if v:
+                    faults[f"{fam}.{k}"] = v
+        ranks[r] = {
+            "step": snap.get("step"),
+            "steps": steps,
+            "time": snap.get("time"),
+            "step_wall_mean_s": wall.get("mean"),
+            "step_wall_p50_s": wall.get("p50"),
+            "step_wall_p95_s": wall.get("p95"),
+            "compiles": snap.get("compiles"),
+            "compile_s": snap.get("compile_s"),
+            "collective_wait_s": snap.get("collective_wait_s"),
+            "wait_per_step_s": (
+                round(snap.get("collective_wait_s", 0.0) / steps, 6)
+                if steps else None),
+            "faults": faults,
+        }
+    report = {"generated_at": round(time.time(), 6),
+              "nranks_seen": len(ranks),
+              "ranks": ranks, "step_skew": None, "stragglers": []}
+    if not ranks:
+        return report
+
+    steps_seen = [v["steps"] for v in ranks.values()]
+    report["step_skew"] = max(steps_seen) - min(steps_seen)
+
+    # step-frontier lag
+    frontier = max(steps_seen)
+    for r, v in sorted(ranks.items()):
+        if frontier - v["steps"] > step_lag:
+            report["stragglers"].append({
+                "rank": r, "reason": "step_lag",
+                "detail": f"rank {r} is at step {v['steps']}, "
+                          f"{frontier - v['steps']} behind the group "
+                          f"frontier ({frontier})"})
+
+    # collective-wait asymmetry: the rank peers wait ON waits the least
+    waits = {r: v["wait_per_step_s"] for r, v in ranks.items()
+             if v["wait_per_step_s"] is not None}
+    if len(waits) >= 2:
+        lo_rank = min(waits, key=waits.get)
+        gap = max(waits.values()) - waits[lo_rank]
+        if gap > straggler_gap_s:
+            report["stragglers"].append({
+                "rank": lo_rank, "reason": "collective_wait_asymmetry",
+                "detail": f"rank {lo_rank} waits {waits[lo_rank]:.3f}s/"
+                          f"step at collectives while the slowest-"
+                          f"arriving peer waits {max(waits.values()):.3f}"
+                          f"s/step — peers are stalled on rank "
+                          f"{lo_rank} (gap {gap:.3f}s > "
+                          f"{straggler_gap_s:.3f}s threshold)"})
+    if warn:
+        for s in report["stragglers"]:
+            warnings.warn(
+                f"telemetry straggler: {s['detail']}", RuntimeWarning,
+                stacklevel=2)
+    return report
+
+
+# --------------------------------------------------------------------------
+# offline: merge from a telemetry directory
+# --------------------------------------------------------------------------
+
+def _steps_from_events(path):
+    """Per-step records from one rank's events JSONL (rotated generation
+    first so step order is preserved)."""
+    steps = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("event") == "step":
+                    steps.append(rec)
+    return steps
+
+
+def snapshots_from_dir(directory):
+    """Reconstruct per-rank snapshots from a telemetry dir: the published
+    ``snapshot_rank*.json`` files merged with (and, for step stats,
+    recomputed from) the per-step records in ``events_rank*.jsonl``."""
+    snaps = {}
+    for path in sorted(glob.glob(
+            os.path.join(directory, "snapshot_rank*.json"))):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+            snaps[int(snap.get("rank", 0))] = snap
+        except (ValueError, OSError):
+            continue
+    for path in sorted(glob.glob(
+            os.path.join(directory, "events_rank*.jsonl"))):
+        base = os.path.basename(path)
+        try:
+            rank = int(base[len("events_rank"):-len(".jsonl")])
+        except ValueError:
+            continue
+        records = _steps_from_events(path)
+        if not records and rank not in snaps:
+            continue
+        snap = snaps.setdefault(rank, {"rank": rank, "families": {}})
+        if records:
+            # a restarted incarnation resumes from its checkpoint and
+            # REPLAYS steps into the same appended log: dedupe by (timer
+            # name, step number) — last record wins — so progress and
+            # step stats count each training step once, not once per
+            # incarnation, while distinct timers in one process (train
+            # loop + hapi fit) keep their own step sequences
+            by_step = {}
+            for rec in records:
+                by_step[(str(rec.get("name")), rec.get("step"))] = rec
+            steps = [by_step[k] for k in sorted(by_step)]
+            walls = sorted(s["wall_s"] for s in steps)
+
+            def pct(p):
+                r = max(int(-(-p / 100.0 * len(walls) // 1)), 1)
+                return walls[min(r, len(walls)) - 1]
+
+            last = max(steps, key=lambda s: s.get("time") or 0)
+            snap.update({
+                "time": last.get("time"),
+                "step": last.get("step"),
+                "steps": len(steps),
+                "step_wall": {"count": len(walls),
+                              "sum": round(sum(walls), 9),
+                              "min": walls[0], "max": walls[-1],
+                              "mean": sum(walls) / len(walls),
+                              "p50": pct(50), "p95": pct(95)},
+            })
+            # counter totals: the published snapshot's registry values
+            # are authoritative (they include out-of-step compiles and
+            # records lost to rotation); the per-step sums — over EVERY
+            # record, replays included, since counters reset with the
+            # process — only fill in or raise them
+            for key, total in (
+                    ("compiles",
+                     sum(s.get("compiles") or 0 for s in records)),
+                    ("compile_s", round(
+                        sum(s.get("compile_s") or 0.0
+                            for s in records), 6)),
+                    ("collective_wait_s", round(
+                        sum(s.get("collective_wait_s") or 0.0
+                            for s in records), 6))):
+                snap[key] = max(snap.get(key) or 0, total)
+    return [snaps[r] for r in sorted(snaps)]
+
+
+def merge_from_dir(directory, straggler_gap_s=None, step_lag=None,
+                   warn=False):
+    """The offline merge: reconstruct snapshots from a telemetry dir and
+    merge them (tools/telemetry_report.py and launch --telemetry)."""
+    report = merge(snapshots_from_dir(directory),
+                   straggler_gap_s=straggler_gap_s, step_lag=step_lag,
+                   warn=warn)
+    report["telemetry_dir"] = os.path.abspath(directory)
+    return report
+
+
+def format_report(report):
+    """Human-readable text rendering of a merged report."""
+    lines = ["== paddle_tpu telemetry report =="]
+    if report.get("telemetry_dir"):
+        lines.append(f"telemetry dir: {report['telemetry_dir']}")
+    lines.append(f"ranks seen: {report['nranks_seen']}   "
+                 f"step skew: {report['step_skew']}")
+    for r, v in sorted((report.get("ranks") or {}).items()):
+        def fmt(x, scale=1e3, suffix="ms"):
+            return f"{x * scale:.1f}{suffix}" if x is not None else "-"
+        lines.append(
+            f"  rank {r}: steps={v['steps']} "
+            f"mean={fmt(v['step_wall_mean_s'])} "
+            f"p50={fmt(v['step_wall_p50_s'])} "
+            f"p95={fmt(v['step_wall_p95_s'])} "
+            f"compiles={v.get('compiles')} "
+            f"collective_wait={fmt(v.get('collective_wait_s'), 1, 's')}")
+        if v.get("faults"):
+            faults = ", ".join(f"{k}={n}" for k, n in
+                               sorted(v["faults"].items()))
+            lines.append(f"          faults: {faults}")
+    if report.get("stragglers"):
+        lines.append("  STRAGGLERS:")
+        for s in report["stragglers"]:
+            lines.append(f"    rank {s['rank']} [{s['reason']}]: "
+                         f"{s['detail']}")
+    else:
+        lines.append("  no stragglers detected")
+    return "\n".join(lines)

@@ -1,0 +1,419 @@
+"""Structured metrics registry — the single store behind every host-side
+counter family in paddle_tpu (design after the Prometheus client-library
+data model and MegaScale's per-step diagnostics).
+
+PRs 1–3 each grew an ad-hoc module-level stat dict (``dispatch_cache``,
+``fused_step``, ``reducer``, ``prefetch`` and the composite ``faults``
+family), reachable only through ``profiler.fast_path_summary()``.  This
+registry absorbs them: each module's stat dict is now a
+:class:`StatsFamily` — a mutable-mapping VIEW whose storage IS the
+registry's counters — so the old ``*_stats()`` functions, the bench
+assertions and ``fast_path_summary()`` keep working unchanged while
+``metrics.snapshot()`` / ``to_prometheus()`` / ``export_jsonl()`` see the
+same numbers with no dual bookkeeping.
+
+Metric types:
+
+* :class:`Counter` — monotonic int/float, ``inc()`` under the registry
+  lock (threaded increments lose nothing).
+* :class:`Gauge` — last-write-wins scalar.
+* :class:`Histogram` — count/sum/min/max + cumulative buckets for the
+  Prometheus exposition, plus a bounded reservoir of raw observations so
+  ``percentile(50)`` / ``percentile(95)`` report real quantiles (the
+  bench step-time p50/p95), not bucket midpoints.
+
+Labels: ``counter("name", rank="0")`` keys the metric on (name, sorted
+label items); the same name with different labels is a distinct series,
+exactly the Prometheus model.
+
+Strictly stdlib: this module is imported by ``_dist_bootstrap``,
+``testing/faults.py`` and the launcher — all of which must be importable
+before jax initializes a backend.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections.abc import MutableMapping
+
+# default histogram bucket bounds (seconds-flavored, exponential): wide
+# enough for step times and compile times alike
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+_RESERVOIR_CAP = 4096
+
+
+def _label_key(labels):
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _series_name(name, label_key):
+    if not label_key:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in label_key)
+    return f"{name}{{{inner}}}"
+
+
+def _prom_name(name):
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, registry, name, label_key):
+        self._registry = registry
+        self._lock = registry._lock
+        self.name = name
+        self.labels = dict(label_key)
+        self._label_key = label_key
+
+    @property
+    def series(self):
+        return _series_name(self.name, self._label_key)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, registry, name, label_key):
+        super().__init__(registry, name, label_key)
+        self._value = 0
+
+    def inc(self, v=1):
+        with self._lock:
+            self._value += v
+
+    def set(self, v):
+        """Assignment exists for the legacy dict-view ``stats[k] = 0``
+        reset idiom; new code should only ever ``inc()``."""
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def reset(self):
+        self.set(0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, registry, name, label_key):
+        super().__init__(registry, name, label_key)
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, v=1):
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def reset(self):
+        self.set(0.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, label_key, buckets=None):
+        super().__init__(registry, name, label_key)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._counts = [0] * (len(self.buckets) + 1)   # +inf tail
+        self._reservoir = []
+        self._res_next = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            i = 0
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    break
+            else:
+                i = len(self.buckets)
+            self._counts[i] += 1
+            # bounded ring: recent observations win (a rolling window is
+            # what step-time percentiles should describe anyway)
+            if len(self._reservoir) < _RESERVOIR_CAP:
+                self._reservoir.append(v)
+            else:
+                self._reservoir[self._res_next] = v
+                self._res_next = (self._res_next + 1) % _RESERVOIR_CAP
+
+    def percentile(self, p):
+        """Nearest-rank percentile over the (bounded) reservoir of raw
+        observations; None with no data."""
+        with self._lock:
+            data = sorted(self._reservoir)
+        if not data:
+            return None
+        rank = max(int(-(-p / 100.0 * len(data) // 1)), 1)  # ceil
+        return data[min(rank, len(data)) - 1]
+
+    @property
+    def mean(self):
+        with self._lock:
+            return self.sum / self.count if self.count else None
+
+    def cumulative_buckets(self):
+        """[(le, cumulative_count), ...] ending with ('+Inf', count)."""
+        with self._lock:
+            out, acc = [], 0
+            for le, c in zip(self.buckets, self._counts):
+                acc += c
+                out.append((le, acc))
+            out.append(("+Inf", acc + self._counts[-1]))
+            return out
+
+    def summary(self):
+        with self._lock:
+            n = self.count
+            s = {"count": n, "sum": round(self.sum, 9),
+                 "min": self.min, "max": self.max,
+                 "mean": (self.sum / n if n else None)}
+        s["p50"] = self.percentile(50)
+        s["p95"] = self.percentile(95)
+        return s
+
+    def reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._reservoir = []
+            self._res_next = 0
+            self.count = 0
+            self.sum = 0.0
+            self.min = self.max = None
+
+
+class StatsFamily(MutableMapping):
+    """Dict-shaped VIEW over a group of registry counters (one family of
+    related keys, e.g. ``reducer``).  The legacy module-level stat dicts
+    are these: ``stats["hits"] += 1``, ``dict(stats)``, iteration and
+    ``update()`` all behave like the plain dict they replaced, but the
+    storage is the registry's counters — ``metrics.snapshot()`` and the
+    old ``*_stats()`` views read the same cells."""
+
+    def __init__(self, registry, family, defaults=None):
+        self._registry = registry
+        self.family = family
+        self._counters = {}
+        for k, v in (defaults or {}).items():
+            c = registry.counter(f"{family}.{k}")
+            if v:
+                c.set(v)
+            self._counters[k] = c
+
+    def _counter(self, key):
+        c = self._counters.get(key)
+        if c is None:
+            c = self._counters[key] = self._registry.counter(
+                f"{self.family}.{key}")
+        return c
+
+    def __getitem__(self, key):
+        return self._counters[key].value
+
+    def __setitem__(self, key, value):
+        self._counter(key).set(value)
+
+    def __delitem__(self, key):
+        del self._counters[key]
+
+    def __iter__(self):
+        return iter(self._counters)
+
+    def __len__(self):
+        return len(self._counters)
+
+    def inc(self, key, v=1):
+        """Atomic increment — preferred over ``stats[k] += 1`` (which is
+        a read-then-write) for counters bumped from several threads."""
+        self._counter(key).inc(v)
+
+    def reset(self):
+        for c in self._counters.values():
+            c.reset()
+
+
+class MetricsRegistry:
+    """Thread-safe name->metric store.  One process-wide instance
+    (``metrics.REGISTRY``) backs every paddle_tpu counter; private
+    instances exist only for tests."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = {}          # (name, label_key) -> metric
+        self._families = {}         # family name -> StatsFamily
+
+    # ------------------------------------------------------- constructors
+    def _get_or_create(self, cls, name, labels, **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(self, name, key[1], **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {key[0]!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name, **labels):
+        return self._get_or_create(Counter, name, labels)
+
+    def gauge(self, name, **labels):
+        return self._get_or_create(Gauge, name, labels)
+
+    def histogram(self, name, buckets=None, **labels):
+        return self._get_or_create(Histogram, name, labels, buckets=buckets)
+
+    def stats_family(self, family, defaults=None):
+        """Get-or-create the dict-view for ``family``; re-registration
+        merges any new default keys (module reloads in tests)."""
+        with self._lock:
+            fam = self._families.get(family)
+            if fam is None:
+                fam = self._families[family] = StatsFamily(
+                    self, family, defaults)
+            else:
+                for k in (defaults or {}):
+                    fam._counter(k)
+            return fam
+
+    # ------------------------------------------------------------- views
+    def _sorted_metrics(self):
+        with self._lock:
+            return sorted(self._metrics.values(),
+                          key=lambda m: (m.name, m._label_key))
+
+    def snapshot(self):
+        """Flat ``{series_name: value}`` — counters/gauges by value,
+        histograms by their summary dict."""
+        out = {}
+        for m in self._sorted_metrics():
+            out[m.series] = (m.summary() if isinstance(m, Histogram)
+                             else m.value)
+        return out
+
+    def families(self):
+        """``{family: {key: value}}`` for every registered StatsFamily —
+        the exact numbers the legacy ``*_stats()`` views serve."""
+        with self._lock:
+            fams = list(self._families.values())
+        return {f.family: dict(f) for f in fams}
+
+    def reset(self, family=None):
+        """Zero every metric (or only one family's counters).  The one
+        sanctioned replacement for the per-family ``reset_*_stats()``
+        helpers."""
+        if family is not None:
+            with self._lock:
+                fam = self._families.get(family)
+            if fam is not None:
+                fam.reset()
+            return
+        for m in self._sorted_metrics():
+            m.reset()
+
+    # ------------------------------------------------------------ exports
+    def to_prometheus(self):
+        """Prometheus text exposition (v0.0.4) of every metric."""
+        lines = []
+        seen_types = set()
+        for m in self._sorted_metrics():
+            pname = _prom_name(m.name)
+            if pname not in seen_types:
+                seen_types.add(pname)
+                lines.append(f"# TYPE {pname} {m.kind}")
+            label_s = ("{" + ",".join(f'{_prom_name(k)}="{v}"'
+                                      for k, v in m._label_key) + "}"
+                       if m._label_key else "")
+            if isinstance(m, Histogram):
+                base = m._label_key
+                for le, acc in m.cumulative_buckets():
+                    le_s = le if le == "+Inf" else repr(float(le))
+                    extra = base + (("le", le_s),)
+                    inner = ",".join(f'{_prom_name(k)}="{v}"'
+                                     for k, v in extra)
+                    lines.append(f"{pname}_bucket{{{inner}}} {acc}")
+                lines.append(f"{pname}_sum{label_s} {m.sum}")
+                lines.append(f"{pname}_count{label_s} {m.count}")
+            else:
+                lines.append(f"{pname}{label_s} {m.value}")
+        return "\n".join(lines) + "\n"
+
+    def export_jsonl(self):
+        """One JSON object per metric (machine-ingestable lines for the
+        telemetry event log): name, labels, type, value/summary."""
+        now = time.time()
+        lines = []
+        for m in self._sorted_metrics():
+            rec = {"event": "metric", "time": round(now, 6),
+                   "name": m.name, "type": m.kind, "labels": m.labels}
+            if isinstance(m, Histogram):
+                rec["summary"] = m.summary()
+            else:
+                rec["value"] = m.value
+            lines.append(json.dumps(rec, sort_keys=True))
+        return lines
+
+
+# the process-wide registry every paddle_tpu family registers into
+REGISTRY = MetricsRegistry()
+
+
+def counter(name, **labels):
+    return REGISTRY.counter(name, **labels)
+
+
+def gauge(name, **labels):
+    return REGISTRY.gauge(name, **labels)
+
+
+def histogram(name, buckets=None, **labels):
+    return REGISTRY.histogram(name, buckets=buckets, **labels)
+
+
+def stats_family(family, defaults=None):
+    return REGISTRY.stats_family(family, defaults)
+
+
+def snapshot():
+    return REGISTRY.snapshot()
+
+
+def families():
+    return REGISTRY.families()
+
+
+def reset(family=None):
+    REGISTRY.reset(family)
+
+
+def to_prometheus():
+    return REGISTRY.to_prometheus()
+
+
+def export_jsonl():
+    return REGISTRY.export_jsonl()

@@ -238,11 +238,13 @@ class _DispatchCache:
         self.bad_keys = set()      # signatures whose compile attempt failed
         self.fail_counts = {}      # fn token -> distinct failing signatures
         self.seen = {}             # key -> sighting count below warmup
-        self.hits = 0
-        self.misses = 0
-        self.fallbacks = 0
-        self.warming = 0
-        self.evictions = 0
+        # counters live in the observability registry (the module-level
+        # "dispatch_cache" family); this dict-view IS the storage, so
+        # cache_stats() and metrics.snapshot() read the same cells
+        from ..observability import metrics as _metrics
+        self.stats = _metrics.stats_family("dispatch_cache", {
+            "hits": 0, "misses": 0, "fallbacks": 0, "warming": 0,
+            "evictions": 0})
 
     def maxsize(self):
         try:
@@ -267,7 +269,7 @@ class _DispatchCache:
             e = self.entries.get(key)
             if e is not None:
                 self.entries.move_to_end(key)
-                self.hits += 1
+                self.stats["hits"] += 1
             return e
 
     def insert(self, key, entry):
@@ -277,7 +279,7 @@ class _DispatchCache:
             cap = self.maxsize()
             while len(self.entries) > cap:
                 self.entries.popitem(last=False)
-                self.evictions += 1
+                self.stats["evictions"] += 1
 
 
 _cache = _DispatchCache()
@@ -289,20 +291,19 @@ def cache_enabled():
 
 def cache_stats():
     """Hit/miss/retrace counters (a miss IS a retrace: it traces+compiles
-    a new executable).  Surfaced via paddle_tpu.profiler."""
+    a new executable).  A registry view: the counters live in the
+    observability metrics registry's ``dispatch_cache`` family; size and
+    blacklisted are computed live."""
     with _cache.lock:
-        return {"hits": _cache.hits, "misses": _cache.misses,
-                "fallbacks": _cache.fallbacks,
-                "warming": _cache.warming,
-                "evictions": _cache.evictions,
-                "size": len(_cache.entries),
-                "blacklisted": len(_cache.blacklist)}
+        out = dict(_cache.stats)
+        out["size"] = len(_cache.entries)
+        out["blacklisted"] = len(_cache.blacklist)
+        return out
 
 
 def reset_cache_stats():
     with _cache.lock:
-        _cache.hits = _cache.misses = 0
-        _cache.fallbacks = _cache.warming = _cache.evictions = 0
+        _cache.stats.reset()
 
 
 def clear_cache(blacklist=False):
@@ -347,12 +348,12 @@ def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
     fn_tok = _fn_token(fn)
     if fn_tok is None or fn_tok in _cache.blacklist:
         with _cache.lock:
-            _cache.fallbacks += 1
+            _cache.stats["fallbacks"] += 1
         return _MISS
     dyn_pos, leaf_toks = _leaf_tokens(leaves, Tensor)
     if dyn_pos is None:
         with _cache.lock:
-            _cache.fallbacks += 1
+            _cache.stats["fallbacks"] += 1
         return _MISS
 
     key = (fn_tok, treedef, leaf_toks, tuple(diff_pos), record, amp_tok,
@@ -360,12 +361,12 @@ def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
     try:
         if key in _cache.bad_keys:      # known-failing signature
             with _cache.lock:
-                _cache.fallbacks += 1
+                _cache.stats["fallbacks"] += 1
             return _MISS
         entry = _cache.lookup(key)
     except TypeError:                   # unhashable despite screening
         with _cache.lock:
-            _cache.fallbacks += 1
+            _cache.stats["fallbacks"] += 1
         return _MISS
 
     if entry is None:
@@ -377,7 +378,7 @@ def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
                 _cache.seen[key] = n
                 if len(_cache.seen) > 8 * _cache.maxsize():
                     _cache.seen.clear()
-                _cache.warming += 1
+                _cache.stats["warming"] += 1
                 return _MISS
             _cache.seen.pop(key, None)
 
@@ -412,7 +413,7 @@ def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
                 _cache.fail_counts[fn_tok] = n_bad
                 if n_bad >= 3:
                     _cache.blacklist.add(fn_tok)
-                _cache.fallbacks += 1
+                _cache.stats["fallbacks"] += 1
             return _MISS
         if core.rng_draw_count() != rng0:
             # the primitive drew from the HOST generator while tracing —
@@ -425,7 +426,7 @@ def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
             entry.multi = isinstance(out_probe, (tuple, list))
             _cache.insert(key, entry)
             with _cache.lock:
-                _cache.misses += 1
+                _cache.stats["misses"] += 1
         multi = isinstance((res[0] if record else res), (tuple, list))
     else:
         res = entry.compiled(dyn_vals)

@@ -29,11 +29,15 @@ import jax
 import jax.numpy as jnp
 
 from ..framework import core
+from ..observability import metrics as _metrics
+from ..observability import timeline as _timeline
 from ..tensor.tensor import Tensor, Parameter
 from .lr import LRScheduler
 
-# fused-step counters, surfaced through paddle_tpu.profiler
-_fused_stats = {"calls": 0, "compiles": 0, "eager_steps": 0}
+# fused-step counters, surfaced through paddle_tpu.profiler; a VIEW over
+# the observability registry's "fused_step" family (same storage)
+_fused_stats = _metrics.stats_family(
+    "fused_step", {"calls": 0, "compiles": 0, "eager_steps": 0})
 
 
 def reset_fused_stats():
@@ -181,7 +185,8 @@ class Optimizer:
             if p is None or p.stop_gradient or p._grad is None:
                 continue
             params_grads.append((p, p._grad))
-        self._apply_gradients(params_grads)
+        with _timeline.span("optimizer_step"):
+            self._apply_gradients(params_grads)
 
     # ------------------------------------------------------- fused step
     def _fused_enabled(self):
@@ -306,6 +311,10 @@ class Optimizer:
         subset-group non-member buckets) ride the same compiled call with
         their direct ``.grad``.  Any failure before state mutation falls
         back to eager unbucketing + the normal step."""
+        with _timeline.span("optimizer_step", fused_buckets=True):
+            return self._step_from_buckets_impl(flats, layout, scale)
+
+    def _step_from_buckets_impl(self, flats, layout, scale):
         in_layout = {id(p) for p, *_ in layout}
         extras = [(p, p._grad) for p in self._parameters
                   if p is not None and not p.stop_gradient

@@ -14,9 +14,13 @@ import contextlib
 import json
 import threading
 import time
+import warnings
 from collections import defaultdict
 
 import jax
+
+from .observability import metrics
+from .observability import timeline as _timeline
 
 _op_times = defaultdict(float)
 _op_counts = defaultdict(int)
@@ -40,6 +44,9 @@ def start_profiler(state="All", tracer_option="Default", log_dir=None):
     global _enabled, _t0
     _enabled = True
     _t0 = time.perf_counter()
+    # compile events must reach the trace: retraces during the profiled
+    # window appear as xla_compile events (observability/timeline.py)
+    _timeline.install_compile_hook()
     if log_dir:
         jax.profiler.start_trace(log_dir)
     reset_profiler()
@@ -145,9 +152,11 @@ class Profiler:
         self.on_trace_ready = on_trace_ready
         self.log_dir = log_dir
         self._step = 0
+        self._step_t0 = None
 
     def start(self):
         start_profiler(log_dir=self.log_dir)
+        self._step_t0 = time.perf_counter()
         return self
 
     def stop(self):
@@ -157,8 +166,15 @@ class Profiler:
         return result
 
     def step(self):
+        """Close the span covering everything since the previous step()
+        (or start()) and open the next one — exported chrome traces show
+        real step boundaries, not the zero-duration markers this used to
+        record."""
         self._step += 1
-        record_op("profiler_step", 0.0, t_start=time.perf_counter())
+        now = time.perf_counter()
+        t0 = self._step_t0 if self._step_t0 is not None else now
+        record_op("profiler_step", now - t0, t_start=t0)
+        self._step_t0 = now
 
     def step_num(self):
         return self._step
@@ -183,7 +199,27 @@ def trace(log_dir):
 
 # --------------------------------------------------------------------------
 # Eager fast-path counters (dispatch jit-cache + fused optimizer step)
+#
+# Every family below is a VIEW over the observability metrics registry
+# (paddle_tpu.observability.metrics): the module-level stat dicts ARE
+# registry-backed, so these functions, metrics.snapshot() and the
+# Prometheus/JSONL exports all read the same cells — no dual bookkeeping.
 # --------------------------------------------------------------------------
+
+_deprecated_reset_warned = set()
+
+
+def _warn_reset_deprecated(name, family):
+    if name in _deprecated_reset_warned:
+        return
+    _deprecated_reset_warned.add(name)
+    warnings.warn(
+        f"profiler.{name}() is deprecated: the per-family reset helpers "
+        f"are served by the observability metrics registry — use "
+        f"paddle_tpu.observability.metrics.reset({family!r}) (or "
+        f"metrics.reset() for everything)", DeprecationWarning,
+        stacklevel=3)
+
 
 def dispatch_cache_stats():
     """Hit/miss/retrace counters of the eager dispatch executable cache
@@ -194,6 +230,7 @@ def dispatch_cache_stats():
 
 
 def reset_dispatch_cache_stats():
+    _warn_reset_deprecated("reset_dispatch_cache_stats", "dispatch_cache")
     from .ops import dispatch
     dispatch.reset_cache_stats()
 
@@ -207,8 +244,8 @@ def fused_step_stats():
 
 
 def reset_fused_step_stats():
-    from .optimizer import optimizer as _opt
-    _opt.reset_fused_stats()
+    _warn_reset_deprecated("reset_fused_step_stats", "fused_step")
+    metrics.reset("fused_step")
 
 
 def reducer_stats():
@@ -222,8 +259,8 @@ def reducer_stats():
 
 
 def reset_reducer_stats():
-    from .distributed import reducer as _red
-    _red.reset_reducer_stats()
+    _warn_reset_deprecated("reset_reducer_stats", "reducer")
+    metrics.reset("reducer")
 
 
 def prefetch_stats():
@@ -235,8 +272,8 @@ def prefetch_stats():
 
 
 def reset_prefetch_stats():
-    from .io import dataloader as _dl
-    _dl.reset_prefetch_stats()
+    _warn_reset_deprecated("reset_prefetch_stats", "prefetch")
+    metrics.reset("prefetch")
 
 
 def faults_stats():

@@ -37,8 +37,9 @@ occurrence is deterministic run to run.  ``rank``/``restart`` filters
 read ``PADDLE_TRAINER_ID``/``PADDLE_RESTART_COUNT`` at fire time, i.e.
 the identity the launcher's supervisor assigned this incarnation.
 
-Only stdlib imports: the registry must be consultable before jax (and
-paddle_tpu proper) are importable or initialized.
+Only stdlib imports (plus the stdlib-only observability metrics
+registry): the registry must be consultable before jax (and paddle_tpu
+proper) are importable or initialized.
 """
 from __future__ import annotations
 
@@ -46,10 +47,14 @@ import os
 import sys
 import time
 
+from ..observability import metrics as _metrics
+
 _registry: list[dict] = []
 _env_loaded = [False]
 
-_stats = {"faults_installed": 0, "faults_fired": 0}
+# a VIEW over the observability registry's "faults" family (same storage)
+_stats = _metrics.stats_family(
+    "faults", {"faults_installed": 0, "faults_fired": 0})
 
 
 class InjectedFault(RuntimeError):

@@ -70,17 +70,24 @@ def main(argv=None):
             json.dump({"time": time.time(), "resumed_step": start,
                        "rank": rank}, f)
 
-    for step in range(start + 1, args.steps + 1):
-        faults.kill_check(step)            # chaos: die here if told to
-        rng = np.random.RandomState(9000 + step)   # same data, every rank
-        x = paddle.to_tensor(rng.randn(8, args.width).astype(np.float32))
-        y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
-        loss = paddle.nn.functional.mse_loss(model(x), y)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        if rank == 0:
-            mgr.save(step, model=net, optimizer=opt)
+    # step-timeline telemetry: per-step records land in the JSONL event
+    # log when the launcher set PADDLE_TELEMETRY_DIR (the harness merges
+    # them into the cross-rank report)
+    from paddle_tpu.observability import StepTimer
+    with StepTimer(name="recovery_worker", start_step=start) as timer:
+        for step in range(start + 1, args.steps + 1):
+            faults.kill_check(step)        # chaos: die here if told to
+            rng = np.random.RandomState(9000 + step)  # same data each rank
+            x = paddle.to_tensor(rng.randn(8, args.width)
+                                 .astype(np.float32))
+            y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+            with timer.step():
+                loss = paddle.nn.functional.mse_loss(model(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            if rank == 0:
+                mgr.save(step, model=net, optimizer=opt)
     mgr.wait()                             # all checkpoints published
 
     np.savez(os.path.join(args.out, f"params_rank{rank}.npz"),

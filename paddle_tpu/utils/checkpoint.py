@@ -43,19 +43,22 @@ import numpy as np
 
 from ..io.serialization import load as _load, save as _save
 from ..framework import core
+from ..observability import metrics as _metrics
+from ..observability import timeline as _timeline
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 _SEQ_FILE = "save_seq"    # monotonic publish-order counter (one int)
 _DIGEST_FILE = "digests.json"
 
-# fault-tolerance counters, surfaced through profiler.fast_path_summary()
-_ckpt_stats = {
+# fault-tolerance counters, surfaced through profiler.fast_path_summary();
+# a VIEW over the observability registry's "checkpoint" family
+_ckpt_stats = _metrics.stats_family("checkpoint", {
     "async_saves": 0,            # background (non-blocking) publishes
     "sync_saves": 0,
     "digest_failures": 0,        # files whose content hash mismatched
     "checkpoints_quarantined": 0,  # dirs renamed to step_N.corrupt
     "restore_fallbacks": 0,      # restores that fell back a checkpoint
-}
+})
 
 
 def checkpoint_stats():
@@ -306,6 +309,10 @@ class CheckpointManager:
     def _write(self, final, seq, state, payload):
         """Serialize + digest + atomically publish one checkpoint.  Runs
         on the caller (blocking) or the background worker (async)."""
+        with _timeline.span("checkpoint_publish", step=state["step"]):
+            self._write_inner(final, seq, state, payload)
+
+    def _write_inner(self, final, seq, state, payload):
         from ..testing import faults as _faults
         tmp = final + ".tmp"
         if os.path.exists(tmp):

@@ -1,5 +1,5 @@
-"""Module-level worker for paddle.distributed.spawn tests (multiprocessing
-'spawn' pickles the target by qualified name, so it must live in an
+"""Module-level workers for paddle.distributed.spawn tests (multiprocessing
+'spawn' pickles the target by qualified name, so they must live in an
 importable module, not a test function body)."""
 import os
 
@@ -8,3 +8,28 @@ def write_rank(out_dir):
     rank = os.environ.get("PADDLE_TRAINER_ID", "?")
     with open(os.path.join(out_dir, f"rank_{rank}.txt"), "w") as f:
         f.write(rank)
+
+
+def telemetry_train(telemetry_dir, steps=4):
+    """Tiny fixed-seed training loop under a StepTimer, writing this
+    rank's JSONL step records + published snapshot into ``telemetry_dir``
+    (the 2-process aggregation e2e merges them cross-rank)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import StepTimer, aggregate, timeline
+
+    timeline.configure(telemetry_dir)
+    paddle.seed(7)
+    net = paddle.nn.Linear(8, 4)
+    opt = paddle.optimizer.Momentum(0.05, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    with StepTimer(name="spawn_e2e", tokens_per_step=32,
+                   publish_interval=0) as timer:
+        for _ in range(steps):
+            x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+            with timer.step():
+                loss = (net(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+    aggregate.publish(step=steps)
