@@ -56,6 +56,108 @@ def tpu_compiler_params(pltpu, **kwargs):
     return cls(**kwargs)
 
 
+def donation_enabled(env_var):
+    """Shared buffer-donation gate: ``env_var`` 0/1 forces, "auto" (the
+    default) donates everywhere but CPU, whose donation path only warns.
+    Used by the fused optimizer step (``PADDLE_TPU_FUSED_DONATE``) and
+    the serving engine's prefill/decode executables
+    (``PADDLE_TPU_SERVING_DONATE``)."""
+    import os
+    import jax
+    mode = os.environ.get(env_var, "auto")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:                                      # noqa: BLE001
+        return False
+
+
+# --------------------------------------------------------------------------
+# Persistent compilation cache (PADDLE_JIT_CACHE_DIR)
+# --------------------------------------------------------------------------
+
+_persistent_cache_dir = [None]
+
+
+def enable_persistent_cache(cache_dir=None):
+    """Point jax's persistent compilation cache at ``cache_dir`` (default:
+    ``PADDLE_JIT_CACHE_DIR``), so a fresh process re-loads every executable
+    it compiled last time instead of re-running XLA — the serving engine's
+    warm-restart path.  Thresholds are dropped to zero (the default
+    min-compile-time gate of 1s would skip exactly the small CPU
+    executables the tests exercise).  jax memoizes its is-cache-used
+    decision at first compile, so flipping the knob after a compile has
+    already happened must reset that memo — done here via
+    ``compilation_cache.reset_cache()``.
+
+    No-op (returns None) when no directory is configured; returns the
+    active directory otherwise.  Idempotent per directory.
+    """
+    import os as _os
+    d = cache_dir or _os.environ.get("PADDLE_JIT_CACHE_DIR")
+    if not d:
+        return None
+    d = str(d)
+    import jax
+    if _persistent_cache_dir[0] == d:
+        return d
+    jax.config.update("jax_compilation_cache_dir", d)
+    # cache every executable, however small/fast the compile
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()           # drop the memoized cache-unused verdict
+    except Exception:                                  # noqa: BLE001
+        pass                        # older/newer layout: first-compile wins
+    _persistent_cache_dir[0] = d
+    install_cache_event_hook()
+    return d
+
+
+def persistent_cache_dir():
+    """The directory ``enable_persistent_cache`` activated, or None."""
+    return _persistent_cache_dir[0]
+
+
+# jax announces persistent-cache traffic through plain monitoring events;
+# route them into counters so "did the warm restart actually skip XLA?"
+# is a registry read, not a log grep
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "persistent_cache_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_cache_misses",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "persistent_cache_requests",
+}
+_cache_event_hook_done = [False]
+
+
+def install_cache_event_hook():
+    """Count persistent-compilation-cache hits/misses/requests into the
+    observability registry (``compile.persistent_cache_*``).  Idempotent;
+    the listener stays registered for the process lifetime."""
+    if _cache_event_hook_done[0]:
+        return False
+    from jax import monitoring
+    from ..observability import metrics as _metrics
+
+    def _listener(event, **kw):
+        name = _CACHE_EVENTS.get(event)
+        if name is not None:
+            try:
+                _metrics.counter(f"compile.{name}").inc()
+            except Exception:                          # noqa: BLE001
+                pass        # telemetry must never break a compile
+    monitoring.register_event_listener(_listener)
+    # only after registration succeeded — a failed attempt must stay
+    # retryable, not silently leave the counters dead for the process
+    _cache_event_hook_done[0] = True
+    return True
+
+
 # --------------------------------------------------------------------------
 # XLA compile hook (observability)
 # --------------------------------------------------------------------------

@@ -149,16 +149,64 @@ def save_inference_model(path_prefix, layer_or_fn, input_spec,
     return meta
 
 
-class StandaloneModel:
-    """Loaded standalone artifact: call(*arrays) -> tuple of arrays."""
+def _next_bucket(n):
+    """Smallest power of two >= n: the dynamic-batch pad ladder."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
 
-    def __init__(self, path_prefix, device=None):
+
+class StandaloneModel:
+    """Loaded standalone artifact: call(*arrays) -> tuple of arrays.
+
+    Executables are cached per input-shape SIGNATURE (dispatch.py's
+    keying discipline) and counted in ``serving.standalone_compiles`` —
+    repeated variable-shape calls are observable instead of silently
+    retracing.  Shape-polymorphic artifacts additionally PAD their
+    dynamic (batch) dims up to a power-of-two bucket and slice the
+    outputs back, so nearby batch sizes share one executable: calling at
+    two batch sizes inside a bucket compiles once.
+
+    Bucketing assumes batch rows are independent (the manifest can't
+    prove it: a model may mix rows yet keep the batch axis on its
+    outputs, e.g. ``x - x.mean(0)``).  The first CONCLUSIVE padded call
+    (one where constant- and edge-replicated pads actually differ —
+    degenerate all-zero inputs prove nothing, leave the probe pending,
+    and are answered at their exact shape) therefore re-runs the same
+    executable under both pad modes and compares: a mismatch permanently
+    disables bucketing for this instance and returns the exact result —
+    never a silent wrong answer.  Models whose
+    outputs drop the batch dim skip bucketing outright, and
+    ``batch_bucketing=False`` opts out entirely."""
+
+    def __init__(self, path_prefix, device=None, batch_bucketing=True):
         with open(path_prefix + HLO_SUFFIX, "rb") as f:
             self._exported = jax_export.deserialize(f.read())
         with open(path_prefix + META_SUFFIX) as f:
             self.meta = json.load(f)
         self._device = device
-        self._call = jax.jit(self._exported.call)
+        # dynamic axes per input/output, from the -1s in the manifest
+        self._in_dyn = [[ax for ax, d in enumerate(i["shape"]) if d == -1]
+                        for i in self.meta["inputs"]]
+        self._out_dyn = [[ax for ax, d in enumerate(o["shape"]) if d == -1]
+                         for o in self.meta["outputs"]]
+        # pad-to-bucket is only sound when every output carries the batch
+        # dim (row-independent models): an output that AGGREGATES over
+        # the batch (a scalar mean, a batch-derived dim) would absorb the
+        # zero pad rows and has no axis to slice back — run those at
+        # their true shape instead
+        self._bucketing = bool(batch_bucketing
+                               and self.meta.get("dynamic_batch")
+                               and self._out_dyn
+                               and all(self._out_dyn))
+        from ..observability import metrics as _metrics
+        from ..ops.dispatch import SignatureLRU
+        self._stats = _metrics.stats_family("serving",
+                                            {"standalone_compiles": 0})
+        self._calls = SignatureLRU(maxsize=32, stats=self._stats,
+                                   compile_key="standalone_compiles")
+        self._bucket_probed = False
 
     def input_names(self):
         return [i["name"] for i in self.meta["inputs"]]
@@ -166,12 +214,81 @@ class StandaloneModel:
     def output_names(self):
         return [o["name"] for o in self.meta["outputs"]]
 
+    def _call_exact(self, arrays):
+        """Run at the true input shapes (signature-cached, counted)."""
+        key = tuple((a.shape, str(a.dtype)) for a in arrays)
+        call = self._calls.get(key,
+                               lambda: jax.jit(self._exported.call))
+        out = call(*arrays)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
     def __call__(self, *arrays):
         arrays = [jnp.asarray(a) for a in arrays]
         if self._device is not None:
             arrays = [jax.device_put(a, self._device) for a in arrays]
-        out = self._call(*arrays)
-        return out if isinstance(out, (tuple, list)) else (out,)
+        real_b = None
+        if self._bucketing:
+            for a, axes in zip(arrays, self._in_dyn):
+                if axes:
+                    real_b = a.shape[axes[0]]
+                    break
+        if real_b is None or real_b == 0 or _next_bucket(real_b) == real_b:
+            # batch 0 must take the exact path too: edge-replicated pads
+            # can't be built from an empty axis (and _next_bucket(0) is 1)
+            return tuple(self._call_exact(arrays))
+
+        bucket = _next_bucket(real_b)
+
+        def pad_to_bucket(mode):
+            out = []
+            for a, axes in zip(arrays, self._in_dyn):
+                pad = [(0, 0)] * a.ndim
+                for ax in axes:
+                    pad[ax] = (0, bucket - a.shape[ax])
+                out.append(jnp.pad(a, pad, mode=mode) if axes else a)
+            return out
+
+        def slice_back(outs):
+            sliced = []
+            for o, axes in zip(outs, self._out_dyn):
+                for ax in axes:
+                    o = jax.lax.slice_in_dim(o, 0, real_b, axis=ax)
+                sliced.append(o)
+            # outputs beyond the manifest (shouldn't happen) pass through
+            sliced.extend(outs[len(self._out_dyn):])
+            return sliced
+
+        padded = pad_to_bucket("constant")
+        if not self._bucket_probed:
+            # row-independence probe: the manifest can't tell a per-row
+            # model from one that mixes rows but keeps the batch axis
+            # (x - x.mean(0)).  Run the SAME executable (signature cache
+            # hit, zero new compiles) under constant- AND edge-replicated
+            # pads: a per-row model can't see the pads, so its real rows
+            # must agree; a mismatch disables bucketing for good and
+            # falls back to the exact shape — never a silent wrong answer
+            alt_in = pad_to_bucket("edge")
+            conclusive = any(not bool(jnp.array_equal(p, q))
+                             for p, q in zip(padded, alt_in))
+            if not conclusive:
+                # the two pad modes built IDENTICAL inputs (the edge row
+                # is all zeros), so agreement would prove nothing — leave
+                # the probe pending for the next informative call and
+                # serve THIS one at its exact shape, skipping the padded
+                # run entirely: an unverified bucketed result could
+                # silently mix pad rows into real ones
+                return tuple(self._call_exact(arrays))
+            sliced = slice_back(self._call_exact(padded))
+            self._bucket_probed = True
+            alt = slice_back(self._call_exact(alt_in))
+            for s, e in zip(sliced, alt):
+                if not jnp.allclose(s.astype(jnp.float32),
+                                    e.astype(jnp.float32),
+                                    rtol=1e-5, atol=1e-6):
+                    self._bucketing = False
+                    return tuple(self._call_exact(arrays))
+            return tuple(sliced)
+        return tuple(slice_back(self._call_exact(padded)))
 
 
 def exists(path_prefix):

@@ -1,0 +1,205 @@
+"""Predictor API (ref: paddle/fluid/inference/api/analysis_predictor.cc,
+paddle_inference_api.h, python/paddle/inference/__init__.py).
+
+Three model sources load into the same Predictor:
+
+  * standalone StableHLO (inference/export.py::save_inference_model) —
+    parameters baked in, loadable in a fresh process with no Python class
+    (the analogue of the reference's frozen __model__ + params); named
+    input/output handles come from the .pdmeta manifest.  Calls go
+    through StandaloneModel's per-shape-signature executable cache
+    (counted in ``serving.standalone_compiles``).
+  * jit.save pickles (.pdmodel/.pdiparams) — in-ecosystem reload of a
+    Layer; re-traced on first run.
+  * an in-memory Layer (``Predictor.from_layer``) — serve a model you
+    just trained without a save/load round-trip; compile reuse rides the
+    eager dispatch cache.
+
+Config genuinely selects the execution device; the reference's IR pass
+pipeline (fusion, memory planning) is XLA's job here.  With
+``PADDLE_JIT_CACHE_DIR`` set, compiled executables persist across
+processes (framework/jax_compat.py::enable_persistent_cache), so a
+predictor restart skips every retrace.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..framework import jax_compat
+from ..jit import api as jit_api
+from ..tensor.tensor import Tensor
+from . import export as export_mod
+from .export import StandaloneModel
+
+
+class Config:
+    """ref paddle_inference_api.h::AnalysisConfig — device selection and
+    optimization toggles (the latter are XLA's defaults here)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = None          # None -> default platform
+        self._memory_pool_mb = 0
+        self._ir_optim = True
+
+    # -- device selection (really honored by Predictor) --
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        """Accelerator request: maps to the TPU platform."""
+        self._device = "tpu"
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def device(self):
+        """Resolved jax device (or None for platform default)."""
+        if self._device is None:
+            return None
+        for d in jax.devices():
+            if d.platform == self._device:
+                return d
+        if self._device == "cpu":
+            return jax.devices("cpu")[0]
+        return None
+
+    # -- optimization toggles: XLA always fuses/plans; kept for parity --
+    def enable_memory_optim(self):
+        self._ir_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._num_threads = int(n)
+
+
+class Predictor:
+    def __init__(self, config, _layer=None):
+        if isinstance(config, str):
+            config = Config(config)
+        self._config = config
+        jax_compat.enable_persistent_cache()
+        dev = config.device()
+        self._device = dev
+        self._layer = None
+        self._model = None
+        self._traced = None
+        if _layer is not None:
+            self._layer = _layer
+            self._layer.eval()
+            n_in = getattr(config, "_n_inputs", 1)
+            self._in_names = [f"x{i}" for i in range(n_in)]
+            self._out_names = ["out0"]
+        else:
+            path = config.model_path
+            if path is None:
+                raise ValueError(
+                    "Config has no model_path — pass an artifact prefix "
+                    "(save_inference_model / jit.save output) or use "
+                    "Predictor.from_layer(layer) for an in-memory model")
+            if path.endswith(jit_api._JIT_SUFFIX):
+                path = path[: -len(jit_api._JIT_SUFFIX)]
+            if export_mod.exists(path):
+                self._model = StandaloneModel(path, device=dev)
+                self._in_names = self._model.input_names()
+                self._out_names = self._model.output_names()
+            else:
+                self._traced = jit_api.load(path)
+                self._traced._layer.eval()
+                meta = getattr(self._traced, "_meta", None) or {}
+                n_in = len(meta.get("input_spec", [])) or 1
+                self._in_names = [f"x{i}" for i in range(n_in)]
+                self._out_names = ["out0"]
+        self._inputs = {}
+        self._outputs = None
+
+    @classmethod
+    def from_layer(cls, layer, config=None, n_inputs=1):
+        """Serve an IN-MEMORY Layer (no artifact round-trip): the eager
+        dispatch cache gives per-signature compile reuse, so repeated
+        same-shape calls don't retrace."""
+        config = config or Config()
+        config._n_inputs = int(n_inputs)
+        return cls(config, _layer=layer)
+
+    # -- named IO handles (ref: GetInputHandle/GetOutputHandle) --
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_input_handle(self, name):
+        if name not in self._in_names:
+            raise KeyError(f"unknown input '{name}'; have {self._in_names}")
+        return _Handle(self, name)
+
+    def get_output_handle(self, name):
+        if name not in self._out_names:
+            raise KeyError(
+                f"unknown output '{name}'; have {self._out_names}")
+        return _OutHandle(self, self._out_names.index(name))
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            if len(inputs) != len(self._in_names):
+                raise ValueError(
+                    f"got {len(inputs)} inputs for {len(self._in_names)} "
+                    f"input handles {self._in_names}; for an in-memory "
+                    "layer declare the arity with "
+                    "Predictor.from_layer(net, n_inputs=N)")
+            self._inputs = {n: np.asarray(x.numpy() if isinstance(x, Tensor)
+                                          else x)
+                            for n, x in zip(self._in_names, inputs)}
+        ordered = [self._inputs[n] for n in self._in_names]
+        if self._model is not None:
+            outs = self._model(*ordered)
+            self._outputs = [np.asarray(o) for o in outs]
+        else:
+            args = [Tensor(jax.device_put(o, self._device)
+                           if self._device is not None else o)
+                    for o in ordered]
+            runner = self._layer if self._layer is not None else self._traced
+            out = runner(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            self._outputs = [o.numpy() for o in outs]
+        if len(self._outputs) != len(self._out_names):
+            # jit-pickle / in-memory paths don't record the output arity;
+            # grow the handle names to one per REAL output on first run
+            self._out_names = [f"out{i}"
+                               for i in range(len(self._outputs))]
+        return self._outputs
+
+
+class _Handle:
+    def __init__(self, predictor, name):
+        self.predictor = predictor
+        self.name = name
+        self._shape = None
+
+    def copy_from_cpu(self, arr):
+        arr = np.asarray(arr)
+        if self._shape is not None:
+            arr = arr.reshape(self._shape)
+        self.predictor._inputs[self.name] = arr
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+
+class _OutHandle:
+    def __init__(self, predictor, index):
+        self.predictor = predictor
+        self.index = index
+
+    def copy_to_cpu(self):
+        return self.predictor._outputs[self.index]
+
+
+def create_predictor(config):
+    return Predictor(config)

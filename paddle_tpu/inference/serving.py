@@ -1,0 +1,637 @@
+"""High-throughput serving engine: continuous batching over a slot-pooled
+KV cache (ISSUE 5 tentpole).
+
+The reference serves frozen programs through a request-at-a-time predictor
+(ref: paddle/fluid/inference/api/analysis_predictor.cc) — fine for CNNs,
+hopeless for autoregressive decoding, where request-level batching wastes
+most of the batch on padding and parks finished sequences until the
+slowest one drains.  This engine is the Orca/vLLM-shaped redesign:
+
+* **slots, not batches** — a fixed pool of ``slots`` decode lanes backed
+  by ONE shared ``[L, slots, max_len, nh, hd]`` KV buffer with a per-slot
+  fill length (models/gpt.py::init_slot_cache).  Every iteration one
+  jitted, **buffer-donated** decode step (models/gpt.py::decode_step_slots)
+  advances all in-flight sequences a token; a finished sequence's slot is
+  handed to the next queued request immediately — no drain barrier, no
+  padding rows beyond the pool size.  The decode executable's signature
+  never changes, so requests churning through slots cost ZERO retraces.
+* **bucketed prefill** — prompts are padded to a ``(batch, seq)`` shape
+  ladder and prefilled through per-bucket executables (cached in a
+  :class:`~paddle_tpu.ops.dispatch.SignatureLRU`, the dispatch cache's
+  keying discipline), so compile count is bounded by the ladder size no
+  matter how many distinct prompt lengths arrive.  Each prefill executable
+  also scatters its K/V rows straight into the donated slot buffer and
+  returns the first sampled token — one XLA program per admission wave.
+* **persistent compiles** — ``PADDLE_JIT_CACHE_DIR`` (via
+  framework/jax_compat.py::enable_persistent_cache) makes a server restart
+  reload yesterday's executables instead of re-running XLA.
+
+Telemetry rides the PR-4 registry under ``serving.*``: queue depth and
+slot occupancy gauges, prefill/decode/request latency histograms,
+tokens/s, and compile counters the bench asserts on.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+
+import numpy as np
+
+from ..framework import jax_compat
+from ..models import gpt
+from ..observability import metrics, timeline
+from ..ops.dispatch import SignatureLRU
+
+DEFAULT_BATCH_BUCKETS = (1, 2, 4)
+
+
+class ServingQueueFull(RuntimeError):
+    """submit() back-pressure: the bounded admission queue is at
+    ``max_queue`` — callers must retry/shed, exactly like a 429."""
+
+
+def _donation_enabled():
+    """Donate the slot KV buffers into prefill/decode executables
+    (in-place update, no second cache-sized allocation).  Same contract
+    as the fused optimizer step: ``PADDLE_TPU_SERVING_DONATE`` 0/1
+    forces, auto skips CPU (whose donation path only warns)."""
+    return jax_compat.donation_enabled("PADDLE_TPU_SERVING_DONATE")
+
+
+def _pow2_ladder(lo, hi):
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+def serving_stats():
+    """The ``serving.*`` counter family with its default keys
+    materialized.  Monitoring processes should read
+    ``paddle_tpu.inference.serving_stats`` / ``profiler.serving_stats``
+    instead — same registry cells, no serving-stack import."""
+    return dict(_stats_family())
+
+
+def _stats_family():
+    return metrics.stats_family("serving", {
+        "prefill_compiles": 0, "decode_compiles": 0,
+        "prefill_calls": 0, "decode_steps": 0,
+        "requests_admitted": 0, "requests_completed": 0,
+        "tokens_generated": 0, "queue_rejects": 0,
+        "standalone_compiles": 0})
+
+
+class _StatsMirror:
+    """SignatureLRU-compatible ``inc`` that routes through the engine's
+    dual (global family + per-engine) counting."""
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def inc(self, key, v=1):
+        self._engine._inc(key, v)
+
+
+class Request:
+    """One generation request's lifecycle record."""
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, eos_token=None,
+                 request_id=None):
+        self.id = request_id if request_id is not None else next(self._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.eos_token = eos_token
+        self.tokens = []            # generated ids (python ints)
+        self.logits = None          # per-token [V] rows when captured
+        self.slot = None
+        self.done = False
+        self.finish_reason = None   # "length" | "eos"
+        self.submit_t = time.perf_counter()
+        self.finish_t = None
+
+    @property
+    def output(self):
+        """prompt + generated ids as one int32 array."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+    def latency(self):
+        return (self.finish_t - self.submit_t) if self.done else None
+
+
+class ServingEngine:
+    """Continuous-batching greedy decoder over a GPT functional core.
+
+    ``model``: a ``models.gpt.GPT`` Layer, or a ``(params_pytree, cfg)``
+    pair (raw jax arrays).  Knobs:
+
+    * ``slots`` — in-flight sequence pool size (the decode batch).
+    * ``max_len`` — per-slot KV capacity; admission requires
+      ``len(prompt) + max_new_tokens <= max_len``.
+    * ``seq_buckets`` / ``batch_buckets`` — the prefill shape ladder;
+      total prefill executables are bounded by
+      ``len(seq_buckets) * len(batch_buckets)``.
+    * ``max_queue`` — bounded admission queue (default ``8 * slots``);
+      beyond it :meth:`submit` raises :class:`ServingQueueFull`.
+    * ``capture_logits`` — keep each request's per-token fp32 logit rows
+      (parity tests / bench; costs a host fetch per step).
+
+    Decoding is greedy (the parity contract with
+    ``models.gpt.generate(temperature=0)``).
+    """
+
+    def __init__(self, model, *, slots=4, max_len=None, seq_buckets=None,
+                 batch_buckets=DEFAULT_BATCH_BUCKETS, max_queue=None,
+                 capture_logits=False, cache_dtype=None):
+        import jax
+        import jax.numpy as jnp
+        self._jax, self._jnp = jax, jnp
+
+        if isinstance(model, (tuple, list)) and len(model) == 2:
+            params, cfg = model
+        else:
+            cfg = model.cfg
+            from ..ops import dispatch as _dispatch
+            params = _dispatch.unwrap(model._tree())
+        self.cfg = cfg
+        self.params = params
+
+        self.slots = int(slots)
+        self.max_len = int(max_len or cfg.max_seq_len)
+        if self.max_len > cfg.max_seq_len:
+            raise ValueError(f"max_len {self.max_len} exceeds "
+                             f"cfg.max_seq_len {cfg.max_seq_len}")
+        if seq_buckets is None:
+            seq_buckets = _pow2_ladder(min(16, self.max_len), self.max_len)
+        self.seq_buckets = tuple(sorted(int(s) for s in seq_buckets))
+        if self.seq_buckets[-1] > self.max_len:
+            raise ValueError(f"seq bucket {self.seq_buckets[-1]} exceeds "
+                             f"max_len {self.max_len}")
+        self.batch_buckets = tuple(sorted(int(b) for b in batch_buckets))
+        self.max_queue = int(max_queue if max_queue is not None
+                             else 8 * self.slots)
+        self.capture_logits = bool(capture_logits)
+
+        # a restart re-loads yesterday's executables (no-op without
+        # PADDLE_JIT_CACHE_DIR)
+        jax_compat.enable_persistent_cache()
+        timeline.install_compile_hook()
+
+        cache = gpt.init_slot_cache(cfg, self.slots, self.max_len,
+                                    dtype=cache_dtype)
+        self._cache_k, self._cache_v = cache["k"], cache["v"]
+        # host-side bookkeeping mirrors: authoritative for scheduling
+        self._lens = np.zeros((self.slots,), np.int32)
+        self._active = np.zeros((self.slots,), bool)
+        self._last_tok = np.zeros((self.slots,), np.int32)
+        self._slot_req = [None] * self.slots
+        self._queue = collections.deque()
+
+        self._stats = _stats_family()
+        # the serving.* family is process-global (all engines share the
+        # registry cells); _inc mirrors every count into THIS engine's
+        # own dict, which stats() reports — a global-delta snapshot would
+        # misattribute a coexisting engine's traffic
+        self._counts = {k: 0 for k in self._stats}
+        self._prefill = SignatureLRU(
+            maxsize=4 * len(self.seq_buckets) * len(self.batch_buckets),
+            stats=_StatsMirror(self), compile_key="prefill_compiles")
+        self._decode_jit = None
+        self._g_queue = metrics.gauge("serving.queue_depth")
+        self._g_occ = metrics.gauge("serving.slot_occupancy")
+        self._g_occ_peak = metrics.gauge("serving.slot_occupancy_peak")
+        self._g_tps = metrics.gauge("serving.tokens_per_s")
+        self._h_prefill = metrics.histogram("serving.prefill_s")
+        self._h_decode = metrics.histogram("serving.decode_step_s")
+        self._h_req = metrics.histogram("serving.request_latency_s")
+        self._tok_window = collections.deque(maxlen=64)  # (t, n) samples
+        self._occ_peak = 0
+        self._warming = False
+
+    # ------------------------------------------------------------- intake
+    _UNSET = object()
+
+    def submit(self, prompt, max_new_tokens=_UNSET, eos_token=_UNSET,
+               request_id=_UNSET):
+        """Queue one request; returns its :class:`Request` handle.
+        ``prompt`` is a token array (``max_new_tokens`` defaults to 16)
+        or a prepared :class:`Request` — whose limits travel ON it, so
+        passing them here too would be silently dropped and raises
+        instead.  Raises :class:`ServingQueueFull` past ``max_queue``
+        queued (the pool's in-flight slots don't count — they drain on
+        their own)."""
+        U = self._UNSET
+        if isinstance(prompt, Request):
+            if (max_new_tokens is not U or eos_token is not U
+                    or request_id is not U):
+                raise ValueError(
+                    "submit(Request, ...) ignores per-call limits — set "
+                    "max_new_tokens/eos_token/request_id on the Request "
+                    "itself")
+            req = prompt
+            # latency is measured from ENQUEUE: a Request prepared long
+            # before submission must not report its idle time as serving
+            req.submit_t = time.perf_counter()
+        else:
+            req = Request(prompt,
+                          16 if max_new_tokens is U else max_new_tokens,
+                          None if eos_token is U else eos_token,
+                          None if request_id is U else request_id)
+        need = len(req.prompt) + req.max_new_tokens
+        if need > self.max_len:
+            raise ValueError(
+                f"request needs {need} cache positions "
+                f"(prompt {len(req.prompt)} + {req.max_new_tokens} new) "
+                f"> max_len {self.max_len}")
+        if len(req.prompt) > self.seq_buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} exceeds the largest "
+                f"prefill bucket {self.seq_buckets[-1]}")
+        if len(self._queue) >= self.max_queue:
+            self._inc("queue_rejects")
+            raise ServingQueueFull(
+                f"queue depth {len(self._queue)} at max_queue "
+                f"{self.max_queue}")
+        self._queue.append(req)
+        self._g_queue.set(len(self._queue))
+        return req
+
+    # ------------------------------------------------------- bucket maths
+    def _seq_bucket(self, n):
+        for b in self.seq_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no seq bucket fits prompt length {n}")
+
+    def _batch_bucket(self, n):
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    # --------------------------------------------------------- executables
+    def _build_prefill(self, b, s):
+        """One prefill executable per (batch, seq) bucket: runs the causal
+        forward over the padded prompts, scatters each row's K/V into its
+        slot of the DONATED pool buffer, and samples each row's first
+        token from the logits at its true last position."""
+        jax, jnp = self._jax, self._jnp
+        cfg = self.cfg
+
+        cap = self.capture_logits
+
+        def prefill(params, cache_k, cache_v, tokens, lens, slot_ids):
+            fresh = gpt.init_cache(cfg, b, s, dtype=cache_k.dtype)
+            logits, filled = gpt.forward_cached(params, tokens, cfg, fresh)
+            for r in range(b):          # b is static: unrolled scatter
+                cache_k = jax.lax.dynamic_update_slice(
+                    cache_k, filled["k"][:, r:r + 1],
+                    (0, slot_ids[r], 0, 0, 0))
+                cache_v = jax.lax.dynamic_update_slice(
+                    cache_v, filled["v"][:, r:r + 1],
+                    (0, slot_ids[r], 0, 0, 0))
+            idx = jnp.clip(lens - 1, 0, s - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]      # [b, V]
+            first_tok = jnp.argmax(last, -1).astype(jnp.int32)
+            # a fp32 [b, V] output nobody reads is dead HBM traffic on
+            # the hot path — only materialize it when capturing
+            if cap:
+                return cache_k, cache_v, first_tok, last
+            return cache_k, cache_v, first_tok
+
+        donate = (1, 2) if _donation_enabled() else ()
+        return jax.jit(prefill, donate_argnums=donate)
+
+    def _build_decode(self):
+        jax, jnp = self._jax, self._jnp
+        cfg = self.cfg
+
+        cap = self.capture_logits
+
+        def decode(params, cache_k, cache_v, lens, toks, active):
+            cache = {"k": cache_k, "v": cache_v, "len": lens}
+            logits, cache = gpt.decode_step_slots(params, toks, cfg, cache,
+                                                  active)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            if cap:
+                return cache["k"], cache["v"], nxt, logits
+            return cache["k"], cache["v"], nxt
+
+        donate = (1, 2) if _donation_enabled() else ()
+        return jax.jit(decode, donate_argnums=donate)
+
+    # ----------------------------------------------------------- scheduling
+    def _free_slots(self):
+        return [i for i in range(self.slots) if not self._active[i]]
+
+    def _admit(self):
+        """Move queued requests into free slots, one prefill wave per
+        contiguous same-seq-bucket run (padded to the batch ladder).
+        Returns requests that finished DURING admission — the prefill's
+        first sampled token can already satisfy ``max_new_tokens=1`` or
+        hit ``eos_token``."""
+        jnp = self._jnp
+        finished = []
+        while self._queue and not self._active.all():
+            free = self._free_slots()
+            group, sbucket = [], None
+            while (self._queue and len(group) < len(free)
+                   and len(group) < self.batch_buckets[-1]):
+                nxt_b = self._seq_bucket(len(self._queue[0].prompt))
+                if sbucket is None:
+                    sbucket = nxt_b
+                elif nxt_b != sbucket:
+                    break           # next wave picks it up
+                group.append(self._queue.popleft())
+            if not group:
+                break
+            bbucket = self._batch_bucket(len(group))
+            toks = np.zeros((bbucket, sbucket), np.int32)
+            lens = np.ones((bbucket,), np.int32)   # pad rows: len 1
+            slot_ids = np.zeros((bbucket,), np.int32)
+            scratch = free[0]       # pad rows scatter over a row that a
+            for r, req in enumerate(group):        # real row rewrites
+                toks[r, :len(req.prompt)] = req.prompt
+                lens[r] = len(req.prompt)
+                slot_ids[r] = free[r]
+                req.slot = free[r]
+            for r in range(len(group), bbucket):
+                slot_ids[r] = scratch
+            if len(group) < bbucket:
+                # a pad row writing AFTER a real row would clobber that
+                # slot: scatter pads first (loop order in the executable
+                # is row order), i.e. pads must come first.  Rows are
+                # written in order r=0..b-1, so point pads at the scratch
+                # slot and ensure the real row for that slot comes later.
+                order = list(range(len(group), bbucket)) \
+                    + list(range(len(group)))
+                toks = toks[order]
+                lens = lens[order]
+                slot_ids = slot_ids[order]
+                group_rows = {id(req): order.index(r)
+                              for r, req in enumerate(group)}
+            else:
+                group_rows = {id(req): r for r, req in enumerate(group)}
+
+            fn = self._prefill.get(
+                (bbucket, sbucket),
+                lambda: self._build_prefill(bbucket, sbucket))
+            t0 = time.perf_counter()
+            with timeline.span("serving.prefill", batch=bbucket,
+                               seq=sbucket):
+                out = fn(self.params, self._cache_k, self._cache_v,
+                         jnp.asarray(toks), jnp.asarray(lens),
+                         jnp.asarray(slot_ids))
+            if self.capture_logits:
+                self._cache_k, self._cache_v, first_tok, last_logits = out
+                logits_np = np.asarray(last_logits)
+            else:
+                self._cache_k, self._cache_v, first_tok = out
+                logits_np = None
+            self._inc("prefill_calls")
+            first_np = np.asarray(first_tok)
+            for req in group:
+                r = group_rows[id(req)]
+                s = req.slot
+                self._lens[s] = len(req.prompt)
+                self._active[s] = True
+                self._slot_req[s] = req
+                self._append_token(req, int(first_np[r]),
+                                   logits_np[r] if logits_np is not None
+                                   else None)
+                self._last_tok[s] = int(first_np[r])
+                self._inc("requests_admitted")
+                if req.done:
+                    finished.append(req)
+            if not self._warming:
+                self._h_prefill.observe(time.perf_counter() - t0)
+        self._g_queue.set(len(self._queue))
+        occ = int(self._active.sum())
+        self._g_occ.set(occ)
+        if not self._warming:
+            self._occ_peak = max(self._occ_peak, occ)
+            if occ > self._g_occ_peak.value:
+                self._g_occ_peak.set(occ)
+        return finished
+
+    def _append_token(self, req, tok, logits_row):
+        req.tokens.append(tok)
+        if self.capture_logits:
+            if req.logits is None:
+                req.logits = []
+            req.logits.append(np.asarray(logits_row, np.float32))
+        self._inc("tokens_generated")
+        if not self._warming:
+            self._tok_window.append((time.perf_counter(), 1))
+        if (req.eos_token is not None and tok == req.eos_token):
+            self._finish(req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, "length")
+
+    def _finish(self, req, reason):
+        req.done = True
+        req.finish_reason = reason
+        req.finish_t = time.perf_counter()
+        if not self._warming:
+            self._h_req.observe(req.finish_t - req.submit_t)
+        if req.slot is not None:
+            s = req.slot
+            self._active[s] = False
+            self._slot_req[s] = None
+            gpt.reset_slots(self._lens, s)
+        self._inc("requests_completed")
+
+    # ------------------------------------------------------------- driving
+    def step(self):
+        """One engine iteration: admit from the queue into free slots,
+        then one slot-batched decode step.  Returns the requests that
+        FINISHED this iteration (their slots are already free — the next
+        ``step()`` re-admits from the queue: continuous batching)."""
+        finished = self._admit()
+        if not self._active.any():
+            return finished
+        jnp = self._jnp
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+            self._inc("decode_compiles")
+        t0 = time.perf_counter()
+        with timeline.span("serving.decode_step",
+                           active=int(self._active.sum())):
+            out = self._decode_jit(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(self._lens), jnp.asarray(self._last_tok),
+                jnp.asarray(self._active))
+        if self.capture_logits:
+            self._cache_k, self._cache_v, nxt, logits = out
+            logits_np = np.asarray(logits)
+        else:
+            self._cache_k, self._cache_v, nxt = out
+            logits_np = None
+        self._inc("decode_steps")
+        nxt_np = np.asarray(nxt)
+        for s in range(self.slots):
+            if not self._active[s]:
+                continue
+            req = self._slot_req[s]
+            self._lens[s] += 1
+            self._append_token(req, int(nxt_np[s]),
+                               logits_np[s] if logits_np is not None
+                               else None)
+            self._last_tok[s] = int(nxt_np[s])
+            if req.done:
+                finished.append(req)
+        dt = time.perf_counter() - t0
+        if not self._warming:
+            self._h_decode.observe(dt)
+        self._g_occ.set(int(self._active.sum()))
+        self._update_tps()
+        if not self._warming and timeline.telemetry_dir():
+            timeline.emit({"event": "serving_step",
+                           "active": int(self._active.sum()),
+                           "queue": len(self._queue),
+                           "decode_s": round(dt, 6),
+                           "finished": len(finished)})
+        return finished
+
+    def _tps_value(self):
+        """Tokens/s over THIS engine's recent-sample window (0.0 until
+        two samples exist)."""
+        if len(self._tok_window) < 2:
+            return 0.0
+        t0 = self._tok_window[0][0]
+        t1 = self._tok_window[-1][0]
+        if t1 <= t0:
+            return 0.0
+        return round(sum(c for _, c in self._tok_window) / (t1 - t0), 3)
+
+    def _update_tps(self):
+        v = self._tps_value()
+        if v:
+            self._g_tps.set(v)
+
+    def run(self, max_steps=None):
+        """Drive :meth:`step` until the queue and every slot drain.
+        Returns all requests finished during the run."""
+        out = []
+        steps = 0
+        while self._queue or self._active.any():
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def warmup(self, max_new_tokens=2):
+        """Compile every ladder executable BEFORE taking traffic: for
+        each (batch, seq) bucket pair, run a wave of dummy requests
+        shaped exactly to it, plus the decode step.  After this, steady
+        serving issues zero new XLA compiles no matter which buckets
+        requests land in — and with ``PADDLE_JIT_CACHE_DIR`` set, a
+        restarted server's warmup is pure cache reload.  The synthetic
+        wave is kept OUT of the traffic telemetry (latency histograms,
+        tokens/s window, occupancy peak, request/step counters) — only
+        the compile counters record it — so a consumer's percentiles
+        describe real requests, not compile time.  Returns the number
+        of prefill executables compiled."""
+        before = self._counts["prefill_compiles"]
+        self._warming = True
+        # back-pressure is for traffic, not boot: a deliberately small
+        # max_queue must not reject the warmup waves (each wave needs its
+        # whole group queued at once so it prefills as ONE batch rung)
+        real_max_queue = self.max_queue
+        self.max_queue = max(real_max_queue, self.slots,
+                             self.batch_buckets[-1])
+        try:
+            lo = 1                  # smallest prompt length in this rung
+            for s in self.seq_buckets:
+                # a legal request lands in this rung iff even its
+                # SHORTEST prompt (lo) leaves room for one generated
+                # token; longer warmup prompts shrink max_new_tokens
+                # rather than sliding down a rung (prompt 15 / max_new 1
+                # on a max_len-16 ladder must still precompile the top
+                # bucket)
+                mnt = min(max_new_tokens, self.max_len - lo)
+                if mnt < 1:
+                    continue        # rung unreachable by any admission
+                n = min(s, self.max_len - mnt)
+                lo = s + 1
+                prev = 0
+                for b in self.batch_buckets:
+                    # smallest group size that pads to bucket b; a rung
+                    # no group can reach (its floor exceeds the pool)
+                    # stays cold
+                    wave = prev + 1
+                    prev = b
+                    if wave > self.slots:
+                        continue
+                    for _ in range(wave):
+                        self.submit(np.ones((n,), np.int32), mnt)
+                    self.run()
+        finally:
+            self._warming = False
+            self.max_queue = real_max_queue
+        return self._counts["prefill_compiles"] - before
+
+    def reset_occupancy_peak(self):
+        """Restart THIS engine's slot-occupancy high-water mark (e.g.
+        after a warmup wave, so a measured run's peak reflects ITS
+        traffic).  The shared ``serving.slot_occupancy_peak`` gauge is a
+        process-wide monotone max — lowering it here would erase a
+        coexisting engine's recorded peak."""
+        self._occ_peak = int(self._active.sum())
+
+    def generate(self, prompts, max_new_tokens=16, eos_token=None):
+        """Batch convenience: submit every prompt, run to drain, return
+        the per-prompt generated-token lists in submission order.
+        Batches larger than ``max_queue`` are absorbed by stepping the
+        engine between submissions (back-pressure is for ONLINE callers
+        who can shed; a batch caller just wants the work done)."""
+        reqs = []
+        for p in prompts:
+            while (len(self._queue) >= self.max_queue
+                   and (self._queue or self._active.any())):
+                self.step()         # drain room instead of rejecting
+            reqs.append(self.submit(p, max_new_tokens, eos_token))
+        self.run()
+        return [r.tokens for r in reqs]
+
+    # --------------------------------------------------------------- views
+    # traffic counters a warmup wave must not inflate; compile counters
+    # stay live (compiling executables is exactly what warmup reports)
+    _WARMUP_QUIET = frozenset((
+        "prefill_calls", "decode_steps", "requests_admitted",
+        "requests_completed", "tokens_generated"))
+
+    def _inc(self, key, v=1):
+        """Count into the process-global serving.* registry family AND
+        this engine's own dict — :meth:`stats` reads the latter, so a
+        coexisting engine's traffic is never misattributed."""
+        if self._warming and key in self._WARMUP_QUIET:
+            return
+        self._stats.inc(key, v)
+        self._counts[key] = self._counts.get(key, 0) + v
+
+    def stats(self):
+        """THIS engine's serving.* counters + live gauges, one dict.
+        The process-global family (all engines pooled) is
+        :func:`serving_stats`."""
+        out = dict(self._counts)
+        out["queue_depth"] = len(self._queue)
+        out["slot_occupancy"] = int(self._active.sum())
+        out["slot_occupancy_peak"] = self._occ_peak
+        # from the engine-local sample window, NOT the shared gauge — a
+        # coexisting engine's throughput must not show up here
+        out["tokens_per_s"] = self._tps_value()
+        return out

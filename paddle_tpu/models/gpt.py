@@ -295,11 +295,14 @@ def generate(params, cfg: GPTConfig, prompt, max_new_tokens,
 
     prompt: [B, T0] int32.  Greedy when temperature == 0; otherwise
     temperature softmax sampling, optionally top-k truncated.  Returns
-    [B, T0 + max_new_tokens] (generation continues past eos; mask with
-    ``eos_token`` downstream if early-stop semantics are needed — shapes
-    stay static for XLA).  Replaces the reference's fused decoding ops
-    (ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu
+    [B, T0 + max_new_tokens] (generation continues past eos; shapes stay
+    static for XLA — trim finished rows host-side with :func:`trim_eos`,
+    which honors ``eos_token``).  Replaces the reference's fused decoding
+    ops (ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu
     int8/cache path) with a scanned XLA program."""
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     B, T0 = prompt.shape
     total = T0 + max_new_tokens
     cache = init_cache(cfg, B, total)
@@ -317,6 +320,15 @@ def generate(params, cfg: GPTConfig, prompt, max_new_tokens,
             return jax.random.categorical(k, lg)
         return jnp.argmax(lg, -1)
 
+    if max_new_tokens == 1:
+        # the scan below would have length 0 — skip it entirely (a
+        # zero-length scan still traces its body, compiling an L-layer
+        # forward that never runs).  RNG consumption matches the scan
+        # path exactly: the single sample uses split(key)[1].
+        _, sub = jax.random.split(key)
+        final = sample(last, sub).astype(jnp.int32)
+        return jnp.concatenate([prompt, final[:, None]], axis=1)
+
     def step(carry, _):
         cache, last, k = carry
         k, sub = jax.random.split(k)
@@ -333,6 +345,123 @@ def generate(params, cfg: GPTConfig, prompt, max_new_tokens,
     toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), final[:, None]],
                            axis=1)
     return jnp.concatenate([prompt, toks], axis=1)
+
+
+def trim_eos(sequences, prompt_len, eos_token, include_eos=True):
+    """Host-side early-stop: cut each row of a ``generate`` result at the
+    first ``eos_token`` in the GENERATED region (the prompt may legally
+    contain eos).  Device shapes stay static — generation runs to
+    ``max_new_tokens`` and this trims afterwards, which is the XLA-shaped
+    analogue of the reference's dynamic ``is_finished`` early exit.
+    Returns a list of 1-D int numpy arrays (ragged)."""
+    import numpy as np
+    seqs = np.asarray(sequences)
+    out = []
+    for row in seqs:
+        gen = row[prompt_len:]
+        hits = np.nonzero(gen == eos_token)[0]
+        if hits.size:
+            end = prompt_len + int(hits[0]) + (1 if include_eos else 0)
+        else:
+            end = row.shape[0]
+        out.append(row[:end])
+    return out
+
+
+# --------------------------------------------------------------------------
+# slot-batched decode (the serving engine's KV layout)
+# --------------------------------------------------------------------------
+#
+# Training/`generate` cache one REQUEST per batch row with a shared scalar
+# ``len``.  The serving engine instead owns a fixed pool of decode slots
+# backed by one [L, S, max_len, nh, hd] buffer with a PER-SLOT ``len``
+# vector: every iteration one jitted, buffer-donated step advances all
+# in-flight sequences a token, and a finished sequence's slot is re-filled
+# by a new request's prefill without touching the others (continuous
+# batching — Orca's iteration-level scheduling).  Stale K/V beyond a
+# slot's ``len`` is masked off in attention, so slot reuse needs no
+# zeroing, only a length reset.
+
+
+def init_slot_cache(cfg: GPTConfig, slots, max_len, dtype=None):
+    """Slot-pooled KV cache: {'k','v': [L, S, max_len, nh, hd],
+    'len': int32[S] tokens filled per slot}."""
+    if max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"slot cache max_len {max_len} exceeds cfg.max_seq_len "
+            f"{cfg.max_seq_len}: positions past it would reuse the last "
+            "positional embedding")
+    cd = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.num_layers, slots, max_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd),
+            "len": jnp.zeros((slots,), jnp.int32)}
+
+
+def reset_slots(lens, slots):
+    """Zero the fill lengths of ``slots`` (int or sequence).  Works on the
+    host numpy mirror the engine keeps or on the device vector; K/V need
+    no reset — everything past len is masked."""
+    import numpy as np
+    if isinstance(lens, np.ndarray):
+        lens[np.asarray(slots)] = 0
+        return lens
+    return lens.at[jnp.asarray(slots)].set(0)
+
+
+def _slot_block(cfg, x, blk, k_cache, v_cache, lens):
+    """block_apply for the slot-batched single-token decode: each slot's
+    new K/V land at ITS OWN ``lens[s]`` (a vmapped scatter, one write
+    position per slot) and its query attends ``k_pos <= lens[s]``.
+    x: [S, 1, H]; k_cache/v_cache: [S, max_len, nh, hd]; lens: int32[S]."""
+    cd = jnp.dtype(cfg.dtype)
+    hd = cfg.head_dim
+    max_len = k_cache.shape[1]
+
+    def slot_attn(q, k, v):
+        def write(c, new, l):
+            return jax.lax.dynamic_update_slice(
+                c, new.astype(c.dtype), (l, 0, 0))
+        kc = jax.vmap(write)(k_cache, k, lens)
+        vc = jax.vmap(write)(v_cache, v, lens)
+        logits = jnp.einsum("sqhd,skhd->shqk", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / math.sqrt(hd)
+        # per-slot fill bound: the new token sits at position lens[s]
+        mask = jnp.arange(max_len)[None, :] <= lens[:, None]   # [S,max_len]
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(cd)
+        a = jnp.einsum("shqk,skhd->sqhd", probs, vc.astype(cd))
+        return a, (kc, vc)
+
+    x, (k_cache, v_cache) = block_apply(cfg, x, blk, attn_fn=slot_attn)
+    return x, k_cache, v_cache
+
+
+def decode_step_slots(params, tokens, cfg: GPTConfig, cache, active=None):
+    """One decode iteration for EVERY slot at once: consume one token per
+    slot (each at its own position ``cache['len'][s]``), return
+    (logits [S, V] fp32, updated cache).  ``active`` (bool[S]) gates the
+    length advance — inactive slots still compute (the batch shape is
+    static) but their ``len`` stays put, so their K/V write lands on the
+    same spot every iteration and is harmlessly overwritten by the next
+    prefill into that slot."""
+    lens = cache["len"]
+    x = jnp.take(params["wte"], tokens, axis=0) \
+        + jnp.take(params["wpe"], lens, axis=0)
+    x = x[:, None, :].astype(jnp.dtype(cfg.dtype))        # [S, 1, H]
+
+    def scan_body(carry, layer):
+        xx = carry
+        blk, kc, vc = layer
+        xx, kc, vc = _slot_block(cfg, xx, blk, kc, vc, lens)
+        return xx, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    new_len = lens + 1 if active is None else jnp.where(active, lens + 1,
+                                                        lens)
+    return logits[:, 0], {"k": ks, "v": vs, "len": new_len}
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig):

@@ -11,6 +11,13 @@ the step-timeline tracer, and cross-rank aggregation.
   telemetry dir, and the group-wide merge with straggler detection
   (``tools/telemetry_report.py`` renders it).
 
+Registered families include the training fast paths (``dispatch_cache``,
+``fused_step``, ``reducer``, ``prefetch``, ``faults``) and the inference
+side's ``serving.*`` (queue depth / slot occupancy gauges, prefill and
+decode latency histograms, bucket/standalone compile counters) plus
+``compile.persistent_cache_*`` from the ``PADDLE_JIT_CACHE_DIR``
+persistent-compilation-cache hook.
+
 ``metrics`` is strictly stdlib so pre-jax modules (the launcher, the
 fault registry, the bootstrap) can register families; ``timeline`` and
 ``aggregate`` import jax only lazily inside functions.

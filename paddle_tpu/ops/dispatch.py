@@ -581,6 +581,56 @@ def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
     return wrapped if multi else wrapped[0]
 
 
+class SignatureLRU:
+    """Bounded signature -> compiled-executable map with counters in a
+    metrics family — the same keying discipline as the dispatch cache
+    above (keys describe ABSTRACT shapes/dtypes/buckets, never values),
+    reused by the inference predictor's per-shape call cache and the
+    serving engine's bucketed prefill executables.
+
+    ``get(key, build)`` returns the cached executable or calls ``build()``
+    once, counting a compile in ``stats[compile_key]`` (and hits in
+    ``stats[hit_key]`` when given)."""
+
+    def __init__(self, maxsize=64, stats=None, compile_key="compiles",
+                 hit_key=None):
+        self.entries = collections.OrderedDict()
+        self.lock = threading.Lock()
+        self.maxsize = int(maxsize)
+        self.stats = stats
+        self.compile_key = compile_key
+        self.hit_key = hit_key
+
+    def __len__(self):
+        with self.lock:
+            return len(self.entries)
+
+    def get(self, key, build):
+        with self.lock:
+            e = self.entries.get(key)
+            if e is not None:
+                self.entries.move_to_end(key)
+                if self.stats is not None and self.hit_key:
+                    self.stats.inc(self.hit_key)
+                return e
+        # build OUTSIDE the lock (tracing can re-enter arbitrary code);
+        # a racing double-build costs one redundant trace, never a wrong
+        # result — last insert wins
+        e = build()
+        with self.lock:
+            self.entries[key] = e
+            self.entries.move_to_end(key)
+            while len(self.entries) > self.maxsize:
+                self.entries.popitem(last=False)
+        if self.stats is not None:
+            self.stats.inc(self.compile_key)
+        return e
+
+    def clear(self):
+        with self.lock:
+            self.entries.clear()
+
+
 def unwrap(x):
     """Tensor -> jax value; passthrough otherwise (recurses into containers)."""
     from ..tensor import Tensor

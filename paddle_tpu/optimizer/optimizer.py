@@ -45,15 +45,8 @@ def reset_fused_stats():
 
 
 def _donation_enabled():
-    mode = os.environ.get("PADDLE_TPU_FUSED_DONATE", "auto")
-    if mode == "0":
-        return False
-    if mode == "1":
-        return True
-    try:
-        return jax.default_backend() != "cpu"
-    except Exception:                                      # noqa: BLE001
-        return False
+    from ..framework import jax_compat
+    return jax_compat.donation_enabled("PADDLE_TPU_FUSED_DONATE")
 
 
 class _UnhashableSignature(Exception):

@@ -301,15 +301,33 @@ def faults_stats():
     return out
 
 
+def serving_stats():
+    """Serving-engine counter family (inference/serving.py): bucketed
+    prefill / decode-step compiles and calls, request admissions and
+    completions, generated tokens, queue rejects, and the standalone
+    predictor's per-signature compiles.  Read straight from the registry
+    — importing the serving stack (GPT core + Pallas) just to read
+    counters would defeat its lazy loading; a process that never served
+    simply reports an empty family."""
+    return metrics.families().get("serving", {})
+
+
+def reset_serving_stats():
+    _warn_reset_deprecated("reset_serving_stats", "serving")
+    metrics.reset("serving")
+
+
 def fast_path_summary():
     """One dict with every fast-path counter family — what the bench.py
     eager microbench and dp-overlap bench assert on — plus the ``faults``
-    family the recovery bench and chaos tests assert on."""
+    family the recovery bench and chaos tests assert on and the
+    ``serving`` family the serving bench asserts on."""
     out = {"dispatch_cache": dispatch_cache_stats()}
     for key, fn in (("fused_step", fused_step_stats),
                     ("reducer", reducer_stats),
                     ("prefetch", prefetch_stats),
-                    ("faults", faults_stats)):
+                    ("faults", faults_stats),
+                    ("serving", serving_stats)):
         try:
             out[key] = fn()
         except Exception:                                  # noqa: BLE001
