@@ -1038,6 +1038,154 @@ def faults_bench():
 
 
 # --------------------------------------------------------------------------
+# child: --fleet  (fault-tolerant serving-fleet chaos benchmark)
+# --------------------------------------------------------------------------
+
+def fleet_bench():
+    """Serving-fleet chaos e2e (ISSUE 7 tentpole): sustained synthetic
+    traffic through a 2-replica supervised fleet, one replica SIGKILLed
+    mid-run WITH requests in flight.  Asserts the durability contract
+    instead of trusting it: ZERO lost requests (every admitted id
+    completes), token-exact outputs for the re-queued requests vs an
+    uninterrupted run of the same traffic, in-flight work really
+    re-queued (requeues >= 1), the replacement replica warm-restarts
+    from the shared persistent compilation cache (0 cache misses), and
+    request p99 stays under BENCH_FLEET_P99_S (default 30s).  Emits one
+    parsed JSON metric line: fleet_recovery_time_s (incident detection
+    -> replacement serving again) plus p50/p99 request latency.
+
+    Replicas are clean re-execed CPU-backend interpreters (same dance as
+    --faults), so this runs under the orchestrator or standalone —
+    ``--cpu-mesh N`` recommended off-TPU.  Knobs: BENCH_FLEET_REPLICAS
+    (default 2), BENCH_FLEET_REQUESTS (default 24), BENCH_FLEET_TOKENS
+    (default 48)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.testing.env import clean_cpu_env
+
+    replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 2))
+    n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", 24))
+    gen_tokens = int(os.environ.get("BENCH_FLEET_TOKENS", 48))
+    p99_bound = float(os.environ.get("BENCH_FLEET_P99_S", 30))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="paddle_tpu_fleet_")
+
+    import numpy as np
+    spec = {"cfg": {"vocab_size": 256, "hidden_size": 32, "num_layers": 2,
+                    "num_heads": 2, "max_seq_len": 128, "dtype": "float32",
+                    "use_flash": False, "remat": False},
+            "seed": 0, "slots": 2, "max_len": 8 + gen_tokens,
+            "seq_buckets": [8], "batch_buckets": [1, 2]}
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 256, int(rng.randint(3, 8)))
+               for _ in range(n_requests)]
+    env = clean_cpu_env(repo, device_count=1)
+    env.pop("PADDLE_FAULTS", None)
+    cache = os.path.join(work, "jit_cache")
+
+    def make_fleet(tag):
+        return ServingFleet(
+            spec, replicas=replicas, env_base=env,
+            jit_cache_dir=cache,
+            log_dir=os.path.join(work, tag, "logs"),
+            telemetry_dir=os.path.join(work, tag, "telemetry"),
+            heartbeat_s=20, restart_backoff_s=0.2)
+
+    try:
+        # reference: the SAME traffic, nobody killed (also fills the
+        # persistent cache the chaos fleet's replicas warm-boot from)
+        fleet = make_fleet("ref")
+        assert fleet.await_healthy(timeout=120) == replicas
+        for i, p in enumerate(prompts):
+            fleet.submit(p, gen_tokens, request_id=f"req{i}")
+        done, failed = fleet.drain(timeout=300)
+        assert not failed and len(done) == n_requests, (len(done), failed)
+        ref_tokens = {rid: r.tokens for rid, r in done.items()}
+        assert fleet.stats()["incidents"] == 0
+        fleet.close()
+
+        # chaos: same traffic, one replica SIGKILLed holding live work
+        fleet = make_fleet("chaos")
+        assert fleet.await_healthy(timeout=120) == replicas
+        victim = fleet._replicas[0]
+        killed_holding = None
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            fleet.submit(p, gen_tokens, request_id=f"req{i}")
+            if killed_holding is None and i >= n_requests // 3:
+                # sustained traffic reached the victim: kill it the
+                # moment it really holds in-flight requests
+                deadline = time.time() + 10
+                while not victim.inflight and time.time() < deadline:
+                    time.sleep(0.002)
+                killed_holding = len(victim.inflight)
+                fleet.kill_replica(victim.id)
+        done, failed = fleet.drain(timeout=300)
+        wall = time.perf_counter() - t0
+        assert killed_holding and killed_holding > 0, (
+            "victim never held in-flight work — the kill tested nothing")
+        # the durability contract, asserted
+        assert not failed, f"requests LOST/failed: {failed}"
+        assert len(done) == n_requests, (len(done), n_requests)
+        st = fleet.stats()
+        assert st["requeues"] >= 1, st
+        mismatch = [rid for rid in ref_tokens
+                    if done[rid].tokens != ref_tokens[rid]]
+        assert not mismatch, (
+            f"re-queued requests lost token parity: {mismatch}")
+        # the replacement replica must be back — and warm
+        assert fleet.await_healthy(timeout=120) == replicas
+        st = fleet.stats()
+        assert st["recoveries"], "no recovery recorded"
+        rec = st["recoveries"][-1]
+        assert rec["warm_cache_misses"] == 0, (
+            f"replacement replica recompiled: {rec}")
+        ttr = fleet.recovery_time_s()
+        lat = st["latency_s"]
+        assert lat["p99"] is not None and lat["p99"] <= p99_bound, lat
+
+        telem = {"registry": {"fleet": {k: st[k] for k in (
+            "requests_admitted", "requests_completed", "requeues",
+            "retries", "incidents", "replica_restarts",
+            "heartbeat_misses", "sheds", "dup_completions")}}}
+        try:
+            from paddle_tpu.observability import aggregate
+            report = aggregate.merge_from_dir(
+                os.path.join(work, "chaos", "telemetry"))
+            telem["replicas"] = {
+                r: {"steps": v["steps"], "faults": v["faults"]}
+                for r, v in report["ranks"].items()}
+        except Exception as e:                             # noqa: BLE001
+            telem["replicas"] = {"error": f"{type(e).__name__}: {e}"}
+        fleet.close()
+
+        print(json.dumps({
+            "metric": "fleet_recovery_time_s",
+            "value": round(ttr, 3),
+            "unit": "s",
+            "vs_baseline": round(ttr / wall, 4),
+            "requests": n_requests,
+            "replicas": replicas,
+            "lost_requests": 0,
+            "requeues": st["requeues"],
+            "killed_holding": killed_holding,
+            "latency_ms": {"p50": round(lat["p50"] * 1e3, 3),
+                           "p99": round(lat["p99"] * 1e3, 3)},
+            "warm_cache_misses": rec["warm_cache_misses"],
+            "telemetry": telem,
+        }), flush=True)
+        print(f"# fleet: {n_requests} requests over {replicas} replicas, "
+              f"SIGKILL with {killed_holding} in flight -> "
+              f"{st['requeues']} requeued, 0 lost, token-exact, "
+              f"recovery {ttr:.2f}s, p99 {lat['p99'] * 1e3:.0f}ms",
+              file=sys.stderr)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
 # parent: orchestrator — never touches the jax backend
 # --------------------------------------------------------------------------
 
@@ -1195,7 +1343,8 @@ def _reexec_cpu_mesh():
     try:
         n = int(sys.argv[sys.argv.index("--cpu-mesh") + 1])
     except (IndexError, ValueError):
-        sys.exit("usage: bench.py [--dp-overlap|--faults] --cpu-mesh N  "
+        sys.exit("usage: bench.py [--dp-overlap|--faults|--serving|"
+                 "--fleet] --cpu-mesh N  "
                  "(N = forced host-platform device count)")
     env = dict(os.environ)
     env["BENCH_CPU_MESH_CHILD"] = "1"
@@ -1232,5 +1381,7 @@ if __name__ == "__main__":
         serving_bench()
     elif "--faults" in sys.argv:
         faults_bench()
+    elif "--fleet" in sys.argv:
+        fleet_bench()
     else:
         sys.exit(orchestrate())

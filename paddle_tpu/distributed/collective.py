@@ -190,7 +190,10 @@ def _kv_call(client, method, *args):
     pod emits UNAVAILABLE-shaped errors that resolve in milliseconds).
     Deadline expiries are NOT retried — the caller turns them into a
     diagnosable CollectiveTimeout — and neither are non-transient
-    errors."""
+    errors.  A transient error that survives every retry is re-raised
+    as-is; rendezvous call sites (:func:`_kv_allgather`, :func:`barrier`)
+    convert THAT into a CollectiveTimeout too (op/group/ranks named)
+    rather than surfacing a bare KV error mid-collective."""
     retries = int(os.environ.get("PADDLE_KV_RETRIES", "3"))
     delay = 0.05
     for attempt in range(retries + 1):
@@ -204,6 +207,19 @@ def _kv_call(client, method, *args):
             _watchdog_stats["kv_retries"] += 1
             time.sleep(delay)
             delay *= 2
+
+
+def _watchdog_detail(e):
+    """(convert?, detail) for an exception escaping a rendezvous _kv_call:
+    deadlines and retry-exhausted transients both become
+    CollectiveTimeout — the group is equally broken either way, and the
+    operator needs op/group/ranks, not a bare KV stack."""
+    if _is_deadline(e):
+        return True, str(e).splitlines()[0]
+    if _is_transient(e):
+        return True, ("PADDLE_KV_RETRIES exhausted on a transient "
+                      "coordinator failure: " + str(e).splitlines()[0])
+    return False, None
 
 
 def _kv_world():
@@ -266,19 +282,21 @@ def _kv_allgather(value, op="allgather", bucket=None, group=None):
         _timeline.record_collective_wait(
             time.perf_counter() - t_wait, op=op)
     except Exception as e:                                 # noqa: BLE001
-        if not _is_deadline(e):
+        convert, detail = _watchdog_detail(e)
+        if not convert:
             raise
         _watchdog_stats["collective_timeouts"] += 1
         raise CollectiveTimeout(
             op, timeout_ms, group=group, bucket=bucket,
             ranks_seen=_ranks_seen(client, key, n), nranks=n,
-            detail=str(e).splitlines()[0]) from e
+            detail=detail) from e
     # everyone has read every row — each process reclaims its own key so
     # per-step collectives don't grow the coordinator's store unboundedly
     try:
         _kv_call(client, "wait_at_barrier", f"{key}_drain", timeout_ms)
     except Exception as e:                                 # noqa: BLE001
-        if not _is_deadline(e):
+        convert, detail = _watchdog_detail(e)
+        if not convert:
             raise
         # a peer vanished AFTER contributing: the gather completed but
         # the group is broken — same diagnosable failure, named as such
@@ -286,8 +304,7 @@ def _kv_allgather(value, op="allgather", bucket=None, group=None):
         raise CollectiveTimeout(
             op, timeout_ms, group=group, bucket=bucket,
             ranks_seen=_ranks_seen(client, key, n), nranks=n,
-            detail="post-gather drain barrier: "
-                   + str(e).splitlines()[0]) from e
+            detail="post-gather drain barrier: " + detail) from e
     try:
         client.key_value_delete(f"{key}/{me}")
     except Exception:                                      # noqa: BLE001
@@ -371,12 +388,13 @@ def barrier(group=None):
             try:
                 _kv_call(client, "wait_at_barrier", name, timeout_ms)
             except Exception as e:                         # noqa: BLE001
-                if not _is_deadline(e):
+                convert, detail = _watchdog_detail(e)
+                if not convert:
                     raise
                 _watchdog_stats["collective_timeouts"] += 1
                 raise CollectiveTimeout(
                     "barrier", timeout_ms, group=group, nranks=n,
-                    detail=str(e).splitlines()[0]) from e
+                    detail=detail) from e
         return
     jnp.zeros(()).block_until_ready()
 

@@ -61,15 +61,112 @@ def _free_local_port():
         return s.getsockname()[1]
 
 
+# --------------------------------------------------------------------------
+# reusable supervision hooks (shared by supervise() and non-training
+# worker fleets, e.g. inference/fleet.py's serving replicas)
+# --------------------------------------------------------------------------
+
+def signal_name(rc):
+    """Symbolic signal for a negative exit code (``-9`` -> ``"SIGKILL"``),
+    or None for normal exits — the "was it killed, and by what" half of
+    an incident record."""
+    if rc is None or rc >= 0:
+        return None
+    try:
+        return signal.Signals(-rc).name
+    except ValueError:
+        return f"signal {-rc}"
+
+
+def backoff_delay(base, restarts_used, cap=60.0):
+    """Exponential relaunch backoff: ``base * 2**restarts_used``, capped
+    so a crash-looping worker fleet keeps retrying on a bounded cadence
+    instead of sleeping into the hour range."""
+    return min(base * (2 ** restarts_used), cap)
+
+
+def spawn_worker(argv, env, log_path=None, python=True):
+    """Spawn ONE supervised worker subprocess: stdout+stderr teed
+    (unbuffered, append-mode — lines survive across incarnations) into
+    ``log_path`` when given.  Returns a worker handle dict
+    (``proc``/``log_f``/``log_path``) that :func:`stop_worker` and
+    :func:`close_worker_log` consume.  ``python=True`` prefixes the
+    current interpreter."""
+    log_f = None
+    if log_path:
+        os.makedirs(os.path.dirname(os.path.abspath(log_path)),
+                    exist_ok=True)
+        log_path = os.path.abspath(log_path)
+        # unbuffered fd + PYTHONUNBUFFERED: a killed worker's last lines
+        # (usually the diagnosis) must reach the file
+        log_f = open(log_path, "ab", buffering=0)
+        env = dict(env)
+        env.setdefault("PYTHONUNBUFFERED", "1")
+    cmd = ([sys.executable] + list(argv)) if python else list(argv)
+    try:
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=log_f,
+            stderr=subprocess.STDOUT if log_f else None)
+    except Exception:
+        if log_f is not None:
+            log_f.close()
+        raise
+    return {"proc": proc, "log_f": log_f, "log_path": log_path}
+
+
+def stop_worker(worker, term_grace=10.0):
+    """SIGTERM one worker (exactly once — callers track their own
+    already-signalled state for group semantics), SIGKILL whatever
+    ignored it past the grace period.  Returns the exit code."""
+    proc = worker["proc"]
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        _launch_stats["sigterms_sent"] += 1
+    try:
+        proc.wait(timeout=max(term_grace, 0.1))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+    return proc.poll()
+
+
+def close_worker_log(worker):
+    if worker.get("log_f") is not None and not worker["log_f"].closed:
+        worker["log_f"].close()
+
+
+def incident_record(rank, rc, incarnation, log_path=None, t0=None,
+                    also_failed=()):
+    """One machine-readable incident: WHO failed (rank), HOW (exit code +
+    decoded signal), WHEN (wall time, both absolute and relative to the
+    supervisor's start), and the restart count at the moment of failure.
+    The fleet router and ``bench.py --fleet`` consume these."""
+    now = time.time()
+    return {
+        "time": now,
+        "wall_time_s": round(now - t0, 3) if t0 is not None else None,
+        "rank": rank,
+        "exit_code": rc,
+        "signal": signal_name(rc),
+        "incarnation": incarnation,
+        "restart_count": incarnation,
+        "log": log_path,
+        "also_failed": list(also_failed),
+    }
+
+
 def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
               nranks=None, log_dir=None, max_restarts=0, backoff=1.0,
               term_grace=10.0, poll_interval=0.2, telemetry_dir=None):
     """Run ``nprocs`` copies of the script under supervision (global ranks
     rank_base..rank_base+nprocs-1 of nranks total).  Returns a summary
     dict: ``rc`` (0, or the FIRST failing exit code of the final
-    incident), ``restarts_used``, ``incidents`` (each naming time, rank,
-    exit code, incarnation and log path), ``failed_rank``/``failed_log``
-    for the terminal failure, and per-worker ``logs``.
+    incident), ``restarts_used``, ``incidents`` (per-incident records:
+    time + wall time since supervise() started, failing rank, exit code
+    with the decoded signal when killed, restart count at failure, log
+    path — what the fleet router and ``bench.py --fleet`` consume),
+    ``failed_rank``/``failed_log`` for the terminal failure, and
+    per-worker ``logs``.
 
     Restart semantics (TorchElastic worker-group model): any worker
     failing fails the GROUP — survivors get SIGTERM exactly once, then
@@ -119,27 +216,14 @@ def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
             if telemetry_dir:
                 env["PADDLE_TELEMETRY_DIR"] = os.path.abspath(
                     telemetry_dir)
-            log_f = log_path = None
+            log_path = None
             if log_dir:
-                os.makedirs(log_dir, exist_ok=True)
                 log_path = os.path.abspath(
                     os.path.join(log_dir, f"worker{rank}.log"))
-                # unbuffered fd + PYTHONUNBUFFERED: a killed worker's last
-                # lines (usually the diagnosis) must reach the file
-                log_f = open(log_path, "ab", buffering=0)
-                env.setdefault("PYTHONUNBUFFERED", "1")
                 log_paths[rank] = log_path
-            try:
-                proc = subprocess.Popen(
-                    [sys.executable] + script_argv, env=env,
-                    stdout=log_f,
-                    stderr=subprocess.STDOUT if log_f else None)
-            except Exception:
-                if log_f is not None:
-                    log_f.close()
-                raise
-            group.append({"rank": rank, "proc": proc,
-                          "log_f": log_f, "log_path": log_path})
+            w = spawn_worker(script_argv, env, log_path=log_path)
+            w["rank"] = rank
+            group.append(w)
 
     def stop_group(group):
         """Tear down survivors: SIGTERM each still-running worker exactly
@@ -158,8 +242,7 @@ def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
 
     def close_logs(group):
         for w in group:
-            if w["log_f"] is not None and not w["log_f"].closed:
-                w["log_f"].close()
+            close_worker_log(w)
 
     workers = spawn_group()
     rc = 0
@@ -184,11 +267,9 @@ def supervise(script_argv, nprocs, master=None, env_base=None, rank_base=0,
             if failed is not None:
                 w, r = failed
                 _launch_stats["incidents"] += 1
-                incidents.append({
-                    "time": time.time(), "rank": w["rank"],
-                    "exit_code": r, "incarnation": restarts_used,
-                    "log": w["log_path"], "also_failed": also_failed,
-                })
+                incidents.append(incident_record(
+                    w["rank"], r, restarts_used, log_path=w["log_path"],
+                    t0=t0, also_failed=also_failed))
                 stop_group(workers)
                 close_logs(workers)
                 if restarts_used < max_restarts:

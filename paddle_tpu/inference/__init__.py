@@ -10,6 +10,10 @@ serving.
 * :mod:`.serving` — :class:`ServingEngine`: continuous batching over a
   slot-pooled KV cache with bucketed prefill executables and a single
   buffer-donated decode step (ISSUE 5 tentpole).
+* :mod:`.fleet` — :class:`ServingFleet`: a re-queueing router over N
+  supervised engine-replica subprocesses (health checks, request
+  retries, load shedding — no admitted request is ever dropped) with
+  :mod:`.fleet_worker` as the replica entrypoint (ISSUE 7 tentpole).
 
 Set ``PADDLE_JIT_CACHE_DIR`` to persist compiled executables across
 processes: a server restart reloads them instead of re-running XLA
@@ -23,6 +27,7 @@ from .predictor import (Config, Predictor, create_predictor,  # noqa: F401
                         _Handle, _OutHandle)
 
 _SERVING_NAMES = ("ServingEngine", "ServingQueueFull", "Request")
+_FLEET_NAMES = ("ServingFleet", "FleetOverloaded", "FleetRequest")
 
 
 def serving_stats():
@@ -44,4 +49,11 @@ def __getattr__(name):
         if name == "serving":
             return serving
         return getattr(serving, name)
+    # the fleet router is jax-light but rides the same lazy discipline
+    if name in _FLEET_NAMES or name == "fleet":
+        import importlib
+        fleet = importlib.import_module(__name__ + ".fleet")
+        if name == "fleet":
+            return fleet
+        return getattr(fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,866 @@
+"""Fault-tolerant serving fleet (ISSUE 7 tentpole): a front-end router
+admitting requests across N supervised :class:`ServingEngine` replicas,
+guaranteeing **no admitted request is ever dropped**.
+
+This is the composition layer ROADMAP direction 4 calls for — the PR-3
+supervision machinery (incident records, exponential-backoff relaunch,
+``distributed/launch.py``'s reusable worker hooks), the PR-4 telemetry
+registry, and the PR-5 continuous-batching engine assembled into a
+serving *fleet*:
+
+* **replica lifecycle** — each replica is a subprocess
+  (``paddle_tpu/inference/fleet_worker.py``) speaking a length-prefixed
+  JSON RPC over a loopback socket.  The router spawns it through
+  ``launch.spawn_worker`` (log tee per incarnation), health-checks it
+  with a per-RPC heartbeat deadline, detects crashes (process exit, EOF,
+  heartbeat miss), handles each incident exactly once, and relaunches
+  with exponential backoff under a restart budget — with a shared
+  ``PADDLE_JIT_CACHE_DIR`` the replacement warm-restarts from the
+  persistent compilation cache and compiles nothing.
+* **request durability** — every admitted request carries a stable
+  client-suppliable id (auto-uuid otherwise) and lives in the router's
+  PENDING table until its final token is acked.  Requests in flight on a
+  crashed replica are **re-queued** onto survivors (greedy decoding over
+  identical replica weights makes the retry token-exact), with bounded
+  retries + backoff, per-request deadlines, and completion dedupe on the
+  id — a replica double-reporting after a dropped reply can never
+  double-complete.
+* **graceful degradation** — replicas pull work proportionally to their
+  free capacity (the ``serving.*`` occupancy/queue numbers each step
+  reply carries), so a slow replica naturally sheds load to fast ones;
+  past the global ``max_pending`` bound :meth:`ServingFleet.submit`
+  raises :class:`FleetOverloaded` immediately (a fast 429, not unbounded
+  latency).
+
+Telemetry rides the ``fleet.*`` registry family (replica up/down
+gauges, requeues, retries, sheds, heartbeat misses, incidents, recovery
+seconds) and merges through the PR-4 cross-rank aggregation view — each
+replica publishes per-replica snapshots into the shared telemetry dir,
+the router publishes its own under rank ``nreplicas``.
+
+The router process never touches the jax backend or builds the model:
+construction happens inside the workers (spec via the
+``PADDLE_FLEET_MODEL`` env var), so the router stays responsive while
+replicas compile, and a wedged XLA client can never take the control
+plane down with it.
+"""
+from __future__ import annotations
+
+import collections
+import importlib
+import json
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from ..observability import metrics, timeline
+
+# spelled out through importlib: paddle_tpu.distributed exports a
+# launch() FUNCTION that shadows the submodule attribute
+_launch = importlib.import_module("paddle_tpu.distributed.launch")
+
+__all__ = ["ServingFleet", "FleetRequest", "FleetOverloaded",
+           "send_msg", "recv_msg"]
+
+
+class FleetOverloaded(RuntimeError):
+    """submit() load shedding: the router's global pending table is at
+    ``max_pending`` — reject fast (the caller retries/sheds) instead of
+    queueing into unbounded latency.  The serving-fleet 429."""
+
+
+# --------------------------------------------------------------------------
+# wire protocol: 4-byte big-endian length + UTF-8 JSON (shared with
+# fleet_worker.py; stdlib-only so workers can import it before jax)
+# --------------------------------------------------------------------------
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20          # a malformed peer must not OOM the router
+
+
+def send_msg(sock, obj):
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock):
+    n = _LEN.unpack(_recv_exact(sock, 4))[0]
+    if n > MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({n} bytes)")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+# --------------------------------------------------------------------------
+# env knobs (MIGRATING.md documents these)
+# --------------------------------------------------------------------------
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+def _stats_family():
+    return metrics.stats_family("fleet", {
+        "requests_admitted": 0, "requests_completed": 0,
+        "requests_failed": 0, "requeues": 0, "retries": 0,
+        "sheds": 0, "dup_completions": 0, "heartbeat_misses": 0,
+        "incidents": 0, "replica_restarts": 0, "rpc_errors": 0,
+        "deadline_exceeded": 0, "rejects_permanent": 0})
+
+
+def fleet_stats():
+    """The process-global ``fleet.*`` counter family."""
+    return dict(_stats_family())
+
+
+class FleetRequest:
+    """One request's router-side lifecycle record — the PENDING-table
+    entry that guarantees durability.  ``id`` is stable across retries
+    and replicas (client-suppliable, auto-uuid otherwise)."""
+
+    def __init__(self, prompt, max_new_tokens, eos_token=None,
+                 request_id=None, deadline_s=None):
+        self.id = str(request_id) if request_id is not None \
+            else uuid.uuid4().hex
+        self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = eos_token
+        self.deadline_s = deadline_s
+        self.tokens = []
+        self.finish_reason = None
+        self.done = False
+        self.failed = False
+        self.error = None
+        self.retries = 0              # re-queues consumed so far
+        self.replica = None           # current / completing replica
+        self.replicas_tried = []
+        self.not_before = 0.0         # retry-backoff dispatch gate
+        self.submit_t = time.perf_counter()
+        self.finish_t = None
+
+    def latency(self):
+        return (self.finish_t - self.submit_t) \
+            if self.finish_t is not None else None
+
+    def expired(self, now=None):
+        if self.deadline_s is None:
+            return False
+        return (now or time.perf_counter()) - self.submit_t \
+            > self.deadline_s
+
+
+class _ReplicaGone(RuntimeError):
+    """Internal: this replica just failed (crash/EOF/heartbeat miss) —
+    unwind to the driver loop's incident handler."""
+
+
+class _Replica:
+    def __init__(self, rid, listener):
+        self.id = rid
+        self.listener = listener           # lives across incarnations
+        self.port = listener.getsockname()[1]
+        self.worker = None                 # launch.spawn_worker handle
+        self.conn = None
+        self.state = "starting"            # starting | healthy | dead
+        self.incarnation = 0
+        self.restarts_used = 0
+        self.inflight = {}                 # id -> FleetRequest
+        self.last_stats = {}
+        self.hello = {}
+        self.pending_ack = []              # completion ids to ack next RPC
+        self.pending_cancel = []           # ids to cancel next RPC
+        self.incident_t = None             # set on incident, cleared on
+        self.next_spawn_t = 0.0            # recovery (recovery_s source)
+        self.spawn_deadline = None
+
+    @property
+    def pid(self):
+        return self.worker["proc"].pid if self.worker else None
+
+
+class ServingFleet:
+    """Route requests across ``replicas`` supervised serving workers.
+
+    ``model_spec`` is the worker-side model/engine recipe (a JSON-able
+    dict — see fleet_worker.py: ``cfg`` GPTConfig kwargs or ``preset``,
+    ``seed``, plus engine knobs ``slots``/``max_len``/``seq_buckets``/
+    ``batch_buckets``).  Every replica builds the IDENTICAL model, which
+    is what makes re-queued greedy requests token-exact.
+
+    The router guarantees: an admitted request either completes, or
+    fails with a named reason (``deadline_exceeded``,
+    ``retries_exhausted``, a permanent worker reject, or fleet
+    shutdown) — it is never silently dropped.  Call :meth:`drain` to
+    wait for the pending table to empty, :meth:`close` to tear down.
+    """
+
+    def __init__(self, model_spec, replicas=None, *, env_base=None,
+                 log_dir=None, jit_cache_dir=None, telemetry_dir=None,
+                 heartbeat_s=None, heartbeat_idle_s=0.05,
+                 request_deadline_s=None, max_retries=None,
+                 retry_backoff_s=None, max_pending=None,
+                 max_restarts=None, restart_backoff_s=None,
+                 spawn_timeout_s=None, steps_per_rpc=4,
+                 dispatch_queue_depth=None, worker_argv=None):
+        self.model_spec = dict(model_spec or {})
+        self.nreplicas = int(replicas if replicas is not None
+                             else _env_int("PADDLE_FLEET_REPLICAS", 2))
+        if self.nreplicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self.env_base = dict(env_base if env_base is not None
+                             else os.environ)
+        self.log_dir = log_dir
+        self.jit_cache_dir = jit_cache_dir \
+            or self.env_base.get("PADDLE_JIT_CACHE_DIR")
+        self.telemetry_dir = telemetry_dir \
+            or self.env_base.get("PADDLE_TELEMETRY_DIR")
+        self.heartbeat_s = heartbeat_s if heartbeat_s is not None \
+            else _env_float("PADDLE_FLEET_HEARTBEAT_S", 10.0)
+        self.heartbeat_idle_s = heartbeat_idle_s
+        self.request_deadline_s = request_deadline_s \
+            if request_deadline_s is not None \
+            else (_env_float("PADDLE_FLEET_DEADLINE_S", 0.0) or None)
+        self.max_retries = max_retries if max_retries is not None \
+            else _env_int("PADDLE_FLEET_MAX_RETRIES", 3)
+        self.retry_backoff_s = retry_backoff_s \
+            if retry_backoff_s is not None \
+            else _env_float("PADDLE_FLEET_RETRY_BACKOFF_S", 0.25)
+        self.max_restarts = max_restarts if max_restarts is not None \
+            else _env_int("PADDLE_FLEET_MAX_RESTARTS", 8)
+        self.restart_backoff_s = restart_backoff_s \
+            if restart_backoff_s is not None \
+            else _env_float("PADDLE_FLEET_RESTART_BACKOFF_S", 0.5)
+        self.spawn_timeout_s = spawn_timeout_s \
+            if spawn_timeout_s is not None \
+            else _env_float("PADDLE_FLEET_SPAWN_TIMEOUT_S", 180.0)
+        self.steps_per_rpc = int(steps_per_rpc)
+        slots = int(self.model_spec.get("slots", 4))
+        self.dispatch_queue_depth = int(
+            dispatch_queue_depth if dispatch_queue_depth is not None
+            else slots)
+        self._slots = slots
+        self.max_pending = int(
+            max_pending if max_pending is not None
+            else _env_int("PADDLE_FLEET_MAX_PENDING",
+                          8 * slots * self.nreplicas))
+        self.worker_argv = list(worker_argv) if worker_argv else \
+            ["-m", "paddle_tpu.inference.fleet_worker"]
+        # finished-request retention: the _done/_failed tables double as
+        # the dedupe window, so they are BOUNDED (oldest evicted) — a
+        # sustained-traffic router must not grow without limit
+        self.done_retention = _env_int("PADDLE_FLEET_DONE_RETENTION",
+                                       4096)
+
+        self._stats = _stats_family()
+        # the fleet.* family is process-global; mirror every count into
+        # THIS fleet's own dict (stats() reports it) so a coexisting
+        # fleet's traffic is never misattributed — same discipline as
+        # ServingEngine._inc
+        self._counts = {k: 0 for k in self._stats}
+        self._g_up = metrics.gauge("fleet.replicas_up")
+        self._g_configured = metrics.gauge("fleet.replicas_configured")
+        self._g_pending = metrics.gauge("fleet.pending")
+        self._g_recovery = metrics.gauge("fleet.last_recovery_s")
+        self._h_latency = metrics.histogram("fleet.request_latency_s")
+        # instance-local latency window: the registry histogram pools
+        # every fleet in the process, so stats() percentiles come from
+        # here (same cross-contamination fix as ServingEngine tokens/s)
+        self._latencies = collections.deque(maxlen=4096)
+        self._g_configured.set(self.nreplicas)
+
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._ready = collections.deque()     # dispatchable FleetRequests
+        self._pending = {}                    # id -> FleetRequest (table)
+        self._done = {}                       # id -> completed
+        self._failed = {}                     # id -> failed (named reason)
+        self.incidents = []                   # launch.incident_record + extras
+        self.recoveries = []                  # {replica, incarnation, recovery_s}
+        self._t0 = time.time()
+        self._telemetry_next = 0.0
+
+        self._replicas = []
+        self._threads = []
+        try:
+            for i in range(self.nreplicas):
+                lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                lst.bind(("127.0.0.1", 0))
+                lst.listen(1)
+                self._replicas.append(_Replica(i, lst))
+            for r in self._replicas:
+                self._spawn(r)
+        except Exception:
+            # a mid-fleet spawn failure (EMFILE, log_dir perms, ...) must
+            # not leak the replicas already started — they would sit in
+            # recv_msg forever, unsupervised (same guard as
+            # launch.spawn_group)
+            for r in self._replicas:
+                if r.worker is not None:
+                    r.worker["proc"].kill()
+                    _launch.close_worker_log(r.worker)
+                r.listener.close()
+            raise
+        for r in self._replicas:
+            t = threading.Thread(target=self._drive, args=(r,),
+                                 name=f"fleet-replica-{r.id}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens=16, eos_token=None,
+               request_id=None, deadline_s=None):
+        """Admit one request; returns its :class:`FleetRequest` handle.
+        Re-submitting an id already pending/completed returns the
+        EXISTING record (dedupe — a client retrying over a flaky hop
+        can't double-serve).  Raises :class:`FleetOverloaded` past the
+        global ``max_pending`` bound."""
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
+        req = FleetRequest(prompt, max_new_tokens, eos_token=eos_token,
+                           request_id=request_id, deadline_s=deadline_s)
+        with self._lock:
+            for table in (self._pending, self._done, self._failed):
+                if req.id in table:
+                    return table[req.id]
+            if len(self._pending) >= self.max_pending:
+                self._inc("sheds")
+                raise FleetOverloaded(
+                    f"pending table at max_pending {self.max_pending} "
+                    f"({len(self._done)} completed so far) — shed and "
+                    "retry with backoff")
+            self._pending[req.id] = req
+            self._ready.append(req)
+            self._inc("requests_admitted")
+            self._g_pending.set(len(self._pending))
+        return req
+
+    # ------------------------------------------------- replica lifecycle
+    def _worker_env(self, r):
+        env = dict(self.env_base)
+        env["PADDLE_FLEET_PORT"] = str(r.port)
+        env["PADDLE_FLEET_REPLICA"] = str(r.id)
+        # faults rank/restart filters + telemetry rank = the replica id
+        env["PADDLE_TRAINER_ID"] = str(r.id)
+        env["PADDLE_RESTART_COUNT"] = str(r.incarnation)
+        env["PADDLE_FLEET_MODEL"] = json.dumps(self.model_spec,
+                                               sort_keys=True)
+        if self.jit_cache_dir:
+            env["PADDLE_JIT_CACHE_DIR"] = os.path.abspath(
+                self.jit_cache_dir)
+        if self.telemetry_dir:
+            env["PADDLE_TELEMETRY_DIR"] = os.path.abspath(
+                self.telemetry_dir)
+        # a worker is ONE engine process, never a jax.distributed member
+        env.pop("PADDLE_MASTER", None)
+        return env
+
+    def _spawn(self, r):
+        log_path = None
+        if self.log_dir:
+            log_path = os.path.join(self.log_dir,
+                                    f"replica{r.id}.log")
+        r.worker = _launch.spawn_worker(
+            self.worker_argv, self._worker_env(r), log_path=log_path)
+        r.state = "starting"
+        r.spawn_deadline = time.monotonic() + self.spawn_timeout_s
+
+    def _await_hello(self, r):
+        """Accept the (re)spawned worker's connection + hello.  Bounded
+        by spawn_timeout_s; a worker dying while starting is an
+        incident like any other."""
+        r.listener.settimeout(0.25)
+        while not self._stop.is_set():
+            if r.worker["proc"].poll() is not None:
+                raise _ReplicaGone(
+                    f"worker exited rc={r.worker['proc'].poll()} "
+                    "before hello")
+            if time.monotonic() > r.spawn_deadline:
+                raise _ReplicaGone(
+                    f"no hello within spawn_timeout_s="
+                    f"{self.spawn_timeout_s}")
+            try:
+                conn, _ = r.listener.accept()
+            except socket.timeout:
+                continue
+            conn.settimeout(self.heartbeat_s)
+            try:
+                hello = recv_msg(conn)
+            except (OSError, ValueError) as e:
+                conn.close()
+                raise _ReplicaGone(f"bad hello: {e}") from e
+            r.conn = conn
+            r.hello = hello
+            r.last_stats = hello.get("stats") or {}
+            r.state = "healthy"
+            self._g_up.inc(1)
+            if r.incident_t is not None:
+                rec = round(time.monotonic() - r.incident_t, 3)
+                r.incident_t = None
+                self._g_recovery.set(rec)
+                with self._lock:
+                    self.recoveries.append({
+                        "replica": r.id, "incarnation": r.incarnation,
+                        "recovery_s": rec,
+                        "warm_cache_misses": (hello.get(
+                            "persistent_cache") or {}).get("misses"),
+                    })
+            return
+
+    def _incident(self, r, reason):
+        """Exactly-once per incarnation (driver thread is the sole
+        owner): record, kill whatever is left, re-queue the in-flight
+        table, schedule the backoff relaunch."""
+        proc = r.worker["proc"] if r.worker else None
+        if proc is not None and proc.poll() is None:
+            proc.kill()                  # a suspect replica must not keep
+            try:                         # serving half-connected
+                proc.wait(timeout=10)
+            except Exception:                              # noqa: BLE001
+                pass
+        rc = proc.poll() if proc is not None else None
+        if r.conn is not None:
+            try:
+                r.conn.close()
+            except OSError:
+                pass
+            r.conn = None
+        if r.state == "healthy":
+            self._g_up.inc(-1)
+        was = r.state
+        r.state = "dead"
+        if r.incident_t is None:         # first detection this incarnation
+            r.incident_t = time.monotonic()
+        rec = _launch.incident_record(
+            r.id, rc, r.incarnation,
+            log_path=(r.worker or {}).get("log_path"), t0=self._t0)
+        rec["replica"] = r.id
+        rec["reason"] = reason
+        rec["state_at_failure"] = was
+        self._inc("incidents")
+        with self._lock:
+            self.incidents.append(rec)
+            victims = list(r.inflight.values())
+            r.inflight.clear()
+            for req in victims:
+                self._requeue_locked(req, f"replica {r.id} {reason}")
+        timeline.emit({"event": "fleet_incident", "replica": r.id,
+                       "incarnation": r.incarnation, "reason": reason,
+                       "exit_code": rc, "requeued": len(victims)})
+        r.next_spawn_t = time.monotonic() + _launch.backoff_delay(
+            self.restart_backoff_s, r.restarts_used)
+
+    def _maybe_relaunch(self, r):
+        if r.restarts_used >= self.max_restarts:
+            # budget spent: this replica stays down.  If EVERY replica is
+            # permanently down the pending table can never drain — fail
+            # the stranded requests with a named reason (never silent).
+            with self._lock:
+                if all(x.state == "dead"
+                       and x.restarts_used >= self.max_restarts
+                       for x in self._replicas):
+                    for req in list(self._pending.values()):
+                        self._fail_locked(
+                            req, "fleet_down: every replica exhausted "
+                                 "its restart budget")
+            self._stop.wait(0.5)
+            return
+        wait = r.next_spawn_t - time.monotonic()
+        if wait > 0:
+            self._stop.wait(min(wait, 0.25))
+            return
+        r.restarts_used += 1
+        r.incarnation += 1
+        self._inc("replica_restarts")
+        self._spawn(r)
+
+    # ------------------------------------------------------------ driving
+    def _rpc(self, r, msg):
+        """One request/response exchange with heartbeat accounting.  Any
+        failure — dead process, EOF, oversize/undecodable frame, or a
+        reply missing past the heartbeat deadline — raises
+        _ReplicaGone."""
+        if r.worker["proc"].poll() is not None:
+            raise _ReplicaGone(
+                f"process exited rc={r.worker['proc'].poll()}")
+        try:
+            send_msg(r.conn, msg)
+            return recv_msg(r.conn)
+        except socket.timeout as e:
+            self._inc("heartbeat_misses")
+            raise _ReplicaGone(
+                f"heartbeat miss: no reply to '{msg.get('op')}' within "
+                f"{self.heartbeat_s}s") from e
+        except (OSError, ValueError, ConnectionError) as e:
+            self._inc("rpc_errors")
+            raise _ReplicaGone(f"rpc failed: {type(e).__name__}: {e}") \
+                from e
+
+    def _capacity(self, r):
+        """How many more requests this replica can hold: free slots plus
+        bounded worker-queue headroom, judged from the serving.* numbers
+        its last reply carried — the least-loaded routing signal."""
+        st = r.last_stats or {}
+        slots = int(st.get("slots", self._slots))
+        return max(0, slots + self.dispatch_queue_depth
+                   - len(r.inflight))
+
+    def _pick_dispatch(self, r):
+        now = time.perf_counter()
+        batch = []
+        with self._lock:
+            cap = self._capacity(r)
+            skipped = []
+            while self._ready and len(batch) < cap:
+                req = self._ready.popleft()
+                if req.done or req.failed or req.id not in self._pending:
+                    continue                    # cancelled/deduped away
+                if req.expired(now):
+                    self._fail_locked(req, "deadline_exceeded")
+                    self._inc("deadline_exceeded")
+                    continue
+                if req.not_before > now:
+                    skipped.append(req)         # still backing off
+                    continue
+                if req.retries:
+                    self._inc("retries")
+                req.replica = r.id
+                req.replicas_tried.append(r.id)
+                r.inflight[req.id] = req
+                batch.append(req)
+            self._ready.extend(skipped)
+        return batch
+
+    def _rpc_submit(self, r, batch):
+        resp = self._rpc(r, {
+            "op": "submit",
+            "requests": [{"id": q.id, "prompt": q.prompt,
+                          "max_new_tokens": q.max_new_tokens,
+                          "eos_token": q.eos_token} for q in batch]})
+        rejected = resp.get("rejected") or []
+        with self._lock:
+            for rej in rejected:
+                req = r.inflight.pop(rej["id"], None)
+                if req is None:
+                    continue
+                if rej.get("permanent"):
+                    # a request the engine can NEVER serve (prompt too
+                    # long for the ladder, ...) — failing fast beats
+                    # bouncing it between replicas forever
+                    self._inc("rejects_permanent")
+                    self._fail_locked(
+                        req, f"rejected: {rej.get('err', 'unserveable')}")
+                else:                           # back-pressure: try later
+                    req.not_before = time.perf_counter() + 0.05
+                    self._ready.append(req)
+
+    def _handle_step_resp(self, r, resp):
+        for fin in resp.get("finished") or []:
+            # dup or not, ack it — the worker's buffer must drain
+            self._complete(fin, r)
+            r.pending_ack.append(fin["id"])
+        with self._lock:
+            for rid in resp.get("requeue") or []:
+                req = r.inflight.pop(rid, None)
+                if req is not None:
+                    self._requeue_locked(
+                        req, f"replica {r.id} aborted mid-step: "
+                             f"{resp.get('error')}")
+        r.last_stats = resp.get("stats") or r.last_stats
+
+    def _complete(self, fin, r):
+        rid = fin["id"]
+        with self._lock:
+            req = self._pending.pop(rid, None)
+            r.inflight.pop(rid, None)
+            if req is None:
+                self._inc("dup_completions")
+                return False
+            req.tokens = [int(t) for t in fin.get("tokens") or []]
+            req.finish_reason = fin.get("finish_reason")
+            req.replica = r.id
+            req.done = True
+            req.finish_t = time.perf_counter()
+            self._done[rid] = req
+            self._evict_locked(self._done)
+            self._inc("requests_completed")
+            lat = req.finish_t - req.submit_t
+            self._h_latency.observe(lat)
+            self._latencies.append(lat)
+            self._g_pending.set(len(self._pending))
+        return True
+
+    def _evict_locked(self, table):
+        """Keep a finished-request table inside done_retention (dicts
+        iterate in insertion order: the oldest entries go first).  The
+        dedupe window shrinks with it — callers re-using request ids
+        older than the retention horizon are re-served, not deduped."""
+        while len(table) > self.done_retention:
+            table.pop(next(iter(table)))
+
+    def _requeue_locked(self, req, reason):
+        """Back into the ready queue (bounded retries + backoff) — the
+        no-request-dropped invariant's working end."""
+        if req.done or req.failed:
+            return
+        req.retries += 1
+        self._inc("requeues")
+        if req.retries > self.max_retries:
+            self._fail_locked(req, f"retries_exhausted after "
+                                   f"{self.max_retries}: {reason}")
+            return
+        req.not_before = time.perf_counter() + self.retry_backoff_s \
+            * (2 ** (req.retries - 1))
+        req.replica = None
+        # re-queued work jumps the line: it has already waited longest
+        self._ready.appendleft(req)
+
+    def _fail_locked(self, req, reason):
+        self._pending.pop(req.id, None)
+        if req.done or req.failed:
+            return
+        req.failed = True
+        req.error = reason
+        req.finish_t = time.perf_counter()
+        self._failed[req.id] = req
+        self._evict_locked(self._failed)
+        self._inc("requests_failed")
+        self._g_pending.set(len(self._pending))
+
+    def _sweep_deadlines(self, r):
+        now = time.perf_counter()
+        with self._lock:
+            for rid, req in list(r.inflight.items()):
+                if req.expired(now):
+                    r.inflight.pop(rid)
+                    r.pending_cancel.append(rid)
+                    self._inc("deadline_exceeded")
+                    self._fail_locked(req, "deadline_exceeded")
+
+    def _publish_telemetry(self):
+        """Router snapshot (rank = nreplicas, past the replica ids) into
+        the shared telemetry dir, so merge_from_dir shows the fleet.*
+        counters next to the per-replica serving stats.  Written
+        directly — NOT via timeline.configure(), whose process-global
+        state would race across the driver threads."""
+        if not self.telemetry_dir:
+            return
+        with self._lock:
+            now = time.monotonic()
+            if now < self._telemetry_next:
+                return
+            self._telemetry_next = now + 2.0
+        try:
+            from ..observability import aggregate
+            snap = aggregate.snapshot_record(rank=self.nreplicas)
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            path = os.path.join(self.telemetry_dir,
+                                f"snapshot_rank{self.nreplicas}.json")
+            tmp = f"{path}.tmp{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                json.dump(snap, f, sort_keys=True)
+            os.replace(tmp, path)
+        except Exception:                                  # noqa: BLE001
+            pass              # telemetry must never hurt serving
+
+    def _drive(self, r):
+        """Per-replica driver thread: relaunch when dead, handshake when
+        starting, otherwise dispatch + step + health-check.  All
+        incidents for this replica funnel through here (exactly-once)."""
+        while not self._stop.is_set():
+            try:
+                if r.state == "dead":
+                    self._maybe_relaunch(r)
+                    continue
+                if r.state == "starting":
+                    self._await_hello(r)
+                    continue
+                self._sweep_deadlines(r)
+                batch = self._pick_dispatch(r)
+                if batch:
+                    self._rpc_submit(r, batch)
+                st = r.last_stats or {}
+                busy = bool(batch or r.inflight
+                            or st.get("queue_depth")
+                            or st.get("slot_occupancy"))
+                msg = {"op": "step" if busy else "ping",
+                       "max_steps": self.steps_per_rpc if busy else 0,
+                       "ack": r.pending_ack[:],
+                       "cancel": r.pending_cancel[:]}
+                r.pending_ack.clear()
+                r.pending_cancel.clear()
+                resp = self._rpc(r, msg)
+                self._handle_step_resp(r, resp)
+                self._publish_telemetry()
+                if not busy:
+                    self._stop.wait(self.heartbeat_idle_s)
+            except _ReplicaGone as e:
+                self._incident(r, str(e))
+            except Exception as e:                         # noqa: BLE001
+                # a router-side bug must not strand the replica's
+                # in-flight requests: treat as an incident and relaunch
+                self._incident(r, f"driver error: "
+                                  f"{type(e).__name__}: {e}")
+
+    # ------------------------------------------------------------- public
+    def kill_replica(self, rid, sig=signal.SIGKILL):
+        """Hard-kill a replica's process (chaos harness / bench).  The
+        driver thread detects the death and runs the normal incident
+        path — requeue, backoff, relaunch."""
+        r = self._replicas[rid]
+        pid = r.pid
+        if pid is not None:
+            try:
+                os.kill(pid, sig)
+            except ProcessLookupError:
+                pass
+        return pid
+
+    def replicas_up(self):
+        return sum(1 for r in self._replicas if r.state == "healthy")
+
+    def await_healthy(self, n=None, timeout=60.0, poll=0.05):
+        """Block until at least ``n`` replicas (default all) are
+        healthy; returns the healthy count (which may be short if
+        ``timeout`` expires — callers assert)."""
+        want = self.nreplicas if n is None else int(n)
+        deadline = time.monotonic() + timeout
+        while self.replicas_up() < want \
+                and time.monotonic() < deadline:
+            time.sleep(poll)
+        return self.replicas_up()
+
+    def pending_count(self):
+        with self._lock:
+            return len(self._pending)
+
+    def drain(self, timeout=None, poll=0.02):
+        """Block until every admitted request completed or failed.
+        Returns (done, failed) dicts by id.  Raises TimeoutError with
+        the stranded ids when ``timeout`` expires — silence is the one
+        thing a durability layer may never produce."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return dict(self._done), dict(self._failed)
+                stranded = list(self._pending)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(stranded)} requests still pending after "
+                    f"{timeout}s: {stranded[:8]}{'...' if len(stranded) > 8 else ''}")
+            time.sleep(poll)
+
+    def _inc(self, key, v=1):
+        """Count into the process-global fleet.* registry family AND
+        this fleet's own dict — :meth:`stats` reads the latter."""
+        self._stats.inc(key, v)
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + v
+
+    def stats(self):
+        """THIS fleet's counters + live state, one dict (the
+        process-global family — all fleets pooled — is
+        :func:`fleet_stats`)."""
+        out = dict(self._counts)
+        with self._lock:
+            out.update(
+                pending=len(self._pending), completed=len(self._done),
+                failed=len(self._failed), ready=len(self._ready),
+                replicas_up=self.replicas_up(),
+                replicas=self.nreplicas,
+                incidents_detail=list(self.incidents),
+                recoveries=list(self.recoveries))
+        # THIS fleet's window, not the shared registry histogram — a
+        # coexisting fleet's traffic must not shape these percentiles
+        with self._lock:
+            data = sorted(self._latencies)
+
+        def pct(p):
+            if not data:
+                return None
+            rank = max(int(-(-p / 100.0 * len(data) // 1)), 1)
+            return data[min(rank, len(data)) - 1]
+        out["latency_s"] = {"p50": pct(50), "p99": pct(99),
+                            "count": len(data)}
+        return out
+
+    def recovery_time_s(self):
+        """Seconds from the LAST incident's detection to its replacement
+        replica serving again (None until a recovery happened) — the
+        bench's ``fleet_recovery_time_s`` metric."""
+        with self._lock:
+            if not self.recoveries:
+                return None
+            return self.recoveries[-1]["recovery_s"]
+
+    def close(self):
+        """Tear the fleet down: stop driver threads, best-effort
+        graceful worker shutdown, then kill.  Pending requests are
+        failed with reason ``fleet_shutdown`` (never silently lost)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.heartbeat_s + 5)
+        for r in self._replicas:
+            if r.conn is not None:
+                try:
+                    r.conn.settimeout(1.0)
+                    send_msg(r.conn, {"op": "shutdown"})
+                except OSError:
+                    pass
+                try:
+                    r.conn.close()
+                except OSError:
+                    pass
+            if r.worker is not None:
+                try:
+                    _launch.stop_worker(r.worker, term_grace=2.0)
+                except Exception:                          # noqa: BLE001
+                    pass
+                _launch.close_worker_log(r.worker)
+            try:
+                r.listener.close()
+            except OSError:
+                pass
+            if r.state == "healthy":
+                self._g_up.inc(-1)
+                r.state = "dead"
+        with self._lock:
+            for req in list(self._pending.values()):
+                self._fail_locked(req, "fleet_shutdown")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False

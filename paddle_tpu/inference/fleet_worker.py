@@ -1,0 +1,244 @@
+"""One serving-fleet replica: a subprocess wrapping a
+:class:`~paddle_tpu.inference.serving.ServingEngine`, driven by the
+router (``inference/fleet.py``) over a length-prefixed JSON RPC on a
+loopback socket.
+
+Boot sequence: build the model from the ``PADDLE_FLEET_MODEL`` spec
+(every replica builds the IDENTICAL seeded model — that determinism is
+what makes router re-queues token-exact), ``warmup()`` the engine
+(with a shared ``PADDLE_JIT_CACHE_DIR`` a relaunched replica's warmup is
+pure persistent-cache reload: zero compiles), connect to
+``PADDLE_FLEET_PORT`` and send the hello carrying warmup/compile/cache
+stats.  Then serve RPCs single-threaded — the router owns scheduling.
+
+Delivery contract: finished requests stay in a worker-side buffer and
+are re-sent in EVERY step/ping reply until the router acks their ids
+(at-least-once; the router dedupes on request id), so a reply lost to a
+dropped connection can never lose a completion.  A mid-step engine
+failure (device error, injected ``engine_error``) does NOT kill the
+replica: the engine's abort path frees the slots and the victims'
+ids ride back as ``requeue`` — the router re-queues them elsewhere.
+
+Fault hooks (testing/faults.py): ``replica_kill`` fires inside the
+engine's step/admission; ``rpc_delay``/``rpc_drop`` fire per incoming
+RPC here (a drop closes the connection without replying, which the
+router sees as a vanished replica).
+
+Spec keys (all optional): ``preset`` ("gpt_tiny", default), ``cfg``
+(GPTConfig kwargs — overrides preset), ``seed`` (params PRNG, default
+0), ``slots``, ``max_len``, ``seq_buckets``, ``batch_buckets``,
+``max_queue``, ``warmup`` (default true).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+from ..observability import metrics, timeline
+from ..testing import faults as _faults
+from .fleet import recv_msg, send_msg
+
+
+def _build_engine(spec):
+    """The replica's engine, from the router's JSON spec.  Imports jax /
+    the GPT stack HERE (worker process), never in the router."""
+    import jax
+    from ..models import gpt as G
+    from .serving import ServingEngine
+
+    preset = spec.get("preset", "gpt_tiny")
+    if spec.get("cfg"):
+        cfg = G.GPTConfig(**spec["cfg"])
+    elif preset == "gpt_tiny":
+        cfg = G.gpt_tiny()
+    else:
+        cfg = getattr(G, preset)()
+    params = G.init_params(cfg, jax.random.PRNGKey(int(spec.get("seed",
+                                                                0))))
+    kw = {}
+    for k in ("slots", "max_len", "max_queue"):
+        if spec.get(k) is not None:
+            kw[k] = int(spec[k])
+    for k in ("seq_buckets", "batch_buckets"):
+        if spec.get(k) is not None:
+            kw[k] = tuple(int(x) for x in spec[k])
+    return ServingEngine((params, cfg), **kw)
+
+
+def _cache_counters():
+    return {"hits": metrics.counter("compile.persistent_cache_hits").value,
+            "misses":
+                metrics.counter("compile.persistent_cache_misses").value,
+            "requests":
+                metrics.counter("compile.persistent_cache_requests").value}
+
+
+def _stats(engine, extra=None):
+    st = engine.stats()
+    st["slots"] = engine.slots
+    st["persistent_cache"] = _cache_counters()
+    if extra:
+        st.update(extra)
+    return st
+
+
+class _Publisher:
+    """Time-gated per-replica telemetry snapshot (rank = replica id via
+    PADDLE_TRAINER_ID) so the router/bench can merge_from_dir a
+    per-replica view through the PR-4 aggregator."""
+
+    def __init__(self):
+        try:
+            self.interval = float(
+                os.environ.get("PADDLE_TELEMETRY_INTERVAL", "2"))
+        except ValueError:
+            self.interval = 2.0
+        self._next = 0.0
+
+    def maybe(self, step=None):
+        if not timeline.telemetry_dir():
+            return
+        now = time.monotonic()
+        if now < self._next:
+            return
+        self._next = now + self.interval
+        try:
+            from ..observability import aggregate
+            aggregate.publish(step=step)
+        except Exception:                                  # noqa: BLE001
+            pass
+
+
+def serve(sock, engine, replica, incarnation):
+    """The single-threaded RPC loop.  Returns on shutdown / router
+    disconnect / injected rpc_drop."""
+    finished = {}          # id -> result, until the router acks
+    publisher = _Publisher()
+    while True:
+        try:
+            msg = recv_msg(sock)
+        except (ConnectionError, OSError):
+            return 0                       # router went away: exit clean
+        op = str(msg.get("op", ""))
+        if _faults.active() and _faults.rpc_entry(op):
+            # rpc_drop: vanish without replying — the router must treat
+            # us as unhealthy and re-deliver elsewhere
+            print(f"# faults: dropping rpc '{op}' reply",
+                  file=sys.stderr, flush=True)
+            sock.close()
+            return 0
+        for rid in msg.get("ack") or []:
+            finished.pop(rid, None)
+        resp = {"ok": True, "seq": msg.get("seq")}
+        if op == "submit":
+            from .serving import Request, ServingQueueFull
+            accepted, rejected = [], []
+            for item in msg.get("requests") or []:
+                try:
+                    req = Request(item["prompt"],
+                                  item.get("max_new_tokens", 16),
+                                  eos_token=item.get("eos_token"),
+                                  request_id=item["id"])
+                    engine.submit(req)
+                    accepted.append(item["id"])
+                except ServingQueueFull as e:
+                    rejected.append({"id": item["id"], "err": str(e),
+                                     "permanent": False})
+                except Exception as e:                     # noqa: BLE001
+                    rejected.append({"id": item["id"],
+                                     "err": f"{type(e).__name__}: {e}",
+                                     "permanent": True})
+            resp.update(accepted=accepted, rejected=rejected)
+        elif op in ("step", "ping"):
+            requeue, err = [], None
+
+            def buffer_finished(reqs):
+                for r in reqs:
+                    finished[str(r.id)] = {
+                        "id": str(r.id),
+                        "tokens": [int(t) for t in r.tokens],
+                        "finish_reason": r.finish_reason}
+            for _ in range(max(int(msg.get("max_steps", 1)), 0)):
+                st = engine.stats()
+                if not (st["queue_depth"] or st["slot_occupancy"]):
+                    break
+                try:
+                    buffer_finished(engine.step())
+                except Exception as e:                     # noqa: BLE001
+                    # slot-leak fix at work: the engine freed every slot
+                    # and parked the victims — hand their ids back for
+                    # router-side re-queueing and KEEP SERVING.  Anything
+                    # that COMPLETED before the failure is still on the
+                    # engine's finished backlog: report it, don't re-run
+                    err = f"{type(e).__name__}: {e}"
+                    requeue = [str(r.id)
+                               for r in engine.take_aborted()]
+                    buffer_finished(engine.take_finished())
+                    break
+            resp.update(finished=list(finished.values()),
+                        requeue=requeue, error=err)
+        elif op == "cancel":
+            cancelled = [rid for rid in msg.get("ids") or []
+                         if engine.cancel(rid) is not None]
+            resp.update(cancelled=cancelled)
+        elif op == "shutdown":
+            try:
+                send_msg(sock, resp)
+            except OSError:
+                pass
+            return 0
+        else:
+            resp.update(ok=False, err=f"unknown op {op!r}")
+        resp["stats"] = _stats(engine, {
+            "replica": replica, "incarnation": incarnation,
+            "pid": os.getpid()})
+        # cancels ride every message, not just "cancel" ops
+        for rid in msg.get("cancel") or []:
+            engine.cancel(rid)
+        try:
+            send_msg(sock, resp)
+        except OSError:
+            return 0
+        publisher.maybe(step=engine.stats()["decode_steps"])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("paddle_tpu.inference.fleet_worker")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("PADDLE_FLEET_PORT", 0)))
+    ap.add_argument("--replica", type=int,
+                    default=int(os.environ.get("PADDLE_FLEET_REPLICA",
+                                               0)))
+    args = ap.parse_args(argv)
+    if not args.port:
+        ap.error("no router port (--port / PADDLE_FLEET_PORT)")
+    incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    spec = json.loads(os.environ.get("PADDLE_FLEET_MODEL") or "{}")
+
+    t0 = time.perf_counter()
+    engine = _build_engine(spec)
+    warm = engine.warmup() if spec.get("warmup", True) else 0
+    boot_s = time.perf_counter() - t0
+
+    sock = socket.create_connection((args.host, args.port), timeout=30)
+    sock.settimeout(None)              # the router owns the cadence
+    send_msg(sock, {"op": "hello", "replica": args.replica,
+                    "pid": os.getpid(), "incarnation": incarnation,
+                    "warmup_prefill_compiles": warm,
+                    "boot_s": round(boot_s, 3),
+                    "persistent_cache": _cache_counters(),
+                    "stats": _stats(engine)})
+    timeline.emit({"event": "fleet_replica_up", "replica": args.replica,
+                   "incarnation": incarnation, "boot_s": round(boot_s, 3),
+                   "warmup_prefill_compiles": warm,
+                   "persistent_cache": _cache_counters()})
+    return serve(sock, engine, args.replica, incarnation)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,8 +33,9 @@ tokens/s, and compile counters the bench asserts on.
 from __future__ import annotations
 
 import collections
-import itertools
+import os
 import time
+import uuid
 
 import numpy as np
 
@@ -42,6 +43,7 @@ from ..framework import jax_compat
 from ..models import gpt
 from ..observability import metrics, timeline
 from ..ops.dispatch import SignatureLRU
+from ..testing import faults as _faults
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4)
 
@@ -83,6 +85,8 @@ def _stats_family():
         "prefill_calls": 0, "decode_steps": 0,
         "requests_admitted": 0, "requests_completed": 0,
         "tokens_generated": 0, "queue_rejects": 0,
+        "step_aborts": 0, "requests_aborted": 0,
+        "requests_cancelled": 0,
         "standalone_compiles": 0})
 
 
@@ -98,12 +102,18 @@ class _StatsMirror:
 
 
 class Request:
-    """One generation request's lifecycle record."""
-    _ids = itertools.count()
+    """One generation request's lifecycle record.
+
+    ``request_id`` is the request's STABLE identity: client-suppliable
+    (any hashable — a router retrying across replicas reuses the same id
+    so completions dedupe), auto-assigned a uuid4 hex otherwise.  It
+    travels into ``serving_step`` / ``request_complete`` JSONL events
+    and the latency-histogram labels, so telemetry from different
+    replicas joins on it."""
 
     def __init__(self, prompt, max_new_tokens, eos_token=None,
                  request_id=None):
-        self.id = request_id if request_id is not None else next(self._ids)
+        self.id = request_id if request_id is not None else uuid.uuid4().hex
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -116,6 +126,8 @@ class Request:
         self.logits = None          # per-token [V] rows when captured
         self.slot = None
         self.done = False
+        self.failed = False         # aborted mid-step; re-queueable
+        self.error = None           # the abort's diagnosis when failed
         self.finish_reason = None   # "length" | "eos"
         self.submit_t = time.perf_counter()
         self.finish_t = None
@@ -128,6 +140,21 @@ class Request:
 
     def latency(self):
         return (self.finish_t - self.submit_t) if self.done else None
+
+    def reset_for_retry(self):
+        """Scrub generation state so the SAME Request (same id, same
+        limits) can be re-queued from scratch after a mid-step abort —
+        greedy decoding makes the retry token-exact with a run that
+        never failed."""
+        self.tokens = []
+        self.logits = None
+        self.slot = None
+        self.done = False
+        self.failed = False
+        self.error = None
+        self.finish_reason = None
+        self.finish_t = None
+        return self
 
 
 class ServingEngine:
@@ -188,6 +215,7 @@ class ServingEngine:
         jax_compat.enable_persistent_cache()
         timeline.install_compile_hook()
 
+        self._cache_dtype = cache_dtype
         cache = gpt.init_slot_cache(cfg, self.slots, self.max_len,
                                     dtype=cache_dtype)
         self._cache_k, self._cache_v = cache["k"], cache["v"]
@@ -214,7 +242,16 @@ class ServingEngine:
         self._g_tps = metrics.gauge("serving.tokens_per_s")
         self._h_prefill = metrics.histogram("serving.prefill_s")
         self._h_decode = metrics.histogram("serving.decode_step_s")
-        self._h_req = metrics.histogram("serving.request_latency_s")
+        # a fleet replica labels its latency series with its replica id
+        # (PADDLE_FLEET_REPLICA, set by the router) so per-replica
+        # latency joins across the fleet's merged telemetry
+        self._replica = os.environ.get("PADDLE_FLEET_REPLICA")
+        self._h_req = metrics.histogram(
+            "serving.request_latency_s",
+            **({"replica": self._replica} if self._replica else {}))
+        self._aborted = []          # mid-step abort victims, until taken
+        self._admitting = []        # requests inside the current prefill
+        self._finished_backlog = []  # finished, not yet handed to a caller
         self._tok_window = collections.deque(maxlen=64)  # (t, n) samples
         self._occ_peak = 0
         self._warming = False
@@ -339,11 +376,10 @@ class ServingEngine:
     def _admit(self):
         """Move queued requests into free slots, one prefill wave per
         contiguous same-seq-bucket run (padded to the batch ladder).
-        Returns requests that finished DURING admission — the prefill's
-        first sampled token can already satisfy ``max_new_tokens=1`` or
-        hit ``eos_token``."""
+        Requests finishing DURING admission — the prefill's first
+        sampled token can already satisfy ``max_new_tokens=1`` or hit
+        ``eos_token`` — land on the finished backlog like any other."""
         jnp = self._jnp
-        finished = []
         while self._queue and not self._active.all():
             free = self._free_slots()
             group, sbucket = [], None
@@ -385,6 +421,10 @@ class ServingEngine:
             else:
                 group_rows = {id(req): r for r, req in enumerate(group)}
 
+            # visible to _abort_inflight: these requests left the queue
+            # but are not in _slot_req yet — a prefill failure must mark
+            # them re-queueable too, not silently lose them
+            self._admitting = group
             fn = self._prefill.get(
                 (bbucket, sbucket),
                 lambda: self._build_prefill(bbucket, sbucket))
@@ -413,8 +453,13 @@ class ServingEngine:
                                    else None)
                 self._last_tok[s] = int(first_np[r])
                 self._inc("requests_admitted")
-                if req.done:
-                    finished.append(req)
+                # not during warmup: the quiet counters don't advance
+                # there, so a step/request-scoped fault would see the
+                # same index forever and fire at boot
+                if _faults.active() and not self._warming:
+                    _faults.replica_kill_check(
+                        request=self._counts["requests_admitted"])
+            self._admitting = []
             if not self._warming:
                 self._h_prefill.observe(time.perf_counter() - t0)
         self._g_queue.set(len(self._queue))
@@ -424,7 +469,6 @@ class ServingEngine:
             self._occ_peak = max(self._occ_peak, occ)
             if occ > self._g_occ_peak.value:
                 self._g_occ_peak.set(occ)
-        return finished
 
     def _append_token(self, req, tok, logits_row):
         req.tokens.append(tok)
@@ -444,8 +488,21 @@ class ServingEngine:
         req.done = True
         req.finish_reason = reason
         req.finish_t = time.perf_counter()
+        # completions ride a backlog drained by step()/take_finished():
+        # a request finishing inside a step that LATER raises must still
+        # reach the caller (the fleet worker reports it to the router) —
+        # returning step-local lists would drop it with the exception
+        self._finished_backlog.append(req)
         if not self._warming:
             self._h_req.observe(req.finish_t - req.submit_t)
+            if timeline.telemetry_dir():
+                timeline.emit({"event": "request_complete",
+                               "request_id": str(req.id),
+                               "replica": self._replica,
+                               "latency_s": round(
+                                   req.finish_t - req.submit_t, 6),
+                               "tokens": len(req.tokens),
+                               "finish_reason": reason})
         if req.slot is not None:
             s = req.slot
             self._active[s] = False
@@ -458,11 +515,92 @@ class ServingEngine:
         """One engine iteration: admit from the queue into free slots,
         then one slot-batched decode step.  Returns the requests that
         FINISHED this iteration (their slots are already free — the next
-        ``step()`` re-admits from the queue: continuous batching)."""
-        finished = self._admit()
+        ``step()`` re-admits from the queue: continuous batching).
+
+        If the step raises mid-flight (device error, injected
+        ``engine_error`` fault), every in-flight request is ABORTED
+        rather than leaked: its slot is freed, the KV pool is rebuilt
+        (a failed donated dispatch may have consumed the buffers), and
+        the request is marked ``failed``/re-queueable and parked in
+        :meth:`take_aborted` — occupancy recovers instead of pinning
+        dead slots forever.  The original exception still propagates;
+        requests that COMPLETED before the failure stay on the finished
+        backlog and come back from the next ``step()`` /
+        :meth:`take_finished` — a crash after a completion never
+        un-completes it."""
+        try:
+            self._step_inner()
+        except Exception as e:
+            self._abort_inflight(e)
+            raise
+        return self.take_finished()
+
+    def take_finished(self):
+        """Drain the finished-request backlog (normally what ``step()``
+        just returned; after a step that RAISED, the requests that
+        completed before the failure)."""
+        out, self._finished_backlog = self._finished_backlog, []
+        return out
+
+    def _abort_inflight(self, err):
+        """Free every slot and mark the victims re-queueable (the
+        slot-leak fix): in-flight requests AND any mid-admission group
+        whose prefill failed after leaving the queue."""
+        aborted = [r for r in self._slot_req if r is not None]
+        aborted += [r for r in self._admitting
+                    if r not in aborted and not r.done]
+        self._admitting = []
+        detail = f"{type(err).__name__}: {err}"
+        for req in aborted:
+            req.failed = True
+            req.error = detail
+            req.slot = None
+        self._active[:] = False
+        self._lens[:] = 0
+        self._slot_req = [None] * self.slots
+        # rebuild the donated KV pool: the failed dispatch may have
+        # consumed (donated) the old buffers, and whatever it scattered
+        # is untrusted anyway — every victim restarts from its prompt
+        cache = gpt.init_slot_cache(self.cfg, self.slots, self.max_len,
+                                    dtype=self._cache_dtype)
+        self._cache_k, self._cache_v = cache["k"], cache["v"]
+        self._g_occ.set(0)
+        if aborted:
+            self._inc("step_aborts")
+            self._inc("requests_aborted", len(aborted))
+            self._aborted.extend(aborted)
+        return aborted
+
+    def take_aborted(self):
+        """Drain the requests aborted by failed steps since the last
+        call — the fleet worker re-queues these (each already
+        ``reset_for_retry()``-able; ids are stable so the router
+        dedupes)."""
+        out, self._aborted = self._aborted, []
+        return out
+
+    def cancel(self, request_id):
+        """Remove a QUEUED request by id (deadline/cancel path); returns
+        the Request or None.  An in-flight request runs to completion —
+        callers dedupe/discard its completion by id."""
+        for req in self._queue:
+            if req.id == request_id:
+                self._queue.remove(req)
+                self._g_queue.set(len(self._queue))
+                self._inc("requests_cancelled")
+                return req
+        return None
+
+    def _step_inner(self):
+        self._admit()
         if not self._active.any():
-            return finished
+            return
+        finished = []        # this decode wave's, for the step event
         jnp = self._jnp
+        if _faults.active() and not self._warming:
+            _faults.engine_step_error(self._counts["decode_steps"] + 1)
+            _faults.replica_kill_check(
+                step=self._counts["decode_steps"] + 1)
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
             self._inc("decode_compiles")
@@ -502,8 +640,9 @@ class ServingEngine:
                            "active": int(self._active.sum()),
                            "queue": len(self._queue),
                            "decode_s": round(dt, 6),
-                           "finished": len(finished)})
-        return finished
+                           "finished": len(finished),
+                           # stable ids: telemetry joins across replicas
+                           "finished_ids": [str(r.id) for r in finished]})
 
     def _tps_value(self):
         """Tokens/s over THIS engine's recent-sample window (0.0 until

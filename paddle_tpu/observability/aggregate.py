@@ -182,8 +182,10 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
         steps = snap.get("steps") or 0
         faults = {}
         fams = snap.get("families") or {}
+        # "fleet" rides along: the router's requeues/sheds/heartbeat
+        # misses are fault counters in every sense that matters here
         for fam in ("faults", "watchdog", "launch", "checkpoint",
-                    "bootstrap"):
+                    "bootstrap", "fleet"):
             for k, v in (fams.get(fam) or {}).items():
                 if v:
                     faults[f"{fam}.{k}"] = v

@@ -317,6 +317,15 @@ def reset_serving_stats():
     metrics.reset("serving")
 
 
+def fleet_stats():
+    """Serving-fleet router counter family (inference/fleet.py):
+    admissions/completions/failures, re-queues and retries, load sheds,
+    heartbeat misses, replica incidents/restarts, dedupe hits.  A pure
+    registry read (a process that never routed reports an empty
+    family)."""
+    return metrics.families().get("fleet", {})
+
+
 def fast_path_summary():
     """One dict with every fast-path counter family — what the bench.py
     eager microbench and dp-overlap bench assert on — plus the ``faults``
@@ -327,7 +336,8 @@ def fast_path_summary():
                     ("reducer", reducer_stats),
                     ("prefetch", prefetch_stats),
                     ("faults", faults_stats),
-                    ("serving", serving_stats)):
+                    ("serving", serving_stats),
+                    ("fleet", fleet_stats)):
         try:
             out[key] = fn()
         except Exception:                                  # noqa: BLE001

@@ -31,6 +31,30 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         published — a non-atomic-filesystem torn write — so restore's
         digest verify + quarantine path can be exercised end to end.
 
+    Serving-fleet faults (consumed by inference/fleet_worker.py and the
+    ServingEngine; ``rank`` here is the REPLICA id the router assigns via
+    PADDLE_TRAINER_ID, ``restart`` the replica incarnation)::
+
+    replica_kill:step=6,rank=1[,code=43]  /  replica_kill:request=5,...
+        hard-exit the matching serving replica when its engine announces
+        decode step N (``step=``) or admits its Nth request
+        (``request=``) — a replica dying with requests in flight; the
+        router must re-queue them onto survivors.
+    rpc_delay:nth=2[,op=step][,seconds=0.5]
+        sleep before answering the Nth matching router RPC (network
+        blip / slow replica; exercises heartbeat margins).  With
+        ``repeat=1`` every matching RPC is delayed — a persistently
+        SLOW replica the least-loaded router should route around.
+    rpc_drop:nth=3[,op=step]
+        drop the reply to the Nth matching RPC (worker closes the
+        connection without answering) — the router sees a vanished
+        response and must retry/health-check, and any completion
+        riding that reply must be re-delivered, deduped by request id.
+    engine_error:step=4
+        the engine's decode step N raises InjectedFault mid-step — the
+        slot-leak regression path: in-flight requests must be marked
+        re-queueable and their slots freed, never leaked.
+
 Every fault fires at most once (add ``repeat=1`` to re-arm after each
 fire); ``nth`` counts only calls whose other filters matched, so the Nth
 occurrence is deterministic run to run.  ``rank``/``restart`` filters
@@ -125,7 +149,7 @@ def _want_int(fault, key):
     return None if v is None else int(v)
 
 
-def take(kind, step=None, op=None):
+def take(kind, step=None, op=None, request=None):
     """The matching armed fault for this call site, or None.  A matching
     call advances the fault's occurrence counter; the fault fires (and
     disarms, unless ``repeat``) when the counter reaches ``nth``
@@ -149,6 +173,10 @@ def take(kind, step=None, op=None):
             # a step-scoped fault never matches a call site that has no
             # step notion (step=None) — firing "at the first occurrence"
             # instead would silently corrupt the chaos scenario
+            continue
+        if _want_int(fault, "request") is not None \
+                and _want_int(fault, "request") != request:
+            # same contract as step= for request-count-scoped faults
             continue
         want_op = fault.get("op") or fault.get("file")
         if want_op and want_op not in str(op or ""):
@@ -205,3 +233,42 @@ def checkpoint_truncate(step, file):
     checkpoint writer truncates the file and (unless ``publish=1``)
     simulates the writer crashing before the atomic rename."""
     return take("ckpt_truncate", step=step, op=file)
+
+
+# ------------------------------------------------------- serving faults
+def replica_kill_check(step=None, request=None):
+    """Serving replicas call this per engine step (``step=``) and per
+    admitted request (``request=``); a matching ``replica_kill`` fault
+    hard-exits the replica — the router sees a dead worker with requests
+    in flight and must re-queue them."""
+    fault = take("replica_kill", step=step, request=request)
+    if fault is not None:
+        code = int(fault.get("code", 43))
+        where = (f"step {step}" if step is not None
+                 else f"request {request}")
+        print(f"# faults: replica kill at {where} (exit {code})",
+              file=sys.stderr, flush=True)
+        os._exit(code)
+
+
+def rpc_entry(op):
+    """Called by the fleet worker's RPC server per incoming message.
+    ``rpc_delay`` sleeps before the reply (slow replica / network blip);
+    a matching ``rpc_drop`` returns True — the caller must close the
+    connection WITHOUT replying, so the router exercises its
+    retry/health path and completion dedupe."""
+    fault = take("rpc_delay", op=op)
+    if fault is not None:
+        time.sleep(float(fault.get("seconds", 0.5)))
+    return take("rpc_drop", op=op) is not None
+
+
+def engine_step_error(step):
+    """Called by ServingEngine.step() before the decode dispatch; a
+    matching ``engine_error`` fault raises InjectedFault mid-step — the
+    slot-leak regression path (in-flight requests must be freed and
+    marked re-queueable, not leaked)."""
+    fault = take("engine_error", step=step)
+    if fault is not None:
+        raise InjectedFault(
+            f"injected serving engine error at decode step {step}")

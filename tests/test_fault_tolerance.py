@@ -569,6 +569,83 @@ class TestCollectiveWatchdog:
             collective._kv_call(client, "key_value_set", "k", "v")
         assert client.set_calls == 3      # 1 try + 2 retries
 
+    def test_retry_exhaustion_mid_rendezvous_is_collective_timeout(
+            self, monkeypatch):
+        """PADDLE_KV_RETRIES exhausted on a transient coordinator failure
+        DURING a rendezvous must surface as a diagnosable
+        CollectiveTimeout naming op/group/ranks — not hang, and not leak
+        the bare UNAVAILABLE KV error up through the training loop."""
+        from paddle_tpu.distributed import collective
+
+        class _Flaky(_FakeKVClient):
+            # barrier passes; the per-rank GETs are persistently flaky —
+            # the retry loop must exhaust and the wrapper must convert
+            def wait_at_barrier(self, name, timeout_ms, *a):
+                self.barrier_calls += 1
+
+            def blocking_key_value_get(self, key, timeout_ms):
+                raise RuntimeError("UNAVAILABLE: coordinator restarting")
+
+        client = _Flaky()
+        monkeypatch.setattr(collective, "_kv_world",
+                            lambda: (client, 2, 0))
+        monkeypatch.setenv("PADDLE_KV_RETRIES", "1")
+        monkeypatch.setenv("PADDLE_COLLECTIVE_TIMEOUT", "1")
+        before = collective.watchdog_stats()["collective_timeouts"]
+        with pytest.raises(collective.CollectiveTimeout) as ei:
+            collective._kv_allgather(np.ones(2), op="fleet_gather",
+                                     group=None)
+        msg = str(ei.value)
+        assert "fleet_gather" in msg                     # names the op
+        assert "WORLD" in msg                            # names the group
+        assert "2" in msg                                # names the world
+        assert "PADDLE_KV_RETRIES exhausted" in msg      # names the cause
+        assert collective.watchdog_stats()["collective_timeouts"] \
+            == before + 1
+
+    def test_retry_exhaustion_at_barrier_is_collective_timeout(
+            self, monkeypatch):
+        """Same contract for the plain barrier() rendezvous path."""
+        from paddle_tpu.distributed import collective
+
+        class _Flaky(_FakeKVClient):
+            def wait_at_barrier(self, name, timeout_ms, *a):
+                self.barrier_calls += 1
+                raise RuntimeError("UNAVAILABLE: connection reset")
+
+        client = _Flaky()
+        monkeypatch.setattr(collective, "_kv_world",
+                            lambda: (client, 2, 0))
+        monkeypatch.setattr(collective, "_process_count", lambda: 2)
+        monkeypatch.setenv("PADDLE_KV_RETRIES", "1")
+        monkeypatch.setenv("PADDLE_COLLECTIVE_TIMEOUT", "1")
+
+        def _no_sync(name):
+            raise RuntimeError("no cross-process device collectives")
+        import jax.experimental.multihost_utils as mhu
+        monkeypatch.setattr(mhu, "sync_global_devices", _no_sync)
+        with pytest.raises(collective.CollectiveTimeout,
+                           match="PADDLE_KV_RETRIES exhausted"):
+            collective.barrier()
+        assert client.barrier_calls == 2     # 1 try + 1 retry, then raise
+
+    def test_nontransient_kv_error_stays_bare(self, monkeypatch):
+        """A NON-transient mid-rendezvous failure (a real bug, e.g. a
+        pickling error) must keep its own type — wrapping it as a
+        timeout would misdirect the operator at a dead rank that
+        doesn't exist."""
+        from paddle_tpu.distributed import collective
+
+        class _Broken(_FakeKVClient):
+            def wait_at_barrier(self, name, timeout_ms, *a):
+                raise AttributeError("client lost its barrier method")
+
+        monkeypatch.setattr(collective, "_kv_world",
+                            lambda: (_Broken(), 2, 0))
+        monkeypatch.setenv("PADDLE_COLLECTIVE_TIMEOUT", "1")
+        with pytest.raises(AttributeError):
+            collective._kv_allgather(np.ones(2), op="allgather")
+
 
 # ------------------------------------------------------ bootstrap retry ----
 

@@ -1,0 +1,397 @@
+"""Serving-fleet tests (ISSUE 7): the router's durability contract
+(re-queue on crash, dedupe, shedding, deadlines), the engine's
+slot-leak-on-failure fix, stable request ids in telemetry, the
+serving-fault injection hooks, and the richer launcher incident
+records.
+
+Subprocess fleets use a deliberately tiny GPT so each replica boots in
+a couple of seconds on the CPU backend; everything else is in-process.
+"""
+import glob
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.env import clean_cpu_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_CFG = {"vocab_size": 256, "hidden_size": 32, "num_layers": 2,
+            "num_heads": 2, "max_seq_len": 128, "dtype": "float32",
+            "use_flash": False, "remat": False}
+SPEC = {"cfg": TINY_CFG, "seed": 0, "slots": 2, "max_len": 96,
+        "seq_buckets": [8], "batch_buckets": [1, 2]}
+
+
+def _engine(slots=2, max_len=32, **kw):
+    import jax
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.inference.serving import ServingEngine
+    cfg = G.GPTConfig(**TINY_CFG)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    return ServingEngine((params, cfg), slots=slots, max_len=max_len,
+                         seq_buckets=(8,), batch_buckets=(1, 2), **kw)
+
+
+def _fleet(tmp_path, tag, replicas=2, fault_spec=None, **kw):
+    from paddle_tpu.inference.fleet import ServingFleet
+    env = clean_cpu_env(REPO, device_count=1)
+    env.pop("PADDLE_FAULTS", None)
+    if fault_spec:
+        env["PADDLE_FAULTS"] = fault_spec
+    kw.setdefault("heartbeat_s", 20)
+    kw.setdefault("restart_backoff_s", 0.2)
+    return ServingFleet(SPEC, replicas=replicas, env_base=env,
+                        log_dir=str(tmp_path / tag / "logs"), **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------ wire protocol ----
+
+class TestFraming:
+    def test_roundtrip(self):
+        from paddle_tpu.inference.fleet import recv_msg, send_msg
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, {"op": "step", "ids": list(range(50))})
+            out = recv_msg(b)
+            assert out["op"] == "step" and len(out["ids"]) == 50
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_raises_connection_error(self):
+        from paddle_tpu.inference.fleet import recv_msg
+        a, b = socket.socketpair()
+        a.close()
+        with pytest.raises(ConnectionError):
+            recv_msg(b)
+        b.close()
+
+    def test_oversize_frame_rejected(self):
+        import struct
+        from paddle_tpu.inference.fleet import recv_msg
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", 1 << 30))
+            with pytest.raises(ConnectionError, match="oversized"):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# ------------------------------------------------ engine slot-leak fix ----
+
+class TestEngineAbort:
+    def test_mid_step_failure_frees_slots_and_marks_requeueable(self):
+        """Satellite regression: a decode step raising must not leave
+        in-flight requests pinning their slots forever — occupancy
+        recovers, the victims are failed/re-queueable, and the SAME
+        engine serves the retries token-exactly."""
+        eng = _engine()
+        r1 = eng.submit(np.arange(1, 6, dtype=np.int32), 6)
+        r2 = eng.submit(np.arange(1, 4, dtype=np.int32), 6)
+        eng.step()
+        want1, want2 = list(r1.tokens), list(r2.tokens)
+        assert eng.stats()["slot_occupancy"] == 2
+        faults.install("engine_error:step=2")
+        with pytest.raises(faults.InjectedFault):
+            eng.step()
+        st = eng.stats()
+        assert st["slot_occupancy"] == 0, st       # the leak, fixed
+        assert st["step_aborts"] == 1
+        assert st["requests_aborted"] == 2
+        aborted = eng.take_aborted()
+        assert {a.id for a in aborted} == {r1.id, r2.id}
+        assert all(a.failed and not a.done and a.error for a in aborted)
+        assert eng.take_aborted() == []            # drained exactly once
+        # the engine keeps serving, and retries are token-exact
+        for a in aborted:
+            eng.submit(a.reset_for_retry())
+        done = eng.run()
+        assert len(done) == 2
+        assert r1.tokens[:len(want1)] == want1
+        assert r2.tokens[:len(want2)] == want2
+        assert len(r1.tokens) == 6 and len(r2.tokens) == 6
+
+    def test_completion_before_failure_survives_on_backlog(self):
+        """A request that COMPLETES inside a step that later raises must
+        not vanish with the exception: it stays on the finished backlog
+        and the next step()/take_finished() delivers it (a crash never
+        un-completes a request)."""
+        eng = _engine()
+        # finishes during ADMISSION (prefill's first sampled token is
+        # its whole budget); the decode fault then fails the same step()
+        quick = eng.submit(np.arange(1, 6, dtype=np.int32), 1)
+        slow = eng.submit(np.arange(1, 4, dtype=np.int32), 8)
+        faults.install("engine_error:step=1")
+        with pytest.raises(faults.InjectedFault):
+            eng.step()
+        assert quick.done and len(quick.tokens) == 1
+        delivered = eng.take_finished()
+        assert delivered == [quick]
+        aborted = eng.take_aborted()
+        assert aborted == [slow] and slow.failed
+
+    def test_prefill_failure_aborts_admitting_group(self, monkeypatch):
+        """A prefill blowing up AFTER its group left the queue must mark
+        that group re-queueable too — not silently lose it."""
+        eng = _engine()
+
+        def boom(*a, **k):
+            raise RuntimeError("device exploded in prefill")
+        monkeypatch.setattr(eng, "_build_prefill",
+                            lambda b, s: boom)
+        r = eng.submit(np.arange(1, 6, dtype=np.int32), 4)
+        with pytest.raises(RuntimeError, match="device exploded"):
+            eng.step()
+        assert eng.stats()["slot_occupancy"] == 0
+        aborted = eng.take_aborted()
+        assert aborted and aborted[0].id == r.id
+        assert r.failed and "device exploded" in r.error
+
+    def test_abort_rebuilds_cache_and_occupancy_gauge(self):
+        from paddle_tpu.observability import metrics
+        eng = _engine()
+        eng.submit(np.arange(1, 6, dtype=np.int32), 8)
+        eng.step()
+        k_before = eng._cache_k
+        faults.install("engine_error:step=2")
+        with pytest.raises(faults.InjectedFault):
+            eng.step()
+        assert eng._cache_k is not k_before        # fresh donated pool
+        assert metrics.gauge("serving.slot_occupancy").value == 0
+
+
+# ------------------------------------------------ stable request ids ----
+
+class TestRequestIds:
+    def test_auto_uuid_and_client_supplied(self):
+        from paddle_tpu.inference.serving import Request
+        a = Request([1, 2], 2)
+        b = Request([1, 2], 2)
+        assert isinstance(a.id, str) and len(a.id) == 32
+        assert a.id != b.id
+        c = Request([1, 2], 2, request_id="client-7")
+        assert c.id == "client-7"
+
+    def test_ids_surface_in_jsonl_events(self, tmp_path):
+        from paddle_tpu.observability import timeline
+        timeline.configure(str(tmp_path))
+        try:
+            eng = _engine()
+            r = eng.submit(np.arange(1, 6, dtype=np.int32), 3,
+                           request_id="ride-along")
+            eng.run()
+            assert r.done
+        finally:
+            timeline.configure(None)
+        recs = []
+        for path in glob.glob(str(tmp_path / "events_rank*.jsonl")):
+            with open(path) as f:
+                recs += [json.loads(line) for line in f if line.strip()]
+        steps = [x for x in recs if x.get("event") == "serving_step"]
+        assert any("ride-along" in (x.get("finished_ids") or [])
+                   for x in steps), steps
+        comp = [x for x in recs if x.get("event") == "request_complete"]
+        assert any(x["request_id"] == "ride-along"
+                   and x["finish_reason"] == "length"
+                   and x["latency_s"] > 0 for x in comp), comp
+
+    def test_replica_label_on_latency_histogram(self, monkeypatch):
+        from paddle_tpu.observability import metrics
+        monkeypatch.setenv("PADDLE_FLEET_REPLICA", "9")
+        eng = _engine()
+        eng.submit(np.arange(1, 4, dtype=np.int32), 2)
+        eng.run()
+        h = metrics.histogram("serving.request_latency_s", replica="9")
+        assert h.count >= 1
+
+
+# ----------------------------------------------------- fault hooks ----
+
+class TestServingFaultHooks:
+    def test_rpc_delay_sleeps_and_drop_signals(self):
+        faults.install("rpc_delay:op=step,seconds=0.05")
+        t0 = time.perf_counter()
+        dropped = faults.rpc_entry("step")
+        assert time.perf_counter() - t0 >= 0.05
+        assert dropped is False
+        faults.install("rpc_drop:op=step")
+        assert faults.rpc_entry("step") is True
+        assert faults.rpc_entry("step") is False   # fired once, disarmed
+
+    def test_replica_kill_filters_on_request_count(self):
+        f = faults.install("replica_kill:request=3")[0]
+        assert faults.take("replica_kill", request=1) is None
+        assert faults.take("replica_kill", request=2) is None
+        assert faults.take("replica_kill", request=3) is f
+        # step-scoped spec never matches a request-only call site
+        faults.clear()
+        faults.install("replica_kill:step=2")
+        assert faults.take("replica_kill", request=2) is None
+
+    def test_engine_error_hook_raises_injected(self):
+        faults.install("engine_error:step=5")
+        faults.engine_step_error(4)                # no-op off the mark
+        with pytest.raises(faults.InjectedFault):
+            faults.engine_step_error(5)
+
+
+# ------------------------------------------------- launcher incidents ----
+
+class TestIncidentRecords:
+    def test_supervise_incidents_carry_signal_and_wall_time(self, tmp_path):
+        """Satellite: the exit summary's per-incident records name the
+        failing rank, decoded signal/rc, wall time and restart count."""
+        import importlib
+        launch = importlib.import_module("paddle_tpu.distributed.launch")
+        script = tmp_path / "die.py"
+        script.write_text("import os, signal; os.kill(os.getpid(), "
+                          "signal.SIGKILL)\n")
+        env = clean_cpu_env(REPO, device_count=1)
+        summary = launch.supervise([str(script)], nprocs=1, env_base=env,
+                                   max_restarts=1, backoff=0.05)
+        assert summary["rc"] == -9
+        assert len(summary["incidents"]) == 2
+        for i, inc in enumerate(summary["incidents"]):
+            assert inc["rank"] == 0
+            assert inc["exit_code"] == -9
+            assert inc["signal"] == "SIGKILL"
+            assert inc["restart_count"] == i
+            assert inc["wall_time_s"] is not None \
+                and inc["wall_time_s"] >= 0
+
+
+# ------------------------------------------------- subprocess fleets ----
+
+def _tiny_prompts(n, seed=0, tokens=24):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(1, 256, int(rng.randint(3, 8))), tokens)
+            for _ in range(n)]
+
+
+class TestFleet:
+    def test_serves_dedupes_sheds_and_deadline(self, tmp_path):
+        """One boot, several contracts: completion, id dedupe, load
+        shedding past max_pending, per-request deadline failure."""
+        from paddle_tpu.inference.fleet import FleetOverloaded
+        fleet = _fleet(tmp_path, "basic", max_pending=64)
+        try:
+            assert fleet.await_healthy(timeout=120) == 2
+            reqs = [fleet.submit(p, m, request_id=f"r{i}")
+                    for i, (p, m) in enumerate(_tiny_prompts(8))]
+            # dedupe: same id returns the SAME pending record
+            again = fleet.submit([9, 9, 9], 4, request_id="r0")
+            assert again is reqs[0]
+            done, failed = fleet.drain(timeout=120)
+            assert not failed and len(done) == 8
+            assert all(len(done[f"r{i}"].tokens) == 24 for i in range(8))
+            # dedupe after completion: the finished record comes back
+            assert fleet.submit([9], 4, request_id="r0") is reqs[0]
+            # shedding: a tiny pending bound rejects fast
+            fleet.max_pending = 1
+            fleet.submit([1, 2, 3], 64, request_id="s0")
+            with pytest.raises(FleetOverloaded):
+                fleet.submit([1, 2, 3], 64, request_id="s1")
+            assert fleet.stats()["sheds"] == 1
+            fleet.max_pending = 64
+            # deadline: an expired request fails NAMED, never silent
+            d = fleet.submit([5, 5, 5], 64, request_id="dl",
+                             deadline_s=0.0)
+            deadline = time.time() + 30
+            while "dl" not in fleet._failed and time.time() < deadline:
+                time.sleep(0.01)
+            assert d.failed and "deadline_exceeded" in d.error
+            done, failed = fleet.drain(timeout=120)
+            assert "dl" in failed and not d.tokens
+            st = fleet.stats()
+            assert st["deadline_exceeded"] >= 1
+            assert st["requests_completed"] >= 9    # s0 still served
+        finally:
+            fleet.close()
+
+    def test_replica_sigkill_requeues_with_token_parity(self, tmp_path):
+        """The tentpole invariant, in-tree: SIGKILL a replica holding
+        in-flight requests; nothing is lost, the re-queued requests'
+        tokens match an in-process reference engine exactly, and the
+        replacement replica comes back."""
+        import jax
+        from paddle_tpu.models import gpt as G
+        cfg = G.GPTConfig(**TINY_CFG)
+        params = G.init_params(cfg, jax.random.PRNGKey(0))
+        prompts = _tiny_prompts(12, seed=5, tokens=48)
+        ref = {f"r{i}": [int(t) for t in np.asarray(
+            G.generate(params, cfg, np.asarray(p)[None], m))[0, len(p):]]
+            for i, (p, m) in enumerate(prompts)}
+
+        fleet = _fleet(tmp_path, "chaos")
+        try:
+            assert fleet.await_healthy(timeout=120) == 2
+            for i, (p, m) in enumerate(prompts):
+                fleet.submit(p, m, request_id=f"r{i}")
+            victim = fleet._replicas[0]
+            deadline = time.time() + 15
+            while not victim.inflight and time.time() < deadline:
+                time.sleep(0.002)
+            assert victim.inflight, "victim never got work"
+            fleet.kill_replica(0)
+            done, failed = fleet.drain(timeout=180)
+            assert not failed and len(done) == 12, (len(done), failed)
+            st = fleet.stats()
+            assert st["incidents"] >= 1 and st["requeues"] >= 1
+            for rid, want in ref.items():
+                assert done[rid].tokens == want, rid
+            assert fleet.await_healthy(timeout=120) == 2
+            assert fleet.recovery_time_s() is not None
+        finally:
+            fleet.close()
+
+    def test_worker_engine_error_requeues_without_restart(self, tmp_path):
+        """A mid-step engine failure inside a replica must NOT need a
+        replica restart: the worker aborts, hands the victims back, the
+        router re-queues them, everything completes."""
+        fleet = _fleet(tmp_path, "engerr",
+                       fault_spec="engine_error:step=3,rank=0")
+        try:
+            assert fleet.await_healthy(timeout=120) == 2
+            for i, (p, m) in enumerate(_tiny_prompts(8, seed=2,
+                                                     tokens=32)):
+                fleet.submit(p, m, request_id=f"r{i}")
+            done, failed = fleet.drain(timeout=180)
+            assert not failed and len(done) == 8
+            st = fleet.stats()
+            assert st["requeues"] >= 1, st
+            assert st["replica_restarts"] == 0, st
+        finally:
+            fleet.close()
+
+    def test_rpc_drop_recovers_without_losing_completions(self, tmp_path):
+        """An injected dropped RPC reply (replica vanishes mid-answer)
+        runs the incident path; any completion riding the lost reply is
+        re-delivered/re-served and deduped — zero lost."""
+        fleet = _fleet(tmp_path, "drop",
+                       fault_spec="rpc_drop:nth=4,op=step,rank=1")
+        try:
+            assert fleet.await_healthy(timeout=120) == 2
+            for i, (p, m) in enumerate(_tiny_prompts(10, seed=3,
+                                                     tokens=32)):
+                fleet.submit(p, m, request_id=f"r{i}")
+            done, failed = fleet.drain(timeout=180)
+            assert not failed and len(done) == 10
+            st = fleet.stats()
+            assert st["incidents"] >= 1, st
+        finally:
+            fleet.close()

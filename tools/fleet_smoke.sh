@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Fleet smoke: the multi-replica serving kill-and-recover scenario on the
+# CPU backend, inside a hard 120s budget — CI's proof that the serving
+# fleet (router + supervised engine replicas + re-queueing + warm
+# restarts) still survives a replica SIGKILL end to end.
+#
+# Runs bench.py --fleet (--cpu-mesh 2 re-execs with a clean forced-CPU
+# env, same dance as tests/conftest.py): 2 replicas take ~20 requests of
+# sustained traffic, one replica is SIGKILLed while it provably holds
+# in-flight requests, and the bench asserts zero lost requests,
+# token-exact parity of the re-queued requests vs an uninterrupted run,
+# and a replacement replica that warm-restarts from the shared
+# persistent compilation cache.  This script additionally greps the
+# parsed JSON metric line for fleet_recovery_time_s and the
+# warm-restart compile count being exactly 0.
+#
+# Usage: tools/fleet_smoke.sh
+# Exit:  bench exit status, or 1 if the metric line / warm-restart
+#        assertion is missing.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+LOG=$(mktemp /tmp/fleet_smoke.XXXXXX.log)
+timeout -k 10 120 env JAX_PLATFORMS=cpu BENCH_FLEET_REQUESTS=20 \
+    python bench.py --fleet --cpu-mesh 2 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+if [ "$rc" -ne 0 ]; then
+    echo "fleet_smoke: FAIL (rc=$rc)" >&2
+    exit "$rc"
+fi
+if ! grep -q '"metric": "fleet_recovery_time_s"' "$LOG"; then
+    echo "fleet_smoke: FAIL — fleet ran but emitted no parsed" \
+         "fleet_recovery_time_s metric line" >&2
+    exit 1
+fi
+if ! grep -q '"lost_requests": 0' "$LOG"; then
+    echo "fleet_smoke: FAIL — metric line does not attest zero lost" \
+         "requests" >&2
+    exit 1
+fi
+if ! grep -q '"warm_cache_misses": 0' "$LOG"; then
+    echo "fleet_smoke: FAIL — replacement replica did not warm-restart" \
+         "with 0 persistent-cache misses" >&2
+    exit 1
+fi
+echo "fleet_smoke: OK"
