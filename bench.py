@@ -781,7 +781,8 @@ def dp_overlap():
 
 def serving_bench():
     """Continuous-batching serving engine: tokens/s and request latency
-    through the slot-pooled KV cache (ISSUE 5 tentpole).
+    through the slot-pooled KV cache (ISSUE 5 tentpole), then the paged
+    KV engine (ISSUE 8) against it at a FIXED KV byte budget.
 
     Asserts the tentpole claims instead of trusting them: the decode-step
     executable compiles exactly ONCE and stays constant while requests
@@ -789,16 +790,22 @@ def serving_bench():
     the measured wave runs with zero new XLA compiles anywhere), prefill
     compiles stay bounded by the (batch, seq) bucket-ladder size, and the
     slot-batched engine's per-token LOGITS and token ids match per-request
-    ``models.gpt.generate`` to 1e-5.  Runs on any backend (CPU smoke
-    included) — the contract being measured is compile reuse + scheduling,
-    not FLOPs.  Knobs: BENCH_SERVING_REQUESTS (default 24),
-    BENCH_SERVING_SLOTS (default 4)."""
+    ``models.gpt.generate`` to 1e-5.  The paged phase re-runs the same
+    mixed-length trace through a PagedServingEngine whose page pool holds
+    EXACTLY the baseline pool's bytes, and asserts the ISSUE-8 criteria:
+    ``kv_bytes_per_token <= 0.6x`` the slot-contiguous baseline,
+    ``>= 1.5x`` admitted concurrency at that byte budget, decode_compiles
+    still 1, zero steady-state compiles, and token-exact parity.  Runs on
+    any backend (CPU smoke included) — the contract being measured is
+    compile reuse + scheduling + memory accounting, not FLOPs.  Knobs:
+    BENCH_SERVING_REQUESTS (default 24), BENCH_SERVING_SLOTS (default 4)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from paddle_tpu import profiler
     from paddle_tpu.models import gpt as G
-    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.serving import (PagedServingEngine,
+                                              ServingEngine)
     from paddle_tpu.observability import metrics as obs_metrics
 
     slots = int(os.environ.get("BENCH_SERVING_SLOTS", 4))
@@ -836,14 +843,42 @@ def serving_bench():
     compiles0 = obs_metrics.counter("compile.count").value
     admitted0 = engine.stats()["requests_admitted"]
 
+    class KVSampler:
+        """Per-step KV accounting: time-averaged bytes reserved per
+        token actually held, plus paged page-utilization."""
+
+        def __init__(self):
+            self.bytes_sum = 0
+            self.tok_sum = 0
+            self.util = []
+            self.n = 0
+
+        def sample(self, st):
+            if st["kv_tokens_held"]:
+                self.bytes_sum += st["kv_bytes_reserved"]
+                self.tok_sum += st["kv_tokens_held"]
+                self.n += 1
+                if "page_utilization" in st:
+                    self.util.append(st["page_utilization"])
+
+        def bytes_per_token(self):
+            return self.bytes_sum / max(1, self.tok_sum)
+
+        def mean_util(self):
+            return (sum(self.util) / len(self.util)) if self.util else None
+
     # measured wave: requests churn through slots with ZERO new compiles
     reqs = []
+    kv_base = KVSampler()
     t0 = time.perf_counter()
     for p, m in make_requests(n_requests, 2):
         reqs.append(engine.submit(p, m))
-    done = engine.run()
-    # host fetch of the last request's tokens bounds the timed region
-    # (tokens are host ints already — the engine fetches per step)
+    done = []
+    while engine._busy():
+        done.extend(engine.step())
+        kv_base.sample(engine.stats())
+    # tokens are host ints already — the engine fetches per step, so the
+    # timed region is bounded without an extra device sync
     dt = time.perf_counter() - t0
     stats = engine.stats()
     new_compiles = obs_metrics.counter("compile.count").value - compiles0
@@ -883,7 +918,67 @@ def serving_bench():
                                  float(np.abs(ref - row).max()))
     assert max_logit_diff < 1e-5, max_logit_diff
 
+    # ---- paged phase (ISSUE 8): same trace, same KV byte budget -------
+    # the paged pool holds EXACTLY the baseline pool's positions
+    # (slots * max_len), cut into page_size-token pages — any extra
+    # concurrency it admits comes from paging alone, not extra memory
+    page_size = 8
+    max_len = 96
+    num_pages = (slots * max_len) // page_size
+    paged_slots = 3 * slots
+    paged = PagedServingEngine(
+        (params, cfg), slots=paged_slots, max_len=max_len,
+        page_size=page_size, num_pages=num_pages,
+        seq_buckets=seq_buckets, batch_buckets=batch_buckets,
+        prefill_chunk=16,                 # prompts > 16 admit chunked
+        max_queue=max(n_requests, 8 * paged_slots))
+    paged.warmup()
+    paged.reset_occupancy_peak()
+    assert paged.stats()["kv_bytes_total"] == engine.stats()[
+        "kv_bytes_reserved"], "byte budgets diverged"
+    compiles1 = obs_metrics.counter("compile.count").value
+    kv_paged = KVSampler()
+    preqs = []
+    t1 = time.perf_counter()
+    for p, m in make_requests(n_requests, 2):     # the SAME mixed trace
+        preqs.append(paged.submit(p, m))
+    pdone = []
+    while paged._busy():
+        pdone.extend(paged.step())
+        kv_paged.sample(paged.stats())
+    dt_paged = time.perf_counter() - t1
+    pstats = paged.stats()
+    paged_new_compiles = (obs_metrics.counter("compile.count").value
+                          - compiles1)
+    assert len(pdone) == n_requests, (len(pdone), n_requests)
+    assert pstats["decode_compiles"] == 1, pstats
+    assert paged_new_compiles == 0, (
+        f"paged steady state retraced: {paged_new_compiles} new XLA "
+        "compiles (warmup must cover ladder + chunk + COW copy)")
+    # token-exact parity on the paged path (after the compile assert:
+    # gpt.generate itself compiles)
+    for req in preqs[:6]:
+        want = np.asarray(G.generate(params, cfg,
+                                     jnp.asarray(req.prompt)[None],
+                                     req.max_new_tokens))[0,
+                                                          len(req.prompt):]
+        assert (want == np.asarray(req.tokens)).all(), (req.id,)
+    bpt_base = kv_base.bytes_per_token()
+    bpt_paged = kv_paged.bytes_per_token()
+    ratio = bpt_paged / bpt_base
+    assert ratio <= 0.6, (
+        f"paged kv_bytes_per_token {bpt_paged:.0f} is {ratio:.2f}x the "
+        f"slot-contiguous baseline {bpt_base:.0f} (need <= 0.6x)")
+    conc_gain = pstats["slot_occupancy_peak"] / max(
+        1, stats["slot_occupancy_peak"])
+    assert conc_gain >= 1.5, (
+        f"paged admitted concurrency {pstats['slot_occupancy_peak']} is "
+        f"only {conc_gain:.2f}x the baseline "
+        f"{stats['slot_occupancy_peak']} at the same KV byte budget "
+        "(need >= 1.5x)")
+
     total_tokens = sum(len(r.tokens) for r in reqs)
+    paged_tokens = sum(len(r.tokens) for r in preqs)
     lat = obs_metrics.histogram("serving.request_latency_s").summary()
     counters = profiler.fast_path_summary()
     print(json.dumps({
@@ -900,7 +995,27 @@ def serving_bench():
             "p95": round(obs_metrics.histogram("serving.decode_step_s")
                          .percentile(95) * 1e3, 3)},
         "max_logit_diff": max_logit_diff,
+        "kv": {
+            "baseline": {
+                "kv_bytes_total": engine.stats()["kv_bytes_reserved"],
+                "kv_bytes_per_token": round(bpt_base, 1),
+                "admitted_concurrency": stats["slot_occupancy_peak"]},
+            "paged": {
+                "kv_bytes_total": pstats["kv_bytes_total"],
+                "kv_bytes_per_token": round(bpt_paged, 1),
+                "page_utilization": round(kv_paged.mean_util() or 0, 4),
+                "admitted_concurrency": pstats["slot_occupancy_peak"],
+                "page_size": page_size, "num_pages": num_pages,
+                "paged_slots": paged_slots,
+                "tokens_per_sec": round(paged_tokens / dt_paged, 2),
+                "prefix_page_hits": pstats["prefix_page_hits"],
+                "prefill_chunks": pstats["prefill_chunks"],
+                "cow_copies": pstats["cow_copies"],
+                "preemptions": pstats["preemptions"]},
+            "bytes_per_token_ratio": round(ratio, 4),
+            "concurrency_gain": round(conc_gain, 2)},
         "telemetry": {"steady_state_compiles": new_compiles,
+                      "paged_steady_state_compiles": paged_new_compiles,
                       "registry": {"serving": counters["serving"]}},
     }), flush=True)
     print(f"# serving: {total_tokens / dt:.1f} tok/s "
@@ -908,6 +1023,13 @@ def serving_bench():
           f"prefill_compiles={stats['prefill_compiles']}<=ladder {ladder}, "
           f"decode_compiles={stats['decode_compiles']}, "
           f"logit_parity={max_logit_diff:.2e}", file=sys.stderr)
+    print(f"# serving/paged: {paged_tokens / dt_paged:.1f} tok/s, "
+          f"kv bytes/token {bpt_paged:.0f} vs {bpt_base:.0f} "
+          f"({ratio:.2f}x <= 0.6x), concurrency "
+          f"{pstats['slot_occupancy_peak']} vs "
+          f"{stats['slot_occupancy_peak']} ({conc_gain:.1f}x >= 1.5x), "
+          f"chunks={pstats['prefill_chunks']}, "
+          f"preemptions={pstats['preemptions']}", file=sys.stderr)
 
 
 # --------------------------------------------------------------------------

@@ -26,7 +26,8 @@ from .export import save_inference_model, StandaloneModel  # noqa: F401
 from .predictor import (Config, Predictor, create_predictor,  # noqa: F401
                         _Handle, _OutHandle)
 
-_SERVING_NAMES = ("ServingEngine", "ServingQueueFull", "Request")
+_SERVING_NAMES = ("ServingEngine", "PagedServingEngine",
+                  "ServingQueueFull", "Request")
 _FLEET_NAMES = ("ServingFleet", "FleetOverloaded", "FleetRequest")
 
 

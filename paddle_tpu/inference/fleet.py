@@ -525,13 +525,29 @@ class ServingFleet:
                 from e
 
     def _capacity(self, r):
-        """How many more requests this replica can hold: free slots plus
-        bounded worker-queue headroom, judged from the serving.* numbers
-        its last reply carried — the least-loaded routing signal."""
+        """How many more requests this replica can hold, judged from
+        the serving.* numbers its last reply carried — the least-loaded
+        routing signal.
+
+        Paged replicas are keyed on their FREE-PAGE fraction: free
+        pages divided by the replica's observed pages-per-request
+        footprint bounds how many more requests it can physically KV —
+        a replica whose slots look free but whose page pool is pinned
+        (fragmented-but-counted-free slots) no longer wins routing.
+        Non-paged replicas fall back to the slot-occupancy headroom."""
         st = r.last_stats or {}
         slots = int(st.get("slots", self._slots))
-        return max(0, slots + self.dispatch_queue_depth
-                   - len(r.inflight))
+        cap = slots + self.dispatch_queue_depth - len(r.inflight)
+        free_pages = st.get("pages_free")
+        if free_pages is not None:
+            ppr = max(1, int(st.get("pages_per_request_est") or 1))
+            # pages_free already excludes pages held by ADMITTED work;
+            # only the in-flight requests not yet holding pages (still
+            # in the worker queue / in transit) claim from the free set
+            unpaged = max(0, len(r.inflight)
+                          - int(st.get("slot_occupancy") or 0))
+            cap = min(cap, int(free_pages) // ppr - unpaged)
+        return max(0, cap)
 
     def _pick_dispatch(self, r):
         now = time.perf_counter()

@@ -27,7 +27,11 @@ router sees as a vanished replica).
 Spec keys (all optional): ``preset`` ("gpt_tiny", default), ``cfg``
 (GPTConfig kwargs — overrides preset), ``seed`` (params PRNG, default
 0), ``slots``, ``max_len``, ``seq_buckets``, ``batch_buckets``,
-``max_queue``, ``warmup`` (default true).
+``max_queue``, ``warmup`` (default true).  With ``paged: true`` the
+replica runs a :class:`~paddle_tpu.inference.serving.PagedServingEngine`
+(knobs ``page_size``, ``num_pages``, ``prefix_cache``,
+``prefill_chunk``) and its step replies carry the free-page numbers the
+router's page-aware least-loaded routing keys on.
 """
 from __future__ import annotations
 
@@ -48,7 +52,7 @@ def _build_engine(spec):
     the GPT stack HERE (worker process), never in the router."""
     import jax
     from ..models import gpt as G
-    from .serving import ServingEngine
+    from .serving import PagedServingEngine, ServingEngine
 
     preset = spec.get("preset", "gpt_tiny")
     if spec.get("cfg"):
@@ -66,7 +70,15 @@ def _build_engine(spec):
     for k in ("seq_buckets", "batch_buckets"):
         if spec.get(k) is not None:
             kw[k] = tuple(int(x) for x in spec[k])
-    return ServingEngine((params, cfg), **kw)
+    cls = ServingEngine
+    if spec.get("paged"):
+        cls = PagedServingEngine
+        for k in ("page_size", "num_pages", "prefill_chunk"):
+            if spec.get(k) is not None:
+                kw[k] = int(spec[k])
+        if spec.get("prefix_cache") is not None:
+            kw["prefix_cache"] = bool(spec["prefix_cache"])
+    return cls((params, cfg), **kw)
 
 
 def _cache_counters():

@@ -29,6 +29,12 @@ slowest one drains.  This engine is the Orca/vLLM-shaped redesign:
 Telemetry rides the PR-4 registry under ``serving.*``: queue depth and
 slot occupancy gauges, prefill/decode/request latency histograms,
 tokens/s, and compile counters the bench asserts on.
+
+:class:`PagedServingEngine` (ISSUE 8, bottom of this module) replaces
+the slot-contiguous pool with a block-table paged KV cache — fixed-size
+pages, per-slot page tables, shared-prefix page reuse, chunked prefill —
+while keeping every invariant above (one donated decode executable,
+token-exact greedy parity, bounded prefill compiles).
 """
 from __future__ import annotations
 
@@ -87,7 +93,10 @@ def _stats_family():
         "tokens_generated": 0, "queue_rejects": 0,
         "step_aborts": 0, "requests_aborted": 0,
         "requests_cancelled": 0,
-        "standalone_compiles": 0})
+        "standalone_compiles": 0,
+        # paged-KV family (PagedServingEngine; zero on slot engines)
+        "prefill_chunks": 0, "prefix_page_hits": 0,
+        "prefix_page_misses": 0, "cow_copies": 0, "preemptions": 0})
 
 
 class _StatsMirror:
@@ -125,6 +134,7 @@ class Request:
         self.tokens = []            # generated ids (python ints)
         self.logits = None          # per-token [V] rows when captured
         self.slot = None
+        self.preemptions = 0        # page-exhaustion evictions survived
         self.done = False
         self.failed = False         # aborted mid-step; re-queueable
         self.error = None           # the abort's diagnosis when failed
@@ -143,9 +153,10 @@ class Request:
 
     def reset_for_retry(self):
         """Scrub generation state so the SAME Request (same id, same
-        limits) can be re-queued from scratch after a mid-step abort —
-        greedy decoding makes the retry token-exact with a run that
-        never failed."""
+        limits) can be re-queued from scratch after a mid-step abort or
+        a page-exhaustion preemption — greedy decoding makes the retry
+        token-exact with a run that never failed.  ``preemptions``
+        survives on purpose (it is the retry's audit trail)."""
         self.tokens = []
         self.logits = None
         self.slot = None
@@ -216,9 +227,7 @@ class ServingEngine:
         timeline.install_compile_hook()
 
         self._cache_dtype = cache_dtype
-        cache = gpt.init_slot_cache(cfg, self.slots, self.max_len,
-                                    dtype=cache_dtype)
-        self._cache_k, self._cache_v = cache["k"], cache["v"]
+        self._rebuild_cache()
         # host-side bookkeeping mirrors: authoritative for scheduling
         self._lens = np.zeros((self.slots,), np.int32)
         self._active = np.zeros((self.slots,), bool)
@@ -256,6 +265,15 @@ class ServingEngine:
         self._occ_peak = 0
         self._warming = False
 
+    def _rebuild_cache(self):
+        """(Re)allocate the KV pool — called at construction and by
+        :meth:`_abort_inflight` (a failed donated dispatch consumed the
+        old buffers).  The paged subclass overrides this with the page
+        pool + allocator reset."""
+        cache = gpt.init_slot_cache(self.cfg, self.slots, self.max_len,
+                                    dtype=self._cache_dtype)
+        self._cache_k, self._cache_v = cache["k"], cache["v"]
+
     # ------------------------------------------------------------- intake
     _UNSET = object()
 
@@ -291,10 +309,7 @@ class ServingEngine:
                 f"request needs {need} cache positions "
                 f"(prompt {len(req.prompt)} + {req.max_new_tokens} new) "
                 f"> max_len {self.max_len}")
-        if len(req.prompt) > self.seq_buckets[-1]:
-            raise ValueError(
-                f"prompt length {len(req.prompt)} exceeds the largest "
-                f"prefill bucket {self.seq_buckets[-1]}")
+        self._check_prompt(req)
         if len(self._queue) >= self.max_queue:
             self._inc("queue_rejects")
             raise ServingQueueFull(
@@ -303,6 +318,15 @@ class ServingEngine:
         self._queue.append(req)
         self._g_queue.set(len(self._queue))
         return req
+
+    def _check_prompt(self, req):
+        """Reject prompts the engine can NEVER serve (a named fast
+        failure beats bouncing them forever).  The paged subclass
+        relaxes the bucket bound for chunk-eligible prompts."""
+        if len(req.prompt) > self.seq_buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} exceeds the largest "
+                f"prefill bucket {self.seq_buckets[-1]}")
 
     # ------------------------------------------------------- bucket maths
     def _seq_bucket(self, n):
@@ -561,9 +585,7 @@ class ServingEngine:
         # rebuild the donated KV pool: the failed dispatch may have
         # consumed (donated) the old buffers, and whatever it scattered
         # is untrusted anyway — every victim restarts from its prompt
-        cache = gpt.init_slot_cache(self.cfg, self.slots, self.max_len,
-                                    dtype=self._cache_dtype)
-        self._cache_k, self._cache_v = cache["k"], cache["v"]
+        self._rebuild_cache()
         self._g_occ.set(0)
         if aborted:
             self._inc("step_aborts")
@@ -660,12 +682,18 @@ class ServingEngine:
         if v:
             self._g_tps.set(v)
 
+    def _busy(self):
+        """Work left to drive?  (The paged subclass adds its
+        mid-chunked-prefill jobs, which hold slots without being decode-
+        active yet.)"""
+        return bool(self._queue) or bool(self._active.any())
+
     def run(self, max_steps=None):
         """Drive :meth:`step` until the queue and every slot drain.
         Returns all requests finished during the run."""
         out = []
         steps = 0
-        while self._queue or self._active.any():
+        while self._busy():
             out.extend(self.step())
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -704,8 +732,10 @@ class ServingEngine:
                 mnt = min(max_new_tokens, self.max_len - lo)
                 if mnt < 1:
                     continue        # rung unreachable by any admission
-                n = min(s, self.max_len - mnt)
+                n = self._warmup_wave_len(lo, s, mnt)
                 lo = s + 1
+                if n is None:
+                    continue        # rung unreachable via this path
                 prev = 0
                 for b in self.batch_buckets:
                     # smallest group size that pads to bucket b; a rung
@@ -722,6 +752,14 @@ class ServingEngine:
             self._warming = False
             self.max_queue = real_max_queue
         return self._counts["prefill_compiles"] - before
+
+    def _warmup_wave_len(self, lo, s, mnt):
+        """Warmup prompt length that lands in bucket rung ``s`` (whose
+        shortest admissible prompt is ``lo``), or None if no wave
+        prompt can reach the rung.  The paged subclass caps this at
+        ``prefill_chunk`` — longer prompts divert to the chunked path
+        and would leave the rung cold."""
+        return min(s, self.max_len - mnt)
 
     def reset_occupancy_peak(self):
         """Restart THIS engine's slot-occupancy high-water mark (e.g.
@@ -740,7 +778,7 @@ class ServingEngine:
         reqs = []
         for p in prompts:
             while (len(self._queue) >= self.max_queue
-                   and (self._queue or self._active.any())):
+                   and self._busy()):
                 self.step()         # drain room instead of rejecting
             reqs.append(self.submit(p, max_new_tokens, eos_token))
         self.run()
@@ -751,7 +789,9 @@ class ServingEngine:
     # stay live (compiling executables is exactly what warmup reports)
     _WARMUP_QUIET = frozenset((
         "prefill_calls", "decode_steps", "requests_admitted",
-        "requests_completed", "tokens_generated"))
+        "requests_completed", "tokens_generated",
+        "prefill_chunks", "prefix_page_hits", "prefix_page_misses",
+        "cow_copies", "preemptions"))
 
     def _inc(self, key, v=1):
         """Count into the process-global serving.* registry family AND
@@ -773,4 +813,638 @@ class ServingEngine:
         # from the engine-local sample window, NOT the shared gauge — a
         # coexisting engine's throughput must not show up here
         out["tokens_per_s"] = self._tps_value()
+        out.update(self._kv_accounting())
+        return out
+
+    def _kv_accounting(self):
+        """KV-memory accounting (bench.py --serving's kv block): a
+        slot-contiguous pool RESERVES its full footprint whether or not
+        slots are filled — that over-reservation is exactly what the
+        paged subclass's override shrinks."""
+        held = int(self._lens.sum())
+        return {"kv_bytes_reserved": int(self._cache_k.nbytes
+                                         + self._cache_v.nbytes),
+                "kv_tokens_held": held}
+
+
+# --------------------------------------------------------------------------
+# paged engine (ISSUE 8 tentpole)
+# --------------------------------------------------------------------------
+
+class PagedServingEngine(ServingEngine):
+    """Continuous batching over a **block-table paged KV cache**: the
+    contiguous-per-slot pool is replaced by ``num_pages`` fixed
+    ``page_size``-token pages plus a per-slot page table
+    (inference/kv_pager.py), so the HBM a request pins tracks its
+    LENGTH, not ``max_len`` — at a fixed KV byte budget the paged pool
+    admits several times the concurrency of the slot pool.  On top:
+
+    * **shared-prefix reuse** (``prefix_cache=True``) — prompt pages are
+      content-hashed; a request repeating an earlier system prompt
+      re-acquires the same physical pages (zero new allocations, the
+      smoke's attested "prefix hit"), released prompt pages are retained
+      LRU for future hits, and divergence is copy-on-write.
+    * **chunked prefill** (``prefill_chunk=N``) — prompts longer than
+      ``N`` are admitted in ``N``-token pieces, ONE piece per engine
+      iteration, so in-flight decodes keep producing tokens while a
+      long admission trickles in instead of stalling behind one big
+      prefill dispatch.  All chunks share one executable (the position
+      offset is a traced scalar).
+    * the PR-5 invariants survive: ONE buffer-donated jitted decode
+      step forever (``decode_compiles == 1``; the page table, write
+      coordinates and lengths are traced operands, so churn never
+      changes the signature) and token-exact greedy parity with
+      ``models.gpt.generate``.
+
+    Attention gathers K/V through the table via
+    ops/pallas/paged_attn.py — a Pallas kernel that DMAs exactly the
+    referenced pages on TPU, and a pure-lax gather with *identical
+    math* to the slot engine's masked attention elsewhere (CPU tier-1).
+
+    Pool exhaustion is never a stall: the NEWEST request is preempted —
+    pages freed, request re-queued from its prompt (named in telemetry
+    as ``page_exhaustion``, counted in ``preemptions``, stamped on
+    ``Request.preemptions``) — and greedy decoding makes its eventual
+    retry token-exact.
+
+    Constraints: ``max_len`` must be a page multiple (seq buckets are
+    rounded up to page multiples), and ``prefill_chunk`` must divide
+    ``max_len`` and fit inside the largest prefill bucket."""
+
+    def __init__(self, model, *, page_size=16, num_pages=None,
+                 prefix_cache=True, prefill_chunk=None, **kw):
+        from .kv_pager import KVPager, PagesExhausted  # noqa: F401
+        self._KVPager, self._PagesExhausted = KVPager, PagesExhausted
+        self._page_size = int(page_size)
+        if self._page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self._num_pages_cfg = None if num_pages is None else int(num_pages)
+        self._prefix_cache_on = bool(prefix_cache)
+        self._prefill_chunk = None          # set after buckets are known
+        self._chunk_jobs = collections.deque()
+        self._chunk_slots = set()
+        self._copy_jit = None
+        self._chunk_jit = None
+        self._admit_seq = 0
+        super().__init__(model, **kw)
+        ps = self._page_size
+        # the gathered page view is maxP*ps == max_len wide, so paged
+        # attention sees exactly the slot engine's mask width — that, and
+        # identical fallback math, is what keeps parity token-exact
+        self.seq_buckets = tuple(sorted(
+            {min(-(-b // ps) * ps, self.max_len) for b in self.seq_buckets}))
+        if prefill_chunk is not None:
+            c = -(-int(prefill_chunk) // ps) * ps
+            if c > self.seq_buckets[-1]:
+                raise ValueError(
+                    f"prefill_chunk {c} exceeds the largest prefill "
+                    f"bucket {self.seq_buckets[-1]} — prompts between "
+                    "them would be unserveable")
+            if self.max_len % c:
+                raise ValueError(
+                    f"prefill_chunk {c} must divide max_len "
+                    f"{self.max_len} (a clamped chunk write would "
+                    "corrupt earlier positions)")
+            self._prefill_chunk = c
+
+    # ------------------------------------------------------------ plumbing
+    def _rebuild_cache(self):
+        ps = self._page_size
+        if self.max_len % ps:
+            raise ValueError(
+                f"max_len {self.max_len} must be a multiple of "
+                f"page_size {ps}")
+        self._pages_per_slot = self.max_len // ps
+        num_pages = (self._num_pages_cfg
+                     if self._num_pages_cfg is not None
+                     else self.slots * self._pages_per_slot + 1)
+        self._num_pages = int(num_pages)
+        self._pager = self._KVPager(self._num_pages, ps, self.slots,
+                                    prefix_cache=self._prefix_cache_on)
+        cache = gpt.init_paged_cache(self.cfg, self._num_pages, ps,
+                                     dtype=self._cache_dtype)
+        self._cache_k, self._cache_v = cache["k"], cache["v"]
+        self._tables_np = np.zeros((self.slots, self._pages_per_slot),
+                                   np.int32)
+        self._chunk_jobs.clear()
+        self._chunk_slots.clear()
+
+    def _chunk_eligible(self, req):
+        return (self._prefill_chunk is not None
+                and len(req.prompt) > self._prefill_chunk)
+
+    def _check_prompt(self, req):
+        need = len(req.prompt) + req.max_new_tokens
+        need_pages = self._pager.pages_for(need)
+        if need_pages > self._num_pages - 1:
+            raise ValueError(
+                f"request needs {need_pages} KV pages but the pool only "
+                f"has {self._num_pages - 1} — raise num_pages or shrink "
+                "the request")
+        if self._chunk_eligible(req):
+            return                  # the chunked path ignores the ladder
+        super()._check_prompt(req)
+
+    def _free_slots(self):
+        return [i for i in range(self.slots)
+                if not self._active[i] and i not in self._chunk_slots]
+
+    def _busy(self):
+        return super()._busy() or bool(self._chunk_jobs)
+
+    def _next_admit_seq(self):
+        self._admit_seq += 1
+        return self._admit_seq
+
+    # ----------------------------------------------------------- admission
+    def _admit(self):
+        """Wave admission into pages: same same-seq-bucket grouping as
+        the slot engine, but each admitted prompt first acquires its
+        page table (prefix-cache hits share physical pages).  Page
+        exhaustion stops the wave — queued requests simply wait for
+        decodes to free pages.  Long prompts divert to the chunked
+        path."""
+        self._intake_chunked()
+        while self._queue and self._free_slots():
+            if self._chunk_eligible(self._queue[0]):
+                break               # FIFO: the long head waits for intake
+            free = self._free_slots()
+            group, tables, sbucket, hits_total = [], [], None, 0
+            exhausted = False
+            while (self._queue and len(group) < len(free)
+                   and len(group) < self.batch_buckets[-1]):
+                nxt = self._queue[0]
+                if self._chunk_eligible(nxt):
+                    break
+                nxt_b = self._seq_bucket(len(nxt.prompt))
+                if sbucket is None:
+                    sbucket = nxt_b
+                elif nxt_b != sbucket:
+                    break           # next wave picks it up
+                slot = free[len(group)]
+                try:
+                    table, hits = self._pager.admit(slot, nxt.prompt)
+                except self._PagesExhausted:
+                    exhausted = True
+                    break
+                self._queue.popleft()
+                nxt.slot = slot
+                group.append(nxt)
+                tables.append(table)
+                hits_total += hits
+            if not group:
+                break
+            self._prefill_group(group, tables, sbucket, hits_total)
+            if exhausted:
+                break
+        self._g_queue.set(len(self._queue))
+        occ = int(self._active.sum())
+        self._g_occ.set(occ)
+        if not self._warming:
+            self._occ_peak = max(self._occ_peak, occ)
+            if occ > self._g_occ_peak.value:
+                self._g_occ_peak.set(occ)
+
+    def _prefill_group(self, group, tables, sbucket, hits):
+        jnp = self._jnp
+        ps = self._page_size
+        bbucket = self._batch_bucket(len(group))
+        maxPb = sbucket // ps
+        toks = np.zeros((bbucket, sbucket), np.int32)
+        lens = np.ones((bbucket,), np.int32)    # pad rows: len 1
+        ptab = np.zeros((bbucket, maxPb), np.int32)   # pads -> scratch
+        for r, req in enumerate(group):
+            toks[r, :len(req.prompt)] = req.prompt
+            lens[r] = len(req.prompt)
+            ptab[r, :len(tables[r])] = tables[r]
+        fresh = sum(len(t) for t in tables) - hits
+        self._inc("prefix_page_hits", hits)
+        self._inc("prefix_page_misses", fresh)
+        # visible to _abort_inflight, same contract as the base engine
+        self._admitting = group
+        fn = self._prefill.get(
+            (bbucket, sbucket),
+            lambda: self._build_prefill(bbucket, sbucket))
+        t0 = time.perf_counter()
+        with timeline.span("serving.prefill", batch=bbucket, seq=sbucket,
+                           paged=True):
+            out = fn(self.params, self._cache_k, self._cache_v,
+                     jnp.asarray(toks), jnp.asarray(lens),
+                     jnp.asarray(ptab))
+        if self.capture_logits:
+            self._cache_k, self._cache_v, first_tok, last_logits = out
+            logits_np = np.asarray(last_logits)
+        else:
+            self._cache_k, self._cache_v, first_tok = out
+            logits_np = None
+        self._inc("prefill_calls")
+        first_np = np.asarray(first_tok)
+        for r, req in enumerate(group):
+            s = req.slot
+            self._tables_np[s] = 0
+            self._tables_np[s, :len(tables[r])] = tables[r]
+            self._lens[s] = len(req.prompt)
+            self._active[s] = True
+            self._slot_req[s] = req
+            req._admit_seq = self._next_admit_seq()
+            self._append_token(req, int(first_np[r]),
+                               logits_np[r] if logits_np is not None
+                               else None)
+            self._last_tok[s] = int(first_np[r])
+            self._inc("requests_admitted")
+            if _faults.active() and not self._warming:
+                _faults.replica_kill_check(
+                    request=self._counts["requests_admitted"])
+        self._admitting = []
+        if not self._warming:
+            self._h_prefill.observe(time.perf_counter() - t0)
+
+    def _build_prefill(self, b, s):
+        """Paged prefill executable: causal forward over the padded
+        prompts, then one batched scatter of the filled K/V page chunks
+        into the DONATED pool through the page tables (pad rows target
+        the scratch page; shared pages receive content identical to
+        what they already hold, so duplicate indices are benign)."""
+        jax, jnp = self._jax, self._jnp
+        cfg = self.cfg
+        ps = self._page_size
+        pr = s // ps
+        cap = self.capture_logits
+
+        def prefill(params, cache_k, cache_v, tokens, lens, ptab):
+            fresh = gpt.init_cache(cfg, b, s, dtype=cache_k.dtype)
+            logits, filled = gpt.forward_cached(params, tokens, cfg, fresh)
+            L = cfg.num_layers
+            nh, hd = cfg.num_heads, cfg.head_dim
+            flat = ptab.reshape(-1)
+            fk = filled["k"].reshape(L, b * pr, ps, nh, hd)
+            fv = filled["v"].reshape(L, b * pr, ps, nh, hd)
+            cache_k = cache_k.at[:, flat].set(fk)
+            cache_v = cache_v.at[:, flat].set(fv)
+            idx = jnp.clip(lens - 1, 0, s - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]      # [b, V]
+            first_tok = jnp.argmax(last, -1).astype(jnp.int32)
+            if cap:
+                return cache_k, cache_v, first_tok, last
+            return cache_k, cache_v, first_tok
+
+        donate = (1, 2) if _donation_enabled() else ()
+        return self._jax.jit(prefill, donate_argnums=donate)
+
+    # ------------------------------------------------------ chunked prefill
+    def _intake_chunked(self):
+        """Claim a slot + the full prompt's page table for long prompts
+        at the queue head; the chunks themselves run one per engine
+        iteration in :meth:`_advance_chunks`.  Fresh pages are NOT
+        prefix-registered until their content lands (deferred
+        registration) — a concurrent identical prompt must never share
+        an unwritten page."""
+        if self._prefill_chunk is None:
+            return
+        while self._queue and self._chunk_eligible(self._queue[0]):
+            free = self._free_slots()
+            if not free:
+                return
+            req = self._queue[0]
+            slot = free[0]
+            try:
+                table, hits = self._pager.admit(slot, req.prompt,
+                                                defer_register=True)
+            except self._PagesExhausted:
+                return              # decodes will free pages; retry later
+            self._queue.popleft()
+            req.slot = slot
+            req._chunk_pos = 0
+            req._chunk_time = 0.0
+            req._admit_seq = self._next_admit_seq()
+            self._chunk_slots.add(slot)
+            self._chunk_jobs.append(req)
+            self._slot_req[slot] = req
+            self._tables_np[slot] = 0
+            self._tables_np[slot, :len(table)] = table
+            self._inc("prefix_page_hits", hits)
+            self._inc("prefix_page_misses", len(table) - hits)
+
+    def _advance_chunks(self):
+        """Run ONE prefill chunk of the oldest chunked admission — the
+        interleaving contract: in-flight decodes get an iteration
+        between every pair of chunks instead of stalling behind the
+        whole long prompt."""
+        if not self._chunk_jobs:
+            return
+        jnp = self._jnp
+        req = self._chunk_jobs[0]
+        C = self._prefill_chunk
+        n = len(req.prompt)
+        pos = req._chunk_pos
+        take = min(C, n - pos)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :take] = req.prompt[pos:pos + take]
+        if self._chunk_jit is None:
+            self._chunk_jit = self._build_chunk(C)
+            self._inc("prefill_compiles")
+        s = req.slot
+        t0 = time.perf_counter()
+        with timeline.span("serving.prefill_chunk", pos=pos, take=take):
+            out = self._chunk_jit(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(toks), jnp.asarray(self._tables_np[s]),
+                np.int32(pos), np.int32(take))
+        if self.capture_logits:
+            self._cache_k, self._cache_v, tok, last_row = out
+            row_np = np.asarray(last_row)
+        else:
+            self._cache_k, self._cache_v, tok = out
+            row_np = None
+        self._inc("prefill_chunks")
+        req._chunk_pos = pos + take
+        # the prefill histogram records the WHOLE admission's work, so
+        # accumulate per-chunk durations and observe once at the end
+        req._chunk_time += time.perf_counter() - t0
+        self._pager.register_prompt(s, req._chunk_pos)
+        if req._chunk_pos < n:
+            return                  # decode runs before the next chunk
+        # final chunk: the prompt is in — the sampled token admits the
+        # request into the decode pool like a one-shot prefill would
+        self._chunk_jobs.popleft()
+        self._chunk_slots.discard(s)
+        self._lens[s] = n
+        self._active[s] = True
+        self._append_token(req, int(tok), row_np)
+        self._last_tok[s] = int(tok)
+        self._inc("requests_admitted")
+        if not self._warming:
+            self._h_prefill.observe(req._chunk_time)
+        if _faults.active() and not self._warming:
+            _faults.replica_kill_check(
+                request=self._counts["requests_admitted"])
+
+    def _build_chunk(self, C):
+        """ONE executable serves every chunk of every long prompt: the
+        absolute position offset and the chunk's true token count are
+        traced scalars, so chunk index never changes the signature."""
+        jax, jnp = self._jax, self._jnp
+        cfg = self.cfg
+        cap = self.capture_logits
+
+        def chunk(params, cache_k, cache_v, toks, ptab_row, offset, tlen):
+            logits, cache_k, cache_v = gpt.forward_paged_chunk(
+                params, toks, cfg, cache_k, cache_v, ptab_row, offset)
+            last = jax.lax.dynamic_index_in_dim(logits[0], tlen - 1, 0,
+                                                keepdims=False)    # [V]
+            tok = jnp.argmax(last, -1).astype(jnp.int32)
+            if cap:
+                return cache_k, cache_v, tok, last
+            return cache_k, cache_v, tok
+
+        donate = (1, 2) if _donation_enabled() else ()
+        return jax.jit(chunk, donate_argnums=donate)
+
+    # ----------------------------------------------------- page lifecycle
+    def _finish(self, req, reason):
+        s = req.slot
+        super()._finish(req, reason)
+        if s is not None:
+            self._pager.release(s)
+            self._tables_np[s] = 0
+
+    def _copy_page(self, src, dst):
+        """Device-side copy-on-write: duplicate page ``src`` into the
+        freshly-owned ``dst`` before the diverging write lands.  One
+        jitted donated executable, compiled once (warmup primes it)."""
+        if self._copy_jit is None:
+            self._copy_jit = self._build_copy()
+        self._cache_k, self._cache_v = self._copy_jit(
+            self._cache_k, self._cache_v, np.int32(src), np.int32(dst))
+        self._inc("cow_copies")
+
+    def _build_copy(self):
+        jax = self._jax
+
+        def cp(k, v, src, dst):
+            return (k.at[:, dst].set(k[:, src]),
+                    v.at[:, dst].set(v[:, src]))
+
+        donate = (0, 1) if _donation_enabled() else ()
+        return jax.jit(cp, donate_argnums=donate)
+
+    def _newest_victim(self):
+        """The most recently admitted in-flight request (decode-active
+        or mid-chunked-prefill) — the preemption policy's target."""
+        cands = [r for r in self._slot_req if r is not None and not r.done]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: getattr(r, "_admit_seq", -1))
+
+    def _preempt(self, req, why):
+        """Page-exhaustion eviction: free the victim's slot and pages,
+        scrub it back to its prompt, and put it at the queue head for
+        re-admission once pages free up.  NAMED (telemetry event,
+        ``preemptions`` counter, ``Request.preemptions``) — exhaustion
+        is never a silent stall or loss."""
+        s = req.slot
+        if s is not None:
+            self._pager.release(s)
+            self._tables_np[s] = 0
+            self._active[s] = False
+            self._lens[s] = 0
+            self._slot_req[s] = None
+            if s in self._chunk_slots:
+                self._chunk_slots.discard(s)
+                try:
+                    self._chunk_jobs.remove(req)
+                except ValueError:
+                    pass
+        req.reset_for_retry()
+        req.preemptions += 1
+        self._queue.appendleft(req)
+        self._inc("preemptions")
+        self._g_queue.set(len(self._queue))
+        if not self._warming and timeline.telemetry_dir():
+            timeline.emit({"event": "page_exhaustion",
+                           "request_id": str(req.id),
+                           "action": "preempted", "reason": why})
+
+    def _ensure_decode_pages(self):
+        """Give every active slot a writable position for this step's
+        token: a fresh tail page on a page boundary, a COW copy when the
+        tail is shared.  On exhaustion, preempt the newest request and
+        retry (``ensure_append`` is idempotent, so re-walking already-
+        ensured slots is safe) — progress is guaranteed because a lone
+        request always fits (submit enforces it)."""
+        ps = self._page_size
+        wpages = np.zeros((self.slots,), np.int32)   # inactive -> scratch
+        woffs = np.zeros((self.slots,), np.int32)
+        while True:
+            try:
+                for s in range(self.slots):
+                    if not self._active[s]:
+                        wpages[s] = 0
+                        woffs[s] = 0
+                        continue
+                    pos = int(self._lens[s])
+                    pid, off, cow_src = self._pager.ensure_append(s, pos)
+                    if cow_src is not None:
+                        self._copy_page(cow_src, pid)
+                    self._tables_np[s, pos // ps] = pid
+                    wpages[s] = pid
+                    woffs[s] = off
+                return wpages, woffs
+            except self._PagesExhausted as e:
+                victim = self._newest_victim()
+                if victim is None:
+                    raise
+                self._preempt(victim, str(e))
+
+    # ------------------------------------------------------------- driving
+    def _step_inner(self):
+        self._admit()
+        self._advance_chunks()
+        if not self._active.any():
+            return
+        jnp = self._jnp
+        if _faults.active() and not self._warming:
+            if _faults.page_exhaustion_check(
+                    step=self._counts["decode_steps"] + 1):
+                victim = self._newest_victim()
+                if victim is not None:
+                    self._preempt(victim, "injected page_exhaustion")
+            _faults.engine_step_error(self._counts["decode_steps"] + 1)
+            _faults.replica_kill_check(
+                step=self._counts["decode_steps"] + 1)
+        if not self._active.any():
+            return                  # the injected preemption emptied it
+        finished = []
+        wpages, woffs = self._ensure_decode_pages()
+        if not self._active.any():
+            return
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+            self._inc("decode_compiles")
+        t0 = time.perf_counter()
+        with timeline.span("serving.decode_step",
+                           active=int(self._active.sum()), paged=True):
+            out = self._decode_jit(
+                self.params, self._cache_k, self._cache_v,
+                jnp.asarray(self._tables_np), jnp.asarray(wpages),
+                jnp.asarray(woffs), jnp.asarray(self._lens),
+                jnp.asarray(self._last_tok))
+        if self.capture_logits:
+            self._cache_k, self._cache_v, nxt, logits = out
+            logits_np = np.asarray(logits)
+        else:
+            self._cache_k, self._cache_v, nxt = out
+            logits_np = None
+        self._inc("decode_steps")
+        nxt_np = np.asarray(nxt)
+        for s in range(self.slots):
+            if not self._active[s]:
+                continue
+            req = self._slot_req[s]
+            self._lens[s] += 1
+            self._append_token(req, int(nxt_np[s]),
+                               logits_np[s] if logits_np is not None
+                               else None)
+            self._last_tok[s] = int(nxt_np[s])
+            if req.done:
+                finished.append(req)
+        dt = time.perf_counter() - t0
+        if not self._warming:
+            self._h_decode.observe(dt)
+        self._g_occ.set(int(self._active.sum()))
+        self._update_tps()
+        if not self._warming and timeline.telemetry_dir():
+            timeline.emit({"event": "serving_step",
+                           "active": int(self._active.sum()),
+                           "queue": len(self._queue),
+                           "decode_s": round(dt, 6),
+                           "finished": len(finished),
+                           "pages_in_use": self._pager.pages_in_use(),
+                           "finished_ids": [str(r.id) for r in finished]})
+
+    def _build_decode(self):
+        jax, jnp = self._jax, self._jnp
+        cfg = self.cfg
+        cap = self.capture_logits
+
+        def decode(params, cache_k, cache_v, page_table, wpages, woffs,
+                   lens, toks):
+            logits, cache_k, cache_v = gpt.decode_step_paged(
+                params, toks, cfg, cache_k, cache_v, page_table,
+                wpages, woffs, lens)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            if cap:
+                return cache_k, cache_v, nxt, logits
+            return cache_k, cache_v, nxt
+
+        donate = (1, 2) if _donation_enabled() else ()
+        return jax.jit(decode, donate_argnums=donate)
+
+    # -------------------------------------------------------------- warmup
+    def _warmup_wave_len(self, lo, s, mnt):
+        """Rungs only reachable by chunk-eligible prompts stay cold on
+        the WAVE path (the chunked executable covers those admissions);
+        rungs short prompts can still bucket up into get a warmup
+        prompt capped at ``prefill_chunk`` so it is not diverted."""
+        n = super()._warmup_wave_len(lo, s, mnt)
+        if self._prefill_chunk is None:
+            return n
+        if lo > self._prefill_chunk:
+            return None             # every prompt this long chunks
+        return min(n, self._prefill_chunk)
+
+    def warmup(self, max_new_tokens=2):
+        """Base ladder + decode warmup, plus the paged extras: the COW
+        copy executable and (when chunking is on) the chunk executable,
+        so steady traffic compiles NOTHING even on first divergence or
+        first long prompt.  Warmup's synthetic prompt pages are flushed
+        from the prefix cache afterwards — they must not shadow real
+        traffic's hits or hold pages."""
+        before = self._counts["prefill_compiles"]
+        super().warmup(max_new_tokens)
+        self._warming = True
+        real_max_queue = self.max_queue
+        self.max_queue = max(real_max_queue, self.slots,
+                             self.batch_buckets[-1])
+        try:
+            if self._copy_jit is None:
+                self._copy_jit = self._build_copy()
+            # scratch-onto-scratch: a no-op copy that only compiles
+            self._cache_k, self._cache_v = self._copy_jit(
+                self._cache_k, self._cache_v, np.int32(0), np.int32(0))
+            if (self._prefill_chunk is not None
+                    and self._prefill_chunk + 2 <= self.max_len):
+                n = self._prefill_chunk + 1      # two chunks: full + tail
+                self.submit(np.ones((n,), np.int32), 1)
+                self.run()
+        finally:
+            self._warming = False
+            self.max_queue = real_max_queue
+        self._pager.flush_reclaimable()
+        return self._counts["prefill_compiles"] - before
+
+    # --------------------------------------------------------------- views
+    def _kv_accounting(self):
+        """Paged accounting: reserved = pages actually referenced (the
+        whole point — idle capacity costs nothing); ``page_utilization``
+        is tokens held per in-use page position and can exceed 1.0 when
+        prefix sharing packs several requests onto one physical page."""
+        ps = self._page_size
+        total = int(self._cache_k.nbytes + self._cache_v.nbytes)
+        page_bytes = total // self._num_pages
+        in_use = self._pager.pages_in_use()
+        held = int(self._lens.sum()) + sum(
+            int(getattr(r, "_chunk_pos", 0)) for r in self._chunk_jobs)
+        return {"kv_bytes_reserved": int(in_use * page_bytes),
+                "kv_bytes_total": total,
+                "kv_tokens_held": held,
+                "page_utilization": round(held / max(1, in_use * ps), 4)}
+
+    def stats(self):
+        out = super().stats()
+        pg = self._pager.stats()
+        for k in ("prefix_page_hits", "prefix_page_misses", "cow_copies"):
+            pg.pop(k)    # the engine-mirrored (warmup-quiet) counts win
+        out.update(pg)
         return out

@@ -464,6 +464,116 @@ def decode_step_slots(params, tokens, cfg: GPTConfig, cache, active=None):
     return logits[:, 0], {"k": ks, "v": vs, "len": new_len}
 
 
+# --------------------------------------------------------------------------
+# paged decode (the block-table KV layout — ISSUE 8)
+# --------------------------------------------------------------------------
+#
+# The slot cache above still reserves a contiguous [max_len] strip per
+# slot.  The paged layout breaks the pool into fixed-size pages
+# ([L, num_pages, page_size, nh, hd]) and gives each slot a PAGE TABLE
+# (int32[maxP] of physical page ids, scratch page 0 padding the unused
+# tail): position p of a slot's sequence lives at
+# (table[p // page_size], p % page_size).  Attention gathers K/V through
+# the table (ops/pallas/paged_attn.py: a Pallas kernel that DMAs exactly
+# the referenced pages on TPU, a lax gather view elsewhere), so the HBM
+# a request pins is proportional to its LENGTH, not to max_len — and
+# identical prompt prefixes can share physical pages
+# (inference/kv_pager.py owns that bookkeeping).
+
+
+def init_paged_cache(cfg: GPTConfig, num_pages, page_size, dtype=None):
+    """Paged KV pool: {'k','v': [L, num_pages, page_size, nh, hd]}.
+    Page 0 is the scratch page (inactive lanes / padded prefill rows
+    scatter there; nothing reads it)."""
+    cd = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd)}
+
+
+def _paged_slot_block(cfg, x, blk, k_pages, v_pages, page_table,
+                      write_pages, write_offs, lens):
+    """block_apply for the page-table single-token decode: slot s's new
+    K/V land at (write_pages[s], write_offs[s]) — a batched scatter into
+    the shared pool — and its query attends the gathered page view
+    masked to ``k_pos <= lens[s]``.  x: [S, 1, H]; k/v_pages:
+    [P, ps, nh, hd]; page_table: int32 [S, maxP]."""
+    from ..ops.pallas.paged_attn import paged_attention
+
+    def pattn(q, k, v):
+        kc = k_pages.at[write_pages, write_offs].set(
+            k[:, 0].astype(k_pages.dtype))
+        vc = v_pages.at[write_pages, write_offs].set(
+            v[:, 0].astype(v_pages.dtype))
+        a = paged_attention(q, kc, vc, page_table, lens)
+        return a, (kc, vc)
+
+    x, (k_pages, v_pages) = block_apply(cfg, x, blk, attn_fn=pattn)
+    return x, k_pages, v_pages
+
+
+def decode_step_paged(params, tokens, cfg: GPTConfig, cache_k, cache_v,
+                      page_table, write_pages, write_offs, lens):
+    """One decode iteration for every slot through the paged pool:
+    consume one token per slot (at its own ``lens[s]``), return
+    (logits [S, V] fp32, k_pool, v_pool).  Inactive slots point their
+    write coordinates at the scratch page and their table rows at
+    scratch, so the batch shape stays static and their garbage never
+    lands on a real page — the host advances only active lens."""
+    x = jnp.take(params["wte"], tokens, axis=0) \
+        + jnp.take(params["wpe"], lens, axis=0)
+    x = x[:, None, :].astype(jnp.dtype(cfg.dtype))        # [S, 1, H]
+
+    def scan_body(carry, layer):
+        blk, kp, vp = layer
+        xx, kp, vp = _paged_slot_block(cfg, carry, blk, kp, vp,
+                                       page_table, write_pages,
+                                       write_offs, lens)
+        return xx, (kp, vp)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x,
+                               (params["blocks"], cache_k, cache_v))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits[:, 0], ks, vs
+
+
+def forward_paged_chunk(params, tokens, cfg: GPTConfig, cache_k, cache_v,
+                        pt_row, offset):
+    """One chunked-prefill piece for a single slot: consume ``tokens``
+    [1, C] starting at absolute position ``offset`` (a traced scalar, so
+    every chunk of every prompt reuses ONE executable), attending the
+    slot's already-filled pages plus the in-chunk causal prefix.
+    Returns (logits [1, C, V] fp32, k_pool, v_pool).
+
+    Per layer: gather the slot's page view, splice the chunk in with
+    the exact `_cached_block` math, scatter the view back to its pages.
+    Padded tail rows of the final chunk write garbage at positions past
+    the true prompt length — masked by ``len`` until decode overwrites
+    them, same contract as the slot-contiguous prefill pads."""
+    maxP = pt_row.shape[0]
+    ps = cache_k.shape[2]
+    x = embed(cfg, params, tokens, pos_offset=offset)
+
+    def scan_body(carry, layer):
+        xx = carry
+        blk, kp, vp = layer
+        tail = kp.shape[2:]                       # (nh, hd)
+        view_k = kp[pt_row].reshape(1, maxP * ps, *tail)
+        view_v = vp[pt_row].reshape(1, maxP * ps, *tail)
+        xx, view_k, view_v = _cached_block(cfg, xx, blk, view_k, view_v,
+                                           offset)
+        kp = kp.at[pt_row].set(view_k[0].reshape(maxP, ps, *tail))
+        vp = vp.at[pt_row].set(view_v[0].reshape(maxP, ps, *tail))
+        return xx, (kp, vp)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x,
+                               (params["blocks"], cache_k, cache_v))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, ks, vs
+
+
 def loss_fn(params, tokens, labels, cfg: GPTConfig):
     """Mean next-token cross entropy.  labels [B, N] int32 (-100 = ignore)."""
     logits = forward(params, tokens, cfg)

@@ -4,5 +4,7 @@
 from . import flash_attn
 from . import norms
 from . import fused_ffn
+from . import paged_attn
 from .flash_attn import flash_attention  # noqa: F401
 from .norms import layer_norm, rms_norm  # noqa: F401
+from .paged_attn import paged_attention  # noqa: F401

@@ -1,0 +1,173 @@
+"""Paged-attention decode kernel (ISSUE 8): single-token queries gather
+K/V through a block page table instead of a contiguous per-slot strip.
+
+Extends flash_attn.py's blocked online-softmax scaffolding to the paged
+KV layout the serving engine owns: K/V live in a fixed pool
+``[num_pages, page_size, nh, hd]`` and each decode lane's logical
+sequence is the concatenation of the pages its table names.  The TPU
+kernel streams one *physical page* per grid step — the page id comes
+from the scalar-prefetched page table, so the BlockSpec index map turns
+the logical ``(slot, page_j)`` coordinate into the physical page's HBM
+block and Mosaic DMAs exactly the pages a lane references, never the
+whole pool.
+
+A pure-lax fallback (gather pages into the contiguous per-slot view,
+then the exact `_slot_block` masked-attention math) keeps
+``JAX_PLATFORMS=cpu`` and tier-1 green; the Pallas kernel is validated
+in interpret mode by the slow suite and engaged on real TPUs by the
+same gate discipline as flash_attention.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .utils import HAS_PALLAS, pallas_enabled
+
+if HAS_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _ref_paged_attention(q, k_pages, v_pages, page_table, lens):
+    """Lax fallback: gather each slot's pages into its contiguous view
+    and run the slot-batched masked attention — the SAME math (shapes,
+    mask constant, fp32 softmax) as models/gpt.py::_slot_block, so the
+    paged engine's logits match the slot-contiguous engine bit-for-bit
+    when the view width equals max_len.
+
+    q: [S, 1, nh, hd]; k/v_pages: [P, ps, nh, hd];
+    page_table: int32 [S, maxP]; lens: int32 [S] (the new token sits at
+    position lens[s], already scattered into its page).  Returns
+    [S, 1, nh, hd]."""
+    S, maxP = page_table.shape
+    ps = k_pages.shape[1]
+    hd = q.shape[-1]
+    cd = q.dtype
+    view = maxP * ps
+    kc = k_pages[page_table].reshape(S, view, *k_pages.shape[2:])
+    vc = v_pages[page_table].reshape(S, view, *v_pages.shape[2:])
+    logits = jnp.einsum("sqhd,skhd->shqk", q.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / math.sqrt(hd)
+    mask = jnp.arange(view)[None, :] <= lens[:, None]       # [S, view]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, -1).astype(cd)
+    return jnp.einsum("shqk,skhd->sqhd", probs, vc.astype(cd))
+
+
+def _paged_decode_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size, max_pages):
+    """Grid (slot, page_j).  One physical page of K/V per step, online
+    softmax across a lane's pages exactly like flash_attn's streamed
+    K-blocks.  q_ref: [nh, hd]; k_ref/v_ref: [ps, nh, hd] — the page the
+    scalar-prefetched table names for this (slot, j)."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ln = lens_ref[s]
+    # pages entirely past the fill bound contribute nothing; skipping
+    # them is the paged analogue of the causal block skip
+    @pl.when(j * page_size <= ln)
+    def _body():
+        q = q_ref[:]                                     # [nh, hd]
+        k = k_ref[:]                                     # [ps, nh, hd]
+        v = v_ref[:]
+        hd = q.shape[-1]
+        # scores[h, p] = q[h, :] . k[p, h, :] — batch over heads
+        scr = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) / math.sqrt(hd)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scr.shape, 1)
+        scr = jnp.where(pos <= ln, scr, NEG_INF)
+
+        m_prev = m_scr[:]                                # [nh, 128]
+        m_cur = jnp.max(scr, axis=1, keepdims=True)      # [nh, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(scr - m_new[:, :1])                  # [nh, ps]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        # out[h, d] += p[h, :] @ v[:, h, d] — batch over heads
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[:] = (acc_scr[:]
+                    / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_attention_tpu(q, k_pages, v_pages, page_table, lens,
+                         interpret=False):
+    """q: [S, 1, nh, hd] -> [S, 1, nh, hd] through the Pallas kernel.
+    The page table rides the scalar-prefetch channel so BlockSpec index
+    maps can translate logical page coordinates into physical pool
+    blocks before the DMA is issued."""
+    S, T, nh, hd = q.shape
+    assert T == 1, "paged decode kernel is single-token"
+    P, ps = k_pages.shape[0], k_pages.shape[1]
+    maxP = page_table.shape[1]
+    qs = q[:, 0]                                         # [S, nh, hd]
+    pt_flat = page_table.reshape(-1).astype(jnp.int32)
+    lens32 = lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, maxP),
+        in_specs=[
+            pl.BlockSpec((None, nh, hd),
+                         lambda s, j, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((None, ps, nh, hd),
+                         lambda s, j, pt, ln: (pt[s * maxP + j], 0, 0, 0)),
+            pl.BlockSpec((None, ps, nh, hd),
+                         lambda s, j, pt, ln: (pt[s * maxP + j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, nh, hd),
+                               lambda s, j, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page_size=ps,
+                          max_pages=maxP),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nh, hd), q.dtype),
+        interpret=interpret,
+    )(pt_flat, lens32, qs, k_pages, v_pages)
+    return out[:, None]
+
+
+def _use_pallas_paged(q, k_pages):
+    if not pallas_enabled():
+        return False
+    hd = q.shape[-1]
+    ps = k_pages.shape[1]
+    return (hd % 128 == 0 or hd in (64,)) and ps % 8 == 0
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lens):
+    """Decode attention through a page table.  q: [S, 1, nh, hd] (one
+    new token per slot, already scattered into its page); k/v_pages:
+    [P, ps, nh, hd]; page_table: int32 [S, maxP]; lens: int32 [S].
+    Returns [S, 1, nh, hd].  Inference-only (no custom VJP): the decode
+    step never differentiates."""
+    if _use_pallas_paged(q, k_pages):
+        return _paged_attention_tpu(q, k_pages, v_pages, page_table, lens)
+    return _ref_paged_attention(q, k_pages, v_pages, page_table, lens)

@@ -54,6 +54,11 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         the engine's decode step N raises InjectedFault mid-step — the
         slot-leak regression path: in-flight requests must be marked
         re-queueable and their slots freed, never leaked.
+    page_exhaustion:step=3
+        the paged engine treats its decode step N as a KV page-pool
+        exhaustion event: the NEWEST in-flight request must be
+        preempted (pages freed, request re-queued from its prompt,
+        named in telemetry/counters) — never a silent stall or loss.
 
 Every fault fires at most once (add ``repeat=1`` to re-arm after each
 fire); ``nth`` counts only calls whose other filters matched, so the Nth
@@ -261,6 +266,15 @@ def rpc_entry(op):
     if fault is not None:
         time.sleep(float(fault.get("seconds", 0.5)))
     return take("rpc_drop", op=op) is not None
+
+
+def page_exhaustion_check(step=None):
+    """Called by the paged serving engine once per decode step; returns
+    True when a matching ``page_exhaustion`` fault fires — the engine
+    must run its real exhaustion path (preempt the newest request back
+    to the queue, pages freed, failure named) without the pool actually
+    being full."""
+    return take("page_exhaustion", step=step) is not None
 
 
 def engine_step_error(step):

@@ -1,0 +1,467 @@
+"""Paged KV serving (ISSUE 8): the block-table pager, the paged engine's
+token-exact parity through slot churn, shared-prefix reuse, chunked
+prefill interleaving, page-table edge cases, and the page-exhaustion
+preemption path.
+
+Everything here runs on the lax gather fallback (tier-1, CPU); the
+Pallas paged-attention kernel itself is validated in interpret mode in
+the slow class at the bottom, alongside the other kernel suites.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.kv_pager import KVPager, PagesExhausted
+
+
+# --------------------------------------------------------------------------
+# pager units (pure host bookkeeping, no jax)
+# --------------------------------------------------------------------------
+
+class TestKVPager:
+    def test_alloc_release_roundtrip(self):
+        pg = KVPager(9, 4, slots=2, prefix_cache=False)
+        table, hits = pg.admit(0, np.arange(10))     # 3 pages
+        assert len(table) == 3 and hits == 0
+        assert pg.pages_in_use() == 3 and pg.pages_free() == 5
+        assert 0 not in table                        # scratch reserved
+        pg.release(0)
+        assert pg.pages_in_use() == 0 and pg.pages_free() == 8
+
+    def test_prefix_share_refcount(self):
+        pg = KVPager(17, 4, slots=3)
+        prompt = np.arange(1, 11)                    # 10 tokens, 3 pages
+        t0, h0 = pg.admit(0, prompt)
+        t1, h1 = pg.admit(1, prompt)
+        assert h0 == 0 and h1 == 3
+        assert t0 == t1                              # same physical pages
+        assert pg.pages_in_use() == 3                # counted once
+        pg.release(0)
+        assert pg.pages_in_use() == 3                # slot 1 still holds
+        pg.release(1)
+        assert pg.pages_in_use() == 0
+        # retained: a third admission still hits
+        t2, h2 = pg.admit(2, prompt)
+        assert h2 == 3 and t2 == t0
+
+    def test_partial_prefix_differs(self):
+        pg = KVPager(17, 4, slots=2)
+        pg.admit(0, np.arange(1, 11))                # tail = tokens (9, 10)
+        _, h = pg.admit(1, np.arange(1, 10))         # tail = (9,) — no hit
+        assert h == 2                                # the two full pages
+
+    def test_reclaim_lru_eviction(self):
+        pg = KVPager(5, 4, slots=2)                  # 4 usable pages
+        pg.admit(0, np.arange(8))                    # 2 pages
+        pg.release(0)                                # retained
+        assert pg.pages_free() == 4
+        t, h = pg.admit(1, np.arange(100, 116))      # needs all 4 pages
+        assert h == 0 and len(t) == 4
+        assert pg.evictions == 2                     # retained pages evicted
+        pg.release(1)
+        # the evicted prefix no longer hits
+        _, h2 = pg.admit(0, np.arange(8))
+        assert h2 == 0
+
+    def test_exhaustion_rolls_back(self):
+        pg = KVPager(4, 4, slots=2, prefix_cache=False)   # 3 usable
+        pg.admit(0, np.arange(8))                    # 2 pages
+        with pytest.raises(PagesExhausted):
+            pg.admit(1, np.arange(100, 110))         # needs 3
+        assert pg.pages_free() == 1                  # rollback complete
+        assert pg.tables[1] == []
+
+    def test_ensure_append_tail_and_new_page(self):
+        pg = KVPager(9, 4, slots=1, prefix_cache=False)
+        pg.admit(0, np.arange(5))                    # 2 pages, tail has 1
+        pid, off, cow = pg.ensure_append(0, 5)       # into the tail page
+        assert pid == pg.tables[0][1] and off == 1 and cow is None
+        # idempotent
+        assert pg.ensure_append(0, 5) == (pid, off, None)
+        pid2, off2, _ = pg.ensure_append(0, 8)       # page boundary
+        assert off2 == 0 and pid2 == pg.tables[0][2]
+
+    def test_cow_on_shared_tail(self):
+        pg = KVPager(17, 4, slots=2)
+        prompt = np.arange(1, 7)                     # 6 tokens: 1 full + tail
+        pg.admit(0, prompt)
+        pg.admit(1, prompt)                          # shares both pages
+        old_tail = pg.tables[0][1]
+        pid, off, cow = pg.ensure_append(0, 6)       # diverging write
+        assert cow == old_tail and pid != old_tail and off == 2
+        assert pg.tables[1][1] == old_tail           # peer untouched
+        assert pg.cow_copies == 1
+        # the registered tail stays FROZEN at prompt-only content: the
+        # peer's first append COWs too, retiring the pristine page to
+        # the reclaim list for future identical prompts
+        pid1, _, cow1 = pg.ensure_append(1, 6)
+        assert cow1 == old_tail and pid1 not in (old_tail, pid)
+        assert pg.cow_copies == 2
+        assert old_tail in pg._reclaim               # pristine, reusable
+        pg.release(0)
+        pg.release(1)
+        _, hits = pg.admit(0, prompt)
+        assert hits == 2                             # full page + pristine tail
+
+    def test_frozen_tail_never_shares_live_decode_state(self):
+        """Regression (review finding): request A decodes into its tail
+        page, request B then admits the same prompt — B must NOT share
+        the page A is writing generated K/V into."""
+        pg = KVPager(17, 4, slots=2)
+        prompt = np.arange(1, 7)                     # 1 full + 2-token tail
+        pg.admit(0, prompt)
+        a_tail, _, cow = pg.ensure_append(0, 6)      # A's first append
+        assert cow is not None                       # moved off the frozen page
+        t1, hits = pg.admit(1, prompt)
+        assert hits == 2                             # full + pristine tail
+        assert t1[1] != a_tail                       # never A's live page
+
+    def test_deferred_registration(self):
+        pg = KVPager(17, 4, slots=2)
+        prompt = np.arange(1, 11)                    # 3 pages
+        pg.admit(0, prompt, defer_register=True)
+        # nothing registered yet: an identical admit allocates fresh
+        _, h = pg.admit(1, prompt)
+        assert h == 0
+        pg.release(1)
+        pg.register_prompt(0, 8)                     # two full pages in
+        pg.register_prompt(0, 10)                    # tail in
+        pg.release(0)
+        _, h2 = pg.admit(1, prompt)
+        assert h2 == 3
+
+
+# --------------------------------------------------------------------------
+# paged engine (lax fallback, CPU)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    from paddle_tpu.models import gpt as G
+    cfg = G.GPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                      num_heads=2, max_seq_len=64, dtype="float32",
+                      use_flash=False, remat=False)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def _generate_ref(tiny_model, prompt, n):
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt as G
+    params, cfg = tiny_model
+    out = G.generate(params, cfg, jnp.asarray(prompt)[None], n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _make_engine(tiny_model, **kw):
+    from paddle_tpu.inference.serving import PagedServingEngine
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("batch_buckets", (1, 2))
+    return PagedServingEngine(tiny_model, **kw)
+
+
+class TestPagedEngine:
+    def test_parity_across_churned_slots(self, tiny_model):
+        eng = _make_engine(tiny_model, capture_logits=True)
+        assert eng.warmup() >= 1
+        rng = np.random.RandomState(3)
+        reqs = [eng.submit(
+            rng.randint(1, 256, rng.randint(3, 15)).astype(np.int32),
+            int(rng.randint(3, 8))) for _ in range(10)]
+        done = eng.run()
+        st = eng.stats()
+        assert len(done) == 10
+        assert st["decode_compiles"] == 1
+        assert st["prefill_compiles"] <= 2 * 2     # the (batch, seq) ladder
+        assert st["slot_occupancy_peak"] >= 2      # churn really batched
+        for r in reqs:
+            want = _generate_ref(tiny_model, r.prompt, r.max_new_tokens)
+            assert (np.asarray(r.tokens) == want).all(), r.id
+        # pool fully drained: nothing leaks
+        assert st["pages_in_use"] == 0
+        assert st["kv_tokens_held"] == 0
+
+    def test_prefix_reuse_attestation(self, tiny_model):
+        """The ISSUE's attestation: a second request with the same
+        system prompt allocates ZERO new prefix pages."""
+        eng = _make_engine(tiny_model, page_size=4)
+        eng.warmup()
+        sys_prompt = np.arange(1, 11, dtype=np.int32)   # 10 tokens, 3 pages
+        r1 = eng.submit(sys_prompt, 4)
+        eng.run()
+        s1 = eng.stats()
+        r2 = eng.submit(sys_prompt, 4)
+        eng.run()
+        s2 = eng.stats()
+        assert s2["prefix_page_hits"] - s1["prefix_page_hits"] == 3
+        assert s2["prefix_page_misses"] - s1["prefix_page_misses"] == 0
+        assert r1.tokens == r2.tokens
+
+    def test_concurrent_shared_prefix_cow(self, tiny_model):
+        """Two in-flight requests on one physical prefix: the first
+        diverging write triggers copy-on-write, and both stay
+        token-exact with the reference."""
+        eng = _make_engine(tiny_model, page_size=4)
+        eng.warmup()
+        prompt = np.arange(20, 30, dtype=np.int32)
+        ra = eng.submit(prompt, 6)
+        rb = eng.submit(prompt, 6)
+        eng.run()
+        st = eng.stats()
+        assert st["cow_copies"] >= 1
+        want = _generate_ref(tiny_model, prompt, 6)
+        assert (np.asarray(ra.tokens) == want).all()
+        assert (np.asarray(rb.tokens) == want).all()
+
+    def test_chunked_prefill_interleaves_decode(self, tiny_model):
+        """While a long prompt trickles in chunk by chunk, in-flight
+        decodes must advance between every pair of chunks."""
+        eng = _make_engine(tiny_model, prefill_chunk=8, capture_logits=True)
+        eng.warmup()
+        # occupy a slot with a decoding request first
+        short = eng.submit(np.arange(1, 6, dtype=np.int32), 12)
+        eng.step()
+        long_prompt = np.arange(40, 62, dtype=np.int32)     # 22 tokens: 3 chunks
+        long_req = eng.submit(long_prompt, 4)
+        trace = []
+        while not (short.done and long_req.done):
+            eng.step()
+            st = eng.stats()
+            trace.append((st["prefill_chunks"], st["decode_steps"]))
+        chunks = [c for c, _ in trace]
+        assert max(chunks) == 3
+        # between consecutive chunk advances the decode counter moved
+        for (c0, d0), (c1, d1) in zip(trace, trace[1:]):
+            if c1 > c0 and c0 > 0:
+                assert d1 > d0, trace
+        want = _generate_ref(tiny_model, long_prompt, 4)
+        assert (np.asarray(long_req.tokens) == want).all()
+        want_s = _generate_ref(tiny_model, short.prompt, 12)
+        assert (np.asarray(short.tokens) == want_s).all()
+
+    def test_one_token_tail_page(self, tiny_model):
+        """A prompt of len ≡ 1 (mod page_size) pins a 1-token tail page;
+        decode appends into it and parity holds."""
+        eng = _make_engine(tiny_model, page_size=8)
+        eng.warmup()
+        prompt = np.arange(1, 10, dtype=np.int32)        # 9 = 8 + 1
+        r = eng.submit(prompt, 5)
+        eng.run()
+        want = _generate_ref(tiny_model, prompt, 5)
+        assert (np.asarray(r.tokens) == want).all()
+        assert eng.stats()["pages_in_use"] == 0
+
+    def test_eos_releases_pages(self, tiny_model):
+        eng = _make_engine(tiny_model)
+        eng.warmup()
+        free0 = eng.stats()["pages_free"]
+        want = _generate_ref(tiny_model, np.arange(1, 7), 8)
+        eos = int(want[2])                               # stop at token 3
+        r = eng.submit(np.arange(1, 7, dtype=np.int32), 8, eos_token=eos)
+        eng.run()
+        assert r.done and r.finish_reason == "eos"
+        first = int(np.nonzero(want == eos)[0][0])       # eos may repeat
+        assert len(r.tokens) == first + 1
+        assert (np.asarray(r.tokens) == want[:first + 1]).all()
+        st = eng.stats()
+        assert st["pages_in_use"] == 0
+        assert st["pages_free"] == free0                 # ref-counts clean
+
+    def test_max_new_one_finishes_in_admission(self, tiny_model):
+        eng = _make_engine(tiny_model)
+        eng.warmup()
+        r = eng.submit(np.arange(1, 6, dtype=np.int32), 1)
+        eng.run()
+        assert r.done and len(r.tokens) == 1
+        assert (np.asarray(r.tokens)
+                == _generate_ref(tiny_model, r.prompt, 1)).all()
+        assert eng.stats()["pages_in_use"] == 0
+
+    def test_warmup_covers_rungs_past_prefill_chunk(self, tiny_model):
+        """Regression (review finding): a bucket rung larger than
+        prefill_chunk is still reachable by SHORT prompts that bucket
+        up into it — warmup must compile it via a chunk-capped prompt
+        instead of diverting to the chunked path and leaving it cold."""
+        from paddle_tpu.observability import metrics
+        eng = _make_engine(tiny_model, seq_buckets=(8, 32),
+                           prefill_chunk=16)
+        eng.warmup()
+        before = metrics.counter("compile.count").value
+        # 12 tokens: > bucket 8, <= chunk 16 -> wave path, rung 32
+        r = eng.submit(np.arange(1, 13, dtype=np.int32), 3)
+        eng.run()
+        assert r.done
+        assert metrics.counter("compile.count").value == before, \
+            "rung past prefill_chunk was cold after warmup"
+
+    def test_oversize_request_named_rejection(self, tiny_model):
+        eng = _make_engine(tiny_model, num_pages=4)      # 3 usable pages
+        with pytest.raises(ValueError, match="KV pages"):
+            eng.submit(np.arange(1, 16, dtype=np.int32), 16)
+
+
+class TestPageExhaustion:
+    def test_real_exhaustion_preempts_newest(self, tiny_model):
+        """Pool exhaustion preempts the NEWEST request back to the
+        queue: pages freed, request re-admitted later, both complete
+        token-exact — no deadlock, failure named in the counters."""
+        eng = _make_engine(tiny_model, slots=2, page_size=4,
+                          num_pages=9,                   # 32 positions
+                          seq_buckets=(16,), batch_buckets=(1,),
+                          prefix_cache=False)
+        eng.warmup()
+        a = eng.submit(np.arange(1, 13, dtype=np.int32), 16)
+        b = eng.submit(np.arange(3, 15, dtype=np.int32), 16)
+        done = eng.run(max_steps=400)                    # bounded: no hang
+        st = eng.stats()
+        assert len(done) == 2 and a.done and b.done
+        assert st["preemptions"] >= 1
+        assert a.preemptions + b.preemptions >= 1        # named on the req
+        for r in (a, b):
+            want = _generate_ref(tiny_model, r.prompt, r.max_new_tokens)
+            assert (np.asarray(r.tokens) == want).all(), r.id
+        assert st["pages_in_use"] == 0
+
+    def test_injected_page_exhaustion_fault(self, tiny_model):
+        from paddle_tpu.testing import faults
+        faults.clear()
+        faults.install("page_exhaustion:step=2")
+        try:
+            eng = _make_engine(tiny_model, slots=2, seq_buckets=(16,))
+            eng.warmup()
+            c = eng.submit(np.arange(1, 6, dtype=np.int32), 6)
+            d = eng.submit(np.arange(2, 7, dtype=np.int32), 6)
+            done = eng.run(max_steps=200)
+            st = eng.stats()
+            assert st["preemptions"] == 1
+            assert len(done) == 2 and c.done and d.done
+            assert c.preemptions + d.preemptions == 1
+            for r in (c, d):
+                want = _generate_ref(tiny_model, r.prompt, r.max_new_tokens)
+                assert (np.asarray(r.tokens) == want).all(), r.id
+        finally:
+            faults.clear()
+
+    def test_engine_error_aborts_and_rebuilds_paged_pool(self, tiny_model):
+        """The PR-6 slot-leak fix must hold on the paged path: a mid-step
+        failure frees slots AND pages, victims are re-queueable, and the
+        rebuilt pool serves the retries token-exact."""
+        from paddle_tpu.testing import faults
+        faults.clear()
+        faults.install("engine_error:step=2")
+        try:
+            eng = _make_engine(tiny_model, slots=2)
+            eng.warmup()
+            a = eng.submit(np.arange(1, 8, dtype=np.int32), 5)
+            b = eng.submit(np.arange(2, 9, dtype=np.int32), 5)
+            with pytest.raises(faults.InjectedFault):
+                eng.run()
+            victims = eng.take_aborted()
+            assert {v.id for v in victims} <= {a.id, b.id}
+            assert victims
+            st = eng.stats()
+            assert st["pages_in_use"] == 0               # pager rebuilt
+            assert st["slot_occupancy"] == 0
+            for v in victims:
+                eng.submit(v.reset_for_retry())
+            eng.run()
+            for r in (a, b):
+                want = _generate_ref(tiny_model, r.prompt, r.max_new_tokens)
+                assert (np.asarray(r.tokens) == want).all(), r.id
+        finally:
+            faults.clear()
+
+
+# --------------------------------------------------------------------------
+# router satellite: page-aware least-loaded capacity
+# --------------------------------------------------------------------------
+
+class TestFleetPageRouting:
+    def _fleet_stub(self):
+        from paddle_tpu.inference.fleet import ServingFleet
+        fleet = ServingFleet.__new__(ServingFleet)
+        fleet._slots = 4
+        fleet.dispatch_queue_depth = 4
+        return fleet
+
+    class _R:
+        def __init__(self, stats, inflight=0):
+            self.last_stats = stats
+            self.inflight = dict.fromkeys(range(inflight))
+
+    def test_slot_fallback_for_non_paged(self):
+        fleet = self._fleet_stub()
+        r = self._R({"slots": 4}, inflight=2)
+        assert fleet._capacity(r) == 6                   # 4 + 4 - 2
+
+    def test_free_pages_cap_routing(self):
+        """A replica whose slots look free but whose page pool is pinned
+        (fragmented-but-counted-free slots) must NOT win routing."""
+        fleet = self._fleet_stub()
+        starved = self._R({"slots": 4, "pages_free": 3,
+                           "pages_per_request_est": 3}, inflight=0)
+        roomy = self._R({"slots": 4, "pages_free": 24,
+                         "pages_per_request_est": 3}, inflight=0)
+        assert fleet._capacity(starved) == 1             # 3 // 3
+        assert fleet._capacity(roomy) == 8               # slot bound wins
+        # admitted in-flight work already holds its pages (pages_free
+        # excludes them) — only not-yet-admitted in-flight claims from
+        # the free set
+        admitted = self._R({"slots": 4, "pages_free": 9, "slot_occupancy": 2,
+                            "pages_per_request_est": 3}, inflight=2)
+        assert fleet._capacity(admitted) == 3            # min(6, 9//3 - 0)
+        queued = self._R({"slots": 4, "pages_free": 9, "slot_occupancy": 0,
+                          "pages_per_request_est": 3}, inflight=2)
+        assert fleet._capacity(queued) == 1              # 9//3 - 2
+
+    def test_zero_free_pages_zero_capacity(self):
+        fleet = self._fleet_stub()
+        r = self._R({"slots": 4, "pages_free": 0,
+                     "pages_per_request_est": 2})
+        assert fleet._capacity(r) == 0
+
+
+# --------------------------------------------------------------------------
+# Pallas paged-attention kernel (interpret mode) — slow tier
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestPagedAttentionKernel:
+    @pytest.mark.parametrize("S,nh,hd,P,ps,maxP", [
+        (4, 4, 16, 12, 8, 4),
+        (2, 2, 64, 6, 16, 2),
+        (3, 4, 32, 16, 8, 6),
+    ])
+    def test_kernel_matches_lax_fallback(self, S, nh, hd, P, ps, maxP):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.paged_attn import (
+            _paged_attention_tpu, _ref_paged_attention)
+        rng = np.random.RandomState(S + P)
+        q = jnp.asarray(rng.randn(S, 1, nh, hd).astype(np.float32))
+        k = jnp.asarray(rng.randn(P, ps, nh, hd).astype(np.float32))
+        v = jnp.asarray(rng.randn(P, ps, nh, hd).astype(np.float32))
+        pt = jnp.asarray(rng.randint(0, P, (S, maxP)).astype(np.int32))
+        lens = jnp.asarray(
+            rng.randint(0, maxP * ps, (S,)).astype(np.int32))
+        ref = _ref_paged_attention(q, k, v, pt, lens)
+        got = _paged_attention_tpu(q, k, v, pt, lens, interpret=True)
+        assert float(jnp.abs(ref - got).max()) < 1e-5
+
+    def test_kernel_len_zero_lane(self):
+        """A lens[s]==0 lane attends only its just-written position —
+        the softmax denominator must not divide by zero."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.paged_attn import (
+            _paged_attention_tpu, _ref_paged_attention)
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(2, 1, 2, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(5, 8, 2, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(5, 8, 2, 16).astype(np.float32))
+        pt = jnp.asarray(rng.randint(0, 5, (2, 2)).astype(np.int32))
+        lens = jnp.asarray(np.array([0, 9], np.int32))
+        ref = _ref_paged_attention(q, k, v, pt, lens)
+        got = _paged_attention_tpu(q, k, v, pt, lens, interpret=True)
+        assert float(jnp.abs(ref - got).max()) < 1e-5
