@@ -795,7 +795,13 @@ def serving_bench():
     EXACTLY the baseline pool's bytes, and asserts the ISSUE-8 criteria:
     ``kv_bytes_per_token <= 0.6x`` the slot-contiguous baseline,
     ``>= 1.5x`` admitted concurrency at that byte budget, decode_compiles
-    still 1, zero steady-state compiles, and token-exact parity.  Runs on
+    still 1, zero steady-state compiles, and token-exact parity.  A third
+    QUANTIZED phase (ISSUE 9: int8 weight-only executables + int8 paged
+    KV) re-runs the trace once more at the fp32 paged pool's byte budget
+    and asserts ``kv_bytes_per_token <= 0.5x`` the paged-fp32 number,
+    ``>= 1.3x`` its admitted concurrency, max logit error within the
+    declared budget (BENCH_QUANT_LOGIT_BUDGET, default 0.05) with
+    greedy-token match, and the same compile invariants.  Runs on
     any backend (CPU smoke included) — the contract being measured is
     compile reuse + scheduling + memory accounting, not FLOPs.  Knobs:
     BENCH_SERVING_REQUESTS (default 24), BENCH_SERVING_SLOTS (default 4)."""
@@ -931,7 +937,8 @@ def serving_bench():
         page_size=page_size, num_pages=num_pages,
         seq_buckets=seq_buckets, batch_buckets=batch_buckets,
         prefill_chunk=16,                 # prompts > 16 admit chunked
-        max_queue=max(n_requests, 8 * paged_slots))
+        max_queue=max(n_requests, 8 * paged_slots),
+        capture_logits=True)              # the quant phase's fp32 reference
     paged.warmup()
     paged.reset_occupancy_peak()
     assert paged.stats()["kv_bytes_total"] == engine.stats()[
@@ -977,8 +984,80 @@ def serving_bench():
         f"{stats['slot_occupancy_peak']} at the same KV byte budget "
         "(need >= 1.5x)")
 
+    # ---- quantized phase (ISSUE 9): same trace, same KV byte budget ---
+    # int8 weights + int8 paged KV against the fp32 paged engine: the
+    # pool gets however many int8+scale pages fit in the SAME bytes the
+    # fp32 paged pool used, so every extra admitted request comes from
+    # quantization alone.  Accuracy is gated, not assumed: max logit
+    # error within the declared budget AND greedy-token match on the
+    # bench prompts.
+    logit_budget = float(os.environ.get("BENCH_QUANT_LOGIT_BUDGET", 0.05))
+    budget_bytes = pstats["kv_bytes_total"]
+    # bytes per page in the int8 pool: 2 pools of 1-byte elements plus
+    # 2 fp32 per-position-per-head scale rows, per layer
+    q_page_bytes = 2 * cfg.num_layers * (
+        page_size * cfg.num_heads * cfg.head_dim
+        + page_size * cfg.num_heads * 4)
+    q_num_pages = budget_bytes // q_page_bytes
+    q_slots = 2 * paged_slots
+    quant = PagedServingEngine(
+        (params, cfg), slots=q_slots, max_len=max_len,
+        page_size=page_size, num_pages=q_num_pages,
+        seq_buckets=seq_buckets, batch_buckets=batch_buckets,
+        prefill_chunk=16, quant="int8", kv_dtype="int8",
+        max_queue=max(n_requests, 8 * q_slots), capture_logits=True)
+    quant.warmup()
+    quant.reset_occupancy_peak()
+    qtotal = quant.stats()["kv_bytes_total"]
+    assert qtotal <= budget_bytes, (qtotal, budget_bytes)
+    compiles2 = obs_metrics.counter("compile.count").value
+    kv_quant = KVSampler()
+    qreqs = []
+    t2 = time.perf_counter()
+    for p, m in make_requests(n_requests, 2):     # the SAME mixed trace
+        qreqs.append(quant.submit(p, m))
+    qdone = []
+    while quant._busy():
+        qdone.extend(quant.step())
+        kv_quant.sample(quant.stats())
+    dt_quant = time.perf_counter() - t2
+    qstats = quant.stats()
+    quant_new_compiles = (obs_metrics.counter("compile.count").value
+                          - compiles2)
+    assert len(qdone) == n_requests, (len(qdone), n_requests)
+    assert qstats["decode_compiles"] == 1, qstats
+    assert quant_new_compiles == 0, (
+        f"quantized steady state retraced: {quant_new_compiles} new XLA "
+        "compiles")
+    # accuracy budget vs the fp32 paged engine on the same prompts:
+    # greedy tokens EXACT, per-token logit rows within the budget
+    max_quant_err = 0.0
+    for pr, qr in zip(preqs, qreqs):
+        assert pr.tokens == qr.tokens, (
+            f"quantized greedy tokens diverged from fp32 on {qr.id}: "
+            f"{pr.tokens} vs {qr.tokens}")
+        for fr, qrow in zip(pr.logits, qr.logits):
+            max_quant_err = max(max_quant_err,
+                                float(np.abs(fr - qrow).max()))
+    assert max_quant_err <= logit_budget, (
+        f"quantized max logit error {max_quant_err:.4f} exceeds the "
+        f"declared budget {logit_budget}")
+    bpt_quant = kv_quant.bytes_per_token()
+    q_ratio = bpt_quant / bpt_paged
+    assert q_ratio <= 0.5, (
+        f"quantized kv_bytes_per_token {bpt_quant:.0f} is {q_ratio:.2f}x "
+        f"the fp32 paged number {bpt_paged:.0f} (need <= 0.5x)")
+    q_conc_gain = qstats["slot_occupancy_peak"] / max(
+        1, pstats["slot_occupancy_peak"])
+    assert q_conc_gain >= 1.3, (
+        f"quantized admitted concurrency {qstats['slot_occupancy_peak']} "
+        f"is only {q_conc_gain:.2f}x the fp32 paged "
+        f"{pstats['slot_occupancy_peak']} at the same byte budget "
+        "(need >= 1.3x)")
+
     total_tokens = sum(len(r.tokens) for r in reqs)
     paged_tokens = sum(len(r.tokens) for r in preqs)
+    quant_tokens = sum(len(r.tokens) for r in qreqs)
     lat = obs_metrics.histogram("serving.request_latency_s").summary()
     counters = profiler.fast_path_summary()
     print(json.dumps({
@@ -1012,10 +1091,30 @@ def serving_bench():
                 "prefill_chunks": pstats["prefill_chunks"],
                 "cow_copies": pstats["cow_copies"],
                 "preemptions": pstats["preemptions"]},
+            "quant": {
+                "quant": "int8", "kv_dtype": "int8",
+                "kv_bytes_total": qstats["kv_bytes_total"],
+                "kv_bytes_per_token": round(bpt_quant, 1),
+                "bytes_per_token_vs_paged": round(q_ratio, 4),
+                "page_utilization": round(kv_quant.mean_util() or 0, 4),
+                "admitted_concurrency": qstats["slot_occupancy_peak"],
+                "concurrency_gain_vs_paged": round(q_conc_gain, 2),
+                "num_pages": q_num_pages, "slots": q_slots,
+                "tokens_per_sec": round(quant_tokens / dt_quant, 2),
+                "max_logit_err": round(max_quant_err, 6),
+                "logit_budget": logit_budget,
+                "greedy_match": True,
+                "prefix_page_hits": qstats["prefix_page_hits"],
+                "quant_matmuls": qstats["quant_matmuls"],
+                "kv_quant_bytes_saved": qstats["kv_quant_bytes_saved"],
+                "dequant_kernel_calls":
+                    counters["serving"].get("dequant_kernel_calls", 0),
+                "preemptions": qstats["preemptions"]},
             "bytes_per_token_ratio": round(ratio, 4),
             "concurrency_gain": round(conc_gain, 2)},
         "telemetry": {"steady_state_compiles": new_compiles,
                       "paged_steady_state_compiles": paged_new_compiles,
+                      "quant_steady_state_compiles": quant_new_compiles,
                       "registry": {"serving": counters["serving"]}},
     }), flush=True)
     print(f"# serving: {total_tokens / dt:.1f} tok/s "
@@ -1030,6 +1129,13 @@ def serving_bench():
           f"{stats['slot_occupancy_peak']} ({conc_gain:.1f}x >= 1.5x), "
           f"chunks={pstats['prefill_chunks']}, "
           f"preemptions={pstats['preemptions']}", file=sys.stderr)
+    print(f"# serving/quant: {quant_tokens / dt_quant:.1f} tok/s, "
+          f"kv bytes/token {bpt_quant:.0f} vs paged {bpt_paged:.0f} "
+          f"({q_ratio:.2f}x <= 0.5x), concurrency "
+          f"{qstats['slot_occupancy_peak']} vs "
+          f"{pstats['slot_occupancy_peak']} ({q_conc_gain:.1f}x >= 1.3x), "
+          f"logit_err={max_quant_err:.2e} <= {logit_budget}, "
+          f"greedy tokens exact", file=sys.stderr)
 
 
 # --------------------------------------------------------------------------

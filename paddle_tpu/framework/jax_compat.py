@@ -56,6 +56,17 @@ def tpu_compiler_params(pltpu, **kwargs):
     return cls(**kwargs)
 
 
+def fp8_dtype():
+    """The float8 storage dtype for weight-only quantized serving
+    (``PagedServingEngine(quant="fp8")``), or None when this jax doesn't
+    expose one.  jax 0.4.37 ships ``jnp.float8_e4m3fn`` (e4m3, max 448);
+    route through here instead of naming it so older/newer spellings
+    degrade to a clean "fp8 unavailable" error instead of an
+    AttributeError."""
+    import jax.numpy as jnp
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
 def donation_enabled(env_var):
     """Shared buffer-donation gate: ``env_var`` 0/1 forces, "auto" (the
     default) donates everywhere but CPU, whose donation path only warns.

@@ -230,6 +230,28 @@ class ServingFleet:
                  spawn_timeout_s=None, steps_per_rpc=4,
                  dispatch_queue_depth=None, worker_argv=None):
         self.model_spec = dict(model_spec or {})
+        # spec keys the built engine could not honor would otherwise
+        # surface as a fleet-wide boot crash or hello contract mismatch
+        # — a config slip must fail HERE, in the caller's process, not
+        # as N permanently-dead replicas.  The mode list mirrors
+        # models/gpt.py::QUANT_MODES (importing it would pull jax into
+        # the router, which deliberately never loads a backend);
+        # fp8 *availability* can only be probed worker-side.
+        if (self.model_spec.get("kv_dtype") is not None
+                and not self.model_spec.get("paged")):
+            raise ValueError(
+                "model_spec has kv_dtype but not paged: true — only the "
+                "paged engine has a quantizable KV pool")
+        quant = self.model_spec.get("quant")
+        if quant is not None and quant not in ("int8", "int8_dynamic",
+                                               "fp8"):
+            raise ValueError(
+                f"model_spec quant mode {quant!r} is unknown — expected "
+                "one of ('int8', 'int8_dynamic', 'fp8')")
+        if self.model_spec.get("kv_dtype") not in (None, "int8"):
+            raise ValueError(
+                f"model_spec kv_dtype {self.model_spec['kv_dtype']!r} "
+                "is unknown — expected 'int8' or omit it")
         self.nreplicas = int(replicas if replicas is not None
                              else _env_int("PADDLE_FLEET_REPLICAS", 2))
         if self.nreplicas < 1:
@@ -417,9 +439,28 @@ class ServingFleet:
             except (OSError, ValueError) as e:
                 conn.close()
                 raise _ReplicaGone(f"bad hello: {e}") from e
+            # numeric-contract attestation (ISSUE 9): a replica serving
+            # a different quant mode / KV dtype than the spec asked for
+            # would return budget-different tokens for re-queued
+            # requests — refuse it like any other unhealthy replica
+            stats = hello.get("stats") or {}
+            mismatch = self._contract_mismatch(stats)
+            if mismatch is not None:
+                conn.close()
+                # deterministic config error, not a crash: relaunching
+                # the identical spec can only mismatch again, so spend
+                # the whole restart budget now — the replica goes (and
+                # stays) down with the incident named, instead of
+                # burning minutes of kill/backoff/relaunch churn
+                r.restarts_used = self.max_restarts
+                raise _ReplicaGone(
+                    f"numeric contract mismatch: replica hello reports "
+                    f"(quant, kv_dtype)={mismatch[0]} but the fleet "
+                    f"spec says {mismatch[1]} — config error, replica "
+                    "will not be relaunched")
             r.conn = conn
             r.hello = hello
-            r.last_stats = hello.get("stats") or {}
+            r.last_stats = stats
             r.state = "healthy"
             self._g_up.inc(1)
             if r.incident_t is not None:
@@ -523,6 +564,18 @@ class ServingFleet:
             self._inc("rpc_errors")
             raise _ReplicaGone(f"rpc failed: {type(e).__name__}: {e}") \
                 from e
+
+    def _contract_mismatch(self, stats):
+        """None when the replica's reported numeric contract (quant
+        mode, kv_dtype — echoed in every engine ``stats()``) matches
+        the fleet spec's; else ``(got, want)`` for the incident
+        record.  Requests re-queued across replicas assume identical
+        numerics — a mixed-contract fleet would silently break the
+        token-exact retry guarantee."""
+        want = (self.model_spec.get("quant"),
+                self.model_spec.get("kv_dtype"))
+        got = (stats.get("quant"), stats.get("kv_dtype"))
+        return None if got == want else (got, want)
 
     def _capacity(self, r):
         """How many more requests this replica can hold, judged from

@@ -27,11 +27,17 @@ router sees as a vanished replica).
 Spec keys (all optional): ``preset`` ("gpt_tiny", default), ``cfg``
 (GPTConfig kwargs — overrides preset), ``seed`` (params PRNG, default
 0), ``slots``, ``max_len``, ``seq_buckets``, ``batch_buckets``,
-``max_queue``, ``warmup`` (default true).  With ``paged: true`` the
-replica runs a :class:`~paddle_tpu.inference.serving.PagedServingEngine`
+``max_queue``, ``warmup`` (default true), ``quant`` (weight-only
+quantization mode — "int8"/"int8_dynamic"/"fp8").  With ``paged: true``
+the replica runs a
+:class:`~paddle_tpu.inference.serving.PagedServingEngine`
 (knobs ``page_size``, ``num_pages``, ``prefix_cache``,
-``prefill_chunk``) and its step replies carry the free-page numbers the
-router's page-aware least-loaded routing keys on.
+``prefill_chunk``, ``kv_dtype`` — "int8" for the quantized page pool)
+and its step replies carry the free-page numbers the router's
+page-aware least-loaded routing keys on.  The hello's stats echo
+``quant``/``kv_dtype`` back; the router refuses a replica whose numeric
+contract differs from the fleet spec (a mixed fp32/int8 fleet must
+never re-queue a request onto a replica with different numerics).
 """
 from __future__ import annotations
 
@@ -70,6 +76,19 @@ def _build_engine(spec):
     for k in ("seq_buckets", "batch_buckets"):
         if spec.get(k) is not None:
             kw[k] = tuple(int(x) for x in spec[k])
+    # the numeric contract (ISSUE 9): quant mode travels in the spec so
+    # every replica — and every RELAUNCHED replica — builds the same
+    # quantized executables; the hello carries it back for the router's
+    # attestation
+    if spec.get("quant") is not None:
+        kw["quant"] = str(spec["quant"])
+    if spec.get("kv_dtype") is not None and not spec.get("paged"):
+        # never build an engine that can't honor the spec's numeric
+        # contract — the router validates too, but a hand-rolled env
+        # must fail loudly here rather than echo kv_dtype=None forever
+        raise ValueError(
+            "spec has kv_dtype but not paged: true — only the paged "
+            "engine has a quantizable KV pool")
     cls = ServingEngine
     if spec.get("paged"):
         cls = PagedServingEngine
@@ -78,6 +97,8 @@ def _build_engine(spec):
                 kw[k] = int(spec[k])
         if spec.get("prefix_cache") is not None:
             kw["prefix_cache"] = bool(spec["prefix_cache"])
+        if spec.get("kv_dtype") is not None:
+            kw["kv_dtype"] = str(spec["kv_dtype"])
     return cls((params, cfg), **kw)
 
 

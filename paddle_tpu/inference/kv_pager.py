@@ -70,11 +70,18 @@ class KVPager:
     token positions ``[j*page_size, (j+1)*page_size)`` of that slot's
     sequence."""
 
-    def __init__(self, num_pages, page_size, slots, prefix_cache=True):
+    def __init__(self, num_pages, page_size, slots, prefix_cache=True,
+                 hash_key=None):
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.slots = int(slots)
         self.prefix_cache = bool(prefix_cache)
+        # the numeric contract under the page bytes (quant mode,
+        # kv_dtype — ISSUE 9): salted into every content hash so pages
+        # from engines with different numeric contracts can never be
+        # mistaken for one another (a fleet comparing prefix keys across
+        # mixed fp32/int8 replicas must never alias them)
+        self.hash_key = "" if hash_key is None else str(hash_key)
         if self.num_pages < 2:
             raise ValueError(
                 f"num_pages must be >= 2 (page 0 is scratch), got "
@@ -126,6 +133,8 @@ class KVPager:
         toks = np.asarray(prompt, np.int64).reshape(-1)
         ps = self.page_size
         h = hashlib.blake2b(digest_size=16)
+        if self.hash_key:
+            h.update(self.hash_key.encode())
         keys = []
         for j in range(0, len(toks), ps):
             chunk = toks[j:j + ps]

@@ -96,7 +96,15 @@ def _stats_family():
         "standalone_compiles": 0,
         # paged-KV family (PagedServingEngine; zero on slot engines)
         "prefill_chunks": 0, "prefix_page_hits": 0,
-        "prefix_page_misses": 0, "cow_copies": 0, "preemptions": 0})
+        "prefix_page_misses": 0, "cow_copies": 0, "preemptions": 0,
+        # quantized-serving family (ISSUE 9): quantized matmuls executed,
+        # KV bytes the int8 pool saved vs the same pool at compute
+        # dtype, and fused dequant kernel INSTANTIATIONS — the inc
+        # fires at trace time, once per kernel per compiled executable,
+        # so it answers "did the Pallas path engage in what XLA built?"
+        # not "how many steps ran" (0 off-TPU: the lax fallback serves)
+        "quant_matmuls": 0, "kv_quant_bytes_saved": 0,
+        "dequant_kernel_calls": 0})
 
 
 class _StatsMirror:
@@ -184,6 +192,12 @@ class ServingEngine:
       beyond it :meth:`submit` raises :class:`ServingQueueFull`.
     * ``capture_logits`` — keep each request's per-token fp32 logit rows
       (parity tests / bench; costs a host fetch per step).
+    * ``quant`` — weight-only quantization mode (``"int8"``,
+      ``"int8_dynamic"``, ``"fp8"``; see models/gpt.py::quantize_params):
+      the param pytree is quantized once at construction and every
+      executable runs its matmuls through the fused dequant path.
+      Accuracy is a budget, not exact parity — gate on the bench's
+      logit-error check.
 
     Decoding is greedy (the parity contract with
     ``models.gpt.generate(temperature=0)``).
@@ -191,7 +205,7 @@ class ServingEngine:
 
     def __init__(self, model, *, slots=4, max_len=None, seq_buckets=None,
                  batch_buckets=DEFAULT_BATCH_BUCKETS, max_queue=None,
-                 capture_logits=False, cache_dtype=None):
+                 capture_logits=False, cache_dtype=None, quant=None):
         import jax
         import jax.numpy as jnp
         self._jax, self._jnp = jax, jnp
@@ -203,6 +217,16 @@ class ServingEngine:
             from ..ops import dispatch as _dispatch
             params = _dispatch.unwrap(model._tree())
         self.cfg = cfg
+        # weight-only quantization (ISSUE 9): the param pytree is
+        # quantized ONCE here — every executable built below closes over
+        # int8/fp8 weights + scales as ordinary pytree operands, and
+        # models/gpt.py::block_apply routes their matmuls through the
+        # fused dequant path.  Orthogonal to the paged engine's
+        # kv_dtype: quant shrinks the weights, kv_dtype the KV pool.
+        self.quant = quant
+        self._kv_dtype = None          # the paged subclass may set int8
+        if quant is not None:
+            params = gpt.quantize_params(params, quant)
         self.params = params
 
         self.slots = int(slots)
@@ -465,6 +489,7 @@ class ServingEngine:
                 self._cache_k, self._cache_v, first_tok = out
                 logits_np = None
             self._inc("prefill_calls")
+            self._count_quant_matmuls()
             first_np = np.asarray(first_tok)
             for req in group:
                 r = group_rows[id(req)]
@@ -640,6 +665,7 @@ class ServingEngine:
             self._cache_k, self._cache_v, nxt = out
             logits_np = None
         self._inc("decode_steps")
+        self._count_quant_matmuls()
         nxt_np = np.asarray(nxt)
         for s in range(self.slots):
             if not self._active[s]:
@@ -791,7 +817,15 @@ class ServingEngine:
         "prefill_calls", "decode_steps", "requests_admitted",
         "requests_completed", "tokens_generated",
         "prefill_chunks", "prefix_page_hits", "prefix_page_misses",
-        "cow_copies", "preemptions"))
+        "cow_copies", "preemptions", "quant_matmuls"))
+
+    def _count_quant_matmuls(self):
+        """One model forward = 4 quantized matmuls per layer (qkv, proj,
+        fc1, fc2) when the weights are quantized — counted next to every
+        prefill/chunk/decode dispatch so ``serving.quant_matmuls``
+        tracks the quantized executables actually running."""
+        if self.quant:
+            self._inc("quant_matmuls", 4 * self.cfg.num_layers)
 
     def _inc(self, key, v=1):
         """Count into the process-global serving.* registry family AND
@@ -813,6 +847,10 @@ class ServingEngine:
         # from the engine-local sample window, NOT the shared gauge — a
         # coexisting engine's throughput must not show up here
         out["tokens_per_s"] = self._tps_value()
+        # the numeric contract (fleet routing/hello attests on these: a
+        # mixed fp32/int8 fleet must never cross-route)
+        out["quant"] = self.quant
+        out["kv_dtype"] = self._kv_dtype
         out.update(self._kv_accounting())
         return out
 
@@ -867,17 +905,40 @@ class PagedServingEngine(ServingEngine):
     ``Request.preemptions``) — and greedy decoding makes its eventual
     retry token-exact.
 
+    * **quantized KV** (``kv_dtype="int8"``, ISSUE 9) — the page pool
+      stores K/V int8 with per-position-per-head fp32 scale arrays
+      alongside (models/gpt.py::init_paged_cache_quant): prefill and
+      chunk scatters quantize on write, decode attention dequantizes on
+      read (in-kernel on TPU: ops/pallas/paged_attn.py::
+      paged_attention_quant).  ~4x the tokens per KV byte; COW copies
+      page+scale pairs; the prefix hash is salted with the numeric
+      contract so int8 pages never alias fp pages.  Composes with
+      ``quant=`` (weight-only int8/fp8 executables) — together they are
+      the quantized serving path the bench gates on an accuracy budget.
+
     Constraints: ``max_len`` must be a page multiple (seq buckets are
     rounded up to page multiples), and ``prefill_chunk`` must divide
     ``max_len`` and fit inside the largest prefill bucket."""
 
     def __init__(self, model, *, page_size=16, num_pages=None,
-                 prefix_cache=True, prefill_chunk=None, **kw):
+                 prefix_cache=True, prefill_chunk=None, kv_dtype=None,
+                 **kw):
         from .kv_pager import KVPager, PagesExhausted  # noqa: F401
         self._KVPager, self._PagesExhausted = KVPager, PagesExhausted
         self._page_size = int(page_size)
         if self._page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (compute dtype) or 'int8', got "
+                f"{kv_dtype!r} — float overrides go through cache_dtype")
+        if kv_dtype == "int8" and kw.get("cache_dtype") is not None:
+            raise ValueError(
+                "kv_dtype='int8' and cache_dtype are mutually exclusive "
+                "— the int8 pool's storage dtype is fixed (int8 pages + "
+                "fp32 scales); drop cache_dtype")
+        self._kv_quant = kv_dtype == "int8"
+        self._kv_saved_counted = False
         self._num_pages_cfg = None if num_pages is None else int(num_pages)
         self._prefix_cache_on = bool(prefix_cache)
         self._prefill_chunk = None          # set after buckets are known
@@ -887,6 +948,10 @@ class PagedServingEngine(ServingEngine):
         self._chunk_jit = None
         self._admit_seq = 0
         super().__init__(model, **kw)
+        self._kv_dtype = kv_dtype
+        if getattr(self, "_kv_saved_pending", None):
+            self._inc("kv_quant_bytes_saved", self._kv_saved_pending)
+            self._kv_saved_pending = 0
         ps = self._page_size
         # the gathered page view is maxP*ps == max_len wide, so paged
         # attention sees exactly the slot engine's mask width — that, and
@@ -919,15 +984,60 @@ class PagedServingEngine(ServingEngine):
                      if self._num_pages_cfg is not None
                      else self.slots * self._pages_per_slot + 1)
         self._num_pages = int(num_pages)
-        self._pager = self._KVPager(self._num_pages, ps, self.slots,
-                                    prefix_cache=self._prefix_cache_on)
-        cache = gpt.init_paged_cache(self.cfg, self._num_pages, ps,
-                                     dtype=self._cache_dtype)
+        # the prefix hashes are salted with the numeric contract so an
+        # int8 pool's pages can never alias an fp pool's (satellite:
+        # mixed-fleet prefix keys must not collide across contracts)
+        self._pager = self._KVPager(
+            self._num_pages, ps, self.slots,
+            prefix_cache=self._prefix_cache_on,
+            hash_key=f"quant={self.quant or 'none'}"
+                     f"/kv={'int8' if self._kv_quant else 'fp'}")
+        if self._kv_quant:
+            cache = gpt.init_paged_cache_quant(self.cfg, self._num_pages,
+                                               ps)
+            self._cache_ks = cache["k_scale"]
+            self._cache_vs = cache["v_scale"]
+            if not self._kv_saved_counted:
+                # bytes the int8+scale pool saves vs the SAME pool at
+                # the compute dtype (what a rebuild without kv_dtype
+                # would have allocated) — counted once, not per rebuild.
+                # The first build happens inside the base constructor
+                # before the counters exist; park it for __init__'s tail.
+                fp_bytes = 2 * (cache["k"].size
+                                * self._jnp.dtype(self._cache_dtype
+                                                  or self.cfg.dtype).itemsize)
+                q_bytes = sum(int(cache[n].nbytes) for n in
+                              ("k", "v", "k_scale", "v_scale"))
+                self._kv_saved_pending = max(0, fp_bytes - q_bytes)
+                self._kv_saved_counted = True
+        else:
+            cache = gpt.init_paged_cache(self.cfg, self._num_pages, ps,
+                                         dtype=self._cache_dtype)
+            self._cache_ks = self._cache_vs = None
         self._cache_k, self._cache_v = cache["k"], cache["v"]
         self._tables_np = np.zeros((self.slots, self._pages_per_slot),
                                    np.int32)
         self._chunk_jobs.clear()
         self._chunk_slots.clear()
+
+    def _cache_operands(self):
+        """The donated KV pool arrays in executable-operand order:
+        (k, v) for the fp pool, (k, k_scale, v, v_scale) for int8."""
+        if self._kv_quant:
+            return (self._cache_k, self._cache_ks,
+                    self._cache_v, self._cache_vs)
+        return (self._cache_k, self._cache_v)
+
+    def _set_cache(self, arrs):
+        if self._kv_quant:
+            (self._cache_k, self._cache_ks,
+             self._cache_v, self._cache_vs) = arrs
+        else:
+            self._cache_k, self._cache_v = arrs
+
+    @property
+    def _n_cache(self):
+        return 4 if self._kv_quant else 2
 
     def _chunk_eligible(self, req):
         return (self._prefill_chunk is not None
@@ -1028,16 +1138,15 @@ class PagedServingEngine(ServingEngine):
         t0 = time.perf_counter()
         with timeline.span("serving.prefill", batch=bbucket, seq=sbucket,
                            paged=True):
-            out = fn(self.params, self._cache_k, self._cache_v,
+            out = fn(self.params, *self._cache_operands(),
                      jnp.asarray(toks), jnp.asarray(lens),
                      jnp.asarray(ptab))
-        if self.capture_logits:
-            self._cache_k, self._cache_v, first_tok, last_logits = out
-            logits_np = np.asarray(last_logits)
-        else:
-            self._cache_k, self._cache_v, first_tok = out
-            logits_np = None
+        self._set_cache(out[:self._n_cache])
+        first_tok = out[self._n_cache]
+        logits_np = (np.asarray(out[self._n_cache + 1])
+                     if self.capture_logits else None)
         self._inc("prefill_calls")
+        self._count_quant_matmuls()
         first_np = np.asarray(first_tok)
         for r, req in enumerate(group):
             s = req.slot
@@ -1064,32 +1173,59 @@ class PagedServingEngine(ServingEngine):
         prompts, then one batched scatter of the filled K/V page chunks
         into the DONATED pool through the page tables (pad rows target
         the scratch page; shared pages receive content identical to
-        what they already hold, so duplicate indices are benign)."""
+        what they already hold, so duplicate indices are benign).
+
+        With ``kv_dtype="int8"`` the forward still runs — and attends
+        its own prompt — in the compute dtype; the K/V QUANTIZE ON
+        WRITE (per-position-per-head absmax, models/gpt.py::quantize_kv)
+        as they scatter into the int8 pool, scales landing in the scale
+        arrays at the same page coordinates.  Quantization error only
+        ever enters on later reads."""
         jax, jnp = self._jax, self._jnp
         cfg = self.cfg
         ps = self._page_size
         pr = s // ps
         cap = self.capture_logits
+        kvq = self._kv_quant
 
-        def prefill(params, cache_k, cache_v, tokens, lens, ptab):
-            fresh = gpt.init_cache(cfg, b, s, dtype=cache_k.dtype)
+        def prefill(params, *args):
+            if kvq:
+                cache_k, k_scale, cache_v, v_scale = args[:4]
+                tokens, lens, ptab = args[4:]
+                fresh = gpt.init_cache(cfg, b, s,
+                                       dtype=jnp.dtype(cfg.dtype))
+            else:
+                cache_k, cache_v = args[:2]
+                tokens, lens, ptab = args[2:]
+                fresh = gpt.init_cache(cfg, b, s, dtype=cache_k.dtype)
             logits, filled = gpt.forward_cached(params, tokens, cfg, fresh)
             L = cfg.num_layers
             nh, hd = cfg.num_heads, cfg.head_dim
             flat = ptab.reshape(-1)
             fk = filled["k"].reshape(L, b * pr, ps, nh, hd)
             fv = filled["v"].reshape(L, b * pr, ps, nh, hd)
-            cache_k = cache_k.at[:, flat].set(fk)
-            cache_v = cache_v.at[:, flat].set(fv)
+            if kvq:
+                fkq, fks = gpt.quantize_kv(fk)
+                fvq, fvs = gpt.quantize_kv(fv)
+                cache_k = cache_k.at[:, flat].set(fkq)
+                k_scale = k_scale.at[:, flat].set(fks)
+                cache_v = cache_v.at[:, flat].set(fvq)
+                v_scale = v_scale.at[:, flat].set(fvs)
+                out_cache = (cache_k, k_scale, cache_v, v_scale)
+            else:
+                cache_k = cache_k.at[:, flat].set(fk)
+                cache_v = cache_v.at[:, flat].set(fv)
+                out_cache = (cache_k, cache_v)
             idx = jnp.clip(lens - 1, 0, s - 1)
             last = jnp.take_along_axis(
                 logits, idx[:, None, None], axis=1)[:, 0]      # [b, V]
             first_tok = jnp.argmax(last, -1).astype(jnp.int32)
             if cap:
-                return cache_k, cache_v, first_tok, last
-            return cache_k, cache_v, first_tok
+                return (*out_cache, first_tok, last)
+            return (*out_cache, first_tok)
 
-        donate = (1, 2) if _donation_enabled() else ()
+        n = self._n_cache
+        donate = tuple(range(1, 1 + n)) if _donation_enabled() else ()
         return self._jax.jit(prefill, donate_argnums=donate)
 
     # ------------------------------------------------------ chunked prefill
@@ -1148,16 +1284,15 @@ class PagedServingEngine(ServingEngine):
         t0 = time.perf_counter()
         with timeline.span("serving.prefill_chunk", pos=pos, take=take):
             out = self._chunk_jit(
-                self.params, self._cache_k, self._cache_v,
+                self.params, *self._cache_operands(),
                 jnp.asarray(toks), jnp.asarray(self._tables_np[s]),
                 np.int32(pos), np.int32(take))
-        if self.capture_logits:
-            self._cache_k, self._cache_v, tok, last_row = out
-            row_np = np.asarray(last_row)
-        else:
-            self._cache_k, self._cache_v, tok = out
-            row_np = None
+        self._set_cache(out[:self._n_cache])
+        tok = out[self._n_cache]
+        row_np = (np.asarray(out[self._n_cache + 1])
+                  if self.capture_logits else None)
         self._inc("prefill_chunks")
+        self._count_quant_matmuls()
         req._chunk_pos = pos + take
         # the prefill histogram records the WHOLE admission's work, so
         # accumulate per-chunk durations and observe once at the end
@@ -1183,22 +1318,33 @@ class PagedServingEngine(ServingEngine):
     def _build_chunk(self, C):
         """ONE executable serves every chunk of every long prompt: the
         absolute position offset and the chunk's true token count are
-        traced scalars, so chunk index never changes the signature."""
+        traced scalars, so chunk index never changes the signature.
+        int8 pools route through ``gpt.forward_paged_chunk_quant``
+        (dequantized gather view in, quantized chunk-only scatter
+        out)."""
         jax, jnp = self._jax, self._jnp
         cfg = self.cfg
         cap = self.capture_logits
+        kvq = self._kv_quant
 
-        def chunk(params, cache_k, cache_v, toks, ptab_row, offset, tlen):
-            logits, cache_k, cache_v = gpt.forward_paged_chunk(
-                params, toks, cfg, cache_k, cache_v, ptab_row, offset)
+        def chunk(params, *args):
+            if kvq:
+                cache, (toks, ptab_row, offset, tlen) = args[:4], args[4:]
+                logits, *cache = gpt.forward_paged_chunk_quant(
+                    params, toks, cfg, *cache, ptab_row, offset)
+            else:
+                cache, (toks, ptab_row, offset, tlen) = args[:2], args[2:]
+                logits, *cache = gpt.forward_paged_chunk(
+                    params, toks, cfg, *cache, ptab_row, offset)
             last = jax.lax.dynamic_index_in_dim(logits[0], tlen - 1, 0,
                                                 keepdims=False)    # [V]
             tok = jnp.argmax(last, -1).astype(jnp.int32)
             if cap:
-                return cache_k, cache_v, tok, last
-            return cache_k, cache_v, tok
+                return (*cache, tok, last)
+            return (*cache, tok)
 
-        donate = (1, 2) if _donation_enabled() else ()
+        donate = (tuple(range(1, 1 + self._n_cache))
+                  if _donation_enabled() else ())
         return jax.jit(chunk, donate_argnums=donate)
 
     # ----------------------------------------------------- page lifecycle
@@ -1212,21 +1358,24 @@ class PagedServingEngine(ServingEngine):
     def _copy_page(self, src, dst):
         """Device-side copy-on-write: duplicate page ``src`` into the
         freshly-owned ``dst`` before the diverging write lands.  One
-        jitted donated executable, compiled once (warmup primes it)."""
+        jitted donated executable, compiled once (warmup primes it).
+        On the int8 pool the page's scale rows travel WITH its bytes —
+        an int8 page without its scales is garbage."""
         if self._copy_jit is None:
             self._copy_jit = self._build_copy()
-        self._cache_k, self._cache_v = self._copy_jit(
-            self._cache_k, self._cache_v, np.int32(src), np.int32(dst))
+        self._set_cache(self._copy_jit(
+            *self._cache_operands(), np.int32(src), np.int32(dst)))
         self._inc("cow_copies")
 
     def _build_copy(self):
         jax = self._jax
 
-        def cp(k, v, src, dst):
-            return (k.at[:, dst].set(k[:, src]),
-                    v.at[:, dst].set(v[:, src]))
+        def cp(*args):
+            arrs, (src, dst) = args[:-2], args[-2:]
+            return tuple(a.at[:, dst].set(a[:, src]) for a in arrs)
 
-        donate = (0, 1) if _donation_enabled() else ()
+        donate = (tuple(range(self._n_cache))
+                  if _donation_enabled() else ())
         return jax.jit(cp, donate_argnums=donate)
 
     def _newest_victim(self):
@@ -1326,17 +1475,16 @@ class PagedServingEngine(ServingEngine):
         with timeline.span("serving.decode_step",
                            active=int(self._active.sum()), paged=True):
             out = self._decode_jit(
-                self.params, self._cache_k, self._cache_v,
+                self.params, *self._cache_operands(),
                 jnp.asarray(self._tables_np), jnp.asarray(wpages),
                 jnp.asarray(woffs), jnp.asarray(self._lens),
                 jnp.asarray(self._last_tok))
-        if self.capture_logits:
-            self._cache_k, self._cache_v, nxt, logits = out
-            logits_np = np.asarray(logits)
-        else:
-            self._cache_k, self._cache_v, nxt = out
-            logits_np = None
+        self._set_cache(out[:self._n_cache])
+        nxt = out[self._n_cache]
+        logits_np = (np.asarray(out[self._n_cache + 1])
+                     if self.capture_logits else None)
         self._inc("decode_steps")
+        self._count_quant_matmuls()
         nxt_np = np.asarray(nxt)
         for s in range(self.slots):
             if not self._active[s]:
@@ -1367,18 +1515,23 @@ class PagedServingEngine(ServingEngine):
         jax, jnp = self._jax, self._jnp
         cfg = self.cfg
         cap = self.capture_logits
+        kvq = self._kv_quant
 
-        def decode(params, cache_k, cache_v, page_table, wpages, woffs,
-                   lens, toks):
-            logits, cache_k, cache_v = gpt.decode_step_paged(
-                params, toks, cfg, cache_k, cache_v, page_table,
-                wpages, woffs, lens)
+        def decode(params, *args):
+            n = 4 if kvq else 2
+            cache = args[:n]
+            page_table, wpages, woffs, lens, toks = args[n:]
+            step = (gpt.decode_step_paged_quant if kvq
+                    else gpt.decode_step_paged)
+            logits, *cache = step(params, toks, cfg, *cache, page_table,
+                                  wpages, woffs, lens)
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             if cap:
-                return cache_k, cache_v, nxt, logits
-            return cache_k, cache_v, nxt
+                return (*cache, nxt, logits)
+            return (*cache, nxt)
 
-        donate = (1, 2) if _donation_enabled() else ()
+        donate = (tuple(range(1, 1 + self._n_cache))
+                  if _donation_enabled() else ())
         return jax.jit(decode, donate_argnums=donate)
 
     # -------------------------------------------------------------- warmup
@@ -1411,8 +1564,8 @@ class PagedServingEngine(ServingEngine):
             if self._copy_jit is None:
                 self._copy_jit = self._build_copy()
             # scratch-onto-scratch: a no-op copy that only compiles
-            self._cache_k, self._cache_v = self._copy_jit(
-                self._cache_k, self._cache_v, np.int32(0), np.int32(0))
+            self._set_cache(self._copy_jit(
+                *self._cache_operands(), np.int32(0), np.int32(0)))
             if (self._prefill_chunk is not None
                     and self._prefill_chunk + 2 <= self.max_len):
                 n = self._prefill_chunk + 1      # two chunks: full + tail
@@ -1429,9 +1582,15 @@ class PagedServingEngine(ServingEngine):
         """Paged accounting: reserved = pages actually referenced (the
         whole point — idle capacity costs nothing); ``page_utilization``
         is tokens held per in-use page position and can exceed 1.0 when
-        prefix sharing packs several requests onto one physical page."""
+        prefix sharing packs several requests onto one physical page.
+
+        Bytes derive from the ACTUAL cache arrays (``nbytes``), never an
+        assumed 4-byte element — an int8 pool's pages cost 1 byte per
+        element PLUS their per-position-per-head scale rows, and both
+        halves of that pair count (a page without its scales is not a
+        page)."""
         ps = self._page_size
-        total = int(self._cache_k.nbytes + self._cache_v.nbytes)
+        total = sum(int(a.nbytes) for a in self._cache_operands())
         page_bytes = total // self._num_pages
         in_use = self._pager.pages_in_use()
         held = int(self._lens.sum()) + sum(

@@ -128,6 +128,90 @@ def init_params(cfg: GPTConfig, key):
     }
 
 
+QUANT_MODES = ("int8", "int8_dynamic", "fp8")
+
+
+def quantize_params(params, quant="int8"):
+    """Weight-only storage quantization of the serving param pytree
+    (ISSUE 9).  The four block matmul weights (qkv_w, proj_w, fc1_w,
+    fc2_w — the overwhelming share of the bytes) become
+    ``{"qw": int8/fp8 [L, K, ...out], "scale": fp32 [L, 1, ...out]}``
+    dict leaves: per-OUTPUT-channel absmax scales over the contraction
+    axis (``quantization.quant_absmax_scale``), which
+    :func:`block_apply` routes through the fused dequant matmul.
+    Embeddings, biases, layernorms and the tied lm head stay in master
+    precision — they're a sliver of the bytes and dominate the accuracy
+    budget.  Modes:
+
+    * ``"int8"`` — weight-only: int8 storage, dequant fused into the
+      matmul tile loop (ops/pallas/dequant_matmul.py; lax fallback off
+      TPU).  The AWQ-shaped serving recipe.
+    * ``"int8_dynamic"`` — W8A8: int8 storage AND activations
+      dynamically quantized per-ROW in-graph (batch-invariant, so
+      retries stay deterministic), through
+      ``quantization.int8_matmul``'s int8xint8 MXU core.  More
+      throughput on int8-rich TPUs, looser accuracy.
+    * ``"fp8"`` — float8_e4m3 storage where this jax exposes it
+      (framework/jax_compat.py::fp8_dtype), dequant-fused via the lax
+      path.
+
+    The quantized tree scans exactly like the fp tree (every dict leaf
+    keeps the leading [L] axis), so every cached/paged forward variant
+    below is quant-aware for free."""
+    if quant not in QUANT_MODES:
+        raise ValueError(
+            f"unknown quant mode {quant!r}; expected one of {QUANT_MODES}")
+    from .. import quantization as Q
+    fp8 = None
+    if quant == "fp8":
+        from ..framework import jax_compat
+        fp8 = jax_compat.fp8_dtype()
+        if fp8 is None:
+            raise ValueError(
+                "quant='fp8': this jax exposes no float8_e4m3 dtype — "
+                "use quant='int8'")
+    key = "qw_dyn" if quant == "int8_dynamic" else "qw"
+    blocks = dict(params["blocks"])
+    for name in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
+        w = jnp.asarray(blocks[name], jnp.float32)
+        if fp8 is not None:
+            # e4m3 max-normal is 448; absmax scaling keeps the cast
+            # from saturating
+            s = jnp.maximum(
+                jnp.max(jnp.abs(w), axis=1, keepdims=True) / 448.0, 1e-8)
+            qw = (w / s).astype(fp8)
+        else:
+            keep = tuple(i for i in range(w.ndim) if i != 1)
+            s = jnp.expand_dims(Q.quant_absmax_scale(w, axis=keep), 1)
+            qw = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+        blocks[name] = {key: qw, "scale": s.astype(jnp.float32)}
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
+def _is_qweight(w):
+    return isinstance(w, dict)
+
+
+def _q_matmul(x, w, cd):
+    """x [..., K] through a quantized weight dict (per-layer view of
+    :func:`quantize_params`' leaves, L axis stripped by the scan).
+    Returns [..., *out] in ``cd``."""
+    qw = w["qw_dyn"] if "qw_dyn" in w else w["qw"]
+    out_shape = qw.shape[1:]
+    x2 = x.reshape(-1, qw.shape[0])
+    w2 = qw.reshape(qw.shape[0], -1)
+    s2 = w["scale"].reshape(1, -1)
+    if "qw_dyn" in w:
+        from ..quantization import int8_dynamic_matmul
+        y = int8_dynamic_matmul(x2, w2, s2)
+    else:
+        from ..ops.pallas.dequant_matmul import dequant_matmul
+        y = dequant_matmul(x2, w2, s2)
+    return y.reshape(*x.shape[:-1], *out_shape).astype(cd)
+
+
 def _layer_norm(x, g, b, eps):
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)
@@ -167,7 +251,10 @@ def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
 
     ln = _pallas_layer_norm if cfg.use_pallas_norm else _layer_norm
     h = ln(x, blk["ln1_g"], blk["ln1_b"], cfg.layer_norm_eps)
-    qkv = jnp.einsum("bnh,hcd->bncd", h, blk["qkv_w"].astype(cd))
+    if _is_qweight(blk["qkv_w"]):
+        qkv = _q_matmul(h, blk["qkv_w"], cd)
+    else:
+        qkv = jnp.einsum("bnh,hcd->bncd", h, blk["qkv_w"].astype(cd))
     qkv = qkv + blk["qkv_b"].astype(cd)
     q, k, v = [qkv[:, :, i].reshape(B, N, nh, hd) for i in range(3)]
     if attn_fn is None:
@@ -175,11 +262,20 @@ def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
     else:
         a, aux = attn_fn(q, k, v)
     a = a.reshape(B, N, -1)
-    a = a @ blk["proj_w"].astype(cd) + blk["proj_b"].astype(cd)
+    if _is_qweight(blk["proj_w"]):
+        a = _q_matmul(a, blk["proj_w"], cd) + blk["proj_b"].astype(cd)
+    else:
+        a = a @ blk["proj_w"].astype(cd) + blk["proj_b"].astype(cd)
     x = x + a
 
     h = ln(x, blk["ln2_g"], blk["ln2_b"], cfg.layer_norm_eps)
-    if cfg.use_fused_ffn:
+    if _is_qweight(blk["fc1_w"]):
+        # quantized FFN goes through the fused dequant matmul — the
+        # fused_ffn kernel only knows float weights
+        h = jax.nn.gelu(_q_matmul(h, blk["fc1_w"], cd)
+                        + blk["fc1_b"].astype(cd), approximate=True)
+        h = _q_matmul(h, blk["fc2_w"], cd) + blk["fc2_b"].astype(cd)
+    elif cfg.use_fused_ffn:
         from ..ops.pallas.fused_ffn import fused_ffn
         h = fused_ffn(h, blk["fc1_w"].astype(cd), blk["fc1_b"].astype(cd),
                       blk["fc2_w"].astype(cd), blk["fc2_b"].astype(cd))
@@ -572,6 +668,153 @@ def forward_paged_chunk(params, tokens, cfg: GPTConfig, cache_k, cache_v,
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
     logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
     return logits, ks, vs
+
+
+# --------------------------------------------------------------------------
+# quantized paged KV (ISSUE 9): int8 pool + per-position-per-head scales
+# --------------------------------------------------------------------------
+#
+# The fp paged pool above stores K/V in the compute dtype (4 bytes on
+# the CPU bench path, 2 on TPU bf16).  The quantized pool stores them
+# int8 with an fp32 absmax scale PER (page, position, head) — position
+# granularity because pages are written position-at-a-time (decode
+# appends, chunked prefill): a page-granular scale would need the whole
+# page requantized on every append, and requantizing from already-
+# quantized content drifts.  Each position's scale is written exactly
+# once, together with its K/V bytes, and never touched again — which
+# also keeps shared prefix pages byte-deterministic (same prompt, same
+# params => same int8 bytes + scales), the property the pager's content
+# hash relies on.  Reads dequantize: the Pallas paged-attention kernel
+# does it inside the DMA'd block (ops/pallas/paged_attn.py), the lax
+# fallback on the gathered view.
+
+
+def quantize_kv(x):
+    """Per-position-per-head absmax int8: x [..., nh, hd] float ->
+    (q int8 same shape, scale fp32 [..., nh])."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, s, dtype):
+    """Inverse of :func:`quantize_kv` (up to rounding)."""
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
+
+
+def init_paged_cache_quant(cfg: GPTConfig, num_pages, page_size):
+    """int8 paged KV pool + scale arrays: {'k','v': int8
+    [L, P, ps, nh, hd], 'k_scale','v_scale': fp32 [L, P, ps, nh]}.
+    Page 0 stays the scratch page."""
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
+
+
+def _paged_slot_block_quant(cfg, x, blk, k_pages, k_scale, v_pages,
+                            v_scale, page_table, write_pages, write_offs,
+                            lens):
+    """:func:`_paged_slot_block` over the int8 pool: each slot's new K/V
+    quantize on write — int8 bytes into (write_pages[s], write_offs[s]),
+    the absmax scale into the scale arrays at the same coordinate — and
+    attention dequantizes on read through
+    ops/pallas/paged_attn.py::paged_attention_quant."""
+    from ..ops.pallas.paged_attn import paged_attention_quant
+
+    def pattn(q, k, v):
+        kq, ks = quantize_kv(k[:, 0])        # [S, nh, hd] -> int8, [S, nh]
+        vq, vs = quantize_kv(v[:, 0])
+        kc = k_pages.at[write_pages, write_offs].set(kq)
+        ksc = k_scale.at[write_pages, write_offs].set(ks)
+        vc = v_pages.at[write_pages, write_offs].set(vq)
+        vsc = v_scale.at[write_pages, write_offs].set(vs)
+        a = paged_attention_quant(q, kc, ksc, vc, vsc, page_table, lens)
+        return a, (kc, ksc, vc, vsc)
+
+    x, (k_pages, k_scale, v_pages, v_scale) = block_apply(
+        cfg, x, blk, attn_fn=pattn)
+    return x, k_pages, k_scale, v_pages, v_scale
+
+
+def decode_step_paged_quant(params, tokens, cfg: GPTConfig, cache_k,
+                            k_scale, cache_v, v_scale, page_table,
+                            write_pages, write_offs, lens):
+    """One decode iteration for every slot through the INT8 paged pool
+    (same contract as :func:`decode_step_paged`; the scale arrays ride
+    along as donated operands).  Returns
+    (logits [S, V] fp32, k, k_scale, v, v_scale)."""
+    x = jnp.take(params["wte"], tokens, axis=0) \
+        + jnp.take(params["wpe"], lens, axis=0)
+    x = x[:, None, :].astype(jnp.dtype(cfg.dtype))        # [S, 1, H]
+
+    def scan_body(carry, layer):
+        blk, kp, ksp, vp, vsp = layer
+        xx, kp, ksp, vp, vsp = _paged_slot_block_quant(
+            cfg, carry, blk, kp, ksp, vp, vsp, page_table, write_pages,
+            write_offs, lens)
+        return xx, (kp, ksp, vp, vsp)
+
+    x, (ks, kss, vs, vss) = jax.lax.scan(
+        scan_body, x,
+        (params["blocks"], cache_k, k_scale, cache_v, v_scale))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits[:, 0], ks, kss, vs, vss
+
+
+def forward_paged_chunk_quant(params, tokens, cfg: GPTConfig, cache_k,
+                              k_scale, cache_v, v_scale, pt_row, offset):
+    """:func:`forward_paged_chunk` over the int8 pool: the slot's
+    already-filled pages are dequantized into the fp gathered view, the
+    chunk runs the exact ``_cached_block`` math over it, then ONLY the
+    chunk's own positions — static width C, page-aligned because the
+    engine enforces ``prefill_chunk % page_size == 0`` and chunk offsets
+    are C-multiples — are quantized and scattered back.  Earlier
+    positions never round-trip through requantization, so their bytes
+    (and the pager's content-hash contract) stay exact.  The final
+    chunk's padded tail positions land on the table's scratch-padded
+    page ids like every other pad."""
+    maxP = pt_row.shape[0]
+    ps = cache_k.shape[2]
+    C = tokens.shape[1]
+    cpages = C // ps
+    cd = jnp.dtype(cfg.dtype)
+    x = embed(cfg, params, tokens, pos_offset=offset)
+    j0 = offset // ps
+
+    def scan_body(carry, layer):
+        xx = carry
+        blk, kp, ksp, vp, vsp = layer
+        tail = kp.shape[2:]                       # (nh, hd)
+        view_k = dequantize_kv(kp[pt_row], ksp[pt_row], cd).reshape(
+            1, maxP * ps, *tail)
+        view_v = dequantize_kv(vp[pt_row], vsp[pt_row], cd).reshape(
+            1, maxP * ps, *tail)
+        xx, view_k, view_v = _cached_block(cfg, xx, blk, view_k, view_v,
+                                           offset)
+        ck = jax.lax.dynamic_slice(view_k[0], (offset, 0, 0),
+                                   (C,) + tuple(tail))
+        cv = jax.lax.dynamic_slice(view_v[0], (offset, 0, 0),
+                                   (C,) + tuple(tail))
+        ckq, cks = quantize_kv(ck)                # [C, nh, hd], [C, nh]
+        cvq, cvs = quantize_kv(cv)
+        pages = jax.lax.dynamic_slice(pt_row, (j0,), (cpages,))
+        kp = kp.at[pages].set(ckq.reshape(cpages, ps, *tail))
+        ksp = ksp.at[pages].set(cks.reshape(cpages, ps, tail[0]))
+        vp = vp.at[pages].set(cvq.reshape(cpages, ps, *tail))
+        vsp = vsp.at[pages].set(cvs.reshape(cpages, ps, tail[0]))
+        return xx, (kp, ksp, vp, vsp)
+
+    x, (ks, kss, vs, vss) = jax.lax.scan(
+        scan_body, x,
+        (params["blocks"], cache_k, k_scale, cache_v, v_scale))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, ks, kss, vs, vss
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig):

@@ -25,7 +25,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from .utils import HAS_PALLAS, pallas_enabled
+from .utils import HAS_PALLAS, count_dequant_kernel, pallas_enabled
 
 if HAS_PALLAS:
     from jax.experimental import pallas as pl
@@ -171,3 +171,143 @@ def paged_attention(q, k_pages, v_pages, page_table, lens):
     if _use_pallas_paged(q, k_pages):
         return _paged_attention_tpu(q, k_pages, v_pages, page_table, lens)
     return _ref_paged_attention(q, k_pages, v_pages, page_table, lens)
+
+
+# --------------------------------------------------------------------------
+# quantized pages (ISSUE 9): int8 K/V + per-position-per-head scales
+# --------------------------------------------------------------------------
+
+def _ref_paged_attention_quant(q, k_pages, k_scale, v_pages, v_scale,
+                               page_table, lens):
+    """Lax fallback over the int8 pool: dequantize
+    (``q_int8 * scale`` per position per head, staying fp32 like the fp
+    path's score math) and delegate to :func:`_ref_paged_attention` —
+    ONE copy of the gather/mask/softmax semantics to keep in sync.
+    k/v_pages: [P, ps, nh, hd] int8; k/v_scale: [P, ps, nh] fp32."""
+    return _ref_paged_attention(
+        q, k_pages.astype(jnp.float32) * k_scale[..., None],
+        v_pages.astype(jnp.float32) * v_scale[..., None],
+        page_table, lens)
+
+
+def _paged_decode_kernel_quant(pt_ref, lens_ref, q_ref, k_ref, ks_ref,
+                               v_ref, vs_ref, o_ref, m_scr, l_scr,
+                               acc_scr, *, page_size, max_pages):
+    """The quantized twin of :func:`_paged_decode_kernel`: the DMA'd
+    block is the int8 page plus its [ps, nh] scale row, and the dequant
+    (``int8 -> fp32 * scale``) happens here in VMEM — HBM traffic per
+    page is 1 byte/element plus the scale row instead of 2-4
+    bytes/element."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ln = lens_ref[s]
+
+    @pl.when(j * page_size <= ln)
+    def _body():
+        q = q_ref[:].astype(jnp.float32)                 # [nh, hd]
+        k = k_ref[:].astype(jnp.float32) * ks_ref[:][..., None]
+        # the fallback casts the dequantized V to the compute dtype
+        # before the probs @ V contraction (the fp path's vc.astype(cd))
+        # — mirror it, or bf16 engines decode differently on TPU vs the
+        # lax path
+        v = (v_ref[:].astype(jnp.float32)
+             * vs_ref[:][..., None]).astype(o_ref.dtype)
+        hd = q.shape[-1]
+        scr = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) / math.sqrt(hd)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scr.shape, 1)
+        scr = jnp.where(pos <= ln, scr, NEG_INF)
+
+        m_prev = m_scr[:]                                # [nh, 128]
+        m_cur = jnp.max(scr, axis=1, keepdims=True)      # [nh, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(scr - m_new[:, :1])                  # [nh, ps]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[:] = (acc_scr[:]
+                    / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_attention_quant_tpu(q, k_pages, k_scale, v_pages, v_scale,
+                               page_table, lens, interpret=False):
+    """Quantized-pool Pallas path: same scalar-prefetched page-table
+    indexing as :func:`_paged_attention_tpu`, with the scale rows riding
+    their own page-indexed BlockSpecs so each grid step DMAs exactly one
+    (int8 page, scale row) pair."""
+    S, T, nh, hd = q.shape
+    assert T == 1, "paged decode kernel is single-token"
+    ps = k_pages.shape[1]
+    maxP = page_table.shape[1]
+    qs = q[:, 0]                                         # [S, nh, hd]
+    pt_flat = page_table.reshape(-1).astype(jnp.int32)
+    lens32 = lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, maxP),
+        in_specs=[
+            pl.BlockSpec((None, nh, hd),
+                         lambda s, j, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((None, ps, nh, hd),
+                         lambda s, j, pt, ln: (pt[s * maxP + j], 0, 0, 0)),
+            pl.BlockSpec((None, ps, nh),
+                         lambda s, j, pt, ln: (pt[s * maxP + j], 0, 0)),
+            pl.BlockSpec((None, ps, nh, hd),
+                         lambda s, j, pt, ln: (pt[s * maxP + j], 0, 0, 0)),
+            pl.BlockSpec((None, ps, nh),
+                         lambda s, j, pt, ln: (pt[s * maxP + j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, nh, hd),
+                               lambda s, j, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel_quant, page_size=ps,
+                          max_pages=maxP),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nh, hd), q.dtype),
+        interpret=interpret,
+    )(pt_flat, lens32, qs, k_pages, k_scale, v_pages, v_scale)
+    return out[:, None]
+
+
+def paged_attention_quant(q, k_pages, k_scale, v_pages, v_scale,
+                          page_table, lens):
+    """Decode attention through a page table over the INT8 pool:
+    k/v_pages [P, ps, nh, hd] int8 with per-position-per-head fp32
+    scales [P, ps, nh]; dequant happens on read (in-kernel on TPU).
+    Same shapes/contract as :func:`paged_attention` otherwise.
+
+    The kernel gate adds int8's stricter sublane minimum on top of the
+    fp gate: ``page_size % 32 == 0``.  Smaller pages (including the
+    engine's default 16) take the lax fallback, which gathers a
+    dequantized fp view per layer — pick ``page_size >= 32`` when
+    running ``kv_dtype="int8"`` on a real TPU."""
+    if (_use_pallas_paged(q, k_pages)
+            and k_pages.shape[1] % 32 == 0):   # int8 sublane minimum
+        count_dequant_kernel("paged_attn")
+        return _paged_attention_quant_tpu(q, k_pages, k_scale, v_pages,
+                                          v_scale, page_table, lens)
+    return _ref_paged_attention_quant(q, k_pages, k_scale, v_pages,
+                                      v_scale, page_table, lens)

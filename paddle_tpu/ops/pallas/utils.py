@@ -28,3 +28,20 @@ def pallas_enabled():
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "") not in ("", "0"):
         return False
     return HAS_PALLAS and on_tpu()
+
+
+def count_dequant_kernel(kernel):
+    """Trace-time engagement counter for the quantized-serving kernels
+    (ISSUE 9): bumps the aggregate ``serving.dequant_kernel_calls``
+    family cell AND a per-kernel series
+    (``serving.dequant_kernel_calls_<kernel>``), so "the dequant GEMM
+    engaged but quantized paged attention fell back" stays visible.
+    Fires once per kernel per compiled executable — it answers "did the
+    Pallas path engage in what XLA built?", not "how many steps ran".
+    Telemetry must never break a trace, so failures are swallowed."""
+    try:
+        from ...observability import metrics
+        metrics.counter("serving.dequant_kernel_calls").inc()
+        metrics.counter(f"serving.dequant_kernel_calls_{kernel}").inc()
+    except Exception:                                  # noqa: BLE001
+        pass

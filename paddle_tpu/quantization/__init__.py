@@ -30,8 +30,8 @@ from ..ops.dispatch import call
 from .. import nn
 
 __all__ = ["fake_quantize", "quant_absmax_scale", "int8_matmul",
-           "QuantConfig", "QAT", "PostTrainingQuantization",
-           "QuantedLinear"]
+           "int8_dynamic_matmul", "QuantConfig", "QAT",
+           "PostTrainingQuantization", "QuantedLinear"]
 
 
 # --------------------------------------------------------------------------
@@ -40,13 +40,17 @@ __all__ = ["fake_quantize", "quant_absmax_scale", "int8_matmul",
 
 def quant_absmax_scale(x, axis=None, bits=8):
     """absmax scale so x/scale fits [-qmax, qmax] (per-tensor, or
-    per-channel when axis given)."""
+    per-channel when axis given — an int keeps that axis, a tuple keeps
+    several, e.g. the per-output-channel scales of a stacked [L, K, ...]
+    weight keep every axis but the contraction)."""
     v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
     qmax = 2.0 ** (bits - 1) - 1
     if axis is None:
         s = jnp.max(jnp.abs(v)) / qmax
     else:
-        red = tuple(i for i in range(v.ndim) if i != axis)
+        keep = ((axis,) if isinstance(axis, int) else tuple(axis))
+        keep = tuple(a % v.ndim for a in keep)
+        red = tuple(i for i in range(v.ndim) if i not in keep)
         s = jnp.max(jnp.abs(v), axis=red, keepdims=False) / qmax
     return jnp.maximum(s, 1e-8)
 
@@ -82,17 +86,42 @@ def fake_quantize(x, scale, bits=8, name=None):
                 _name="fake_quantize")
 
 
+def _int8_mm_core(xv, wv, xs, ws):
+    """The MXU int8 GEMM at the heart of :func:`int8_matmul`: quantize x
+    with scale ``xs``, ``lax.dot_general(int8, int8) -> int32``, rescale
+    to float.  Pure jax (no Tensor/tape) so the serving executables call
+    it directly inside jit (:func:`int8_dynamic_matmul`) — one code path
+    for the calibrated eager layer and the serving hot loop."""
+    xq = jnp.clip(jnp.round(xv / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wv, (((xv.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (xs * ws)
+
+
 def int8_matmul(x, w_int8, x_scale, w_scale, name=None):
     """Real int8 GEMM: quantize x per-tensor, int8xint8->int32 on the MXU,
     rescale to float.  w_int8: [in, out] int8; w_scale: [out] or scalar."""
-    def _mm(xv, wv, xs, ws):
-        xq = jnp.clip(jnp.round(xv / xs), -127, 127).astype(jnp.int8)
-        acc = jax.lax.dot_general(
-            xq, wv, (((xv.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        return acc.astype(jnp.float32) * (xs * ws)
+    return call(_int8_mm_core, x, w_int8, x_scale, w_scale,
+                _name="int8_matmul")
 
-    return call(_mm, x, w_int8, x_scale, w_scale, _name="int8_matmul")
+
+def int8_dynamic_matmul(x, w_int8, w_scale):
+    """W8A8 matmul for the quantized serving path (``quant=
+    "int8_dynamic"``): the activation scale is computed IN-GRAPH per
+    call (no calibration pass exists at serving time), then the same
+    int8xint8 MXU core as :func:`int8_matmul`.  x: [..., in] float;
+    w_int8: [in, out]; w_scale: [out]-broadcastable.  Returns fp32.
+
+    The dynamic scale is PER-ROW absmax, not per-tensor: each row of a
+    serving batch belongs to a different request (or a pad row), and a
+    whole-tensor scale would make one request's logits depend on its
+    batchmates — breaking the engine/fleet token-exact retry guarantee
+    the moment a retry lands in a different batch mix.  Per-row scales
+    are batch-invariant (and tighter)."""
+    xs = jnp.maximum(jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+                     / 127.0, 1e-8)
+    return _int8_mm_core(x, w_int8, xs, w_scale)
 
 
 # --------------------------------------------------------------------------
