@@ -1139,6 +1139,197 @@ def serving_bench():
 
 
 # --------------------------------------------------------------------------
+# child: --model-parallel  (composed TP+PP+ZeRO train step on the mesh)
+# --------------------------------------------------------------------------
+
+def model_parallel_bench():
+    """Model-parallel scale-out (ISSUE 10): the composed GSPMD TP + 1F1B
+    PP + ZeRO train step (paddle_tpu.distributed.auto) on a dp×tp×pp
+    mesh (default 2x2x2 over 8 devices; ``--cpu-mesh 8`` forces the
+    host-platform mesh so this emits real numbers with the TPU tunnel
+    dead).  Three asserted phases:
+
+      parity    a FITTING config (gpt_tiny) trains BENCH_MP_STEPS steps
+                on the mesh (zero_stage=2, microbatched pipeline) and
+                against a jitted single-device reference with identical
+                AdamW/clip semantics; per-step |loss diff| must stay
+                within BENCH_MP_PARITY (default 1e-5).
+      scale     a config whose REPLICATED params+Adam moments exceed the
+                simulated per-device budget (BENCH_MP_DEVICE_BUDGET_MB,
+                default 8) trains on the mesh; the per-device param +
+                optimizer bytes actually pinned (addressable shards)
+                must fit the budget, and the loss must fall.
+      contract  optimizer-state bytes/device shrink >= BENCH_MP_MIN_SHRINK
+                (default 1.9 — the dp=2 ZeRO floor; tp/pp sharding
+                pushes it well past) vs replication, and the sharding.*
+                counters match the step's static collective plan exactly:
+                ONE dp reduce-scatter per param bucket per step, the
+                planned tp psums and pp ppermute handoffs per axis.
+
+    Always prints the parsed JSON metric line
+    (model_parallel_step_time_ms) before enforcing the floors."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import auto
+    from paddle_tpu.models import gpt
+    from paddle_tpu.optimizer.functional import adamw_update
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.observability import timeline as obs_timeline
+    obs_timeline.install_compile_hook()   # count XLA retraces honestly
+
+    steps = int(os.environ.get("BENCH_MP_STEPS", 5))
+    budget_mb = float(os.environ.get("BENCH_MP_DEVICE_BUDGET_MB", 8))
+    parity_tol = float(os.environ.get("BENCH_MP_PARITY", 1e-5))
+    min_shrink = float(os.environ.get("BENCH_MP_MIN_SHRINK", 1.9))
+    dp, tp, pp = (int(x) for x in
+                  os.environ.get("BENCH_MP_MESH", "2x2x2").split("x"))
+    micro = int(os.environ.get("BENCH_MP_MICRO", 2))
+    LR = 1e-3
+    HY = dict(beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.1,
+              clip_norm=1.0)
+    mesh = auto.make_mesh(dp=dp, tp=tp, pp=pp)
+    key = jax.random.PRNGKey(0)
+
+    def batch_for(cfg, seq):
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, seq)),
+                           jnp.int32)
+        return toks, toks
+
+    def mesh_losses(cfg, toks, labels):
+        params, m, v = auto.init_state(cfg, mesh, key, zero_stage=2)
+        step = auto.make_train_step(cfg, mesh, n_microbatch=micro,
+                                    zero_stage=2, **HY)
+        losses, t_first = [], None
+        t0 = time.perf_counter()
+        for t in range(1, steps + 1):
+            params, m, v, loss = step(params, m, v, t, toks, labels, LR)
+            losses.append(float(loss))       # host sync per step
+            if t == 1:
+                t_first = time.perf_counter() - t0
+        dt = ((time.perf_counter() - t0 - t_first) / max(steps - 1, 1)
+              if steps > 1 else t_first)
+        return losses, dt, step.plan
+
+    # ---- phase 1: parity (fitting config vs single-device reference)
+    fit_cfg = gpt.gpt_tiny()
+    toks, labels = batch_for(fit_cfg, 64)
+    mesh_l, _, _ = mesh_losses(fit_cfg, toks, labels)
+
+    from paddle_tpu.models.gpt_hybrid import NO_DECAY as no_decay
+    from paddle_tpu.models.gpt_hybrid import LN_NAMES as ln_names
+
+    def ref_step(params, m, v, t, tk, lb):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt.loss_fn(p, tk, lb, fit_cfg))(params)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                          for g in jax.tree_util.tree_leaves(grads)))
+        scale = jnp.minimum(1.0, HY["clip_norm"] / jnp.maximum(gn, 1e-12))
+        grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+        def upd(path, p, g, mm, vv):
+            leaf = str(getattr(path[-1], "key", path[-1]))
+            decay = leaf not in no_decay and leaf not in ln_names
+            return adamw_update(p, g, mm, vv, LR, t, HY["beta1"],
+                                HY["beta2"], HY["eps"],
+                                HY["weight_decay"], decay)
+        out = jax.tree_util.tree_map_with_path(upd, params, grads, m, v)
+        tup = lambda o: isinstance(o, tuple) and len(o) == 3  # noqa: E731
+        return (jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=tup),
+                jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=tup),
+                jax.tree_util.tree_map(lambda o: o[2], out, is_leaf=tup),
+                loss)
+
+    rp = gpt.init_params(fit_cfg, key)
+    rm = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), rp)
+    rv = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), rp)
+    jref = jax.jit(ref_step)
+    ref_l = []
+    for t in range(1, steps + 1):
+        rp, rm, rv, loss = jref(rp, rm, rv, jnp.float32(t), toks, labels)
+        ref_l.append(float(loss))
+    parity = max(abs(a - b) for a, b in zip(mesh_l, ref_l))
+
+    # ---- phase 2: the config that cannot fit replicated
+    big_cfg = gpt.GPTConfig(
+        vocab_size=int(os.environ.get("BENCH_MP_VOCAB", 1024)),
+        hidden_size=int(os.environ.get("BENCH_MP_HIDDEN", 128)),
+        num_layers=int(os.environ.get("BENCH_MP_LAYERS", 4)),
+        num_heads=8, max_seq_len=128, dtype="float32",
+        use_flash=False, remat=False)
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(
+            jax.eval_shape(lambda k: gpt.init_params(big_cfg, k), key)))
+    replicated_mb = n_params * 4 * 3 / (1 << 20)    # params + m + v fp32
+    assert replicated_mb > budget_mb, (
+        f"scale config too small: replicated params+moments "
+        f"{replicated_mb:.1f}MB must exceed the simulated "
+        f"{budget_mb:.0f}MB device budget")
+
+    auto.reset_sharding_stats()
+    c0 = obs_metrics.counter("compile.count").value
+    big_toks, big_labels = batch_for(big_cfg, 64)
+    big_l, dt, plan = mesh_losses(big_cfg, big_toks, big_labels)
+    compiles = obs_metrics.counter("compile.count").value - c0
+    stats = auto.sharding_stats()
+    per_device_mb = (stats["param_bytes_per_device"]
+                     + stats["opt_state_bytes_per_device"]) / (1 << 20)
+    shrink = stats["opt_state_shrink"]
+    expected = {"dp": plan.dp_collectives * steps,
+                "tp": plan.tp_collectives * steps,
+                "pp": plan.pp_collectives * steps}
+    got = {ax: stats[f"collectives_{ax}"] for ax in ("dp", "tp", "pp")}
+
+    print(json.dumps({
+        "metric": "model_parallel_step_time_ms",
+        "value": round(dt * 1e3, 2),
+        "unit": "ms/step",
+        "mesh": {"dp": dp, "tp": tp, "pp": pp,
+                 "devices": dp * tp * pp},
+        "steps": steps,
+        "n_microbatch": micro,
+        "zero_stage": 2,
+        "parity_max_loss_diff": parity,
+        "loss_first": round(big_l[0], 6),
+        "loss_last": round(big_l[-1], 6),
+        "device_budget_mb": budget_mb,
+        "replicated_state_mb": round(replicated_mb, 2),
+        "per_device_state_mb": round(per_device_mb, 2),
+        "opt_state_shrink": shrink,
+        "bubble_fraction_pct": stats["bubble_fraction_pct"],
+        "collectives": {"expected_per_axis": expected, "counted": got,
+                        "bytes": {ax: stats[f"bytes_{ax}"]
+                                  for ax in ("dp", "tp", "pp")}},
+        "zero_leaves": {"sharded": stats["zero_sharded_leaves"],
+                        "replicated": stats["zero_replicated_leaves"]},
+        "telemetry": {"compiles": compiles},
+    }), flush=True)
+    print(f"# model-parallel: parity={parity:.2e} (tol {parity_tol}) "
+          f"shrink={shrink}x budget={budget_mb}MB "
+          f"replicated={replicated_mb:.1f}MB "
+          f"per_device={per_device_mb:.2f}MB", file=sys.stderr)
+
+    assert parity <= parity_tol, (
+        f"mesh-vs-single-device loss parity {parity:.2e} exceeds "
+        f"{parity_tol}")
+    assert big_l[-1] < big_l[0] and all(np.isfinite(big_l)), (
+        f"scale config failed to train: losses {big_l}")
+    assert per_device_mb <= budget_mb, (
+        f"per-device state {per_device_mb:.2f}MB exceeds the simulated "
+        f"{budget_mb:.0f}MB budget the replicated run failed")
+    assert shrink >= min_shrink, (
+        f"optimizer-state bytes/device shrink {shrink}x is below the "
+        f"{min_shrink}x floor at dp={dp}")
+    for ax in ("dp", "tp", "pp"):
+        assert got[ax] == expected[ax], (
+            f"{ax} collectives {got[ax]} != plan {expected[ax]} — one "
+            "collective per bucket per axis per step is the contract")
+    print("# model-parallel: ok — sharding counters nonzero and "
+          "plan-exact, ZeRO shrink + parity attested", file=sys.stderr)
+
+
+# --------------------------------------------------------------------------
 # child: --faults  (kill-and-recover chaos benchmark)
 # --------------------------------------------------------------------------
 
@@ -1473,13 +1664,19 @@ def orchestrate():
               "dp-overlap benchmark so this round still emits a parsed "
               "metric line.", file=sys.stderr)
         rc, _ = _spawn(["--dp-overlap", "--cpu-mesh", "8"],
-                       max(min(remaining() - 15, 900), 120), capture=False)
-        if rc == 0:
+                       max(min(remaining() - 135, 900), 120),
+                       capture=False)
+        mp_rc = 0
+        if remaining() > 150:
+            mp_rc, _ = _spawn(["--model-parallel", "--cpu-mesh", "8"],
+                              min(120, remaining() - 15), capture=False)
+        if rc == 0 and mp_rc == 0:
             print("# cpu-mesh fallback ok (TPU tunnel still dead — "
                   "flagship MFU numbers unavailable this round)",
                   file=sys.stderr)
             return 0
-        print(f"# cpu-mesh fallback failed (rc={rc})", file=sys.stderr)
+        print(f"# cpu-mesh fallback failed (dp-overlap rc={rc}, "
+              f"model-parallel rc={mp_rc})", file=sys.stderr)
         return 3
     print(f"# probe ok: {probe_info}", file=sys.stderr)
 
@@ -1546,6 +1743,16 @@ def orchestrate():
             print(f"# serving bench failed (rc={src}); continuing to "
                   "the timed run", file=sys.stderr)
 
+    # Phase 2.8: the model-parallel bench on the 8-device host mesh —
+    # deterministic (no tunnel involved), asserts the TP+PP+ZeRO parity,
+    # memory-shrink and collective-plan contracts (ISSUE 10).
+    if remaining() > 780:
+        prc, _ = _spawn(["--model-parallel", "--cpu-mesh", "8"],
+                        min(150, remaining() - 600), capture=False)
+        if prc not in (0,):
+            print(f"# model-parallel bench failed (rc={prc}); continuing "
+                  "to the timed run", file=sys.stderr)
+
     # Phase 3: the timed run, with every remaining second as its budget.
     run_budget = max(remaining() - 15, 60)
     rc, _ = _spawn("--run", run_budget, capture=False)
@@ -1572,7 +1779,7 @@ def _reexec_cpu_mesh():
         n = int(sys.argv[sys.argv.index("--cpu-mesh") + 1])
     except (IndexError, ValueError):
         sys.exit("usage: bench.py [--dp-overlap|--faults|--serving|"
-                 "--fleet] --cpu-mesh N  "
+                 "--fleet|--model-parallel] --cpu-mesh N  "
                  "(N = forced host-platform device count)")
     env = dict(os.environ)
     env["BENCH_CPU_MESH_CHILD"] = "1"
@@ -1607,6 +1814,8 @@ if __name__ == "__main__":
         dp_overlap()
     elif "--serving" in sys.argv:
         serving_bench()
+    elif "--model-parallel" in sys.argv:
+        model_parallel_bench()
     elif "--faults" in sys.argv:
         faults_bench()
     elif "--fleet" in sys.argv:

@@ -10,7 +10,7 @@ from . import fleet
 from .data_parallel import DataParallel, DistributedDataParallel
 from . import reducer
 from .reducer import (Reducer, DeviceMeshAllReduce,  # noqa: F401
-                      EagerProcessTransport)
+                      MeshAxesAllReduce, EagerProcessTransport)
 from . import sharding
 from .ps_compat import (EntryAttr, ProbabilityEntry,  # noqa: F401
                         CountFilterEntry, InMemoryDataset, QueueDataset)
@@ -20,3 +20,15 @@ def launch():
     from .launch import main
     main()
 from . import utils  # noqa: E402
+
+
+def __getattr__(name):
+    # lazy (PEP 562): the model-parallel subsystem pulls the optimizer/
+    # models layers — importing it eagerly here would lengthen (and risk
+    # cycling) the base `import paddle_tpu.distributed`
+    if name == "auto":
+        import importlib
+        mod = importlib.import_module(".auto", __name__)
+        globals()["auto"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

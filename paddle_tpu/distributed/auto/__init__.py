@@ -1,0 +1,40 @@
+"""paddle_tpu.distributed.auto — the model-parallel scale-out subsystem
+(ISSUE 10 tentpole): GSPMD tensor parallelism, 1F1B pipeline stages and
+ZeRO-sharded optimizer states over a multi-axis ``jax.sharding.Mesh``.
+
+Three layers, smallest first:
+
+* :mod:`.rules` — the sharding-rule registry: model family ->
+  PartitionSpec pytree (Megatron column/row splits for gpt/bert, expert
+  sharding for moe), plus placement/validation/byte-accounting
+  utilities.  Models register through a ``sharding_rules`` hook next to
+  their ``init_params``.
+* :mod:`.pipeline` — layer-range stage assignment and the 1F1B
+  microbatch :class:`~.pipeline.Schedule`; ``pipeline_forward`` runs the
+  tick table inside shard_map with ppermute activation handoffs.
+* :mod:`.zero` — ZeRO-1/2: structured-axis moment sharding + grad
+  reduce-scatter for the compiled step, and
+  :func:`~.zero.shard_optimizer_states` placement for the dygraph
+  donated fused step (the fold of the old ``distributed/sharding.py``).
+
+:mod:`.engine` composes them: :func:`~.engine.make_mesh` (axes
+dp/pp/tp), :func:`~.engine.init_state`, and
+:func:`~.engine.make_train_step` — one buffer-donated jitted shard_map
+program per step, with a static per-step collective plan published into
+the ``sharding.*`` registry family (per-axis collective counts/bytes,
+bubble fraction, per-device param/optimizer bytes).
+
+Every mesh/shard_map/NamedSharding access routes through
+``framework/jax_compat.py`` (standing ROADMAP constraint; enforced by
+``tools/shard_map_guard.sh``).
+"""
+from . import rules          # noqa: F401
+from . import pipeline       # noqa: F401
+from . import zero           # noqa: F401
+from . import engine         # noqa: F401
+from .stats import sharding_stats, reset_sharding_stats  # noqa: F401
+from .rules import register_rules, rules_for             # noqa: F401
+from .pipeline import Schedule, StageAssignment          # noqa: F401
+from .zero import shard_optimizer_states                 # noqa: F401
+from .engine import (make_mesh, init_state,              # noqa: F401
+                     make_train_step, make_forward)

@@ -1,0 +1,173 @@
+"""Sharding-rule registry: model family -> PartitionSpec pytrees.
+
+GSPMD (Xu et al.) partitions a single-device program from per-tensor
+sharding annotations; the only model-specific knowledge the partitioner
+needs is WHICH axis of which parameter to split.  This registry is that
+knowledge, centralized: each model family (gpt / bert / moe / ...)
+registers a provider ``fn(cfg) -> spec pytree`` matching its
+``init_params`` structure, and every consumer — the composed train step
+(engine.py), eager placement (zero.py), fleet's legacy
+``distributed_model`` — resolves layouts here instead of hand-writing
+PartitionSpecs per call site.
+
+The built-in rules are the Megatron-LM layouts (Shoeybi et al.): QKV and
+FFN up-projections column-split over 'tp' (attention heads divide across
+ranks), attention output and FFN down-projections row-split (partial
+sums made whole by ONE psum each — the two allreduces/block recipe),
+vocab-parallel embeddings, and the stacked layer axis split over 'pp'.
+All sharding types route through framework/jax_compat.py (standing
+ROADMAP constraint).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.jax_compat import named_sharding, partition_spec as P
+
+_REGISTRY = {}       # family -> fn(cfg) -> spec pytree
+
+
+def register_rules(family, fn=None):
+    """Register ``fn(cfg) -> PartitionSpec pytree`` for ``family``.
+    Usable as a decorator: ``@register_rules("gpt")``.  Re-registration
+    replaces (models re-imported under test harnesses must not error)."""
+    def _do(f):
+        _REGISTRY[family] = f
+        return f
+    return _do if fn is None else _do(fn)
+
+
+def _ensure_builtin(family):
+    """Lazy-load the built-in providers: rules live WITH their model
+    (``models/gpt.py::sharding_rules`` etc.) so layout and init_params
+    can't drift apart; the model module is imported on first resolve and
+    its ``sharding_rules`` hook registered — model files never import
+    this package, so there is no import cycle."""
+    if family in _REGISTRY:
+        return
+    import importlib
+    mod = {"gpt": "paddle_tpu.models.gpt",
+           "bert": "paddle_tpu.models.bert",
+           "moe": "paddle_tpu.parallel.moe"}.get(family)
+    if mod is not None:
+        fn = getattr(importlib.import_module(mod), "sharding_rules", None)
+        if fn is not None:
+            _REGISTRY[family] = fn
+
+
+def rules_for(family, cfg=None):
+    """The registered spec pytree for ``family`` (KeyError with the known
+    families named when unregistered)."""
+    _ensure_builtin(family)
+    fn = _REGISTRY.get(family)
+    if fn is None:
+        raise KeyError(
+            f"no sharding rules registered for {family!r}; known: "
+            f"{sorted(_REGISTRY)}")
+    return fn(cfg)
+
+
+def registered_families():
+    for fam in ("gpt", "bert", "moe"):
+        _ensure_builtin(fam)
+    return sorted(_REGISTRY)
+
+
+# --------------------------------------------------------------------------
+# spec utilities (shared by engine.py / zero.py / legacy placement)
+# --------------------------------------------------------------------------
+
+def spec_axes(spec):
+    """Flat tuple of mesh-axis names a PartitionSpec shards over."""
+    return tuple(a for part in spec if part is not None
+                 for a in ((part,) if isinstance(part, str) else part))
+
+
+def replicated_like(specs):
+    """Same tree shape, every leaf fully replicated."""
+    return jax.tree_util.tree_map(
+        lambda _: P(), specs, is_leaf=_is_spec)
+
+
+def _is_spec(x):
+    from jax.sharding import PartitionSpec
+    return isinstance(x, PartitionSpec)
+
+
+def prune_to_mesh(specs, mesh):
+    """Drop axis names the mesh doesn't carry (or carries at size 1) from
+    every leaf spec, so one rule set serves any dp/tp/pp slice: a tp-only
+    mesh reads the same gpt rules as the full 2x2x2 one."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def keep(name):
+        return sizes.get(name, 1) > 1
+
+    def prune_part(part):
+        if part is None:
+            return None
+        if isinstance(part, str):
+            return part if keep(part) else None
+        kept = tuple(a for a in part if keep(a))
+        return kept if kept else None
+
+    def prune(spec):
+        parts = tuple(prune_part(p) for p in spec)
+        while parts and parts[-1] is None:
+            parts = parts[:-1]
+        return P(*parts)
+
+    return jax.tree_util.tree_map(prune, specs, is_leaf=_is_spec)
+
+
+def shardings(mesh, specs):
+    """Spec pytree -> NamedSharding pytree (through jax_compat)."""
+    return jax.tree_util.tree_map(
+        lambda s: named_sharding(mesh, s), specs, is_leaf=_is_spec)
+
+
+def place(tree, mesh, specs):
+    """device_put every leaf of ``tree`` with its rule's NamedSharding.
+    Leaves whose spec doesn't divide their shape raise — a silent
+    replication here is exactly the round-2 verdict bug class."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, named_sharding(mesh, s)),
+        tree, specs)
+
+
+def validate(specs, shapes_tree, mesh):
+    """Check every sharded dim divides by the product of its mesh axes;
+    returns a list of (path, spec, shape) violations instead of letting
+    device_put raise one leaf at a time."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    bad = []
+
+    def one(path, spec, x):
+        shape = tuple(x.shape) if hasattr(x, "shape") else tuple(x)
+        for i, part in enumerate(spec):
+            if part is None:
+                continue
+            names = (part,) if isinstance(part, str) else part
+            div = 1
+            for nm in names:
+                div *= sizes.get(nm, 1)
+            if i >= len(shape) or shape[i] % div:
+                bad.append((jax.tree_util.keystr(path), spec, shape))
+                return
+
+    jax.tree_util.tree_map_with_path(one, specs, shapes_tree,
+                                     is_leaf=_is_spec)
+    return bad
+
+
+def bytes_per_device(tree):
+    """Sum of the addressable shard bytes of every leaf — the per-device
+    memory a sharded pytree actually pins (the ZeRO/TP memory proof)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += shards[0].data.size * shards[0].data.dtype.itemsize
+        else:
+            total += leaf.size * jax.numpy.dtype(leaf.dtype).itemsize
+    return total

@@ -1,0 +1,47 @@
+"""``sharding.*`` registry family — the model-parallel subsystem's
+observability surface (per-axis collective counts/bytes, pipeline bubble
+fraction, per-device state bytes).
+
+A VIEW over the observability registry (same storage as
+``metrics.snapshot()`` / ``profiler.fast_path_summary()["sharding"]``).
+Counts are bumped HOST-SIDE from the engine's static per-step collective
+plan — inside the compiled program there is nothing to count, so the
+builder derives how many collectives of which size each step issues per
+axis and the step wrapper adds them per call.  That makes the counters a
+CONTRACT ("1 reduce-scatter per bucket per step on dp"), which is
+exactly what bench.py --model-parallel asserts.
+"""
+from __future__ import annotations
+
+from ...observability import metrics as _metrics
+
+_sharding_stats = _metrics.stats_family("sharding", {
+    "steps": 0,                    # composed train-step launches
+    "collectives_dp": 0,           # grad reduce-scatters/psums on dp
+    "collectives_tp": 0,           # block/embed/xent psums on tp
+    "collectives_pp": 0,           # ppermute handoffs + output fan-out
+    "bytes_dp": 0,                 # payload bytes entering dp collectives
+    "bytes_tp": 0,
+    "bytes_pp": 0,
+    "zero_sharded_leaves": 0,      # moment leaves dp-sharded
+    "zero_replicated_leaves": 0,   # leaves with no dp-divisible axis
+    "bubble_fraction_pct": 0,      # 100 * (pp-1)/(micro+pp-1), last built
+    "param_bytes_per_device": 0,   # gauges: last engine init
+    "opt_state_bytes_per_device": 0,
+    "opt_state_bytes_replicated": 0,  # what replication WOULD have cost
+})
+
+
+def sharding_stats():
+    """Dict snapshot plus the derived ZeRO shrink factor the bench
+    asserts (replicated-moment bytes / per-device moment bytes)."""
+    s = dict(_sharding_stats)
+    per_dev = s["opt_state_bytes_per_device"]
+    s["opt_state_shrink"] = (
+        round(s["opt_state_bytes_replicated"] / per_dev, 4)
+        if per_dev else 0.0)
+    return s
+
+
+def reset_sharding_stats():
+    _sharding_stats.reset()

@@ -1,0 +1,243 @@
+"""ZeRO-1/2 optimizer-state sharding over the 'dp' mesh axis (Rajbhandari
+et al.) — both halves of the repo's training surface:
+
+* **compiled** (:func:`zero_specs` / :func:`scatter_grad` /
+  :func:`gather_param_shard`): structured-axis ZeRO used INSIDE the
+  composed shard_map train step (engine.py).  Each leaf's Adam moments
+  get 'dp' added onto the largest dp-divisible axis of its spec, grads
+  are reduce-scattered along that same axis (stage 2; stage 1 psums full
+  and slices), the update runs shard-local, and the updated param shard
+  is all-gathered back.  Composes with tp/pp: a qkv weight sharded
+  ('pp', None, None, 'tp') carries moments ('pp', 'dp', None, 'tp') —
+  optimizer state per device is 1/(pp·tp·dp) of replicated.
+
+* **eager/fused** (:func:`shard_optimizer_states`): placement-only ZeRO
+  for the dygraph Optimizer — moments are device_put with dp-sharded
+  NamedShardings and the existing donated fused step keeps them placed
+  across updates (``_accumulator_placement``, optimizer/optimizer.py).
+  GSPMD inserts the collectives; update numerics are untouched, so the
+  fused step stays BIT-identical to the replicated one.  This is the
+  fold target of the old 73-line ``distributed/sharding.py``.
+
+Leaves with no dp-divisible axis are counted
+(``sharding.zero_replicated_leaves``) — never silently replicated
+without trace, the round-2 verdict bug class.  Flat+pad sub-axis
+sharding for such leaves lives in ``paddle_tpu.parallel.zero``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.jax_compat import (named_sharding, psum_scatter,
+                                     partition_spec as P)
+from . import rules as rules_mod
+from .stats import _sharding_stats
+
+bytes_per_device = rules_mod.bytes_per_device
+
+
+# --------------------------------------------------------------------------
+# structured-axis ZeRO (the compiled path's layout algebra)
+# --------------------------------------------------------------------------
+
+def _axis_names(part):
+    if part is None:
+        return ()
+    return (part,) if isinstance(part, str) else tuple(part)
+
+
+def pick_zero_axis(shape, spec, mesh_sizes, dp_axis="dp"):
+    """The axis index to shard this leaf's optimizer state (and scatter
+    its grad) over ``dp_axis``, or None when no axis divides.
+
+    Candidates are axes whose LOCAL extent (global / already-sharding
+    axes) divides by dp; the largest local extent wins — it gives the
+    most even flop/byte split and keeps tiny trailing dims replicated."""
+    dp = mesh_sizes.get(dp_axis, 1)
+    if dp <= 1:
+        return None
+    best, best_local = None, 0
+    for i, n in enumerate(shape):
+        parts = _axis_names(spec[i]) if i < len(spec) else ()
+        if dp_axis in parts:
+            return None          # already dp-sharded: nothing to do
+        div = 1
+        for a in parts:
+            div *= mesh_sizes.get(a, 1)
+        local = n // div
+        if n % div == 0 and local % dp == 0 and local > best_local:
+            best, best_local = i, local
+    return best
+
+
+def with_dp_axis(spec, axis, dp_axis="dp"):
+    """``spec`` with ``dp_axis`` appended to the sharding of ``axis``."""
+    parts = list(spec) + [None] * (axis + 1 - len(spec))
+    cur = _axis_names(parts[axis])
+    parts[axis] = (cur + (dp_axis,)) if cur else dp_axis
+    return P(*parts)
+
+
+def zero_specs(param_specs, shapes_tree, mesh, dp_axis="dp", record=True):
+    """(moment_specs, zero_axes): per-leaf moment PartitionSpecs with dp
+    folded in, plus the chosen scatter axis per leaf (``-1`` = no
+    dp-divisible axis, moments replicated over dp for that leaf — an int
+    sentinel, not None, so the axes tree stays a mappable pytree).
+    ``record=True`` counts both outcomes into ``sharding.*`` (pass False
+    for repeat/derived calls so the counters stay per-build)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), shapes_tree)
+
+    def one(spec, shape):
+        ax = pick_zero_axis(shape, spec, sizes, dp_axis)
+        if ax is None:
+            if record:
+                _sharding_stats.inc("zero_replicated_leaves")
+            return (spec, -1)
+        if record:
+            _sharding_stats.inc("zero_sharded_leaves")
+        return (with_dp_axis(spec, ax, dp_axis), ax)
+
+    pair = jax.tree_util.tree_map(one, param_specs, shapes,
+                                  is_leaf=rules_mod._is_spec)
+    is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and \
+        rules_mod._is_spec(x[0])      # noqa: E731
+    mspecs = jax.tree_util.tree_map(lambda t: t[0], pair, is_leaf=is_pair)
+    axes = jax.tree_util.tree_map(lambda t: t[1], pair, is_leaf=is_pair)
+    return mspecs, axes
+
+
+def scatter_grad(g, zero_axis, stage, dp_axis="dp"):
+    """Reduce this leaf's grad over dp INSIDE shard_map.
+
+    stage 2: reduce-scatter along ``zero_axis`` — a full cross-dp-reduced
+    grad never materializes on any rank.  stage 1: psum full, then slice
+    this rank's tile (full grad exists transiently; moments still shard).
+    ``zero_axis`` None: plain psum (replicated-state leaf).  All paths
+    return the SUM over dp; the caller owns the 1/N scale."""
+    if zero_axis is None or zero_axis < 0:
+        return jax.lax.psum(g, dp_axis)
+    if stage >= 2:
+        return psum_scatter(g, dp_axis, scatter_dimension=zero_axis,
+                            tiled=True)
+    full = jax.lax.psum(g, dp_axis)
+    # psum keeps the local extent; slice this rank's tile of zero_axis
+    from ...framework.jax_compat import axis_size
+    dp = axis_size(dp_axis)
+    k = g.shape[zero_axis] // dp
+    idx = jax.lax.axis_index(dp_axis) * k
+    return jax.lax.dynamic_slice_in_dim(full, idx, k, axis=zero_axis)
+
+
+def param_shard(p, zero_axis, dp_axis="dp"):
+    """This dp rank's tile of a (dp-replicated) param leaf along its zero
+    axis — the slice the shard-local update writes."""
+    if zero_axis is None or zero_axis < 0:
+        return p
+    from ...framework.jax_compat import axis_size
+    dp = axis_size(dp_axis)
+    k = p.shape[zero_axis] // dp
+    idx = jax.lax.axis_index(dp_axis) * k
+    return jax.lax.dynamic_slice_in_dim(p, idx, k, axis=zero_axis)
+
+
+def gather_param_shard(upd, zero_axis, dp_axis="dp"):
+    """All-gather an updated param shard back to the full (dp-replicated)
+    leaf — the ZeRO weight-update regather."""
+    if zero_axis is None or zero_axis < 0:
+        return upd
+    return jax.lax.all_gather(upd, dp_axis, axis=zero_axis, tiled=True)
+
+
+# --------------------------------------------------------------------------
+# eager/fused-step placement ZeRO (dygraph Optimizer integration)
+# --------------------------------------------------------------------------
+
+def dp_placement_spec(shape, dp, dp_axis="dp"):
+    """Largest dp-divisible axis sharded, replicated (and counted) when
+    none — the eager heuristic the old distributed/sharding.py carried,
+    now with the silent-replication case observable."""
+    cands = [i for i in range(len(shape)) if shape[i] % dp == 0]
+    if not shape or not cands:
+        _sharding_stats.inc("zero_replicated_leaves")
+        return P()
+    axis = max(cands, key=lambda i: shape[i])
+    parts = [None] * len(shape)
+    parts[axis] = dp_axis
+    _sharding_stats.inc("zero_sharded_leaves")
+    return P(*parts)
+
+
+def shard_optimizer_states(optimizer, mesh=None, stage=1, dp_axis="dp",
+                           model=None):
+    """ZeRO placement for the dygraph/fused training path.
+
+    stage >= 1: every Adam-family accumulator the optimizer creates (and
+    any already created) is device_put with a dp-sharded NamedSharding —
+    the donated fused step re-places after each update, so optimizer
+    state lives at ~1/dp per device for the whole run.  stage >= 3 (the
+    ``p_g_os`` level): parameters themselves are placed dp-sharded via
+    their ``_sharding_axes`` hints (gather-on-use is GSPMD's job).
+    Returns the optimizer.  Requires an active mesh with a sized dp axis
+    (pass one or ``parallel.mesh.set_mesh`` first); without one this is
+    a no-op — same contract as the legacy ``group_sharded_parallel``."""
+    from ...parallel import mesh as mesh_mod
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    optimizer._zero_stage = stage
+    if mesh is None or dp_axis not in mesh.axis_names:
+        return optimizer
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape))[dp_axis]
+    if dp <= 1:
+        return optimizer
+
+    def place_accumulator(p, zeros):
+        ns = named_sharding(mesh, dp_placement_spec(zeros.shape, dp,
+                                                    dp_axis))
+        return jax.device_put(zeros, ns)
+
+    optimizer._accumulator_placement = place_accumulator
+    if stage < 3:
+        # params stay REPLICATED (os / os_g) — but ON THE MESH: mixing a
+        # single-device param with mesh-sharded moments in one update is
+        # an incompatible-devices error, and an unpinned fused step leaks
+        # dp-sharded params into the next eager forward (partitioned-
+        # matmul numeric drift vs the replicated run — the bit-parity
+        # contract).  So params are placed replicated now and the
+        # optimizer re-pins them after every update.
+        rep = named_sharding(mesh, ())
+        optimizer._param_placement = \
+            lambda p, v: jax.device_put(v, rep)
+        for p in optimizer._parameters:
+            if p is not None:
+                p.value = jax.device_put(p.value, rep)
+    by_id = {id(p): p for p in optimizer._parameters}
+    for nm, d in optimizer._accumulators.items():
+        for pid, arr in list(d.items()):
+            if pid in by_id:
+                d[pid] = place_accumulator(by_id[pid], arr)
+    if stage >= 3 and model is not None:
+        for p in model.parameters():
+            spec = dp_placement_spec(tuple(p.shape), dp, dp_axis)
+            p._sharding_axes = tuple(spec)
+        with_mesh = mesh_mod.get_mesh()
+        if with_mesh is None:
+            mesh_mod.set_mesh(mesh)
+        mesh_mod.shard_params(model)
+        if with_mesh is None:
+            mesh_mod.set_mesh(None)
+    return optimizer
+
+
+def optimizer_state_bytes(optimizer, per_device=True):
+    """Bytes of the optimizer's accumulators: addressable-shard bytes
+    when ``per_device`` (the ZeRO memory proof), full logical bytes
+    otherwise (what replication would cost)."""
+    total = 0
+    for d in optimizer._accumulators.values():
+        for arr in d.values():
+            if per_device:
+                total += bytes_per_device([arr])
+            else:
+                total += arr.size * jnp.dtype(arr.dtype).itemsize
+    return total

@@ -692,7 +692,9 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         return tensor
 
     def _rs(x):
-        return jax.lax.psum_scatter(x, ax, tiled=not bool(tensor_list))
+        from ..framework.jax_compat import psum_scatter
+        return psum_scatter(x, ax, scatter_dimension=0,
+                            tiled=not bool(tensor_list))
     out = call(_rs, src, _name="c_reduce_scatter")
     tensor._rebind(out)
     return tensor

@@ -159,7 +159,19 @@ class Fleet:
         """Shard the model's parameters on the fleet mesh per their
         _sharding_axes hints (set by meta_parallel layers); replicated
         otherwise.  The returned model is the same object — GSPMD handles
-        gradient sync when the step runs under pjit."""
+        gradient sync when the step runs under pjit.
+
+        DEPRECATED legacy entry point: model-parallel layouts now come
+        from the ``distributed.auto`` rule registry
+        (``auto.rules.rules_for`` + ``auto.rules.place``, or the composed
+        ``auto.make_train_step``); this alias keeps the fluid-fleet
+        recipe working (MIGRATING.md, 'fluid fleet -> mesh')."""
+        import warnings
+        warnings.warn(
+            "fleet.distributed_model is deprecated; use "
+            "paddle_tpu.distributed.auto (rules.place / make_train_step) "
+            "— see MIGRATING.md 'fluid fleet -> mesh'",
+            DeprecationWarning, stacklevel=2)
         mesh_mod.shard_params(model)
         model._is_fleet_distributed = True
         return model

@@ -1,34 +1,31 @@
-"""Sharded (ZeRO) training — the eager placement API (ref:
-python/paddle/distributed/sharding/ + fleet sharding meta-optimizer).
+"""DEPRECATED shim — the eager ZeRO placement API now lives in
+``paddle_tpu.distributed.auto.zero`` (ISSUE 10 folded this module into
+the model-parallel subsystem; see MIGRATING.md "fluid fleet -> mesh").
 
-This module serves the dygraph ``group_sharded_parallel`` surface: it
-PLACES existing eager state with dp-sharded NamedShardings and lets GSPMD
-insert collectives per-op.  The real compiled ZeRO — explicit
-reduce-scatter of grads into the sharded moment layout, gather-on-use FSDP
-with sub-axis (flattened+padded) sharding so every leaf shards regardless
-of axis divisibility, all inside ONE jitted shard_map step — lives in
-``paddle_tpu.parallel.zero`` (make_zero_train_step / init_zero_state);
-use that for training loops, as fleet's static path does.
+``group_sharded_parallel``/``save_group_sharded_model`` keep their exact
+signatures and semantics as thin aliases with a one-time
+DeprecationWarning: placement-only ZeRO over the active mesh's 'dp'
+axis, the donated fused optimizer step keeping moments sharded across
+updates.  New code should call
+:func:`paddle_tpu.distributed.auto.zero.shard_optimizer_states` (eager /
+fused path) or :func:`paddle_tpu.distributed.auto.engine.make_train_step`
+(the compiled TP+PP+ZeRO step).
 """
 from __future__ import annotations
 
-import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
+import warnings
 
-from ..parallel import mesh as mesh_mod
+_warned = set()
 
 
-def _dp_spec(shape, dp_size):
-    """Shard the largest dp-divisible axis over 'dp'; replicated if none."""
-    if not shape:
-        return P()
-    cands = [i for i in range(len(shape)) if shape[i] % dp_size == 0]
-    if not cands:
-        return P()
-    axis = max(cands, key=lambda i: shape[i])
-    spec = [None] * len(shape)
-    spec[axis] = "dp"
-    return P(*spec)
+def _deprecated(name, instead):
+    if name in _warned:
+        return
+    _warned.add(name)
+    warnings.warn(
+        f"paddle_tpu.distributed.sharding.{name} is deprecated; use "
+        f"{instead} (see MIGRATING.md, 'fluid fleet -> mesh')",
+        DeprecationWarning, stacklevel=3)
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
@@ -37,36 +34,19 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            sync_comm=False):
     """level: 'os' (stage1: optimizer states), 'os_g' (stage2: +grads),
     'p_g_os' (stage3: +params).  Requires an active mesh with a 'dp' axis
-    (parallel.mesh.set_mesh / mesh_scope)."""
+    (parallel.mesh.set_mesh / mesh_scope).  DEPRECATED alias of
+    ``distributed.auto.zero.shard_optimizer_states``."""
+    _deprecated("group_sharded_parallel",
+                "distributed.auto.zero.shard_optimizer_states")
+    from .auto import zero as auto_zero
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
-    optimizer._zero_stage = stage
-
-    mesh = mesh_mod.get_mesh()
-    if mesh is not None and "dp" in mesh.axis_names:
-        dp = dict(zip(mesh.axis_names, mesh.devices.shape))["dp"]
-        if dp > 1:
-            # stage>=1: moments live dp-sharded; the optimizer asks us how
-            # to place each accumulator it creates
-            def place_accumulator(p, zeros):
-                ns = NamedSharding(mesh, _dp_spec(zeros.shape, dp))
-                return jax.device_put(zeros, ns)
-
-            optimizer._accumulator_placement = place_accumulator
-            # re-place any accumulators that already exist
-            by_id = {id(p): p for p in optimizer._parameters}
-            for nm, d in optimizer._accumulators.items():
-                for pid, arr in list(d.items()):
-                    if pid in by_id:
-                        d[pid] = place_accumulator(by_id[pid], arr)
-            if stage >= 3:
-                for p in model.parameters():
-                    spec = _dp_spec(p.shape, dp)
-                    p._sharding_axes = tuple(spec)
-                mesh_mod.shard_params(model)
+    auto_zero.shard_optimizer_states(optimizer, stage=stage, model=model)
     return model, optimizer, scaler
 
 
 def save_group_sharded_model(model, output, optimizer=None):
+    _deprecated("save_group_sharded_model",
+                "io.serialization.save on state_dict()")
     from ..io.serialization import save
     save(model.state_dict(), output + ".pdmodel.params")
     if optimizer is not None:

@@ -28,6 +28,52 @@ def shard_map(f, **kwargs):
     return _shard_map(f, **kwargs)
 
 
+def make_mesh(devices, axis_names):
+    """``jax.sharding.Mesh`` over an already-shaped device ndarray.  The
+    constructor itself is stable across the jax releases this repo
+    targets, but every *new* mesh call site routes through here (standing
+    ROADMAP constraint) so a future rename — jax keeps re-homing the
+    sharding types — is a one-line fix instead of a repo-wide grep."""
+    from jax.sharding import Mesh
+    return Mesh(devices, axis_names)
+
+
+def partition_spec(*parts):
+    """``jax.sharding.PartitionSpec`` by the stable import path."""
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*parts)
+
+
+def named_sharding(mesh, spec):
+    """``jax.sharding.NamedSharding`` for ``mesh`` and a PartitionSpec
+    (or the tuple/None shorthand: ``named_sharding(mesh, ("dp", None))``)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec) if spec is not None else PartitionSpec()
+    return NamedSharding(mesh, spec)
+
+
+def with_sharding_constraint(x, mesh, spec):
+    """``jax.lax.with_sharding_constraint`` with the NamedSharding built
+    through :func:`named_sharding` (jax has moved this function between
+    ``jax.lax`` and ``jax.experimental.pjit`` across releases)."""
+    import jax
+    fn = getattr(jax.lax, "with_sharding_constraint", None)
+    if fn is None:                                   # pragma: no cover
+        from jax.experimental.pjit import with_sharding_constraint as fn
+    return fn(x, named_sharding(mesh, spec))
+
+
+def psum_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    """``jax.lax.psum_scatter`` (reduce-scatter inside shard_map/pmap) —
+    stable in the pinned jax, wrapped here because it is a
+    version-moving manual-collective like shard_map itself."""
+    import jax
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=tiled)
+
+
 def axis_size(axis_name):
     """``jax.lax.axis_size`` (new) — older jax spells it ``psum(1, axis)``,
     which constant-folds to a python int inside mapped code."""

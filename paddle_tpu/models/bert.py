@@ -291,6 +291,14 @@ def param_specs(cfg: BertConfig):
     }
 
 
+def sharding_rules(cfg: BertConfig = None):
+    """Model-parallel layout hook for the distributed.auto rule registry
+    (family "bert"): the Megatron tp splits above, resolved through the
+    same registry every other family uses (rules.prune_to_mesh drops
+    axes a given mesh doesn't size)."""
+    return param_specs(cfg)
+
+
 def _mesh_specs(cfg, mesh):
     """Param specs for ``mesh``: Megatron tp specs when it has a sized 'tp'
     axis, replicated otherwise (pure DP)."""

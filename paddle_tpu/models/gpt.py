@@ -128,6 +128,18 @@ def init_params(cfg: GPTConfig, key):
     }
 
 
+def sharding_rules(cfg: GPTConfig = None):
+    """Model-parallel layout hook for the distributed.auto rule registry
+    (family "gpt"): the Megatron column/row splits over 'tp' (attention
+    heads divide across ranks via the column-split qkv; FFN up-proj
+    column / down-proj row) with the stacked layer axis over 'pp' —
+    defined next to init_params so layout and structure can't drift.
+    Delegates to models/gpt_hybrid.py::param_specs, the same specs the
+    explicit shard_map train step uses."""
+    from .gpt_hybrid import param_specs
+    return param_specs(cfg)
+
+
 QUANT_MODES = ("int8", "int8_dynamic", "fp8")
 
 

@@ -41,6 +41,14 @@ from ..ops.pallas.flash_attn import flash_attention
 
 MESH_AXES = ("dp", "pp", "tp", "sp")
 
+# AdamW decay exclusions for the gpt param tree — the ONE definition
+# every train-step builder (this module, distributed/auto/engine.py,
+# bench.py's reference loop, tests) imports; a leaf added to
+# init_params gets its decay policy decided here, nowhere else
+NO_DECAY = frozenset({"wpe", "lnf_g", "lnf_b"})
+LN_NAMES = frozenset({"ln1_g", "ln1_b", "ln2_g", "ln2_b",
+                      "proj_b", "qkv_b", "fc1_b", "fc2_b"})
+
 
 # --------------------------------------------------------------------------
 # sharding specs
@@ -175,9 +183,13 @@ def _check_mesh(cfg, mesh):
     return axes["sp"], axes["pp"]
 
 
-def _backbone(cfg, sp_size, pp_size, n_microbatch, params, x):
+def _backbone(cfg, sp_size, pp_size, n_microbatch, params, x,
+              pipeline_fn=None):
     """Embed-to-final-hidden shared by train and inference forwards: scan
-    this stage's blocks, pipelined over 'pp' when the axis is sized."""
+    this stage's blocks, pipelined over 'pp' when the axis is sized.
+    ``pipeline_fn(stage_fn, x, n_microbatch, axis_name)`` swaps the
+    microbatch scheduler (default: the GPipe loop in parallel/pipeline.py;
+    distributed/auto passes its 1F1B scheduler)."""
     blk_fn = functools.partial(_sharded_block, cfg, sp_size)
     if cfg.remat:
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -191,7 +203,8 @@ def _backbone(cfg, sp_size, pp_size, n_microbatch, params, x):
         return out
 
     if pp_size > 1:
-        x = pipeline_forward(stage_fn, x, n_microbatch, axis_name="pp")
+        pipe = pipeline_fn if pipeline_fn is not None else pipeline_forward
+        x = pipe(stage_fn, x, n_microbatch, axis_name="pp")
     else:
         x = stage_fn(x)
     return _layer_norm(x, params["lnf_g"], params["lnf_b"],
@@ -199,9 +212,10 @@ def _backbone(cfg, sp_size, pp_size, n_microbatch, params, x):
 
 
 def _fwd_loss(cfg, sp_size, pp_size, n_microbatch, params, tokens, labels,
-              xent_chunks=1):
+              xent_chunks=1, pipeline_fn=None):
     x = _vp_embed(cfg, params, tokens)       # [B_l, N_l, H]
-    x = _backbone(cfg, sp_size, pp_size, n_microbatch, params, x)
+    x = _backbone(cfg, sp_size, pp_size, n_microbatch, params, x,
+                  pipeline_fn=pipeline_fn)
     wte = params["wte"]
 
     def ce_of(xc, lc):
@@ -305,13 +319,10 @@ def make_train_step(cfg: GPTConfig, mesh, n_microbatch=1,
             scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
         tf = t.astype(jnp.float32)
-        no_decay = {"wpe", "lnf_g", "lnf_b"}
-        ln_names = {"ln1_g", "ln1_b", "ln2_g", "ln2_b",
-                    "proj_b", "qkv_b", "fc1_b", "fc2_b"}
 
         def upd(path, p, g, mm, vv):
             leaf = str(getattr(path[-1], "key", path[-1]))
-            decay = leaf not in no_decay and leaf not in ln_names
+            decay = leaf not in NO_DECAY and leaf not in LN_NAMES
             return adamw_update(p, g, mm, vv, lr, tf, beta1, beta2, eps,
                                 weight_decay, decay)
         out = jax.tree_util.tree_map_with_path(upd, params, grads, m, v)

@@ -256,7 +256,15 @@ class Optimizer:
         self._step_count = t
         _fused_stats["calls"] += 1
         place = getattr(self, "_accumulator_placement", None)
+        pplace = getattr(self, "_param_placement", None)
         for p, nv, ns in zip(params, new_ps, new_ss):
+            if pplace is not None:
+                # ZeRO: pin updated params to their declared placement
+                # (replicated for stage 1/2).  Without this, the jitted
+                # step's inferred output shardings leak dp-sharded
+                # params into the next eager forward, whose partitioned
+                # matmuls then drift from the replicated run's numerics.
+                nv = pplace(p, nv)
             p.value = nv
             for nm, sv in ns.items():
                 if place is not None:
@@ -408,6 +416,8 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         lr_global = self.get_lr()
         self._step_count += 1
+        pplace = getattr(self, "_param_placement", None)
+        place = getattr(self, "_accumulator_placement", None)
         for p, g in params_grads:
             if g is None:
                 continue
@@ -417,8 +427,10 @@ class Optimizer:
             state = self._state_for(p)
             new_val, new_state = self._update_with_param(
                 p, p.value, g, state, lr, self._step_count)
+            if pplace is not None:
+                # ZeRO: same placement pin as the fused commit path
+                new_val = pplace(p, new_val)
             p.value = new_val
-            place = getattr(self, "_accumulator_placement", None)
             for nm, sv in new_state.items():
                 if place is not None:
                     # ZeRO: keep moments dp-sharded across eager updates

@@ -60,13 +60,13 @@ def shard_constraint(x, *spec):
     Accepts Tensor or raw array (used inside traced layer forwards)."""
     from ..tensor.tensor import Tensor
     from ..ops.dispatch import call
+    from ..framework import jax_compat
     mesh = get_mesh()
     if mesh is None:
         return x
-    ns = NamedSharding(mesh, P(*spec))
 
     def _c(v):
-        return jax.lax.with_sharding_constraint(v, ns)
+        return jax_compat.with_sharding_constraint(v, mesh, P(*spec))
     if isinstance(x, Tensor):
         return call(_c, x, _name="sharding_constraint")
     return _c(x)

@@ -43,6 +43,20 @@ def init_moe_params(key, n_experts, hidden, ffn, dtype=jnp.float32):
     }
 
 
+def sharding_rules(cfg=None, axis_name="tp"):
+    """Model-parallel layout hook for the distributed.auto rule registry
+    (family "moe"): the gate replicates, every expert-stacked leaf
+    shards its leading [E] axis over ``axis_name`` — on the auto mesh
+    experts ride the 'tp' axis (the classic ep-on-mp placement; pass
+    ``axis_name="ep"`` for a dedicated expert axis)."""
+    from ..framework.jax_compat import partition_spec as P
+    return {
+        "gate_w": P(),
+        "w1": P(axis_name), "b1": P(axis_name),
+        "w2": P(axis_name), "b2": P(axis_name),
+    }
+
+
 def moe_ffn(x, params, axis_name="ep", capacity_factor=1.25,
             n_experts=None):
     """x: LOCAL [T, H] tokens inside a shard_map over ``axis_name``;

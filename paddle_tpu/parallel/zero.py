@@ -28,7 +28,7 @@ import math
 
 import jax
 import jax.numpy as jnp
-from ..framework.jax_compat import shard_map
+from ..framework.jax_compat import shard_map, psum_scatter
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..optimizer.functional import adamw_update
@@ -126,8 +126,8 @@ def make_zero_train_step(loss_fn, param_template, mesh, stage=2,
             if stage >= 2:
                 # reduce-scatter: rank i keeps row i summed — a full grad
                 # tensor never exists on any rank
-                return jax.lax.psum_scatter(
-                    gf, dp_axis, scatter_dimension=0) / dp
+                return psum_scatter(
+                    gf, dp_axis, scatter_dimension=0, tiled=False) / dp
             return (jax.lax.psum(gf, dp_axis) / dp)[
                 jax.lax.axis_index(dp_axis)]
 

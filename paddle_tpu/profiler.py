@@ -326,6 +326,23 @@ def fleet_stats():
     return metrics.families().get("fleet", {})
 
 
+def sharding_stats():
+    """Model-parallel subsystem counter family (distributed/auto):
+    per-axis collective counts/bytes from the composed step's static
+    plan, ZeRO sharded/replicated leaf counts, pipeline bubble fraction,
+    per-device param/optimizer-state bytes.  A pure registry read (a
+    process that never built a parallel step reports an empty family);
+    the derived ``opt_state_shrink`` ratio rides along when the family
+    is live."""
+    fam = metrics.families().get("sharding", {})
+    if fam and fam.get("opt_state_bytes_per_device"):
+        fam = dict(fam)
+        fam["opt_state_shrink"] = round(
+            fam["opt_state_bytes_replicated"]
+            / fam["opt_state_bytes_per_device"], 4)
+    return fam
+
+
 def fast_path_summary():
     """One dict with every fast-path counter family — what the bench.py
     eager microbench and dp-overlap bench assert on — plus the ``faults``
@@ -337,7 +354,8 @@ def fast_path_summary():
                     ("prefetch", prefetch_stats),
                     ("faults", faults_stats),
                     ("serving", serving_stats),
-                    ("fleet", fleet_stats)):
+                    ("fleet", fleet_stats),
+                    ("sharding", sharding_stats)):
         try:
             out[key] = fn()
         except Exception:                                  # noqa: BLE001

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Standing-constraint guard (ROADMAP): version-moving jax APIs must route
+# through paddle_tpu/framework/jax_compat.py.  This greps the package for
+# direct imports/uses of the moving names — jax.experimental.shard_map
+# (renamed to jax.shard_map upstream), bare "from jax import shard_map",
+# and direct jax.lax.psum_scatter outside the compat shim — and fails CI
+# on any hit outside framework/jax_compat.py.
+#
+# Usage: tools/shard_map_guard.sh   (run from anywhere; cd's to the repo)
+# Exit:  0 clean, 1 on violations (each printed with file:line).
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+fail=0
+
+check() {
+    local pattern="$1" why="$2"
+    # grep the python package, excluding the one module allowed to pin
+    # the moving spelling (and caches/this guard's own docs)
+    hits=$(grep -rnE "$pattern" paddle_tpu \
+        --include='*.py' \
+        | grep -v 'framework/jax_compat.py' \
+        | grep -v '__pycache__' || true)
+    if [ -n "$hits" ]; then
+        echo "shard_map_guard: $why" >&2
+        echo "$hits" >&2
+        fail=1
+    fi
+}
+
+check 'jax\.experimental\.shard_map' \
+    "direct jax.experimental.shard_map import (use framework.jax_compat.shard_map)"
+check 'from jax import shard_map|jax\.shard_map\(' \
+    "direct jax.shard_map usage (use framework.jax_compat.shard_map)"
+check 'jax\.lax\.psum_scatter' \
+    "direct jax.lax.psum_scatter (use framework.jax_compat.psum_scatter)"
+
+if [ "$fail" -ne 0 ]; then
+    echo "shard_map_guard: FAIL" >&2
+    exit 1
+fi
+echo "shard_map_guard: OK"
