@@ -1461,35 +1461,62 @@ def faults_bench():
 # --------------------------------------------------------------------------
 
 def fleet_bench():
-    """Serving-fleet chaos e2e (ISSUE 7 tentpole): sustained synthetic
-    traffic through a 2-replica supervised fleet, one replica SIGKILLed
-    mid-run WITH requests in flight.  Asserts the durability contract
-    instead of trusting it: ZERO lost requests (every admitted id
-    completes), token-exact outputs for the re-queued requests vs an
-    uninterrupted run of the same traffic, in-flight work really
-    re-queued (requeues >= 1), the replacement replica warm-restarts
-    from the shared persistent compilation cache (0 cache misses), and
-    request p99 stays under BENCH_FLEET_P99_S (default 30s).  Emits one
-    parsed JSON metric line: fleet_recovery_time_s (incident detection
-    -> replacement serving again) plus p50/p99 request latency.
+    """Serving-fleet e2e benches (ISSUE 7 + ISSUE 11), phase-selectable
+    via BENCH_FLEET_PHASES (default "chaos,autoscale"):
+
+    * ``chaos`` — sustained synthetic traffic through a 2-replica
+      supervised fleet, one replica SIGKILLed mid-run WITH requests in
+      flight.  Asserts the durability contract instead of trusting it:
+      ZERO lost requests, token-exact outputs for the re-queued
+      requests vs an uninterrupted run, requeues >= 1, the replacement
+      replica warm-restarts from the shared persistent compilation
+      cache (0 cache misses), p99 under BENCH_FLEET_P99_S (default
+      30s).  Emits the fleet_recovery_time_s JSON metric line.
+    * ``autoscale`` — SLO-driven elasticity under realistic traffic: a
+      seeded Poisson stream with a 3x burst (testing/traffic.py) drives
+      an Autoscaler-governed fleet between BENCH_AS_MIN and
+      BENCH_AS_MAX replicas.  Asserts interactive p99 <= the
+      PADDLE_FLEET_SLO_P99_S target, replicas_up RISES during the burst
+      and FALLS after cooldown, only batch-class requests are shed,
+      every scale-up replica joins warm (0 persistent-cache misses),
+      zero admitted requests lost, and goodput (SLO-met tokens/s) beats
+      a static fleet pinned at BENCH_AS_MIN replicas over the identical
+      arrivals (skippable via BENCH_AS_STATIC=0 for the smoke budget).
+      Emits the fleet_autoscale_goodput_tps JSON metric line.
 
     Replicas are clean re-execed CPU-backend interpreters (same dance as
     --faults), so this runs under the orchestrator or standalone —
     ``--cpu-mesh N`` recommended off-TPU.  Knobs: BENCH_FLEET_REPLICAS
     (default 2), BENCH_FLEET_REQUESTS (default 24), BENCH_FLEET_TOKENS
-    (default 48)."""
+    (default 48), BENCH_AS_{MIN,MAX,RATE,DURATION_S,SLO_S,COOLDOWN_S,
+    MAX_PENDING,STATIC}."""
     import shutil
     import tempfile
 
-    from paddle_tpu.inference.fleet import ServingFleet
     from paddle_tpu.testing.env import clean_cpu_env
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="paddle_tpu_fleet_")
+    env = clean_cpu_env(repo, device_count=1)
+    env.pop("PADDLE_FAULTS", None)
+    phases = [p.strip() for p in os.environ.get(
+        "BENCH_FLEET_PHASES", "chaos,autoscale").split(",") if p.strip()]
+    try:
+        if "chaos" in phases:
+            _fleet_chaos_phase(work, env)
+        if "autoscale" in phases:
+            _fleet_autoscale_phase(work, env)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _fleet_chaos_phase(work, env):
+    from paddle_tpu.inference.fleet import ServingFleet
 
     replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 2))
     n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", 24))
     gen_tokens = int(os.environ.get("BENCH_FLEET_TOKENS", 48))
     p99_bound = float(os.environ.get("BENCH_FLEET_P99_S", 30))
-    repo = os.path.dirname(os.path.abspath(__file__))
-    work = tempfile.mkdtemp(prefix="paddle_tpu_fleet_")
 
     import numpy as np
     spec = {"cfg": {"vocab_size": 256, "hidden_size": 32, "num_layers": 2,
@@ -1500,8 +1527,6 @@ def fleet_bench():
     rng = np.random.RandomState(7)
     prompts = [rng.randint(1, 256, int(rng.randint(3, 8)))
                for _ in range(n_requests)]
-    env = clean_cpu_env(repo, device_count=1)
-    env.pop("PADDLE_FAULTS", None)
     cache = os.path.join(work, "jit_cache")
 
     def make_fleet(tag):
@@ -1512,96 +1537,290 @@ def fleet_bench():
             telemetry_dir=os.path.join(work, tag, "telemetry"),
             heartbeat_s=20, restart_backoff_s=0.2)
 
+    # reference: the SAME traffic, nobody killed (also fills the
+    # persistent cache the chaos fleet's replicas warm-boot from)
+    fleet = make_fleet("ref")
+    assert fleet.await_healthy(timeout=120) == replicas
+    for i, p in enumerate(prompts):
+        fleet.submit(p, gen_tokens, request_id=f"req{i}")
+    done, failed = fleet.drain(timeout=300)
+    assert not failed and len(done) == n_requests, (len(done), failed)
+    ref_tokens = {rid: r.tokens for rid, r in done.items()}
+    assert fleet.stats()["incidents"] == 0
+    fleet.close()
+
+    # chaos: same traffic, one replica SIGKILLed holding live work
+    fleet = make_fleet("chaos")
+    assert fleet.await_healthy(timeout=120) == replicas
+    victim = fleet._replicas[0]
+    killed_holding = None
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        fleet.submit(p, gen_tokens, request_id=f"req{i}")
+        if killed_holding is None and i >= n_requests // 3:
+            # sustained traffic reached the victim: kill it the
+            # moment it really holds in-flight requests
+            deadline = time.time() + 10
+            while not victim.inflight and time.time() < deadline:
+                time.sleep(0.002)
+            killed_holding = len(victim.inflight)
+            fleet.kill_replica(victim.id)
+    done, failed = fleet.drain(timeout=300)
+    wall = time.perf_counter() - t0
+    assert killed_holding and killed_holding > 0, (
+        "victim never held in-flight work — the kill tested nothing")
+    # the durability contract, asserted
+    assert not failed, f"requests LOST/failed: {failed}"
+    assert len(done) == n_requests, (len(done), n_requests)
+    st = fleet.stats()
+    assert st["requeues"] >= 1, st
+    mismatch = [rid for rid in ref_tokens
+                if done[rid].tokens != ref_tokens[rid]]
+    assert not mismatch, (
+        f"re-queued requests lost token parity: {mismatch}")
+    # the replacement replica must be back — and warm
+    assert fleet.await_healthy(timeout=120) == replicas
+    st = fleet.stats()
+    assert st["recoveries"], "no recovery recorded"
+    rec = st["recoveries"][-1]
+    assert rec["warm_cache_misses"] == 0, (
+        f"replacement replica recompiled: {rec}")
+    ttr = fleet.recovery_time_s()
+    lat = st["latency_s"]
+    assert lat["p99"] is not None and lat["p99"] <= p99_bound, lat
+
+    telem = {"registry": {"fleet": {k: st[k] for k in (
+        "requests_admitted", "requests_completed", "requeues",
+        "retries", "incidents", "replica_restarts",
+        "heartbeat_misses", "sheds", "dup_completions")}}}
     try:
-        # reference: the SAME traffic, nobody killed (also fills the
-        # persistent cache the chaos fleet's replicas warm-boot from)
-        fleet = make_fleet("ref")
-        assert fleet.await_healthy(timeout=120) == replicas
-        for i, p in enumerate(prompts):
-            fleet.submit(p, gen_tokens, request_id=f"req{i}")
-        done, failed = fleet.drain(timeout=300)
-        assert not failed and len(done) == n_requests, (len(done), failed)
-        ref_tokens = {rid: r.tokens for rid, r in done.items()}
-        assert fleet.stats()["incidents"] == 0
-        fleet.close()
+        from paddle_tpu.observability import aggregate
+        report = aggregate.merge_from_dir(
+            os.path.join(work, "chaos", "telemetry"))
+        telem["replicas"] = {
+            r: {"steps": v["steps"], "faults": v["faults"]}
+            for r, v in report["ranks"].items()}
+    except Exception as e:                             # noqa: BLE001
+        telem["replicas"] = {"error": f"{type(e).__name__}: {e}"}
+    fleet.close()
 
-        # chaos: same traffic, one replica SIGKILLed holding live work
-        fleet = make_fleet("chaos")
-        assert fleet.await_healthy(timeout=120) == replicas
-        victim = fleet._replicas[0]
-        killed_holding = None
-        t0 = time.perf_counter()
-        for i, p in enumerate(prompts):
-            fleet.submit(p, gen_tokens, request_id=f"req{i}")
-            if killed_holding is None and i >= n_requests // 3:
-                # sustained traffic reached the victim: kill it the
-                # moment it really holds in-flight requests
-                deadline = time.time() + 10
-                while not victim.inflight and time.time() < deadline:
-                    time.sleep(0.002)
-                killed_holding = len(victim.inflight)
-                fleet.kill_replica(victim.id)
-        done, failed = fleet.drain(timeout=300)
-        wall = time.perf_counter() - t0
-        assert killed_holding and killed_holding > 0, (
-            "victim never held in-flight work — the kill tested nothing")
-        # the durability contract, asserted
-        assert not failed, f"requests LOST/failed: {failed}"
-        assert len(done) == n_requests, (len(done), n_requests)
-        st = fleet.stats()
-        assert st["requeues"] >= 1, st
-        mismatch = [rid for rid in ref_tokens
-                    if done[rid].tokens != ref_tokens[rid]]
-        assert not mismatch, (
-            f"re-queued requests lost token parity: {mismatch}")
-        # the replacement replica must be back — and warm
-        assert fleet.await_healthy(timeout=120) == replicas
-        st = fleet.stats()
-        assert st["recoveries"], "no recovery recorded"
-        rec = st["recoveries"][-1]
-        assert rec["warm_cache_misses"] == 0, (
-            f"replacement replica recompiled: {rec}")
-        ttr = fleet.recovery_time_s()
-        lat = st["latency_s"]
-        assert lat["p99"] is not None and lat["p99"] <= p99_bound, lat
+    print(json.dumps({
+        "metric": "fleet_recovery_time_s",
+        "value": round(ttr, 3),
+        "unit": "s",
+        "vs_baseline": round(ttr / wall, 4),
+        "requests": n_requests,
+        "replicas": replicas,
+        "lost_requests": 0,
+        "requeues": st["requeues"],
+        "killed_holding": killed_holding,
+        "latency_ms": {"p50": round(lat["p50"] * 1e3, 3),
+                       "p99": round(lat["p99"] * 1e3, 3)},
+        "warm_cache_misses": rec["warm_cache_misses"],
+        "telemetry": telem,
+    }), flush=True)
+    print(f"# fleet: {n_requests} requests over {replicas} replicas, "
+          f"SIGKILL with {killed_holding} in flight -> "
+          f"{st['requeues']} requeued, 0 lost, token-exact, "
+          f"recovery {ttr:.2f}s, p99 {lat['p99'] * 1e3:.0f}ms",
+          file=sys.stderr)
 
-        telem = {"registry": {"fleet": {k: st[k] for k in (
-            "requests_admitted", "requests_completed", "requeues",
-            "retries", "incidents", "replica_restarts",
-            "heartbeat_misses", "sheds", "dup_completions")}}}
+
+def _fleet_autoscale_phase(work, env):
+    """ISSUE 11: SLO-driven elasticity under a generated 3x Poisson
+    burst — see fleet_bench's docstring for the asserted contract."""
+    import threading
+
+    from paddle_tpu.inference.autoscale import Autoscaler
+    from paddle_tpu.inference.fleet import (FleetOverloaded,
+                                            ServingFleet)
+    from paddle_tpu.testing import traffic as T
+
+    min_r = int(os.environ.get("BENCH_AS_MIN", 1))
+    max_r = int(os.environ.get("BENCH_AS_MAX", 3))
+    slo_s = float(os.environ.get("PADDLE_FLEET_SLO_P99_S",
+                                 os.environ.get("BENCH_AS_SLO_S", 4.0)))
+    duration = float(os.environ.get("BENCH_AS_DURATION_S", 18.0))
+    base_rate = float(os.environ.get("BENCH_AS_RATE", 20.0))
+    cooldown = float(os.environ.get("BENCH_AS_COOLDOWN_S", 2.0))
+    max_pending = int(os.environ.get("BENCH_AS_MAX_PENDING", 96))
+    run_static = os.environ.get("BENCH_AS_STATIC", "1") != "0"
+
+    gen_hi = 64
+    spec = {"cfg": {"vocab_size": 256, "hidden_size": 32, "num_layers": 2,
+                    "num_heads": 2, "max_seq_len": 128, "dtype": "float32",
+                    "use_flash": False, "remat": False},
+            "seed": 0, "slots": 2, "max_len": 8 + gen_hi,
+            "seq_buckets": [8], "batch_buckets": [1, 2]}
+    arrivals = T.generate(T.TrafficSpec(
+        duration_s=duration, base_rate=base_rate, seed=11,
+        bursts=((0.28, 0.72, 3.0),), diurnal_amplitude=0.15,
+        prompt_len=(5, 0.4, 4, 8), output_tokens=(44, 0.3, 24, gen_hi),
+        prefix_hit_rate=0.3, prefix_len=3, batch_fraction=0.3))
+    cache = os.path.join(work, "as_jit_cache")
+
+    def run(tag, autoscale):
+        fleet = ServingFleet(
+            spec, replicas=min_r, env_base=env, jit_cache_dir=cache,
+            log_dir=os.path.join(work, tag, "logs"),
+            telemetry_dir=os.path.join(work, tag, "telemetry"),
+            heartbeat_s=20, restart_backoff_s=0.2,
+            max_pending=max_pending)
+        counts = {"submitted": 0, "admit_sheds": 0}
+        series = []                      # (t, replicas_up, configured)
+        stop_sampling = threading.Event()
+
+        def sample():
+            while not stop_sampling.is_set():
+                series.append((time.perf_counter(), fleet.replicas_up(),
+                               fleet.nreplicas))
+                stop_sampling.wait(0.1)
+        scaler = None
         try:
-            from paddle_tpu.observability import aggregate
-            report = aggregate.merge_from_dir(
-                os.path.join(work, "chaos", "telemetry"))
-            telem["replicas"] = {
-                r: {"steps": v["steps"], "faults": v["faults"]}
-                for r, v in report["ranks"].items()}
-        except Exception as e:                             # noqa: BLE001
-            telem["replicas"] = {"error": f"{type(e).__name__}: {e}"}
-        fleet.close()
+            assert fleet.await_healthy(timeout=180) == min_r
+            if autoscale:
+                scaler = Autoscaler(
+                    fleet, slo_p99_s=slo_s, min_replicas=min_r,
+                    max_replicas=max_r, cooldown_s=cooldown,
+                    interval_s=0.2, window_s=8.0, down_ticks=10,
+                    up_backlog_per_replica=1.5).start()
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
 
-        print(json.dumps({
-            "metric": "fleet_recovery_time_s",
-            "value": round(ttr, 3),
-            "unit": "s",
-            "vs_baseline": round(ttr / wall, 4),
-            "requests": n_requests,
-            "replicas": replicas,
-            "lost_requests": 0,
-            "requeues": st["requeues"],
-            "killed_holding": killed_holding,
-            "latency_ms": {"p50": round(lat["p50"] * 1e3, 3),
-                           "p99": round(lat["p99"] * 1e3, 3)},
-            "warm_cache_misses": rec["warm_cache_misses"],
-            "telemetry": telem,
-        }), flush=True)
-        print(f"# fleet: {n_requests} requests over {replicas} replicas, "
-              f"SIGKILL with {killed_holding} in flight -> "
-              f"{st['requeues']} requeued, 0 lost, token-exact, "
-              f"recovery {ttr:.2f}s, p99 {lat['p99'] * 1e3:.0f}ms",
-              file=sys.stderr)
-    finally:
-        shutil.rmtree(work, ignore_errors=True)
+            def submit(a):
+                try:
+                    fleet.submit(a.prompt, a.max_new_tokens,
+                                 request_id=a.request_id,
+                                 priority=a.priority)
+                    counts["submitted"] += 1
+                except FleetOverloaded:
+                    counts["admit_sheds"] += 1     # named, at admission
+            t0 = time.perf_counter()
+            T.replay(arrivals, submit)
+            done, failed = fleet.drain(timeout=300)
+            wall = time.perf_counter() - t0
+            if autoscale:
+                # after the burst + cooldown the fleet must de-provision
+                deadline = time.monotonic() + 60
+                while fleet.nreplicas > min_r \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.2)
+            stop_sampling.set()
+            sampler.join(timeout=5)
+            st = fleet.stats()
+            sc = scaler.stats() if scaler else {}
+        finally:
+            if scaler:
+                scaler.stop()
+            stop_sampling.set()
+            fleet.close()
+        from paddle_tpu.observability.metrics import \
+            nearest_rank_percentile
+        slo_met_tokens = sum(
+            len(r.tokens) for r in done.values()
+            if r.latency() is not None and r.latency() <= slo_s)
+        lats = {"interactive": [], "batch": []}
+        for r in done.values():
+            lats[r.priority].append(r.latency())
+
+        def p99(xs):
+            return nearest_rank_percentile(sorted(xs), 99)
+        return {
+            "tag": tag, "wall_s": wall, "done": done, "failed": failed,
+            "stats": st, "scaler": sc, "counts": counts,
+            "series": series, "goodput_tps": slo_met_tokens / wall,
+            "p99_interactive_s": p99(lats["interactive"]),
+            "p99_batch_s": p99(lats["batch"]),
+            "final_replicas": fleet.nreplicas,
+        }
+
+    static = run("as_static", autoscale=False) if run_static else None
+    elastic = run("as_elastic", autoscale=True)
+
+    st = elastic["stats"]
+    # the SLO contract: interactive p99 under the target
+    assert elastic["p99_interactive_s"] is not None \
+        and elastic["p99_interactive_s"] <= slo_s, (
+        f"interactive p99 {elastic['p99_interactive_s']} over the "
+        f"SLO target {slo_s}s")
+    # elasticity: replicas_up rose during the burst and fell after
+    peak_up = max(up for (_, up, _c) in elastic["series"])
+    peak_cfg = max(c for (_, _up, c) in elastic["series"])
+    assert peak_up > min_r, (
+        f"replicas_up never rose above {min_r} — no scale-up happened")
+    assert elastic["final_replicas"] == min_r, (
+        f"fleet did not de-provision: {elastic['final_replicas']} "
+        f"replicas after cooldown (min {min_r})")
+    assert st["scale_ups"] >= 1 and st["scale_downs"] >= 1, st
+    # graceful degradation: the shed axe NEVER hits the interactive
+    # class (batch existed throughout — the traffic is 30% batch)
+    assert st["sheds_interactive"] == 0, st
+    failed_reasons = {rid: r.error for rid, r in elastic["failed"].items()}
+    bad_fail = {rid: e for rid, e in failed_reasons.items()
+                if "shed_overload" not in (e or "")}
+    assert not bad_fail, f"non-shed failures: {bad_fail}"
+    shed_classes = {elastic["failed"][rid].priority
+                    for rid in elastic["failed"]}
+    assert shed_classes <= {"batch"}, (
+        f"sheds hit non-batch classes: {shed_classes}")
+    # zero-lost: every admitted id completed or failed NAMED (the
+    # displaced batch sheds are in `failed` with reason shed_overload)
+    assert len(elastic["done"]) + len(elastic["failed"]) \
+        == elastic["counts"]["submitted"], (
+        len(elastic["done"]), len(elastic["failed"]),
+        elastic["counts"]["submitted"])
+    # warm elasticity: every scale-up replica that JOINED did so with 0
+    # persistent-cache misses (shared PADDLE_JIT_CACHE_DIR).  A late
+    # scale-up drained away before its hello has no miss count — and
+    # compiled nothing.
+    ups = [e for e in st["scale_events"] if e["action"] == "scale_up"
+           and "hello_t" in e]
+    assert ups and all(e.get("warm_cache_misses") == 0 for e in ups), (
+        st["scale_events"])
+    vs_static = None
+    if static is not None:
+        vs_static = elastic["goodput_tps"] / max(static["goodput_tps"],
+                                                 1e-9)
+        assert elastic["goodput_tps"] >= static["goodput_tps"], (
+            f"elastic goodput {elastic['goodput_tps']:.1f} tok/s did "
+            f"not beat the static baseline "
+            f"{static['goodput_tps']:.1f} tok/s")
+
+    print(json.dumps({
+        "metric": "fleet_autoscale_goodput_tps",
+        "value": round(elastic["goodput_tps"], 1),
+        "unit": "slo_met_tokens/s",
+        "vs_static": round(vs_static, 3) if vs_static else None,
+        "static_goodput_tps": (round(static["goodput_tps"], 1)
+                               if static else None),
+        "slo_p99_s": slo_s,
+        "p99_interactive_s": round(elastic["p99_interactive_s"], 3),
+        "p99_batch_s": (round(elastic["p99_batch_s"], 3)
+                        if elastic["p99_batch_s"] else None),
+        "arrivals": len(arrivals),
+        "submitted": elastic["counts"]["submitted"],
+        "completed": len(elastic["done"]),
+        "lost_requests": 0,
+        "replicas": {"min": min_r, "max": max_r, "peak_up": peak_up,
+                     "peak_configured": peak_cfg,
+                     "final": elastic["final_replicas"]},
+        "scale_ups": st["scale_ups"], "scale_downs": st["scale_downs"],
+        "sheds": {"batch": st["sheds_batch"],
+                  "interactive": st["sheds_interactive"],
+                  "admission": elastic["counts"]["admit_sheds"]},
+        "warm_scaleup_cache_misses": 0,
+        "autoscale": {k: elastic["scaler"].get(k) for k in (
+            "ticks", "scale_ups", "scale_downs", "holds_cooldown",
+            "holds_bounds", "tick_errors")},
+    }), flush=True)
+    print(f"# autoscale: {len(arrivals)} arrivals over {duration:.0f}s "
+          f"(3x burst), replicas {min_r}->{peak_cfg}->"
+          f"{elastic['final_replicas']}, interactive p99 "
+          f"{elastic['p99_interactive_s']:.2f}s vs SLO {slo_s}s, "
+          f"goodput {elastic['goodput_tps']:.0f} tok/s"
+          + (f" ({vs_static:.2f}x static)" if vs_static else "")
+          + f", batch sheds {st['sheds_batch']}, 0 lost",
+          file=sys.stderr)
 
 
 # --------------------------------------------------------------------------

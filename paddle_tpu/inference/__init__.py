@@ -14,6 +14,13 @@ serving.
   supervised engine-replica subprocesses (health checks, request
   retries, load shedding — no admitted request is ever dropped) with
   :mod:`.fleet_worker` as the replica entrypoint (ISSUE 7 tentpole).
+  Elastic since ISSUE 11: ``add_replica()`` / drain-then-stop
+  ``remove_replica()`` plus ``submit(priority=)`` classes.
+* :mod:`.autoscale` — :class:`Autoscaler`: the SLO-driven control loop
+  (queue depth, occupancy, windowed p99 vs ``PADDLE_FLEET_SLO_P99_S``)
+  that scales a :class:`ServingFleet` between
+  ``PADDLE_FLEET_{MIN,MAX}_REPLICAS`` with hysteresis + cooldown
+  (ISSUE 11 tentpole).
 
 Set ``PADDLE_JIT_CACHE_DIR`` to persist compiled executables across
 processes: a server restart reloads them instead of re-running XLA
@@ -29,6 +36,7 @@ from .predictor import (Config, Predictor, create_predictor,  # noqa: F401
 _SERVING_NAMES = ("ServingEngine", "PagedServingEngine",
                   "ServingQueueFull", "Request")
 _FLEET_NAMES = ("ServingFleet", "FleetOverloaded", "FleetRequest")
+_AUTOSCALE_NAMES = ("Autoscaler",)
 
 
 def serving_stats():
@@ -57,4 +65,10 @@ def __getattr__(name):
         if name == "fleet":
             return fleet
         return getattr(fleet, name)
+    if name in _AUTOSCALE_NAMES or name == "autoscale":
+        import importlib
+        autoscale = importlib.import_module(__name__ + ".autoscale")
+        if name == "autoscale":
+            return autoscale
+        return getattr(autoscale, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,279 @@
+"""SLO-driven autoscaling for the serving fleet (ISSUE 11 tentpole).
+
+The :class:`Autoscaler` is a small control loop over
+:meth:`ServingFleet.autoscale_signals`: it drives the fleet's replica
+count from the telemetry the PR-4 layer already aggregates — router
+queue backlog, pending-table fraction (the shed horizon), replica
+slot/queue occupancy, and the trailing-window request p99 against the
+``PADDLE_FLEET_SLO_P99_S`` target — in the Clipper/production tradition
+where the SLO itself is the control signal, not raw CPU.
+
+Design points the tests pin down:
+
+* **scale up BEFORE shedding** — the pending-fraction trigger fires at
+  ``pending_headroom`` (default 70%) of ``max_pending``, well inside
+  the :class:`~paddle_tpu.inference.fleet.FleetOverloaded` horizon, so
+  capacity arrives before the router starts refusing work.
+* **hysteresis + cooldown** — scaling up needs ``up_ticks`` consecutive
+  breach ticks (default 1: bursts are urgent), scaling down
+  ``down_ticks`` consecutive idle ticks (default 8: de-provisioning is
+  patient), and any action arms a ``cooldown_s`` window during which
+  the loop only observes.  The combination keeps a noisy signal from
+  flapping the fleet.
+* **bounds** — replica count stays inside
+  ``[min_replicas, max_replicas]`` no matter what the signals (or the
+  ``autoscale_flap`` chaos fault) demand.
+* **graceful scale-down** — victims come from
+  :meth:`ServingFleet.scaledown_victim` (dead replicas first, then the
+  least-loaded healthy one) and are removed via the fleet's
+  drain-then-stop path, so de-provisioning can never lose a request.
+* **wedge-proof** — every tick is exception-isolated (counted in
+  ``autoscale.tick_errors``); a failed ``add_replica`` or a replica
+  SIGKILLed mid-scale-up leaves the loop running and the next tick
+  re-evaluates from fresh signals.
+
+Telemetry rides the ``autoscale.*`` registry family plus the
+``fleet.replicas_target`` gauge; every decision is a JSONL
+``autoscale_decision`` timeline event and a record on
+:attr:`Autoscaler.decisions`.
+
+Env knobs (all overridable per-instance): ``PADDLE_FLEET_SLO_P99_S``,
+``PADDLE_FLEET_MIN_REPLICAS``, ``PADDLE_FLEET_MAX_REPLICAS``,
+``PADDLE_FLEET_SCALE_COOLDOWN_S``.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from ..observability import metrics, timeline
+from ..testing import faults as _faults
+from .fleet import _env_float, _env_int
+
+__all__ = ["Autoscaler", "autoscale_stats"]
+
+
+def _stats_family():
+    return metrics.stats_family("autoscale", {
+        "ticks": 0, "scale_ups": 0, "scale_downs": 0,
+        "holds_cooldown": 0, "holds_bounds": 0, "tick_errors": 0,
+        "flap_forced": 0, "up_signals_p99": 0, "up_signals_backlog": 0,
+        "up_signals_pending": 0, "up_signals_occupancy": 0})
+
+
+def autoscale_stats():
+    """The process-global ``autoscale.*`` counter family."""
+    return dict(_stats_family())
+
+
+class Autoscaler:
+    """Drive ``fleet``'s replica count from its own SLO telemetry.
+
+    Use as a context manager (``with Autoscaler(fleet) as a:``) or call
+    :meth:`start` / :meth:`stop`; :meth:`tick` is the whole control law
+    and is directly callable for deterministic tests — ``fleet`` only
+    needs ``autoscale_signals() / add_replica() / remove_replica() /
+    scaledown_victim()``.
+    """
+
+    def __init__(self, fleet, *, slo_p99_s=None, min_replicas=None,
+                 max_replicas=None, cooldown_s=None, interval_s=0.25,
+                 window_s=15.0, up_backlog_per_replica=2.0,
+                 pending_headroom=0.7, hi_occupancy=0.85,
+                 lo_occupancy=0.35, up_ticks=1, down_ticks=8,
+                 slo_down_margin=0.5):
+        self.fleet = fleet
+        self.slo_p99_s = slo_p99_s if slo_p99_s is not None \
+            else _env_float("PADDLE_FLEET_SLO_P99_S", 5.0)
+        self.min_replicas = max(1, min_replicas if min_replicas is not None
+                                else _env_int("PADDLE_FLEET_MIN_REPLICAS",
+                                              1))
+        self.max_replicas = max_replicas if max_replicas is not None \
+            else _env_int("PADDLE_FLEET_MAX_REPLICAS", 4)
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}")
+        self.cooldown_s = cooldown_s if cooldown_s is not None \
+            else _env_float("PADDLE_FLEET_SCALE_COOLDOWN_S", 5.0)
+        self.interval_s = float(interval_s)
+        self.window_s = float(window_s)
+        self.up_backlog_per_replica = float(up_backlog_per_replica)
+        self.pending_headroom = float(pending_headroom)
+        self.hi_occupancy = float(hi_occupancy)
+        self.lo_occupancy = float(lo_occupancy)
+        self.up_ticks = int(up_ticks)
+        self.down_ticks = int(down_ticks)
+        self.slo_down_margin = float(slo_down_margin)
+
+        self._stats = _stats_family()
+        # the autoscale.* family is process-global; mirror every
+        # count into THIS instance's dict (stats() reports it) so a
+        # coexisting autoscaler's ticks are never misattributed —
+        # same discipline as ServingFleet._inc
+        self._counts = {k: 0 for k in self._stats}
+        self._g_target = metrics.gauge("fleet.replicas_target")
+        self._stop = threading.Event()
+        self._thread = None
+        self._cool_until = 0.0
+        self._up_streak = 0
+        self._down_streak = 0
+        # bounded: a loop on a short cooldown must not grow forever
+        self.decisions = collections.deque(maxlen=256)
+
+    # ------------------------------------------------------------ control
+    def tick(self, now=None):
+        """One control decision.  Returns ``"up"``, ``"down"``, or
+        ``None`` (hold).  Exception-isolated: a failing fleet call is
+        counted and swallowed so the loop can never wedge."""
+        now = time.monotonic() if now is None else now
+        self._inc("ticks")
+        try:
+            return self._tick_inner(now)
+        except Exception as e:                             # noqa: BLE001
+            self._inc("tick_errors")
+            timeline.emit({"event": "autoscale_tick_error",
+                           "error": f"{type(e).__name__}: {e}"})
+            return None
+
+    def _tick_inner(self, now):
+        sig = self.fleet.autoscale_signals(self.window_s)
+        target = sig["configured"]
+        self._g_target.set(target)
+
+        # bounds are restorative, not just gates: a fleet outside
+        # [min, max] (operator remove_replica, construction below the
+        # floor) is steered back regardless of load signals — streaks
+        # don't apply, cooldown still does (no restore-thrash)
+        if target < self.min_replicas or target > self.max_replicas:
+            if now < self._cool_until:
+                self._inc("holds_cooldown")
+                return None
+            direction = "up" if target < self.min_replicas else "down"
+            return self._act(direction, sig, now, reasons=("bounds",))
+
+        forced = _faults.autoscale_flap() if _faults.active() else None
+        if forced is not None:
+            # chaos: force the DECISION every tick — bounds still apply,
+            # cooldown deliberately does not (that is the race the fault
+            # exists to amplify)
+            self._inc("flap_forced")
+            return self._act(forced, sig, now, reasons=("flap",))
+
+        reasons_up = []
+        p99 = sig["p99_s"]
+        if self.slo_p99_s and p99 is not None and p99 > self.slo_p99_s:
+            reasons_up.append("p99")
+            self._inc("up_signals_p99")
+        healthy = max(sig["healthy"], 1)
+        if sig["backlog"] > self.up_backlog_per_replica * healthy:
+            reasons_up.append("backlog")
+            self._inc("up_signals_backlog")
+        if sig["pending_fraction"] >= self.pending_headroom:
+            # the scale-up-BEFORE-shed trigger: fires inside the
+            # FleetOverloaded horizon, not at it
+            reasons_up.append("pending")
+            self._inc("up_signals_pending")
+        if sig["occupancy"] >= self.hi_occupancy and sig["backlog"] > 0:
+            reasons_up.append("occupancy")
+            self._inc("up_signals_occupancy")
+
+        idle = (sig["backlog"] == 0
+                and sig["occupancy"] <= self.lo_occupancy
+                and (p99 is None or not self.slo_p99_s
+                     or p99 < self.slo_p99_s * self.slo_down_margin))
+
+        if reasons_up:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif idle:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+            return None
+
+        if now < self._cool_until:
+            self._inc("holds_cooldown")
+            return None
+        if reasons_up and self._up_streak >= self.up_ticks:
+            return self._act("up", sig, now, reasons=tuple(reasons_up))
+        if idle and self._down_streak >= self.down_ticks:
+            return self._act("down", sig, now, reasons=("idle",))
+        return None
+
+    def _act(self, direction, sig, now, reasons):
+        target = sig["configured"]
+        if direction == "up":
+            if target >= self.max_replicas:
+                self._inc("holds_bounds")
+                return None
+            rid = self.fleet.add_replica()
+            self._inc("scale_ups")
+        else:
+            if target <= self.min_replicas:
+                self._inc("holds_bounds")
+                return None
+            rid = self.fleet.scaledown_victim()
+            if rid is None:
+                self._inc("holds_bounds")
+                return None
+            self.fleet.remove_replica(rid)
+            self._inc("scale_downs")
+        self._cool_until = now + self.cooldown_s
+        self._up_streak = self._down_streak = 0
+        rec = {"action": f"scale_{direction}", "replica": rid,
+               "reasons": list(reasons), "t": time.time(),
+               "signals": {k: sig[k] for k in (
+                   "backlog", "pending_fraction", "occupancy", "p99_s",
+                   "configured", "healthy")}}
+        self.decisions.append(rec)
+        self._g_target.set(target + (1 if direction == "up" else -1))
+        timeline.emit(dict(rec, event="autoscale_decision"))
+        return direction
+
+    # ------------------------------------------------------------- loop
+    def _run(self):
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(self.interval_s)
+
+    def start(self):
+        """Start the control loop thread (idempotent)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fleet-autoscaler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the control loop (the fleet keeps its current size)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5)
+            self._thread = None
+
+    def _inc(self, key, v=1):
+        self._stats.inc(key, v)
+        self._counts[key] = self._counts.get(key, 0) + v
+
+    def stats(self):
+        """THIS autoscaler's counters plus loop state (the
+        process-global family — all autoscalers pooled — is
+        :func:`autoscale_stats`)."""
+        out = dict(self._counts)
+        out.update(min_replicas=self.min_replicas,
+                   max_replicas=self.max_replicas,
+                   cooldown_s=self.cooldown_s,
+                   slo_p99_s=self.slo_p99_s,
+                   decisions=[dict(d) for d in self.decisions])
+        return out
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+        return False

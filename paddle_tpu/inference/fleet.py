@@ -31,6 +31,23 @@ serving *fleet*:
   past the global ``max_pending`` bound :meth:`ServingFleet.submit`
   raises :class:`FleetOverloaded` immediately (a fast 429, not unbounded
   latency).
+* **elastic lifecycle** (ISSUE 11) — :meth:`ServingFleet.add_replica`
+  spawns a fresh supervised replica at runtime (with a shared
+  ``PADDLE_JIT_CACHE_DIR`` it joins warm: 0 persistent-cache misses),
+  and :meth:`ServingFleet.remove_replica` **drain-then-stops**: the
+  replica stops receiving dispatches, finishes (or re-queues, past
+  ``PADDLE_FLEET_DRAIN_TIMEOUT_S``) its in-flight work, then exits — so
+  the zero-lost guarantee holds through every scale-down.  The
+  :mod:`~paddle_tpu.inference.autoscale` control loop drives both from
+  the fleet's own telemetry (queue depth, occupancy, p99 vs the
+  ``PADDLE_FLEET_SLO_P99_S`` target).
+* **priority classes** (ISSUE 11) — ``submit(..., priority="batch")``
+  marks sheddable work.  Dispatch is weighted-fair (interactive first,
+  but batch never starves), and under overload the shed ALWAYS hits the
+  batch class first: an interactive arrival past ``max_pending``
+  displaces a queued — then an in-flight — batch request (failed with
+  the named reason ``shed_overload``) and is itself shed only when no
+  batch work exists anywhere in the fleet.
 
 Telemetry rides the ``fleet.*`` registry family (replica up/down
 gauges, requeues, retries, sheds, heartbeat misses, incidents, recovery
@@ -67,6 +84,14 @@ _launch = importlib.import_module("paddle_tpu.distributed.launch")
 
 __all__ = ["ServingFleet", "FleetRequest", "FleetOverloaded",
            "send_msg", "recv_msg"]
+
+# the router's telemetry-snapshot rank.  A constant far above any
+# replica id: elastic fleets mint replica ids monotonically, so the
+# historical choice (rank = nreplicas) would collide with the first
+# scaled-up replica's id.
+ROUTER_RANK = 1000
+
+PRIORITIES = ("interactive", "batch")
 
 
 class FleetOverloaded(RuntimeError):
@@ -128,9 +153,11 @@ def _stats_family():
     return metrics.stats_family("fleet", {
         "requests_admitted": 0, "requests_completed": 0,
         "requests_failed": 0, "requeues": 0, "retries": 0,
-        "sheds": 0, "dup_completions": 0, "heartbeat_misses": 0,
+        "sheds": 0, "sheds_batch": 0, "sheds_interactive": 0,
+        "dup_completions": 0, "heartbeat_misses": 0,
         "incidents": 0, "replica_restarts": 0, "rpc_errors": 0,
-        "deadline_exceeded": 0, "rejects_permanent": 0})
+        "deadline_exceeded": 0, "rejects_permanent": 0,
+        "scale_ups": 0, "scale_downs": 0, "drain_requeues": 0})
 
 
 def fleet_stats():
@@ -144,7 +171,7 @@ class FleetRequest:
     and replicas (client-suppliable, auto-uuid otherwise)."""
 
     def __init__(self, prompt, max_new_tokens, eos_token=None,
-                 request_id=None, deadline_s=None):
+                 request_id=None, deadline_s=None, priority="interactive"):
         self.id = str(request_id) if request_id is not None \
             else uuid.uuid4().hex
         self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -153,6 +180,10 @@ class FleetRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
         self.deadline_s = deadline_s
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority {priority!r} is unknown — "
+                             f"expected one of {PRIORITIES}")
+        self.priority = priority
         self.tokens = []
         self.finish_reason = None
         self.done = False
@@ -188,7 +219,7 @@ class _Replica:
         self.port = listener.getsockname()[1]
         self.worker = None                 # launch.spawn_worker handle
         self.conn = None
-        self.state = "starting"            # starting | healthy | dead
+        self.state = "starting"    # starting | healthy | dead | removed
         self.incarnation = 0
         self.restarts_used = 0
         self.inflight = {}                 # id -> FleetRequest
@@ -199,6 +230,10 @@ class _Replica:
         self.incident_t = None             # set on incident, cleared on
         self.next_spawn_t = 0.0            # recovery (recovery_s source)
         self.spawn_deadline = None
+        self.thread = None                 # this replica's driver thread
+        self.draining = False              # scale-down: no new dispatches
+        self.drain_t0 = None               # when draining began
+        self.scale_ev = None               # open scale-up event record
 
     @property
     def pid(self):
@@ -228,7 +263,8 @@ class ServingFleet:
                  retry_backoff_s=None, max_pending=None,
                  max_restarts=None, restart_backoff_s=None,
                  spawn_timeout_s=None, steps_per_rpc=4,
-                 dispatch_queue_depth=None, worker_argv=None):
+                 dispatch_queue_depth=None, worker_argv=None,
+                 drain_timeout_s=None, interactive_weight=None):
         self.model_spec = dict(model_spec or {})
         # spec keys the built engine could not honor would otherwise
         # surface as a fleet-wide boot crash or hello contract mismatch
@@ -294,6 +330,17 @@ class ServingFleet:
                           8 * slots * self.nreplicas))
         self.worker_argv = list(worker_argv) if worker_argv else \
             ["-m", "paddle_tpu.inference.fleet_worker"]
+        # scale-down drain bound: past it the still-in-flight requests
+        # are re-queued onto survivors (zero-lost holds either way — the
+        # bound only caps how long a removal politely waits)
+        self.drain_timeout_s = drain_timeout_s \
+            if drain_timeout_s is not None \
+            else _env_float("PADDLE_FLEET_DRAIN_TIMEOUT_S", 30.0)
+        # weighted-fair dispatch: W interactive pops per 1 batch pop when
+        # both classes wait — interactive goes first, batch never starves
+        self.interactive_weight = int(
+            interactive_weight if interactive_weight is not None
+            else _env_int("PADDLE_FLEET_INTERACTIVE_WEIGHT", 4))
         # finished-request retention: the _done/_failed tables double as
         # the dedupe window, so they are BOUNDED (oldest evicted) — a
         # sustained-traffic router must not grow without limit
@@ -308,6 +355,7 @@ class ServingFleet:
         self._counts = {k: 0 for k in self._stats}
         self._g_up = metrics.gauge("fleet.replicas_up")
         self._g_configured = metrics.gauge("fleet.replicas_configured")
+        self._g_target = metrics.gauge("fleet.replicas_target")
         self._g_pending = metrics.gauge("fleet.pending")
         self._g_recovery = metrics.gauge("fleet.last_recovery_s")
         self._h_latency = metrics.histogram("fleet.request_latency_s")
@@ -315,28 +363,37 @@ class ServingFleet:
         # every fleet in the process, so stats() percentiles come from
         # here (same cross-contamination fix as ServingEngine tokens/s)
         self._latencies = collections.deque(maxlen=4096)
+        # (finish-time, latency) pairs: the autoscaler's RECENT-p99
+        # signal needs a time-windowed view, not the lifetime one
+        self._lat_recent = collections.deque(maxlen=4096)
         self._g_configured.set(self.nreplicas)
+        self._g_target.set(self.nreplicas)
 
         self._lock = threading.RLock()
         self._stop = threading.Event()
-        self._ready = collections.deque()     # dispatchable FleetRequests
+        # per-class ready queues; _pop_ready_locked interleaves them
+        # weighted-fair (interactive_weight : 1)
+        self._ready_hi = collections.deque()  # interactive
+        self._ready_lo = collections.deque()  # batch (shed-first)
+        self._wf_ticket = 0
         self._pending = {}                    # id -> FleetRequest (table)
         self._done = {}                       # id -> completed
         self._failed = {}                     # id -> failed (named reason)
         self.incidents = []                   # launch.incident_record + extras
         self.recoveries = []                  # {replica, incarnation, recovery_s}
+        # bounded like _done/_failed: a fleet cycling on a short
+        # cooldown for months must not grow (or deep-copy) forever
+        self.scale_events = collections.deque(maxlen=256)
+        self._next_rid = 0
         self._t0 = time.time()
         self._telemetry_next = 0.0
+        self._q_sweep_next = 0.0
 
         self._replicas = []
         self._threads = []
         try:
-            for i in range(self.nreplicas):
-                lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-                lst.bind(("127.0.0.1", 0))
-                lst.listen(1)
-                self._replicas.append(_Replica(i, lst))
+            for _ in range(self.nreplicas):
+                self._replicas.append(self._new_replica())
             for r in self._replicas:
                 self._spawn(r)
         except Exception:
@@ -351,39 +408,123 @@ class ServingFleet:
                 r.listener.close()
             raise
         for r in self._replicas:
-            t = threading.Thread(target=self._drive, args=(r,),
-                                 name=f"fleet-replica-{r.id}",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._start_driver(r)
+
+    def _new_replica(self):
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        r = _Replica(self._next_rid, lst)
+        self._next_rid += 1
+        return r
+
+    def _start_driver(self, r):
+        r.thread = threading.Thread(target=self._drive, args=(r,),
+                                    name=f"fleet-replica-{r.id}",
+                                    daemon=True)
+        r.thread.start()
+        self._threads.append(r.thread)
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens=16, eos_token=None,
-               request_id=None, deadline_s=None):
+               request_id=None, deadline_s=None, priority="interactive"):
         """Admit one request; returns its :class:`FleetRequest` handle.
         Re-submitting an id already pending/completed returns the
         EXISTING record (dedupe — a client retrying over a flaky hop
-        can't double-serve).  Raises :class:`FleetOverloaded` past the
-        global ``max_pending`` bound."""
+        can't double-serve).
+
+        ``priority`` is the request's admission class:
+        ``"interactive"`` (default) or ``"batch"`` (sheddable).  Past
+        the global ``max_pending`` bound a batch arrival is rejected
+        with :class:`FleetOverloaded`; an interactive arrival first
+        DISPLACES a batch request (queued ones before in-flight ones —
+        the victim fails with the named reason ``shed_overload``) and is
+        rejected only when no batch work exists in the fleet."""
         if deadline_s is None:
             deadline_s = self.request_deadline_s
         req = FleetRequest(prompt, max_new_tokens, eos_token=eos_token,
-                           request_id=request_id, deadline_s=deadline_s)
+                           request_id=request_id, deadline_s=deadline_s,
+                           priority=priority)
         with self._lock:
             for table in (self._pending, self._done, self._failed):
                 if req.id in table:
                     return table[req.id]
             if len(self._pending) >= self.max_pending:
-                self._inc("sheds")
-                raise FleetOverloaded(
-                    f"pending table at max_pending {self.max_pending} "
-                    f"({len(self._done)} completed so far) — shed and "
-                    "retry with backoff")
+                if req.priority == "interactive" \
+                        and self._shed_batch_victim_locked(req.id):
+                    pass          # a batch request made room, named shed
+                else:
+                    self._inc("sheds")
+                    self._inc(f"sheds_{req.priority}")
+                    raise FleetOverloaded(
+                        f"pending table at max_pending "
+                        f"{self.max_pending} "
+                        f"({len(self._done)} completed so far) — shed "
+                        "and retry with backoff")
             self._pending[req.id] = req
-            self._ready.append(req)
+            (self._ready_hi if req.priority == "interactive"
+             else self._ready_lo).append(req)
             self._inc("requests_admitted")
             self._g_pending.set(len(self._pending))
         return req
+
+    def _shed_batch_victim_locked(self, for_id):
+        """Displace one batch request to admit an interactive arrival
+        under overload: newest QUEUED batch first (zero sunk cost), then
+        newest IN-FLIGHT batch (cancelled on its replica).  Returns True
+        when a victim was shed.  The victim fails with the named reason
+        ``shed_overload`` — graceful degradation is loud, never
+        silent."""
+        victim, owner = None, None
+        while self._ready_lo:
+            cand = self._ready_lo.pop()            # newest queued batch
+            if cand.done or cand.failed or cand.id not in self._pending:
+                continue       # stale entry (mass-fail, dedupe): drop,
+            victim = cand      # it frees no pending slot
+            break
+        if victim is None:
+            for r in self._replicas:
+                for q in r.inflight.values():
+                    if q.priority == "batch" and not (q.done or q.failed) \
+                            and q.id in self._pending \
+                            and (victim is None
+                                 or q.submit_t > victim.submit_t):
+                        victim, owner = q, r
+            if owner is not None:
+                owner.inflight.pop(victim.id, None)
+                owner.pending_cancel.append(victim.id)
+        if victim is None:
+            return False
+        self._inc("sheds")
+        self._inc("sheds_batch")
+        self._fail_locked(
+            victim, f"shed_overload: batch request displaced by "
+                    f"interactive admission {for_id!r} at max_pending "
+                    f"{self.max_pending}")
+        return True
+
+    def _pop_ready_locked(self):
+        """The next dispatchable request, weighted-fair across the
+        priority classes: ``interactive_weight`` interactive pops per
+        batch pop while both queues are non-empty; a lone class drains
+        at full rate."""
+        hi, lo = self._ready_hi, self._ready_lo
+        if hi and lo:
+            if self._wf_ticket >= self.interactive_weight:
+                self._wf_ticket = 0
+                return lo.popleft()
+            self._wf_ticket += 1
+            return hi.popleft()
+        if hi:
+            return hi.popleft()
+        if lo:
+            return lo.popleft()
+        return None
+
+    def _ready_queue_of(self, req):
+        return self._ready_hi if req.priority == "interactive" \
+            else self._ready_lo
 
     # ------------------------------------------------- replica lifecycle
     def _worker_env(self, r):
@@ -421,6 +562,12 @@ class ServingFleet:
         incident like any other."""
         r.listener.settimeout(0.25)
         while not self._stop.is_set():
+            # queued requests must not outlive their deadlines just
+            # because every replica is still booting (never-dispatched
+            # deadline sweep — no dispatch loop runs while we sit here)
+            self._sweep_queued_deadlines()
+            if r.draining:
+                return             # being removed while starting: bail
             if r.worker["proc"].poll() is not None:
                 raise _ReplicaGone(
                     f"worker exited rc={r.worker['proc'].poll()} "
@@ -463,6 +610,14 @@ class ServingFleet:
             r.last_stats = stats
             r.state = "healthy"
             self._g_up.inc(1)
+            if r.scale_ev is not None:
+                # close the open scale-up record: the bench's
+                # warm-scale-up attestation reads these
+                r.scale_ev["hello_t"] = time.time()
+                r.scale_ev["boot_s"] = hello.get("boot_s")
+                r.scale_ev["warm_cache_misses"] = (hello.get(
+                    "persistent_cache") or {}).get("misses")
+                r.scale_ev = None
             if r.incident_t is not None:
                 rec = round(time.monotonic() - r.incident_t, 3)
                 r.incident_t = None
@@ -536,6 +691,10 @@ class ServingFleet:
             return
         wait = r.next_spawn_t - time.monotonic()
         if wait > 0:
+            # the backoff window MUST be shutdown-interruptible (ISSUE
+            # 11 satellite): wait on the stop event — never time.sleep —
+            # and in short slices so a concurrent remove_replica()
+            # (draining flip) is noticed promptly too
             self._stop.wait(min(wait, 0.25))
             return
         r.restarts_used += 1
@@ -603,13 +762,17 @@ class ServingFleet:
         return max(0, cap)
 
     def _pick_dispatch(self, r):
+        if r.draining:
+            return []          # drain-then-stop: no new work, ever
         now = time.perf_counter()
         batch = []
         with self._lock:
             cap = self._capacity(r)
             skipped = []
-            while self._ready and len(batch) < cap:
-                req = self._ready.popleft()
+            while len(batch) < cap:
+                req = self._pop_ready_locked()
+                if req is None:
+                    break
                 if req.done or req.failed or req.id not in self._pending:
                     continue                    # cancelled/deduped away
                 if req.expired(now):
@@ -625,7 +788,8 @@ class ServingFleet:
                 req.replicas_tried.append(r.id)
                 r.inflight[req.id] = req
                 batch.append(req)
-            self._ready.extend(skipped)
+            for req in skipped:
+                self._ready_queue_of(req).append(req)
         return batch
 
     def _rpc_submit(self, r, batch):
@@ -649,7 +813,7 @@ class ServingFleet:
                         req, f"rejected: {rej.get('err', 'unserveable')}")
                 else:                           # back-pressure: try later
                     req.not_before = time.perf_counter() + 0.05
-                    self._ready.append(req)
+                    self._ready_queue_of(req).append(req)
 
     def _handle_step_resp(self, r, resp):
         for fin in resp.get("finished") or []:
@@ -684,6 +848,7 @@ class ServingFleet:
             lat = req.finish_t - req.submit_t
             self._h_latency.observe(lat)
             self._latencies.append(lat)
+            self._lat_recent.append((req.finish_t, lat, req.priority))
             self._g_pending.set(len(self._pending))
         return True
 
@@ -695,22 +860,29 @@ class ServingFleet:
         while len(table) > self.done_retention:
             table.pop(next(iter(table)))
 
-    def _requeue_locked(self, req, reason):
+    def _requeue_locked(self, req, reason, charge_retry=True):
         """Back into the ready queue (bounded retries + backoff) — the
-        no-request-dropped invariant's working end."""
+        no-request-dropped invariant's working end.
+
+        ``charge_retry=False`` is the VOLUNTARY path (scale-down drain
+        handoff): the request did nothing wrong and the fleet chose to
+        move it, so it must not consume the failure-retry budget — a
+        request bounced by several scale-downs can never be failed
+        ``retries_exhausted`` — and it redispatches without backoff."""
         if req.done or req.failed:
             return
-        req.retries += 1
-        self._inc("requeues")
-        if req.retries > self.max_retries:
-            self._fail_locked(req, f"retries_exhausted after "
-                                   f"{self.max_retries}: {reason}")
-            return
-        req.not_before = time.perf_counter() + self.retry_backoff_s \
-            * (2 ** (req.retries - 1))
+        if charge_retry:
+            req.retries += 1
+            self._inc("requeues")
+            if req.retries > self.max_retries:
+                self._fail_locked(req, f"retries_exhausted after "
+                                       f"{self.max_retries}: {reason}")
+                return
+            req.not_before = time.perf_counter() + self.retry_backoff_s \
+                * (2 ** (req.retries - 1))
         req.replica = None
         # re-queued work jumps the line: it has already waited longest
-        self._ready.appendleft(req)
+        self._ready_queue_of(req).appendleft(req)
 
     def _fail_locked(self, req, reason):
         self._pending.pop(req.id, None)
@@ -734,12 +906,38 @@ class ServingFleet:
                     self._inc("deadline_exceeded")
                     self._fail_locked(req, "deadline_exceeded")
 
+    def _sweep_queued_deadlines(self):
+        """Deadline enforcement for NEVER-DISPATCHED requests (ISSUE 11
+        satellite): a request stranded in the router queue — every
+        replica busy, dead, or still booting — must fail fast at its
+        deadline, not wait for a dispatch attempt that may never come.
+        Every driver thread calls this (including from inside the
+        _await_hello poll loop, where no dispatch runs at all); the
+        time gate keeps the sweep O(queue) per 50ms, not per loop."""
+        now = time.perf_counter()
+        # gate read OUTSIDE the lock: every driver thread calls this per
+        # loop iteration, and the common case is a no-op that must not
+        # contend the router lock (a stale read at worst re-checks once)
+        if now < self._q_sweep_next:
+            return
+        with self._lock:
+            if now < self._q_sweep_next:
+                return
+            self._q_sweep_next = now + 0.05
+            for dq in (self._ready_hi, self._ready_lo):
+                expired = [q for q in dq if q.expired(now)]
+                for req in expired:
+                    dq.remove(req)
+                    self._inc("deadline_exceeded")
+                    self._fail_locked(req, "deadline_exceeded")
+
     def _publish_telemetry(self):
-        """Router snapshot (rank = nreplicas, past the replica ids) into
-        the shared telemetry dir, so merge_from_dir shows the fleet.*
-        counters next to the per-replica serving stats.  Written
-        directly — NOT via timeline.configure(), whose process-global
-        state would race across the driver threads."""
+        """Router snapshot (rank = ROUTER_RANK, far past any replica id
+        an elastic fleet can mint) into the shared telemetry dir, so
+        merge_from_dir shows the fleet.* counters next to the
+        per-replica serving stats.  Written directly — NOT via
+        timeline.configure(), whose process-global state would race
+        across the driver threads."""
         if not self.telemetry_dir:
             return
         with self._lock:
@@ -749,10 +947,10 @@ class ServingFleet:
             self._telemetry_next = now + 2.0
         try:
             from ..observability import aggregate
-            snap = aggregate.snapshot_record(rank=self.nreplicas)
+            snap = aggregate.snapshot_record(rank=ROUTER_RANK)
             os.makedirs(self.telemetry_dir, exist_ok=True)
             path = os.path.join(self.telemetry_dir,
-                                f"snapshot_rank{self.nreplicas}.json")
+                                f"snapshot_rank{ROUTER_RANK}.json")
             tmp = f"{path}.tmp{threading.get_ident()}"
             with open(tmp, "w") as f:
                 json.dump(snap, f, sort_keys=True)
@@ -763,9 +961,23 @@ class ServingFleet:
     def _drive(self, r):
         """Per-replica driver thread: relaunch when dead, handshake when
         starting, otherwise dispatch + step + health-check.  All
-        incidents for this replica funnel through here (exactly-once)."""
+        incidents for this replica funnel through here (exactly-once).
+        A draining replica (scale-down) stops dispatching, keeps
+        stepping until its in-flight table empties (bounded by
+        drain_timeout_s), then retires — zero-lost holds through every
+        removal."""
         while not self._stop.is_set():
             try:
+                self._sweep_queued_deadlines()
+                if r.draining:
+                    if r.state != "healthy":
+                        break      # dead/starting: nothing to finish
+                    if not r.inflight:
+                        break                          # drained clean
+                    if r.drain_t0 is not None and \
+                            time.monotonic() - r.drain_t0 \
+                            > self.drain_timeout_s:
+                        break      # _retire re-queues the leftovers
                 if r.state == "dead":
                     self._maybe_relaunch(r)
                     continue
@@ -798,13 +1010,226 @@ class ServingFleet:
                 # in-flight requests: treat as an incident and relaunch
                 self._incident(r, f"driver error: "
                                   f"{type(e).__name__}: {e}")
+        if r.draining and not self._stop.is_set():
+            self._retire(r)
+
+    # ------------------------------------------------ elastic lifecycle
+    def _replica_by_id(self, rid):
+        with self._lock:
+            return next((x for x in self._replicas if x.id == int(rid)),
+                        None)
+
+    def add_replica(self):
+        """Scale UP: mint, spawn, and drive one more supervised replica;
+        returns its id (replica ids are minted monotonically and never
+        reused).  With a shared ``PADDLE_JIT_CACHE_DIR`` the newcomer
+        warm-boots from the persistent compilation cache — its hello's
+        cache-miss count lands on the scale event record, which the
+        bench asserts is 0."""
+        with self._lock:
+            # registration (not the slow spawn) happens under the lock:
+            # close() snapshots _replicas under it, so once we are past
+            # this block a racing close() WILL see the replica
+            if self._stop.is_set():
+                raise RuntimeError("fleet is closed")
+            r = self._new_replica()
+            ev = {"action": "scale_up", "replica": r.id,
+                  "t": time.time()}
+            self.scale_events.append(ev)
+            r.scale_ev = ev
+            self._replicas.append(r)
+            self.nreplicas = len(self._replicas)
+            self._g_configured.set(self.nreplicas)
+        try:
+            self._spawn(r)
+        except Exception:
+            with self._lock:
+                self._replicas.remove(r)
+                self.nreplicas = len(self._replicas)
+                self._g_configured.set(self.nreplicas)
+                ev["error"] = "spawn failed"
+            r.listener.close()
+            raise
+        if self._stop.is_set():
+            # close() raced the spawn: its teardown sweep may have seen
+            # r.worker as None, so the orphan is OURS to kill (both
+            # killing is harmless — every step is idempotent)
+            r.worker["proc"].kill()
+            _launch.close_worker_log(r.worker)
+            try:
+                r.listener.close()
+            except OSError:
+                pass
+            ev["error"] = "fleet closed during spawn"
+            raise RuntimeError("fleet is closed")
+        self._inc("scale_ups")
+        self._start_driver(r)
+        timeline.emit({"event": "fleet_scale_up", "replica": r.id,
+                       "replicas_configured": self.nreplicas})
+        return r.id
+
+    def remove_replica(self, rid, wait=False, timeout=None):
+        """Scale DOWN, drain-then-stop: the replica immediately stops
+        receiving dispatches, finishes its in-flight work (re-queued
+        onto survivors past ``drain_timeout_s``), then its worker exits
+        and the replica unregisters — an admitted request can never be
+        lost to a scale-down.  Asynchronous by default (the replica's
+        own driver thread performs the drain); ``wait=True`` blocks
+        until the replica is gone.  Refuses to remove the last
+        non-draining replica (use :meth:`close` to tear down)."""
+        with self._lock:
+            r = self._replica_by_id(rid)
+            if r is None:
+                raise KeyError(f"no replica {rid} in this fleet")
+            if not r.draining:
+                live = [x for x in self._replicas if not x.draining]
+                if len(live) <= 1:
+                    raise ValueError(
+                        "refusing to remove the last serving replica — "
+                        "close() tears the whole fleet down")
+                r.draining = True
+                r.drain_t0 = time.monotonic()
+                self._inc("scale_downs")
+                self.scale_events.append(
+                    {"action": "scale_down", "replica": r.id,
+                     "t": time.time()})
+                timeline.emit({"event": "fleet_scale_down",
+                               "replica": r.id,
+                               "inflight_at_drain": len(r.inflight)})
+            thread = r.thread
+        if wait and thread is not None:
+            thread.join(timeout if timeout is not None
+                        else self.drain_timeout_s + self.heartbeat_s
+                        + 10)
+            if thread.is_alive():
+                raise TimeoutError(
+                    f"replica {rid} did not drain within the wait")
+
+    def _retire(self, r):
+        """Finalize a scale-down (driver thread only): re-queue whatever
+        the drain could not finish, politely stop the worker (the final
+        ack set rides the shutdown message), release the socket/log, and
+        unregister the replica."""
+        with self._lock:
+            victims = list(r.inflight.values())
+            r.inflight.clear()
+            for req in victims:
+                self._inc("drain_requeues")
+                self._requeue_locked(req, f"replica {r.id} removed",
+                                     charge_retry=False)
+        if r.conn is not None:
+            try:
+                r.conn.settimeout(2.0)
+                send_msg(r.conn, {"op": "shutdown",
+                                  "ack": r.pending_ack[:]})
+            except OSError:
+                pass
+            try:
+                r.conn.close()
+            except OSError:
+                pass
+            r.conn = None
+        if r.worker is not None:
+            try:
+                _launch.stop_worker(r.worker, term_grace=2.0)
+            except Exception:                              # noqa: BLE001
+                pass
+            _launch.close_worker_log(r.worker)
+        try:
+            r.listener.close()
+        except OSError:
+            pass
+        if r.state == "healthy":
+            self._g_up.inc(-1)
+        r.state = "removed"
+        with self._lock:
+            if r in self._replicas:
+                self._replicas.remove(r)
+            if r.thread in self._threads:
+                # a long-lived elastic fleet must not accumulate one
+                # dead Thread per scale cycle
+                self._threads.remove(r.thread)
+            self.nreplicas = len(self._replicas)
+            self._g_configured.set(self.nreplicas)
+            ev = next((e for e in reversed(self.scale_events)
+                       if e.get("replica") == r.id
+                       and e["action"] == "scale_down"
+                       and "done_t" not in e), None)
+            if ev is not None:
+                ev["done_t"] = time.time()
+                ev["drain_requeues"] = len(victims)
+        if self.telemetry_dir:
+            # ids are never reused, so a retired replica's snapshot
+            # would read as a live-but-frozen rank in merged telemetry
+            # forever — drop it
+            try:
+                os.unlink(os.path.join(self.telemetry_dir,
+                                       f"snapshot_rank{r.id}.json"))
+            except OSError:
+                pass
+        timeline.emit({"event": "fleet_replica_removed",
+                       "replica": r.id,
+                       "drain_requeues": len(victims)})
+
+    def scaledown_victim(self):
+        """The cheapest replica to remove right now, or None: a dead or
+        still-booting replica first (it serves nothing), else the
+        healthy replica with the least in-flight work.  Already-draining
+        replicas are never re-picked; the last live replica is never
+        offered."""
+        with self._lock:
+            cands = [r for r in self._replicas if not r.draining]
+            if len(cands) <= 1:
+                return None
+            unhealthy = [r for r in cands if r.state != "healthy"]
+            if unhealthy:
+                return unhealthy[0].id
+            return min(cands, key=lambda r: len(r.inflight)).id
+
+    def autoscale_signals(self, window_s=15.0):
+        """One consistent snapshot of the control signals the
+        :mod:`~paddle_tpu.inference.autoscale` loop keys on: router
+        backlog, pending-table fraction (the shed horizon), per-replica
+        occupancy, and the p99 of completions inside the trailing
+        ``window_s`` (lifetime percentiles can never scale DOWN — a
+        window can)."""
+        now = time.perf_counter()
+        with self._lock:
+            backlog = len(self._ready_hi) + len(self._ready_lo)
+            pending = len(self._pending)
+            reps = [r for r in self._replicas if not r.draining]
+            healthy = sum(1 for r in reps if r.state == "healthy")
+            occ = []
+            for r in reps:
+                if r.state != "healthy":
+                    continue
+                st = r.last_stats or {}
+                slots = max(int(st.get("slots") or self._slots), 1)
+                occ.append(min(
+                    (int(st.get("slot_occupancy") or 0)
+                     + int(st.get("queue_depth") or 0)) / slots, 2.0))
+            lats = sorted(lat for (t, lat, _p) in self._lat_recent
+                          if now - t <= window_s)
+            sheds = self._counts.get("sheds", 0)
+            configured = len(reps)
+        return {
+            "backlog": backlog, "pending": pending,
+            "pending_fraction": pending / max(self.max_pending, 1),
+            "configured": configured, "healthy": healthy,
+            "occupancy": (sum(occ) / len(occ)) if occ else 0.0,
+            "p99_s": metrics.nearest_rank_percentile(lats, 99),
+            "p50_s": metrics.nearest_rank_percentile(lats, 50),
+            "window_n": len(lats), "sheds": sheds,
+        }
 
     # ------------------------------------------------------------- public
     def kill_replica(self, rid, sig=signal.SIGKILL):
         """Hard-kill a replica's process (chaos harness / bench).  The
         driver thread detects the death and runs the normal incident
         path — requeue, backoff, relaunch."""
-        r = self._replicas[rid]
+        r = self._replica_by_id(rid)
+        if r is None:
+            raise KeyError(f"no replica {rid} in this fleet")
         pid = r.pid
         if pid is not None:
             try:
@@ -814,7 +1239,12 @@ class ServingFleet:
         return pid
 
     def replicas_up(self):
-        return sum(1 for r in self._replicas if r.state == "healthy")
+        # under the lock: an elastic fleet mutates _replicas at runtime,
+        # and an unlocked list iteration racing a remove() can skip an
+        # element and undercount
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.state == "healthy")
 
     def await_healthy(self, n=None, timeout=60.0, poll=0.05):
         """Block until at least ``n`` replicas (default all) are
@@ -864,23 +1294,23 @@ class ServingFleet:
         with self._lock:
             out.update(
                 pending=len(self._pending), completed=len(self._done),
-                failed=len(self._failed), ready=len(self._ready),
+                failed=len(self._failed),
+                ready=len(self._ready_hi) + len(self._ready_lo),
+                ready_interactive=len(self._ready_hi),
+                ready_batch=len(self._ready_lo),
                 replicas_up=self.replicas_up(),
                 replicas=self.nreplicas,
                 incidents_detail=list(self.incidents),
-                recoveries=list(self.recoveries))
+                recoveries=list(self.recoveries),
+                scale_events=[dict(e) for e in self.scale_events])
         # THIS fleet's window, not the shared registry histogram — a
         # coexisting fleet's traffic must not shape these percentiles
         with self._lock:
             data = sorted(self._latencies)
-
-        def pct(p):
-            if not data:
-                return None
-            rank = max(int(-(-p / 100.0 * len(data) // 1)), 1)
-            return data[min(rank, len(data)) - 1]
-        out["latency_s"] = {"p50": pct(50), "p99": pct(99),
-                            "count": len(data)}
+        out["latency_s"] = {
+            "p50": metrics.nearest_rank_percentile(data, 50),
+            "p99": metrics.nearest_rank_percentile(data, 99),
+            "count": len(data)}
         return out
 
     def recovery_time_s(self):
@@ -895,11 +1325,20 @@ class ServingFleet:
     def close(self):
         """Tear the fleet down: stop driver threads, best-effort
         graceful worker shutdown, then kill.  Pending requests are
-        failed with reason ``fleet_shutdown`` (never silently lost)."""
+        failed with reason ``fleet_shutdown`` (never silently lost).
+        Interruptible everywhere — a replica parked in its
+        restart-backoff window wakes on the stop event immediately,
+        never sleeping out the backoff (:meth:`shutdown` is the same
+        call by its production name)."""
         self._stop.set()
-        for t in self._threads:
+        with self._lock:
+            # snapshot: a concurrent _retire() prunes this list
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=self.heartbeat_s + 5)
-        for r in self._replicas:
+        with self._lock:
+            reps = list(self._replicas)
+        for r in reps:
             if r.conn is not None:
                 try:
                     r.conn.settimeout(1.0)
@@ -926,6 +1365,10 @@ class ServingFleet:
         with self._lock:
             for req in list(self._pending.values()):
                 self._fail_locked(req, "fleet_shutdown")
+
+    # the production name for the same teardown; tests assert it
+    # returns promptly even mid-restart-backoff
+    shutdown = close
 
     def __enter__(self):
         return self

@@ -253,6 +253,11 @@ def main(argv=None):
     incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
     spec = json.loads(os.environ.get("PADDLE_FLEET_MODEL") or "{}")
 
+    # replica_slow_start fault: a deterministically slow joiner — the
+    # elastic router/autoscaler must tolerate a scale-up replica whose
+    # hello is late without wedging or counting phantom capacity
+    _faults.slow_start_check()
+
     t0 = time.perf_counter()
     engine = _build_engine(spec)
     warm = engine.warmup() if spec.get("warmup", True) else 0

@@ -183,9 +183,11 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
         faults = {}
         fams = snap.get("families") or {}
         # "fleet" rides along: the router's requeues/sheds/heartbeat
-        # misses are fault counters in every sense that matters here
+        # misses are fault counters in every sense that matters here —
+        # and "autoscale" with it (scale decisions/errors are incidents
+        # the group view should surface)
         for fam in ("faults", "watchdog", "launch", "checkpoint",
-                    "bootstrap", "fleet"):
+                    "bootstrap", "fleet", "autoscale"):
             for k, v in (fams.get(fam) or {}).items():
                 if v:
                     faults[f"{fam}.{k}"] = v

@@ -45,6 +45,17 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 _RESERVOIR_CAP = 4096
 
 
+def nearest_rank_percentile(sorted_data, p):
+    """Nearest-rank (ceil) percentile over an ALREADY-SORTED sequence;
+    None with no data.  The one shared implementation of the idiom —
+    histograms, the fleet's latency windows, and the bench all key
+    their p50/p99 numbers on it."""
+    if not sorted_data:
+        return None
+    rank = max(int(-(-p / 100.0 * len(sorted_data) // 1)), 1)  # ceil
+    return sorted_data[min(rank, len(sorted_data)) - 1]
+
+
 def _label_key(labels):
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
@@ -167,10 +178,7 @@ class Histogram(_Metric):
         observations; None with no data."""
         with self._lock:
             data = sorted(self._reservoir)
-        if not data:
-            return None
-        rank = max(int(-(-p / 100.0 * len(data) // 1)), 1)  # ceil
-        return data[min(rank, len(data)) - 1]
+        return nearest_rank_percentile(data, p)
 
     @property
     def mean(self):

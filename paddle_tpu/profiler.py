@@ -319,11 +319,19 @@ def reset_serving_stats():
 
 def fleet_stats():
     """Serving-fleet router counter family (inference/fleet.py):
-    admissions/completions/failures, re-queues and retries, load sheds,
-    heartbeat misses, replica incidents/restarts, dedupe hits.  A pure
-    registry read (a process that never routed reports an empty
-    family)."""
+    admissions/completions/failures, re-queues and retries, load sheds
+    (per priority class), heartbeat misses, replica incidents/restarts,
+    scale ups/downs, dedupe hits.  A pure registry read (a process that
+    never routed reports an empty family)."""
     return metrics.families().get("fleet", {})
+
+
+def autoscale_stats():
+    """Fleet-autoscaler counter family (inference/autoscale.py): control
+    ticks, scale ups/downs, cooldown/bound holds, per-signal up
+    triggers, isolated tick errors.  A pure registry read (a process
+    that never autoscaled reports an empty family)."""
+    return metrics.families().get("autoscale", {})
 
 
 def sharding_stats():
@@ -355,6 +363,7 @@ def fast_path_summary():
                     ("faults", faults_stats),
                     ("serving", serving_stats),
                     ("fleet", fleet_stats),
+                    ("autoscale", autoscale_stats),
                     ("sharding", sharding_stats)):
         try:
             out[key] = fn()

@@ -54,6 +54,18 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         the engine's decode step N raises InjectedFault mid-step — the
         slot-leak regression path: in-flight requests must be marked
         re-queueable and their slots freed, never leaked.
+    replica_slow_start:seconds=3[,rank=2[,restart=0]]
+        the matching replica sleeps N seconds BEFORE building its engine
+        and sending the hello — a slow-starting replica (cold page cache,
+        saturated host) joining the fleet.  An autoscaler that counts a
+        slow joiner as capacity too early, or an elastic router that
+        wedges waiting on it, fails deterministically under this spec.
+    autoscale_flap:repeat=1[,dir=up|down]
+        every autoscaler tick is forced into a scale decision (with no
+        ``dir`` the direction alternates fire to fire) — the control-loop
+        race amplifier: min/max bounds, cooldown bookkeeping, and the
+        drain-then-stop path must hold under a decision storm.  Bounds
+        still apply; the fault forces the DECISION, not a bound breach.
     page_exhaustion:step=3
         the paged engine treats its decode step N as a KV page-pool
         exhaustion event: the NEWEST in-flight request must be
@@ -275,6 +287,35 @@ def page_exhaustion_check(step=None):
     to the queue, pages freed, failure named) without the pool actually
     being full."""
     return take("page_exhaustion", step=step) is not None
+
+
+def slow_start_check():
+    """Fleet replicas call this once at boot, before building the engine
+    and sending the router hello; a matching ``replica_slow_start``
+    fault sleeps ``seconds`` — a deterministically slow joiner for
+    elastic-fleet / autoscaler races."""
+    fault = take("replica_slow_start")
+    if fault is not None:
+        s = float(fault.get("seconds", 1.0))
+        print(f"# faults: replica slow start, sleeping {s}s before hello",
+              file=sys.stderr, flush=True)
+        time.sleep(s)
+
+
+def autoscale_flap():
+    """Called by the autoscaler once per control tick; returns a forced
+    scale direction (``"up"``/``"down"``) when a matching
+    ``autoscale_flap`` fault fires, else None.  With no ``dir=`` the
+    direction alternates across fires (install with ``repeat=1`` to
+    force a decision EVERY tick)."""
+    fault = take("autoscale_flap")
+    if fault is None:
+        return None
+    d = fault.get("dir")
+    if d in ("up", "down"):
+        return d
+    fault["_flap_up"] = not fault.get("_flap_up", False)
+    return "up" if fault["_flap_up"] else "down"
 
 
 def engine_step_error(step):

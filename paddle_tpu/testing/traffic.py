@@ -1,0 +1,205 @@
+"""Seeded, reproducible production-shaped serving traffic (ISSUE 11).
+
+Real fleets are not exercised by uniform request streams: arrivals are
+bursty (Poisson with a time-varying rate), rates follow a diurnal
+cycle, prompt and output lengths are heavy-tailed, and a large fraction
+of prompts share a common prefix (system prompts, few-shot headers —
+the PR-8 prefix cache's whole reason to exist).  This module generates
+that shape as plain DATA — a list of :class:`Arrival` records with
+absolute arrival offsets — from one integer seed, so an autoscaling
+bench or a chaos test replays the identical workload run after run.
+
+The rate function is ``base_rate * diurnal(t) * burst(t)`` and arrivals
+are drawn by Lewis thinning (candidate events at the peak rate, each
+accepted with probability ``rate(t) / rate_max``), which keeps the
+process exactly Poisson at every instant while staying reproducible
+from a single ``numpy.random.RandomState``.
+
+Only numpy beyond the stdlib — importable before jax, like the rest of
+``paddle_tpu.testing``.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["Arrival", "TrafficSpec", "generate", "replay"]
+
+PRIORITIES = ("interactive", "batch")
+
+
+class Arrival:
+    """One generated request: submit at ``t`` seconds after replay
+    start.  ``request_id`` is stable (derived from the arrival index)
+    so reruns of the same spec join on ids."""
+
+    __slots__ = ("t", "prompt", "max_new_tokens", "priority",
+                 "request_id", "prefix_hit")
+
+    def __init__(self, t, prompt, max_new_tokens, priority, request_id,
+                 prefix_hit):
+        self.t = float(t)
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = priority
+        self.request_id = request_id
+        self.prefix_hit = bool(prefix_hit)
+
+    def __repr__(self):
+        return (f"Arrival(t={self.t:.3f}, len={len(self.prompt)}, "
+                f"new={self.max_new_tokens}, {self.priority!r}, "
+                f"id={self.request_id!r})")
+
+
+class TrafficSpec:
+    """The knobs, one JSON-able record.
+
+    * ``duration_s`` / ``base_rate`` — window length and the baseline
+      Poisson arrival rate (requests/s).
+    * ``bursts`` — ``(start_frac, end_frac, multiplier)`` phases; inside
+      ``[start_frac, end_frac) * duration_s`` the rate is multiplied
+      (overlapping phases compound).  The bench's "3x burst" is one
+      ``(0.33, 0.66, 3.0)`` entry.
+    * ``diurnal_amplitude`` — 0 disables; ``a`` modulates the rate by
+      ``1 + a * sin(2*pi*t/diurnal_period_s)`` (clipped at 0), the
+      slow ramp under the bursts.
+    * ``prompt_len`` / ``output_tokens`` — ``(median, sigma, lo, hi)``
+      log-normal draws clipped into ``[lo, hi]``: heavy-tailed like
+      production token counts, but bounded so every request fits the
+      engine's ladder/budget.
+    * ``prefix_hit_rate`` — probability a prompt starts with one of
+      ``prefix_pool`` shared ``prefix_len``-token prefixes (exercises
+      PR-8 shared-prefix page reuse); the remainder of the prompt is
+      unique either way.
+    * ``batch_fraction`` — probability a request is ``priority="batch"``
+      (the sheddable class); the rest are ``"interactive"``.
+    """
+
+    def __init__(self, duration_s=10.0, base_rate=4.0, *, seed=0,
+                 vocab=256, bursts=((0.33, 0.66, 3.0),),
+                 diurnal_amplitude=0.0, diurnal_period_s=None,
+                 prompt_len=(5, 0.5, 3, 8), output_tokens=(12, 0.5, 4, 32),
+                 prefix_hit_rate=0.0, prefix_pool=4, prefix_len=4,
+                 batch_fraction=0.0, id_prefix="t"):
+        self.duration_s = float(duration_s)
+        self.base_rate = float(base_rate)
+        self.seed = int(seed)
+        self.vocab = int(vocab)
+        self.bursts = tuple((float(a), float(b), float(m))
+                            for a, b, m in bursts)
+        self.diurnal_amplitude = float(diurnal_amplitude)
+        self.diurnal_period_s = float(diurnal_period_s
+                                      if diurnal_period_s is not None
+                                      else duration_s)
+        self.prompt_len = tuple(prompt_len)
+        self.output_tokens = tuple(output_tokens)
+        self.prefix_hit_rate = float(prefix_hit_rate)
+        self.prefix_pool = int(prefix_pool)
+        self.prefix_len = int(prefix_len)
+        self.batch_fraction = float(batch_fraction)
+        self.id_prefix = str(id_prefix)
+        if not 0.0 <= self.batch_fraction <= 1.0:
+            raise ValueError("batch_fraction must be in [0, 1]")
+        if not 0.0 <= self.prefix_hit_rate <= 1.0:
+            raise ValueError("prefix_hit_rate must be in [0, 1]")
+        if self.prefix_hit_rate > 0 and self.prefix_len >= self.prompt_len[2]:
+            # a "hit" prompt is prefix + >=1 unique tokens; a prefix at
+            # or past the minimum prompt length would push hit prompts
+            # beyond the promised [lo, hi] length bound
+            raise ValueError(
+                f"prefix_len {self.prefix_len} must be < the minimum "
+                f"prompt length {self.prompt_len[2]} when "
+                "prefix_hit_rate > 0")
+
+    def rate(self, t):
+        """Requests/s at offset ``t`` — the thinning target."""
+        r = self.base_rate
+        if self.diurnal_amplitude:
+            r *= max(0.0, 1.0 + self.diurnal_amplitude
+                     * np.sin(2 * np.pi * t / self.diurnal_period_s))
+        for a, b, m in self.bursts:
+            if a * self.duration_s <= t < b * self.duration_s:
+                r *= m
+        return r
+
+    def rate_max(self):
+        """An upper bound on :meth:`rate` over the window (the thinning
+        envelope): peak diurnal times the product of burst multipliers
+        (overlaps compound, so the product is the safe bound)."""
+        r = self.base_rate * (1.0 + max(self.diurnal_amplitude, 0.0))
+        for _, _, m in self.bursts:
+            if m > 1.0:
+                r *= m
+        return r
+
+
+def _clipped_lognormal(rng, median, sigma, lo, hi):
+    v = rng.lognormal(mean=np.log(max(float(median), 1.0)),
+                      sigma=float(sigma))
+    return int(np.clip(round(v), lo, hi))
+
+
+def generate(spec=None, **kw):
+    """The arrival list for ``spec`` (or ``TrafficSpec(**kw)``), sorted
+    by ``t``.  Same spec + seed -> byte-identical prompts, lengths,
+    priorities, and arrival times."""
+    if spec is None:
+        spec = TrafficSpec(**kw)
+    elif kw:
+        raise TypeError("pass a TrafficSpec OR knobs, not both")
+    rng = np.random.RandomState(spec.seed)
+    # the shared-prefix pool is drawn FIRST so prefix bytes are stable
+    # regardless of how many arrivals the thinning accepts
+    pool = [rng.randint(1, spec.vocab, spec.prefix_len)
+            for _ in range(max(spec.prefix_pool, 1))]
+    rate_max = spec.rate_max()
+    out = []
+    t = 0.0
+    i = 0
+    while True:
+        t += rng.exponential(1.0 / rate_max) if rate_max > 0 else spec.duration_s
+        if t >= spec.duration_s:
+            break
+        if rng.uniform() * rate_max > spec.rate(t):
+            continue                       # thinned candidate
+        plen = _clipped_lognormal(rng, *spec.prompt_len)
+        hit = rng.uniform() < spec.prefix_hit_rate
+        if hit:
+            prefix = pool[rng.randint(len(pool))]
+            body = rng.randint(1, spec.vocab,
+                               max(plen - spec.prefix_len, 1))
+            prompt = np.concatenate([prefix, body])
+        else:
+            prompt = rng.randint(1, spec.vocab, plen)
+        out.append(Arrival(
+            t=t, prompt=prompt,
+            max_new_tokens=_clipped_lognormal(rng, *spec.output_tokens),
+            priority=("batch" if rng.uniform() < spec.batch_fraction
+                      else "interactive"),
+            request_id=f"{spec.id_prefix}{i:05d}", prefix_hit=hit))
+        i += 1
+    return out
+
+
+def replay(arrivals, submit, *, speed=1.0, stop=None):
+    """Submit each arrival at its wall-clock offset (``speed`` > 1
+    compresses time).  ``submit(arrival)`` owns error handling — a
+    shedding fleet raises through it and the caller decides whether a
+    shed ends the run.  ``stop`` (an optional ``threading.Event``)
+    aborts the replay early.  Returns the number submitted."""
+    t0 = time.perf_counter()
+    n = 0
+    for a in arrivals:
+        if stop is not None and stop.is_set():
+            break
+        delay = a.t / speed - (time.perf_counter() - t0)
+        if delay > 0:
+            if stop is not None:
+                if stop.wait(delay):
+                    break
+            else:
+                time.sleep(delay)
+        submit(a)
+        n += 1
+    return n

@@ -275,6 +275,72 @@ class TestIncidentRecords:
                 and inc["wall_time_s"] >= 0
 
 
+# ------------------------------------------- router-side fixes (I11) ----
+
+def _stub_fleet(tmp_path, tag, worker_src, replicas=1, **kw):
+    """A fleet over trivial non-jax workers: router state machinery
+    without an engine boot."""
+    from paddle_tpu.inference.fleet import ServingFleet
+    env = clean_cpu_env(REPO, device_count=1)
+    env.pop("PADDLE_FAULTS", None)
+    kw.setdefault("heartbeat_s", 5)
+    kw.setdefault("spawn_timeout_s", 120)
+    return ServingFleet(SPEC, replicas=replicas, env_base=env,
+                        log_dir=str(tmp_path / tag / "logs"),
+                        worker_argv=["-c", worker_src], **kw)
+
+
+class TestQueuedDeadlineSweep:
+    def test_never_dispatched_request_fails_at_deadline(self, tmp_path):
+        """ISSUE 11 satellite regression: a request stuck in the ROUTER
+        queue (here: no replica ever finishes booting, so nothing is
+        ever dispatched) must fail named at its deadline — the sweep
+        covers the queued set, not just the per-replica in-flight
+        tables."""
+        fleet = _stub_fleet(tmp_path, "qdl",
+                            "import time; time.sleep(300)")
+        try:
+            req = fleet.submit([1, 2, 3], 8, request_id="stuck",
+                               deadline_s=0.2)
+            deadline = time.time() + 5
+            while not req.failed and time.time() < deadline:
+                time.sleep(0.01)
+            assert req.failed and "deadline_exceeded" in req.error, (
+                req.failed, req.error)
+            st = fleet.stats()
+            assert st["deadline_exceeded"] >= 1
+            assert "stuck" in fleet._failed and not fleet._pending
+        finally:
+            fleet.close()
+
+
+class TestShutdownInterruptsBackoff:
+    def test_shutdown_during_restart_backoff_returns_fast(self, tmp_path):
+        """ISSUE 11 satellite regression: shutdown() during a replica's
+        restart-backoff window must wake the driver thread off the stop
+        event immediately — never sleep out the (here: 20s) backoff."""
+        fleet = _stub_fleet(tmp_path, "bko", "raise SystemExit(1)",
+                            restart_backoff_s=20.0, max_restarts=5)
+        try:
+            # the worker dies instantly; wait until the replica is DEAD
+            # and parked inside its first 20s backoff window
+            r = fleet._replicas[0]
+            deadline = time.time() + 30
+            while (r.state != "dead" or not fleet.incidents) \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+            assert r.state == "dead" and fleet.incidents
+            assert r.next_spawn_t > time.monotonic() + 5, \
+                "replica is not in a long backoff window"
+        finally:
+            t0 = time.perf_counter()
+            fleet.shutdown()            # the close() production alias
+            elapsed = time.perf_counter() - t0
+        assert elapsed < 5.0, (
+            f"shutdown blocked {elapsed:.1f}s — backoff sleep is not "
+            "interruptible")
+
+
 # ------------------------------------------------- subprocess fleets ----
 
 def _tiny_prompts(n, seed=0, tokens=24):

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Autoscale smoke: SLO-driven fleet elasticity on the CPU backend,
+# inside a hard 120s budget — CI's proof that the autoscaler (ISSUE 11)
+# still scales a serving fleet 2 -> 4 -> 2 under a generated 3x Poisson
+# burst while honoring the durability + priority contracts.
+#
+# Runs bench.py --fleet's autoscale phase only (BENCH_FLEET_PHASES=
+# autoscale; the static-baseline goodput comparison is skipped via
+# BENCH_AS_STATIC=0 to fit the budget — the nightly bench keeps it).
+# The bench itself asserts: interactive p99 under the SLO target,
+# replicas_up rises during the burst and falls back to the minimum
+# after cooldown, every scale-up replica joins warm from the shared
+# persistent compilation cache, and NO admitted request is lost.  This
+# script additionally greps the parsed JSON metric line for the
+# zero-lost and batch-only-shed attestations.
+#
+# Usage: tools/autoscale_smoke.sh
+# Exit:  bench exit status, or 1 if the metric line / attestations are
+#        missing.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+LOG=$(mktemp /tmp/autoscale_smoke.XXXXXX.log)
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    BENCH_FLEET_PHASES=autoscale BENCH_AS_STATIC=0 \
+    BENCH_AS_MIN=2 BENCH_AS_MAX=4 BENCH_AS_DURATION_S=12 \
+    python bench.py --fleet --cpu-mesh 2 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+if [ "$rc" -ne 0 ]; then
+    echo "autoscale_smoke: FAIL (rc=$rc)" >&2
+    exit "$rc"
+fi
+if ! grep -q '"metric": "fleet_autoscale_goodput_tps"' "$LOG"; then
+    echo "autoscale_smoke: FAIL — no parsed fleet_autoscale_goodput_tps" \
+         "metric line" >&2
+    exit 1
+fi
+if ! grep -q '"lost_requests": 0' "$LOG"; then
+    echo "autoscale_smoke: FAIL — metric line does not attest zero lost" \
+         "requests through the scale up/down cycle" >&2
+    exit 1
+fi
+if ! grep -q '"interactive": 0' "$LOG"; then
+    echo "autoscale_smoke: FAIL — metric line does not attest that the" \
+         "interactive class was never shed" >&2
+    exit 1
+fi
+echo "autoscale_smoke: OK"

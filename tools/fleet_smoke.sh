@@ -21,7 +21,10 @@ set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 
 LOG=$(mktemp /tmp/fleet_smoke.XXXXXX.log)
+# chaos phase only: the autoscale phase has its own smoke + budget
+# (tools/autoscale_smoke.sh)
 timeout -k 10 120 env JAX_PLATFORMS=cpu BENCH_FLEET_REQUESTS=20 \
+    BENCH_FLEET_PHASES=chaos \
     python bench.py --fleet --cpu-mesh 2 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 
