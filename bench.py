@@ -645,7 +645,7 @@ def dp_overlap():
     (BENCH_DP_MIN_REDUCTION, default 0.20)."""
     import numpy as np
     import jax
-    from jax.sharding import Mesh
+    from paddle_tpu.framework.jax_compat import make_mesh
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.distributed as dist
@@ -663,7 +663,7 @@ def dp_overlap():
     min_reduction = float(os.environ.get("BENCH_DP_MIN_REDUCTION", 0.20))
 
     devices = jax.devices()
-    mesh = Mesh(np.array(devices), ("dp",))
+    mesh = make_mesh(np.array(devices), ("dp",))
 
     def build():
         paddle.seed(42)

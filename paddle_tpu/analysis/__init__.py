@@ -1,0 +1,90 @@
+"""Compile-hygiene static analysis for paddle_tpu.
+
+The repo's load-bearing invariants are things no test can guard
+exhaustively: every hot path must stay inside ONE donated jitted
+executable (``decode_compiles==1``, zero steady-state compiles),
+version-moving jax APIs must route through ``framework/jax_compat.py``,
+and the fleet/router/autoscaler's zero-lost guarantee depends on
+disciplined lock usage.  This package enforces them at lint time with a
+compositional AST analysis (design after Blackshear et al., "RacerD:
+Compositional Static Race Detection"): per-module summaries (imports,
+call graph, lock acquisitions) composed into project-level findings.
+
+Rules (stable ids — suppress inline with
+``# ptl: disable=PTLxxx -- justification``):
+
+* PTL000 — suppression hygiene (malformed / justification-free disables)
+* PTL001 — moving-api: direct version-moving jax spelling outside
+  framework/jax_compat.py (alias/attribute-chain aware; supersedes the
+  old ``tools/shard_map_guard.sh`` grep, which missed aliased imports)
+* PTL002 — tracer-leak: Python control flow / int()/float()/bool() /
+  ``.item()`` / f-strings on traced values inside jitted (or one-hop
+  reachable) functions — each a silent retrace
+* PTL003 — donation safety: reads of a buffer after it was passed as a
+  donated operand, and the same object donated twice in one call
+* PTL004 — host-sync in hot path: ``block_until_ready`` /
+  ``jax.device_get`` / ``np.asarray`` inside the known hot roots
+  (engine step/decode, reducer grad-ready hooks, router dispatch loop)
+* PTL005 — lock-order: cycles in the cross-module lock-acquisition
+  graph (potential ABBA deadlocks)
+
+Strictly stdlib at import time — no jax, no paddle_tpu package
+side-effects — so the tree loads standalone on bare CI python (the
+``tools/`` guards and ``tests/test_analysis.py`` rely on this).
+
+CLI: ``python -m paddle_tpu.analysis <paths> [--rules=...]`` (needs the
+paddle_tpu package importable, hence jax), or ``tools/ptl_lint.py`` for
+a jax-less box (standalone-loads this tree) — see README "Static
+analysis".
+"""
+from __future__ import annotations
+
+from .core import (AnalysisResult, Finding, Rule, all_rules, analyze,
+                   rule_by_name)
+
+__all__ = ["AnalysisResult", "Finding", "Rule", "all_rules", "analyze",
+           "rule_by_name", "publish_metrics", "family_dict"]
+
+
+def family_dict(result):
+    """The canonical ``analysis.*`` family payload for one
+    :class:`AnalysisResult` — the ONE place the key set is defined, so
+    the registry (``publish_metrics``) and the telemetry snapshot the
+    CLI writes can never drift.  Every registered rule gets an explicit
+    ``findings_<id>`` (zero-filled)."""
+    fam = {
+        "files_scanned": result.files_scanned,
+        "findings_total": len(result.findings),
+        "findings_new": sum(1 for f in result.findings if f.new),
+        "findings_baselined": sum(
+            1 for f in result.findings if not f.new),
+        "suppressed": result.suppressed,
+        "baseline_size": result.baseline_size,
+        "baseline_stale": len(result.stale_baseline),
+    }
+    by_rule = {}
+    for f in result.findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    for rule in all_rules():
+        fam[f"findings_{rule.id}"] = by_rule.get(rule.id, 0)
+    fam["findings_PTL000"] = by_rule.get("PTL000", 0)
+    return fam
+
+
+def publish_metrics(result):
+    """Mirror an :class:`AnalysisResult` into the PR-4 metrics registry
+    as the ``analysis.*`` family (findings by rule id, suppressions,
+    baseline posture) so ``profiler.fast_path_summary()`` and
+    ``tools/telemetry_report.py`` report lint posture alongside runtime
+    counters.  Returns False (and does nothing) when the observability
+    package isn't importable — the standalone / bare-CI load path."""
+    try:
+        from ..observability import metrics
+    except Exception:                                  # noqa: BLE001
+        return False
+    fam = metrics.stats_family("analysis")
+    for k, v in family_dict(result).items():
+        fam[k] = v
+    return True
+# (reading the family back lives in profiler.analysis_stats(), beside
+# the other fast_path_summary views — one reader, no drift)

@@ -1,0 +1,93 @@
+"""Baseline bookkeeping: accepted pre-existing findings live in a
+checked-in JSON file (``tools/analysis_baseline.json``) so they don't
+block CI while every NEW finding fails.
+
+Keys are line-number-free (rule id | posix relpath | enclosing scope |
+symbol — see ``Finding.key``) with an occurrence count, so edits that
+move code don't invalidate entries, while a second occurrence of a
+baselined pattern in the same function still fails.  Entries whose
+finding no longer exists — in a file that WAS scanned — are reported
+stale (warn, not fail) so the file shrinks as debt is paid.
+"""
+from __future__ import annotations
+
+import json
+
+BASELINE_VERSION = 1
+
+
+def load(path):
+    """{key: count} from a baseline file; empty dict when absent."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a baseline file "
+                         f"(expected {{'version', 'entries'}})")
+    return {str(k): int(v) for k, v in data["entries"].items()}
+
+
+def apply(result, entries):
+    """Mark findings covered by ``entries`` as not-new (first N
+    occurrences of each key, N = the entry count) and record stale
+    entries on the result.  PTL000 hygiene findings are never
+    baselineable — a justification-free disable must be fixed, not
+    grandfathered."""
+    used = {}
+    for f in result.findings:
+        if f.rule_id == "PTL000":
+            continue
+        allowed = entries.get(f.key, 0)
+        taken = used.get(f.key, 0)
+        if taken < allowed:
+            used[f.key] = taken + 1
+            f.new = False
+    result.baseline_size = sum(entries.values())
+    stale = []
+    for key, count in sorted(entries.items()):
+        parts = key.split("|")
+        rule = parts[0] if parts else ""
+        path = parts[1] if len(parts) > 1 else ""
+        if path not in result.scanned_paths:
+            continue            # file not in this run's scope: no claim
+        if result.rules_run and rule not in result.rules_run:
+            continue            # rule not run: entry untestable here
+        if used.get(key, 0) < count:
+            stale.append({"key": key,
+                          "unused": count - used.get(key, 0)})
+    result.stale_baseline = stale
+    return result
+
+
+def write(path, findings, scanned_paths=None, rules_run=None,
+          previous=None):
+    """Serialize current findings as the new baseline (sorted, counted);
+    returns the entry total.  A refresh only speaks for what the run
+    SAW: ``previous`` entries for files outside ``scanned_paths`` or
+    rules outside ``rules_run`` are preserved, so a path-subset or
+    ``--rules=`` refresh can't silently drop accepted debt."""
+    entries = {}
+    for f in findings:
+        if f.rule_id == "PTL000":
+            continue
+        entries[f.key] = entries.get(f.key, 0) + 1
+    for key, count in (previous or {}).items():
+        parts = key.split("|")
+        rule = parts[0] if parts else ""
+        p = parts[1] if len(parts) > 1 else ""
+        out_of_scope = (
+            (scanned_paths is not None and p not in scanned_paths)
+            or (rules_run is not None and rule not in rules_run))
+        if out_of_scope:
+            entries.setdefault(key, count)
+    data = {"version": BASELINE_VERSION,
+            "comment": "accepted pre-existing findings; regenerate with "
+                       "python -m paddle_tpu.analysis <paths> "
+                       "--write-baseline",
+            "entries": dict(sorted(entries.items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return sum(entries.values())

@@ -1,0 +1,97 @@
+"""Function index + light call graph shared by the tracer, hot-path and
+lock-order rules.
+
+Per module: every (async) function with its qualname, owning class and
+parameter list.  Call edges resolve three shapes — ``f()`` (module
+function), ``self.m()`` (same-class method), ``obj.m()`` (project-unique
+method name, used only where a rule opts in) — which covers the repo's
+idioms without pretending to be a type inferencer.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: object                    # ast.FunctionDef
+    qualname: str                   # Class.method / func / outer.inner
+    class_name: str                 # "" for free functions
+    module: object                  # ModuleInfo
+
+    @property
+    def name(self):
+        return self.node.name
+
+    def param_names(self, skip_self=True):
+        a = self.node.args
+        names = [p.arg for p in
+                 (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        if skip_self and self.class_name and names[:1] in (["self"],
+                                                           ["cls"]):
+            names = names[1:]
+        return names
+
+
+def index_functions(module):
+    """{qualname: FunctionInfo} for one module (cached on the module)."""
+    cached = getattr(module, "_fn_index", None)
+    if cached is not None:
+        return cached
+    out = {}
+
+    def walk(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[q] = FunctionInfo(child, q, cls, module)
+                walk(child, q + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", child.name)
+            else:
+                walk(child, prefix, cls)
+    walk(module.tree, "", "")
+    module._fn_index = out
+    return out
+
+
+def called_names(fn_node):
+    """(bare_calls, self_calls) name sets inside one function body —
+    the one-hop edge material."""
+    bare, self_m = set(), set()
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            bare.add(f.id)
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)
+              and f.value.id in ("self", "cls")):
+            self_m.add(f.attr)
+    return bare, self_m
+
+
+def one_hop_callees(info, fn_index):
+    """FunctionInfos called directly from ``info`` that live in the same
+    module: bare names resolving to free functions (or any unique
+    qualname tail) and ``self.m()`` into the same class."""
+    bare, self_m = called_names(info.node)
+    out = []
+    for q, cand in fn_index.items():
+        if cand is info:
+            continue
+        if (cand.class_name and cand.class_name == info.class_name
+                and q == f"{cand.class_name}.{cand.name}"
+                and cand.name in self_m):
+            out.append(cand)
+        elif cand.name in bare and (
+                q == cand.name                      # free function
+                or q == f"{info.qualname}.{cand.name}"):   # own nested def
+            out.append(cand)
+    return out

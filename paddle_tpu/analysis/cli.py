@@ -1,0 +1,141 @@
+"""``python -m paddle_tpu.analysis`` — the compile-hygiene lint CLI.
+
+Usage:
+    python -m paddle_tpu.analysis <paths...> [--rules=r1,r2]
+        [--format=text|json] [--baseline=FILE | --no-baseline]
+        [--write-baseline] [--show-baselined] [--list-rules]
+
+Exit codes: 0 clean (no new findings), 1 new findings, 2 usage error.
+The default baseline is ``tools/analysis_baseline.json`` when it exists
+under the working directory (the repo-root convention the CI guards
+rely on).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import publish_metrics
+from . import baseline as baseline_mod
+from .core import all_rules, analyze, rule_by_name
+from .report import render_json, render_text
+
+DEFAULT_BASELINE = os.path.join("tools", "analysis_baseline.json")
+
+# rank the analyzer's telemetry snapshot publishes under (fleet's router
+# owns 1000; lint posture sits beside it in the merged report)
+LINT_RANK = 1001
+
+
+def _list_rules():
+    rows = [(r.id, r.name, r.describe) for r in all_rules()]
+    rows.insert(0, ("PTL000", "(always on)",
+                    "suppression hygiene: malformed or justification-"
+                    "free '# ptl: disable' comments, unparseable files"))
+    width = max(len(n) for _, n, _ in rows)
+    return "\n".join(f"{i}  {n:<{width}}  {d}" for i, n, d in rows)
+
+
+def _maybe_publish_telemetry(result):
+    """Drop a lint-posture snapshot into PADDLE_TELEMETRY_DIR (when set)
+    so tools/telemetry_report.py merges it beside runtime counters."""
+    tdir = os.environ.get("PADDLE_TELEMETRY_DIR")
+    if not tdir or not os.path.isdir(tdir):
+        return
+    from . import family_dict
+    snap = {"rank": LINT_RANK, "time": round(time.time(), 6),
+            "families": {"analysis": family_dict(result)}}
+    try:
+        path = os.path.join(tdir, f"snapshot_rank{LINT_RANK}.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(snap, fh, sort_keys=True)
+    except OSError:
+        pass                    # telemetry must never break the lint
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        "paddle_tpu.analysis",
+        description="compile-hygiene static analyzer (AST, stdlib-only)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule names or ids "
+                             "(default: all)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: "
+                             f"{DEFAULT_BASELINE} when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline: every finding is new")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings to the baseline "
+                             "file and exit 0")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="print baselined findings too")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("paddle_tpu.analysis: no paths given", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        try:
+            rules = [rule_by_name(tok.strip())()
+                     for tok in args.rules.split(",") if tok.strip()]
+        except KeyError as e:
+            known = ", ".join(f"{r.name}({r.id})" for r in all_rules())
+            print(f"paddle_tpu.analysis: unknown rule {e.args[0]!r} "
+                  f"(known: {known})", file=sys.stderr)
+            return 2
+
+    try:
+        result = analyze(args.paths, rules=rules)
+    except FileNotFoundError as e:
+        print(f"paddle_tpu.analysis: no such path: {e.args[0]}",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        try:
+            previous = baseline_mod.load(path)
+        except ValueError:
+            previous = {}
+        n = baseline_mod.write(
+            path, result.findings, scanned_paths=result.scanned_paths,
+            rules_run=result.rules_run, previous=previous)
+        print(f"paddle_tpu.analysis: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {path}")
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        try:
+            entries = baseline_mod.load(baseline_path)
+        except ValueError as e:
+            print(f"paddle_tpu.analysis: {e}", file=sys.stderr)
+            return 2
+        baseline_mod.apply(result, entries)
+
+    publish_metrics(result)
+    _maybe_publish_telemetry(result)
+
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result,
+                          verbose_baselined=args.show_baselined))
+    return 1 if result.new_findings else 0

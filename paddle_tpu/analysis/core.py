@@ -1,0 +1,337 @@
+"""Analyzer core: findings, the rule registry, module loading,
+suppression parsing and the ``analyze()`` driver.
+
+Pure stdlib.  A :class:`ModuleInfo` is one parsed file plus the
+per-module summaries every rule shares (import/alias table, function
+index, suppression table); a :class:`Project` is the set of modules one
+``analyze()`` call sees, so compositional rules (lock-order) can reason
+across files.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+KEY_SEP = "|"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic.  ``key`` (rule id, posix relpath, enclosing scope,
+    symbol — no line number) is the stable identity baselines match on,
+    so re-formatting a file doesn't invalidate accepted entries."""
+    rule_id: str
+    path: str                       # posix relpath from the analysis cwd
+    line: int
+    col: int
+    message: str
+    symbol: str = ""                # offending name (baseline identity)
+    scope: str = "<module>"         # enclosing function qualname
+    new: bool = True                # cleared when a baseline entry covers it
+
+    @property
+    def key(self):
+        return KEY_SEP.join(
+            (self.rule_id, self.path, self.scope, self.symbol))
+
+    def format(self):
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule_id} {self.message}")
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+_RULES = []             # registration order == report order
+
+
+class Rule:
+    """Base rule: subclasses set ``id`` (PTLxxx), ``name`` (the
+    ``--rules=`` spelling) and ``describe``, then implement
+    ``visit_module`` (per-file) and/or ``finalize`` (whole-project,
+    after every module was visited).  Rules are instantiated fresh per
+    ``analyze()`` call, so instance state is per-run."""
+    id = "PTL???"
+    name = "unnamed"
+    describe = ""
+
+    def visit_module(self, module, add):
+        """Per-module pass; call ``add(Finding(...))`` to report."""
+
+    def finalize(self, project, add):
+        """Project-level pass, after all visit_module calls."""
+
+
+def register(cls):
+    _RULES.append(cls)
+    return cls
+
+
+def all_rules():
+    """Fresh instances of every registered rule, registration order."""
+    _load_builtin_rules()
+    return [cls() for cls in _RULES]
+
+
+def rule_by_name(spec):
+    """Resolve a ``--rules=`` token (rule name or PTL id) to its class;
+    raises KeyError on unknown tokens."""
+    _load_builtin_rules()
+    for cls in _RULES:
+        if spec in (cls.name, cls.id):
+            return cls
+    raise KeyError(spec)
+
+
+_builtin_loaded = [False]
+
+
+def _load_builtin_rules():
+    # deferred so core can be imported by the rule modules themselves
+    if _builtin_loaded[0]:
+        return
+    _builtin_loaded[0] = True
+    from . import (rules_compat, rules_donation,  # noqa: F401
+                   rules_hotpath, rules_locks, rules_tracer)
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+# "# ptl: disable=PTL001,PTL002 -- justification"  (same physical line)
+# "# ptl: disable-next=PTL001 -- justification"    (the following line)
+# Anchored to the START of the comment: a comment that merely QUOTES
+# the syntax ('# see "# ptl: disable=..." in the README') is neither a
+# live suppression nor a hygiene failure.
+_SUPPRESS_RE = re.compile(
+    r"^#\s*ptl:\s*(disable(?:-next)?)\s*=\s*([A-Za-z0-9_,\s]+?)"
+    r"\s*(?:--\s*(.*\S))?\s*$")
+_DIRECTIVE_RE = re.compile(r"^#\s*ptl:")
+
+
+def _comment_tokens(source):
+    """(lineno, comment_text) for every real COMMENT token — tokenize,
+    not a line regex, so string literals that *mention* the disable
+    syntax (docs, this analyzer's own sources) never parse as one."""
+    import io
+    import tokenize
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError):
+        return
+
+
+class Suppressions:
+    """Per-module table: line -> set of suppressed rule ids, plus PTL000
+    findings for disables with no ``-- justification`` text (a disable
+    without a recorded why is itself a finding, and not suppressible)."""
+
+    def __init__(self, relpath, source):
+        self.by_line = {}           # lineno (1-based) -> set(rule ids)
+        self.hygiene = []           # PTL000 findings
+        self.count_lines = 0
+        for n, text in _comment_tokens(source):
+            m = _SUPPRESS_RE.match(text)
+            if not m:
+                if _DIRECTIVE_RE.match(text):
+                    self.hygiene.append(Finding(
+                        "PTL000", relpath, n, 0,
+                        "malformed ptl control comment (expected "
+                        "'# ptl: disable=PTLxxx -- justification')",
+                        symbol="malformed", scope="<module>"))
+                continue
+            kind, ids_s, why = m.group(1), m.group(2), m.group(3)
+            ids = {i.strip() for i in ids_s.split(",") if i.strip()}
+            if not why:
+                self.hygiene.append(Finding(
+                    "PTL000", relpath, n, 0,
+                    f"suppression of {','.join(sorted(ids))} has no "
+                    f"justification (write '# ptl: {kind}=... -- why')",
+                    symbol="no-justification", scope="<module>"))
+                continue
+            target = n + 1 if kind == "disable-next" else n
+            self.by_line.setdefault(target, set()).update(ids)
+            self.count_lines += 1
+
+    def covers(self, finding):
+        return finding.rule_id in self.by_line.get(finding.line, ())
+
+
+# --------------------------------------------------------------------------
+# modules
+# --------------------------------------------------------------------------
+
+def _qualname_index(tree):
+    """[(start, end, qualname)] for every (async) function, innermost
+    resolvable by smallest span — the finding-scope lookup."""
+    spans = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                spans.append((child.lineno,
+                              getattr(child, "end_lineno", child.lineno),
+                              q))
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+    walk(tree, "")
+    return spans
+
+
+class ModuleInfo:
+    """One parsed source file + shared per-module summaries."""
+
+    def __init__(self, path, relpath, source):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = Suppressions(relpath, source)
+        from .resolve import ImportTable
+        self.imports = ImportTable(self.tree)
+        self._spans = _qualname_index(self.tree)
+
+    def scope_at(self, line):
+        """Innermost enclosing function qualname for a line."""
+        best = None
+        for start, end, q in self._spans:
+            if start <= line <= end:
+                if best is None or (end - start) < (best[1] - best[0]):
+                    best = (start, end, q)
+        return best[2] if best else "<module>"
+
+    @property
+    def modname(self):
+        base = os.path.basename(self.relpath)
+        return base[:-3] if base.endswith(".py") else base
+
+
+class Project:
+    def __init__(self, modules, errors=None):
+        self.modules = modules
+        self.errors = errors or []  # unparseable files' PTL000 findings
+
+
+# --------------------------------------------------------------------------
+# file collection + driver
+# --------------------------------------------------------------------------
+
+def _posix_rel(path, root):
+    rel = os.path.relpath(os.path.abspath(path), root)
+    return rel.replace(os.sep, "/")
+
+
+def collect_files(paths):
+    """Expand files/dirs into a sorted, deduped .py file list (skipping
+    __pycache__ and hidden dirs)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    seen, uniq = set(), []
+    for f in out:
+        a = os.path.abspath(f)
+        if a not in seen:
+            seen.add(a)
+            uniq.append(f)
+    return uniq
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list                  # post-suppression, baseline-marked
+    suppressed: int
+    files_scanned: int
+    scanned_paths: set
+    baseline_size: int = 0
+    stale_baseline: list = dataclasses.field(default_factory=list)
+    rules_run: list = dataclasses.field(default_factory=list)
+
+    @property
+    def new_findings(self):
+        return [f for f in self.findings if f.new]
+
+
+def analyze(paths, rules=None, root=None):
+    """Run ``rules`` (default: all) over ``paths``; returns an
+    :class:`AnalysisResult` with suppressions applied but NO baseline
+    comparison (the CLI layers that on via ``baseline.apply``)."""
+    root = os.path.abspath(root or os.getcwd())
+    files = collect_files(paths)
+    modules, errors = [], []
+    for f in files:
+        rel = _posix_rel(f, root)
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append(ModuleInfo(f, rel, src))
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+            line = getattr(e, "lineno", 0) or 0
+            errors.append(Finding(
+                "PTL000", rel, line, 0, f"file does not parse: {e}",
+                symbol="syntax-error", scope="<module>"))
+    project = Project(modules, errors)
+
+    instances = rules if rules is not None else all_rules()
+    raw = list(errors)
+    for mod in modules:
+        raw.extend(mod.suppressions.hygiene)
+
+    def add_for(rule):
+        def add(finding):
+            finding.rule_id = rule.id
+            raw.append(finding)
+        return add
+
+    for rule in instances:
+        adder = add_for(rule)
+        for mod in modules:
+            rule.visit_module(mod, adder)
+        rule.finalize(project, adder)
+
+    # apply suppressions (PTL000 is exempt: hygiene findings cannot be
+    # waved off with the mechanism they police)
+    supp_tables = {m.relpath: m.suppressions for m in modules}
+    kept, suppressed = [], 0
+    for f in raw:
+        table = supp_tables.get(f.path)
+        if (f.rule_id != "PTL000" and table is not None
+                and table.covers(f)):
+            suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return AnalysisResult(
+        findings=kept, suppressed=suppressed, files_scanned=len(files),
+        scanned_paths={m.relpath for m in modules},
+        rules_run=[r.id for r in instances])

@@ -1,0 +1,50 @@
+"""Text / JSON reporters for analysis results."""
+from __future__ import annotations
+
+import json
+
+
+def render_text(result, verbose_baselined=False):
+    """Human/CI text: one ``path:line:col: PTLxxx message`` per NEW
+    finding (baselined ones summarized unless asked for), stale-entry
+    warnings, one summary line."""
+    lines = []
+    for f in result.findings:
+        if f.new or verbose_baselined:
+            mark = "" if f.new else " [baselined]"
+            lines.append(f.format() + mark)
+    for s in result.stale_baseline:
+        lines.append(f"warning: stale baseline entry "
+                     f"({s['unused']} unused): {s['key']}")
+    new = len(result.new_findings)
+    base = len(result.findings) - new
+    lines.append(
+        f"paddle_tpu.analysis: {new} new finding(s), {base} baselined, "
+        f"{result.suppressed} suppressed, "
+        f"{len(result.stale_baseline)} stale baseline entr"
+        f"{'y' if len(result.stale_baseline) == 1 else 'ies'}; "
+        f"{result.files_scanned} files, "
+        f"rules {','.join(result.rules_run)}")
+    return "\n".join(lines)
+
+
+def render_json(result):
+    by_rule = {}
+    for f in result.findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    doc = {
+        "version": 1,
+        "files_scanned": result.files_scanned,
+        "rules_run": result.rules_run,
+        "findings": [f.to_dict() for f in result.findings],
+        "summary": {
+            "new": len(result.new_findings),
+            "baselined": (len(result.findings)
+                          - len(result.new_findings)),
+            "suppressed": result.suppressed,
+            "by_rule": by_rule,
+            "baseline_size": result.baseline_size,
+            "stale_baseline": result.stale_baseline,
+        },
+    }
+    return json.dumps(doc, indent=1, sort_keys=False)

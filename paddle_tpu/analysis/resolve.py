@@ -1,0 +1,113 @@
+"""Import/alias resolution — the piece the old grep guard lacked.
+
+Builds a per-module table mapping every locally-bound name to the dotted
+origin it refers to, covering all the spellings of one API:
+
+    import jax                                  # jax -> jax
+    import jax.experimental.shard_map as sm     # sm  -> jax.experimental.shard_map
+    from jax.experimental import shard_map as s # s   -> jax.experimental.shard_map
+    from jax.sharding import NamedSharding      # NamedSharding -> jax.sharding.NamedSharding
+    from ..framework.jax_compat import shard_map# shard_map -> .framework.jax_compat.shard_map
+    sm2 = jax.experimental.shard_map            # sm2 -> jax.experimental.shard_map
+
+``qualify(node)`` then resolves an ``ast.Name``/``ast.Attribute`` chain
+to its dotted origin (``sm.shard_map`` -> ``jax.experimental.shard_map.
+shard_map``), so rules match on ORIGINS, never on surface spellings.
+
+Scoping is module-flat on purpose: function-local imports (a repo idiom
+for lazy jax loading) bind into the same table.  Relative imports keep
+their leading dots; matchers use suffix semantics for those.
+"""
+from __future__ import annotations
+
+import ast
+
+
+def dotted_name(node):
+    """Textual ``a.b.c`` chain for Name/Attribute nodes, else None —
+    the one shared chain-to-string helper (rules reuse it for donation
+    operand and lock identities)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportTable:
+    def __init__(self, tree):
+        self.origins = {}           # local name -> dotted origin
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.origins[a.asname] = a.name
+                    else:
+                        # "import jax.numpy" binds the ROOT name
+                        root = a.name.split(".", 1)[0]
+                        self.origins[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    dot = "." if base and not base.endswith(".") else ""
+                    self.origins[local] = f"{base}{dot}{a.name}"
+        # simple module-level aliasing: sm = jax.experimental.shard_map
+        for node in getattr(tree, "body", []):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                dotted = self._dotted(node.value)
+                if dotted:
+                    origin = self.qualify_dotted(dotted)
+                    if origin:
+                        self.origins[node.targets[0].id] = origin
+
+    _dotted = staticmethod(dotted_name)
+
+    def qualify_dotted(self, dotted):
+        """Resolve a textual chain's root through the table."""
+        if not dotted:
+            return None
+        root, _, rest = dotted.partition(".")
+        origin = self.origins.get(root)
+        if origin is None:
+            return None
+        return f"{origin}.{rest}" if rest else origin
+
+    def qualify(self, node):
+        """Dotted origin of a Name/Attribute node, or None when the root
+        name was never import-bound (a plain local variable)."""
+        return self.qualify_dotted(self._dotted(node))
+
+    def root_origin(self, node):
+        """Origin of just the ROOT name of a chain (to tell ``jax.
+        sharding.Mesh`` — root 'jax', worth flagging the use — from
+        ``Mesh(...)`` — root origin itself the moving name, already
+        flagged at its import)."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        if isinstance(node, ast.Name):
+            return self.origins.get(node.id)
+        return None
+
+
+def matches(origin, targets):
+    """True when ``origin`` names one of ``targets`` or a member of one.
+    Absolute origins prefix-match; relative origins (leading dot) match
+    by suffix so ``..framework.jax_compat.shard_map`` hits a
+    ``framework.jax_compat.shard_map`` target."""
+    if not origin:
+        return None
+    for t in targets:
+        if origin == t or origin.startswith(t + "."):
+            return t
+        if origin.startswith(".") and (
+                origin.lstrip(".").endswith(t)
+                or (t + ".") in origin.lstrip(".")):
+            return t
+    return None

@@ -1,0 +1,280 @@
+"""PTL003 — donation safety.
+
+A buffer passed at a ``donate_argnums`` position is dead the moment the
+jitted call launches: XLA may alias its memory for outputs.  Reading it
+afterwards returns garbage (or raises on some backends only, so CPU
+tests stay green while TPU corrupts); passing the SAME object at two
+donated positions aliases one buffer into two donated operands.
+
+Statically tracked shapes:
+
+* ``f = jax.jit(g, donate_argnums=(0,))`` /
+  ``self._step = jax.jit(g, donate_argnums=...)`` — direct bindings
+* ``def _build(): return jax.jit(g, donate_argnums=...)`` then
+  ``self._step = self._build()`` — the repo's executable-builder idiom
+  (positions kept when the literal resolves, else "unknown": only the
+  duplicate-operand check applies)
+
+Within each function body (linear statement order, loop bodies walked
+twice so an iteration-N donation is seen by an iteration-N+1 read):
+a donated operand name is dead until rebound; any read flags.
+``cache = step(cache, x)`` is the sanctioned idiom — the rebind
+revives the name.
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import index_functions
+from .core import Finding, Rule, register
+from .resolve import dotted_name
+from .resolve import matches
+
+JIT_NAMES = ("jax.jit",)
+
+
+_dotted = dotted_name
+
+
+def _donate_positions(call):
+    """Literal donate_argnums -> frozenset of ints; present-but-
+    unresolvable -> None ("unknown"); absent -> no donation (False)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            return None
+        if isinstance(val, int):
+            return frozenset([val])
+        try:
+            return frozenset(int(v) for v in val)
+        except (TypeError, ValueError):
+            return None
+    return False
+
+
+def _terminates(body):
+    """Does this statement list end by leaving the enclosing block?"""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _jit_call(node, imports):
+    """The jax.jit(...) Call when ``node`` is one with donation, else
+    None.  Returns (call, positions)."""
+    if isinstance(node, ast.Call) and \
+            matches(imports.qualify(node.func), JIT_NAMES):
+        pos = _donate_positions(node)
+        if pos is not False:
+            return node, pos
+    return None
+
+
+def collect_donated_callables(mod):
+    """{dotted name: positions} of callables known to donate.  Dotted
+    names are how call sites spell them (``step_fn``, ``self._decode``).
+    ``positions`` is a frozenset or None (unknown)."""
+    imports = mod.imports
+    fns = index_functions(mod)
+    donated = {}
+
+    # builder functions whose return value is a donated jit
+    builder_pos = {}
+    for q, info in fns.items():
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                hit = _jit_call(node.value, imports)
+                if hit:
+                    builder_pos[info.name] = hit[1]
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = _dotted(node.targets[0])
+        if target is None:
+            continue
+        hit = _jit_call(node.value, imports)
+        if hit:
+            donated[target] = hit[1]
+            continue
+        # self._step = self._build_step(...)
+        if isinstance(node.value, ast.Call):
+            fname = None
+            f = node.value.func
+            if isinstance(f, ast.Name):
+                fname = f.id
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                fname = f.attr
+            if fname in builder_pos:
+                donated[target] = builder_pos[fname]
+    return donated
+
+
+class _DonationChecker:
+    def __init__(self, rule, mod, info, donated, add):
+        self.rule, self.mod, self.info = rule, mod, info
+        self.donated, self.add = donated, add
+        self.dead = {}              # name -> donating call lineno
+        self._flagged = set()       # loop bodies run twice: dedupe
+
+    def flag(self, node, msg, symbol):
+        key = (node.lineno, node.col_offset, symbol)
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        self.add(Finding(
+            self.rule.id, self.mod.relpath, node.lineno,
+            node.col_offset, msg, symbol=symbol,
+            scope=self.info.qualname))
+
+    def _donating_calls(self, expr):
+        """[(call, positions)] for calls to known-donated callables in
+        this expression."""
+        out = []
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func)
+                if name in self.donated:
+                    out.append((sub, self.donated[name], name))
+        return out
+
+    def _reads(self, expr, skip_calls):
+        """Dotted names read inside ``expr``, excluding the operand
+        lists of this statement's own donating calls."""
+        skip_nodes = set()
+        for call, _, _ in skip_calls:
+            for a in call.args:
+                for s in ast.walk(a):
+                    skip_nodes.add(id(s))
+            skip_nodes.add(id(call.func))
+        reads = []
+        for sub in ast.walk(expr):
+            if id(sub) in skip_nodes:
+                continue
+            if isinstance(sub, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(sub, "ctx", None), ast.Load):
+                name = _dotted(sub)
+                if name:
+                    reads.append((name, sub))
+        return reads
+
+    def _process_donation(self, call, positions, name):
+        seen = {}
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                return                  # positions unmappable
+            arg_name = _dotted(a)
+            if arg_name is None:
+                continue
+            is_donated = positions is None or i in positions
+            if not is_donated:
+                continue
+            if arg_name in seen:
+                hedge = ("" if positions is not None
+                         else " (donate positions unresolved: every "
+                              "positional operand is a candidate)")
+                self.flag(call,
+                          f"same object `{arg_name}` passed at two "
+                          f"donated positions of `{name}` "
+                          f"(positions {seen[arg_name]} and {i})"
+                          f"{hedge}",
+                          symbol=f"dup:{arg_name}")
+            seen[arg_name] = i
+            if positions is not None:
+                self.dead[arg_name] = call.lineno
+
+    def run_stmt(self, stmt):
+        exprs = [sub for sub in ast.iter_child_nodes(stmt)
+                 if isinstance(sub, ast.expr)]
+        calls = []
+        for e in exprs:
+            calls.extend(self._donating_calls(e))
+        # 1) reads of already-dead names (this statement's own donating
+        #    operands excluded — they're being consumed, not read)
+        for e in exprs:
+            for name, node in self._reads(e, calls):
+                if name in self.dead:
+                    self.flag(node,
+                              f"`{name}` read after being donated "
+                              f"(donated at line {self.dead[name]}) — "
+                              f"buffer may be aliased by XLA",
+                              symbol=f"use-after-donate:{name}")
+        # 2) new donations
+        for call, positions, name in calls:
+            self._process_donation(call, positions, name)
+        # 3) rebinds revive
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                name = _dotted(sub) if isinstance(
+                    sub, (ast.Name, ast.Attribute)) else None
+                if name:
+                    self.dead.pop(name, None)
+        # recurse into compound statements.  A branch whose body ENDS the
+        # function (return/raise/break/continue) cannot leak its
+        # donations into the code after the If — the classic
+        # early-return-then-direct-path shape.
+        if isinstance(stmt, ast.If):
+            before = dict(self.dead)
+            for s in stmt.body:
+                self.run_stmt(s)
+            body_dead = (dict(before) if _terminates(stmt.body)
+                         else dict(self.dead))
+            self.dead = dict(before)
+            for s in stmt.orelse:
+                self.run_stmt(s)
+            else_dead = (dict(before) if _terminates(stmt.orelse)
+                         else dict(self.dead))
+            merged = dict(body_dead)
+            merged.update(else_dead)
+            self.dead = merged
+        elif isinstance(stmt, ast.While):
+            for _ in range(2):
+                for s in stmt.body:
+                    self.run_stmt(s)
+            for s in stmt.orelse:
+                self.run_stmt(s)
+        elif isinstance(stmt, ast.For):
+            for _ in range(2):
+                for s in stmt.body:
+                    self.run_stmt(s)
+            for s in stmt.orelse:
+                self.run_stmt(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for s in stmt.body:
+                self.run_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self.run_stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self.run_stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self.run_stmt(s)
+
+
+@register
+class DonationSafetyRule(Rule):
+    id = "PTL003"
+    name = "donation"
+    describe = ("reads of a buffer after donating it to a jitted call; "
+                "same object at two donated positions")
+
+    def visit_module(self, mod, add):
+        donated = collect_donated_callables(mod)
+        if not donated:
+            return
+        for q, info in index_functions(mod).items():
+            checker = _DonationChecker(self, mod, info, donated, add)
+            for stmt in info.node.body:
+                checker.run_stmt(stmt)

@@ -1,0 +1,92 @@
+"""PTL004 — host sync in a hot path.
+
+The serving/reducer/router hot loops are latency budgets: one stray
+``block_until_ready`` / ``jax.device_get`` / ``np.asarray`` of a device
+value stalls the async dispatch pipeline every iteration.  Hot ROOTS
+are the known per-iteration bodies (engine ``step``/``_step_inner``/
+``_admit``, the reducer's grad-ready hook + bucket-launch +
+``all_reduce_flat`` transports, the fleet router's ``_drive`` loop);
+from each root the scan propagates ONE level through the module-local
+call graph (bare calls and ``self.`` methods), mirroring how the real
+sync sites hide one helper deep.
+
+Every intentional sync (the sampled-token readback, the reducer's
+one-in-flight collective drain) carries an inline
+``# ptl: disable=PTL004 -- why``; anything new fails lint.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .callgraph import index_functions, one_hop_callees
+from .core import Finding, Rule, register
+from .resolve import matches
+
+# (path regex, qualname regex) — both must match for a hot ROOT
+HOT_ROOTS = (
+    (r"(^|/)serving\.py$",
+     r"(^|\.)(step|_step_inner|_admit)$"),
+    (r"(^|/)reducer\.py$",
+     r"(^|\.)(_on_grad_ready|_launch|all_reduce_flat|hook)$"),
+    (r"(^|/)fleet\.py$",
+     r"(^|\.)_drive$"),
+)
+
+SYNC_ATTR_CALLS = {"block_until_ready", "item", "tolist"}
+SYNC_ORIGINS = ("jax.device_get", "numpy.asarray", "numpy.array")
+
+
+def hot_functions(mod):
+    """{qualname: provenance} — roots plus one-hop callees."""
+    fns = index_functions(mod)
+    hot = {}
+    for path_re, qual_re in HOT_ROOTS:
+        if not re.search(path_re, mod.relpath):
+            continue
+        for q, info in fns.items():
+            if re.search(qual_re, q):
+                hot.setdefault(q, f"hot root {q}")
+    for q in list(hot):
+        info = fns[q]
+        for callee in one_hop_callees(info, fns):
+            hot.setdefault(callee.qualname, f"reachable from {q}")
+    return hot
+
+
+@register
+class HostSyncRule(Rule):
+    id = "PTL004"
+    name = "host-sync"
+    describe = ("block_until_ready / jax.device_get / np.asarray inside "
+                "the engine/reducer/router hot loops (one-hop deep)")
+
+    def visit_module(self, mod, add):
+        hot = hot_functions(mod)
+        if not hot:
+            return
+        fns = index_functions(mod)
+        seen = set()    # a nested hot def is inside its parent's walk
+        for q, why in hot.items():
+            info = fns[q]
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = None
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in SYNC_ATTR_CALLS):
+                    label = f".{node.func.attr}()"
+                else:
+                    origin = mod.imports.qualify(node.func)
+                    hit = matches(origin, SYNC_ORIGINS)
+                    if hit:
+                        label = hit.replace("numpy.", "np.")
+                if label is None or (node.lineno, node.col_offset) \
+                        in seen:
+                    continue
+                seen.add((node.lineno, node.col_offset))
+                add(Finding(
+                    self.id, mod.relpath, node.lineno, node.col_offset,
+                    f"host sync {label} in hot path ({q}; {why}) — "
+                    f"stalls async dispatch every iteration",
+                    symbol=f"{label}@{q}", scope=q))

@@ -1,0 +1,239 @@
+"""PTL005 — lock-order cycles (potential ABBA deadlocks).
+
+The fleet/router/autoscaler is a multi-threaded system whose zero-lost
+guarantee lives in ``with self._lock:`` discipline across
+``inference/fleet.py`` / ``autoscale.py`` / ``serving.py`` (the rule
+runs over every analyzed file; those are where locks live today).
+Compositional, after RacerD: each function gets a summary — the locks
+it may acquire, directly or through callees (transitively, memoized) —
+then every lexically-held region contributes edges ``held -> acquired``
+into one project-wide lock graph.  A cycle means two threads can
+interleave acquisition orders and deadlock.  Self-edges are dropped:
+re-entering the same RLock is the repo's sanctioned idiom.
+
+Lock identity: ``with self._lock`` in class C -> ``C._lock``; a
+module-level ``with _lock`` -> ``<module>._lock``.  Anything whose
+terminal name contains "lock"/"mutex"/"cond" (or is a bare
+``.acquire()`` receiver) counts as a lock.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .callgraph import index_functions
+from .core import Finding, Rule, register
+from .resolve import dotted_name
+
+_LOCKISH = re.compile(r"(lock|mutex|cond)", re.IGNORECASE)
+
+
+_dotted = dotted_name
+
+
+def _lock_id(expr, info):
+    """Canonical lock name for a with/acquire target, or None."""
+    name = _dotted(expr)
+    if not name:
+        return None
+    terminal = name.rsplit(".", 1)[-1]
+    if not _LOCKISH.search(terminal):
+        return None
+    if name.startswith(("self.", "cls.")):
+        owner = info.class_name or info.module.modname
+        return f"{owner}.{name.split('.', 1)[1]}"
+    return f"{info.module.modname}.{name}"
+
+
+class _FnLocks(ast.NodeVisitor):
+    """One function's lock summary: ``direct`` acquisitions (each with
+    its lexical body), ``calls`` made while holding each lock, and
+    ``all_calls`` (for the transitive may-acquire summary)."""
+
+    def __init__(self, info):
+        self.info = info
+        self.held = []              # stack of lock ids
+        self.direct = []            # (lock, line)
+        self.edges = []             # (held, acquired, line) lexical
+        self.calls_under = []       # (held_lock, callee key, line)
+        self.all_calls = []         # callee keys
+        self.visit(info.node)
+
+    def _callee_key(self, call):
+        f = call.func
+        if isinstance(f, ast.Name):
+            return ("bare", f.id)
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                return ("self", f.attr)
+            return ("attr", f.attr)
+        return None
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            lock = _lock_id(item.context_expr, self.info)
+            if lock:
+                self.direct.append((lock, node.lineno))
+                # multiple `with a, b:` items nest left-to-right, so
+                # the held stack already includes earlier items
+                for held in self.held:
+                    self.edges.append((held, lock, node.lineno))
+                acquired.append(lock)
+                self.held.append(lock)
+            else:
+                # a non-lock context expression can CALL into code that
+                # acquires (with lock_a, self._handle(): ...) — visit it
+                # under the locks held so far
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        # remove this with's OWN locks by identity: an .acquire() in the
+        # body pushed entries that survive the block
+        for lock in reversed(acquired):
+            for i in range(len(self.held) - 1, -1, -1):
+                if self.held[i] == lock:
+                    del self.held[i]
+                    break
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        # x.acquire() takes the lock for the rest of the fn (until a
+        # matching x.release()), so later acquisitions get edges FROM it
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            lock = _lock_id(node.func.value, self.info)
+            if lock:
+                self.direct.append((lock, node.lineno))
+                for held in self.held:
+                    self.edges.append((held, lock, node.lineno))
+                self.held.append(lock)
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "release":
+            lock = _lock_id(node.func.value, self.info)
+            if lock and lock in self.held:
+                # drop the most recent acquisition of this lock
+                for i in range(len(self.held) - 1, -1, -1):
+                    if self.held[i] == lock:
+                        del self.held[i]
+                        break
+        key = self._callee_key(node)
+        if key:
+            self.all_calls.append(key)
+            for held in self.held:
+                self.calls_under.append((held, key, node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if node is self.info.node:
+            self.generic_visit(node)
+        # nested defs analyzed via their own FunctionInfo
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _resolve(key, info, by_class, by_name, by_method):
+    """Callee key -> list of function ids.  ``self.m`` resolves in the
+    owning class; a bare name resolves to same-module free functions;
+    ``obj.m`` resolves only when exactly ONE analyzed class defines a
+    method of that name (bounded heuristic)."""
+    kind, name = key
+    if kind == "self":
+        return by_class.get((info.class_name, name), [])
+    if kind == "bare":
+        return [fid for fid in by_name.get(name, [])
+                if fid[0] is info.module]
+    cands = by_method.get(name, [])
+    return cands if len(cands) == 1 else []
+
+
+@register
+class LockOrderRule(Rule):
+    id = "PTL005"
+    name = "lock-order"
+    describe = ("cycles in the cross-module lock-acquisition graph "
+                "(ABBA deadlock candidates)")
+
+    def __init__(self):
+        self.summaries = {}         # fid -> _FnLocks
+
+    def visit_module(self, mod, add):
+        for q, info in index_functions(mod).items():
+            s = _FnLocks(info)
+            if s.direct or s.all_calls:
+                self.summaries[(mod, q)] = s
+
+    def finalize(self, project, add):
+        by_class, by_name, by_method = {}, {}, {}
+        infos = {}
+        for (mod, q), s in self.summaries.items():
+            fid = (mod, q)
+            infos[fid] = s.info
+            if s.info.class_name:
+                by_class.setdefault(
+                    (s.info.class_name, s.info.name), []).append(fid)
+                by_method.setdefault(s.info.name, []).append(fid)
+            else:
+                by_name.setdefault(s.info.name, []).append(fid)
+
+        # transitive may-acquire per function, memoized + cycle-safe
+        memo = {}
+
+        def may_acquire(fid, stack):
+            if fid in memo:
+                return memo[fid]
+            if fid in stack:
+                return set()
+            s = self.summaries.get(fid)
+            if s is None:
+                return set()
+            stack = stack | {fid}
+            out = {lock for lock, _ in s.direct}
+            for key in s.all_calls:
+                for callee in _resolve(key, s.info, by_class, by_name,
+                                       by_method):
+                    out |= may_acquire(callee, stack)
+            memo[fid] = out
+            return out
+
+        # project lock graph: lexical edges + call-through edges
+        graph = {}                  # lock -> {lock: (mod, line, via)}
+        for fid, s in self.summaries.items():
+            for a, b, line in s.edges:
+                if a != b:
+                    graph.setdefault(a, {}).setdefault(
+                        b, (s.info.module, line, s.info.qualname))
+            for held, key, line in s.calls_under:
+                for callee in _resolve(key, s.info, by_class, by_name,
+                                       by_method):
+                    for b in may_acquire(callee, frozenset()):
+                        if held != b:
+                            graph.setdefault(held, {}).setdefault(
+                                b, (s.info.module, line,
+                                    f"{s.info.qualname} -> "
+                                    f"{infos[callee].qualname}"))
+
+        # cycle detection (DFS, each cycle reported once)
+        reported = set()
+
+        def dfs(start, node, path):
+            for nxt, site in sorted(graph.get(node, {}).items()):
+                if nxt == start and len(path) > 1:
+                    cyc = frozenset(path)
+                    if cyc in reported:
+                        continue
+                    reported.add(cyc)
+                    mod, line, via = site
+                    order = " -> ".join(path + [start])
+                    add(Finding(
+                        self.id, mod.relpath, line, 0,
+                        f"lock-order cycle {order} (edge held via "
+                        f"{via}) — ABBA deadlock candidate",
+                        symbol=order, scope=mod.scope_at(line)))
+                elif nxt not in path and nxt in graph:
+                    dfs(start, nxt, path + [nxt])
+
+        for lock in sorted(graph):
+            dfs(lock, lock, [lock])

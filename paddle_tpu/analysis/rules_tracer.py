@@ -1,0 +1,417 @@
+"""PTL002 — tracer-leak / recompile hazard.
+
+Inside functions that jax traces (``jax.jit``/``pmap``/``vmap``/
+``grad``/``value_and_grad``, ``jax_compat.shard_map``, and the repo's
+dispatch-cached callables via ``ops.dispatch.call``), Python-level
+observation of a traced value either raises a ConcretizationError or —
+worse — silently retraces / permanently falls back to eager, breaking
+the zero-steady-state-compiles contract.  Flagged, under a forward
+taint pass (parameters taint; ``.shape``/``.dtype``/``len()``/
+``is None``/``result_type`` don't):
+
+* ``if``/``while`` on a traced value
+* ``int()``/``float()``/``bool()`` of a traced value
+* ``.item()``/``.tolist()`` on a traced value
+* f-string formatting of a traced value
+* ``np.asarray``/``np.array`` of a traced value
+
+Contexts are STRICT (jax.jit & friends: every non-static parameter is
+a tracer) or WEAK (``ops.dispatch.call``: the PR-1 signature cache
+bakes hashable non-array args into the key, so flag-shaped branches —
+``if use_softmax:``, ``reduction == "mean"`` — are static by design;
+only value-ordering tests and hard concretizations flag there).
+
+Traced contexts propagate ONE hop through the module-local call graph,
+argument-wise: a helper's parameter is tainted only when some traced
+call site passes it a tainted argument — so config objects threaded
+into jitted helpers stay clean.
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import index_functions
+from .core import Finding, Rule, register
+from .resolve import matches
+
+STRICT_WRAPPERS = (
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+    "framework.jax_compat.shard_map",
+    "paddle_tpu.framework.jax_compat.shard_map", "jax.checkpoint",
+)
+WEAK_WRAPPERS = ("ops.dispatch.call", "paddle_tpu.ops.dispatch.call")
+
+# attribute reads that yield STATIC (python-level) facts about a tracer
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                "weak_type"}
+# bare-name calls whose result is static regardless of argument taint
+STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type",
+                "id", "repr", "range", "enumerate", "zip"}
+# resolved origins that query static array facts
+STATIC_CALL_ORIGINS = (
+    "jax.numpy.result_type", "jax.numpy.issubdtype", "jax.numpy.shape",
+    "jax.numpy.ndim", "jax.numpy.dtype", "numpy.result_type",
+    "numpy.issubdtype", "numpy.shape", "numpy.ndim", "numpy.dtype",
+    "jax.dtypes.result_type",
+)
+HOST_CASTS = {"int", "float", "bool", "complex"}
+SYNC_METHODS = {"item", "tolist"}
+
+
+def _static_argset(call_or_deco):
+    """Parameter positions/names excluded from tracing by a literal
+    ``static_argnums``/``static_argnames`` on a jit call."""
+    nums, names = set(), set()
+    if not isinstance(call_or_deco, ast.Call):
+        return nums, names
+    for kw in call_or_deco.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        if kw.arg == "static_argnums":
+            nums.update([val] if isinstance(val, int) else val)
+        elif kw.arg == "static_argnames":
+            names.update([val] if isinstance(val, str) else val)
+    return nums, names
+
+
+class TracedContext:
+    def __init__(self, info, tainted_params, strict, why):
+        self.info = info
+        self.tainted_params = tainted_params
+        self.strict = strict
+        self.why = why
+
+
+def find_direct_traced(mod):
+    """{qualname: TracedContext} for functions this module directly
+    wraps in a tracing transform (no call-graph hop yet)."""
+    fns = index_functions(mod)
+    out = {}
+
+    def mark(info, call, strict, why):
+        if info.qualname in out:
+            return
+        nums, names = _static_argset(call)
+        params = info.param_names(skip_self=True)
+        all_params = info.param_names(skip_self=False)
+        offset = len(all_params) - len(params)
+        tainted = {p for i, p in enumerate(params)
+                   if (i + offset) not in nums and p not in names}
+        out[info.qualname] = TracedContext(info, tainted, strict, why)
+
+    # (a) decorated defs
+    for q, info in fns.items():
+        for deco in info.node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            origin = mod.imports.qualify(target)
+            if matches(origin, STRICT_WRAPPERS):
+                mark(info, deco if isinstance(deco, ast.Call) else None,
+                     True, f"decorated @{origin}")
+                break
+            if (isinstance(deco, ast.Call)
+                    and matches(origin, ("functools.partial", "partial"))
+                    and deco.args):
+                inner = mod.imports.qualify(deco.args[0])
+                if matches(inner, STRICT_WRAPPERS):
+                    mark(info, deco, True, f"decorated partial({inner})")
+                    break
+    # (b) wrapper called on a local function: jax.jit(step, ...)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        origin = mod.imports.qualify(node.func)
+        strict = bool(matches(origin, STRICT_WRAPPERS))
+        weak = bool(matches(origin, WEAK_WRAPPERS))
+        if not strict and not weak:
+            continue
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Name):
+            # resolve the NAME the way python would at the call site: a
+            # def nested in the same enclosing function, else a
+            # module-level function — never an unrelated same-named
+            # method elsewhere in the file
+            scope = mod.scope_at(node.lineno)
+            for q, info in fns.items():
+                if info.name != arg0.id:
+                    continue
+                if q == arg0.id or (scope != "<module>"
+                                    and q == f"{scope}.{arg0.id}"):
+                    mark(info, node, strict, f"passed to {origin}")
+    return out
+
+
+def _flag_shaped(test):
+    """True for tests that read like config/flag checks — static under
+    the dispatch signature cache: bare names, ``not name``, attribute
+    chains, ==/!=/in against constants, boolean combinations thereof."""
+    if isinstance(test, (ast.Name, ast.Attribute, ast.Constant)):
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _flag_shaped(test.operand)
+    if isinstance(test, ast.BoolOp):
+        return all(_flag_shaped(v) for v in test.values)
+    if isinstance(test, ast.Compare):
+        eqish = all(isinstance(o, (ast.Eq, ast.NotEq, ast.In, ast.NotIn,
+                                   ast.Is, ast.IsNot))
+                    for o in test.ops)
+        plain = all(isinstance(c, (ast.Constant, ast.Name,
+                                   ast.Attribute))
+                    for c in [test.left] + test.comparators)
+        return eqish and plain
+    return False
+
+
+class _TaintChecker:
+    """One traced function's forward pass: track tainted names, flag
+    host-level observations of them.  ``on_call`` (when set) receives
+    every Call node plus a taint predicate — the rule uses it to
+    propagate argument-wise taint to one-hop callees."""
+
+    def __init__(self, rule, mod, ctx, add, on_call=None):
+        self.rule, self.mod, self.ctx, self.add = rule, mod, ctx, add
+        self.tainted = set(ctx.tainted_params)
+        self.on_call = on_call
+        self._flagged = set()       # loop bodies run twice: dedupe
+
+    def flag(self, node, what, symbol):
+        key = (node.lineno, node.col_offset, symbol)
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        info = self.ctx.info
+        self.add(Finding(
+            self.rule.id, self.mod.relpath, node.lineno,
+            node.col_offset,
+            f"{what} inside traced code ({info.qualname}: "
+            f"{self.ctx.why}) — silent retrace / concretization",
+            symbol=f"{symbol}@{info.qualname}",
+            scope=info.qualname))
+
+    # ------------------------------------------------------ taint eval
+    def taints(self, node):
+        """Does evaluating ``node`` yield a traced value?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.taints(node.value)
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if fname in STATIC_CALLS or fname in HOST_CASTS:
+                return False
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_METHODS):
+                return False        # .item() result is a python scalar
+            origin = self.mod.imports.qualify(node.func)
+            if origin and matches(origin, STATIC_CALL_ORIGINS):
+                return False
+            recv = (self.taints(node.func.value)
+                    if isinstance(node.func, ast.Attribute) else False)
+            return (recv or any(self.taints(a) for a in node.args)
+                    or any(self.taints(kw.value)
+                           for kw in node.keywords))
+        if isinstance(node, ast.Compare):
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in node.ops):
+                return False        # identity checks are static
+            return self.taints(node.left) or any(
+                self.taints(c) for c in node.comparators)
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, (ast.Lambda, ast.ListComp, ast.SetComp,
+                             ast.DictComp, ast.GeneratorExp)):
+            return False            # separate scope; kept conservative
+        if isinstance(node, ast.expr):
+            return any(self.taints(c) for c in ast.iter_child_nodes(node)
+                       if isinstance(c, ast.expr))
+        return False
+
+    # ------------------------------------------------------- checking
+    def check_expr(self, node):
+        """Flag host observations anywhere inside an expression."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                if self.on_call is not None:
+                    self.on_call(sub, self.taints)
+                fname = sub.func.id if isinstance(sub.func, ast.Name) \
+                    else None
+                if fname in HOST_CASTS and sub.args and \
+                        self.taints(sub.args[0]):
+                    self.flag(sub, f"{fname}() of a traced value",
+                              f"{fname}()")
+                origin = self.mod.imports.qualify(sub.func)
+                if origin and matches(origin, ("numpy.asarray",
+                                               "numpy.array")) \
+                        and sub.args and self.taints(sub.args[0]):
+                    self.flag(sub, "np.asarray of a traced value",
+                              "np.asarray")
+                if (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in SYNC_METHODS
+                        and self.taints(sub.func.value)):
+                    self.flag(sub, f".{sub.func.attr}() on a traced "
+                                   f"value", f".{sub.func.attr}()")
+            elif isinstance(sub, ast.FormattedValue):
+                if self.taints(sub.value):
+                    self.flag(sub, "f-string formatting of a traced "
+                                   "value", "f-string")
+
+    def check_branch(self, stmt):
+        self.check_expr(stmt.test)
+        if not self.taints(stmt.test):
+            return
+        if not self.ctx.strict and _flag_shaped(stmt.test):
+            return      # dispatch bakes flags into the signature key
+        kind = "if" if isinstance(stmt, ast.If) else "while"
+        self.flag(stmt, f"python `{kind}` on a traced value", kind)
+
+    def assign_targets(self, target, tainted):
+        if isinstance(target, ast.Name):
+            (self.tainted.add if tainted
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.assign_targets(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign_targets(target.value, tainted)
+
+    def run(self):
+        self.run_body(self.ctx.info.node.body)
+
+    def run_body(self, body):
+        for stmt in body:
+            self.run_stmt(stmt)
+
+    def run_stmt(self, stmt):
+        if isinstance(stmt, ast.Assign):
+            self.check_expr(stmt.value)
+            t = self.taints(stmt.value)
+            for target in stmt.targets:
+                self.assign_targets(target, t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self.check_expr(stmt.value)
+                t = self.taints(stmt.value) or (
+                    isinstance(stmt, ast.AugAssign)
+                    and self.taints(stmt.target))
+                self.assign_targets(stmt.target, t)
+        elif isinstance(stmt, ast.If):
+            self.check_branch(stmt)
+            self.run_body(stmt.body)
+            self.run_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            # two passes, RE-CHECKING the test after each: taint
+            # assigned in the body reaches the next iteration's test
+            # (the accumulate-in-loop shape); flag() dedupes
+            self.check_branch(stmt)
+            for _ in range(2):
+                self.run_body(stmt.body)
+                self.check_branch(stmt)
+            self.run_body(stmt.orelse)
+        elif isinstance(stmt, ast.For):
+            self.check_expr(stmt.iter)
+            self.assign_targets(stmt.target, self.taints(stmt.iter))
+            for _ in range(2):
+                self.run_body(stmt.body)
+            self.run_body(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.check_expr(item.context_expr)
+            self.run_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run_body(stmt.body)
+            for h in stmt.handlers:
+                self.run_body(h.body)
+            self.run_body(stmt.orelse)
+            self.run_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Expr, ast.Raise,
+                               ast.Assert)):
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self.check_expr(sub)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass                    # nested defs get their own context
+        else:
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self.check_expr(sub)
+
+
+def _map_call_taint(call, callee, taints):
+    """{param: bool} for a call site, positional+keyword; a ``*args``
+    splat taints every parameter (conservative)."""
+    params = callee.param_names(skip_self=True)
+    out = {}
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return dict.fromkeys(params, True)
+    for i, a in enumerate(call.args):
+        if i < len(params):
+            out[params[i]] = taints(a)
+    for kw in call.keywords:
+        if kw.arg in params:
+            out[kw.arg] = taints(kw.value)
+    return out
+
+
+@register
+class TracerLeakRule(Rule):
+    id = "PTL002"
+    name = "tracer-leak"
+    describe = ("python control flow / host casts / f-strings on traced "
+                "values inside jitted (or one-hop reachable) functions")
+
+    def visit_module(self, mod, add):
+        direct = find_direct_traced(mod)
+        fns = index_functions(mod)
+        # one-hop propagation material: callee -> (tainted params, strict,
+        # first caller qualname)
+        hops = {}
+
+        def make_on_call(caller_ctx):
+            def on_call(call, taints):
+                f = call.func
+                name, self_call = None, False
+                if isinstance(f, ast.Name):
+                    name = f.id
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("self", "cls"):
+                    name, self_call = f.attr, True
+                if name is None:
+                    return
+                caller = caller_ctx.info
+                for q, cand in fns.items():
+                    if cand.name != name or q in direct:
+                        continue
+                    if self_call:
+                        if not (cand.class_name
+                                and cand.class_name == caller.class_name
+                                and q == f"{cand.class_name}.{name}"):
+                            continue
+                    elif not (q == name
+                              or q == f"{caller.qualname}.{name}"):
+                        continue
+                    tainted = {p for p, t in _map_call_taint(
+                        call, cand, taints).items() if t}
+                    prev = hops.get(q)
+                    if prev is None:
+                        hops[q] = [cand, set(tainted), caller_ctx.strict,
+                                   caller.qualname]
+                    else:
+                        prev[1] |= tainted
+                        prev[2] = prev[2] or caller_ctx.strict
+            return on_call
+
+        for q, ctx in direct.items():
+            _TaintChecker(self, mod, ctx, add,
+                          on_call=make_on_call(ctx)).run()
+        for q, (cand, tainted, strict, caller_q) in hops.items():
+            if not tainted:
+                continue
+            ctx = TracedContext(cand, tainted, strict,
+                                f"called from traced {caller_q}")
+            _TaintChecker(self, mod, ctx, add).run()

@@ -90,8 +90,8 @@ def replicated_like(specs):
 
 
 def _is_spec(x):
-    from jax.sharding import PartitionSpec
-    return isinstance(x, PartitionSpec)
+    from ...framework.jax_compat import partition_spec_class
+    return isinstance(x, partition_spec_class())
 
 
 def prune_to_mesh(specs, mesh):

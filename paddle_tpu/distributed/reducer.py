@@ -78,11 +78,11 @@ class DeviceMeshAllReduce:
     consumer applies the 1/nranks scale (fused into the optimizer step)."""
 
     def __init__(self, mesh=None, devices=None, axis=None):
-        from jax.sharding import Mesh
+        from ..framework.jax_compat import make_mesh
         if mesh is None:
             devices = list(devices if devices is not None
                            else jax.devices())
-            mesh = Mesh(np.array(devices), ("dp",))
+            mesh = make_mesh(np.array(devices), ("dp",))
             axis = "dp"
         self.mesh = mesh
         self.axis = axis or mesh.axis_names[0]
@@ -108,15 +108,15 @@ class DeviceMeshAllReduce:
         return fn
 
     def _build_reduce_fn(self):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..framework.jax_compat import shard_map
+        from ..framework.jax_compat import (named_sharding, shard_map,
+                                            partition_spec as P)
         ax = self.axis
         fn = shard_map(lambda x: jax.lax.psum(x, ax), mesh=self.mesh,
                        in_specs=P(), out_specs=P(), check_vma=False)
         # in_shardings=replicated makes the compiled call itself reshard
         # the (async, device-committed) flat onto the mesh: launch stays
         # ~1ms where an eager host-side device_put would block
-        return jax.jit(fn, in_shardings=NamedSharding(self.mesh, P()))
+        return jax.jit(fn, in_shardings=named_sharding(self.mesh, P()))
 
     def all_reduce_flat(self, flat, tag=None):
         # ONE compiled collective per bucket: GSPMD broadcasts the (async,
@@ -126,6 +126,9 @@ class DeviceMeshAllReduce:
         # result back on the home device so downstream consumers (fused
         # step, per-param write-back) stay off committed-device conflicts.
         if self._inflight is not None:
+            # deliberate one-in-flight collective drain: two concurrent
+            # CPU rendezvous deadlock (see class doc)
+            # ptl: disable-next=PTL004 -- one-in-flight collective drain
             self._inflight.block_until_ready()
         out = self._reduce_fn(tuple(flat.shape), str(flat.dtype))(flat)
         out = jax.device_put(out, self._home)
@@ -214,6 +217,9 @@ class MeshAxesAllReduce:
 
     def all_reduce_flat(self, flat, tag=None):
         if self._inflight is not None:
+            # deliberate one-in-flight collective drain (same single-
+            # comm-stream discipline as DeviceMesh)
+            # ptl: disable-next=PTL004 -- one-in-flight collective drain
             self._inflight.block_until_ready()
         n = flat.shape[0]
         pad = (-n) % self.dp
@@ -273,6 +279,9 @@ class EagerProcessTransport:
         # rendezvous raises CollectiveTimeout naming WHICH bucket and
         # which ranks contributed, instead of blocking backward forever
         member, rows = coll._member_rows(
+            # this TRANSPORT IS a host gather: the eager cross-process
+            # path reduces via the KV store by design
+            # ptl: disable-next=PTL004 -- this TRANSPORT IS a host gather
             coll._eager_rows(np.asarray(flat), op="dp_bucket_all_reduce",
                              bucket=tag, group=self.group), self.group)
         if not member:

@@ -44,6 +44,15 @@ def partition_spec(*parts):
     return PartitionSpec(*parts)
 
 
+def partition_spec_class():
+    """The PartitionSpec TYPE itself — for ``isinstance`` checks and the
+    ``P = partition_spec_class()`` module-alias idiom (``P("dp")``
+    constructs; ``isinstance(x, P)`` works, which the
+    :func:`partition_spec` factory cannot offer)."""
+    from jax.sharding import PartitionSpec
+    return PartitionSpec
+
+
 def named_sharding(mesh, spec):
     """``jax.sharding.NamedSharding`` for ``mesh`` and a PartitionSpec
     (or the tuple/None shorthand: ``named_sharding(mesh, ("dp", None))``)."""

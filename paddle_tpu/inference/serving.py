@@ -484,12 +484,18 @@ class ServingEngine:
                          jnp.asarray(slot_ids))
             if self.capture_logits:
                 self._cache_k, self._cache_v, first_tok, last_logits = out
+                # capture_logits debug mode: the caller asked for host
+                # logits; off by default
+                # ptl: disable-next=PTL004 -- capture_logits debug mode
                 logits_np = np.asarray(last_logits)
             else:
                 self._cache_k, self._cache_v, first_tok = out
                 logits_np = None
             self._inc("prefill_calls")
             self._count_quant_matmuls()
+            # sampled-first-token readback: the one designed sync point
+            # of the prefill wave
+            # ptl: disable-next=PTL004 -- sampled-first-token readback
             first_np = np.asarray(first_tok)
             for req in group:
                 r = group_rows[id(req)]
@@ -524,6 +530,9 @@ class ServingEngine:
         if self.capture_logits:
             if req.logits is None:
                 req.logits = []
+            # logits_row is the already-synced host copy (logits_np
+            # slice), not a device value
+            # ptl: disable-next=PTL004 -- already-synced host copy
             req.logits.append(np.asarray(logits_row, np.float32))
         self._inc("tokens_generated")
         if not self._warming:
@@ -660,12 +669,16 @@ class ServingEngine:
                 jnp.asarray(self._active))
         if self.capture_logits:
             self._cache_k, self._cache_v, nxt, logits = out
+            # ptl: disable-next=PTL004 -- capture_logits debug mode readback
             logits_np = np.asarray(logits)
         else:
             self._cache_k, self._cache_v, nxt = out
             logits_np = None
         self._inc("decode_steps")
         self._count_quant_matmuls()
+        # sampled-token readback: THE designed device->host sync of the
+        # decode loop (tokens must reach clients)
+        # ptl: disable-next=PTL004 -- sampled-token readback
         nxt_np = np.asarray(nxt)
         for s in range(self.slots):
             if not self._active[s]:
@@ -1143,10 +1156,14 @@ class PagedServingEngine(ServingEngine):
                      jnp.asarray(ptab))
         self._set_cache(out[:self._n_cache])
         first_tok = out[self._n_cache]
+        # ptl: disable-next=PTL004 -- capture_logits debug mode readback
         logits_np = (np.asarray(out[self._n_cache + 1])
                      if self.capture_logits else None)
         self._inc("prefill_calls")
         self._count_quant_matmuls()
+        # sampled-first-token readback: the one designed sync point of
+        # the paged prefill wave
+        # ptl: disable-next=PTL004 -- sampled-first-token readback
         first_np = np.asarray(first_tok)
         for r, req in enumerate(group):
             s = req.slot
@@ -1289,6 +1306,7 @@ class PagedServingEngine(ServingEngine):
                 np.int32(pos), np.int32(take))
         self._set_cache(out[:self._n_cache])
         tok = out[self._n_cache]
+        # ptl: disable-next=PTL004 -- capture_logits debug mode readback
         row_np = (np.asarray(out[self._n_cache + 1])
                   if self.capture_logits else None)
         self._inc("prefill_chunks")
@@ -1481,10 +1499,14 @@ class PagedServingEngine(ServingEngine):
                 jnp.asarray(self._last_tok))
         self._set_cache(out[:self._n_cache])
         nxt = out[self._n_cache]
+        # ptl: disable-next=PTL004 -- capture_logits debug mode readback
         logits_np = (np.asarray(out[self._n_cache + 1])
                      if self.capture_logits else None)
         self._inc("decode_steps")
         self._count_quant_matmuls()
+        # sampled-token readback: THE designed device->host sync of the
+        # paged decode loop
+        # ptl: disable-next=PTL004 -- sampled-token readback
         nxt_np = np.asarray(nxt)
         for s in range(self.slots):
             if not self._active[s]:

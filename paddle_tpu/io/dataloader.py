@@ -85,14 +85,14 @@ def _leaf_sharding(x, mesh):
     """Shard the batch's leading axis over the mesh's dp axis when it
     divides evenly; replicate otherwise.  No mesh: default device."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..framework.jax_compat import named_sharding, partition_spec as P
     if mesh is None:
         return jax.devices()[0]
     shape = getattr(x, "shape", ())
     if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 and shape \
             and shape[0] % mesh.shape["dp"] == 0:
-        return NamedSharding(mesh, P("dp"))
-    return NamedSharding(mesh, P())
+        return named_sharding(mesh, P("dp"))
+    return named_sharding(mesh, P())
 
 
 def prefetch_to_device(iterable, depth=1, mesh=None):

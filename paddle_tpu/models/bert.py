@@ -29,7 +29,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from ..framework.jax_compat import named_sharding, partition_spec_class
+
+P = partition_spec_class()
 
 from .common import PytreeLayer
 from ..ops import dispatch
@@ -319,7 +321,7 @@ def init_pretrain_state(cfg: BertConfig, key, mesh=None):
     if mesh is not None:
         specs = _mesh_specs(cfg, mesh)
         place = lambda x, s: jax.device_put(  # noqa: E731
-            x, NamedSharding(mesh, s))
+            x, named_sharding(mesh, s))
         params = jax.tree_util.tree_map(place, params, specs)
         m = jax.tree_util.tree_map(place, m, specs)
         v = jax.tree_util.tree_map(place, v, specs)
@@ -355,10 +357,10 @@ def make_train_step(cfg: BertConfig, mesh=None, beta1=0.9, beta2=0.999,
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1, 2))
     specs = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), _mesh_specs(cfg, mesh),
+        lambda s: named_sharding(mesh, s), _mesh_specs(cfg, mesh),
         is_leaf=lambda x: isinstance(x, P))
-    rep = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P("dp"))
+    rep = named_sharding(mesh, P())
+    data = named_sharding(mesh, P("dp"))
     return jax.jit(
         step, donate_argnums=(0, 1, 2),
         in_shardings=(specs, specs, specs, rep, data, data, data, rep),

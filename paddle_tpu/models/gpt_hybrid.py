@@ -31,7 +31,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from ..framework.jax_compat import shard_map
-from jax.sharding import NamedSharding, PartitionSpec as P
+from ..framework.jax_compat import (named_sharding,
+                                    partition_spec_class)
+
+P = partition_spec_class()
 
 from .gpt import GPTConfig, init_params, _layer_norm
 from ..optimizer.functional import adamw_update
@@ -84,7 +87,7 @@ def init_sharded(cfg: GPTConfig, mesh, key, moment_dtype=jnp.float32):
     specs = param_specs(cfg)
 
     def place(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, named_sharding(mesh, spec))
 
     params = jax.tree_util.tree_map(place, params, specs)
     zeros = functools.partial(jax.tree_util.tree_map,
@@ -291,7 +294,9 @@ def _global_norm(grads, specs):
         spec = spec_leaves[path]
         sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
         axes = _spec_axes(spec)
-        if axes:
+        # axes come from the static PartitionSpec pytree (only the
+        # tree-path indexing confuses taint), never from the tracer
+        if axes:  # ptl: disable=PTL002 -- static PartitionSpec axes
             sq = jax.lax.psum(sq, axes)
         total = total + sq
     return jnp.sqrt(total)

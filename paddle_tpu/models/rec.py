@@ -24,7 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from ..framework.jax_compat import shard_map
-from jax.sharding import NamedSharding, PartitionSpec as P
+from ..framework.jax_compat import named_sharding, partition_spec as P
 
 from .common import PytreeLayer
 from ..ops import dispatch
@@ -184,7 +184,7 @@ def init_sharded(cfg: RecConfig, mesh, key, model="wide_deep"):
     init = init_wide_deep if model == "wide_deep" else init_deepfm
     params = init(cfg, key, shards=axes.get("tp", 1))
     specs = param_specs(params)
-    place = lambda x, s: jax.device_put(x, NamedSharding(mesh, s))  # noqa: E731
+    place = lambda x, s: jax.device_put(x, named_sharding(mesh, s))  # noqa: E731
     params = jax.tree_util.tree_map(place, params, specs)
 
     def zeros():

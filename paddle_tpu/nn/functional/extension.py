@@ -11,6 +11,9 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     from ...framework import core
     dt = core.convert_dtype(dtype)
     def _sm(lengths):
+        # int() branch is trace-dead: the maxlen-is-None case is routed
+        # to the eager path below
+        # ptl: disable-next=PTL002 -- int() branch is trace-dead
         m = maxlen if maxlen is not None else int(lengths.max())
         return (jnp.arange(m)[None, :] < lengths[..., None]).astype(dt)
     if maxlen is None:

@@ -26,6 +26,10 @@ from . import metrics, timeline
 
 KV_PREFIX = "paddle_tpu_telemetry"
 
+# ranks at/above this publish infrastructure counter snapshots (fleet
+# router = 1000, lint CLI = 1001), not per-step training progress
+UTILITY_RANK_BASE = 1000
+
 _publish_seq = [0]
 _last_kv_key = {}          # rank -> this incarnation's last published key
 
@@ -185,10 +189,15 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
         # "fleet" rides along: the router's requeues/sheds/heartbeat
         # misses are fault counters in every sense that matters here —
         # and "autoscale" with it (scale decisions/errors are incidents
-        # the group view should surface)
+        # the group view should surface); from "analysis" (lint posture,
+        # published by the CLI under rank 1001) only the findings_*
+        # counters qualify — files_scanned/suppressed/baseline_size are
+        # gauges a CLEAN run reports nonzero, not incidents
         for fam in ("faults", "watchdog", "launch", "checkpoint",
-                    "bootstrap", "fleet", "autoscale"):
+                    "bootstrap", "fleet", "autoscale", "analysis"):
             for k, v in (fams.get(fam) or {}).items():
+                if fam == "analysis" and not k.startswith("findings_"):
+                    continue
                 if v:
                     faults[f"{fam}.{k}"] = v
         ranks[r] = {
@@ -212,12 +221,18 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
     if not ranks:
         return report
 
-    steps_seen = [v["steps"] for v in ranks.values()]
+    # utility ranks (>= 1000: the fleet router at 1000, the lint CLI at
+    # 1001) publish counter snapshots, not training progress — keeping
+    # them out of skew/straggler math avoids phantom zero-step laggards
+    workers = {r: v for r, v in ranks.items() if r < UTILITY_RANK_BASE}
+    if not workers:
+        return report
+    steps_seen = [v["steps"] for v in workers.values()]
     report["step_skew"] = max(steps_seen) - min(steps_seen)
 
     # step-frontier lag
     frontier = max(steps_seen)
-    for r, v in sorted(ranks.items()):
+    for r, v in sorted(workers.items()):
         if frontier - v["steps"] > step_lag:
             report["stragglers"].append({
                 "rank": r, "reason": "step_lag",
@@ -226,7 +241,7 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
                           f"frontier ({frontier})"})
 
     # collective-wait asymmetry: the rank peers wait ON waits the least
-    waits = {r: v["wait_per_step_s"] for r, v in ranks.items()
+    waits = {r: v["wait_per_step_s"] for r, v in workers.items()
              if v["wait_per_step_s"] is not None}
     if len(waits) >= 2:
         lo_rank = min(waits, key=waits.get)

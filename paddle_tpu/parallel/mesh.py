@@ -11,11 +11,12 @@ import contextlib
 
 import numpy as np
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from ..framework.jax_compat import (make_mesh, named_sharding,
+                                    partition_spec_class)
 
 _current_mesh = [None]
 
-P = PartitionSpec
+P = partition_spec_class()
 
 
 def create_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
@@ -24,7 +25,7 @@ def create_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
     arr = np.asarray(devices[:n]).reshape(dp, pp, tp, sp)
-    mesh = Mesh(arr, axis_names=("dp", "pp", "tp", "sp"))
+    mesh = make_mesh(arr, ("dp", "pp", "tp", "sp"))
     return mesh
 
 
@@ -52,7 +53,7 @@ def sharding(*spec):
     mesh = get_mesh()
     if mesh is None:
         return None
-    return NamedSharding(mesh, P(*spec))
+    return named_sharding(mesh, P(*spec))
 
 
 def shard_constraint(x, *spec):
@@ -80,7 +81,7 @@ def shard_params(layer):
         return layer
     for _, p in layer.named_parameters():
         spec = getattr(p, "_sharding_axes", None) or ()
-        ns = NamedSharding(mesh, P(*spec))
+        ns = named_sharding(mesh, P(*spec))
         try:
             p.value = jax.device_put(p.value, ns)
         except ValueError:

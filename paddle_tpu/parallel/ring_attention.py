@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.jax_compat import axis_size as _axis_size
-from jax.sharding import Mesh, PartitionSpec as P
+from ..framework.jax_compat import partition_spec as P
 from ..framework.jax_compat import shard_map
 
 NEG_INF = -1e30

@@ -29,7 +29,7 @@ import math
 import jax
 import jax.numpy as jnp
 from ..framework.jax_compat import shard_map, psum_scatter
-from jax.sharding import NamedSharding, PartitionSpec as P
+from ..framework.jax_compat import named_sharding, partition_spec as P
 
 from ..optimizer.functional import adamw_update
 
@@ -72,7 +72,7 @@ def unflatten_leaf(flat2d, shape, dtype=None):
 def shard_tree(tree, mesh, dp_axis="dp"):
     """Pytree of arrays -> pytree of [dp, k] leaves placed sharded on dp."""
     dp = dict(zip(mesh.axis_names, mesh.devices.shape))[dp_axis]
-    ns = NamedSharding(mesh, P(dp_axis))
+    ns = named_sharding(mesh, P(dp_axis))
 
     def go(x):
         return jax.device_put(flatten_leaf(x, dp), ns)
@@ -199,7 +199,7 @@ def init_zero_state(params, mesh, stage=2, dp_axis="dp"):
     if stage == 3:
         params = shard_tree(params, mesh, dp_axis)
     else:
-        rep = NamedSharding(mesh, P())
+        rep = named_sharding(mesh, P())
         params = jax.tree_util.tree_map(
             lambda p: jax.device_put(p, rep), params)
     return (params, m, v, jnp.int32(1))

@@ -351,6 +351,16 @@ def sharding_stats():
     return fam
 
 
+def analysis_stats():
+    """Static-analyzer counter family (paddle_tpu/analysis): findings by
+    rule id, new vs baselined, suppressions, baseline size/staleness,
+    files scanned.  A pure registry read — populated when an analyzer
+    run (``python -m paddle_tpu.analysis`` or the tools/ guards)
+    executed in this process; empty otherwise, so lint posture rides
+    beside the runtime counters wherever both exist."""
+    return metrics.families().get("analysis", {})
+
+
 def fast_path_summary():
     """One dict with every fast-path counter family — what the bench.py
     eager microbench and dp-overlap bench assert on — plus the ``faults``
@@ -364,7 +374,8 @@ def fast_path_summary():
                     ("serving", serving_stats),
                     ("fleet", fleet_stats),
                     ("autoscale", autoscale_stats),
-                    ("sharding", sharding_stats)):
+                    ("sharding", sharding_stats),
+                    ("analysis", analysis_stats)):
         try:
             out[key] = fn()
         except Exception:                                  # noqa: BLE001

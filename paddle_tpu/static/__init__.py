@@ -31,12 +31,11 @@ class ParallelExecutor(Executor):
             and not isinstance(places[0], str) else None
         devices = devices or jax.devices()
         if len(devices) > 1:
-            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            from ..framework.jax_compat import make_mesh, named_sharding
             import numpy as _np
-            self._mesh = Mesh(_np.asarray(devices), axis_names=("dp",))
-            self._feed_sharding = NamedSharding(self._mesh,
-                                                PartitionSpec("dp"))
-            self._rep_sharding = NamedSharding(self._mesh, PartitionSpec())
+            self._mesh = make_mesh(_np.asarray(devices), ("dp",))
+            self._feed_sharding = named_sharding(self._mesh, ("dp",))
+            self._rep_sharding = named_sharding(self._mesh, None)
         else:
             self._mesh = None
             self._feed_sharding = None

@@ -25,11 +25,12 @@ def run_check():
 
     if len(jax.devices()) > 1:
         from paddle_tpu.parallel.mesh import create_mesh
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.framework.jax_compat import (named_sharding,
+                                                     partition_spec as P)
         mesh = create_mesh(dp=len(jax.devices()))
         arr = jax.device_put(
             np.ones((len(jax.devices()), 2), np.float32),
-            NamedSharding(mesh, P("dp")))
+            named_sharding(mesh, P("dp")))
         total = float(jax.jit(lambda a: a.sum())(arr))
         assert total == 2 * len(jax.devices())
         print(f"PaddlePaddle(TPU-native) works on {len(jax.devices())} "

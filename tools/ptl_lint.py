@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Jax-less entry point for the compile-hygiene analyzer.
+
+``python -m paddle_tpu.analysis`` imports the paddle_tpu package first,
+and the package __init__ imports jax — fine in the CI container, fatal
+on a bare-python box.  This bootstrap loads the analysis module tree
+STANDALONE (the analysis package is stdlib-only by design; same
+importlib trick as tools/telemetry_report.py uses for observability)
+so lint runs anywhere:
+
+    python tools/ptl_lint.py paddle_tpu tools bench.py
+
+Identical flags and exit codes to the ``-m`` form (see cli.py); the
+only behavior difference is that the ``analysis.*`` registry family is
+not published (no package, no registry).
+"""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    pkg_dir = os.path.join(REPO, "paddle_tpu", "analysis")
+    name = "_ptl_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    analysis = _load_analysis()
+    from _ptl_analysis.cli import main
+    sys.exit(main())

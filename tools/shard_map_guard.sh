@@ -1,42 +1,42 @@
 #!/usr/bin/env bash
 # Standing-constraint guard (ROADMAP): version-moving jax APIs must route
-# through paddle_tpu/framework/jax_compat.py.  This greps the package for
-# direct imports/uses of the moving names — jax.experimental.shard_map
-# (renamed to jax.shard_map upstream), bare "from jax import shard_map",
-# and direct jax.lax.psum_scatter outside the compat shim — and fails CI
-# on any hit outside framework/jax_compat.py.
+# through paddle_tpu/framework/jax_compat.py.
 #
-# Usage: tools/shard_map_guard.sh   (run from anywhere; cd's to the repo)
-# Exit:  0 clean, 1 on violations (each printed with file:line).
+# Now a thin wrapper over the PTL001 moving-api rule of the AST static
+# analyzer (python -m paddle_tpu.analysis --rules=moving-api), which
+# resolves imports, aliases and attribute chains — so the aliased
+# spellings the old grep provably missed (`from jax.experimental import
+# shard_map as sm`, `from jax.sharding import NamedSharding`,
+# `import jax; jax.sharding.Mesh(...)`) all fail too.  Same contract as
+# the grep version: hits on stderr, "shard_map_guard: OK"/": FAIL",
+# exit 0 clean / 1 violations / 2 environment error.
+#
+# Usage: tools/shard_map_guard.sh [paths...]   (default: paddle_tpu)
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 
-fail=0
+targets=("$@")
+[ ${#targets[@]} -eq 0 ] && targets=(paddle_tpu)
 
-check() {
-    local pattern="$1" why="$2"
-    # grep the python package, excluding the one module allowed to pin
-    # the moving spelling (and caches/this guard's own docs)
-    hits=$(grep -rnE "$pattern" paddle_tpu \
-        --include='*.py' \
-        | grep -v 'framework/jax_compat.py' \
-        | grep -v '__pycache__' || true)
-    if [ -n "$hits" ]; then
-        echo "shard_map_guard: $why" >&2
-        echo "$hits" >&2
-        fail=1
-    fi
-}
-
-check 'jax\.experimental\.shard_map' \
-    "direct jax.experimental.shard_map import (use framework.jax_compat.shard_map)"
-check 'from jax import shard_map|jax\.shard_map\(' \
-    "direct jax.shard_map usage (use framework.jax_compat.shard_map)"
-check 'jax\.lax\.psum_scatter' \
-    "direct jax.lax.psum_scatter (use framework.jax_compat.psum_scatter)"
-
-if [ "$fail" -ne 0 ]; then
+# tools/ptl_lint.py standalone-loads the same `python -m
+# paddle_tpu.analysis` CLI WITHOUT importing the paddle_tpu package —
+# so the guard needs no jax (like the grep it replaced) and a missing
+# interpreter dep surfaces as exit 2, never as phantom violations
+out=$(python tools/ptl_lint.py "${targets[@]}" --rules=moving-api 2>&1)
+rc=$?
+if [ "$rc" -eq 1 ]; then
+    # the analyzer's documented "findings" exit — everything else
+    # (argparse usage=2, crash traceback, missing interpreter=127)
+    # is an environment problem, not a violation
+    echo "shard_map_guard: direct version-moving jax API outside" \
+         "framework/jax_compat.py (route through the compat shim):" >&2
+    echo "$out" >&2
     echo "shard_map_guard: FAIL" >&2
     exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "shard_map_guard: analyzer failed to run (exit $rc):" >&2
+    echo "$out" >&2
+    exit 2
 fi
 echo "shard_map_guard: OK"

@@ -17,6 +17,14 @@ if ! git diff --quiet || ! git diff --cached --quiet \
     exit 2
 fi
 
+# compile-hygiene lint runs first: a NEW static-analysis finding fails
+# tier-1 the same way post-run litter does (and in seconds, not minutes)
+if ! tools/lint_guard.sh; then
+    echo "tier1_guard: FAIL — static analysis found new issues" \
+         "(tools/lint_guard.sh; see above)" >&2
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
