@@ -803,7 +803,13 @@ def serving_bench():
     declared budget (BENCH_QUANT_LOGIT_BUDGET, default 0.05) with
     greedy-token match, and the same compile invariants.  Runs on
     any backend (CPU smoke included) — the contract being measured is
-    compile reuse + scheduling + memory accounting, not FLOPs.  Knobs:
+    compile reuse + scheduling + memory accounting, not FLOPs.  A fourth
+    SPECULATION phase (ISSUE 13, :func:`_serving_spec_phase`) runs
+    draft/ngram speculative decoding on a repetitive-suffix workload;
+    it is self-contained, so ``BENCH_SERVING_PHASES=spec`` runs it alone
+    (tools/spec_smoke.sh's budget) — the base/paged/quant trio is
+    monolithic (each phase is the next one's byte-budget baseline) and
+    runs whenever the knob includes ``base``.  Knobs:
     BENCH_SERVING_REQUESTS (default 24), BENCH_SERVING_SLOTS (default 4)."""
     import numpy as np
     import jax
@@ -813,6 +819,20 @@ def serving_bench():
     from paddle_tpu.inference.serving import (PagedServingEngine,
                                               ServingEngine)
     from paddle_tpu.observability import metrics as obs_metrics
+
+    phases = {p.strip() for p in os.environ.get(
+        "BENCH_SERVING_PHASES", "base,spec").split(",") if p.strip()}
+    unknown = phases - {"base", "spec"}
+    if unknown:
+        # a typo'd phase list must not read as a green bench that
+        # measured nothing ("base" covers the monolithic
+        # base/paged/quant trio; "spec" the speculation phase)
+        sys.exit(f"BENCH_SERVING_PHASES: unknown phase(s) "
+                 f"{sorted(unknown)} — valid: base, spec")
+    if "base" not in phases:
+        if "spec" in phases:
+            _serving_spec_phase()
+        return
 
     slots = int(os.environ.get("BENCH_SERVING_SLOTS", 4))
     # enough requests that the pool must churn whatever the slot count
@@ -1136,6 +1156,176 @@ def serving_bench():
           f"{pstats['slot_occupancy_peak']} ({q_conc_gain:.1f}x >= 1.3x), "
           f"logit_err={max_quant_err:.2e} <= {logit_budget}, "
           f"greedy tokens exact", file=sys.stderr)
+
+    # ---- speculation phase (ISSUE 13): drafting + one-step verify ----
+    if "spec" in phases:
+        _serving_spec_phase()
+
+
+def _serving_spec_phase():
+    """Speculation phase (ISSUE 13): draft-model and prompt-lookup
+    speculative decoding over the paged engine, on a repetitive-suffix
+    workload (testing/traffic.py's shared-prefix knob; greedy decoding
+    of the seeded model settles into attractor cycles — exactly the
+    repetitive traffic prompt-lookup drafting exploits).  Self-contained
+    (builds its own non-speculative reference engine) so the smoke can
+    run it alone via ``BENCH_SERVING_PHASES=spec``.
+
+    Asserts, per mode (``ngram`` model-free; ``draft`` with a
+    same-config same-seed self-draft — the acceptance-machinery
+    attestation, acceptance ~= k by construction):
+
+    * accepted_tokens/step > 1.5 (the >1 speedup factor vs one-token
+      decode; BENCH_SPEC_MIN_ACCEPT overrides),
+    * token-EXACT greedy parity vs the non-speculative paged engine on
+      every request,
+    * the fixed executable set: ``decode_compiles == 1`` (the one
+      donated verify step — never a compile per accept length),
+      ``spec_draft_compiles`` <= 2 (draft prefill + the fused
+      catch-up/draft step; 0 for ngram), prefill ladder bound,
+    * zero steady-state XLA compiles after warmup,
+    * and on ``kv_dtype="int8"``: token parity vs a non-speculative
+      int8 engine plus live prefix-page hits (the page-byte/prefix-hash
+      determinism contract is byte-asserted in tests/test_speculative.py;
+      here the shared-prefix cache demonstrably still matches).
+    Knobs: BENCH_SPEC_REQUESTS (default 12), BENCH_SPEC_K (default 4),
+    BENCH_SPEC_INT8=0 skips the int8 leg (the CPU smoke's budget)."""
+    import dataclasses
+    import numpy as np
+    import jax
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.inference.serving import PagedServingEngine
+    from paddle_tpu.inference.speculative import SpeculativeServingEngine
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.testing import traffic
+
+    cfg = G.gpt_tiny()
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    spec_k = int(os.environ.get("BENCH_SPEC_K", 4))
+    n_req = int(os.environ.get("BENCH_SPEC_REQUESTS", 12))
+    min_accept = float(os.environ.get("BENCH_SPEC_MIN_ACCEPT", 1.5))
+    # prefix_len == page_size: the shared system prefix fills a whole
+    # page, so the prefix-page cache can actually hit (a partial-page
+    # prefix hashes together with the request-unique tail)
+    arrivals = traffic.generate(traffic.TrafficSpec(
+        duration_s=2.0 * n_req, base_rate=1.0, seed=11,
+        vocab=cfg.vocab_size, prompt_len=(10, 0.3, 9, 12),
+        output_tokens=(24, 0.3, 16, 32),
+        prefix_hit_rate=0.75, prefix_pool=2, prefix_len=8))[:n_req]
+    assert len(arrivals) == n_req, (len(arrivals), n_req)
+    work = [(a.prompt, a.max_new_tokens) for a in arrivals]
+    kw = dict(slots=4, max_len=48, page_size=8, seq_buckets=(8, 16),
+              batch_buckets=(1, 2), max_queue=4 * n_req)
+
+    ref = PagedServingEngine((params, cfg), **kw)
+    ref.warmup()
+    t0 = time.perf_counter()
+    rrefs = [ref.submit(p, m) for p, m in work]
+    ref.run()
+    dt_ref = time.perf_counter() - t0
+    ref_tokens = [r.tokens for r in rrefs]
+    ref_steps = ref.stats()["decode_steps"]
+
+    modes = {}
+    for mode, mkw in (("ngram", {}),
+                      ("draft", {"spec_draft_cfg": dataclasses.asdict(cfg),
+                                 "spec_draft_seed": 0})):
+        eng = SpeculativeServingEngine((params, cfg), spec_mode=mode,
+                                       spec_k=spec_k, **mkw, **kw)
+        eng.warmup()
+        compiles0 = obs_metrics.counter("compile.count").value
+        t1 = time.perf_counter()
+        reqs = [eng.submit(p, m) for p, m in work]
+        eng.run(max_steps=100 * n_req)
+        dt = time.perf_counter() - t1
+        st = eng.stats()
+        new_compiles = obs_metrics.counter("compile.count").value - compiles0
+        for r, want in zip(reqs, ref_tokens):
+            assert r.tokens == want, (
+                f"spec/{mode} diverged from the non-speculative paged "
+                f"engine on {r.id}: {r.tokens} vs {want}")
+        assert st["decode_compiles"] == 1, st
+        assert new_compiles == 0, (
+            f"spec/{mode} steady state retraced: {new_compiles} new XLA "
+            "compiles (the verify must never compile per accept length)")
+        draft_budget = 2 if mode == "draft" else 0
+        assert st["spec_draft_compiles"] <= draft_budget, st
+        ladder = len(kw["seq_buckets"]) * len(kw["batch_buckets"])
+        assert st["prefill_compiles"] <= ladder, (st, ladder)
+        acc = st["accepted_tokens_per_step"]
+        assert acc > min_accept, (
+            f"spec/{mode} accepted_tokens/step {acc} <= {min_accept} on "
+            "the repetitive-suffix workload")
+        modes[mode] = {
+            "accepted_tokens_per_step": acc,
+            "spec_steps": st["spec_steps"],
+            "decode_steps": st["decode_steps"],
+            "drafted_tokens": st["drafted_tokens"],
+            "accepted_tokens": st["accepted_tokens"],
+            "rejected_tokens": st["rejected_tokens"],
+            "decode_compiles": st["decode_compiles"],
+            "spec_draft_compiles": st["spec_draft_compiles"],
+            "steady_state_compiles": new_compiles,
+            "tokens_per_sec": round(
+                sum(len(r.tokens) for r in reqs) / dt, 2),
+            "target_forwards_vs_nonspec": round(
+                st["decode_steps"] / max(1, ref_steps), 4),
+        }
+        print(f"# serving/spec {mode}: acc/step={acc} (>{min_accept}), "
+              f"parity token-exact over {n_req} requests, "
+              f"decode_compiles={st['decode_compiles']}, "
+              f"spec_draft_compiles={st['spec_draft_compiles']}, "
+              f"steady_compiles={new_compiles}, "
+              f"verify_steps={st['decode_steps']} vs "
+              f"{ref_steps} non-spec decode steps", file=sys.stderr)
+
+    int8_leg = None
+    if os.environ.get("BENCH_SPEC_INT8", "1") != "0":
+        q_ref = PagedServingEngine((params, cfg), quant="int8",
+                                   kv_dtype="int8", **kw)
+        q_ref.warmup()
+        q_refs = [q_ref.submit(p, m) for p, m in work]
+        q_ref.run()
+        q_spec = SpeculativeServingEngine((params, cfg), spec_mode="ngram",
+                                          spec_k=spec_k, quant="int8",
+                                          kv_dtype="int8", **kw)
+        q_spec.warmup()
+        q_reqs = [q_spec.submit(p, m) for p, m in work]
+        q_spec.run(max_steps=100 * n_req)
+        qst = q_spec.stats()
+        for a, b in zip(q_refs, q_reqs):
+            assert a.tokens == b.tokens, (
+                f"spec int8 diverged from non-spec int8 on {b.id}")
+        assert qst["decode_compiles"] == 1, qst
+        # the shared-prefix cache still hits under speculation: page
+        # bytes (prompt pages are never touched by the spec window, and
+        # committed positions write sequential-exact bytes) stayed
+        # deterministic enough for the content-hash contract
+        assert qst["prefix_page_hits"] > 0, qst
+        int8_leg = {
+            "accepted_tokens_per_step": qst["accepted_tokens_per_step"],
+            "prefix_page_hits": qst["prefix_page_hits"],
+            "greedy_match_vs_nonspec_int8": True,
+            "decode_compiles": qst["decode_compiles"]}
+        print(f"# serving/spec int8: acc/step="
+              f"{qst['accepted_tokens_per_step']}, parity token-exact vs "
+              f"non-spec int8, prefix_page_hits="
+              f"{qst['prefix_page_hits']}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "serving_spec_accepted_tokens_per_step",
+        "value": modes["ngram"]["accepted_tokens_per_step"],
+        "unit": "tokens/step",
+        "requests": n_req, "spec_k": spec_k,
+        "min_accept": min_accept,
+        "parity": "token-exact",
+        "workload": {"prefix_hit_rate": 0.75,
+                     "nonspec_decode_steps": ref_steps,
+                     "nonspec_tokens_per_sec": round(
+                         sum(len(t) for t in ref_tokens) / dt_ref, 2)},
+        "modes": modes,
+        "int8": int8_leg,
+    }), flush=True)
 
 
 # --------------------------------------------------------------------------

@@ -10,6 +10,11 @@ serving.
 * :mod:`.serving` — :class:`ServingEngine`: continuous batching over a
   slot-pooled KV cache with bucketed prefill executables and a single
   buffer-donated decode step (ISSUE 5 tentpole).
+* :mod:`.speculative` — :class:`SpeculativeServingEngine`: draft-model
+  and prompt-lookup speculative decoding over the paged engine, k+1
+  positions verified per donated decode step with an in-graph
+  longest-accepted-prefix commit — token-exact greedy output at a
+  fraction of the target forwards (ISSUE 13 tentpole).
 * :mod:`.fleet` — :class:`ServingFleet`: a re-queueing router over N
   supervised engine-replica subprocesses (health checks, request
   retries, load shedding — no admitted request is ever dropped) with
@@ -35,6 +40,7 @@ from .predictor import (Config, Predictor, create_predictor,  # noqa: F401
 
 _SERVING_NAMES = ("ServingEngine", "PagedServingEngine",
                   "ServingQueueFull", "Request")
+_SPEC_NAMES = ("SpeculativeServingEngine",)
 _FLEET_NAMES = ("ServingFleet", "FleetOverloaded", "FleetRequest")
 _AUTOSCALE_NAMES = ("Autoscaler",)
 
@@ -58,6 +64,12 @@ def __getattr__(name):
         if name == "serving":
             return serving
         return getattr(serving, name)
+    if name in _SPEC_NAMES or name == "speculative":
+        import importlib
+        speculative = importlib.import_module(__name__ + ".speculative")
+        if name == "speculative":
+            return speculative
+        return getattr(speculative, name)
     # the fleet router is jax-light but rides the same lazy discipline
     if name in _FLEET_NAMES or name == "fleet":
         import importlib

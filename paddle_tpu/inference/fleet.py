@@ -288,6 +288,30 @@ class ServingFleet:
             raise ValueError(
                 f"model_spec kv_dtype {self.model_spec['kv_dtype']!r} "
                 "is unknown — expected 'int8' or omit it")
+        spec_mode = self.model_spec.get("spec_mode")
+        if spec_mode is not None and spec_mode not in ("draft", "ngram"):
+            raise ValueError(
+                f"model_spec spec_mode {spec_mode!r} is unknown — "
+                "expected 'draft', 'ngram', or omit it")
+        if spec_mode is not None and not self.model_spec.get("paged"):
+            raise ValueError(
+                "model_spec has spec_mode but not paged: true — "
+                "speculative decoding runs over the paged engine")
+        if spec_mode is not None:
+            # same fail-HERE contract as quant/kv_dtype: a bad spec knob
+            # must not surface as N replicas crash-looping through their
+            # whole restart budget before the first hello
+            spec_k = self.model_spec.get("spec_k")
+            if spec_k is not None and (not isinstance(spec_k, int)
+                                       or spec_k < 1):
+                raise ValueError(
+                    f"model_spec spec_k must be an int >= 1, got "
+                    f"{spec_k!r}")
+            draft_cfg = self.model_spec.get("spec_draft_cfg")
+            if draft_cfg is not None and not isinstance(draft_cfg, dict):
+                raise ValueError(
+                    "model_spec spec_draft_cfg must be a dict of "
+                    f"GPTConfig kwargs, got {type(draft_cfg).__name__}")
         self.nreplicas = int(replicas if replicas is not None
                              else _env_int("PADDLE_FLEET_REPLICAS", 2))
         if self.nreplicas < 1:
@@ -602,9 +626,9 @@ class ServingFleet:
                 r.restarts_used = self.max_restarts
                 raise _ReplicaGone(
                     f"numeric contract mismatch: replica hello reports "
-                    f"(quant, kv_dtype)={mismatch[0]} but the fleet "
-                    f"spec says {mismatch[1]} — config error, replica "
-                    "will not be relaunched")
+                    f"(quant, kv_dtype, spec_mode)={mismatch[0]} but "
+                    f"the fleet spec says {mismatch[1]} — config "
+                    "error, replica will not be relaunched")
             r.conn = conn
             r.hello = hello
             r.last_stats = stats
@@ -725,15 +749,20 @@ class ServingFleet:
                 from e
 
     def _contract_mismatch(self, stats):
-        """None when the replica's reported numeric contract (quant
-        mode, kv_dtype — echoed in every engine ``stats()``) matches
-        the fleet spec's; else ``(got, want)`` for the incident
-        record.  Requests re-queued across replicas assume identical
-        numerics — a mixed-contract fleet would silently break the
-        token-exact retry guarantee."""
+        """None when the replica's reported numeric/behavior contract
+        (quant mode, kv_dtype, spec_mode — echoed in every engine
+        ``stats()``) matches the fleet spec's; else ``(got, want)`` for
+        the incident record.  Requests re-queued across replicas assume
+        identical numerics — a mixed-contract fleet would silently
+        break the token-exact retry guarantee; and though speculation
+        is token-exact by design, a spec/non-spec mix would skew every
+        per-replica latency/compile attestation the bench joins on, so
+        spec_mode is part of the attested contract too (ISSUE 13)."""
         want = (self.model_spec.get("quant"),
-                self.model_spec.get("kv_dtype"))
-        got = (stats.get("quant"), stats.get("kv_dtype"))
+                self.model_spec.get("kv_dtype"),
+                self.model_spec.get("spec_mode"))
+        got = (stats.get("quant"), stats.get("kv_dtype"),
+               stats.get("spec_mode"))
         return None if got == want else (got, want)
 
     def _capacity(self, r):

@@ -34,10 +34,16 @@ the replica runs a
 (knobs ``page_size``, ``num_pages``, ``prefix_cache``,
 ``prefill_chunk``, ``kv_dtype`` — "int8" for the quantized page pool)
 and its step replies carry the free-page numbers the router's
-page-aware least-loaded routing keys on.  The hello's stats echo
-``quant``/``kv_dtype`` back; the router refuses a replica whose numeric
-contract differs from the fleet spec (a mixed fp32/int8 fleet must
-never re-queue a request onto a replica with different numerics).
+page-aware least-loaded routing keys on.  With ``spec_mode``
+("draft"/"ngram", paged only) the replica runs a
+:class:`~paddle_tpu.inference.speculative.SpeculativeServingEngine`
+(knobs ``spec_k``, ``spec_draft_cfg``, ``spec_draft_seed``,
+``spec_ngram_max``).  The hello's stats echo
+``quant``/``kv_dtype``/``spec_mode`` back; the router refuses a replica
+whose numeric/behavior contract differs from the fleet spec (a mixed
+fp32/int8 fleet must never re-queue a request onto a replica with
+different numerics, and a mixed spec/non-spec fleet would skew the
+latency/compile attestations the bench reads).
 """
 from __future__ import annotations
 
@@ -89,6 +95,12 @@ def _build_engine(spec):
         raise ValueError(
             "spec has kv_dtype but not paged: true — only the paged "
             "engine has a quantizable KV pool")
+    if spec.get("spec_mode") is not None and not spec.get("paged"):
+        # same fail-loudly contract as kv_dtype: speculation runs over
+        # the paged engine's deferred-commit machinery only
+        raise ValueError(
+            "spec has spec_mode but not paged: true — speculative "
+            "decoding runs over the paged engine")
     cls = ServingEngine
     if spec.get("paged"):
         cls = PagedServingEngine
@@ -99,6 +111,19 @@ def _build_engine(spec):
             kw["prefix_cache"] = bool(spec["prefix_cache"])
         if spec.get("kv_dtype") is not None:
             kw["kv_dtype"] = str(spec["kv_dtype"])
+        if spec.get("spec_mode") is not None:
+            # speculative decoding (ISSUE 13): the mode travels in the
+            # spec so every (re)launched replica speculates identically
+            # and the hello's stats echo it back for the router's
+            # behavior-contract attestation
+            from .speculative import SpeculativeServingEngine
+            cls = SpeculativeServingEngine
+            kw["spec_mode"] = str(spec["spec_mode"])
+            for k in ("spec_k", "spec_draft_seed", "spec_ngram_max"):
+                if spec.get(k) is not None:
+                    kw[k] = int(spec[k])
+            if spec.get("spec_draft_cfg") is not None:
+                kw["spec_draft_cfg"] = dict(spec["spec_draft_cfg"])
     return cls((params, cfg), **kw)
 
 

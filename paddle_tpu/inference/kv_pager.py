@@ -292,6 +292,28 @@ class KVPager:
             return dst, off, pid
         return pid, off, None
 
+    def ensure_append_window(self, slot, pos, n):
+        """Speculative multi-token append (ISSUE 13): make positions
+        ``pos .. pos + n - 1`` of ``slot`` writable in one walk —
+        allocating every tail page the window crosses and COWing a
+        shared/frozen tail exactly like :meth:`ensure_append` (whose
+        idempotence this inherits: re-walking after a preemption retry
+        is safe, and pages pre-allocated for a window the verify then
+        only partially committed are simply reused by the next window).
+        Returns ``(pids [n], offs [n], cows)`` where ``cows`` is a list
+        of ``(src, dst)`` pairs the engine must copy device-side before
+        any write.  On exhaustion the already-ensured prefix stays owned
+        by the slot (released wholesale if the slot is preempted) and
+        :class:`PagesExhausted` propagates."""
+        pids, offs, cows = [], [], []
+        for d in range(int(n)):
+            pid, off, cow = self.ensure_append(slot, int(pos) + d)
+            if cow is not None:
+                cows.append((cow, pid))
+            pids.append(pid)
+            offs.append(off)
+        return pids, offs, cows
+
     # ------------------------------------------------------------ release
     def release(self, slot):
         """Drop the slot's table.  Pages fall to ref 0 and either retire

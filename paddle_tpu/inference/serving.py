@@ -104,7 +104,16 @@ def _stats_family():
         # so it answers "did the Pallas path engage in what XLA built?"
         # not "how many steps ran" (0 off-TPU: the lax fallback serves)
         "quant_matmuls": 0, "kv_quant_bytes_saved": 0,
-        "dequant_kernel_calls": 0})
+        "dequant_kernel_calls": 0,
+        # speculative-decoding family (SpeculativeServingEngine,
+        # ISSUE 13; zero on non-speculative engines): candidates the
+        # drafter proposed, how many of those the verify accepted /
+        # rejected, and verify dispatches (each commits accepted+1
+        # tokens: the longest accepted draft prefix plus the bonus
+        # token the verify's own logits supply)
+        "drafted_tokens": 0, "accepted_tokens": 0,
+        "rejected_tokens": 0, "spec_steps": 0,
+        "spec_draft_compiles": 0})
 
 
 class _StatsMirror:
@@ -143,6 +152,12 @@ class Request:
         self.logits = None          # per-token [V] rows when captured
         self.slot = None
         self.preemptions = 0        # page-exhaustion evictions survived
+        # speculative engine's per-row pending-draft state (ISSUE 13):
+        # committed tokens the draft model has not ingested yet (None
+        # until the spec engine activates the row).  MUST be scrubbed on
+        # retry — a preempted-then-retried request re-prefills the draft
+        # cache from its prompt, and stale ctx would double-feed tokens
+        self.pending_draft = None
         self.done = False
         self.failed = False         # aborted mid-step; re-queueable
         self.error = None           # the abort's diagnosis when failed
@@ -168,6 +183,7 @@ class Request:
         self.tokens = []
         self.logits = None
         self.slot = None
+        self.pending_draft = None
         self.done = False
         self.failed = False
         self.error = None
@@ -244,6 +260,10 @@ class ServingEngine:
         self.max_queue = int(max_queue if max_queue is not None
                              else 8 * self.slots)
         self.capture_logits = bool(capture_logits)
+        # speculative-decoding identity (the spec subclass overrides;
+        # part of the fleet numeric/behavior contract attestation)
+        self.spec_mode = None
+        self.spec_k = None
 
         # a restart re-loads yesterday's executables (no-op without
         # PADDLE_JIT_CACHE_DIR)
@@ -542,6 +562,23 @@ class ServingEngine:
         elif len(req.tokens) >= req.max_new_tokens:
             self._finish(req, "length")
 
+    def _append_tokens(self, req, toks, logits_rows=None):
+        """Multi-token commit (ISSUE 13): append an accepted speculative
+        window's tokens in order, stopping at the first finishing token
+        (eos / length — the device-side commit math already truncates
+        there, so the guard is defensive).  ``logits_rows`` is the
+        already-synced [W, V] host block when capturing.  Returns how
+        many were appended."""
+        n = 0
+        for i, tok in enumerate(toks):
+            self._append_token(req, int(tok),
+                               logits_rows[i] if logits_rows is not None
+                               else None)
+            n += 1
+            if req.done:
+                break
+        return n
+
     def _finish(self, req, reason):
         req.done = True
         req.finish_reason = reason
@@ -830,7 +867,9 @@ class ServingEngine:
         "prefill_calls", "decode_steps", "requests_admitted",
         "requests_completed", "tokens_generated",
         "prefill_chunks", "prefix_page_hits", "prefix_page_misses",
-        "cow_copies", "preemptions", "quant_matmuls"))
+        "cow_copies", "preemptions", "quant_matmuls",
+        "drafted_tokens", "accepted_tokens", "rejected_tokens",
+        "spec_steps"))
 
     def _count_quant_matmuls(self):
         """One model forward = 4 quantized matmuls per layer (qkv, proj,
@@ -864,6 +903,7 @@ class ServingEngine:
         # mixed fp32/int8 fleet must never cross-route)
         out["quant"] = self.quant
         out["kv_dtype"] = self._kv_dtype
+        out["spec_mode"] = self.spec_mode
         out.update(self._kv_accounting())
         return out
 

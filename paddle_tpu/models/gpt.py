@@ -829,6 +829,256 @@ def forward_paged_chunk_quant(params, tokens, cfg: GPTConfig, cache_k,
     return logits, ks, kss, vs, vss
 
 
+# --------------------------------------------------------------------------
+# speculative verify + draft plumbing (ISSUE 13)
+# --------------------------------------------------------------------------
+#
+# Speculative decoding turns the one-token decode step into a W = k+1
+# position VERIFY: window position 0 consumes the last committed token,
+# positions 1..k consume draft candidates, and one batched forward
+# scores every position at once.  The hard paged-KV constraint is that
+# rejected candidates must never corrupt the page pool, so the verify
+# forward below is DEFERRED-COMMIT: the pool is strictly read-only
+# during the forward (queries attend the gathered page view of the
+# committed prefix plus an in-window causal mask over the window's own
+# K/V), and the window K/V are RETURNED to the caller, which scatters
+# only the accepted prefix — a masked page-aligned write whose rejected
+# lanes redirect to the scratch page, so accept length stays a traced
+# value and the executable set stays fixed.  Accepted positions write
+# the exact bytes a sequential decode would have (same cast to the pool
+# dtype, same quantize-once per position on the int8 pool), which is
+# what keeps the prefix-hash/page-byte determinism contract intact.
+
+
+def _paged_verify_block(cfg, x, blk, k_pages, v_pages, page_table, lens):
+    """block_apply for the W-token speculative verify window: queries at
+    absolute positions ``lens[s] + j`` attend the gathered page view
+    with the window's own K/V SPLICED IN at their true positions
+    (``lens[s] + i``, a per-row scatter whose out-of-bounds lanes drop)
+    under the mask ``k_pos <= lens[s] + j`` — the in-window causal mask
+    and the fill bound in one.  Splicing (rather than concatenating the
+    window) keeps the attention contraction width exactly the
+    non-speculative decode's ``maxP * ps``, so each ACCEPTED position's
+    activations — and therefore the K/V bytes the engine later commits —
+    are bit-identical to a sequential decode, which is what the
+    page-byte determinism regression demands.  x: [S, W, H];
+    k/v_pages: [P, ps, nh, hd]; page_table: int32 [S, maxP].  Returns
+    (x_out, win_k, win_v) with the window K/V in the POOL dtype (the
+    cast a committed write applies) — the pool itself is untouched."""
+    S, maxP = page_table.shape
+    ps = k_pages.shape[1]
+    hd = cfg.head_dim
+    view = maxP * ps
+    cd = jnp.dtype(cfg.dtype)
+
+    def vattn(q, k, v):
+        W = q.shape[1]
+        kw = k.astype(k_pages.dtype)
+        vw = v.astype(v_pages.dtype)
+        kc = k_pages[page_table].reshape(S, view, *k_pages.shape[2:])
+        vc = v_pages[page_table].reshape(S, view, *v_pages.shape[2:])
+        rows = jnp.arange(S)[:, None]
+        cols = lens[:, None] + jnp.arange(W)[None, :]
+        kc = kc.at[rows, cols].set(kw)      # OOB window lanes drop
+        vc = vc.at[rows, cols].set(vw)
+        # one single-query attention PER LANE (W is small and static):
+        # each lane's dot_generals have exactly the one-token decode's
+        # shapes, so XLA accumulates in the same order and an accepted
+        # lane's output — hence the K/V bytes committed downstream — is
+        # BITWISE what the sequential decode would have produced.  A
+        # W-query batched einsum is ulp-close but not bit-equal (the
+        # page-byte determinism regression catches exactly that).
+        # Lanes > j sit spliced in the view but masked for query j: the
+        # same ``k_pos <= len`` bound the decode applies at the step
+        # that would have consumed lane j sequentially; their exp(-1e30)
+        # underflows to exactly 0, so their differing values never leak.
+        kcf = kc.astype(jnp.float32)
+        vcc = vc.astype(cd)
+        outs = []
+        for j in range(W):
+            lg = jnp.einsum("sqhd,skhd->shqk",
+                            q[:, j:j + 1].astype(jnp.float32),
+                            kcf) / math.sqrt(hd)
+            m = jnp.arange(view)[None, :] <= (lens + j)[:, None]
+            lg = jnp.where(m[:, None, None, :], lg, -1e30)
+            pj = jax.nn.softmax(lg, -1).astype(cd)
+            outs.append(jnp.einsum("shqk,skhd->sqhd", pj, vcc))
+        a = jnp.concatenate(outs, axis=1)             # [S, W, nh, hd]
+        return a, (kw, vw)
+
+    x, (win_k, win_v) = block_apply(cfg, x, blk, attn_fn=vattn)
+    return x, win_k, win_v
+
+
+def decode_step_paged_verify(params, tokens, cfg: GPTConfig, cache_k,
+                             cache_v, page_table, lens):
+    """Speculative verify forward (ISSUE 13): consume ``tokens`` [S, W]
+    (W = spec_k + 1 — the last committed token plus the k draft
+    candidates) at absolute positions ``lens[s] + j`` through the paged
+    pool, WITHOUT writing it.  Returns (logits [S, W, V] fp32,
+    win_k, win_v [L, S, W, nh, hd] in the pool dtype) — the caller
+    commits the accepted prefix with one masked scatter."""
+    S, W = tokens.shape
+    pos = lens[:, None] + jnp.arange(W)[None, :]
+    x = jnp.take(params["wte"], tokens, axis=0) \
+        + jnp.take(params["wpe"], pos, axis=0)
+    x = x.astype(jnp.dtype(cfg.dtype))                    # [S, W, H]
+
+    def scan_body(carry, layer):
+        blk, kp, vp = layer
+        xx, kw, vw = _paged_verify_block(cfg, carry, blk, kp, vp,
+                                         page_table, lens)
+        return xx, (kw, vw)
+
+    x, (wk, wv) = jax.lax.scan(scan_body, x,
+                               (params["blocks"], cache_k, cache_v))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, wk, wv
+
+
+def _paged_verify_block_quant(cfg, x, blk, k_pages, k_scale, v_pages,
+                              v_scale, page_table, lens):
+    """:func:`_paged_verify_block` over the int8 pool.  The window K/V
+    quantize IMMEDIATELY (per-position absmax, exactly the bytes a
+    committed write stores) and the in-window attention reads them back
+    DEQUANTIZED — mirroring the sequential int8 decode, where a token's
+    own K/V round-trips through the quantizer before attention sees it,
+    so accepted positions reproduce the non-speculative logits and page
+    bytes exactly.  Returns (x_out, win_kq, win_ks, win_vq, win_vs)."""
+    S, maxP = page_table.shape
+    ps = k_pages.shape[1]
+    hd = cfg.head_dim
+    view = maxP * ps
+    cd = jnp.dtype(cfg.dtype)
+
+    def vattn(q, k, v):
+        W = q.shape[1]
+        kq, ks = quantize_kv(k)                   # [S, W, nh, hd] int8
+        vq, vs = quantize_kv(v)
+        kw = dequantize_kv(kq, ks, jnp.float32)
+        vw = dequantize_kv(vq, vs, jnp.float32)
+        kc = dequantize_kv(k_pages[page_table], k_scale[page_table],
+                           jnp.float32).reshape(S, view, *k_pages.shape[2:])
+        vc = dequantize_kv(v_pages[page_table], v_scale[page_table],
+                           jnp.float32).reshape(S, view, *v_pages.shape[2:])
+        rows = jnp.arange(S)[:, None]
+        cols = lens[:, None] + jnp.arange(W)[None, :]
+        kc = kc.at[rows, cols].set(kw)      # OOB window lanes drop
+        vc = vc.at[rows, cols].set(vw)
+        # per-lane single-query attention for bitwise parity with the
+        # sequential int8 decode — see _paged_verify_block
+        vcc = vc.astype(cd)
+        outs = []
+        for j in range(W):
+            lg = jnp.einsum("sqhd,skhd->shqk",
+                            q[:, j:j + 1].astype(jnp.float32),
+                            kc) / math.sqrt(hd)
+            m = jnp.arange(view)[None, :] <= (lens + j)[:, None]
+            lg = jnp.where(m[:, None, None, :], lg, -1e30)
+            pj = jax.nn.softmax(lg, -1).astype(cd)
+            outs.append(jnp.einsum("shqk,skhd->sqhd", pj, vcc))
+        a = jnp.concatenate(outs, axis=1)
+        return a, (kq, ks, vq, vs)
+
+    x, (kq, ks, vq, vs) = block_apply(cfg, x, blk, attn_fn=vattn)
+    return x, kq, ks, vq, vs
+
+
+def decode_step_paged_verify_quant(params, tokens, cfg: GPTConfig,
+                                   cache_k, k_scale, cache_v, v_scale,
+                                   page_table, lens):
+    """:func:`decode_step_paged_verify` over the INT8 paged pool.
+    Returns (logits [S, W, V] fp32, win_kq [L, S, W, nh, hd] int8,
+    win_ks [L, S, W, nh] fp32, win_vq, win_vs) — quantized exactly once
+    per window position, so the caller's masked commit lands the same
+    bytes AND scales a sequential int8 decode would have."""
+    S, W = tokens.shape
+    pos = lens[:, None] + jnp.arange(W)[None, :]
+    x = jnp.take(params["wte"], tokens, axis=0) \
+        + jnp.take(params["wpe"], pos, axis=0)
+    x = x.astype(jnp.dtype(cfg.dtype))
+
+    def scan_body(carry, layer):
+        blk, kp, ksp, vp, vsp = layer
+        xx, kq, ks, vq, vs = _paged_verify_block_quant(
+            cfg, carry, blk, kp, ksp, vp, vsp, page_table, lens)
+        return xx, (kq, ks, vq, vs)
+
+    x, (wkq, wks, wvq, wvs) = jax.lax.scan(
+        scan_body, x,
+        (params["blocks"], cache_k, k_scale, cache_v, v_scale))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(x.dtype).T).astype(jnp.float32)
+    return logits, wkq, wks, wvq, wvs
+
+
+def draft_prefill_slot(params, tokens, cfg: GPTConfig, cache_k, cache_v,
+                       slot, offset):
+    """One C-token chunk of the DRAFT model's prompt ingestion into a
+    single slot of its slot-contiguous cache (ISSUE 13 draft mode).
+    ``slot`` and ``offset`` are traced scalars, so every chunk of every
+    prompt reuses ONE executable.  No logits are returned — the first
+    sampled token always comes from the TARGET prefill.  Padded tail
+    positions write garbage past the true prompt length, masked by the
+    draft length until the catch-up writes overwrite them (the same
+    contract as the target engine's prefill pads)."""
+    x = embed(cfg, params, tokens, pos_offset=offset)
+
+    def scan_body(carry, layer):
+        xx = carry
+        blk, kc, vc = layer                   # kc: [S, maxd, nh, hd]
+        row_k = jax.lax.dynamic_index_in_dim(kc, slot, 0, keepdims=True)
+        row_v = jax.lax.dynamic_index_in_dim(vc, slot, 0, keepdims=True)
+        xx, row_k, row_v = _cached_block(cfg, xx, blk, row_k, row_v,
+                                         offset)
+        kc = jax.lax.dynamic_update_slice(kc, row_k, (slot, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, row_v, (slot, 0, 0, 0))
+        return xx, (kc, vc)
+
+    _, (ks, vs) = jax.lax.scan(scan_body, x,
+                               (params["blocks"], cache_k, cache_v))
+    return ks, vs
+
+
+def draft_catchup_and_draft(params, cfg: GPTConfig, cache_k, cache_v,
+                            ctx, n_ctx, lens, k):
+    """The draft model's per-engine-iteration work, ONE executable for
+    every step of every request (ISSUE 13 draft mode): first CATCH UP on
+    the tokens the target committed last iteration (``ctx`` [S, W],
+    left-aligned, ``n_ctx`` of them per row — the verify commits at most
+    W = k+1, so the backlog always fits), then DRAFT ``k`` candidates by
+    greedy self-sampling.  Runs ``W + k - 1`` single-token slot decodes:
+    iteration ``j`` consumes ``ctx[:, j]`` while ``j < n_ctx[s]``, else
+    the token the row itself sampled at ``j - 1``; K/V land at position
+    ``lens[s] + j`` of the slot cache.  Only the ctx writes are durable
+    (the caller advances ``lens`` by ``n_ctx``); draft-token K/V past
+    that are speculative garbage masked by the fill bound and
+    overwritten by the next catch-up — the slot cache must therefore be
+    ``2k`` positions deeper than the longest sequence.  Returns
+    (cache_k, cache_v, drafts [S, k] int32)."""
+    S, W = ctx.shape
+    steps = W + k - 1
+
+    def body(carry, j):
+        kc, vc, prev = carry
+        tok = jnp.where(j < n_ctx,
+                        jax.lax.dynamic_index_in_dim(ctx, j, 1, False),
+                        prev)
+        cache = {"k": kc, "v": vc, "len": lens + j}
+        logits, cache = decode_step_slots(params, tok, cfg, cache)
+        y = jnp.argmax(logits, -1).astype(jnp.int32)
+        return (cache["k"], cache["v"], y), y
+
+    (kc, vc, _), ys = jax.lax.scan(body, (cache_k, cache_v, ctx[:, 0]),
+                                   jnp.arange(steps))
+    ys = jnp.swapaxes(ys, 0, 1)                       # [S, steps]
+    idx = jnp.clip(n_ctx[:, None] - 1 + jnp.arange(k)[None, :], 0,
+                   steps - 1)
+    drafts = jnp.take_along_axis(ys, idx, axis=1)
+    return kc, vc, drafts
+
+
 def loss_fn(params, tokens, labels, cfg: GPTConfig):
     """Mean next-token cross entropy.  labels [B, N] int32 (-100 = ignore)."""
     logits = forward(params, tokens, cfg)

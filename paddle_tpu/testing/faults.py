@@ -71,6 +71,13 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         exhaustion event: the NEWEST in-flight request must be
         preempted (pages freed, request re-queued from its prompt,
         named in telemetry/counters) — never a silent stall or loss.
+    spec_reject:step=3[,repeat=1]
+        the speculative engine's verify at decode step N is forced into
+        an ALL-REJECT (accept length 0: every draft candidate refused,
+        exactly one bonus token commits — the degenerate case that must
+        behave like a plain decode step).  The regression it guards:
+        rejected candidates must leave the paged KV pool's bytes (and
+        int8 scales) byte-identical to a never-speculated run.
 
 Every fault fires at most once (add ``repeat=1`` to re-arm after each
 fire); ``nth`` counts only calls whose other filters matched, so the Nth
@@ -287,6 +294,15 @@ def page_exhaustion_check(step=None):
     to the queue, pages freed, failure named) without the pool actually
     being full."""
     return take("page_exhaustion", step=step) is not None
+
+
+def spec_reject_check(step=None):
+    """Called by the speculative serving engine once per verify step;
+    returns True when a matching ``spec_reject`` fault fires — the
+    engine must force an all-reject verify (commit exactly the one
+    bonus token) while leaving paged KV bytes exactly as a
+    never-speculated run would."""
+    return take("spec_reject", step=step) is not None
 
 
 def slow_start_check():

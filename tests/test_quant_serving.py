@@ -389,7 +389,7 @@ class TestFleetQuantContract:
         ok = {"quant": "int8", "kv_dtype": "int8"}
         assert fleet._contract_mismatch(ok) is None
         bad = fleet._contract_mismatch({"quant": None, "kv_dtype": None})
-        assert bad == ((None, None), ("int8", "int8"))
+        assert bad == ((None, None, None), ("int8", "int8", None))
         # fp32 fleet rejects a quantized replica too
         fp = self._fleet_stub({"paged": True})
         assert fp._contract_mismatch({"quant": None,

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Speculative-decoding smoke: both drafting modes on the CPU backend,
+# inside a hard 55s budget — CI's proof that speculation (ISSUE 13)
+# still commits >1.5 accepted tokens per verify step on repetitive
+# traffic while staying token-exact with the non-speculative paged
+# engine, inside the fixed executable set (ONE donated verify step,
+# never a compile per accept length).
+#
+# Runs bench.py --serving's speculation phase only
+# (BENCH_SERVING_PHASES=spec; the base/paged/quant trio is the nightly
+# bench's job), with the int8 leg ON (it is the page-byte/prefix-hash
+# attestation's live half — the byte-exact half lives in
+# tests/test_speculative.py) and a telemetry dir so the serving_step
+# JSONL events can be grepped for the new drafted/accepted fields.
+#
+# Usage: tools/spec_smoke.sh
+# Exit:  bench exit status, or 1 if the metric line / attestations /
+#        JSONL fields are missing.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+LOG=$(mktemp /tmp/spec_smoke.XXXXXX.log)
+TEL=$(mktemp -d /tmp/spec_smoke_tel.XXXXXX)
+timeout -k 10 55 env JAX_PLATFORMS=cpu \
+    BENCH_SERVING_PHASES=spec BENCH_SPEC_REQUESTS=8 \
+    PADDLE_TELEMETRY_DIR="$TEL" \
+    python bench.py --serving 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+if [ "$rc" -ne 0 ]; then
+    echo "spec_smoke: FAIL (rc=$rc)" >&2
+    exit "$rc"
+fi
+if ! grep -q '"metric": "serving_spec_accepted_tokens_per_step"' "$LOG"; then
+    echo "spec_smoke: FAIL — no parsed" \
+         "serving_spec_accepted_tokens_per_step metric line" >&2
+    exit 1
+fi
+if ! grep -q '"parity": "token-exact"' "$LOG"; then
+    echo "spec_smoke: FAIL — metric line does not attest token-exact" \
+         "parity vs the non-speculative paged engine" >&2
+    exit 1
+fi
+for mode in ngram draft; do
+    if ! grep -q "# serving/spec $mode: .*(>1.5)" "$LOG"; then
+        echo "spec_smoke: FAIL — no accepted-rate attestation for the" \
+             "$mode drafting mode" >&2
+        exit 1
+    fi
+done
+if ! grep -q '"greedy_match_vs_nonspec_int8": true' "$LOG"; then
+    echo "spec_smoke: FAIL — metric line does not attest int8 spec" \
+         "parity vs the non-speculative int8 engine" >&2
+    exit 1
+fi
+for field in drafted accepted committed; do
+    if ! grep -h '"event": "serving_step"' "$TEL"/*.jsonl \
+            | grep -q "\"$field\""; then
+        echo "spec_smoke: FAIL — serving_step JSONL events do not" \
+             "carry the $field speculation field" >&2
+        exit 1
+    fi
+done
+rm -rf "$TEL"
+echo "spec_smoke: OK"
