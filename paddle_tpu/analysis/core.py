@@ -103,8 +103,9 @@ def _load_builtin_rules():
     if _builtin_loaded[0]:
         return
     _builtin_loaded[0] = True
-    from . import (rules_compat, rules_donation,  # noqa: F401
-                   rules_hotpath, rules_locks, rules_tracer)
+    from . import (rules_cache, rules_compat,  # noqa: F401
+                   rules_donation, rules_hotpath, rules_locks,
+                   rules_tracer)
 
 
 # --------------------------------------------------------------------------

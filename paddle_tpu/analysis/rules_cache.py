@@ -1,0 +1,172 @@
+"""PTL006 — ad-hoc compiled-executable caches.
+
+ISSUE 14 folded seven separately-invented executable caches into ONE
+compile-management layer (``framework/compile_cache.py``: signature
+keying, donation-aware keys, bounded LRU, the ``compile.*`` counter
+family, AOT artifact serialization).  This rule keeps the sprawl from
+re-accreting: storing a ``jax.jit``/``pjit``-produced callable into a
+subscripted container (``self._fns[key] = jax.jit(f)``, a dict/
+OrderedDict LRU of compiled functions) outside compile_cache.py is a
+NEW ad-hoc cache — route it through a ``compile_cache.site()`` instead,
+where it gets keying discipline, eviction counting and the artifact
+store for free.
+
+Detection is value-flow-lite, matching the repo's historical idioms:
+
+* a direct ``jax.jit(...)`` / ``jax.pjit(...)`` call (origin-resolved
+  through the import table, plus the ``self._jax.jit`` attribute
+  spelling) assigned into any subscript target;
+* a LOCAL name previously bound to such a call (``fn = jax.jit(f);
+  cache[k] = fn``);
+* a call to a same-module builder function/method whose return value
+  is jit-producing — one hop, covering the
+  ``self._fns[key] = self._build_reduce_fn()`` shape — including
+  builders returning dict/tuple/list literals OF jitted callables
+  (the pinned/unpinned variant-pair idiom);
+* ``cache.setdefault(key, jax.jit(f))``.
+
+Suppress a justified exception with the usual
+``# ptl: disable=PTL006 -- why`` escape hatch; accepted legacy sites
+ride the baseline like every other rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .resolve import dotted_name
+
+JIT_ORIGINS = {
+    "jax.jit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+# attribute spellings that cannot resolve through the import table but
+# are unambiguous in this repo (self._jax is the engines' jax handle)
+JIT_TAILS = ("jit", "pjit")
+
+# the one module allowed to hold compiled callables in containers
+ALLOWED_PATH_SUFFIXES = ("framework/compile_cache.py",)
+
+
+def _allowed(relpath):
+    return any(relpath.endswith(s) for s in ALLOWED_PATH_SUFFIXES)
+
+
+@register
+class AdhocCompileCacheRule(Rule):
+    id = "PTL006"
+    name = "adhoc-compile-cache"
+    describe = ("jit-compiled callable stored in an ad-hoc container "
+                "cache outside framework/compile_cache.py")
+
+    # ---------------------------------------------------- classification
+    def _is_jit_expr(self, node, mod, builders, local_jit):
+        """Does this expression produce (or contain) a compiled
+        callable?  Conservative value-flow over one scope."""
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func)
+            if not dotted:
+                return False
+            origin = mod.imports.qualify_dotted(dotted)
+            if origin in JIT_ORIGINS:
+                return True
+            tail = dotted.rsplit(".", 1)[-1]
+            if tail in JIT_TAILS and "." in dotted:
+                # jax.jit / self._jax.jit / pjit module attr chains;
+                # a bare local function NAMED jit() would need the dot
+                return True
+            if tail in builders:
+                return True
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in local_jit
+        if isinstance(node, ast.Dict):
+            return any(v is not None
+                       and self._is_jit_expr(v, mod, builders, local_jit)
+                       for v in node.values)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._is_jit_expr(e, mod, builders, local_jit)
+                       for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self._is_jit_expr(node.body, mod, builders, local_jit)
+                    or self._is_jit_expr(node.orelse, mod, builders,
+                                         local_jit))
+        return False
+
+    def _local_jit_names(self, scope, mod, builders):
+        """Names bound to jit-producing expressions inside ``scope``
+        (two passes: a name bound from another jit-bound name on an
+        earlier line still resolves)."""
+        local = set()
+        for _ in range(2):
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign):
+                    if self._is_jit_expr(node.value, mod, builders,
+                                         local):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local.add(t.id)
+        return local
+
+    def _builders(self, mod):
+        """Same-module functions whose RETURN value is jit-producing —
+        the one-hop call-graph that catches the builder-method idiom."""
+        out = set()
+        fns = [n for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for _ in range(2):          # builders returning builders' calls
+            for fn in fns:
+                if fn.name in out:
+                    continue
+                local = self._local_jit_names(fn, mod, out)
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Return)
+                            and node.value is not None
+                            and self._is_jit_expr(node.value, mod, out,
+                                                  local)):
+                        out.add(fn.name)
+                        break
+        return out
+
+    # ------------------------------------------------------------- visit
+    def visit_module(self, mod, add):
+        if _allowed(mod.relpath):
+            return
+        builders = self._builders(mod)
+        scopes = [mod.tree] + [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        seen = set()
+
+        def report(node, container):
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                return
+            seen.add(key)
+            add(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                f"compiled callable stored in ad-hoc cache "
+                f"{container!r} — route it through framework/"
+                "compile_cache.py::site() (keying, eviction counting "
+                "and AOT artifacts come with it)",
+                symbol=container, scope=mod.scope_at(node.lineno)))
+
+        for scope in scopes:
+            local = self._local_jit_names(scope, mod, builders)
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign):
+                    subs = [t for t in node.targets
+                            if isinstance(t, ast.Subscript)]
+                    if subs and self._is_jit_expr(node.value, mod,
+                                                  builders, local):
+                        for t in subs:
+                            report(node, dotted_name(t.value)
+                                   or "<container>")
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "setdefault"
+                      and len(node.args) >= 2
+                      and self._is_jit_expr(node.args[1], mod, builders,
+                                            local)):
+                    report(node, dotted_name(node.func.value)
+                           or "<container>")

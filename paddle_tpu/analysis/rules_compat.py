@@ -35,6 +35,14 @@ MOVING_API = {
     "jax.numpy.float8_e4m3fn": "fp8_dtype",
     "jax.experimental.pallas.tpu.CompilerParams": "tpu_compiler_params",
     "jax.experimental.pallas.tpu.TPUCompilerParams": "tpu_compiler_params",
+    # AOT export / compiled-executable serialization (ISSUE 14): jax
+    # has re-homed export (experimental -> top-level) and the
+    # serialize_executable surface is experimental — route through
+    # jax_compat so the next move is a one-line fix
+    "jax.export": "jax_export_module",
+    "jax.experimental.export": "jax_export_module",
+    "jax.experimental.serialize_executable":
+        "aot_serialize_compiled / aot_deserialize_compiled",
 }
 
 # the one module allowed to pin the moving spellings

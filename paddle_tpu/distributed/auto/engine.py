@@ -26,6 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...framework import compile_cache as _cc
 from ...framework import jax_compat
 from ...framework.jax_compat import shard_map, partition_spec as P
 from ...optimizer.functional import adamw_update
@@ -35,6 +36,11 @@ from . import zero as zero_mod
 from .stats import _sharding_stats
 
 MESH_AXES = ("dp", "pp", "tp", "sp")
+
+# module-level: the donated MP step cache outlives any one
+# make_train_step call (repeated builders with identical identity
+# reuse one compiled program)
+_mp_step_site = _cc.site("mp.train_step", maxsize=8)
 
 
 def make_mesh(dp=1, tp=1, pp=1, devices=None):
@@ -266,7 +272,25 @@ def make_train_step(cfg, mesh, n_microbatch=1, zero_stage=2,
                   P("dp", "sp"), P()),
         out_specs=(specs, mspecs, mspecs, P()),
         check_vma=False)
-    jitted = jax.jit(sharded, donate_argnums=(0, 1, 2))
+    # the donated MP step rides the unified compile layer: two
+    # make_train_step calls with an identical (cfg, mesh, schedule,
+    # hyper) identity share ONE jitted program instead of re-tracing —
+    # the step is deterministic in exactly these inputs (params/moments
+    # are operands).  No AOT stable_key: shard_map programs are bound to
+    # the live mesh's device topology, which the artifact store cannot
+    # attest across processes.
+    import dataclasses as _dc
+    _mp_key = _cc.make_key(
+        "mp_step",
+        tuple(sorted((k, str(v))
+                     for k, v in _dc.asdict(cfg).items())),
+        tuple(mesh.axis_names), tuple(mesh.devices.shape),
+        tuple(int(d.id) for d in mesh.devices.flat),
+        n_microbatch, zero_stage, beta1, beta2, eps, weight_decay,
+        clip_norm, xent_chunks, family,
+        donate=(0, 1, 2))
+    jitted = _mp_step_site.get(
+        _mp_key, lambda: jax.jit(sharded, donate_argnums=(0, 1, 2)))
 
     # the host wrapper publishes the static plan per launch; batch/seq
     # for byte accounting are read from the first call's operands

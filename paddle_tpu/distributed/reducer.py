@@ -71,6 +71,15 @@ def reset_reducer_stats():
 # transports
 # --------------------------------------------------------------------------
 
+def _cc_key(shape, dtype):
+    """Mesh-collective site key: (shape, dtype).  No donation component
+    — these transports never donate (the reduced flat is a NEW mesh
+    array; donating the input would consume the grad buffer backward
+    still holds)."""
+    from ..framework.compile_cache import make_key
+    return make_key(tuple(shape), str(dtype))
+
+
 class DeviceMeshAllReduce:
     """Bucket all_reduce over a single-process device mesh: replicate the
     flat bucket onto the dp devices, one jitted shard_map psum per bucket
@@ -96,16 +105,16 @@ class DeviceMeshAllReduce:
         # the drained collective was executing while backward kept
         # tracing between the two bucket completions.
         self._inflight = None
-        # per-instance executable cache: a class-level lru_cache would pin
-        # discarded transports (and their meshes + compiled collectives)
-        # alive for the process lifetime
-        self._fns = {}
+        # per-instance executable cache via a compile_cache site: a
+        # class-level lru_cache would pin discarded transports (and
+        # their meshes + compiled collectives) alive for the process
+        # lifetime; the site is per-instance, the counters shared
+        from ..framework import compile_cache as _cc
+        self._fns = _cc.site("reducer.allreduce", maxsize=64)
 
     def _reduce_fn(self, shape, dtype):
-        fn = self._fns.get((shape, dtype))
-        if fn is None:
-            fn = self._fns[(shape, dtype)] = self._build_reduce_fn()
-        return fn
+        return self._fns.get(_cc_key(shape, dtype),
+                             self._build_reduce_fn)
 
     def _build_reduce_fn(self):
         from ..framework.jax_compat import (named_sharding, shard_map,
@@ -177,7 +186,11 @@ class MeshAxesAllReduce:
         self.tp = sizes.get(tp_axis, 1) if tp_axis else 1
         self.nranks = self.dp * self.tp
         self._inflight = None
-        self._fns = {}
+        # pinned/unpinned jit variant PAIRS per (shape, dtype), stored
+        # as one site entry — acquisition and counting through the
+        # unified compile layer
+        from ..framework import compile_cache as _cc
+        self._fns = _cc.site("reducer.mesh_axes", maxsize=64)
 
     def _stats(self):
         from .auto.stats import _sharding_stats
@@ -227,10 +240,7 @@ class MeshAxesAllReduce:
             flat = jnp.concatenate(
                 [flat, jnp.zeros((pad,), flat.dtype)])
         x = flat.reshape(self.dp, (n + pad) // self.dp)
-        key = (tuple(x.shape), str(x.dtype))
-        fns = self._fns.get(key)
-        if fns is None:
-            fns = self._fns[key] = self._build()
+        fns = self._fns.get(_cc_key(x.shape, x.dtype), self._build)
         try:
             out = fns["pinned"](x)
         except ValueError:

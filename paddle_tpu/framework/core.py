@@ -223,11 +223,21 @@ def get_device() -> str:
 class Generator:
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        # LAZY: PRNGKey(seed) compiles two tiny XLA programs, and the
+        # default generator is built at import — a process that never
+        # draws (an AOT-warm serving replica loading checkpointed
+        # params) must stay at zero compiles, so the key materializes
+        # on first use
+        self._key = None
+
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(self._seed)
+        self._key = None
         return self
 
     def initial_seed(self) -> int:
@@ -239,12 +249,12 @@ class Generator:
         # the split would otherwise be staged and a TRACER would escape
         # into host state, corrupting every later draw
         with jax.ensure_compile_time_eval():
-            self._key, sub = jax.random.split(self._key)
+            self._key, sub = jax.random.split(self._ensure_key())
         return sub
 
     def get_state(self):
         """Exact stream position (paddle.get_rng_state analogue)."""
-        return {"seed": self._seed, "key": np.asarray(self._key)}
+        return {"seed": self._seed, "key": np.asarray(self._ensure_key())}
 
     def set_state(self, state):
         self._seed = int(state["seed"])

@@ -142,6 +142,54 @@ def donation_enabled(env_var):
 
 
 # --------------------------------------------------------------------------
+# AOT export / compiled-executable serialization (compile_cache artifacts)
+# --------------------------------------------------------------------------
+
+def jax_export_module():
+    """The ``jax.export`` module (StableHLO export/deserialize,
+    symbolic shapes).  jax has re-homed export twice
+    (``jax.experimental.export`` -> ``jax.export``); every export site
+    routes through here so the next move is a one-line fix."""
+    try:
+        from jax import export
+        return export
+    except ImportError:                                  # pragma: no cover
+        from jax.experimental import export
+        return export
+
+
+def aot_supported():
+    """Can this jax serialize AOT-compiled executables
+    (``jax.experimental.serialize_executable``)?  False on jax builds
+    without the API — compile_cache degrades to the plain
+    build/persistent-cache path."""
+    try:
+        from jax.experimental import serialize_executable  # noqa: F401
+        return True
+    except Exception:                                      # noqa: BLE001
+        return False
+
+
+def aot_serialize_compiled(compiled):
+    """One pickleable blob for a ``jit(f).lower(...).compile()``
+    executable: the xla-serialized binary plus its in/out pytree defs
+    (the triple ``serialize_executable.serialize`` returns).  Loading
+    it back in a FRESH process costs zero traces and zero backend
+    compiles — the whole point of the artifact store."""
+    import pickle
+    from jax.experimental import serialize_executable as _se
+    return pickle.dumps(_se.serialize(compiled))
+
+
+def aot_deserialize_compiled(blob):
+    """Inverse of :func:`aot_serialize_compiled`: a callable executable
+    bound to this process's devices."""
+    import pickle
+    from jax.experimental import serialize_executable as _se
+    return _se.deserialize_and_load(*pickle.loads(blob))
+
+
+# --------------------------------------------------------------------------
 # Persistent compilation cache (PADDLE_JIT_CACHE_DIR)
 # --------------------------------------------------------------------------
 

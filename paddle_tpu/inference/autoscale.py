@@ -167,7 +167,16 @@ class Autoscaler:
             reasons_up.append("p99")
             self._inc("up_signals_p99")
         healthy = max(sig["healthy"], 1)
-        if sig["backlog"] > self.up_backlog_per_replica * healthy:
+        # ISSUE 14 satellite: speculative fleets drain backlog in
+        # accepted-TOKENS/s, not steps/s — a replica committing ~4
+        # tokens per row-verify clears a queue ~4x sooner, so the
+        # backlog threshold scales with the fleet's live
+        # serving.accepted_tokens_per_step (1.0 when absent or
+        # non-speculative: behavior unchanged)
+        spec_rate = max(
+            float(sig.get("accepted_tokens_per_step") or 0.0), 1.0)
+        if sig["backlog"] > (self.up_backlog_per_replica * healthy
+                             * spec_rate):
             reasons_up.append("backlog")
             self._inc("up_signals_backlog")
         if sig["pending_fraction"] >= self.pending_headroom:
@@ -225,9 +234,10 @@ class Autoscaler:
         self._up_streak = self._down_streak = 0
         rec = {"action": f"scale_{direction}", "replica": rid,
                "reasons": list(reasons), "t": time.time(),
-               "signals": {k: sig[k] for k in (
+               "signals": {k: sig.get(k) for k in (
                    "backlog", "pending_fraction", "occupancy", "p99_s",
-                   "configured", "healthy")}}
+                   "configured", "healthy",
+                   "accepted_tokens_per_step")}}
         self.decisions.append(rec)
         self._g_target.set(target + (1 if direction == "up" else -1))
         timeline.emit(dict(rec, event="autoscale_decision"))

@@ -23,10 +23,15 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import export as jax_export
 
 from ..framework import core
+from ..framework import compile_cache as _cc
+from ..framework.jax_compat import jax_export_module
 from ..tensor.tensor import Tensor
+
+# jax has re-homed the export module across releases: route through
+# jax_compat (PTL001) instead of pinning a spelling
+jax_export = jax_export_module()
 
 META_SUFFIX = ".pdmeta"
 HLO_SUFFIX = ".stablehlo"
@@ -149,12 +154,9 @@ def save_inference_model(path_prefix, layer_or_fn, input_spec,
     return meta
 
 
-def _next_bucket(n):
-    """Smallest power of two >= n: the dynamic-batch pad ladder."""
-    b = 1
-    while b < n:
-        b *= 2
-    return b
+# the dynamic-batch pad ladder: the shared bucket maths of the unified
+# compile layer (kept under the old name for existing importers)
+_next_bucket = _cc.next_pow2
 
 
 class StandaloneModel:
@@ -182,7 +184,8 @@ class StandaloneModel:
 
     def __init__(self, path_prefix, device=None, batch_bucketing=True):
         with open(path_prefix + HLO_SUFFIX, "rb") as f:
-            self._exported = jax_export.deserialize(f.read())
+            hlo_bytes = f.read()
+        self._exported = jax_export.deserialize(hlo_bytes)
         with open(path_prefix + META_SUFFIX) as f:
             self.meta = json.load(f)
         self._device = device
@@ -201,11 +204,20 @@ class StandaloneModel:
                                and self._out_dyn
                                and all(self._out_dyn))
         from ..observability import metrics as _metrics
-        from ..ops.dispatch import SignatureLRU
         self._stats = _metrics.stats_family("serving",
                                             {"standalone_compiles": 0})
-        self._calls = SignatureLRU(maxsize=32, stats=self._stats,
-                                   compile_key="standalone_compiles")
+        # per-shape executables live in a compile_cache site; the legacy
+        # serving.standalone_compiles counter stays as the aliased view
+        self._calls = _cc.site(
+            "standalone", maxsize=32,
+            legacy_inc=lambda ev: (self._stats.inc("standalone_compiles")
+                                   if ev == "build" else None))
+        # cross-process AOT identity: the artifact's own bytes (read
+        # once above) + the call signature — two processes loading the
+        # same <prefix>.stablehlo share serialized executables
+        import hashlib as _hl
+        self._hlo_digest = _hl.blake2b(hlo_bytes,
+                                       digest_size=12).hexdigest()
         self._bucket_probed = False
 
     def input_names(self):
@@ -215,10 +227,13 @@ class StandaloneModel:
         return [o["name"] for o in self.meta["outputs"]]
 
     def _call_exact(self, arrays):
-        """Run at the true input shapes (signature-cached, counted)."""
-        key = tuple((a.shape, str(a.dtype)) for a in arrays)
-        call = self._calls.get(key,
-                               lambda: jax.jit(self._exported.call))
+        """Run at the true input shapes (signature-cached, counted;
+        AOT-serialized per shape when PADDLE_AOT_CACHE_DIR is set)."""
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        call = self._calls.get(
+            _cc.make_key(sig), lambda: jax.jit(self._exported.call),
+            stable_key=f"standalone/{self._hlo_digest}/{sig}",
+            example_args=tuple(arrays))
         out = call(*arrays)
         return list(out) if isinstance(out, (tuple, list)) else [out]
 

@@ -257,7 +257,8 @@ class ServingFleet:
     """
 
     def __init__(self, model_spec, replicas=None, *, env_base=None,
-                 log_dir=None, jit_cache_dir=None, telemetry_dir=None,
+                 log_dir=None, jit_cache_dir=None, aot_cache_dir=None,
+                 telemetry_dir=None,
                  heartbeat_s=None, heartbeat_idle_s=0.05,
                  request_deadline_s=None, max_retries=None,
                  retry_backoff_s=None, max_pending=None,
@@ -321,6 +322,10 @@ class ServingFleet:
         self.log_dir = log_dir
         self.jit_cache_dir = jit_cache_dir \
             or self.env_base.get("PADDLE_JIT_CACHE_DIR")
+        # AOT artifact dir (ISSUE 14): replicas load serialized
+        # executables from here and boot with zero XLA compiles
+        self.aot_cache_dir = aot_cache_dir \
+            or self.env_base.get("PADDLE_AOT_CACHE_DIR")
         self.telemetry_dir = telemetry_dir \
             or self.env_base.get("PADDLE_TELEMETRY_DIR")
         self.heartbeat_s = heartbeat_s if heartbeat_s is not None \
@@ -563,6 +568,9 @@ class ServingFleet:
         if self.jit_cache_dir:
             env["PADDLE_JIT_CACHE_DIR"] = os.path.abspath(
                 self.jit_cache_dir)
+        if self.aot_cache_dir:
+            env["PADDLE_AOT_CACHE_DIR"] = os.path.abspath(
+                self.aot_cache_dir)
         if self.telemetry_dir:
             env["PADDLE_TELEMETRY_DIR"] = os.path.abspath(
                 self.telemetry_dir)
@@ -634,6 +642,7 @@ class ServingFleet:
             r.last_stats = stats
             r.state = "healthy"
             self._g_up.inc(1)
+            compile_att = hello.get("compile") or {}
             if r.scale_ev is not None:
                 # close the open scale-up record: the bench's
                 # warm-scale-up attestation reads these
@@ -641,6 +650,12 @@ class ServingFleet:
                 r.scale_ev["boot_s"] = hello.get("boot_s")
                 r.scale_ev["warm_cache_misses"] = (hello.get(
                     "persistent_cache") or {}).get("misses")
+                # AOT cold-start attestation (ISSUE 14): the joiner's
+                # actual backend-compile count + artifact traffic — an
+                # artifact-warm replica reports xla_compiles == 0
+                r.scale_ev["xla_compiles"] = compile_att.get(
+                    "xla_compiles")
+                r.scale_ev["aot"] = compile_att.get("aot")
                 r.scale_ev = None
             if r.incident_t is not None:
                 rec = round(time.monotonic() - r.incident_t, 3)
@@ -652,6 +667,8 @@ class ServingFleet:
                         "recovery_s": rec,
                         "warm_cache_misses": (hello.get(
                             "persistent_cache") or {}).get("misses"),
+                        "xla_compiles": compile_att.get("xla_compiles"),
+                        "aot": compile_att.get("aot"),
                     })
             return
 
@@ -1229,6 +1246,7 @@ class ServingFleet:
             reps = [r for r in self._replicas if not r.draining]
             healthy = sum(1 for r in reps if r.state == "healthy")
             occ = []
+            accepted = []
             for r in reps:
                 if r.state != "healthy":
                     continue
@@ -1237,6 +1255,13 @@ class ServingFleet:
                 occ.append(min(
                     (int(st.get("slot_occupancy") or 0)
                      + int(st.get("queue_depth") or 0)) / slots, 2.0))
+                # speculative replicas echo their live
+                # serving.accepted_tokens_per_step in every reply — the
+                # autoscaler normalizes backlog by it so spec fleets
+                # scale on accepted-tokens/s, not steps/s (ISSUE 14)
+                a = st.get("accepted_tokens_per_step")
+                if a:
+                    accepted.append(float(a))
             lats = sorted(lat for (t, lat, _p) in self._lat_recent
                           if now - t <= window_s)
             sheds = self._counts.get("sheds", 0)
@@ -1249,6 +1274,9 @@ class ServingFleet:
             "p99_s": metrics.nearest_rank_percentile(lats, 99),
             "p50_s": metrics.nearest_rank_percentile(lats, 50),
             "window_n": len(lats), "sheds": sheds,
+            "accepted_tokens_per_step": (
+                round(sum(accepted) / len(accepted), 4)
+                if accepted else 0.0),
         }
 
     # ------------------------------------------------------------- public

@@ -73,8 +73,14 @@ def _build_engine(spec):
         cfg = G.gpt_tiny()
     else:
         cfg = getattr(G, preset)()
-    params = G.init_params(cfg, jax.random.PRNGKey(int(spec.get("seed",
-                                                                0))))
+    if spec.get("params_npz"):
+        # checkpoint boot: pure device_put, no RNG executables — the
+        # AOT cold-start path's zero-compile contract depends on it
+        params = G.load_params_npz(str(spec["params_npz"]))
+    else:
+        params = G.init_params(cfg,
+                               jax.random.PRNGKey(int(spec.get("seed",
+                                                               0))))
     kw = {}
     for k in ("slots", "max_len", "max_queue"):
         if spec.get(k) is not None:
@@ -135,10 +141,24 @@ def _cache_counters():
                 metrics.counter("compile.persistent_cache_requests").value}
 
 
+def _compile_counters():
+    """The replica's compile-layer attestation: backend compiles
+    actually run (compile.count, via the timeline hook installed before
+    the engine builds) and the AOT artifact traffic.  An artifact-warm
+    replica reports xla_compiles == 0 — the fleet cold-start contract
+    bench.py asserts."""
+    from ..framework.compile_cache import compile_stats
+    cs = compile_stats()
+    return {"xla_compiles": int(cs.get("count", 0)),
+            "aot": {k: cs.get(f"aot_{k}", 0)
+                    for k in ("hits", "misses", "saves", "errors")}}
+
+
 def _stats(engine, extra=None):
     st = engine.stats()
     st["slots"] = engine.slots
     st["persistent_cache"] = _cache_counters()
+    st.update(_compile_counters())
     if extra:
         st.update(extra)
     return st
@@ -284,6 +304,9 @@ def main(argv=None):
     _faults.slow_start_check()
 
     t0 = time.perf_counter()
+    # the compile hook must be live BEFORE the engine builds so the
+    # hello's xla_compiles attestation covers every boot compile
+    timeline.install_compile_hook()
     engine = _build_engine(spec)
     warm = engine.warmup() if spec.get("warmup", True) else 0
     boot_s = time.perf_counter() - t0
@@ -295,11 +318,13 @@ def main(argv=None):
                     "warmup_prefill_compiles": warm,
                     "boot_s": round(boot_s, 3),
                     "persistent_cache": _cache_counters(),
+                    "compile": _compile_counters(),
                     "stats": _stats(engine)})
     timeline.emit({"event": "fleet_replica_up", "replica": args.replica,
                    "incarnation": incarnation, "boot_s": round(boot_s, 3),
                    "warmup_prefill_compiles": warm,
-                   "persistent_cache": _cache_counters()})
+                   "persistent_cache": _cache_counters(),
+                   "compile": _compile_counters()})
     return serve(sock, engine, args.replica, incarnation)
 
 

@@ -71,6 +71,7 @@ import time
 
 import numpy as np
 
+from ..framework import compile_cache as _cc
 from ..models import gpt
 from ..observability import metrics, timeline
 from ..testing import faults as _faults
@@ -207,12 +208,34 @@ class SpeculativeServingEngine(PagedServingEngine):
         self._draft_k = self._draft_v = None
         self._draft_jit = None
         self._draft_prefill_jit = None
+        self._draft_site = _cc.site("serving.draft", maxsize=4)
         self._commit_sum = 0            # committed tokens over live traffic
         self._rowstep_sum = 0           # active rows x verify steps
         super().__init__(model, **kw)
         self.spec_mode = mode           # the contract attestation fields
         self.spec_k = k
         self._g_accept = metrics.gauge("serving.accepted_tokens_per_step")
+
+    def _aot_sig(self):
+        dc = (",".join(f"{k}={v}" for k, v in sorted(
+            dataclasses.asdict(self._draft_cfg).items()))
+            if self._draft_cfg is not None else None)
+        return (f"{super()._aot_sig()}/spec={self._spec_mode_val}"
+                f"/k={self._spec_k_val}/dchunk={self._draft_chunk}"
+                f"/dcfg[{dc}]")
+
+    def _aot_has_core(self):
+        """The spec engine's decode site holds the VERIFY executable
+        (the single-token paged decode never runs here); draft mode
+        additionally needs both draft executables before a warmup wave
+        may be skipped — a skipped wave with a missing draft artifact
+        would push the draft compile into live traffic."""
+        core = _cc.artifact_ready(self._aot_key("verify"))
+        if core and self._spec_mode_val == "draft":
+            core = (_cc.artifact_ready(
+                self._aot_key("draft_prefill", c=self._draft_chunk))
+                and _cc.artifact_ready(self._aot_key("draft_step")))
+        return core
 
     # ------------------------------------------------------- draft model
     def _build_draft_cfg(self):
@@ -297,17 +320,23 @@ class SpeculativeServingEngine(PagedServingEngine):
         jnp = self._jnp
         s = req.slot
         C = self._draft_chunk
-        if self._draft_prefill_jit is None:
-            self._draft_prefill_jit = self._build_draft_prefill(C)
-            self._inc("spec_draft_compiles")
         p = np.asarray(req.prompt, np.int32)
         for pos in range(0, len(p), C):
             take = min(C, len(p) - pos)
             toks = np.zeros((1, C), np.int32)
             toks[0, :take] = p[pos:pos + take]
+            operands = (self._draft_params, self._draft_k, self._draft_v,
+                        jnp.asarray(toks), np.int32(s), np.int32(pos))
+            if self._draft_prefill_jit is None:
+                donate = (1, 2) if _donation_enabled() else ()
+                self._draft_prefill_jit = self._draft_site.get(
+                    _cc.make_key("draft_prefill", C, donate=donate),
+                    lambda: self._build_draft_prefill(C),
+                    stable_key=self._aot_key("draft_prefill", c=C),
+                    example_args=operands)
+                self._inc("spec_draft_compiles")
             self._draft_k, self._draft_v = self._draft_prefill_jit(
-                self._draft_params, self._draft_k, self._draft_v,
-                jnp.asarray(toks), np.int32(s), np.int32(pos))
+                *operands)
         self._draft_lens[s] = len(p)
         req.pending_draft = list(req.tokens)
 
@@ -355,15 +384,21 @@ class SpeculativeServingEngine(PagedServingEngine):
             pend = self._slot_req[s].pending_draft or []
             ctx[s, :len(pend)] = pend
             n_ctx[s] = len(pend)
+        operands = (self._draft_params, self._draft_k, self._draft_v,
+                    jnp.asarray(ctx), jnp.asarray(n_ctx),
+                    jnp.asarray(self._draft_lens))
         if self._draft_jit is None:
-            self._draft_jit = self._build_draft_step()
+            donate = (1, 2) if _donation_enabled() else ()
+            self._draft_jit = self._draft_site.get(
+                _cc.make_key("draft_step", k, donate=donate),
+                self._build_draft_step,
+                stable_key=self._aot_key("draft_step"),
+                example_args=operands)
             self._inc("spec_draft_compiles")
         with timeline.span("serving.spec_draft",
                            active=int(self._active.sum())):
             self._draft_k, self._draft_v, drafts = self._draft_jit(
-                self._draft_params, self._draft_k, self._draft_v,
-                jnp.asarray(ctx), jnp.asarray(n_ctx),
-                jnp.asarray(self._draft_lens))
+                *operands)
         for s in range(self.slots):
             if self._active[s]:
                 self._draft_lens[s] += int(n_ctx[s])
@@ -491,20 +526,24 @@ class SpeculativeServingEngine(PagedServingEngine):
         caps = np.where(self._active, caps, 0).astype(np.int32)
         self._spec_draft_sync()
         toks_dev = self._make_drafts()
+        operands = (self.params, *self._cache_operands(), toks_dev,
+                    jnp.asarray(self._tables_np), jnp.asarray(wpages),
+                    jnp.asarray(woffs), jnp.asarray(self._lens),
+                    jnp.asarray(caps), jnp.asarray(eos_ids),
+                    np.int32(force_reject))
         if self._decode_jit is None:
-            self._decode_jit = self._build_verify()
+            donate = self._donate()
+            self._decode_jit = self._decode_site.get(
+                _cc.make_key("verify", donate=donate), self._build_verify,
+                stable_key=self._aot_key("verify"),
+                example_args=operands)
             self._inc("decode_compiles")
         finished = []
         t0 = time.perf_counter()
         with timeline.span("serving.decode_step",
                            active=int(self._active.sum()), paged=True,
                            spec=self._spec_mode_val):
-            out = self._decode_jit(
-                self.params, *self._cache_operands(), toks_dev,
-                jnp.asarray(self._tables_np), jnp.asarray(wpages),
-                jnp.asarray(woffs), jnp.asarray(self._lens),
-                jnp.asarray(caps), jnp.asarray(eos_ids),
-                np.int32(force_reject))
+            out = self._decode_jit(*operands)
         self._set_cache(out[:self._n_cache])
         # ptl: disable-next=PTL004 -- capture_logits debug mode readback
         logits_np = (np.asarray(out[self._n_cache + 2])

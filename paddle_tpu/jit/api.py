@@ -58,31 +58,36 @@ class TracedLayer:
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
-        self._jitted = {}
+        # per-(training, static-kwargs) executables through the unified
+        # compile layer (ISSUE 14)
+        from ..framework import compile_cache as _cc
+        self._jitted = _cc.site("jit.traced_layer", maxsize=32)
+        self._make_key = _cc.make_key
 
     def _get_jitted(self, training, kw_key=(), skw=None):
-        key = (training, kw_key)
-        if key not in self._jitted:
-            layer = self._layer
-            skw = dict(skw or {})
+        layer = self._layer
+        skw = dict(skw or {})
 
+        def build():
             if layer is not None:
-                def staged(param_vals, buffer_vals, rng, arg_vals, kw_vals):
+                def staged(param_vals, buffer_vals, rng, arg_vals,
+                           kw_vals):
                     out, new_buf = fx.functional_call(
                         layer, param_vals, buffer_vals, arg_vals,
                         kwargs={**_to_tensors_kw(kw_vals), **skw},
                         rng_key=rng)
                     return out, new_buf
-                self._jitted[key] = jax.jit(staged)
-            else:
-                def staged(rng, arg_vals, kw_vals):
-                    with fx.trace_mode(rng):
-                        args = _to_tensors(arg_vals)
-                        out = self._fn(*args, **_to_tensors_kw(kw_vals),
-                                       **skw)
-                    return _to_vals(out)
-                self._jitted[key] = jax.jit(staged)
-        return self._jitted[key]
+                return jax.jit(staged)
+
+            def staged(rng, arg_vals, kw_vals):
+                with fx.trace_mode(rng):
+                    args = _to_tensors(arg_vals)
+                    out = self._fn(*args, **_to_tensors_kw(kw_vals),
+                                   **skw)
+                return _to_vals(out)
+            return jax.jit(staged)
+
+        return self._jitted.get(self._make_key(training, kw_key), build)
 
     def __call__(self, *args, **kwargs):
         from ..tensor.tensor import Tensor as _T

@@ -128,6 +128,41 @@ def init_params(cfg: GPTConfig, key):
     }
 
 
+def save_params_npz(path, params):
+    """Checkpoint a param pytree (nested dicts of arrays — fp or the
+    quantized {'qw','scale'} leaves) as one npz, keys = '/'-joined
+    paths.  The serving-replica boot format: a replacement replica
+    loads weights from here instead of re-running the seeded init
+    (which compiles RNG executables — the AOT cold boot must not)."""
+    import numpy as np
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k2 in node:
+                walk(f"{prefix}/{k2}" if prefix else str(k2), node[k2])
+        else:
+            flat[prefix] = np.asarray(node)
+    walk("", params)
+    np.savez(path, **flat)
+    return path
+
+
+def load_params_npz(path):
+    """Inverse of :func:`save_params_npz`: pure ``device_put`` — zero
+    traces, zero XLA compiles."""
+    import numpy as np
+    out = {}
+    with np.load(path) as z:
+        for key in z.files:
+            node = out
+            parts = key.split("/")
+            for p2 in parts[:-1]:
+                node = node.setdefault(p2, {})
+            node[parts[-1]] = jax.device_put(z[key])
+    return out
+
+
 def sharding_rules(cfg: GPTConfig = None):
     """Model-parallel layout hook for the distributed.auto rule registry
     (family "gpt"): the Megatron column/row splits over 'tp' (attention
@@ -491,6 +526,17 @@ def trim_eos(sequences, prompt_len, eos_token, include_eos=True):
 # zeroing, only a length reset.
 
 
+def _pool_zeros(shape, dtype):
+    """Host-side zero pool allocation: ``device_put(np.zeros)`` instead
+    of ``jnp.zeros``, because the eager broadcast COMPILES a tiny XLA
+    program per distinct shape — and the AOT-warm serving replica's
+    contract is ZERO backend compiles at boot.  Only the host-called
+    pool constructors use this; in-trace allocations stay jnp."""
+    import numpy as np
+    import jax
+    return jax.device_put(np.zeros(shape, jnp.dtype(dtype)))
+
+
 def init_slot_cache(cfg: GPTConfig, slots, max_len, dtype=None):
     """Slot-pooled KV cache: {'k','v': [L, S, max_len, nh, hd],
     'len': int32[S] tokens filled per slot}."""
@@ -501,8 +547,8 @@ def init_slot_cache(cfg: GPTConfig, slots, max_len, dtype=None):
             "positional embedding")
     cd = jnp.dtype(dtype or cfg.dtype)
     shape = (cfg.num_layers, slots, max_len, cfg.num_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd),
-            "len": jnp.zeros((slots,), jnp.int32)}
+    return {"k": _pool_zeros(shape, cd), "v": _pool_zeros(shape, cd),
+            "len": _pool_zeros((slots,), jnp.int32)}
 
 
 def reset_slots(lens, slots):
@@ -596,7 +642,7 @@ def init_paged_cache(cfg: GPTConfig, num_pages, page_size, dtype=None):
     cd = jnp.dtype(dtype or cfg.dtype)
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd)}
+    return {"k": _pool_zeros(shape, cd), "v": _pool_zeros(shape, cd)}
 
 
 def _paged_slot_block(cfg, x, blk, k_pages, v_pages, page_table,
@@ -721,10 +767,10 @@ def init_paged_cache_quant(cfg: GPTConfig, num_pages, page_size):
     Page 0 stays the scratch page."""
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, jnp.int8),
-            "v": jnp.zeros(shape, jnp.int8),
-            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
-            "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
+    return {"k": _pool_zeros(shape, jnp.int8),
+            "v": _pool_zeros(shape, jnp.int8),
+            "k_scale": _pool_zeros(shape[:-1], jnp.float32),
+            "v_scale": _pool_zeros(shape[:-1], jnp.float32)}
 
 
 def _paged_slot_block_quant(cfg, x, blk, k_pages, k_scale, v_pages,

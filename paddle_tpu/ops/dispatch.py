@@ -232,7 +232,6 @@ class _Entry:
 
 class _DispatchCache:
     def __init__(self):
-        self.entries = collections.OrderedDict()
         self.lock = threading.Lock()
         self.blacklist = set()     # fn tokens proven untraceable/impure
         self.bad_keys = set()      # signatures whose compile attempt failed
@@ -245,6 +244,21 @@ class _DispatchCache:
         self.stats = _metrics.stats_family("dispatch_cache", {
             "hits": 0, "misses": 0, "fallbacks": 0, "warming": 0,
             "evictions": 0})
+        # executable storage is a compile_cache site (the unified
+        # compile-management layer): LRU + eviction policy live there,
+        # the dispatch_cache family above stays as the ALIASED legacy
+        # view (a miss that compiles an entry IS a "misses" count)
+        from ..framework import compile_cache as _cc
+
+        def _legacy(event):
+            if event == "hit":
+                self.stats.inc("hits")
+            elif event == "build":
+                self.stats.inc("misses")
+            elif event == "evict":
+                self.stats.inc("evictions")
+        self.site = _cc.site("dispatch", maxsize=self.maxsize(),
+                             legacy_inc=_legacy)
 
     def maxsize(self):
         try:
@@ -265,21 +279,12 @@ class _DispatchCache:
             return 3
 
     def lookup(self, key):
-        with self.lock:
-            e = self.entries.get(key)
-            if e is not None:
-                self.entries.move_to_end(key)
-                self.stats["hits"] += 1
-            return e
+        # hit counting (registry "hits" + compile.hits) rides the site
+        return self.site.lookup(key)
 
     def insert(self, key, entry):
-        with self.lock:
-            self.entries[key] = entry
-            self.entries.move_to_end(key)
-            cap = self.maxsize()
-            while len(self.entries) > cap:
-                self.entries.popitem(last=False)
-                self.stats["evictions"] += 1
+        self.site.maxsize = self.maxsize()   # env knob re-read per insert
+        self.site.insert(key, entry)         # counts misses + evictions
 
 
 _cache = _DispatchCache()
@@ -296,7 +301,7 @@ def cache_stats():
     blacklisted are computed live."""
     with _cache.lock:
         out = dict(_cache.stats)
-        out["size"] = len(_cache.entries)
+        out["size"] = len(_cache.site)
         out["blacklisted"] = len(_cache.blacklist)
         return out
 
@@ -310,8 +315,8 @@ def clear_cache(blacklist=False):
     """Drop cached executables (explicit invalidation — called on
     static-mode flips; amp changes need no invalidation because the amp
     config is part of every key)."""
+    _cache.site.clear()
     with _cache.lock:
-        _cache.entries.clear()
         _cache.seen.clear()
         if blacklist:
             _cache.blacklist.clear()
@@ -424,9 +429,7 @@ def _cached_dispatch(fn, leaves, treedef, diff_pos, record, amp_tok,
         else:
             out_probe = res[0] if record else res
             entry.multi = isinstance(out_probe, (tuple, list))
-            _cache.insert(key, entry)
-            with _cache.lock:
-                _cache.stats["misses"] += 1
+            _cache.insert(key, entry)       # counts the miss (a retrace)
         multi = isinstance((res[0] if record else res), (tuple, list))
     else:
         res = entry.compiled(dyn_vals)
@@ -581,54 +584,10 @@ def _call_inner(fn, args, kwargs, _nondiff=(), _name=None):
     return wrapped if multi else wrapped[0]
 
 
-class SignatureLRU:
-    """Bounded signature -> compiled-executable map with counters in a
-    metrics family — the same keying discipline as the dispatch cache
-    above (keys describe ABSTRACT shapes/dtypes/buckets, never values),
-    reused by the inference predictor's per-shape call cache and the
-    serving engine's bucketed prefill executables.
-
-    ``get(key, build)`` returns the cached executable or calls ``build()``
-    once, counting a compile in ``stats[compile_key]`` (and hits in
-    ``stats[hit_key]`` when given)."""
-
-    def __init__(self, maxsize=64, stats=None, compile_key="compiles",
-                 hit_key=None):
-        self.entries = collections.OrderedDict()
-        self.lock = threading.Lock()
-        self.maxsize = int(maxsize)
-        self.stats = stats
-        self.compile_key = compile_key
-        self.hit_key = hit_key
-
-    def __len__(self):
-        with self.lock:
-            return len(self.entries)
-
-    def get(self, key, build):
-        with self.lock:
-            e = self.entries.get(key)
-            if e is not None:
-                self.entries.move_to_end(key)
-                if self.stats is not None and self.hit_key:
-                    self.stats.inc(self.hit_key)
-                return e
-        # build OUTSIDE the lock (tracing can re-enter arbitrary code);
-        # a racing double-build costs one redundant trace, never a wrong
-        # result — last insert wins
-        e = build()
-        with self.lock:
-            self.entries[key] = e
-            self.entries.move_to_end(key)
-            while len(self.entries) > self.maxsize:
-                self.entries.popitem(last=False)
-        if self.stats is not None:
-            self.stats.inc(self.compile_key)
-        return e
-
-    def clear(self):
-        with self.lock:
-            self.entries.clear()
+# SignatureLRU moved to the unified compile-management layer (ISSUE 14):
+# re-exported here for the PR-5 import path.  New call sites should use
+# framework/compile_cache.py::site() directly.
+from ..framework.compile_cache import SignatureLRU  # noqa: E402,F401
 
 
 def unwrap(x):

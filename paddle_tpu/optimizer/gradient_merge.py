@@ -22,28 +22,34 @@ from .optimizer import _donation_enabled
 
 # signature -> jitted tree-add / tree-scale executables (tiny: keyed on
 # the aval tuple of the accumulated grads)
-_tree_cache = collections.OrderedDict()
+def _tree_site():
+    # lazy: gradient_merge imports before the metrics registry in some
+    # paths; the site materializes on first fused accumulate
+    global _tree_cache
+    if _tree_cache is None:
+        from ..framework import compile_cache as _cc
+        _tree_cache = _cc.site("fused_step.tree_ops", maxsize=16)
+    return _tree_cache
+
+
+_tree_cache = None
 
 
 def _tree_op(kind, avals_key):
-    key = (kind, avals_key)
-    fn = _tree_cache.get(key)
-    if fn is None:
+    donate = (0,) if _donation_enabled() else ()
+
+    def build():
         if kind == "add":
             def f(accs, gs):
                 return [a + g for a, g in zip(accs, gs)]
-            donate = (0,) if _donation_enabled() else ()
         else:                       # "scale"
             def f(accs, s):
                 return [a * s for a in accs]
-            donate = (0,) if _donation_enabled() else ()
-        fn = jax.jit(f, donate_argnums=donate)
-        _tree_cache[key] = fn
-        while len(_tree_cache) > 16:
-            _tree_cache.popitem(last=False)
-    else:
-        _tree_cache.move_to_end(key)
-    return fn
+        return jax.jit(f, donate_argnums=donate)
+
+    from ..framework.compile_cache import make_key
+    return _tree_site().get(make_key(kind, avals_key, donate=donate),
+                            build)
 
 
 def _avals_key(arrs):

@@ -82,7 +82,14 @@ class Optimizer:
         self._accumulators = collections.defaultdict(dict)  # name -> {pid: arr}
         self._step_count = 0
         self._param_groups = None
-        self._fused_cache = collections.OrderedDict()  # signature -> jitted
+        # signature -> jitted compiled step: a compile_cache site (the
+        # unified compile layer); fused_step.compiles stays the aliased
+        # legacy view, fed by the site's build events
+        from ..framework import compile_cache as _cc
+        self._fused_cache = _cc.site(
+            "fused_step", maxsize=8,
+            legacy_inc=lambda ev: (_fused_stats.inc("compiles")
+                                   if ev == "build" else None))
         self._fused_mutating = False
         self._param_wd = {}       # id(p) -> per-group weight_decay override
         if (self._parameters and isinstance(self._parameters[0], dict)):
@@ -229,20 +236,17 @@ class Optimizer:
                 tuple(per))
 
     def _fused_lookup(self, key, build):
-        """Signature-keyed compiled-step cache (bounded LRU); ``build``
-        makes the jitted callable on a miss."""
+        """Signature-keyed compiled-step cache (a compile_cache site);
+        ``build`` makes the jitted callable on a miss.  Unhashable key
+        components surface as :class:`_UnhashableSignature` so the
+        caller can retry next step."""
         try:
-            compiled = self._fused_cache.get(key)
+            compiled = self._fused_cache.lookup(key)
         except TypeError as e:
             raise _UnhashableSignature(str(e)) from e
         if compiled is None:
             compiled = build()
-            self._fused_cache[key] = compiled
-            while len(self._fused_cache) > 8:
-                self._fused_cache.popitem(last=False)
-            _fused_stats["compiles"] += 1
-        else:
-            self._fused_cache.move_to_end(key)
+            self._fused_cache.insert(key, compiled)  # counts the compile
         return compiled
 
     def _commit_fused(self, params, new_ps, new_ss, t):

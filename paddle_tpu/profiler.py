@@ -361,6 +361,20 @@ def analysis_stats():
     return metrics.families().get("analysis", {})
 
 
+def compile_stats():
+    """The ONE compile-management family (framework/compile_cache.py,
+    ISSUE 14): unified cache hits/builds/evictions (plus per-site
+    ``<site>_builds`` breakdowns), the AOT artifact-store counters
+    (``aot_hits``/``aot_misses``/``aot_saves``/``aot_errors``/
+    ``aot_stale``), the absorbed persistent-compilation-cache counters,
+    and the timeline compile hook's backend-compile ``count``/
+    ``seconds``.  The seven retired per-site cache counter families
+    (``dispatch_cache.*``, ``fused_step.compiles``,
+    ``serving.*_compiles`` …) remain as ALIASED views fed by this
+    layer."""
+    return metrics.families().get("compile", {})
+
+
 def fast_path_summary():
     """One dict with every fast-path counter family — what the bench.py
     eager microbench and dp-overlap bench assert on — plus the ``faults``
@@ -375,7 +389,8 @@ def fast_path_summary():
                     ("fleet", fleet_stats),
                     ("autoscale", autoscale_stats),
                     ("sharding", sharding_stats),
-                    ("analysis", analysis_stats)):
+                    ("analysis", analysis_stats),
+                    ("compile", compile_stats)):
         try:
             out[key] = fn()
         except Exception:                                  # noqa: BLE001

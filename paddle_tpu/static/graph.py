@@ -407,7 +407,12 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
+        # one jitted replay per (program, feed-signature) — stored in a
+        # compile_cache site (ISSUE 14); the key pins the program object
+        # via id(), so the bounded LRU also stops discarded programs'
+        # executables from accumulating forever
+        from ..framework import compile_cache as _cc
+        self._cache = _cc.site("static.executor", maxsize=64)
 
     # placement hooks — ParallelExecutor shards feeds over its dp mesh
     def _place_feed(self, v):
@@ -463,10 +468,9 @@ class Executor:
                tuple(fetch_ids),
                (program.train_spec[0], id(program.train_spec[1]))
                if program.train_spec is not None else None)
-        if key not in self._cache:
-            self._cache[key] = self._compile(program, feed_names, fetch_ids,
-                                             param_ids)
-        step_fn, buf_updates, cap_ids = self._cache[key]
+        step_fn, buf_updates, cap_ids = self._cache.get(
+            key, lambda: self._compile(program, feed_names, fetch_ids,
+                                       param_ids))
         cap_vals = tuple(program.captured[v].value for v in cap_ids)
 
         if program.train_spec is not None:

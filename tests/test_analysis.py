@@ -397,6 +397,113 @@ class TestHostSync:
 
 
 # --------------------------------------------------------------------------
+# PTL006 ad-hoc compile caches
+# --------------------------------------------------------------------------
+
+class TestAdhocCompileCache:
+    def test_direct_jit_subscript_store(self, tmp_path):
+        res = _run(tmp_path, """
+            import jax
+            _fns = {}
+
+            def get(shape):
+                if shape not in _fns:
+                    _fns[shape] = jax.jit(lambda x: x + 1)
+                return _fns[shape]
+            """, rules=[rule_by_name("adhoc-compile-cache")()])
+        assert _ids(res) == ["PTL006"]
+        assert _symbols(res) == ["_fns"]
+
+    def test_local_name_flow(self, tmp_path):
+        res = _run(tmp_path, """
+            import jax
+
+            def get(cache, key, f):
+                fn = jax.jit(f)
+                cache[key] = fn
+                return fn
+            """, rules=[rule_by_name("adhoc-compile-cache")()])
+        assert _ids(res) == ["PTL006"]
+
+    def test_builder_method_one_hop(self, tmp_path):
+        # the reducer's historical idiom: a dict of PAIRS of jit
+        # variants filled from a same-module builder method
+        res = _run(tmp_path, """
+            import jax
+
+            class Transport:
+                def __init__(self):
+                    self._fns = {}
+
+                def _build(self):
+                    return {"pinned": jax.jit(lambda x: x),
+                            "free": jax.jit(lambda x: x)}
+
+                def get(self, key):
+                    fns = self._fns.get(key)
+                    if fns is None:
+                        fns = self._fns[key] = self._build()
+                    return fns
+            """, rules=[rule_by_name("adhoc-compile-cache")()])
+        assert _ids(res) == ["PTL006"]
+        assert _symbols(res) == ["self._fns"]
+
+    def test_setdefault_and_attr_jit(self, tmp_path):
+        # the self._jax.jit attribute spelling the import table cannot
+        # resolve must still be caught
+        res = _run(tmp_path, """
+            class Engine:
+                def get(self, cache, key, f):
+                    return cache.setdefault(key, self._jax.jit(f))
+            """, rules=[rule_by_name("adhoc-compile-cache")()])
+        assert _ids(res) == ["PTL006"]
+
+    def test_compile_cache_itself_allowed(self, tmp_path):
+        res = _run(tmp_path, """
+            import jax
+
+            class Site:
+                def insert(self, key, f):
+                    self.entries[key] = jax.jit(f)
+            """, name="framework/compile_cache.py",
+            rules=[rule_by_name("adhoc-compile-cache")()])
+        assert res.findings == []
+
+    def test_non_jit_stores_clean(self, tmp_path):
+        res = _run(tmp_path, """
+            import jax
+
+            def fill(cache, key, arr):
+                cache[key] = arr + 1          # a VALUE, not an executable
+                cache.setdefault(key, [1, 2])
+                stats = {}
+                stats["hits"] = 0
+                return jax.jit(lambda x: x)   # returned, never cached
+            """, rules=[rule_by_name("adhoc-compile-cache")()])
+        assert res.findings == []
+
+    def test_suppression_escape_hatch(self, tmp_path):
+        res = _run(tmp_path, """
+            import jax
+            _fns = {}
+
+            def get(shape, f):
+                # ptl: disable-next=PTL006 -- process-lifetime singleton
+                _fns[shape] = jax.jit(f)
+                return _fns[shape]
+            """, rules=[rule_by_name("adhoc-compile-cache")()])
+        assert res.findings == []
+        assert res.suppressed == 1
+
+    def test_repo_is_clean(self):
+        # the seven migrated sites (+ the strays this rule surfaced)
+        # must STAY on compile_cache — the whole repo lints clean
+        res = analyze([os.path.join(REPO, "paddle_tpu")],
+                      rules=[rule_by_name("adhoc-compile-cache")()])
+        assert [f.format() for f in res.findings] == []
+
+
+# --------------------------------------------------------------------------
 # PTL005 lock-order
 # --------------------------------------------------------------------------
 
@@ -794,8 +901,8 @@ class TestCliAndReporting:
     def test_rule_table_complete(self):
         rules = all_rules()
         assert [r.id for r in rules] == [
-            "PTL001", "PTL003", "PTL004", "PTL005", "PTL002"]
-        assert len({r.name for r in rules}) == 5
+            "PTL006", "PTL001", "PTL003", "PTL004", "PTL005", "PTL002"]
+        assert len({r.name for r in rules}) == 6
 
 
 # --------------------------------------------------------------------------

@@ -212,6 +212,27 @@ class TestAutoscalerControl:
         assert a.tick(0.0) == "up"
         assert "pending" in a.decisions[-1]["reasons"]
 
+    def test_backlog_normalized_by_accepted_tokens(self):
+        # ISSUE 14 satellite: a speculative fleet reporting ~4.5
+        # accepted tokens per row-step drains a queue ~4.5x faster, so
+        # the SAME backlog that scales a non-spec fleet up must hold
+        f = FakeFleet()
+        a = _scaler(f)
+        f.sig["backlog"] = 8                         # > 2.0 * 1 healthy
+        f.sig["accepted_tokens_per_step"] = 4.5      # but < 2.0*1*4.5=9
+        assert a.tick(0.0) is None
+        # a backlog past even the token-normalized threshold still fires
+        f.sig["backlog"] = 10
+        assert a.tick(0.0) == "up"
+        assert "backlog" in a.decisions[-1]["reasons"]
+        assert a.decisions[-1]["signals"][
+            "accepted_tokens_per_step"] == 4.5
+        # non-speculative fleets (no signal / 0.0) keep today's law
+        f2 = FakeFleet()
+        a2 = _scaler(f2)
+        f2.sig["backlog"] = 8
+        assert a2.tick(0.0) == "up"
+
     def test_occupancy_needs_backlog(self):
         f = FakeFleet()
         a = _scaler(f)

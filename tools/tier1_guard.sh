@@ -25,6 +25,15 @@ if ! tools/lint_guard.sh; then
     exit 1
 fi
 
+# AOT cold-start smoke (~10s): serialize-executable round trip, zero
+# compiles on the artifact-warm replica, token parity — the compile
+# layer's end-to-end contract, cheap enough to gate every tier-1 run
+if ! tools/aot_smoke.sh; then
+    echo "tier1_guard: FAIL — AOT cold-start smoke" \
+         "(tools/aot_smoke.sh; see above)" >&2
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
