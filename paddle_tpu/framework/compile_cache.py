@@ -93,13 +93,23 @@ def compile_stats():
 # keying
 # --------------------------------------------------------------------------
 
-def make_key(*parts, donate=()):
+def make_key(*parts, donate=(), mesh=None):
     """Build a site key with the donation signature folded in.  A
     donated and a non-donated executable of the same abstract signature
     must NEVER share an entry (the donated one consumes its operand
     buffers), so the donate tuple is part of the identity, not an
-    attribute of the value."""
-    return tuple(parts) + (("donate", tuple(donate)),)
+    attribute of the value.
+
+    ``mesh`` (ISSUE 15) is the device-mesh topology of a SHARDED
+    executable (any hashable — engines pass ``("tp", degree, platform,
+    ndevices)``): a tensor-parallel build partitions its program over
+    the mesh, so the same abstract signature on a different topology is
+    a different executable.  ``None`` (single-device) keys exactly as
+    before, so every pre-TP call site is unchanged."""
+    key = tuple(parts) + (("donate", tuple(donate)),)
+    if mesh is not None:
+        key += (("mesh", mesh),)
+    return key
 
 
 def stable_hash(s, n=20):
@@ -189,15 +199,23 @@ class ArtifactStore:
         return {"jax": jax.__version__,
                 "backend": jax.default_backend()}
 
-    def save(self, stable_key, compiled):
+    def save(self, stable_key, compiled, topology=None):
         """Serialize one AOT-compiled executable; atomic publish (a
         concurrent reader sees the old artifact or the new one, never a
         torn write).  Raises on serialization failure — the caller
-        counts and degrades."""
+        counts and degrades.
+
+        ``topology`` (ISSUE 15) names the device mesh a SHARDED
+        executable was compiled for (e.g. ``"tp/2/cpu/2"``); it lands in
+        the artifact header and loads verify it, so a TP-sharded binary
+        is never deserialized onto a mismatched mesh.  ``None`` marks a
+        single-device executable — artifacts written before the field
+        existed read back as ``None`` too, so they stay valid."""
         from . import jax_compat
         payload = jax_compat.aot_serialize_compiled(compiled)
         rec = dict(self._env())
         rec.update(magic=_ARTIFACT_MAGIC, key=stable_key,
+                   topology=topology,
                    digest=hashlib.blake2b(payload, digest_size=20)
                    .hexdigest(),
                    payload=payload)
@@ -209,13 +227,13 @@ class ArtifactStore:
         os.replace(tmp, path)
         return path
 
-    def _load_record(self, stable_key):
+    def _load_record(self, stable_key, topology=None):
         """(record, reason): the VALIDATED artifact record (magic, full
-        key, jax/backend env, payload digest all checked) or (None,
-        "miss"|"stale"|"corrupt").  Shared by :meth:`load` and
-        :meth:`validate` so the skip-the-warmup decision and the actual
-        deserialization can never disagree about what counts as
-        loadable."""
+        key, jax/backend env, device topology, payload digest all
+        checked) or (None, "miss"|"stale"|"corrupt").  Shared by
+        :meth:`load` and :meth:`validate` so the skip-the-warmup
+        decision and the actual deserialization can never disagree
+        about what counts as loadable."""
         path = self._path(stable_key)
         if not os.path.exists(path):
             return None, "miss"
@@ -231,6 +249,11 @@ class ArtifactStore:
             if (rec.get("jax") != env["jax"]
                     or rec.get("backend") != env["backend"]):
                 return None, "stale"
+            # mesh attestation (ISSUE 15): a sharded executable only
+            # loads onto the exact topology it was compiled for; both
+            # sides None = single-device (pre-field artifacts included)
+            if rec.get("topology") != topology:
+                return None, "stale"
             payload = rec["payload"]
             digest = hashlib.blake2b(payload, digest_size=20).hexdigest()
             if digest != rec.get("digest"):
@@ -240,17 +263,17 @@ class ArtifactStore:
             # truncated/garbage pickle: never crash the boot
             return None, "corrupt"
 
-    def validate(self, stable_key):
+    def validate(self, stable_key, topology=None):
         """Full header+digest validation WITHOUT deserializing the
         executable — the warmup skip-this-compile-wave probe."""
-        rec, reason = self._load_record(stable_key)
+        rec, reason = self._load_record(stable_key, topology=topology)
         return rec is not None, reason
 
-    def load(self, stable_key):
+    def load(self, stable_key, topology=None):
         """(callable, reason): the deserialized executable and None, or
         (None, "miss"|"stale"|"corrupt") — the caller maps reasons onto
         the aot_* counters and falls back to building."""
-        rec, reason = self._load_record(stable_key)
+        rec, reason = self._load_record(stable_key, topology=topology)
         if rec is None:
             return None, reason
         try:
@@ -270,18 +293,19 @@ def _store():
     return ArtifactStore(d)
 
 
-def artifact_ready(stable_key):
+def artifact_ready(stable_key, topology=None):
     """Will a lazy load of this key actually succeed?  Validates the
-    artifact header + payload digest (jax version, backend, full key)
-    WITHOUT deserializing the executable.  Engines use it to skip
-    warmup compile waves — a merely-EXISTING but stale/corrupt artifact
-    (shared dir after a jax upgrade) must NOT skip the wave that would
-    have compiled the real executable, or the compile lands in live
-    traffic instead of boot."""
+    artifact header + payload digest (jax version, backend, full key,
+    device topology) WITHOUT deserializing the executable.  Engines use
+    it to skip warmup compile waves — a merely-EXISTING but
+    stale/corrupt artifact (shared dir after a jax upgrade, or a
+    sharded artifact from a different mesh) must NOT skip the wave that
+    would have compiled the real executable, or the compile lands in
+    live traffic instead of boot."""
     store = _store()
     if store is None:
         return False
-    ok, _reason = store.validate(stable_key)
+    ok, _reason = store.validate(stable_key, topology=topology)
     return ok
 
 
@@ -357,17 +381,20 @@ class Site:
         return value
 
     # ---------------------------------------------------- the main API
-    def get(self, key, build, *, stable_key=None, example_args=None):
+    def get(self, key, build, *, stable_key=None, example_args=None,
+            topology=None):
         """The one acquisition path.  ``build`` runs OUTSIDE the lock
         (tracing re-enters arbitrary code); a racing double-build costs
-        one redundant trace, never a wrong result — last insert wins."""
+        one redundant trace, never a wrong result — last insert wins.
+        ``topology`` is the sharded-executable mesh attestation threaded
+        into the artifact header (None for single-device)."""
         e = self.lookup(key)
         if e is not None:
             return e
         fn = None
         store = _store() if stable_key else None
         if store is not None:
-            fn, reason = store.load(stable_key)
+            fn, reason = store.load(stable_key, topology=topology)
             if fn is not None:
                 self._stats.inc("aot_hits")
             elif reason == "miss":
@@ -379,17 +406,19 @@ class Site:
         if fn is None:
             fn = build()
             if store is not None and example_args is not None:
-                fn = self._aot_save(store, stable_key, fn, example_args)
+                fn = self._aot_save(store, stable_key, fn, example_args,
+                                    topology)
         return self.insert(key, fn)
 
-    def _aot_save(self, store, stable_key, fn, example_args):
+    def _aot_save(self, store, stable_key, fn, example_args,
+                  topology=None):
         """AOT-compile ``fn`` against the example operands and publish
         the artifact.  Returns the AOT executable (so the warm process
         doesn't trace twice); any failure returns ``fn`` unchanged —
         the artifact path degrades, never breaks."""
         try:
             compiled = fn.lower(*example_args).compile()
-            store.save(stable_key, compiled)
+            store.save(stable_key, compiled, topology=topology)
             self._stats.inc("aot_saves")
             return compiled
         except Exception:                                  # noqa: BLE001

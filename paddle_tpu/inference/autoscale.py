@@ -51,7 +51,24 @@ from ..observability import metrics, timeline
 from ..testing import faults as _faults
 from .fleet import _env_float, _env_int
 
-__all__ = ["Autoscaler", "autoscale_stats"]
+__all__ = ["Autoscaler", "autoscale_stats", "role_autoscalers"]
+
+
+def role_autoscalers(fleet, prefill=None, decode=None, **common):
+    """The disaggregated composition (ISSUE 15 satellite): one
+    independent :class:`Autoscaler` per role pool, each reading its own
+    signals (prefill: submit->handoff latency + prefill-phase backlog;
+    decode: handoff->completion latency + decode-phase backlog) and
+    scaling only its own replicas.  ``prefill``/``decode`` are
+    per-pool kwarg overrides layered over ``common``.  Returns the
+    ``[prefill_scaler, decode_scaler]`` pair — start/stop them together
+    (each is a context manager)."""
+    out = []
+    for role, over in (("prefill", prefill), ("decode", decode)):
+        kw = dict(common)
+        kw.update(over or {})
+        out.append(Autoscaler(fleet, role=role, **kw))
+    return out
 
 
 def _stats_family():
@@ -82,8 +99,20 @@ class Autoscaler:
                  window_s=15.0, up_backlog_per_replica=2.0,
                  pending_headroom=0.7, hi_occupancy=0.85,
                  lo_occupancy=0.35, up_ticks=1, down_ticks=8,
-                 slo_down_margin=0.5):
+                 slo_down_margin=0.5, role=None):
         self.fleet = fleet
+        # per-role-pool scaling loop (ISSUE 15): role="prefill"/"decode"
+        # scopes every signal AND every action to that pool of a
+        # disaggregated fleet — the canonical composition is one
+        # Autoscaler per role (see :func:`role_autoscalers`), each with
+        # its own thresholds (prefill pools key on submit->handoff
+        # latency + prefill backlog, decode pools on handoff->complete
+        # latency + decode backlog).  None = the whole (unified) fleet.
+        if role is not None and role not in ("prefill", "decode"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or None, got "
+                f"{role!r}")
+        self.role = role
         self.slo_p99_s = slo_p99_s if slo_p99_s is not None \
             else _env_float("PADDLE_FLEET_SLO_P99_S", 5.0)
         self.min_replicas = max(1, min_replicas if min_replicas is not None
@@ -138,7 +167,12 @@ class Autoscaler:
             return None
 
     def _tick_inner(self, now):
-        sig = self.fleet.autoscale_signals(self.window_s)
+        # role=None stays a positional-only call (test fakes and older
+        # fleet stand-ins don't know the kwarg)
+        sig = (self.fleet.autoscale_signals(self.window_s)
+               if self.role is None
+               else self.fleet.autoscale_signals(self.window_s,
+                                                 role=self.role))
         target = sig["configured"]
         self._g_target.set(target)
 
@@ -218,13 +252,15 @@ class Autoscaler:
             if target >= self.max_replicas:
                 self._inc("holds_bounds")
                 return None
-            rid = self.fleet.add_replica()
+            rid = (self.fleet.add_replica() if self.role is None
+                   else self.fleet.add_replica(role=self.role))
             self._inc("scale_ups")
         else:
             if target <= self.min_replicas:
                 self._inc("holds_bounds")
                 return None
-            rid = self.fleet.scaledown_victim()
+            rid = (self.fleet.scaledown_victim() if self.role is None
+                   else self.fleet.scaledown_victim(role=self.role))
             if rid is None:
                 self._inc("holds_bounds")
                 return None
@@ -233,6 +269,7 @@ class Autoscaler:
         self._cool_until = now + self.cooldown_s
         self._up_streak = self._down_streak = 0
         rec = {"action": f"scale_{direction}", "replica": rid,
+               "role": self.role,
                "reasons": list(reasons), "t": time.time(),
                "signals": {k: sig.get(k) for k in (
                    "backlog", "pending_fraction", "occupancy", "p99_s",
@@ -274,7 +311,8 @@ class Autoscaler:
         process-global family — all autoscalers pooled — is
         :func:`autoscale_stats`)."""
         out = dict(self._counts)
-        out.update(min_replicas=self.min_replicas,
+        out.update(role=self.role,
+                   min_replicas=self.min_replicas,
                    max_replicas=self.max_replicas,
                    cooldown_s=self.cooldown_s,
                    slo_p99_s=self.slo_p99_s,

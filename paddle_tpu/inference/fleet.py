@@ -93,6 +93,14 @@ ROUTER_RANK = 1000
 
 PRIORITIES = ("interactive", "batch")
 
+# replica roles (ISSUE 15 prefill/decode disaggregation).  A fleet is
+# either fully "unified" (every replica prefills AND decodes — the
+# historical shape) or fully disaggregated (only "prefill" and "decode"
+# replicas, at least one of each); an incoherent mix refuses at
+# construction, and a replica whose hello reports a different role than
+# assigned refuses at hello like a numeric-contract mismatch.
+ROLES = ("unified", "prefill", "decode")
+
 
 class FleetOverloaded(RuntimeError):
     """submit() load shedding: the router's global pending table is at
@@ -157,7 +165,12 @@ def _stats_family():
         "dup_completions": 0, "heartbeat_misses": 0,
         "incidents": 0, "replica_restarts": 0, "rpc_errors": 0,
         "deadline_exceeded": 0, "rejects_permanent": 0,
-        "scale_ups": 0, "scale_downs": 0, "drain_requeues": 0})
+        "scale_ups": 0, "scale_downs": 0, "drain_requeues": 0,
+        # prefill/decode disaggregation (ISSUE 15): completed prefill
+        # phases whose KV pages crossed the router, the bytes that
+        # crossed, and payloads SHIPPED MORE THAN ONCE (a decode
+        # replica died or dropped the handoff; zero-lost re-ships)
+        "kv_handoffs": 0, "kv_handoff_bytes": 0, "handoff_reships": 0})
 
 
 def fleet_stats():
@@ -193,12 +206,33 @@ class FleetRequest:
         self.replica = None           # current / completing replica
         self.replicas_tried = []
         self.not_before = 0.0         # retry-backoff dispatch gate
+        # disaggregation lifecycle (ISSUE 15; all None/0 on unified
+        # fleets): phase "prefill" -> (handoff: kv payload + first
+        # token land here) -> "decode" -> completion.  The payload
+        # LIVES ON THE PENDING-TABLE ENTRY, so a decode replica dying
+        # mid-stream re-ships the same pages — retries never lose KV.
+        self.phase = None
+        self.kv = None                # wire-form page payload
+        self.kv_bytes = 0
+        self.kv_ships = 0             # decode dispatches carrying kv
+        self.first_token = None
+        self.prefill_replica = None
+        self.decode_t0 = None         # when the decode phase began
         self.submit_t = time.perf_counter()
         self.finish_t = None
 
     def latency(self):
         return (self.finish_t - self.submit_t) \
             if self.finish_t is not None else None
+
+    def decode_latency(self):
+        """Decode-phase seconds (handoff -> completion, decode-pool
+        queueing included) on a disaggregated fleet — the latency the
+        disagg bench holds flat while prefill load grows.  None before
+        completion and on unified fleets."""
+        if self.finish_t is None or self.decode_t0 is None:
+            return None
+        return self.finish_t - self.decode_t0
 
     def expired(self, now=None):
         if self.deadline_s is None:
@@ -213,8 +247,9 @@ class _ReplicaGone(RuntimeError):
 
 
 class _Replica:
-    def __init__(self, rid, listener):
+    def __init__(self, rid, listener, role="unified"):
         self.id = rid
+        self.role = role
         self.listener = listener           # lives across incarnations
         self.port = listener.getsockname()[1]
         self.worker = None                 # launch.spawn_worker handle
@@ -265,7 +300,8 @@ class ServingFleet:
                  max_restarts=None, restart_backoff_s=None,
                  spawn_timeout_s=None, steps_per_rpc=4,
                  dispatch_queue_depth=None, worker_argv=None,
-                 drain_timeout_s=None, interactive_weight=None):
+                 drain_timeout_s=None, interactive_weight=None,
+                 roles=None):
         self.model_spec = dict(model_spec or {})
         # spec keys the built engine could not honor would otherwise
         # surface as a fleet-wide boot crash or hello contract mismatch
@@ -313,10 +349,46 @@ class ServingFleet:
                 raise ValueError(
                     "model_spec spec_draft_cfg must be a dict of "
                     f"GPTConfig kwargs, got {type(draft_cfg).__name__}")
-        self.nreplicas = int(replicas if replicas is not None
-                             else _env_int("PADDLE_FLEET_REPLICAS", 2))
+        tp = self.model_spec.get("tp")
+        if tp is not None and (not isinstance(tp, int) or tp < 1):
+            raise ValueError(
+                f"model_spec tp must be an int >= 1, got {tp!r}")
+        # replica roles (ISSUE 15): None -> all unified; a list of role
+        # strings (one per replica) or a {"prefill": n, "decode": m}
+        # count dict -> a disaggregated fleet.  Coherence is validated
+        # HERE, in the caller's process — an incoherent fleet would
+        # strand one phase's requests forever.
+        role_plan = self._normalize_roles(roles)
+        if role_plan is not None and replicas is not None \
+                and len(role_plan) != int(replicas):
+            raise ValueError(
+                f"roles names {len(role_plan)} replicas but replicas="
+                f"{replicas} — drop one or make them agree")
+        self.nreplicas = int(
+            replicas if replicas is not None
+            else (len(role_plan) if role_plan is not None
+                  else _env_int("PADDLE_FLEET_REPLICAS", 2)))
         if self.nreplicas < 1:
             raise ValueError("a fleet needs at least one replica")
+        if role_plan is None:
+            role_plan = ["unified"] * self.nreplicas
+        self.disaggregated = any(r != "unified" for r in role_plan)
+        if self.disaggregated:
+            if "unified" in role_plan:
+                raise ValueError(
+                    f"role-incoherent fleet {role_plan}: mixing "
+                    "unified with prefill/decode replicas splits the "
+                    "request stream two incompatible ways — use all "
+                    "unified, or prefill+decode only")
+            if "prefill" not in role_plan or "decode" not in role_plan:
+                raise ValueError(
+                    f"a disaggregated fleet needs at least one prefill "
+                    f"AND one decode replica, got {role_plan}")
+            if not self.model_spec.get("paged"):
+                raise ValueError(
+                    "disaggregation ships KV pages — the spec needs "
+                    "paged: true")
+        self._role_plan = role_plan
         self.env_base = dict(env_base if env_base is not None
                              else os.environ)
         self.log_dir = log_dir
@@ -395,6 +467,11 @@ class ServingFleet:
         # (finish-time, latency) pairs: the autoscaler's RECENT-p99
         # signal needs a time-windowed view, not the lifetime one
         self._lat_recent = collections.deque(maxlen=4096)
+        # per-role windows (disaggregated fleets): the prefill pool's
+        # latency is submit -> handoff, the decode pool's handoff ->
+        # completion — each pool's autoscaler reads ITS OWN signal
+        self._lat_prefill_recent = collections.deque(maxlen=4096)
+        self._lat_decode_recent = collections.deque(maxlen=4096)
         self._g_configured.set(self.nreplicas)
         self._g_target.set(self.nreplicas)
 
@@ -421,8 +498,8 @@ class ServingFleet:
         self._replicas = []
         self._threads = []
         try:
-            for _ in range(self.nreplicas):
-                self._replicas.append(self._new_replica())
+            for role in self._role_plan:
+                self._replicas.append(self._new_replica(role))
             for r in self._replicas:
                 self._spawn(r)
         except Exception:
@@ -439,12 +516,36 @@ class ServingFleet:
         for r in self._replicas:
             self._start_driver(r)
 
-    def _new_replica(self):
+    @staticmethod
+    def _normalize_roles(roles):
+        """None, a per-replica role list, or a {"role": count} dict ->
+        a validated role list (or None for the all-unified default)."""
+        if roles is None:
+            return None
+        if isinstance(roles, dict):
+            plan = []
+            for role in ("prefill", "decode", "unified"):
+                plan.extend([role] * int(roles.get(role, 0)))
+            extra = set(roles) - set(ROLES)
+            if extra:
+                raise ValueError(f"unknown roles {sorted(extra)} — "
+                                 f"expected among {ROLES}")
+        else:
+            plan = [str(r) for r in roles]
+        bad = [r for r in plan if r not in ROLES]
+        if bad:
+            raise ValueError(f"unknown roles {bad} — expected among "
+                             f"{ROLES}")
+        if not plan:
+            raise ValueError("roles names zero replicas")
+        return plan
+
+    def _new_replica(self, role="unified"):
         lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         lst.bind(("127.0.0.1", 0))
         lst.listen(1)
-        r = _Replica(self._next_rid, lst)
+        r = _Replica(self._next_rid, lst, role=role)
         self._next_rid += 1
         return r
 
@@ -491,6 +592,8 @@ class ServingFleet:
                         f"{self.max_pending} "
                         f"({len(self._done)} completed so far) — shed "
                         "and retry with backoff")
+            if self.disaggregated:
+                req.phase = "prefill"     # every request prefills first
             self._pending[req.id] = req
             (self._ready_hi if req.priority == "interactive"
              else self._ready_lo).append(req)
@@ -560,6 +663,7 @@ class ServingFleet:
         env = dict(self.env_base)
         env["PADDLE_FLEET_PORT"] = str(r.port)
         env["PADDLE_FLEET_REPLICA"] = str(r.id)
+        env["PADDLE_FLEET_ROLE"] = r.role
         # faults rank/restart filters + telemetry rank = the replica id
         env["PADDLE_TRAINER_ID"] = str(r.id)
         env["PADDLE_RESTART_COUNT"] = str(r.incarnation)
@@ -623,7 +727,7 @@ class ServingFleet:
             # would return budget-different tokens for re-queued
             # requests — refuse it like any other unhealthy replica
             stats = hello.get("stats") or {}
-            mismatch = self._contract_mismatch(stats)
+            mismatch = self._contract_mismatch(stats, r.role)
             if mismatch is not None:
                 conn.close()
                 # deterministic config error, not a crash: relaunching
@@ -634,9 +738,10 @@ class ServingFleet:
                 r.restarts_used = self.max_restarts
                 raise _ReplicaGone(
                     f"numeric contract mismatch: replica hello reports "
-                    f"(quant, kv_dtype, spec_mode)={mismatch[0]} but "
-                    f"the fleet spec says {mismatch[1]} — config "
-                    "error, replica will not be relaunched")
+                    f"(quant, kv_dtype, spec_mode, tp, role)="
+                    f"{mismatch[0]} but the fleet assigned "
+                    f"{mismatch[1]} — config error, replica will not "
+                    "be relaunched")
             r.conn = conn
             r.hello = hello
             r.last_stats = stats
@@ -765,21 +870,30 @@ class ServingFleet:
             raise _ReplicaGone(f"rpc failed: {type(e).__name__}: {e}") \
                 from e
 
-    def _contract_mismatch(self, stats):
+    def _contract_mismatch(self, stats, role="unified"):
         """None when the replica's reported numeric/behavior contract
-        (quant mode, kv_dtype, spec_mode — echoed in every engine
-        ``stats()``) matches the fleet spec's; else ``(got, want)`` for
-        the incident record.  Requests re-queued across replicas assume
-        identical numerics — a mixed-contract fleet would silently
-        break the token-exact retry guarantee; and though speculation
-        is token-exact by design, a spec/non-spec mix would skew every
-        per-replica latency/compile attestation the bench joins on, so
-        spec_mode is part of the attested contract too (ISSUE 13)."""
+        (quant mode, kv_dtype, spec_mode, tp degree, role — echoed in
+        every engine ``stats()`` / worker reply) matches the fleet
+        spec's; else ``(got, want)`` for the incident record.  Requests
+        re-queued across replicas assume identical numerics — a
+        mixed-contract fleet would silently break the token-exact retry
+        guarantee; and though speculation is token-exact by design, a
+        spec/non-spec mix would skew every per-replica latency/compile
+        attestation the bench joins on, so spec_mode is part of the
+        attested contract too (ISSUE 13).  The tuple grew tp + role in
+        ISSUE 15: a replica sharded over a different tp degree computes
+        different reduction orders (greedy ties can flip between
+        retries), and a replica serving the wrong ROLE would either
+        decode work it was never handed KV for or silently prefill on
+        the decode pool — both refuse at hello like mixed int8/fp32."""
         want = (self.model_spec.get("quant"),
                 self.model_spec.get("kv_dtype"),
-                self.model_spec.get("spec_mode"))
+                self.model_spec.get("spec_mode"),
+                int(self.model_spec.get("tp") or 1),
+                role or "unified")
         got = (stats.get("quant"), stats.get("kv_dtype"),
-               stats.get("spec_mode"))
+               stats.get("spec_mode"), int(stats.get("tp") or 1),
+               stats.get("role") or "unified")
         return None if got == want else (got, want)
 
     def _capacity(self, r):
@@ -807,6 +921,16 @@ class ServingFleet:
             cap = min(cap, int(free_pages) // ppr - unpaged)
         return max(0, cap)
 
+    def _phase_ok(self, req, r):
+        """Role-aware capacity routing (ISSUE 15): a prefill replica
+        only takes prefill-phase requests, a decode replica only
+        handed-off (payload-carrying) ones; unified replicas take the
+        phase-less stream of a unified fleet."""
+        if r.role == "unified":
+            return req.phase is None
+        return req.phase == ("prefill" if r.role == "prefill"
+                             else "decode")
+
     def _pick_dispatch(self, r):
         if r.draining:
             return []          # drain-then-stop: no new work, ever
@@ -825,6 +949,9 @@ class ServingFleet:
                     self._fail_locked(req, "deadline_exceeded")
                     self._inc("deadline_exceeded")
                     continue
+                if not self._phase_ok(req, r):
+                    skipped.append(req)         # the other pool's work
+                    continue
                 if req.not_before > now:
                     skipped.append(req)         # still backing off
                     continue
@@ -834,16 +961,33 @@ class ServingFleet:
                 req.replicas_tried.append(r.id)
                 r.inflight[req.id] = req
                 batch.append(req)
-            for req in skipped:
-                self._ready_queue_of(req).append(req)
+            # restore skipped work at the HEAD in reverse pop order —
+            # queue order is preserved exactly, so a handed-off request
+            # _handoff put at the front (mid-flight work) keeps its
+            # place instead of rotating behind fresh arrivals every
+            # time the OTHER pool's driver examines it
+            for req in reversed(skipped):
+                self._ready_queue_of(req).appendleft(req)
         return batch
 
     def _rpc_submit(self, r, batch):
-        resp = self._rpc(r, {
-            "op": "submit",
-            "requests": [{"id": q.id, "prompt": q.prompt,
-                          "max_new_tokens": q.max_new_tokens,
-                          "eos_token": q.eos_token} for q in batch]})
+        items = []
+        for q in batch:
+            item = {"id": q.id, "prompt": q.prompt,
+                    "max_new_tokens": q.max_new_tokens,
+                    "eos_token": q.eos_token}
+            if q.phase is not None:
+                item["phase"] = q.phase
+                if q.phase == "decode":
+                    item["first_token"] = q.first_token
+                    item["kv"] = q.kv
+                    q.kv_ships += 1
+                    if q.kv_ships > 1:
+                        # the same payload crossing again: a decode
+                        # replica died/dropped it — zero-lost re-ships
+                        self._inc("handoff_reships")
+            items.append(item)
+        resp = self._rpc(r, {"op": "submit", "requests": items})
         rejected = resp.get("rejected") or []
         with self._lock:
             for rej in rejected:
@@ -858,6 +1002,16 @@ class ServingFleet:
                     self._fail_locked(
                         req, f"rejected: {rej.get('err', 'unserveable')}")
                 else:                           # back-pressure: try later
+                    if (req.phase == "decode" and req.kv_ships
+                            and "handoff_drop" not in
+                            (rej.get("err") or "")):
+                        # a ServingQueueFull bounce is not a lost
+                        # handoff — the payload never landed, nothing
+                        # died; un-count the ship so routine
+                        # back-pressure can't read as re-ships (the
+                        # injected handoff_drop fault, which names
+                        # itself in the reject, still counts)
+                        req.kv_ships -= 1
                     req.not_before = time.perf_counter() + 0.05
                     self._ready_queue_of(req).append(req)
 
@@ -875,7 +1029,42 @@ class ServingFleet:
                              f"{resp.get('error')}")
         r.last_stats = resp.get("stats") or r.last_stats
 
+    def _handoff(self, fin, r):
+        """A prefill replica finished a request's PREFILL phase: park
+        the KV payload + first token on the pending-table entry, flip
+        it to the decode phase, and put it back at the ready-queue head
+        (it is mid-flight work — it must not queue behind fresh
+        arrivals).  The payload stays on the entry until the FINAL
+        completion, so a decode-side death re-ships the same pages."""
+        rid = fin["id"]
+        with self._lock:
+            req = self._pending.get(rid)
+            r.inflight.pop(rid, None)
+            if req is None or req.done or req.failed \
+                    or req.phase == "decode":
+                # already handed off / completed: a re-sent handoff
+                # record (lost ack) must not double-queue the request
+                self._inc("dup_completions")
+                return False
+            req.phase = "decode"
+            req.first_token = int(fin["first_token"])
+            req.kv = fin.get("kv")
+            req.kv_bytes = int(fin.get("kv_bytes") or 0)
+            req.kv_ships = 0
+            req.prefill_replica = r.id
+            req.replica = None
+            req.decode_t0 = time.perf_counter()
+            self._lat_prefill_recent.append(
+                (req.decode_t0, req.decode_t0 - req.submit_t,
+                 req.priority))
+            self._inc("kv_handoffs")
+            self._inc("kv_handoff_bytes", req.kv_bytes)
+            self._ready_queue_of(req).appendleft(req)
+        return True
+
     def _complete(self, fin, r):
+        if fin.get("phase") == "prefill":
+            return self._handoff(fin, r)
         rid = fin["id"]
         with self._lock:
             req = self._pending.pop(rid, None)
@@ -887,6 +1076,7 @@ class ServingFleet:
             req.finish_reason = fin.get("finish_reason")
             req.replica = r.id
             req.done = True
+            req.kv = None             # retention tables must not pin KV
             req.finish_t = time.perf_counter()
             self._done[rid] = req
             self._evict_locked(self._done)
@@ -895,6 +1085,10 @@ class ServingFleet:
             self._h_latency.observe(lat)
             self._latencies.append(lat)
             self._lat_recent.append((req.finish_t, lat, req.priority))
+            if req.decode_t0 is not None:
+                self._lat_decode_recent.append(
+                    (req.finish_t, req.finish_t - req.decode_t0,
+                     req.priority))
             self._g_pending.set(len(self._pending))
         return True
 
@@ -935,6 +1129,7 @@ class ServingFleet:
         if req.done or req.failed:
             return
         req.failed = True
+        req.kv = None                 # retention tables must not pin KV
         req.error = reason
         req.finish_t = time.perf_counter()
         self._failed[req.id] = req
@@ -1065,21 +1260,34 @@ class ServingFleet:
             return next((x for x in self._replicas if x.id == int(rid)),
                         None)
 
-    def add_replica(self):
+    def add_replica(self, role=None):
         """Scale UP: mint, spawn, and drive one more supervised replica;
         returns its id (replica ids are minted monotonically and never
         reused).  With a shared ``PADDLE_JIT_CACHE_DIR`` the newcomer
         warm-boots from the persistent compilation cache — its hello's
         cache-miss count lands on the scale event record, which the
-        bench asserts is 0."""
+        bench asserts is 0.  ``role`` ("prefill"/"decode") picks the
+        pool a disaggregated fleet grows; the coherence rule holds
+        elastically too (no unified joiners on a disaggregated fleet
+        and vice versa)."""
+        if role is None:
+            role = "prefill" if self.disaggregated else "unified"
+        if self.disaggregated and role not in ("prefill", "decode"):
+            raise ValueError(
+                f"a disaggregated fleet only grows prefill/decode "
+                f"replicas, not {role!r}")
+        if not self.disaggregated and role != "unified":
+            raise ValueError(
+                f"a unified fleet only grows unified replicas, not "
+                f"{role!r} — build it with roles= to disaggregate")
         with self._lock:
             # registration (not the slow spawn) happens under the lock:
             # close() snapshots _replicas under it, so once we are past
             # this block a racing close() WILL see the replica
             if self._stop.is_set():
                 raise RuntimeError("fleet is closed")
-            r = self._new_replica()
-            ev = {"action": "scale_up", "replica": r.id,
+            r = self._new_replica(role)
+            ev = {"action": "scale_up", "replica": r.id, "role": role,
                   "t": time.time()}
             self.scale_events.append(ev)
             r.scale_ev = ev
@@ -1133,12 +1341,18 @@ class ServingFleet:
                     raise ValueError(
                         "refusing to remove the last serving replica — "
                         "close() tears the whole fleet down")
+                if self.disaggregated and sum(
+                        1 for x in live if x.role == r.role) <= 1:
+                    raise ValueError(
+                        f"refusing to remove the last {r.role} replica "
+                        "— the other phase's requests would strand "
+                        "forever")
                 r.draining = True
                 r.drain_t0 = time.monotonic()
                 self._inc("scale_downs")
                 self.scale_events.append(
                     {"action": "scale_down", "replica": r.id,
-                     "t": time.time()})
+                     "role": r.role, "t": time.time()})
                 timeline.emit({"event": "fleet_scale_down",
                                "replica": r.id,
                                "inflight_at_drain": len(r.inflight)})
@@ -1217,33 +1431,59 @@ class ServingFleet:
                        "replica": r.id,
                        "drain_requeues": len(victims)})
 
-    def scaledown_victim(self):
+    def scaledown_victim(self, role=None):
         """The cheapest replica to remove right now, or None: a dead or
         still-booting replica first (it serves nothing), else the
         healthy replica with the least in-flight work.  Already-draining
         replicas are never re-picked; the last live replica is never
-        offered."""
+        offered — nor, on a disaggregated fleet, the last replica of
+        any role.  ``role`` restricts the pick to one pool (the
+        per-role autoscaler loops)."""
         with self._lock:
-            cands = [r for r in self._replicas if not r.draining]
-            if len(cands) <= 1:
+            live = [r for r in self._replicas if not r.draining]
+            if len(live) <= 1:
+                return None
+            counts = {}
+            for r in live:
+                counts[r.role] = counts.get(r.role, 0) + 1
+            cands = [r for r in live
+                     if (role is None or r.role == role)
+                     and (not self.disaggregated
+                          or counts[r.role] > 1)]
+            if not cands:
                 return None
             unhealthy = [r for r in cands if r.state != "healthy"]
             if unhealthy:
                 return unhealthy[0].id
             return min(cands, key=lambda r: len(r.inflight)).id
 
-    def autoscale_signals(self, window_s=15.0):
+    def autoscale_signals(self, window_s=15.0, role=None):
         """One consistent snapshot of the control signals the
         :mod:`~paddle_tpu.inference.autoscale` loop keys on: router
         backlog, pending-table fraction (the shed horizon), per-replica
         occupancy, and the p99 of completions inside the trailing
         ``window_s`` (lifetime percentiles can never scale DOWN — a
-        window can)."""
+        window can).
+
+        ``role`` scopes the snapshot to ONE pool of a disaggregated
+        fleet (ISSUE 15): replicas/occupancy of that role only, backlog
+        counted over the queued requests in that pool's PHASE, and the
+        latency window swapped for the pool's own — submit->handoff for
+        the prefill pool, handoff->completion for the decode pool — so
+        each role's scaling loop reads signals the other pool's load
+        cannot pollute."""
         now = time.perf_counter()
+        want_phase = {"prefill": "prefill", "decode": "decode"}.get(role)
         with self._lock:
-            backlog = len(self._ready_hi) + len(self._ready_lo)
+            if want_phase is None:
+                backlog = len(self._ready_hi) + len(self._ready_lo)
+            else:
+                backlog = sum(1 for dq in (self._ready_hi,
+                                           self._ready_lo)
+                              for q in dq if q.phase == want_phase)
             pending = len(self._pending)
-            reps = [r for r in self._replicas if not r.draining]
+            reps = [r for r in self._replicas if not r.draining
+                    and (role is None or r.role == role)]
             healthy = sum(1 for r in reps if r.state == "healthy")
             occ = []
             accepted = []
@@ -1262,11 +1502,15 @@ class ServingFleet:
                 a = st.get("accepted_tokens_per_step")
                 if a:
                     accepted.append(float(a))
-            lats = sorted(lat for (t, lat, _p) in self._lat_recent
+            window = {"prefill": self._lat_prefill_recent,
+                      "decode": self._lat_decode_recent}.get(
+                          role, self._lat_recent)
+            lats = sorted(lat for (t, lat, _p) in window
                           if now - t <= window_s)
             sheds = self._counts.get("sheds", 0)
             configured = len(reps)
         return {
+            "role": role,
             "backlog": backlog, "pending": pending,
             "pending_fraction": pending / max(self.max_pending, 1),
             "configured": configured, "healthy": healthy,
@@ -1357,6 +1601,12 @@ class ServingFleet:
                 ready_batch=len(self._ready_lo),
                 replicas_up=self.replicas_up(),
                 replicas=self.nreplicas,
+                disaggregated=self.disaggregated,
+                replicas_by_role={
+                    role: sum(1 for r in self._replicas
+                              if r.role == role and not r.draining)
+                    for role in sorted({r.role
+                                        for r in self._replicas})},
                 incidents_detail=list(self.incidents),
                 recoveries=list(self.recoveries),
                 scale_events=[dict(e) for e in self.scale_events])

@@ -48,6 +48,7 @@ latency/compile attestations the bench reads).
 from __future__ import annotations
 
 import argparse
+import base64
 import json
 import os
 import socket
@@ -59,7 +60,33 @@ from ..testing import faults as _faults
 from .fleet import recv_msg, send_msg
 
 
-def _build_engine(spec):
+class _HandoffDropped(RuntimeError):
+    """Injected ``handoff_drop``: the decode-phase submission is
+    refused WITHOUT admitting it — the router must re-ship the pages
+    (zero-lost through a dropped handoff)."""
+
+
+def _encode_kv_payload(arrays):
+    """The engine's extracted page arrays as a JSON-able wire dict
+    (base64 bytes + shape + dtype per pool operand)."""
+    return {"arrays": [
+        {"shape": list(a.shape), "dtype": str(a.dtype),
+         "data": base64.b64encode(a.tobytes()).decode("ascii")}
+        for a in arrays]}
+
+
+def _decode_kv_payload(item):
+    """Inverse of :func:`_encode_kv_payload`: (first_token, arrays)."""
+    import numpy as np
+    kv = item.get("kv") or {}
+    arrays = [np.frombuffer(base64.b64decode(d["data"]),
+                            dtype=np.dtype(d["dtype"]))
+              .reshape([int(s) for s in d["shape"]])
+              for d in kv.get("arrays") or []]
+    return int(item["first_token"]), arrays
+
+
+def _build_engine(spec, role="unified"):
     """The replica's engine, from the router's JSON spec.  Imports jax /
     the GPT stack HERE (worker process), never in the router."""
     import jax
@@ -107,6 +134,18 @@ def _build_engine(spec):
         raise ValueError(
             "spec has spec_mode but not paged: true — speculative "
             "decoding runs over the paged engine")
+    if role not in ("unified", "prefill", "decode"):
+        raise ValueError(f"unknown replica role {role!r}")
+    if role != "unified" and not spec.get("paged"):
+        # disaggregation ships KV pages; only the paged engine has them
+        raise ValueError(
+            f"role {role!r} needs paged: true — disaggregation ships "
+            "KV pages")
+    if spec.get("tp") is not None:
+        # tensor-parallel serving (ISSUE 15): the degree travels in the
+        # spec so every (re)launched replica shards identically; the
+        # hello's stats echo it back for the contract attestation
+        kw["tp"] = int(spec["tp"])
     cls = ServingEngine
     if spec.get("paged"):
         cls = PagedServingEngine
@@ -117,6 +156,10 @@ def _build_engine(spec):
             kw["prefix_cache"] = bool(spec["prefix_cache"])
         if spec.get("kv_dtype") is not None:
             kw["kv_dtype"] = str(spec["kv_dtype"])
+        if role != "unified":
+            # prime the extract/inject executables at warmup — a
+            # disaggregated replica's first handoff must not compile
+            kw["kv_handoff"] = True
         if spec.get("spec_mode") is not None:
             # speculative decoding (ISSUE 13): the mode travels in the
             # spec so every (re)launched replica speculates identically
@@ -191,11 +234,12 @@ class _Publisher:
             pass
 
 
-def serve(sock, engine, replica, incarnation):
+def serve(sock, engine, replica, incarnation, role="unified"):
     """The single-threaded RPC loop.  Returns on shutdown / router
     disconnect / injected rpc_drop."""
     finished = {}          # id -> result, until the router acks
     publisher = _Publisher()
+    role_extra = {"role": role}
     while True:
         try:
             msg = recv_msg(sock)
@@ -221,9 +265,23 @@ def serve(sock, engine, replica, incarnation):
                                   item.get("max_new_tokens", 16),
                                   eos_token=item.get("eos_token"),
                                   request_id=item["id"])
-                    engine.submit(req)
+                    phase = item.get("phase")
+                    if phase == "decode":
+                        # the disaggregation handoff: the router ships
+                        # the prefill pool's finished pages with the
+                        # request — inject instead of re-prefilling
+                        if _faults.active() and _faults.handoff_drop():
+                            raise _HandoffDropped(
+                                "injected handoff_drop: payload "
+                                "refused, router must re-ship")
+                        tok, arrays = _decode_kv_payload(item)
+                        engine.submit_prefilled(req, tok, arrays)
+                    else:
+                        if phase == "prefill":
+                            req.prefill_only = True
+                        engine.submit(req)
                     accepted.append(item["id"])
-                except ServingQueueFull as e:
+                except (ServingQueueFull, _HandoffDropped) as e:
                     rejected.append({"id": item["id"], "err": str(e),
                                      "permanent": False})
                 except Exception as e:                     # noqa: BLE001
@@ -236,6 +294,19 @@ def serve(sock, engine, replica, incarnation):
 
             def buffer_finished(reqs):
                 for r in reqs:
+                    if r.finish_reason == "prefill_done":
+                        # a prefill-phase completion: the handoff
+                        # record — first token + the prompt's KV pages
+                        # — rides the finished buffer (at-least-once,
+                        # acked and deduped by id like any completion)
+                        finished[str(r.id)] = {
+                            "id": str(r.id), "phase": "prefill",
+                            "first_token": int(r.tokens[0]),
+                            "kv_bytes": int(sum(
+                                a.nbytes for a in r.kv_payload)),
+                            "kv": _encode_kv_payload(r.kv_payload)}
+                        r.kv_payload = None     # the record owns it now
+                        continue
                     finished[str(r.id)] = {
                         "id": str(r.id),
                         "tokens": [int(t) for t in r.tokens],
@@ -271,9 +342,9 @@ def serve(sock, engine, replica, incarnation):
             return 0
         else:
             resp.update(ok=False, err=f"unknown op {op!r}")
-        resp["stats"] = _stats(engine, {
-            "replica": replica, "incarnation": incarnation,
-            "pid": os.getpid()})
+        resp["stats"] = _stats(engine, dict(
+            role_extra, replica=replica, incarnation=incarnation,
+            pid=os.getpid()))
         # cancels ride every message, not just "cancel" ops
         for rid in msg.get("cancel") or []:
             engine.cancel(rid)
@@ -297,6 +368,10 @@ def main(argv=None):
         ap.error("no router port (--port / PADDLE_FLEET_PORT)")
     incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
     spec = json.loads(os.environ.get("PADDLE_FLEET_MODEL") or "{}")
+    # the disaggregation role is PER-REPLICA (the router assigns it via
+    # env); the spec-level key is the single-process fallback
+    role = (os.environ.get("PADDLE_FLEET_ROLE")
+            or spec.get("role") or "unified")
 
     # replica_slow_start fault: a deterministically slow joiner — the
     # elastic router/autoscaler must tolerate a scale-up replica whose
@@ -307,7 +382,7 @@ def main(argv=None):
     # the compile hook must be live BEFORE the engine builds so the
     # hello's xla_compiles attestation covers every boot compile
     timeline.install_compile_hook()
-    engine = _build_engine(spec)
+    engine = _build_engine(spec, role)
     warm = engine.warmup() if spec.get("warmup", True) else 0
     boot_s = time.perf_counter() - t0
 
@@ -319,13 +394,14 @@ def main(argv=None):
                     "boot_s": round(boot_s, 3),
                     "persistent_cache": _cache_counters(),
                     "compile": _compile_counters(),
-                    "stats": _stats(engine)})
+                    "stats": _stats(engine, {"role": role})})
     timeline.emit({"event": "fleet_replica_up", "replica": args.replica,
-                   "incarnation": incarnation, "boot_s": round(boot_s, 3),
+                   "incarnation": incarnation, "role": role,
+                   "boot_s": round(boot_s, 3),
                    "warmup_prefill_compiles": warm,
                    "persistent_cache": _cache_counters(),
                    "compile": _compile_counters()})
-    return serve(sock, engine, args.replica, incarnation)
+    return serve(sock, engine, args.replica, incarnation, role)
 
 
 if __name__ == "__main__":

@@ -107,7 +107,13 @@ def _stats_family():
         # token the verify's own logits supply)
         "drafted_tokens": 0, "accepted_tokens": 0,
         "rejected_tokens": 0, "spec_steps": 0,
-        "spec_draft_compiles": 0})
+        "spec_draft_compiles": 0,
+        # prefill/decode disaggregation family (ISSUE 15; zero on
+        # unified engines): KV page extractions shipped off a prefill
+        # engine, injections landed on a decode engine, the bytes that
+        # crossed, and the extract/inject executable acquisitions
+        "kv_extracts": 0, "kv_injects": 0, "kv_handoff_bytes": 0,
+        "handoff_compiles": 0})
 
 
 def _legacy_counter(engine, key):
@@ -146,6 +152,15 @@ class Request:
         self.logits = None          # per-token [V] rows when captured
         self.slot = None
         self.preemptions = 0        # page-exhaustion evictions survived
+        # prefill/decode disaggregation (ISSUE 15): a prefill-only
+        # request finishes at admission with its prompt's KV pages
+        # extracted onto ``kv_payload`` (reason "prefill_done"); an
+        # injected request carries the shipped pages in ``_inject``
+        # until the decode engine scatters them into its pool
+        self.prefill_only = False
+        self.kv_payload = None      # host arrays, one per pool operand
+        self._inject = None         # shipped pages awaiting injection
+        self._inject_tok = None     # the prefill's first sampled token
         # speculative engine's per-row pending-draft state (ISSUE 13):
         # committed tokens the draft model has not ingested yet (None
         # until the spec engine activates the row).  MUST be scrubbed on
@@ -178,6 +193,7 @@ class Request:
         self.logits = None
         self.slot = None
         self.pending_draft = None
+        self.kv_payload = None      # a retried prefill re-extracts
         self.done = False
         self.failed = False
         self.error = None
@@ -215,7 +231,8 @@ class ServingEngine:
 
     def __init__(self, model, *, slots=4, max_len=None, seq_buckets=None,
                  batch_buckets=DEFAULT_BATCH_BUCKETS, max_queue=None,
-                 capture_logits=False, cache_dtype=None, quant=None):
+                 capture_logits=False, cache_dtype=None, quant=None,
+                 tp=None):
         import jax
         import jax.numpy as jnp
         self._jax, self._jnp = jax, jnp
@@ -237,6 +254,33 @@ class ServingEngine:
         self._kv_dtype = None          # the paged subclass may set int8
         if quant is not None:
             params = gpt.quantize_params(params, quant)
+        # tensor-parallel serving (ISSUE 15): ``tp`` (env fallback
+        # PADDLE_SERVE_TP) places the params with the megatron
+        # column/row rules from distributed/auto/rules.py and shards
+        # the KV pool's head axis over a 1-D 'tp' mesh; the executables
+        # below stay the same jnp programs — GSPMD partitions them from
+        # the operand shardings, so a model whose fp32 weights exceed
+        # one device serves with each rank holding ~1/tp of the bytes.
+        if tp is None:
+            tp = os.environ.get("PADDLE_SERVE_TP") or 1
+        self._tp = int(tp)
+        if self._tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self._tp}")
+        self._mesh = None
+        self._param_specs = None
+        if self._tp > 1:
+            if quant is not None:
+                raise ValueError(
+                    "tp > 1 composes with full-precision serving only — "
+                    "the quantized {'qw','scale'} leaves have no "
+                    "sharding rules; drop quant= or tp=")
+            if cfg.num_heads % self._tp:
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} must divide by tp "
+                    f"{self._tp} — the KV pool shards on the head axis")
+            self._mesh = gpt.serving_mesh(self._tp)
+            params, self._param_specs = gpt.shard_params_for_serving(
+                params, cfg, self._mesh)
         self.params = params
 
         self.slots = int(slots)
@@ -311,7 +355,8 @@ class ServingEngine:
         old buffers).  The paged subclass overrides this with the page
         pool + allocator reset."""
         cache = gpt.init_slot_cache(self.cfg, self.slots, self.max_len,
-                                    dtype=self._cache_dtype)
+                                    dtype=self._cache_dtype,
+                                    mesh=self._mesh)
         self._cache_k, self._cache_v = cache["k"], cache["v"]
 
     # ------------------------------------------------------------- intake
@@ -349,14 +394,22 @@ class ServingEngine:
                 f"request needs {need} cache positions "
                 f"(prompt {len(req.prompt)} + {req.max_new_tokens} new) "
                 f"> max_len {self.max_len}")
+        if req.prefill_only and not getattr(self, "_handoff", False):
+            raise ValueError(
+                "prefill-only admission needs a "
+                "PagedServingEngine(kv_handoff=True) — this engine has "
+                "no page-extraction path")
         self._check_prompt(req)
-        if len(self._queue) >= self.max_queue:
+        # the bound covers EVERY admission queue (_queued_total: the
+        # paged engine's injection queue included) — the gauge, stats()
+        # and this check must agree on what "queued" means
+        if self._queued_total() >= self.max_queue:
             self._inc("queue_rejects")
             raise ServingQueueFull(
-                f"queue depth {len(self._queue)} at max_queue "
+                f"queue depth {self._queued_total()} at max_queue "
                 f"{self.max_queue}")
         self._queue.append(req)
-        self._g_queue.set(len(self._queue))
+        self._g_queue.set(self._queued_total())
         return req
 
     def _check_prompt(self, req):
@@ -404,11 +457,47 @@ class ServingEngine:
         return (f"cfg[{cfgs}]/quant={self.quant}/kv={self._kv_dtype}"
                 f"/cap={int(self.capture_logits)}/slots={self.slots}"
                 f"/max_len={self.max_len}/cdt={self._cache_dtype}"
-                f"/donate={int(_donation_enabled())}")
+                f"/donate={int(_donation_enabled())}/tp={self._tp}")
 
     def _aot_key(self, kind, **extra):
         ex = "".join(f"/{k}={v}" for k, v in sorted(extra.items()))
         return f"serving/{kind}/{self._aot_sig()}{ex}"
+
+    def _mesh_key(self):
+        """Mesh-topology part folded into every compile-cache key
+        (ISSUE 15): a sharded executable on a different mesh is a
+        different program.  None on single-device engines, so their
+        keys are byte-identical to the pre-TP era."""
+        if self._mesh is None:
+            return None
+        devs = self._mesh.devices.reshape(-1)
+        return ("tp", self._tp, devs[0].platform, len(devs))
+
+    def _topology(self):
+        """The artifact-header device-topology attestation: the AOT
+        store rejects (as stale, rebuilt) a sharded executable
+        deserialized onto a mismatched mesh; single-device artifacts
+        carry None and stay valid across the field's introduction."""
+        mk = self._mesh_key()
+        return None if mk is None else "/".join(str(p) for p in mk)
+
+    def _constrain_cache(self, arrs):
+        """Pin KV-pool outputs to the pool sharding inside the jitted
+        builders, so every executable's output sharding provably equals
+        its input's.  Donated dispatches already guarantee it (aliased
+        buffers share a layout); on the non-donated CPU path GSPMD
+        propagation USUALLY agrees — this makes it an invariant, not a
+        habit.  No-op on single-device engines."""
+        if self._mesh is None:
+            return tuple(arrs)
+        return tuple(jax_compat.with_sharding_constraint(
+            a, self._mesh, gpt.KV_POOL_SPEC) for a in arrs)
+
+    def param_bytes_per_device(self):
+        """Bytes of the (possibly tp-sharded) param pytree each device
+        actually pins — the bench's serves-past-one-device proof."""
+        from ..distributed.auto import rules
+        return rules.bytes_per_device(self.params)
 
     def _build_prefill(self, b, s):
         """One prefill executable per (batch, seq) bucket: runs the causal
@@ -430,6 +519,7 @@ class ServingEngine:
                 cache_v = jax.lax.dynamic_update_slice(
                     cache_v, filled["v"][:, r:r + 1],
                     (0, slot_ids[r], 0, 0, 0))
+            cache_k, cache_v = self._constrain_cache((cache_k, cache_v))
             idx = jnp.clip(lens - 1, 0, s - 1)
             last = jnp.take_along_axis(
                 logits, idx[:, None, None], axis=1)[:, 0]      # [b, V]
@@ -454,9 +544,10 @@ class ServingEngine:
             logits, cache = gpt.decode_step_slots(params, toks, cfg, cache,
                                                   active)
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            ck, cv = self._constrain_cache((cache["k"], cache["v"]))
             if cap:
-                return cache["k"], cache["v"], nxt, logits
-            return cache["k"], cache["v"], nxt
+                return ck, cv, nxt, logits
+            return ck, cv, nxt
 
         donate = (1, 2) if _donation_enabled() else ()
         return jax.jit(decode, donate_argnums=donate)
@@ -522,10 +613,11 @@ class ServingEngine:
                         jnp.asarray(toks), jnp.asarray(lens),
                         jnp.asarray(slot_ids))
             fn = self._prefill.get(
-                _cc.make_key(bbucket, sbucket, donate=donate),
+                _cc.make_key(bbucket, sbucket, donate=donate,
+                             mesh=self._mesh_key()),
                 lambda: self._build_prefill(bbucket, sbucket),
                 stable_key=self._aot_key("prefill", b=bbucket, s=sbucket),
-                example_args=operands)
+                example_args=operands, topology=self._topology())
             t0 = time.perf_counter()
             with timeline.span("serving.prefill", batch=bbucket,
                                seq=sbucket):
@@ -565,7 +657,7 @@ class ServingEngine:
             self._admitting = []
             if not self._warming:
                 self._h_prefill.observe(time.perf_counter() - t0)
-        self._g_queue.set(len(self._queue))
+        self._g_queue.set(self._queued_total())
         occ = int(self._active.sum())
         self._g_occ.set(occ)
         if not self._warming:
@@ -707,7 +799,7 @@ class ServingEngine:
         for req in self._queue:
             if req.id == request_id:
                 self._queue.remove(req)
-                self._g_queue.set(len(self._queue))
+                self._g_queue.set(self._queued_total())
                 self._inc("requests_cancelled")
                 return req
         return None
@@ -728,9 +820,11 @@ class ServingEngine:
         if self._decode_jit is None:
             donate = self._donate()
             self._decode_jit = self._decode_site.get(
-                _cc.make_key("decode", donate=donate), self._build_decode,
+                _cc.make_key("decode", donate=donate,
+                             mesh=self._mesh_key()),
+                self._build_decode,
                 stable_key=self._aot_key("decode"),
-                example_args=operands)
+                example_args=operands, topology=self._topology())
             self._inc("decode_compiles")
         t0 = time.perf_counter()
         with timeline.span("serving.decode_step",
@@ -790,6 +884,13 @@ class ServingEngine:
         if v:
             self._g_tps.set(v)
 
+    def _queued_total(self):
+        """Requests waiting for admission — the one definition the
+        queue-depth gauge AND stats() read (the paged subclass adds its
+        injection queue, so a decode-role replica's queued handoffs are
+        never reported as an idle engine)."""
+        return len(self._queue)
+
     def _busy(self):
         """Work left to drive?  (The paged subclass adds its
         mid-chunked-prefill jobs, which hold slots without being decode-
@@ -828,14 +929,16 @@ class ServingEngine:
         return {(b, s) for s in self.seq_buckets
                 for b in self.batch_buckets
                 if _cc.artifact_ready(
-                    self._aot_key("prefill", b=b, s=s))}
+                    self._aot_key("prefill", b=b, s=s),
+                    topology=self._topology())}
 
     def _aot_has_core(self):
         """Do the non-ladder executables the warmup waves would compile
         have artifacts?  (decode here; paged adds nothing — its
         chunk/copy warm blocks gate themselves; the speculative engine
         needs verify + draft.)"""
-        return _cc.artifact_ready(self._aot_key("decode"))
+        return _cc.artifact_ready(self._aot_key("decode"),
+                                  topology=self._topology())
 
     def warmup(self, max_new_tokens=2):
         """Compile every ladder executable BEFORE taking traffic: for
@@ -938,7 +1041,7 @@ class ServingEngine:
         "prefill_chunks", "prefix_page_hits", "prefix_page_misses",
         "cow_copies", "preemptions", "quant_matmuls",
         "drafted_tokens", "accepted_tokens", "rejected_tokens",
-        "spec_steps"))
+        "spec_steps", "kv_extracts", "kv_injects", "kv_handoff_bytes"))
 
     def _count_quant_matmuls(self):
         """One model forward = 4 quantized matmuls per layer (qkv, proj,
@@ -962,7 +1065,7 @@ class ServingEngine:
         The process-global family (all engines pooled) is
         :func:`serving_stats`."""
         out = dict(self._counts)
-        out["queue_depth"] = len(self._queue)
+        out["queue_depth"] = self._queued_total()
         out["slot_occupancy"] = int(self._active.sum())
         out["slot_occupancy_peak"] = self._occ_peak
         # from the engine-local sample window, NOT the shared gauge — a
@@ -973,6 +1076,7 @@ class ServingEngine:
         out["quant"] = self.quant
         out["kv_dtype"] = self._kv_dtype
         out["spec_mode"] = self.spec_mode
+        out["tp"] = self._tp
         out.update(self._kv_accounting())
         return out
 
@@ -1044,10 +1148,22 @@ class PagedServingEngine(ServingEngine):
 
     def __init__(self, model, *, page_size=16, num_pages=None,
                  prefix_cache=True, prefill_chunk=None, kv_dtype=None,
-                 **kw):
+                 kv_handoff=False, **kw):
         from .kv_pager import KVPager, PagesExhausted  # noqa: F401
         self._KVPager, self._PagesExhausted = KVPager, PagesExhausted
         self._page_size = int(page_size)
+        # prefill/decode disaggregation (ISSUE 15): kv_handoff=True
+        # primes the page extract/inject executables at warmup — a
+        # prefill-role replica finishes prefill-only requests with
+        # their prompt pages extracted (submit a Request whose
+        # ``prefill_only`` is set), a decode-role replica admits
+        # shipped pages via :meth:`submit_prefilled`
+        self._handoff = bool(kv_handoff)
+        self._extract_jit = None
+        self._inject_jit = None
+        self._extract_site = _cc.site("serving.extract", maxsize=2)
+        self._inject_site = _cc.site("serving.inject", maxsize=2)
+        self._inject_queue = collections.deque()
         if self._page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if kv_dtype not in (None, "int8"):
@@ -1122,7 +1238,7 @@ class PagedServingEngine(ServingEngine):
                      f"/kv={'int8' if self._kv_quant else 'fp'}")
         if self._kv_quant:
             cache = gpt.init_paged_cache_quant(self.cfg, self._num_pages,
-                                               ps)
+                                               ps, mesh=self._mesh)
             self._cache_ks = cache["k_scale"]
             self._cache_vs = cache["v_scale"]
             if not self._kv_saved_counted:
@@ -1140,7 +1256,8 @@ class PagedServingEngine(ServingEngine):
                 self._kv_saved_counted = True
         else:
             cache = gpt.init_paged_cache(self.cfg, self._num_pages, ps,
-                                         dtype=self._cache_dtype)
+                                         dtype=self._cache_dtype,
+                                         mesh=self._mesh)
             self._cache_ks = self._cache_vs = None
         self._cache_k, self._cache_v = cache["k"], cache["v"]
         self._tables_np = np.zeros((self.slots, self._pages_per_slot),
@@ -1187,8 +1304,12 @@ class PagedServingEngine(ServingEngine):
         return [i for i in range(self.slots)
                 if not self._active[i] and i not in self._chunk_slots]
 
+    def _queued_total(self):
+        return len(self._queue) + len(self._inject_queue)
+
     def _busy(self):
-        return super()._busy() or bool(self._chunk_jobs)
+        return (super()._busy() or bool(self._chunk_jobs)
+                or bool(self._inject_queue))
 
     def _next_admit_seq(self):
         self._admit_seq += 1
@@ -1202,6 +1323,7 @@ class PagedServingEngine(ServingEngine):
         exhaustion stops the wave — queued requests simply wait for
         decodes to free pages.  Long prompts divert to the chunked
         path."""
+        self._intake_injected()
         self._intake_chunked()
         while self._queue and self._free_slots():
             if self._chunk_eligible(self._queue[0]):
@@ -1235,7 +1357,7 @@ class PagedServingEngine(ServingEngine):
             self._prefill_group(group, tables, sbucket, hits_total)
             if exhausted:
                 break
-        self._g_queue.set(len(self._queue))
+        self._g_queue.set(self._queued_total())
         occ = int(self._active.sum())
         self._g_occ.set(occ)
         if not self._warming:
@@ -1265,10 +1387,11 @@ class PagedServingEngine(ServingEngine):
                     jnp.asarray(toks), jnp.asarray(lens),
                     jnp.asarray(ptab))
         fn = self._prefill.get(
-            _cc.make_key(bbucket, sbucket, donate=donate),
+            _cc.make_key(bbucket, sbucket, donate=donate,
+                         mesh=self._mesh_key()),
             lambda: self._build_prefill(bbucket, sbucket),
             stable_key=self._aot_key("prefill", b=bbucket, s=sbucket),
-            example_args=operands)
+            example_args=operands, topology=self._topology())
         t0 = time.perf_counter()
         with timeline.span("serving.prefill", batch=bbucket, seq=sbucket,
                            paged=True):
@@ -1300,6 +1423,7 @@ class PagedServingEngine(ServingEngine):
             if _faults.active() and not self._warming:
                 _faults.replica_kill_check(
                     request=self._counts["requests_admitted"])
+            self._maybe_finish_prefill_only(req)
         self._admitting = []
         if not self._warming:
             self._h_prefill.observe(time.perf_counter() - t0)
@@ -1352,6 +1476,7 @@ class PagedServingEngine(ServingEngine):
                 cache_k = cache_k.at[:, flat].set(fk)
                 cache_v = cache_v.at[:, flat].set(fv)
                 out_cache = (cache_k, cache_v)
+            out_cache = self._constrain_cache(out_cache)
             idx = jnp.clip(lens - 1, 0, s - 1)
             last = jnp.take_along_axis(
                 logits, idx[:, None, None], axis=1)[:, 0]      # [b, V]
@@ -1420,10 +1545,11 @@ class PagedServingEngine(ServingEngine):
         if self._chunk_jit is None:
             donate = self._donate()
             self._chunk_jit = self._chunk_site.get(
-                _cc.make_key("chunk", C, donate=donate),
+                _cc.make_key("chunk", C, donate=donate,
+                             mesh=self._mesh_key()),
                 lambda: self._build_chunk(C),
                 stable_key=self._aot_key("chunk", c=C),
-                example_args=operands)
+                example_args=operands, topology=self._topology())
             self._inc("prefill_compiles")
         t0 = time.perf_counter()
         with timeline.span("serving.prefill_chunk", pos=pos, take=take):
@@ -1456,6 +1582,7 @@ class PagedServingEngine(ServingEngine):
         if _faults.active() and not self._warming:
             _faults.replica_kill_check(
                 request=self._counts["requests_admitted"])
+        self._maybe_finish_prefill_only(req)
 
     def _build_chunk(self, C):
         """ONE executable serves every chunk of every long prompt: the
@@ -1481,6 +1608,7 @@ class PagedServingEngine(ServingEngine):
             last = jax.lax.dynamic_index_in_dim(logits[0], tlen - 1, 0,
                                                 keepdims=False)    # [V]
             tok = jnp.argmax(last, -1).astype(jnp.int32)
+            cache = self._constrain_cache(cache)
             if cap:
                 return (*cache, tok, last)
             return (*cache, tok)
@@ -1500,11 +1628,13 @@ class PagedServingEngine(ServingEngine):
     def _get_copy_jit(self):
         if self._copy_jit is None:
             self._copy_jit = self._copy_site.get(
-                _cc.make_key("copy", donate=self._donate(0)),
+                _cc.make_key("copy", donate=self._donate(0),
+                             mesh=self._mesh_key()),
                 self._build_copy,
                 stable_key=self._aot_key("copy"),
                 example_args=(*self._cache_operands(),
-                              np.int32(0), np.int32(0)))
+                              np.int32(0), np.int32(0)),
+                topology=self._topology())
         return self._copy_jit
 
     def _copy_page(self, src, dst):
@@ -1522,11 +1652,228 @@ class PagedServingEngine(ServingEngine):
 
         def cp(*args):
             arrs, (src, dst) = args[:-2], args[-2:]
-            return tuple(a.at[:, dst].set(a[:, src]) for a in arrs)
+            return self._constrain_cache(
+                tuple(a.at[:, dst].set(a[:, src]) for a in arrs))
 
         donate = (tuple(range(self._n_cache))
                   if _donation_enabled() else ())
         return jax.jit(cp, donate_argnums=donate)
+
+    # ------------------------------------------- KV handoff (ISSUE 15)
+    #
+    # Prefill/decode disaggregation ships a finished prompt's KV pages
+    # from a prefill-role engine to a decode-role engine (DistServe/
+    # Splitwise): the prefill engine admits a ``prefill_only`` request
+    # through the NORMAL wave/chunked paths, then — instead of decoding
+    # — extracts its pages to host bytes, finishes it with reason
+    # "prefill_done", and releases the pages (prompt pages retire to
+    # the prefix-reclaim LRU, so a repeated system prompt prefills
+    # free).  The decode engine re-acquires a page table for the SAME
+    # prompt (prefix hits share physical pages — the shipped bytes are
+    # deterministic, so rewriting a shared page writes what it already
+    # holds) and scatters the payload in with ONE injection executable.
+    # Both directions are one fixed-shape executable each (pages padded
+    # to the per-slot table width), so the zero-steady-state-compiles
+    # invariant survives disaggregation.
+
+    def _build_extract(self):
+        jax = self._jax
+        n = self._n_cache
+
+        def extract(*args):
+            cache, pages = args[:n], args[-1]
+            return tuple(c[:, pages] for c in cache)
+
+        return jax.jit(extract)     # read-only: the pool is NOT donated
+
+    def _extract_slot_kv(self, slot, n_pages):
+        """The slot's first ``n_pages`` pages of every pool operand as
+        host arrays (k, v — plus scales on the int8 pool), via one
+        fixed-width gather executable."""
+        jnp = self._jnp
+        operands = (*self._cache_operands(),
+                    jnp.asarray(self._tables_np[slot]))
+        if self._extract_jit is None:
+            self._extract_jit = self._extract_site.get(
+                _cc.make_key("extract", mesh=self._mesh_key()),
+                self._build_extract,
+                stable_key=self._aot_key("extract"),
+                example_args=operands, topology=self._topology())
+            self._inc("handoff_compiles")
+        with timeline.span("serving.kv_extract", pages=int(n_pages)):
+            out = self._extract_jit(*operands)
+        self._inc("kv_extracts")
+        # the handoff readback: these pages LEAVE the replica as wire
+        # bytes by design — the disaggregation shipping path, not a
+        # hot-loop leak
+        # ptl: disable-next=PTL004 -- KV handoff readback (pages ship out)
+        return [np.asarray(a)[:, :int(n_pages)] for a in out]
+
+    def _maybe_finish_prefill_only(self, req):
+        """Finish a ``prefill_only`` admission the moment its prompt is
+        in: pages extracted onto ``req.kv_payload``, request finished
+        with reason "prefill_done" (slot + pages released).  A request
+        that finished NATURALLY during admission (eos on the first
+        token, max_new_tokens == 1) ships no pages — its completion is
+        already final."""
+        if not req.prefill_only or req.done or self._warming:
+            return
+        s = req.slot
+        n_pages = len(self._pager.tables[s])
+        req.kv_payload = self._extract_slot_kv(s, n_pages)
+        self._inc("kv_handoff_bytes",
+                  sum(int(a.nbytes) for a in req.kv_payload))
+        self._finish(req, "prefill_done")
+
+    def submit_prefilled(self, req, first_token, kv_arrays):
+        """Admit a request whose prompt KV was prefilled on ANOTHER
+        engine (the disaggregation handoff).  ``req`` is a prepared
+        :class:`Request`; ``kv_arrays`` is one host array per pool
+        operand, shaped ``[L, n_pages, page_size, ...]`` for the
+        prompt's pages (what the prefill side's ``kv_payload`` holds);
+        ``first_token`` is the prefill's sampled first token.  Queued
+        on the injection queue — the next :meth:`step` acquires pages
+        and scatters the payload in.  Identical params make the decode
+        byte-stream token-exact with a never-disaggregated run."""
+        if not isinstance(req, Request):
+            raise TypeError("submit_prefilled wants a prepared Request")
+        if not self._handoff:
+            # symmetric with submit()'s prefill_only guard: without
+            # kv_handoff=True the inject executable was never primed,
+            # so the first injection would compile in live traffic
+            raise ValueError(
+                "handed-off admission needs "
+                "PagedServingEngine(kv_handoff=True) — this engine's "
+                "warmup never primed the injection executable")
+        if self.capture_logits:
+            raise ValueError(
+                "capture_logits engines cannot admit handed-off "
+                "requests — the first token's logits row stayed on the "
+                "prefill replica, so the per-token capture would be "
+                "misaligned from its first entry")
+        need_pos = len(req.prompt) + req.max_new_tokens
+        if need_pos > self.max_len:
+            # same admission bound as submit(): past max_len the
+            # fixed-width page table overflows and positions reuse the
+            # last positional embedding — reject up front, not mid-step
+            raise ValueError(
+                f"request needs {need_pos} cache positions "
+                f"(prompt {len(req.prompt)} + {req.max_new_tokens} new) "
+                f"> max_len {self.max_len}")
+        need = self._pager.pages_for(need_pos)
+        if need > self._num_pages - 1:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self._num_pages - 1}")
+        ops = self._cache_operands()
+        if len(kv_arrays) != len(ops):
+            raise ValueError(
+                f"kv payload has {len(kv_arrays)} arrays; this pool "
+                f"has {len(ops)} operands (fp: k,v; int8: k,k_scale,"
+                "v,v_scale)")
+        n_pages = self._pager.pages_for(len(req.prompt))
+        arrays = []
+        for a, pool in zip(kv_arrays, ops):
+            a = np.asarray(a)
+            want = (pool.shape[0], n_pages) + tuple(pool.shape[2:])
+            if tuple(a.shape) != want or a.dtype != np.dtype(pool.dtype):
+                raise ValueError(
+                    f"kv payload shape/dtype {a.shape}/{a.dtype} does "
+                    f"not match the pool's page layout {want}/"
+                    f"{pool.dtype} — mismatched engine configs can "
+                    "never hand off")
+            arrays.append(a)
+        if self._queued_total() >= self.max_queue:
+            self._inc("queue_rejects")
+            raise ServingQueueFull(
+                f"queue depth {self._queued_total()} at max_queue "
+                f"{self.max_queue}")
+        req._inject = arrays
+        req._inject_tok = int(first_token)
+        self._inject_queue.append(req)
+        self._g_queue.set(self._queued_total())
+        return req
+
+    def _build_inject(self):
+        jax = self._jax
+        n = self._n_cache
+
+        def inject(*args):
+            cache, payload, pages = args[:n], args[n:2 * n], args[-1]
+            return self._constrain_cache(tuple(
+                c.at[:, pages].set(p) for c, p in zip(cache, payload)))
+
+        donate = (tuple(range(n)) if _donation_enabled() else ())
+        return jax.jit(inject, donate_argnums=donate)
+
+    def _inject_call(self, pages_row, payload):
+        """One injection dispatch: scatter ``payload`` (already padded
+        to the table width, pad rows aimed at the scratch page) into
+        the donated pool at ``pages_row``."""
+        jnp = self._jnp
+        operands = (*self._cache_operands(),
+                    *(jnp.asarray(p) for p in payload),
+                    jnp.asarray(pages_row))
+        if self._inject_jit is None:
+            self._inject_jit = self._inject_site.get(
+                _cc.make_key("inject", donate=self._donate(0),
+                             mesh=self._mesh_key()),
+                self._build_inject,
+                stable_key=self._aot_key("inject"),
+                example_args=operands, topology=self._topology())
+            self._inc("handoff_compiles")
+        with timeline.span("serving.kv_inject"):
+            self._set_cache(self._inject_jit(*operands))
+
+    def _pad_payload(self, arrays, n_pages):
+        maxP = self._pages_per_slot
+        out = []
+        for a in arrays:
+            pad = np.zeros((a.shape[0], maxP) + tuple(a.shape[2:]),
+                           a.dtype)
+            pad[:, :n_pages] = a
+            out.append(pad)
+        return out
+
+    def _intake_injected(self):
+        """Admit shipped-KV requests from the injection queue: acquire
+        a page table for the prompt (prefix hits share pages — the
+        injection rewrites bytes identical to what a shared page
+        already holds), scatter the payload in, and activate the slot
+        with the prefill's first token already committed.  Page
+        exhaustion leaves the queue intact — decodes free pages."""
+        while self._inject_queue:
+            free = self._free_slots()
+            if not free:
+                return
+            req = self._inject_queue[0]
+            slot = free[0]
+            try:
+                table, hits = self._pager.admit(slot, req.prompt)
+            except self._PagesExhausted:
+                return
+            self._inject_queue.popleft()
+            req.slot = slot
+            n_pages = len(table)
+            pages_row = np.zeros((self._pages_per_slot,), np.int32)
+            pages_row[:n_pages] = table
+            self._inject_call(pages_row,
+                              self._pad_payload(req._inject, n_pages))
+            self._inc("prefix_page_hits", hits)
+            self._inc("prefix_page_misses", n_pages - hits)
+            self._inc("kv_injects")
+            self._tables_np[slot] = pages_row
+            self._lens[slot] = len(req.prompt)
+            self._active[slot] = True
+            self._slot_req[slot] = req
+            req._admit_seq = self._next_admit_seq()
+            self._append_token(req, req._inject_tok, None)
+            self._last_tok[slot] = req._inject_tok
+            self._inc("requests_admitted")
+            self._g_queue.set(self._queued_total())
+            if _faults.active() and not self._warming:
+                _faults.replica_kill_check(
+                    request=self._counts["requests_admitted"])
 
     def _newest_victim(self):
         """The most recently admitted in-flight request (decode-active
@@ -1557,9 +1904,15 @@ class PagedServingEngine(ServingEngine):
                     pass
         req.reset_for_retry()
         req.preemptions += 1
-        self._queue.appendleft(req)
+        if req._inject is not None:
+            # a preempted INJECTED request re-injects its shipped pages
+            # (re-prefilling locally would be correct but would drag
+            # prefill work onto a decode-role replica)
+            self._inject_queue.appendleft(req)
+        else:
+            self._queue.appendleft(req)
         self._inc("preemptions")
-        self._g_queue.set(len(self._queue))
+        self._g_queue.set(self._queued_total())
         if not self._warming and timeline.telemetry_dir():
             timeline.emit({"event": "page_exhaustion",
                            "request_id": str(req.id),
@@ -1625,9 +1978,11 @@ class PagedServingEngine(ServingEngine):
         if self._decode_jit is None:
             donate = self._donate()
             self._decode_jit = self._decode_site.get(
-                _cc.make_key("decode", donate=donate), self._build_decode,
+                _cc.make_key("decode", donate=donate,
+                             mesh=self._mesh_key()),
+                self._build_decode,
                 stable_key=self._aot_key("decode"),
-                example_args=operands)
+                example_args=operands, topology=self._topology())
             self._inc("decode_compiles")
         t0 = time.perf_counter()
         with timeline.span("serving.decode_step",
@@ -1684,6 +2039,7 @@ class PagedServingEngine(ServingEngine):
             logits, *cache = step(params, toks, cfg, *cache, page_table,
                                   wpages, woffs, lens)
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            cache = self._constrain_cache(cache)
             if cap:
                 return (*cache, nxt, logits)
             return (*cache, nxt)
@@ -1691,6 +2047,20 @@ class PagedServingEngine(ServingEngine):
         donate = (tuple(range(1, 1 + self._n_cache))
                   if _donation_enabled() else ())
         return jax.jit(decode, donate_argnums=donate)
+
+    def cancel(self, request_id):
+        """Base cancel plus the injection queue (a handed-off request
+        cancelled before its pages land)."""
+        out = super().cancel(request_id)
+        if out is not None:
+            return out
+        for req in self._inject_queue:
+            if req.id == request_id:
+                self._inject_queue.remove(req)
+                self._g_queue.set(self._queued_total())
+                self._inc("requests_cancelled")
+                return req
+        return None
 
     # -------------------------------------------------------------- warmup
     def _warmup_wave_len(self, lo, s, mnt):
@@ -1721,7 +2091,9 @@ class PagedServingEngine(ServingEngine):
                              self.batch_buckets[-1])
         try:
             if (self._copy_jit is None
-                    and not _cc.artifact_ready(self._aot_key("copy"))):
+                    and not _cc.artifact_ready(
+                        self._aot_key("copy"),
+                        topology=self._topology())):
                 # scratch-onto-scratch: a no-op copy that only compiles
                 # (with an artifact on disk the load happens lazily at
                 # the first real COW — a deserialization, not a compile)
@@ -1731,10 +2103,32 @@ class PagedServingEngine(ServingEngine):
                     and self._prefill_chunk is not None
                     and self._prefill_chunk + 2 <= self.max_len
                     and not _cc.artifact_ready(
-                        self._aot_key("chunk", c=self._prefill_chunk))):
+                        self._aot_key("chunk", c=self._prefill_chunk),
+                        topology=self._topology())):
                 n = self._prefill_chunk + 1      # two chunks: full + tail
                 self.submit(np.ones((n,), np.int32), 1)
                 self.run()
+            if self._handoff:
+                # prime the handoff executables so a disaggregated
+                # replica's first extraction/injection is not a compile
+                # in live traffic: a scratch-table extract and a
+                # zero-payload inject aimed at the scratch page
+                if (self._extract_jit is None
+                        and not _cc.artifact_ready(
+                            self._aot_key("extract"),
+                            topology=self._topology())):
+                    self._extract_slot_kv(0, 0)
+                if (self._inject_jit is None
+                        and not _cc.artifact_ready(
+                            self._aot_key("inject"),
+                            topology=self._topology())):
+                    zeros = [np.zeros(
+                        (p.shape[0], 0) + tuple(p.shape[2:]),
+                        np.dtype(p.dtype))
+                        for p in self._cache_operands()]
+                    self._inject_call(
+                        np.zeros((self._pages_per_slot,), np.int32),
+                        self._pad_payload(zeros, 0))
         finally:
             self._warming = False
             self.max_queue = real_max_queue
@@ -1765,6 +2159,9 @@ class PagedServingEngine(ServingEngine):
                 "page_utilization": round(held / max(1, in_use * ps), 4)}
 
     def stats(self):
+        # queue_depth comes through _queued_total (inject queue
+        # included): drivers polling it — the fleet worker's step loop
+        # — must see queued handoffs or a decode replica never steps
         out = super().stats()
         pg = self._pager.stats()
         for k in ("prefix_page_hits", "prefix_page_misses", "cow_copies"):

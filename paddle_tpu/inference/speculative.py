@@ -230,11 +230,14 @@ class SpeculativeServingEngine(PagedServingEngine):
         additionally needs both draft executables before a warmup wave
         may be skipped — a skipped wave with a missing draft artifact
         would push the draft compile into live traffic."""
-        core = _cc.artifact_ready(self._aot_key("verify"))
+        topo = self._topology()
+        core = _cc.artifact_ready(self._aot_key("verify"), topology=topo)
         if core and self._spec_mode_val == "draft":
             core = (_cc.artifact_ready(
-                self._aot_key("draft_prefill", c=self._draft_chunk))
-                and _cc.artifact_ready(self._aot_key("draft_step")))
+                self._aot_key("draft_prefill", c=self._draft_chunk),
+                topology=topo)
+                and _cc.artifact_ready(self._aot_key("draft_step"),
+                                       topology=topo))
         return core
 
     # ------------------------------------------------------- draft model
@@ -278,10 +281,20 @@ class SpeculativeServingEngine(PagedServingEngine):
                 self._draft_cfg = self._build_draft_cfg()
                 self._draft_params = gpt.init_params(
                     self._draft_cfg, jax.random.PRNGKey(self._draft_seed))
+                if self._mesh is not None:
+                    # a tp-sharded target rejects operands committed
+                    # off-mesh: the draft model is tiny, so it rides
+                    # REPLICATED on the same mesh (its derived head
+                    # count need not divide tp)
+                    self._draft_params = gpt.replicate_on_mesh(
+                        self._draft_params, self._mesh)
             # 2k positions deeper than the target cache: the fused
             # catch-up+draft step writes up to lens + 2k - 1
             dmax = self.max_len + 2 * self._spec_k_val
             cache = gpt.init_slot_cache(self._draft_cfg, self.slots, dmax)
+            if self._mesh is not None:
+                cache = gpt.replicate_on_mesh(
+                    {"k": cache["k"], "v": cache["v"]}, self._mesh)
             self._draft_k, self._draft_v = cache["k"], cache["v"]
         self._draft_lens = np.zeros((self.slots,), np.int32)
 
@@ -330,10 +343,11 @@ class SpeculativeServingEngine(PagedServingEngine):
             if self._draft_prefill_jit is None:
                 donate = (1, 2) if _donation_enabled() else ()
                 self._draft_prefill_jit = self._draft_site.get(
-                    _cc.make_key("draft_prefill", C, donate=donate),
+                    _cc.make_key("draft_prefill", C, donate=donate,
+                                 mesh=self._mesh_key()),
                     lambda: self._build_draft_prefill(C),
                     stable_key=self._aot_key("draft_prefill", c=C),
-                    example_args=operands)
+                    example_args=operands, topology=self._topology())
                 self._inc("spec_draft_compiles")
             self._draft_k, self._draft_v = self._draft_prefill_jit(
                 *operands)
@@ -390,10 +404,11 @@ class SpeculativeServingEngine(PagedServingEngine):
         if self._draft_jit is None:
             donate = (1, 2) if _donation_enabled() else ()
             self._draft_jit = self._draft_site.get(
-                _cc.make_key("draft_step", k, donate=donate),
+                _cc.make_key("draft_step", k, donate=donate,
+                             mesh=self._mesh_key()),
                 self._build_draft_step,
                 stable_key=self._aot_key("draft_step"),
-                example_args=operands)
+                example_args=operands, topology=self._topology())
             self._inc("spec_draft_compiles")
         with timeline.span("serving.spec_draft",
                            active=int(self._active.sum())):
@@ -478,6 +493,7 @@ class SpeculativeServingEngine(PagedServingEngine):
             else:
                 out_cache = (cache[0].at[:, wp, wo].set(wk),
                              cache[1].at[:, wp, wo].set(wv))
+            out_cache = self._constrain_cache(out_cache)
             if cap:
                 return (*out_cache, out_toks, n_commit, logits)
             return (*out_cache, out_toks, n_commit)
@@ -534,9 +550,11 @@ class SpeculativeServingEngine(PagedServingEngine):
         if self._decode_jit is None:
             donate = self._donate()
             self._decode_jit = self._decode_site.get(
-                _cc.make_key("verify", donate=donate), self._build_verify,
+                _cc.make_key("verify", donate=donate,
+                             mesh=self._mesh_key()),
+                self._build_verify,
                 stable_key=self._aot_key("verify"),
-                example_args=operands)
+                example_args=operands, topology=self._topology())
             self._inc("decode_compiles")
         finished = []
         t0 = time.perf_counter()

@@ -175,6 +175,77 @@ def sharding_rules(cfg: GPTConfig = None):
     return param_specs(cfg)
 
 
+# --------------------------------------------------------------------------
+# tensor-parallel serving placement (ISSUE 15)
+# --------------------------------------------------------------------------
+#
+# Serving past one device reuses the TRAINING layouts: params are placed
+# with the megatron column/row PartitionSpecs the distributed.auto rule
+# registry already owns (sharding_rules above delegates to
+# gpt_hybrid.param_specs), and the KV pools shard the HEAD axis over
+# 'tp' — each rank holds nh/tp heads of every page/slot, so the paged
+# page tables and the paged-attention math stay per-shard-local (a page
+# id means the same physical page on every rank; only its head slice
+# differs).  The executables themselves stay the single-device jnp code
+# below: GSPMD partitions them from the operand shardings, which is
+# exactly the pjit/NamedSharding recipe the training engine uses.
+
+# the KV pool sharding: head axis (axis 3 of [L, P, ps, nh, hd] pages,
+# [L, S, max_len, nh, hd] slots, and [L, P, ps, nh] int8 scales alike)
+KV_POOL_SPEC = (None, None, None, "tp")
+
+
+def serving_mesh(tp):
+    """A 1-D ``('tp',)`` mesh over the first ``tp`` local devices — the
+    serving engine's tensor-parallel topology (built through
+    framework/jax_compat.py like every mesh in this repo)."""
+    import numpy as _np
+    from ..framework import jax_compat
+    tp = int(tp)
+    if tp < 2:
+        raise ValueError(f"serving_mesh wants tp >= 2, got {tp} "
+                         "(tp=1 is the plain single-device engine)")
+    devs = jax.devices()
+    if len(devs) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices but only {len(devs)} are "
+            "visible (CPU runs: --xla_force_host_platform_device_count)")
+    return jax_compat.make_mesh(_np.array(devs[:tp]), ("tp",))
+
+
+def shard_params_for_serving(params, cfg, mesh):
+    """Place the serving param pytree with the gpt megatron column/row
+    rules from the distributed.auto registry, pruned to ``mesh`` (the
+    serving mesh carries only 'tp', so the training rules' 'pp' axis
+    drops out).  Returns ``(placed_params, specs)``.  Shapes that don't
+    divide raise up front with every violation named — a silently
+    replicated leaf would void the fits-past-one-device claim."""
+    from ..distributed.auto import rules
+    specs = rules.prune_to_mesh(rules.rules_for("gpt", cfg), mesh)
+    shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
+    bad = rules.validate(specs, shapes, mesh)
+    if bad:
+        raise ValueError(
+            f"gpt params don't shard over this mesh: {bad} — pick a "
+            "config whose sharded axes divide the tp degree")
+    return rules.place(params, mesh, specs), specs
+
+
+def replicate_on_mesh(tree, mesh):
+    """device_put every leaf of ``tree`` fully replicated on ``mesh`` —
+    mesh-sharded executables reject operands committed off-mesh, so
+    small replicated operands (the speculative engine's draft model)
+    must still live on it."""
+    from ..framework import jax_compat
+    sh = jax_compat.named_sharding(mesh, ())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def _kv_pool_sharding(mesh):
+    from ..framework import jax_compat
+    return jax_compat.named_sharding(mesh, KV_POOL_SPEC)
+
+
 QUANT_MODES = ("int8", "int8_dynamic", "fp8")
 
 
@@ -526,28 +597,35 @@ def trim_eos(sequences, prompt_len, eos_token, include_eos=True):
 # zeroing, only a length reset.
 
 
-def _pool_zeros(shape, dtype):
+def _pool_zeros(shape, dtype, sharding=None):
     """Host-side zero pool allocation: ``device_put(np.zeros)`` instead
     of ``jnp.zeros``, because the eager broadcast COMPILES a tiny XLA
     program per distinct shape — and the AOT-warm serving replica's
     contract is ZERO backend compiles at boot.  Only the host-called
-    pool constructors use this; in-trace allocations stay jnp."""
+    pool constructors use this; in-trace allocations stay jnp.
+    ``sharding`` (a NamedSharding) places the pool mesh-sharded for the
+    tensor-parallel engine."""
     import numpy as np
     import jax
-    return jax.device_put(np.zeros(shape, jnp.dtype(dtype)))
+    z = np.zeros(shape, jnp.dtype(dtype))
+    return jax.device_put(z) if sharding is None \
+        else jax.device_put(z, sharding)
 
 
-def init_slot_cache(cfg: GPTConfig, slots, max_len, dtype=None):
+def init_slot_cache(cfg: GPTConfig, slots, max_len, dtype=None,
+                    mesh=None):
     """Slot-pooled KV cache: {'k','v': [L, S, max_len, nh, hd],
-    'len': int32[S] tokens filled per slot}."""
+    'len': int32[S] tokens filled per slot}.  With ``mesh`` the K/V
+    buffers shard the head axis over 'tp' (:data:`KV_POOL_SPEC`)."""
     if max_len > cfg.max_seq_len:
         raise ValueError(
             f"slot cache max_len {max_len} exceeds cfg.max_seq_len "
             f"{cfg.max_seq_len}: positions past it would reuse the last "
             "positional embedding")
     cd = jnp.dtype(dtype or cfg.dtype)
+    sh = None if mesh is None else _kv_pool_sharding(mesh)
     shape = (cfg.num_layers, slots, max_len, cfg.num_heads, cfg.head_dim)
-    return {"k": _pool_zeros(shape, cd), "v": _pool_zeros(shape, cd),
+    return {"k": _pool_zeros(shape, cd, sh), "v": _pool_zeros(shape, cd, sh),
             "len": _pool_zeros((slots,), jnp.int32)}
 
 
@@ -635,14 +713,18 @@ def decode_step_slots(params, tokens, cfg: GPTConfig, cache, active=None):
 # (inference/kv_pager.py owns that bookkeeping).
 
 
-def init_paged_cache(cfg: GPTConfig, num_pages, page_size, dtype=None):
+def init_paged_cache(cfg: GPTConfig, num_pages, page_size, dtype=None,
+                     mesh=None):
     """Paged KV pool: {'k','v': [L, num_pages, page_size, nh, hd]}.
     Page 0 is the scratch page (inactive lanes / padded prefill rows
-    scatter there; nothing reads it)."""
+    scatter there; nothing reads it).  With ``mesh`` the pages shard
+    the head axis over 'tp' — page ids stay rank-invariant, each rank
+    holds its nh/tp head slice of every page."""
     cd = jnp.dtype(dtype or cfg.dtype)
+    sh = None if mesh is None else _kv_pool_sharding(mesh)
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
              cfg.head_dim)
-    return {"k": _pool_zeros(shape, cd), "v": _pool_zeros(shape, cd)}
+    return {"k": _pool_zeros(shape, cd, sh), "v": _pool_zeros(shape, cd, sh)}
 
 
 def _paged_slot_block(cfg, x, blk, k_pages, v_pages, page_table,
@@ -761,16 +843,22 @@ def dequantize_kv(q, s, dtype):
     return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
 
 
-def init_paged_cache_quant(cfg: GPTConfig, num_pages, page_size):
+def init_paged_cache_quant(cfg: GPTConfig, num_pages, page_size,
+                           mesh=None):
     """int8 paged KV pool + scale arrays: {'k','v': int8
     [L, P, ps, nh, hd], 'k_scale','v_scale': fp32 [L, P, ps, nh]}.
-    Page 0 stays the scratch page."""
+    Page 0 stays the scratch page.  With ``mesh`` both the int8 pages
+    and their scale rows shard the head axis (axis 3 in either rank)
+    over 'tp' — a page's bytes AND scales live on the same rank, and
+    the per-position-per-head absmax quantizer needs only its own
+    heads, so the quantize-once byte contract holds per shard."""
+    sh = None if mesh is None else _kv_pool_sharding(mesh)
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
              cfg.head_dim)
-    return {"k": _pool_zeros(shape, jnp.int8),
-            "v": _pool_zeros(shape, jnp.int8),
-            "k_scale": _pool_zeros(shape[:-1], jnp.float32),
-            "v_scale": _pool_zeros(shape[:-1], jnp.float32)}
+    return {"k": _pool_zeros(shape, jnp.int8, sh),
+            "v": _pool_zeros(shape, jnp.int8, sh),
+            "k_scale": _pool_zeros(shape[:-1], jnp.float32, sh),
+            "v_scale": _pool_zeros(shape[:-1], jnp.float32, sh)}
 
 
 def _paged_slot_block_quant(cfg, x, blk, k_pages, k_scale, v_pages,

@@ -71,6 +71,12 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         exhaustion event: the NEWEST in-flight request must be
         preempted (pages freed, request re-queued from its prompt,
         named in telemetry/counters) — never a silent stall or loss.
+    handoff_drop:nth=1[,repeat=1]
+        the matching decode-phase (KV-carrying) submission to a
+        serving replica is refused WITHOUT being admitted — a dropped
+        prefill->decode page handoff.  The router must keep the payload
+        on the pending-table entry and RE-SHIP it (zero lost, counted
+        in fleet.handoff_reships).
     spec_reject:step=3[,repeat=1]
         the speculative engine's verify at decode step N is forced into
         an ALL-REJECT (accept length 0: every draft candidate refused,
@@ -285,6 +291,15 @@ def rpc_entry(op):
     if fault is not None:
         time.sleep(float(fault.get("seconds", 0.5)))
     return take("rpc_drop", op=op) is not None
+
+
+def handoff_drop():
+    """Called by the fleet worker per incoming decode-phase
+    (KV-carrying) submission; returns True when a matching
+    ``handoff_drop`` fault fires — the worker must refuse the item
+    WITHOUT admitting it, so the router re-ships the pages from the
+    pending-table entry (retry re-ships, zero lost)."""
+    return take("handoff_drop") is not None
 
 
 def page_exhaustion_check(step=None):

@@ -34,6 +34,15 @@ if ! tools/aot_smoke.sh; then
     exit 1
 fi
 
+# prefill/decode disaggregation smoke (~25s): 1 prefill + 1 decode
+# replica, decode p99 flat under long-prompt pressure, KV pages handed
+# off through the router, zero lost — the ISSUE-15 fleet contract
+if ! tools/disagg_smoke.sh; then
+    echo "tier1_guard: FAIL — disaggregation smoke" \
+         "(tools/disagg_smoke.sh; see above)" >&2
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
