@@ -76,7 +76,8 @@ def _stats_family():
         "ticks": 0, "scale_ups": 0, "scale_downs": 0,
         "holds_cooldown": 0, "holds_bounds": 0, "tick_errors": 0,
         "flap_forced": 0, "up_signals_p99": 0, "up_signals_backlog": 0,
-        "up_signals_pending": 0, "up_signals_occupancy": 0})
+        "up_signals_pending": 0, "up_signals_occupancy": 0,
+        "up_signals_spill": 0})
 
 
 def autoscale_stats():
@@ -99,7 +100,7 @@ class Autoscaler:
                  window_s=15.0, up_backlog_per_replica=2.0,
                  pending_headroom=0.7, hi_occupancy=0.85,
                  lo_occupancy=0.35, up_ticks=1, down_ticks=8,
-                 slo_down_margin=0.5, role=None):
+                 slo_down_margin=0.5, spill_up=None, role=None):
         self.fleet = fleet
         # per-role-pool scaling loop (ISSUE 15): role="prefill"/"decode"
         # scopes every signal AND every action to that pool of a
@@ -135,6 +136,12 @@ class Autoscaler:
         self.up_ticks = int(up_ticks)
         self.down_ticks = int(down_ticks)
         self.slo_down_margin = float(slo_down_margin)
+        # host-tier spill pressure (ISSUE 17): any replica's pinned-host
+        # KV tier past this fill fraction means evicted chains are
+        # about to fall off the host LRU too — re-prefills imminent —
+        # so more replicas (more device pages, more tier bytes) help
+        self.spill_up = spill_up if spill_up is not None \
+            else _env_float("PADDLE_FLEET_SPILL_UP", 0.9)
 
         self._stats = _stats_family()
         # the autoscale.* family is process-global; mirror every
@@ -221,6 +228,11 @@ class Autoscaler:
         if sig["occupancy"] >= self.hi_occupancy and sig["backlog"] > 0:
             reasons_up.append("occupancy")
             self._inc("up_signals_occupancy")
+        if (self.spill_up
+                and float(sig.get("spill_pressure") or 0.0)
+                >= self.spill_up):
+            reasons_up.append("spill")
+            self._inc("up_signals_spill")
 
         idle = (sig["backlog"] == 0
                 and sig["occupancy"] <= self.lo_occupancy
@@ -274,7 +286,7 @@ class Autoscaler:
                "signals": {k: sig.get(k) for k in (
                    "backlog", "pending_fraction", "occupancy", "p99_s",
                    "configured", "healthy",
-                   "accepted_tokens_per_step")}}
+                   "accepted_tokens_per_step", "spill_pressure")}}
         self.decisions.append(rec)
         self._g_target.set(target + (1 if direction == "up" else -1))
         timeline.emit(dict(rec, event="autoscale_decision"))

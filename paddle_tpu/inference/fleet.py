@@ -77,6 +77,9 @@ import uuid
 import numpy as np
 
 from ..observability import metrics, timeline
+# pure numpy/hashlib helpers (kv_pager never imports jax): the router
+# computes the IDENTICAL sticky-routing digest a replica's pager does
+from .kv_pager import prompt_chain_keys, short_digest
 
 # spelled out through importlib: paddle_tpu.distributed exports a
 # launch() FUNCTION that shadows the submodule attribute
@@ -170,7 +173,13 @@ def _stats_family():
         # phases whose KV pages crossed the router, the bytes that
         # crossed, and payloads SHIPPED MORE THAN ONCE (a decode
         # replica died or dropped the handoff; zero-lost re-ships)
-        "kv_handoffs": 0, "kv_handoff_bytes": 0, "handoff_reships": 0})
+        "kv_handoffs": 0, "kv_handoff_bytes": 0, "handoff_reships": 0,
+        # prefix-aware routing + hot-prefix migration (ISSUE 17):
+        # dispatches held for their sticky replica, sticky targets that
+        # were unusable (dead/draining/full -> least-loaded fallback),
+        # and hot chains copied to a cold replica via the handoff path
+        "prefix_routed": 0, "prefix_fallbacks": 0,
+        "prefix_migrations": 0, "migration_bytes": 0})
 
 
 def fleet_stats():
@@ -218,6 +227,18 @@ class FleetRequest:
         self.first_token = None
         self.prefill_replica = None
         self.decode_t0 = None         # when the decode phase began
+        # prefix-aware routing (ISSUE 17): prefix_chain holds the
+        # prompt's full-page chain digests DEEPEST-FIRST — the router
+        # matches the deepest digest any replica advertises, so exact
+        # repeats go to the replica holding the whole chain while fresh
+        # prefix-sharers still match the shared head page.  A hot-prefix
+        # migration pins the prefill to the chain's current owner and
+        # the decode to the replica the router wants the chain copied
+        # onto
+        self.prefix_chain = ()
+        self.prefix_digest = None     # head digest: hotspot accounting
+        self.migrate_from = None
+        self.migrate_to = None
         self.submit_t = time.perf_counter()
         self.finish_t = None
 
@@ -325,6 +346,12 @@ class ServingFleet:
             raise ValueError(
                 f"model_spec kv_dtype {self.model_spec['kv_dtype']!r} "
                 "is unknown — expected 'int8' or omit it")
+        if ((self.model_spec.get("kv_handoff")
+             or self.model_spec.get("host_tier_mb") is not None)
+                and not self.model_spec.get("paged")):
+            raise ValueError(
+                "model_spec has kv_handoff/host_tier_mb but not "
+                "paged: true — KV pages exist only on the paged engine")
         spec_mode = self.model_spec.get("spec_mode")
         if spec_mode is not None and spec_mode not in ("draft", "ngram"):
             raise ValueError(
@@ -447,6 +474,35 @@ class ServingFleet:
         # sustained-traffic router must not grow without limit
         self.done_retention = _env_int("PADDLE_FLEET_DONE_RETENTION",
                                        4096)
+        # prefix-aware routing (ISSUE 17): replicas roll their pager's
+        # chain digests into every step-stats reply; the router indexes
+        # digest -> replica and holds prefix-sharing dispatches for the
+        # chain's owner (falling back to least-loaded the moment the
+        # owner is dead, draining, or out of capacity)
+        self._spec_page_size = int(self.model_spec.get("page_size")
+                                   or 16)
+        self._hash_salt = (
+            f"quant={self.model_spec.get('quant') or 'none'}"
+            f"/kv={'int8' if self.model_spec.get('kv_dtype') == 'int8' else 'fp'}")
+        self.prefix_sticky = (
+            bool(_env_int("PADDLE_FLEET_PREFIX_STICKY", 1))
+            and bool(self.model_spec.get("paged")))
+        self._prefix_index = collections.OrderedDict()  # digest -> rid
+        self._route_counts = collections.OrderedDict()  # digest -> [n, t0]
+        # hot-prefix migration: past migrate_hot_routes sticky routes
+        # to ONE replica inside migrate_window_s, the chain is copied
+        # (extract -> park -> inject, the ISSUE-15 machinery) to a cold
+        # replica and the index repointed — stickiness never hotspots.
+        # Unified fleets only, and only when the spec opted into
+        # kv_handoff (otherwise workers never primed inject).
+        self.migrate_hot_routes = _env_int(
+            "PADDLE_FLEET_MIGRATE_HOT_ROUTES", 8)
+        self.migrate_window_s = _env_float(
+            "PADDLE_FLEET_MIGRATE_WINDOW_S", 10.0)
+        self.migrate_enabled = (
+            self.prefix_sticky and self.migrate_hot_routes > 0
+            and not self.disaggregated
+            and bool(self.model_spec.get("kv_handoff")))
 
         self._stats = _stats_family()
         # the fleet.* family is process-global; mirror every count into
@@ -594,6 +650,12 @@ class ServingFleet:
                         "and retry with backoff")
             if self.disaggregated:
                 req.phase = "prefill"     # every request prefills first
+            if self.prefix_sticky:
+                chain = [short_digest(k) for k in prompt_chain_keys(
+                    req.prompt, self._spec_page_size, self._hash_salt)]
+                chain = [d for d in chain if d]   # drop the part tail
+                req.prefix_chain = tuple(reversed(chain))
+                req.prefix_digest = chain[0] if chain else None
             self._pending[req.id] = req
             (self._ready_hi if req.priority == "interactive"
              else self._ready_lo).append(req)
@@ -925,11 +987,121 @@ class ServingFleet:
         """Role-aware capacity routing (ISSUE 15): a prefill replica
         only takes prefill-phase requests, a decode replica only
         handed-off (payload-carrying) ones; unified replicas take the
-        phase-less stream of a unified fleet."""
+        phase-less stream of a unified fleet — plus, with migration on
+        (ISSUE 17), the phased legs of a hot-prefix copy, each pinned
+        to its replica (prefill at the chain's hot owner, decode at the
+        cold target) unless that replica is gone/unhealthy/draining, in
+        which case any unified replica serves it (a dead pin must never
+        strand a request)."""
         if r.role == "unified":
-            return req.phase is None
+            if req.phase is None:
+                return True
+            pin = (req.migrate_from if req.phase == "prefill"
+                   else req.migrate_to)
+            if pin is None:
+                return False
+            if pin == r.id:
+                return True
+            t = self._replica_by_id(pin)
+            return t is None or t.state != "healthy" or t.draining
         return req.phase == ("prefill" if r.role == "prefill"
                              else "decode")
+
+    def _sticky_defers_locked(self, req, r, now):
+        """Prefix-sticky verdict for dispatching ``req`` on ``r``
+        (caller holds the lock): True -> hold the request for the
+        chain-owning replica (it has the pages — device or host tier);
+        False -> serve it HERE, counting ``prefix_routed`` when r IS
+        the owner and ``prefix_fallbacks`` when the owner exists but is
+        dead/draining/out of capacity (least-loaded wins — stickiness
+        must never add latency, only save prefill).
+
+        The chain digests are tried DEEPEST first: an exact repeat
+        matches its whole chain's sole holder (memo + pages -> fault
+        back, no re-prefill); a fresh prompt sharing only the pooled
+        prefix falls through to the shared head page's owner."""
+        target = None
+        for d in req.prefix_chain:
+            target = self._prefix_index.get(d)
+            if target is not None:
+                break
+        if target is None:
+            return False              # unknown chain: normal routing
+        if target == r.id:
+            self._inc("prefix_routed")
+            self._note_route_locked(req, r, now)
+            return False
+        t = self._replica_by_id(target)
+        if (t is None or t.state != "healthy" or t.draining
+                or t.role != r.role or self._capacity(t) <= 0):
+            self._inc("prefix_fallbacks")
+            return False
+        return True
+
+    def _note_route_locked(self, req, r, now):
+        """Hotspot bookkeeping: count sticky routes per digest inside
+        ``migrate_window_s``; past ``migrate_hot_routes`` of them, turn
+        THIS dispatch into a migration — its prefill leg pins to the
+        hot owner (prefix hits make it nearly free), the extracted
+        chain parks on the router, and the decode leg pins to the
+        coldest healthy replica, which the index now owns."""
+        if not self.migrate_enabled:
+            return
+        ent = self._route_counts.get(req.prefix_digest)
+        if ent is None or now - ent[1] > self.migrate_window_s:
+            ent = [0, now]
+        ent[0] += 1
+        self._route_counts[req.prefix_digest] = ent
+        self._route_counts.move_to_end(req.prefix_digest)
+        while len(self._route_counts) > 4096:
+            self._route_counts.popitem(last=False)
+        if ent[0] < self.migrate_hot_routes:
+            return
+        cold = None
+        for x in self._replicas:
+            if (x.id == r.id or x.state != "healthy" or x.draining
+                    or x.role != "unified"):
+                continue
+            if cold is None or self._capacity(x) > self._capacity(cold):
+                cold = x
+        if cold is None or self._capacity(cold) <= 0:
+            return                    # nowhere colder: stay sticky
+        req.phase = "prefill"
+        req.migrate_from = r.id
+        req.migrate_to = cold.id
+        self._prefix_index[req.prefix_digest] = cold.id
+        self._prefix_index.move_to_end(req.prefix_digest)
+        self._route_counts[req.prefix_digest] = [0, now]
+
+    def _update_prefix_index(self, r, stats):
+        """Fold a replica's step-stats digest sketch into the fleet
+        prefix index (digest -> replica id, bounded LRU) — the
+        router-side half of prefix-aware routing.
+
+        FIRST writer wins: once a healthy replica owns a digest, a
+        second replica advertising the same chain (two same-prefix
+        requests raced before the index warmed) does NOT steal it —
+        otherwise the index flaps between advertisers on every stats
+        reply and stickiness averages out to random.  Ownership moves
+        only when the owner stops being usable, or when hot-prefix
+        migration repoints the entry deliberately."""
+        digs = (stats or {}).get("chain_digests")
+        if not self.prefix_sticky or not digs:
+            return
+        with self._lock:
+            idx = self._prefix_index
+            for d in digs:
+                cur = idx.get(d)
+                if cur is not None and cur != r.id:
+                    owner = self._replica_by_id(cur)
+                    if owner is not None and owner.state == "healthy" \
+                            and not owner.draining:
+                        idx.move_to_end(d)
+                        continue        # sticky: owner keeps the chain
+                idx[d] = r.id
+                idx.move_to_end(d)
+            while len(idx) > 8192:
+                idx.popitem(last=False)
 
     def _pick_dispatch(self, r):
         if r.draining:
@@ -954,6 +1126,13 @@ class ServingFleet:
                     continue
                 if req.not_before > now:
                     skipped.append(req)         # still backing off
+                    continue
+                if (self.prefix_sticky and req.prefix_chain
+                        and (req.phase is None
+                             or (req.phase == "prefill"
+                                 and req.migrate_from is None))
+                        and self._sticky_defers_locked(req, r, now)):
+                    skipped.append(req)         # the chain's owner's work
                     continue
                 if req.retries:
                     self._inc("retries")
@@ -1028,6 +1207,7 @@ class ServingFleet:
                         req, f"replica {r.id} aborted mid-step: "
                              f"{resp.get('error')}")
         r.last_stats = resp.get("stats") or r.last_stats
+        self._update_prefix_index(r, r.last_stats)
 
     def _handoff(self, fin, r):
         """A prefill replica finished a request's PREFILL phase: park
@@ -1059,6 +1239,12 @@ class ServingFleet:
                  req.priority))
             self._inc("kv_handoffs")
             self._inc("kv_handoff_bytes", req.kv_bytes)
+            if req.migrate_to is not None:
+                # a hot-prefix migration's extract leg just landed: the
+                # parked pages are the chain COPY headed for the cold
+                # replica (content-hashed on inject like any handoff)
+                self._inc("prefix_migrations")
+                self._inc("migration_bytes", req.kv_bytes)
             self._ready_queue_of(req).appendleft(req)
         return True
 
@@ -1487,6 +1673,7 @@ class ServingFleet:
             healthy = sum(1 for r in reps if r.state == "healthy")
             occ = []
             accepted = []
+            spill = []
             for r in reps:
                 if r.state != "healthy":
                     continue
@@ -1495,6 +1682,12 @@ class ServingFleet:
                 occ.append(min(
                     (int(st.get("slot_occupancy") or 0)
                      + int(st.get("queue_depth") or 0)) / slots, 2.0))
+                # host-tier fill (ISSUE 17): a fleet whose tiers run
+                # full is thrashing spills — re-prefills are imminent,
+                # so the autoscaler treats it as an up-pressure signal
+                f = st.get("host_tier_fill")
+                if f is not None:
+                    spill.append(float(f))
                 # speculative replicas echo their live
                 # serving.accepted_tokens_per_step in every reply — the
                 # autoscaler normalizes backlog by it so spec fleets
@@ -1521,6 +1714,7 @@ class ServingFleet:
             "accepted_tokens_per_step": (
                 round(sum(accepted) / len(accepted), 4)
                 if accepted else 0.0),
+            "spill_pressure": max(spill) if spill else 0.0,
         }
 
     # ------------------------------------------------------------- public
@@ -1602,6 +1796,8 @@ class ServingFleet:
                 replicas_up=self.replicas_up(),
                 replicas=self.nreplicas,
                 disaggregated=self.disaggregated,
+                prefix_sticky=self.prefix_sticky,
+                prefix_index_size=len(self._prefix_index),
                 replicas_by_role={
                     role: sum(1 for r in self._replicas
                               if r.role == role and not r.draining)

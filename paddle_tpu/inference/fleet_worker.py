@@ -156,10 +156,16 @@ def _build_engine(spec, role="unified"):
             kw["prefix_cache"] = bool(spec["prefix_cache"])
         if spec.get("kv_dtype") is not None:
             kw["kv_dtype"] = str(spec["kv_dtype"])
-        if role != "unified":
+        if role != "unified" or spec.get("kv_handoff"):
             # prime the extract/inject executables at warmup — a
-            # disaggregated replica's first handoff must not compile
+            # disaggregated replica's first handoff must not compile.
+            # Unified fleets opt in via the spec (ISSUE 17 hot-prefix
+            # migration rides the same executables)
             kw["kv_handoff"] = True
+        if spec.get("host_tier_mb") is not None:
+            # host-RAM page tier (ISSUE 17): evicted device pages spill
+            # to a pinned-host LRU and fault back through inject
+            kw["host_tier_mb"] = float(spec["host_tier_mb"])
         if spec.get("spec_mode") is not None:
             # speculative decoding (ISSUE 13): the mode travels in the
             # spec so every (re)launched replica speculates identically

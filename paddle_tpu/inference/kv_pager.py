@@ -49,9 +49,66 @@ import hashlib
 
 import numpy as np
 
-__all__ = ["KVPager", "PagesExhausted", "SCRATCH_PAGE"]
+__all__ = ["KVPager", "PagesExhausted", "SCRATCH_PAGE",
+           "prompt_chain_keys", "prompt_head_digest", "short_digest"]
 
 SCRATCH_PAGE = 0
+
+# compact digest width for the fleet prefix index (ISSUE 17): 12 hex
+# chars of a 128-bit blake2b — short enough that a replica's whole
+# sketch rides every step-stats reply, long enough that accidental
+# collisions cost only a mis-routed (still correct) dispatch
+SHORT_DIGEST_LEN = 12
+
+
+def prompt_chain_keys(prompt, page_size, hash_key=""):
+    """One content key per page of ``prompt`` (module-level so the
+    ROUTER — which never imports jax or builds an engine — computes the
+    IDENTICAL keys a replica's pager does): full pages key on the
+    running chain digest (prefix-identity, not page-identity: the same
+    tokens after a different prefix are a different page); the partial
+    tail keys on the digest *plus* its token tuple.  ``hash_key`` is
+    the numeric-contract salt (quant mode / kv_dtype)."""
+    toks = np.asarray(prompt, np.int64).reshape(-1)
+    ps = int(page_size)
+    h = hashlib.blake2b(digest_size=16)
+    if hash_key:
+        h.update(str(hash_key).encode())
+    keys = []
+    for j in range(0, len(toks), ps):
+        chunk = toks[j:j + ps]
+        if len(chunk) == ps:
+            h.update(chunk.tobytes())
+            keys.append(("full", h.hexdigest()))
+        else:
+            keys.append(("part", h.hexdigest(),
+                         tuple(int(t) for t in chunk)))
+    return keys
+
+
+def short_digest(key):
+    """A content key's compact wire form for the fleet prefix index, or
+    None for partial-tail keys (only FULL pages are sticky-routable —
+    a tail's bytes change with every prompt length)."""
+    if key[0] != "full":
+        return None
+    return key[1][:SHORT_DIGEST_LEN]
+
+
+def prompt_head_digest(prompt, page_size, hash_key=""):
+    """The compact digest of ``prompt``'s FIRST full page (the sticky-
+    routing key: requests sharing their head page share their whole
+    cached prefix chain's root), or None for prompts shorter than one
+    page."""
+    toks = np.asarray(prompt, np.int64).reshape(-1)
+    ps = int(page_size)
+    if len(toks) < ps:
+        return None
+    h = hashlib.blake2b(digest_size=16)
+    if hash_key:
+        h.update(str(hash_key).encode())
+    h.update(toks[:ps].tobytes())
+    return h.hexdigest()[:SHORT_DIGEST_LEN]
 
 
 class PagesExhausted(RuntimeError):
@@ -92,8 +149,17 @@ class KVPager:
         self.ref = [0] * self.num_pages
         self.tables = [[] for _ in range(self.slots)]
         self._cache = {}                    # content key -> page id
+        self._cache_gen = 0                 # bumps on any _cache mutation
+        self._digest_sketch = (None, None)  # (gen, digests) memo
         self._page_key = {}                 # page id -> content key
         self._reclaim = collections.OrderedDict()   # ref==0, retained
+        # host-tier spill hook (ISSUE 17): the engine installs a
+        # callable(pid, key) fired when a RETAINED prefix page is
+        # evicted out of the reclaim LRU — at call time the page's
+        # device bytes are still valid (the caller overwrites them only
+        # after _alloc returns), so the engine can capture them into
+        # its host tier.  None -> evictions simply discard.
+        self.evict_hook = None
         self._pending_keys = [None] * self.slots    # deferred registration
         self._registered = [0] * self.slots         # pages registered so far
         # counters (the engine mirrors these into the serving.* family)
@@ -126,25 +192,29 @@ class KVPager:
 
     # ------------------------------------------------------------ hashing
     def _prompt_keys(self, prompt):
-        """One content key per page of ``prompt``: full pages key on the
-        running chain digest (prefix-identity, not page-identity: the
-        same tokens after a different prefix are a different page); the
-        partial tail keys on the digest *plus* its token tuple."""
-        toks = np.asarray(prompt, np.int64).reshape(-1)
-        ps = self.page_size
-        h = hashlib.blake2b(digest_size=16)
-        if self.hash_key:
-            h.update(self.hash_key.encode())
-        keys = []
-        for j in range(0, len(toks), ps):
-            chunk = toks[j:j + ps]
-            if len(chunk) == ps:
-                h.update(chunk.tobytes())
-                keys.append(("full", h.hexdigest()))
-            else:
-                keys.append(("part", h.hexdigest(),
-                             tuple(int(t) for t in chunk)))
-        return keys
+        """One content key per page of ``prompt`` — the module-level
+        :func:`prompt_chain_keys` math under this pager's salt (the
+        router mirrors it byte-for-byte for sticky routing)."""
+        return prompt_chain_keys(prompt, self.page_size, self.hash_key)
+
+    def cached_page(self, key):
+        """The physical page currently holding ``key``'s content, or
+        None — the engine's fault-back probe (device hit vs host
+        tier)."""
+        return self._cache.get(key) if self.prefix_cache else None
+
+    def chain_digests(self, limit=128):
+        """The compact digests of the FULL prompt pages this pager can
+        serve as prefix hits right now (registered, device-resident —
+        shared or retained), newest-registered last, capped at
+        ``limit``.  This is the per-replica sketch each step-stats
+        reply ships to the router's fleet prefix index."""
+        gen, memo = self._digest_sketch
+        if gen != self._cache_gen:
+            memo = [d for d in map(short_digest, self._cache)
+                    if d is not None]
+            self._digest_sketch = (self._cache_gen, memo)
+        return memo[-int(limit):]
 
     # --------------------------------------------------------- allocation
     def _alloc(self):
@@ -156,6 +226,13 @@ class KVPager:
             key = self._page_key.pop(pid, None)
             if key is not None:
                 self._cache.pop(key, None)
+                self._cache_gen += 1
+                if self.evict_hook is not None:
+                    # the page's device bytes are still intact HERE —
+                    # the caller only overwrites them after we return —
+                    # so the host-tier spill capture must be synchronous
+                    # with the eviction
+                    self.evict_hook(pid, key)
             self.evictions += 1
             return pid
         raise PagesExhausted(
@@ -220,6 +297,50 @@ class KVPager:
         self._ppr_ema = 0.75 * self._ppr_ema + 0.25 * len(taken)
         return taken, hits
 
+    def admit_pinned(self, slot, prompt):
+        """Two-pass admit for the engine's host-tier fault-back (ISSUE
+        17): acquire every device-cached page FIRST — pinning it (ref
+        >= 1) so the second pass's fresh allocations can never evict it
+        out of the reclaim LRU mid-admission — then allocate+register
+        pages for the missing keys.  Returns ``(table, hit_flags)``
+        where ``hit_flags[j]`` is True for device-shared pages and
+        False for freshly allocated ones (whose bytes the engine
+        injects from its host tier).  Rolls back on exhaustion exactly
+        like :meth:`admit`."""
+        if self.tables[slot]:
+            raise RuntimeError(f"slot {slot} already holds pages")
+        keys = self._prompt_keys(prompt)
+        table = [None] * len(keys)
+        hit_flags = [False] * len(keys)
+        taken = []
+        try:
+            for j, key in enumerate(keys):
+                pid = self._cache.get(key) if self.prefix_cache else None
+                if pid is not None:
+                    self._acquire_cached(pid)
+                    table[j] = pid
+                    hit_flags[j] = True
+                    taken.append(pid)
+            for j, key in enumerate(keys):
+                if table[j] is not None:
+                    continue
+                pid = self._alloc()
+                self.ref[pid] = 1
+                if self.prefix_cache:
+                    self._register(pid, keys[j])
+                table[j] = pid
+                taken.append(pid)
+        except PagesExhausted:
+            for pid in taken:
+                self._decref(pid)
+            raise
+        self.tables[slot] = table
+        hits = sum(hit_flags)
+        self.prefix_hits += hits
+        self.prefix_misses += len(table) - hits
+        self._ppr_ema = 0.75 * self._ppr_ema + 0.25 * len(table)
+        return table, hit_flags
+
     def _register(self, pid, key):
         old = self._cache.get(key)
         if old is not None and old != pid:
@@ -227,6 +348,7 @@ class KVPager:
             # oldest mapping (its content is just as valid)
             return
         self._cache[key] = pid
+        self._cache_gen += 1
         self._page_key[pid] = key
 
     def register_prompt(self, slot, upto_tokens):
@@ -334,6 +456,7 @@ class KVPager:
             key = self._page_key.pop(pid, None)
             if key is not None:
                 self._cache.pop(key, None)
+                self._cache_gen += 1
             self.free.append(pid)
             n += 1
         return n
@@ -361,4 +484,5 @@ class KVPager:
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
             "pages_per_request_est": self.pages_per_request_est(),
+            "chain_digest_count": len(self._cache),
         }

@@ -39,6 +39,7 @@ token-exact greedy parity, bounded prefill compiles).
 from __future__ import annotations
 
 import collections
+import hashlib
 import os
 import time
 import uuid
@@ -113,7 +114,16 @@ def _stats_family():
         # engine, injections landed on a decode engine, the bytes that
         # crossed, and the extract/inject executable acquisitions
         "kv_extracts": 0, "kv_injects": 0, "kv_handoff_bytes": 0,
-        "handoff_compiles": 0})
+        "handoff_compiles": 0,
+        # fleet-scale KV tiering family (ISSUE 17; zero without a host
+        # tier): device pages spilled into the host-RAM tier and their
+        # bytes, pages faulted BACK into the device pool on a prefix
+        # hit, no-prefill fault-back admissions, and host entries whose
+        # content-hash verification REJECTED them (corrupt bytes are
+        # dropped and the request re-prefills — never served)
+        "pages_spilled": 0, "spill_bytes": 0,
+        "pages_faulted_back": 0, "fault_backs": 0,
+        "fault_back_rejects": 0})
 
 
 def _legacy_counter(engine, key):
@@ -1041,7 +1051,9 @@ class ServingEngine:
         "prefill_chunks", "prefix_page_hits", "prefix_page_misses",
         "cow_copies", "preemptions", "quant_matmuls",
         "drafted_tokens", "accepted_tokens", "rejected_tokens",
-        "spec_steps", "kv_extracts", "kv_injects", "kv_handoff_bytes"))
+        "spec_steps", "kv_extracts", "kv_injects", "kv_handoff_bytes",
+        "pages_spilled", "spill_bytes", "pages_faulted_back",
+        "fault_backs", "fault_back_rejects"))
 
     def _count_quant_matmuls(self):
         """One model forward = 4 quantized matmuls per layer (qkv, proj,
@@ -1089,6 +1101,96 @@ class ServingEngine:
         return {"kv_bytes_reserved": int(self._cache_k.nbytes
                                          + self._cache_v.nbytes),
                 "kv_tokens_held": held}
+
+
+# --------------------------------------------------------------------------
+# host-RAM KV page tier (ISSUE 17 tentpole)
+# --------------------------------------------------------------------------
+
+class _HostKVTier:
+    """Byte-bounded LRU of spilled KV pages in host RAM — the tier
+    UNDER the device page pool.  Entries are keyed by the pager's
+    content key and stamped with a blake2b over their exact bytes
+    (salted with the engine's numeric contract): a fault-back serves an
+    entry only after re-verifying the stamp, so torn host memory can
+    never reach the device pool — the per-shard page-byte-determinism
+    invariant extends through the tier."""
+
+    def __init__(self, limit_bytes, hash_key=""):
+        self.limit = int(limit_bytes)
+        self.hash_key = str(hash_key)
+        self._ent = collections.OrderedDict()  # key -> [arrays, stamp, t]
+        self.bytes = 0
+        self.inserts = 0
+        self.lru_evictions = 0
+
+    def __len__(self):
+        return len(self._ent)
+
+    def __contains__(self, key):
+        return key in self._ent
+
+    def _stamp(self, arrays):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(self.hash_key.encode())
+        for a in arrays:
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()
+
+    def put(self, key, arrays):
+        """Insert (or refresh) a spilled page's host copy: one array
+        per pool operand, stamped NOW.  Oldest entries fall off the LRU
+        until the byte bound holds again."""
+        old = self._ent.pop(key, None)
+        if old is not None:
+            self.bytes -= sum(int(a.nbytes) for a in old[0])
+        nbytes = sum(int(a.nbytes) for a in arrays)
+        self._ent[key] = [list(arrays), self._stamp(arrays),
+                          time.perf_counter()]
+        self.bytes += nbytes
+        self.inserts += 1
+        while self.bytes > self.limit and len(self._ent) > 1:
+            _, (arrs, _stamp, _t) = self._ent.popitem(last=False)
+            self.bytes -= sum(int(a.nbytes) for a in arrs)
+            self.lru_evictions += 1
+
+    def fetch(self, key):
+        """``(arrays, age_s)`` for a hash-verified entry (refreshed to
+        LRU-newest), ``None`` when absent, or the string ``"corrupt"``
+        when present but failing verification — the entry is dropped on
+        the spot (bad KV is never served, and never re-tried)."""
+        ent = self._ent.get(key)
+        if ent is None:
+            return None
+        arrays, stamp, t = ent
+        if self._stamp(arrays) != stamp:
+            self._ent.pop(key)
+            self.bytes -= sum(int(a.nbytes) for a in arrays)
+            return "corrupt"
+        self._ent.move_to_end(key)
+        return arrays, time.perf_counter() - t
+
+    def corrupt(self, key):
+        """Testing hook (the ``host_tier_corrupt`` fault): flip one
+        byte of the stored copy AFTER its stamp was taken, so the next
+        :meth:`fetch` exercises the reject path."""
+        ent = self._ent.get(key)
+        if ent is None:
+            return
+        a = ent[0][0]
+        flat = a.view(np.uint8).reshape(-1)
+        flat[0] ^= 0xFF
+
+    def digests(self, limit=64):
+        """Compact digests of the FULL-page chains resident in the
+        tier (the host half of the replica's routing sketch)."""
+        from .kv_pager import short_digest
+        out = []
+        for key in self._ent:
+            d = short_digest(key)
+            if d is not None:
+                out.append(d)
+        return out[-int(limit):]
 
 
 # --------------------------------------------------------------------------
@@ -1148,10 +1250,31 @@ class PagedServingEngine(ServingEngine):
 
     def __init__(self, model, *, page_size=16, num_pages=None,
                  prefix_cache=True, prefill_chunk=None, kv_dtype=None,
-                 kv_handoff=False, **kw):
+                 kv_handoff=False, host_tier_mb=None, **kw):
         from .kv_pager import KVPager, PagesExhausted  # noqa: F401
         self._KVPager, self._PagesExhausted = KVPager, PagesExhausted
         self._page_size = int(page_size)
+        # host-RAM page tier (ISSUE 17): evicted prefix pages spill
+        # their bytes (hash-stamped) into a byte-bounded host LRU, and
+        # a later prefix hit on the spilled chain faults them back
+        # through the donated inject executable WITHOUT re-prefilling.
+        # 0 MB (the default) disables the tier entirely.
+        if host_tier_mb is None:
+            try:
+                host_tier_mb = float(
+                    os.environ.get("PADDLE_KV_HOST_TIER_MB", "0") or 0)
+            except ValueError:
+                host_tier_mb = 0.0
+        self._host_tier_mb = float(host_tier_mb)
+        self._host_tier = None              # built in _rebuild_cache
+        self._spill_pending = collections.deque()
+        # chain-tail digest -> first sampled token: greedy decoding is
+        # deterministic over identical params, so a memoized first
+        # token makes the no-prefill fault-back admission token-exact
+        self._first_tok_memo = collections.OrderedDict()
+        self._g_host_tier = metrics.gauge("serving.host_tier_bytes")
+        self._h_reclaim_age = metrics.histogram(
+            "serving.reclaim_hit_age_s")
         # prefill/decode disaggregation (ISSUE 15): kv_handoff=True
         # primes the page extract/inject executables at warmup — a
         # prefill-role replica finishes prefill-only requests with
@@ -1236,6 +1359,18 @@ class PagedServingEngine(ServingEngine):
             prefix_cache=self._prefix_cache_on,
             hash_key=f"quant={self.quant or 'none'}"
                      f"/kv={'int8' if self._kv_quant else 'fp'}")
+        # host-tier spill capture rides the pager's eviction hook; the
+        # tier itself SURVIVES rebuilds (its entries are content-
+        # addressed host bytes, valid independent of device state)
+        self._pager.evict_hook = self._on_page_evicted
+        # captures still pending against the OLD pool are untrusted
+        # after a rebuild (the failed dispatch may have consumed it)
+        self._spill_pending.clear()
+        if self._host_tier is None and self._host_tier_mb > 0 \
+                and self._prefix_cache_on:
+            self._host_tier = _HostKVTier(
+                int(self._host_tier_mb * (1 << 20)),
+                hash_key=self._pager.hash_key)
         if self._kv_quant:
             cache = gpt.init_paged_cache_quant(self.cfg, self._num_pages,
                                                ps, mesh=self._mesh)
@@ -1324,6 +1459,7 @@ class PagedServingEngine(ServingEngine):
         decodes to free pages.  Long prompts divert to the chunked
         path."""
         self._intake_injected()
+        self._try_fault_back()
         self._intake_chunked()
         while self._queue and self._free_slots():
             if self._chunk_eligible(self._queue[0]):
@@ -1420,6 +1556,7 @@ class PagedServingEngine(ServingEngine):
                                else None)
             self._last_tok[s] = int(first_np[r])
             self._inc("requests_admitted")
+            self._memo_first_token(req)
             if _faults.active() and not self._warming:
                 _faults.replica_kill_check(
                     request=self._counts["requests_admitted"])
@@ -1577,6 +1714,7 @@ class PagedServingEngine(ServingEngine):
         self._append_token(req, int(tok), row_np)
         self._last_tok[s] = int(tok)
         self._inc("requests_admitted")
+        self._memo_first_token(req)
         if not self._warming:
             self._h_prefill.observe(req._chunk_time)
         if _faults.active() and not self._warming:
@@ -1686,13 +1824,15 @@ class PagedServingEngine(ServingEngine):
 
         return jax.jit(extract)     # read-only: the pool is NOT donated
 
-    def _extract_slot_kv(self, slot, n_pages):
-        """The slot's first ``n_pages`` pages of every pool operand as
-        host arrays (k, v — plus scales on the int8 pool), via one
-        fixed-width gather executable."""
+    def _extract_pages_row(self, pages_row):
+        """Dispatch the fixed-width page-gather executable over an
+        explicit full-width ``pages_row`` (pads aimed at scratch) and
+        return the still-on-device output arrays — the shared primitive
+        under the disaggregation handoff AND the host-tier spill
+        capture, so both ride ONE executable and neither ever compiles
+        in steady state."""
         jnp = self._jnp
-        operands = (*self._cache_operands(),
-                    jnp.asarray(self._tables_np[slot]))
+        operands = (*self._cache_operands(), jnp.asarray(pages_row))
         if self._extract_jit is None:
             self._extract_jit = self._extract_site.get(
                 _cc.make_key("extract", mesh=self._mesh_key()),
@@ -1700,8 +1840,14 @@ class PagedServingEngine(ServingEngine):
                 stable_key=self._aot_key("extract"),
                 example_args=operands, topology=self._topology())
             self._inc("handoff_compiles")
+        return self._extract_jit(*operands)
+
+    def _extract_slot_kv(self, slot, n_pages):
+        """The slot's first ``n_pages`` pages of every pool operand as
+        host arrays (k, v — plus scales on the int8 pool), via one
+        fixed-width gather executable."""
         with timeline.span("serving.kv_extract", pages=int(n_pages)):
-            out = self._extract_jit(*operands)
+            out = self._extract_pages_row(self._tables_np[slot])
         self._inc("kv_extracts")
         # the handoff readback: these pages LEAVE the replica as wire
         # bytes by design — the disaggregation shipping path, not a
@@ -1871,9 +2017,177 @@ class PagedServingEngine(ServingEngine):
             self._last_tok[slot] = req._inject_tok
             self._inc("requests_admitted")
             self._g_queue.set(self._queued_total())
+            self._memo_first_token(req)
             if _faults.active() and not self._warming:
                 _faults.replica_kill_check(
                     request=self._counts["requests_admitted"])
+
+    # ------------------------------------------- host page tier (ISSUE 17)
+    #
+    # The tier turns device evictions into demotions: the pager's
+    # reclaim-LRU eviction hook captures the page's bytes through the
+    # SAME fixed-width extract executable the disaggregation handoff
+    # uses (one synthetic row, the pid at position 0), and the post-step
+    # drain moves them to the pinned-host LRU with a content-hash stamp.
+    # A later prompt whose page chain is fully covered by device hits
+    # plus hash-verified host entries — and whose first token is
+    # memoized (greedy decoding is deterministic, so the first token is
+    # a pure function of params and prompt) — admits through the
+    # donated inject executable WITHOUT re-prefilling.  Every moving
+    # part reuses an already-warm executable, so the zero-steady-state-
+    # compiles invariant survives the tier.
+
+    def _on_page_evicted(self, pid, key):
+        """Pager eviction hook: dispatch the page-gather NOW, while the
+        pid's bytes are still valid (the caller reuses the pid right
+        after), but keep the result on device — the host readback
+        defers to the post-step drain so a slow host copy
+        (``spill_stall``) never blocks the decode dispatch."""
+        if self._warming or self._host_tier is None:
+            return
+        row = np.zeros((self._pages_per_slot,), np.int32)  # pads->scratch
+        row[0] = pid
+        self._spill_pending.append((key, self._extract_pages_row(row)))
+
+    def _drain_spills(self):
+        """Deferred half of the spill: host readback, content-hash
+        stamp, LRU insert.  Runs from ``step()``'s finally — strictly
+        after the decode dispatch of the step that evicted."""
+        if not self._spill_pending or self._host_tier is None:
+            self._spill_pending.clear()
+            return
+        while self._spill_pending:
+            key, arrays = self._spill_pending.popleft()
+            if _faults.active() and not self._warming:
+                stall = _faults.spill_stall()
+                if stall is not None:
+                    time.sleep(stall)
+            # the page moves DOWN a tier by design — a demotion copy,
+            # not a hot-loop leak
+            # ptl: disable-next=PTL004 -- host-tier spill readback
+            host = [np.asarray(a)[:, :1].copy() for a in arrays]
+            self._host_tier.put(key, host)
+            if (_faults.active() and not self._warming
+                    and _faults.host_tier_corrupt()):
+                self._host_tier.corrupt(key)
+            self._inc("pages_spilled")
+            self._inc("spill_bytes", sum(int(h.nbytes) for h in host))
+        self._g_host_tier.set(self._host_tier.bytes)
+
+    def step(self):
+        """Base step plus the spill drain.  The drain lives HERE (not
+        ``_step_inner``, which the speculative engine overrides
+        wholesale) so every paged variant demotes evicted pages."""
+        try:
+            return super().step()
+        finally:
+            if self._spill_pending:
+                self._drain_spills()
+
+    def _memo_first_token(self, req):
+        """Record the prompt's greedy first token under its chain-tail
+        page key (already salted by quant/kv-dtype config) — the
+        admission ticket for a later no-prefill fault-back."""
+        if self._host_tier is None or self._warming or not req.tokens:
+            return
+        keys = self._pager._prompt_keys(req.prompt)
+        if not keys:
+            return
+        memo = self._first_tok_memo
+        memo[keys[-1]] = int(req.tokens[0])
+        memo.move_to_end(keys[-1])
+        while len(memo) > 8192:
+            memo.popitem(last=False)
+
+    def _try_fault_back(self):
+        """Head-of-queue fault-back admission: when the head prompt's
+        FULL page chain is covered by device prefix hits plus
+        hash-verified host-tier entries, and its first token is
+        memoized, admit through the inject executable instead of
+        re-prefilling.  Anything short of full verified coverage falls
+        through to the normal prefill paths (head-only keeps FIFO
+        order; a corrupt host entry is dropped and the prompt simply
+        re-prefills — bad KV is never served)."""
+        if (self._host_tier is None or not self._prefix_cache_on
+                or self.capture_logits):
+            return
+        while self._queue:
+            free = self._free_slots()
+            if not free:
+                return
+            req = self._queue[0]
+            keys = self._pager._prompt_keys(req.prompt)
+            if not keys or keys[-1] not in self._first_tok_memo:
+                return
+            fetched = {}
+            covered = True
+            for key in keys:
+                if self._pager.cached_page(key) is not None:
+                    continue
+                got = self._host_tier.fetch(key)
+                if got == "corrupt":
+                    self._inc("fault_back_rejects")
+                    covered = False
+                    break
+                if got is None:
+                    covered = False
+                    break
+                fetched[key] = got
+            if not covered or not fetched:
+                return      # device-only hits: the prefill wave wins
+            slot = free[0]
+            try:
+                table, hit_flags = self._pager.admit_pinned(
+                    slot, req.prompt)
+            except self._PagesExhausted:
+                return
+            # inject ONLY the missing pages (device hits already hold
+            # their bytes); fresh pids pack the row head, pads scratch
+            miss = [(i, k) for i, (k, h)
+                    in enumerate(zip(keys, hit_flags)) if not h]
+            pages_row = np.zeros((self._pages_per_slot,), np.int32)
+            cols = None
+            for j, (i, key) in enumerate(miss):
+                pages_row[j] = table[i]
+                arrays, age = fetched[key]
+                self._h_reclaim_age.observe(age)
+                if cols is None:
+                    cols = [[a] for a in arrays]
+                else:
+                    for lst, a in zip(cols, arrays):
+                        lst.append(a)
+            payload = [np.concatenate(lst, axis=1) for lst in cols]
+            self._inject_call(pages_row,
+                              self._pad_payload(payload, len(miss)))
+            self._queue.popleft()
+            req.slot = slot
+            n_pages = len(table)
+            self._tables_np[slot] = 0
+            self._tables_np[slot, :n_pages] = table
+            self._lens[slot] = len(req.prompt)
+            self._active[slot] = True
+            self._slot_req[slot] = req
+            req._admit_seq = self._next_admit_seq()
+            tok = int(self._first_tok_memo[keys[-1]])
+            self._append_token(req, tok, None)
+            self._last_tok[slot] = tok
+            # the whole chain served without prefill: every page is a
+            # prefix hit from the fleet's point of view
+            self._inc("prefix_page_hits", n_pages)
+            self._inc("pages_faulted_back", len(miss))
+            self._inc("fault_backs")
+            self._inc("kv_injects")
+            self._inc("requests_admitted")
+            self._g_queue.set(self._queued_total())
+            if not self._warming and timeline.telemetry_dir():
+                timeline.emit({"event": "kv_fault_back",
+                               "request_id": str(req.id),
+                               "pages": len(miss),
+                               "device_hits": n_pages - len(miss)})
+            if _faults.active() and not self._warming:
+                _faults.replica_kill_check(
+                    request=self._counts["requests_admitted"])
+            self._maybe_finish_prefill_only(req)
 
     def _newest_victim(self):
         """The most recently admitted in-flight request (decode-active
@@ -2022,6 +2336,12 @@ class PagedServingEngine(ServingEngine):
                            "decode_s": round(dt, 6),
                            "finished": len(finished),
                            "pages_in_use": self._pager.pages_in_use(),
+                           "pages_spilled":
+                               self._counts.get("pages_spilled", 0),
+                           "pages_faulted_back":
+                               self._counts.get("pages_faulted_back", 0),
+                           "chain_digests":
+                               self._pager.stats()["chain_digest_count"],
                            "finished_ids": [str(r.id) for r in finished]})
 
     def _build_decode(self):
@@ -2108,11 +2428,13 @@ class PagedServingEngine(ServingEngine):
                 n = self._prefill_chunk + 1      # two chunks: full + tail
                 self.submit(np.ones((n,), np.int32), 1)
                 self.run()
-            if self._handoff:
+            if self._handoff or self._host_tier is not None:
                 # prime the handoff executables so a disaggregated
                 # replica's first extraction/injection is not a compile
                 # in live traffic: a scratch-table extract and a
-                # zero-payload inject aimed at the scratch page
+                # zero-payload inject aimed at the scratch page.  A
+                # host-tier engine primes BOTH too — spills ride the
+                # extract, fault-backs ride the inject
                 if (self._extract_jit is None
                         and not _cc.artifact_ready(
                             self._aot_key("extract"),
@@ -2167,4 +2489,24 @@ class PagedServingEngine(ServingEngine):
         for k in ("prefix_page_hits", "prefix_page_misses", "cow_copies"):
             pg.pop(k)    # the engine-mirrored (warmup-quiet) counts win
         out.update(pg)
+        tier = self._host_tier
+        out["host_tier_bytes"] = int(tier.bytes) if tier else 0
+        out["host_tier_entries"] = len(tier) if tier else 0
+        out["host_tier_fill"] = (
+            round(tier.bytes / max(1, tier.limit), 4)
+            if tier else 0.0)
+        if tier:
+            self._g_host_tier.set(tier.bytes)
+        # the replica's prefix sketch for the fleet router: short
+        # digests of resident (device) and spilled (host) full-page
+        # chains, deduped, newest-biased, wire-bounded
+        digests = list(self._pager.chain_digests(limit=128))
+        if tier:
+            digests.extend(tier.digests(limit=64))
+        seen, sketch = set(), []
+        for d in reversed(digests):
+            if d not in seen:
+                seen.add(d)
+                sketch.append(d)
+        out["chain_digests"] = sketch[:160]
         return out

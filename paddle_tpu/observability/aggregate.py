@@ -200,6 +200,14 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
                     continue
                 if v:
                     faults[f"{fam}.{k}"] = v
+        # the KV host-tier family (ISSUE 17): spill/fault-back traffic
+        # is routine operation, not an incident — its own rollup, so a
+        # fleet postmortem sees the tier working (or rejecting)
+        serving_kv = {k: v for k, v in (fams.get("serving") or {}).items()
+                      if k in ("pages_spilled", "spill_bytes",
+                               "pages_faulted_back", "fault_backs",
+                               "fault_back_rejects", "host_tier_bytes")
+                      and v}
         ranks[r] = {
             "step": snap.get("step"),
             "steps": steps,
@@ -214,6 +222,7 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
                 round(snap.get("collective_wait_s", 0.0) / steps, 6)
                 if steps else None),
             "faults": faults,
+            "serving_kv": serving_kv,
         }
     report = {"generated_at": round(time.time(), 6),
               "nranks_seen": len(ranks),
@@ -387,6 +396,10 @@ def format_report(report):
             faults = ", ".join(f"{k}={n}" for k, n in
                                sorted(v["faults"].items()))
             lines.append(f"          faults: {faults}")
+        if v.get("serving_kv"):
+            kv = ", ".join(f"{k}={n}" for k, n in
+                           sorted(v["serving_kv"].items()))
+            lines.append(f"          kv tier: {kv}")
     if report.get("stragglers"):
         lines.append("  STRAGGLERS:")
         for s in report["stragglers"]:

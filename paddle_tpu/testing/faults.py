@@ -84,6 +84,18 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         behave like a plain decode step).  The regression it guards:
         rejected candidates must leave the paged KV pool's bytes (and
         int8 scales) byte-identical to a never-speculated run.
+    host_tier_corrupt:nth=1[,repeat=1]
+        flip a byte of the Nth page spilled into the host KV tier
+        AFTER its content-hash stamp was taken — torn host memory / a
+        bad DMA.  The engine's fault-back hash verification must
+        REJECT the entry (counted in serving.fault_back_rejects) and
+        fall through to a normal re-prefill; corrupted KV bytes are
+        never served.
+    spill_stall:nth=1[,seconds=0.2][,repeat=1]
+        the Nth host-tier spill copy stalls ``seconds`` (saturated host
+        memory bus / NUMA contention).  The engine must not serialize
+        the donated decode dispatch behind the copy — the stall lands
+        in the deferred spill-drain stage, decode latency stays flat.
 
 Every fault fires at most once (add ``repeat=1`` to re-arm after each
 fire); ``nth`` counts only calls whose other filters matched, so the Nth
@@ -347,6 +359,27 @@ def autoscale_flap():
         return d
     fault["_flap_up"] = not fault.get("_flap_up", False)
     return "up" if fault["_flap_up"] else "down"
+
+
+def host_tier_corrupt():
+    """Called by the paged engine once per page spilled into the host
+    KV tier (after the hash stamp); returns True when a matching
+    ``host_tier_corrupt`` fault fires — the engine must flip a stored
+    byte so the fault-back verification exercises its reject path
+    (fall back to re-prefill; never serve bad KV)."""
+    return take("host_tier_corrupt") is not None
+
+
+def spill_stall():
+    """Called by the paged engine's deferred spill-drain stage once per
+    host-tier copy; returns the injected stall seconds when a matching
+    ``spill_stall`` fault fires, else None.  The decode dispatch must
+    already have been issued — the stall pins that host copies never
+    serialize the decode step."""
+    fault = take("spill_stall")
+    if fault is None:
+        return None
+    return float(fault.get("seconds", 0.2))
 
 
 def engine_step_error(step):

@@ -441,6 +441,7 @@ def _live_worker_procs(fleet):
 
 
 class TestElasticFleet:
+    @pytest.mark.slow      # ~40s subprocess e2e; tier-1 budget
     def test_scale_down_drains_then_stops_zero_lost(self, tmp_path):
         """ISSUE 11 satellite: scale 3 -> 1 while submit() traffic is
         live.  Zero lost, token-exact parity vs an in-process reference,

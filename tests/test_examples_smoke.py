@@ -30,7 +30,9 @@ def _run(args, timeout=300):
      b"fluid py_reader async input on the TPU-native core: OK"),
     ("examples/ps_dataset_pipeline.py", [],
      b"PS-era dataset pipeline on the TPU-native core: OK"),
-    ("examples/mnist_lenet.py", ["--steps", "3"], b"test accuracy"),
+    pytest.param("examples/mnist_lenet.py", ["--steps", "3"],
+                 b"test accuracy",
+                 marks=pytest.mark.slow),   # ~14s; tier-1 budget
 ])
 def test_example_runs(script, args, expect):
     out = _run([script] + args)
@@ -38,6 +40,7 @@ def test_example_runs(script, args, expect):
     assert expect in out.stdout, out.stdout[-2000:]
 
 
+@pytest.mark.slow          # ~15s subprocess; tier-1 budget
 def test_mnist_example_loss_starts_sane():
     """Regression for the normalization bug: the first logged loss must
     be near ln(10), not in the hundreds (raw-0-255 inputs hitting a

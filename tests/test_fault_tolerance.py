@@ -737,6 +737,7 @@ class TestBootstrapRetry:
 
 # ------------------------------------------------ multi-process e2e ----
 
+@pytest.mark.slow          # ~40s subprocess e2e; tier-1 budget
 def test_multiprocess_kill_recovery(tmp_path):
     """The tentpole e2e in miniature: 2 supervised DP workers, rank 1
     killed mid-step by the fault registry, group relaunched, training

@@ -379,6 +379,7 @@ def test_tp_logit_parity():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow          # ~16s 8-device compose; tier-1 budget
 def test_composed_step_parity_2x2x2():
     """The acceptance gate: dp=2,tp=2,pp=2 TP+PP+ZeRO-2 training matches
     the single-device run to 1e-5 per-step loss, and the per-device

@@ -7,6 +7,8 @@ Everything here runs on the lax gather fallback (tier-1, CPU); the
 Pallas paged-attention kernel itself is validated in interpret mode in
 the slow class at the bottom, alongside the other kernel suites.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -128,6 +130,119 @@ class TestKVPager:
         pg.release(0)
         _, h2 = pg.admit(1, prompt)
         assert h2 == 3
+
+
+# --------------------------------------------------------------------------
+# chain digests + pinned admission (ISSUE 17 pager half, no jax)
+# --------------------------------------------------------------------------
+
+class TestChainDigestsAndPinnedAdmit:
+    def test_chain_keys_dtype_invariant(self):
+        """The router hashes Python-int lists, the engine int32 arrays —
+        both must land on the SAME chain digests."""
+        from paddle_tpu.inference.kv_pager import prompt_chain_keys
+        toks = [5, 9, 200, 3, 17, 44, 250, 1, 7, 12]
+        a = prompt_chain_keys(toks, 4, "salt")
+        b = prompt_chain_keys(np.asarray(toks, np.int32), 4, "salt")
+        c = prompt_chain_keys(np.asarray(toks, np.int64), 4, "salt")
+        assert a == b == c
+
+    def test_chain_keys_structure_and_salt(self):
+        from paddle_tpu.inference.kv_pager import (
+            SHORT_DIGEST_LEN, prompt_chain_keys, short_digest)
+        keys = prompt_chain_keys(np.arange(1, 11), 4, "s1")
+        assert [k[0] for k in keys] == ["full", "full", "part"]
+        assert keys[2][2] == (9, 10)                 # tail rides its tokens
+        digs = [short_digest(k) for k in keys]
+        assert digs[2] is None                       # part pages: no digest
+        assert all(len(d) == SHORT_DIGEST_LEN for d in digs[:2])
+        # the chain is position-dependent: same page tokens, different
+        # predecessor -> different digest
+        keys2 = prompt_chain_keys(np.r_[np.arange(5, 9), np.arange(5, 11)],
+                                  4, "s1")
+        assert short_digest(keys2[1]) != digs[1]
+        # and salted: quant/kv-dtype splits the digest space
+        assert [short_digest(k) for k in
+                prompt_chain_keys(np.arange(1, 11), 4, "s2")][:2] != digs[:2]
+
+    def test_head_digest_is_first_chain_digest(self):
+        from paddle_tpu.inference.kv_pager import (
+            prompt_chain_keys, prompt_head_digest, short_digest)
+        prompt = np.arange(40, 54)
+        head = prompt_head_digest(prompt, 4, "k")
+        assert head == short_digest(prompt_chain_keys(prompt, 4, "k")[0])
+        assert prompt_head_digest([1, 2, 3], 4, "k") is None
+
+    def test_admit_pinned_flags_and_counters(self):
+        pg = KVPager(17, 4, slots=2)
+        prompt = np.arange(1, 11)                    # 2 full + tail
+        pg.admit(0, prompt)
+        pg.release(0)                                # retained in cache
+        t, flags = pg.admit_pinned(1, prompt)
+        assert flags == [True, True, True]           # exact repeat: the
+        assert pg.prefix_hits == 3                   # tail key (tokens
+        assert len(t) == 3                           # inline) hits too
+
+    def test_admit_pinned_hits_survive_own_allocations(self):
+        """Two-pass law: the second pass's fresh allocations must not
+        reclaim the first pass's cache hits out from under the
+        admission."""
+        pg = KVPager(5, 4, slots=2)                  # 4 usable pages
+        pg.admit(0, np.arange(1, 9))                 # 2 full pages
+        pg.release(0)                                # both reclaimable
+        # same 2-page prefix + 8 new tokens: 2 hits + 2 fresh = all 4
+        t, flags = pg.admit_pinned(1, np.r_[np.arange(1, 9),
+                                            np.arange(50, 58)])
+        assert flags == [True, True, False, False]
+        assert pg.evictions == 0                     # hits were pinned
+        assert len(set(t)) == 4
+
+    def test_admit_pinned_rolls_back_pins(self):
+        pg = KVPager(4, 4, slots=2)                  # 3 usable
+        pg.admit(0, np.arange(1, 9))                 # 2 pages
+        pg.release(0)
+        free0 = pg.pages_free()
+        with pytest.raises(PagesExhausted):
+            # 2 hits + needs 2 fresh, only 1 left
+            pg.admit_pinned(1, np.arange(1, 17))
+        assert pg.pages_free() == free0              # pins decref'd
+        assert pg.tables[1] == []
+        # the hit pages are reclaimable again, not leaked as pinned
+        t, h = pg.admit(1, np.arange(1, 9))
+        assert h == 2
+
+    def test_evict_hook_fires_with_key_then_uncached(self):
+        pg = KVPager(5, 4, slots=2)
+        spilled = []
+        pg.evict_hook = lambda pid, key: spilled.append((pid, key))
+        pg.admit(0, np.arange(1, 9))
+        keys = pg._prompt_keys(np.arange(1, 9))
+        pg.release(0)
+        pg.admit(1, np.arange(100, 116))             # needs all 4 pages
+        assert [k for _, k in spilled] == keys[:2]   # LRU order, full keys
+        for _, k in spilled:
+            assert pg.cached_page(k) is None         # gone from the cache
+
+    def test_reclaim_lru_respects_refcount_sharing(self):
+        """A retained chain re-acquired by a live slot is pinned OUT of
+        the reclaim LRU: eviction must take the oldest UNREFERENCED
+        chain instead."""
+        pg = KVPager(7, 4, slots=3)                  # 6 usable
+        a = np.arange(1, 9)                          # 2 pages (oldest)
+        b = np.arange(30, 38)                        # 2 pages
+        pg.admit(0, a)
+        pg.release(0)
+        pg.admit(0, b)
+        pg.release(0)
+        t_a, h_a = pg.admit(1, a)                    # re-pin A (ref >= 1)
+        assert h_a == 2
+        pg.admit(2, np.arange(60, 70))               # 3 pages: must evict
+        ka = pg._prompt_keys(a)
+        kb = pg._prompt_keys(b)
+        assert pg.cached_page(ka[0]) == t_a[0]       # A pinned, survives
+        assert pg.cached_page(kb[0]) is None         # B (LRU) evicted
+        assert pg.chain_digests() \
+            and all(len(d) == 12 for d in pg.chain_digests())
 
 
 # --------------------------------------------------------------------------
@@ -422,6 +537,305 @@ class TestFleetPageRouting:
         r = self._R({"slots": 4, "pages_free": 0,
                      "pages_per_request_est": 2})
         assert fleet._capacity(r) == 0
+
+
+# --------------------------------------------------------------------------
+# host-RAM page tier: spill on evict, hash-verified fault-back (ISSUE 17)
+# --------------------------------------------------------------------------
+
+class TestHostTierSpillFaultBack:
+    """Evicted device pages spill to the pinned-host LRU tier; an exact
+    repeat routed back faults them in through the donated inject
+    executable — token-exact, hash-verified, ZERO re-prefill."""
+
+    @pytest.fixture(autouse=True, scope="class")
+    def _aot_cache(self, tmp_path_factory):
+        # repeat engine builds of the same config deserialize their
+        # executables instead of re-compiling (~0s vs ~4s each)
+        d = str(tmp_path_factory.mktemp("aot"))
+        old = os.environ.get("PADDLE_AOT_CACHE_DIR")
+        os.environ["PADDLE_AOT_CACHE_DIR"] = d
+        yield
+        if old is None:
+            os.environ.pop("PADDLE_AOT_CACHE_DIR", None)
+        else:
+            os.environ["PADDLE_AOT_CACHE_DIR"] = old
+
+    def _tier_engine(self, tiny_model, **kw):
+        kw.setdefault("page_size", 4)
+        kw.setdefault("num_pages", 10)               # 9 usable: tight
+        kw.setdefault("max_len", 32)
+        kw.setdefault("host_tier_mb", 4)
+        return _make_engine(tiny_model, **kw)
+
+    def _spill_then_repeat(self, tiny_model, **kw):
+        eng = self._tier_engine(tiny_model, **kw)
+        eng.warmup()
+        prompt = np.arange(1, 11, dtype=np.int32)    # 3 pages
+        r1 = eng.submit(prompt, 6)
+        eng.run()
+        # churn: unique chains force the retained pages off-device —
+        # one at a time, so nothing preempts (a preempted request's
+        # re-admission is itself a legitimate fault-back and would
+        # blur the exact counts below)
+        rng = np.random.RandomState(7)
+        for _ in range(4):
+            eng.submit(rng.randint(1, 256, 10).astype(np.int32), 4)
+            eng.run()
+        st0 = eng.stats()
+        assert st0["pages_spilled"] >= 1
+        assert st0["host_tier_entries"] >= 1
+        r2 = eng.submit(prompt, 6)
+        eng.run()
+        st1 = eng.stats()
+        return eng, r1, r2, st0, st1
+
+    def test_fault_back_token_exact_no_prefill_fp32(self, tiny_model):
+        eng, r1, r2, st0, st1 = self._spill_then_repeat(tiny_model)
+        assert st1["fault_backs"] == 1
+        assert st1["pages_faulted_back"] >= 1
+        assert st1["fault_back_rejects"] == 0
+        # THE attestation: the repeat never touched the prefill path
+        assert st1["prefill_calls"] == st0["prefill_calls"]
+        # <= 1: with warm AOT artifacts the decode step deserializes
+        # instead of compiling at all
+        assert st1["decode_compiles"] <= 1
+        want = _generate_ref(tiny_model, r2.prompt, 6)
+        assert (np.asarray(r2.tokens) == want).all()
+        assert r1.tokens == r2.tokens
+
+    def test_fault_back_token_exact_no_prefill_int8(self, tiny_model):
+        """Same laws on the int8+scale pool: BOTH per-pool operands
+        (codes and scales) round-trip the host tier byte-exactly."""
+        eng, r1, r2, st0, st1 = self._spill_then_repeat(
+            tiny_model, quant="int8", kv_dtype="int8")
+        assert st1["fault_backs"] == 1
+        assert st1["fault_back_rejects"] == 0
+        assert st1["prefill_calls"] == st0["prefill_calls"]
+        assert r1.tokens == r2.tokens                # bit-exact repeat
+
+    def test_cow_on_faulted_back_page(self, tiny_model):
+        """A faulted-back chain re-enters the prefix cache shared; a
+        second live request on the same prompt must copy-on-write the
+        tail, not scribble on the shared page."""
+        eng = self._tier_engine(tiny_model, num_pages=12)
+        eng.warmup()
+        prompt = np.arange(20, 30, dtype=np.int32)
+        eng.submit(prompt, 4)
+        eng.run()
+        rng = np.random.RandomState(11)
+        for _ in range(4):
+            eng.submit(rng.randint(1, 256, 10).astype(np.int32), 4)
+        eng.run()
+        assert eng.stats()["pages_spilled"] >= 1
+        cow0 = eng.stats()["cow_copies"]
+        ra = eng.submit(prompt, 6)                   # faults back
+        rb = eng.submit(prompt, 6)                   # shares the chain
+        eng.run()
+        st = eng.stats()
+        assert st["fault_backs"] >= 1
+        assert st["cow_copies"] > cow0
+        want = _generate_ref(tiny_model, prompt, 6)
+        assert (np.asarray(ra.tokens) == want).all()
+        assert (np.asarray(rb.tokens) == want).all()
+
+    def test_host_tier_corrupt_rejected_never_served(self, tiny_model):
+        """Injected bit-flip in a spilled entry: the content stamp must
+        reject it (counted), the request re-prefills, and the answer
+        stays token-exact — bad KV is never served."""
+        from paddle_tpu.testing import faults
+        faults.clear()
+        faults.install("host_tier_corrupt:nth=1")
+        try:
+            eng, r1, r2, st0, st1 = self._spill_then_repeat(tiny_model)
+            assert st1["fault_back_rejects"] >= 1
+            assert st1["fault_backs"] == 0           # admission refused
+            assert st1["prefill_calls"] > st0["prefill_calls"]
+            want = _generate_ref(tiny_model, r2.prompt, 6)
+            assert (np.asarray(r2.tokens) == want).all()
+        finally:
+            faults.clear()
+
+    def test_spill_stall_does_not_block_decode(self, tiny_model):
+        """A stalled host readback (injected sleep in the drain) may
+        only delay the spill copy — the decode compute of the step that
+        evicted must still advance its in-flight requests."""
+        import time as _time
+
+        from paddle_tpu.testing import faults
+        eng = self._tier_engine(tiny_model, num_pages=10)
+        eng.warmup()
+        done_first = eng.submit(np.arange(1, 11, dtype=np.int32), 4)
+        eng.run()                                    # chain retained
+        bg = eng.submit(np.arange(100, 110, dtype=np.int32), 12)
+        eng.step()                                   # bg decoding
+        faults.clear()
+        faults.install("spill_stall:nth=1,seconds=0.25")
+        try:
+            # this admission must evict the retained chain -> spill
+            eng.submit(np.arange(200, 210, dtype=np.int32), 4)
+            n0 = len(bg.tokens)
+            t0 = _time.perf_counter()
+            eng.step()
+            dt = _time.perf_counter() - t0
+            assert len(bg.tokens) > n0               # decode advanced
+            assert dt >= 0.2                         # the stall really hit
+            st = eng.stats()
+            assert st["pages_spilled"] >= 1
+            eng.run()
+            want = _generate_ref(tiny_model, bg.prompt, 12)
+            assert (np.asarray(bg.tokens) == want).all()
+            assert done_first.done
+        finally:
+            faults.clear()
+
+
+# --------------------------------------------------------------------------
+# prefix-sticky routing laws (router side, FakeFleet — no processes)
+# --------------------------------------------------------------------------
+
+class TestPrefixStickyRouting:
+    def _stub(self, migrate_hot_routes=3):
+        import collections
+        import threading
+
+        from paddle_tpu.inference.fleet import ServingFleet, _stats_family
+        fleet = ServingFleet.__new__(ServingFleet)
+        fleet._slots = 4
+        fleet.dispatch_queue_depth = 4
+        fleet._lock = threading.RLock()
+        fleet.prefix_sticky = True
+        fleet._prefix_index = collections.OrderedDict()
+        fleet._route_counts = collections.OrderedDict()
+        fleet._stats = _stats_family()
+        fleet._counts = {}
+        fleet.migrate_enabled = True
+        fleet.migrate_hot_routes = migrate_hot_routes
+        fleet.migrate_window_s = 10.0
+        fleet._replicas = []
+        return fleet
+
+    class _R:
+        def __init__(self, rid, role="unified", state="healthy",
+                     draining=False, stats=None, inflight=0):
+            self.id = rid
+            self.role = role
+            self.state = state
+            self.draining = draining
+            self.last_stats = stats if stats is not None else {"slots": 4}
+            self.inflight = dict.fromkeys(range(inflight))
+
+    class _Req:
+        def __init__(self, chain, phase=None):
+            self.prefix_chain = tuple(chain)
+            self.prefix_digest = chain[-1] if chain else None
+            self.phase = phase
+            self.migrate_from = None
+            self.migrate_to = None
+            self.kv_bytes = 0
+
+    def test_deepest_digest_wins(self):
+        """An exact repeat matches its deep digest's sole holder even
+        when another replica owns the shared head page."""
+        fleet = self._stub()
+        r1, r2 = self._R(1), self._R(2)
+        fleet._replicas = [r1, r2]
+        fleet._prefix_index["head"] = 1
+        fleet._prefix_index["deep"] = 2
+        req = self._Req(("deep", "head"))             # deepest first
+        assert fleet._sticky_defers_locked(req, r1, 0.0)   # held for r2
+        assert not fleet._sticky_defers_locked(req, r2, 0.0)
+        assert fleet._counts.get("prefix_routed") == 1
+        # a fresh prompt sharing only the head page sticks to r1
+        fresh = self._Req(("other", "head"))
+        assert not fleet._sticky_defers_locked(fresh, r1, 0.0)
+        assert fleet._counts.get("prefix_routed") == 2
+
+    def test_unknown_chain_routes_least_loaded(self):
+        fleet = self._stub()
+        r1 = self._R(1)
+        fleet._replicas = [r1]
+        assert not fleet._sticky_defers_locked(
+            self._Req(("nobody",)), r1, 0.0)
+        assert not fleet._counts                      # no verdict counted
+
+    def test_fallback_when_owner_unusable(self):
+        """Dead, draining, cross-pool, or full owners never hold a
+        request hostage: least-loaded wins, counted as a fallback."""
+        fleet = self._stub()
+        r1 = self._R(1)
+        for owner in (self._R(2, state="dead"),
+                      self._R(2, draining=True),
+                      self._R(2, role="decode"),
+                      self._R(2, stats={"slots": 4, "pages_free": 0,
+                                        "pages_per_request_est": 2})):
+            fleet._replicas = [r1, owner]
+            fleet._prefix_index.clear()
+            fleet._prefix_index["d"] = 2
+            assert not fleet._sticky_defers_locked(
+                self._Req(("d",)), r1, 0.0)
+        assert fleet._counts["prefix_fallbacks"] == 4
+
+    def test_first_writer_keeps_digest_while_healthy(self):
+        fleet = self._stub()
+        r1, r2 = self._R(1), self._R(2)
+        fleet._replicas = [r1, r2]
+        fleet._update_prefix_index(r1, {"chain_digests": ["d"]})
+        fleet._update_prefix_index(r2, {"chain_digests": ["d"]})
+        assert fleet._prefix_index["d"] == 1          # no flapping
+        r1.state = "dead"
+        fleet._update_prefix_index(r2, {"chain_digests": ["d"]})
+        assert fleet._prefix_index["d"] == 2          # dead owner yields
+
+    def test_prefix_index_bounded(self):
+        fleet = self._stub()
+        r1 = self._R(1)
+        fleet._replicas = [r1]
+        fleet._update_prefix_index(
+            r1, {"chain_digests": [f"d{i}" for i in range(9000)]})
+        assert len(fleet._prefix_index) == 8192
+        assert "d0" not in fleet._prefix_index        # oldest evicted
+
+    def test_hot_route_migration_triggers_and_repoints(self):
+        """Past migrate_hot_routes sticky routes inside the window, the
+        next dispatch becomes a migration: prefill pinned to the hot
+        owner, decode pinned to the coldest replica, which now owns the
+        digest."""
+        fleet = self._stub(migrate_hot_routes=3)
+        hot = self._R(1, inflight=3)
+        cold = self._R(2)
+        fleet._replicas = [hot, cold]
+        fleet._prefix_index["d"] = 1
+        reqs = [self._Req(("d",)) for _ in range(3)]
+        for q in reqs:
+            assert not fleet._sticky_defers_locked(q, hot, 1.0)
+        assert reqs[0].migrate_to is None             # below threshold
+        assert reqs[2].phase == "prefill"             # the hot one
+        assert reqs[2].migrate_from == 1
+        assert reqs[2].migrate_to == 2
+        assert fleet._prefix_index["d"] == 2          # index repointed
+        # the phased legs pin to their replicas
+        assert fleet._phase_ok(reqs[2], hot)
+        assert not fleet._phase_ok(reqs[2], cold)
+        reqs[2].phase = "decode"
+        assert fleet._phase_ok(reqs[2], cold)
+        assert not fleet._phase_ok(reqs[2], hot)
+        # a dead pin never strands the request
+        cold.state = "dead"
+        assert fleet._phase_ok(reqs[2], hot)
+
+    def test_migration_needs_cold_capacity(self):
+        fleet = self._stub(migrate_hot_routes=2)
+        hot = self._R(1)
+        full = self._R(2, stats={"slots": 4, "pages_free": 0,
+                                 "pages_per_request_est": 2})
+        fleet._replicas = [hot, full]
+        fleet._prefix_index["d"] = 1
+        reqs = [self._Req(("d",)) for _ in range(3)]
+        for q in reqs:
+            fleet._sticky_defers_locked(q, hot, 1.0)
+        assert all(q.migrate_to is None for q in reqs)
+        assert fleet._prefix_index["d"] == 1          # stays sticky
 
 
 # --------------------------------------------------------------------------

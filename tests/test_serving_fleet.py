@@ -389,6 +389,7 @@ class TestFleet:
         finally:
             fleet.close()
 
+    @pytest.mark.slow      # ~25s subprocess e2e; tier-1 budget
     def test_replica_sigkill_requeues_with_token_parity(self, tmp_path):
         """The tentpole invariant, in-tree: SIGKILL a replica holding
         in-flight requests; nothing is lost, the re-queued requests'

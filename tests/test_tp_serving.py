@@ -66,6 +66,8 @@ class TestTPEngine:
         assert len(shards) == 2
         assert shards[0].data.shape[3] == tiny_model[1].num_heads // 2
 
+    @pytest.mark.slow      # ~18s; tier-1 budget (per-shard bytes
+                           # + handoff roundtrip keep tp covered)
     def test_parity_churn_and_chunked(self, tiny_model):
         from paddle_tpu.observability import metrics as obs
         eng = _tp_engine(tiny_model, prefill_chunk=16)
@@ -499,6 +501,7 @@ class TestDisaggFleetE2E:
     """Subprocess fleet e2e: 1 prefill + 1 decode replica, the
     handoff_drop fault forcing a re-ship — zero lost, token parity."""
 
+    @pytest.mark.slow      # ~20s subprocess e2e; tier-1 budget
     def test_handoff_drop_reships_zero_lost(self, tmp_path):
         import jax
         import jax.numpy as jnp

@@ -33,13 +33,15 @@ def test_lenet_forward_backward():
 
 
 @pytest.mark.parametrize("ctor,num_classes", [
-    (resnet18, 10), (MobileNetV1, 7), (MobileNetV2, 5)])
+    (resnet18, 10), (MobileNetV1, 7),
+    pytest.param(MobileNetV2, 5, marks=pytest.mark.slow)])
 def test_small_backbones_forward(ctor, num_classes):
     net = ctor(num_classes=num_classes)
     out = net(_imgs())
     assert tuple(out.shape) == (2, num_classes)
 
 
+@pytest.mark.slow
 def test_resnet50_and_vgg_forward():
     out = resnet50(num_classes=4)(_imgs())
     assert tuple(out.shape) == (2, 4)
@@ -47,6 +49,7 @@ def test_resnet50_and_vgg_forward():
     assert tuple(out.shape) == (2, 3)
 
 
+@pytest.mark.slow          # ~16s resnet18 train; tier-1 budget
 def test_resnet_train_step_updates_bn_stats():
     net = resnet18(num_classes=10)
     net.train()

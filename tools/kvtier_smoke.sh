@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Fleet-scale KV smoke (ISSUE 17, ~30s CPU): run the bench's kvtier
+# phase — 2 unified replicas with tight device pools + a host-RAM page
+# tier vs a single giant replica on identical shared-prefix traffic —
+# and grep the attestations that make the feature real:
+#   - the fleet_prefix_hit_rate JSON metric line parses
+#   - ratio_vs_giant <= ratio_bound    (sticky routing keeps hit-rate)
+#   - pages_spilled >= 1               (device pages really spilled)
+#   - fault_backs >= 1, rejects == 0   (hash-verified fault-backs,
+#                                       no re-prefill, no bad KV)
+#   - "sticky routing held" / "spilled to the host tier" /
+#     "hash-verified fault-backs" / "zero steady-state compiles"
+# Budget: 120s.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+WORK=$(mktemp -d "${TMPDIR:-/tmp}/paddle_tpu_kvtier_smoke.XXXXXX")
+trap 'rm -rf "$WORK"' EXIT
+LOG="$WORK/smoke.log"
+
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    BENCH_FLEET_PHASES=kvtier \
+    python -u bench.py --fleet --cpu-mesh 1 >"$LOG" 2>&1
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    cat "$LOG" >&2
+    echo "FAIL: kvtier phase exited rc=$rc" >&2
+    exit 1
+fi
+cat "$LOG"
+
+grep -q '"metric": "fleet_prefix_hit_rate"' "$LOG" \
+    || { echo "FAIL: no fleet_prefix_hit_rate metric line" >&2; exit 1; }
+python - "$LOG" <<'PY' || exit 1
+import json
+import sys
+
+rec = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if cand.get("metric") == "fleet_prefix_hit_rate":
+            rec = cand
+if rec is None:
+    print("FAIL: metric line did not parse", file=sys.stderr)
+    raise SystemExit(1)
+assert rec["ratio_vs_giant"] <= rec["ratio_bound"], rec
+assert rec["pages_spilled"] >= 1, rec
+assert rec["fault_backs"] >= 1, rec
+assert rec["pages_faulted_back"] >= 1, rec
+assert rec["fault_back_rejects"] == 0, rec
+assert rec["prefix_routed"] >= 1, rec
+assert rec["lost_requests"] == 0, rec
+print(f"parsed: hit-rate {rec['value']} "
+      f"({rec['ratio_vs_giant']}x giant, bound {rec['ratio_bound']}x), "
+      f"{rec['pages_spilled']} spilled, {rec['fault_backs']} "
+      f"fault-backs, 0 rejects, 0 lost")
+PY
+grep -q "sticky routing held" "$LOG" \
+    || { echo "FAIL: no sticky-routing attestation" >&2; exit 1; }
+grep -q "pages spilled to the host tier" "$LOG" \
+    || { echo "FAIL: no spill attestation" >&2; exit 1; }
+grep -q "hash-verified fault-backs" "$LOG" \
+    || { echo "FAIL: no fault-back attestation" >&2; exit 1; }
+grep -q "zero steady-state compiles per replica" "$LOG" \
+    || { echo "FAIL: no steady-compile attestation" >&2; exit 1; }
+echo "OK: fleet-scale KV — sticky routing held prefix hit-rate," \
+     "pages spilled to host and hash-verified back, zero re-prefills," \
+     "zero steady-state compiles"

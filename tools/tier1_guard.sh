@@ -43,6 +43,16 @@ if ! tools/disagg_smoke.sh; then
     exit 1
 fi
 
+# fleet-scale KV smoke (~30s): 2 replicas + host tier vs 1 giant on
+# shared-prefix traffic — sticky routing holds the hit-rate, pages
+# spill and hash-verify back with zero re-prefills, zero steady-state
+# compiles — the ISSUE-17 fleet contract
+if ! tools/kvtier_smoke.sh; then
+    echo "tier1_guard: FAIL — fleet-scale KV smoke" \
+         "(tools/kvtier_smoke.sh; see above)" >&2
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
