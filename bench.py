@@ -1812,12 +1812,24 @@ def fleet_bench():
       arrivals (skippable via BENCH_AS_STATIC=0 for the smoke budget).
       Emits the fleet_autoscale_goodput_tps JSON metric line.
 
+    * ``routerchaos`` — control-plane fault tolerance (ISSUE 18): a
+      journaled disaggregated fleet runs under the supervised router
+      (``fleet_supervisor.py``); the router is SIGKILLed mid-traffic
+      with in-flight AND parked-handoff work, relaunched against the
+      same journal, and re-adopts the surviving workers.  Asserts zero
+      admitted requests lost, token-exact parity vs an unkilled run,
+      worker pids UNCHANGED (re-adoption, not replica restarts), zero
+      XLA compiles during re-adoption, and journal write overhead
+      within BENCH_RC_MIN_RATIO of the unjournaled tokens/s.  Emits
+      fleet_router_recovery_s + fleet_journal_overhead JSON metrics.
+
     Replicas are clean re-execed CPU-backend interpreters (same dance as
     --faults), so this runs under the orchestrator or standalone —
     ``--cpu-mesh N`` recommended off-TPU.  Knobs: BENCH_FLEET_REPLICAS
     (default 2), BENCH_FLEET_REQUESTS (default 24), BENCH_FLEET_TOKENS
     (default 48), BENCH_AS_{MIN,MAX,RATE,DURATION_S,SLO_S,COOLDOWN_S,
-    MAX_PENDING,STATIC}."""
+    MAX_PENDING,STATIC}, BENCH_RC_{REQUESTS,TOKENS,OVERHEAD,
+    MIN_RATIO}."""
     import shutil
     import tempfile
 
@@ -1832,7 +1844,7 @@ def fleet_bench():
     env.pop("PADDLE_AOT_CACHE_DIR", None)
     phases = [p.strip() for p in os.environ.get(
         "BENCH_FLEET_PHASES",
-        "chaos,autoscale,aot,disagg,kvtier").split(",")
+        "chaos,autoscale,aot,disagg,kvtier,routerchaos").split(",")
         if p.strip()]
     try:
         if "chaos" in phases:
@@ -1845,6 +1857,8 @@ def fleet_bench():
             _fleet_disagg_phase(work, env)
         if "kvtier" in phases:
             _fleet_kvtier_phase(work, env)
+        if "routerchaos" in phases:
+            _fleet_routerchaos_phase(work, env)
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
@@ -2681,6 +2695,232 @@ def _fleet_kvtier_phase(work, env):
           f"token-exact on {len(joint)} requests; decode_compiles==1 "
           "and zero steady-state compiles per replica, 0 lost",
           file=sys.stderr)
+
+
+def _fleet_routerchaos_phase(work, env):
+    """ISSUE 18: the router is as killable as any replica.  Three runs
+    over identical traffic on a 1-prefill + 1-decode journaled fleet:
+
+    * *ref* — in-process, ``journal_dir=None``: reference tokens +
+      baseline tokens/s.
+    * *journal* — in-process, journal ON: token parity + write
+      overhead (BENCH_RC_OVERHEAD=0 skips it — the smoke's budget).
+    * *chaos* — the supervised router (``fleet_supervisor``) serving
+      the same traffic through a :class:`FleetClient`; SIGKILLed the
+      moment it holds in-flight work AND at least one KV handoff has
+      crossed it, then relaunched by the supervisor against the same
+      journal.  The surviving workers are re-adopted: zero admitted
+      requests lost, token-exact vs ref, worker pids unchanged, zero
+      replica restarts, per-worker cumulative compile counts unchanged
+      across the kill (no XLA compiles during re-adoption)."""
+    import signal as _signal
+    import socket as _socket
+    import threading
+
+    import numpy as np
+    from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.inference.fleet_supervisor import (FleetClient,
+                                                       supervise_router)
+
+    n_requests = int(os.environ.get("BENCH_RC_REQUESTS", 16))
+    gen_tokens = int(os.environ.get("BENCH_RC_TOKENS", 24))
+    run_overhead = os.environ.get("BENCH_RC_OVERHEAD", "1") != "0"
+    # a hard 0.95 gate would fail on box-speed weather, not on a real
+    # regression — loose CI backstop, measured value reported
+    min_ratio = float(os.environ.get("BENCH_RC_MIN_RATIO", 0.6))
+
+    spec = {"cfg": {"vocab_size": 256, "hidden_size": 32,
+                    "num_layers": 2, "num_heads": 2, "max_seq_len": 64,
+                    "dtype": "float32", "use_flash": False,
+                    "remat": False},
+            "seed": 0, "paged": True, "slots": 2,
+            "max_len": 8 + gen_tokens + 8, "page_size": 8,
+            "seq_buckets": [8], "batch_buckets": [1]}
+    roles = ["prefill", "decode"]
+    rng = np.random.RandomState(31)
+    prompts = [rng.randint(1, 256, int(rng.randint(4, 8)))
+               for _ in range(n_requests)]
+    reqs = [{"id": f"rc{i}", "prompt": [int(t) for t in p],
+             "max_new_tokens": gen_tokens} for i, p in
+            enumerate(prompts)]
+    cache = os.path.join(work, "rc_jit")
+
+    def run_inproc(tag, journal_dir):
+        fleet = ServingFleet(
+            spec, roles=roles, env_base=env, jit_cache_dir=cache,
+            journal_dir=journal_dir,
+            log_dir=os.path.join(work, tag, "logs"),
+            heartbeat_s=30, restart_backoff_s=0.2)
+        try:
+            assert fleet.await_healthy(timeout=180) == 2
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                fleet.submit(p, gen_tokens, request_id=f"rc{i}")
+            done, failed = fleet.drain(timeout=240)
+            wall = time.perf_counter() - t0
+            assert not failed and len(done) == n_requests, (
+                tag, len(done), failed)
+            st = fleet.stats()
+            assert st["kv_handoffs"] > 0, (tag, st)
+        finally:
+            fleet.close()
+        toks = {rid: [int(t) for t in r.tokens]
+                for rid, r in done.items()}
+        tps = sum(len(t) for t in toks.values()) / max(wall, 1e-9)
+        return toks, tps
+
+    # ---- ref: journal off (also warms the shared jit cache) ----
+    ref_tokens, ref_tps = run_inproc("rc_ref", None)
+
+    # ---- journal on: parity + write overhead ----
+    overhead = None
+    if run_overhead:
+        j_tokens, j_tps = run_inproc(
+            "rc_journal", os.path.join(work, "rc_journal_wal"))
+        assert j_tokens == ref_tokens, \
+            "journaling changed decode output — it must be pure WAL"
+        overhead = {"ref_tps": round(ref_tps, 2),
+                    "journal_tps": round(j_tps, 2),
+                    "ratio": round(j_tps / max(ref_tps, 1e-9), 4)}
+        assert overhead["ratio"] >= min_ratio, (
+            f"journal write overhead past the CI backstop: {overhead} "
+            f"(min ratio {min_ratio})")
+
+    # ---- chaos: supervised router, SIGKILL mid-traffic ----
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    control_port = probe.getsockname()[1]
+    probe.close()
+    renv = dict(env)
+    renv.update(
+        PADDLE_FLEET_MODEL=json.dumps(spec),
+        PADDLE_FLEET_ROLES=json.dumps(roles),
+        PADDLE_FLEET_CONTROL_PORT=str(control_port),
+        PADDLE_FLEET_JOURNAL_DIR=os.path.join(work, "rc_wal"),
+        PADDLE_FLEET_LOG_DIR=os.path.join(work, "rc_chaos", "logs"),
+        PADDLE_JIT_CACHE_DIR=cache,
+        PADDLE_FLEET_HEARTBEAT_S="30")
+    stop_sup = threading.Event()
+    sup_out = {}
+
+    def sup():
+        try:
+            sup_out["incidents"] = supervise_router(
+                renv, backoff=0.3,
+                log_dir=os.path.join(work, "rc_chaos"),
+                stop_event=stop_sup)
+        except Exception as e:                             # noqa: BLE001
+            sup_out["error"] = f"{type(e).__name__}: {e}"
+    sup_th = threading.Thread(target=sup, daemon=True)
+    sup_th.start()
+    client = FleetClient(control_port, retry_window_s=180.0)
+    try:
+        head, tail = reqs[: n_requests // 2], reqs[n_requests // 2:]
+        t0 = time.perf_counter()
+        resp = client.submit(head)
+        assert not resp["rejected"], resp
+        pid0 = client.poll()["pid"]
+        # kill only once the router really holds the state the journal
+        # must reconstruct: in-flight work and >= 1 crossed handoff
+        killed_at = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            p = client.poll()
+            stc = p["stats"]
+            comp = {str(k): v
+                    for k, v in p["replica_compiles"].items()}
+            # every replica must have REPORTED a compile count before
+            # the kill — a None baseline can't attest 0 readopt compiles
+            if p["pending"] > 0 and stc.get("kv_handoffs", 0) >= 1 \
+                    and all(v is not None for v in comp.values()):
+                killed_at = {"pending": p["pending"],
+                             "kv_handoffs": stc["kv_handoffs"]}
+                pids_before = {str(k): v
+                               for k, v in p["replica_pids"].items()}
+                compiles_before = comp
+                break
+            time.sleep(0.02)
+        assert killed_at, "router never held in-flight+handoff state"
+        os.kill(pid0, _signal.SIGKILL)
+        # the client rides through the death: the rest of the traffic
+        # and every poll retry until the relaunched generation answers
+        resp = client.submit(tail)
+        assert not resp["rejected"], resp
+        n_done = 0
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            p = client.poll()
+            n_done = len(p["done"]) + len(p["failed"])
+            if p["pending"] == 0 and n_done >= n_requests:
+                break
+            time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        st = p["stats"]
+        pid1 = p["pid"]
+        assert pid1 != pid0, "router was never actually replaced"
+        # ---- the certification ----
+        assert not p["failed"], f"requests LOST across the router " \
+                                f"death: {p['failed']}"
+        assert len(p["done"]) == n_requests, (len(p["done"]),
+                                              n_requests)
+        mismatch = [r["id"] for r in reqs
+                    if p["done"][r["id"]]["tokens"]
+                    != ref_tokens[r["id"]]]
+        assert not mismatch, (
+            f"token parity lost across router death: {mismatch}")
+        pids_after = {str(k): v for k, v in p["replica_pids"].items()}
+        assert pids_after == pids_before, (
+            f"worker pids changed — replicas restarted instead of "
+            f"re-adopted: {pids_before} -> {pids_after}")
+        assert st.get("replica_restarts", 0) == 0, st
+        assert st["readopts"] == len(roles), st
+        compiles_after = {str(k): v
+                          for k, v in p["replica_compiles"].items()}
+        assert compiles_after == compiles_before, (
+            f"XLA compiles during re-adoption: {compiles_before} -> "
+            f"{compiles_after}")
+        rec_s = st.get("router_recovery_s")
+        assert rec_s is not None, \
+            "fleet_router_recovery_s never stamped"
+    finally:
+        try:
+            client.shutdown()
+        except Exception:                                  # noqa: BLE001
+            pass
+        stop_sup.set()
+        sup_th.join(timeout=30)
+    assert "error" not in sup_out, sup_out
+    assert len(sup_out.get("incidents") or []) == 1, sup_out
+
+    print(json.dumps({
+        "metric": "fleet_router_recovery_s",
+        "value": round(rec_s, 3),
+        "unit": "s",
+        "requests": n_requests,
+        "lost_requests": 0,
+        "killed_at": killed_at,
+        "router_pids": [pid0, pid1],
+        "readopts": st["readopts"],
+        "readopt_events": st["readopt_events"],
+        "recovery_requeues": st.get("recovery_requeues", 0),
+        "recovery_rehandoffs": st.get("recovery_rehandoffs", 0),
+        "replica_restarts": 0,
+        "journal_size_bytes": st.get("journal_size_bytes"),
+        "wall_s": round(wall, 2),
+        "journal_overhead": overhead,
+    }), flush=True)
+    if overhead:
+        print(json.dumps({
+            "metric": "fleet_journal_overhead",
+            "value": overhead["ratio"], "unit": "ratio",
+            **overhead}), flush=True)
+    print(f"# routerchaos: router pid {pid0} SIGKILLed holding "
+          f"{killed_at['pending']} in-flight "
+          f"({killed_at['kv_handoffs']} handoffs crossed) -> "
+          f"relaunched as pid {pid1}, {st['readopts']} workers "
+          f"re-adopted (pids unchanged, 0 compiles), "
+          f"{n_requests} requests, 0 lost, token-exact, "
+          f"recovery {rec_s:.2f}s", file=sys.stderr)
 
 
 # --------------------------------------------------------------------------

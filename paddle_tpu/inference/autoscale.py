@@ -75,6 +75,7 @@ def _stats_family():
     return metrics.stats_family("autoscale", {
         "ticks": 0, "scale_ups": 0, "scale_downs": 0,
         "holds_cooldown": 0, "holds_bounds": 0, "tick_errors": 0,
+        "ticks_quiescent": 0,
         "flap_forced": 0, "up_signals_p99": 0, "up_signals_backlog": 0,
         "up_signals_pending": 0, "up_signals_occupancy": 0,
         "up_signals_spill": 0})
@@ -176,10 +177,32 @@ class Autoscaler:
     def _tick_inner(self, now):
         # role=None stays a positional-only call (test fakes and older
         # fleet stand-ins don't know the kwarg)
-        sig = (self.fleet.autoscale_signals(self.window_s)
-               if self.role is None
-               else self.fleet.autoscale_signals(self.window_s,
-                                                 role=self.role))
+        try:
+            sig = (self.fleet.autoscale_signals(self.window_s)
+                   if self.role is None
+                   else self.fleet.autoscale_signals(self.window_s,
+                                                     role=self.role))
+        except Exception as e:                             # noqa: BLE001
+            # a router generation swap mid-tick (ISSUE 18): the fleet
+            # object is being torn down / replayed under us.  That is
+            # scheduled maintenance, not a control-law failure — hold
+            # quiescently (ticks_quiescent, NOT tick_errors) and let
+            # the next tick read the new generation's signals
+            self._inc("ticks_quiescent")
+            self._up_streak = self._down_streak = 0
+            timeline.emit({"event": "autoscale_quiescent",
+                           "error": f"{type(e).__name__}: {e}"})
+            return None
+        if sig.get("recovering"):
+            # the new router generation is still re-adopting workers:
+            # its backlog/occupancy snapshot is deliberately zeroed
+            # (fleet.autoscale_signals), so acting on it would
+            # scale-down a busy fleet.  Hold, reset streaks — stale
+            # pre-crash streaks must not carry a decision across a
+            # recovery window
+            self._inc("ticks_quiescent")
+            self._up_streak = self._down_streak = 0
+            return None
         target = sig["configured"]
         self._g_target.set(target)
 

@@ -80,6 +80,9 @@ from ..observability import metrics, timeline
 # pure numpy/hashlib helpers (kv_pager never imports jax): the router
 # computes the IDENTICAL sticky-routing digest a replica's pager does
 from .kv_pager import prompt_chain_keys, short_digest
+# write-ahead request journal (ISSUE 18): stdlib-only, like everything
+# else the router imports
+from . import journal as _journal
 
 # spelled out through importlib: paddle_tpu.distributed exports a
 # launch() FUNCTION that shadows the submodule attribute
@@ -179,7 +182,15 @@ def _stats_family():
         # were unusable (dead/draining/full -> least-loaded fallback),
         # and hot chains copied to a cold replica via the handoff path
         "prefix_routed": 0, "prefix_fallbacks": 0,
-        "prefix_migrations": 0, "migration_bytes": 0})
+        "prefix_migrations": 0, "migration_bytes": 0,
+        # router crash-restart (ISSUE 18): workers re-adopted by a
+        # restarted router, journaled requests re-queued at replay,
+        # parked handoffs lost with the old router's memory (recovery
+        # re-prefills them via the PR-17 fault-back path), and ids
+        # that could NOT be recovered (failed named router_recovery)
+        "readopts": 0, "router_recoveries": 0,
+        "recovery_requeues": 0, "recovery_rehandoffs": 0,
+        "recovery_failures": 0})
 
 
 def fleet_stats():
@@ -240,6 +251,10 @@ class FleetRequest:
         self.migrate_from = None
         self.migrate_to = None
         self.submit_t = time.perf_counter()
+        # wall-clock admission stamp: journaled, so a request replayed
+        # by a RESTARTED router keeps its original deadline budget
+        # (perf_counter timelines don't survive the process)
+        self.admit_wall = time.time()
         self.finish_t = None
 
     def latency(self):
@@ -262,6 +277,52 @@ class FleetRequest:
             > self.deadline_s
 
 
+def _pid_alive(pid):
+    """Signal-0 liveness probe for an ADOPTED worker pid (a process the
+    previous router generation spawned; this router holds no waitable
+    handle for it)."""
+    if not pid or int(pid) <= 0:
+        return False
+    try:
+        os.kill(int(pid), 0)
+    except OSError:
+        return False
+    return True
+
+
+def rebuild_request(view, now_wall=None, now_perf=None):
+    """One replayed journal view (``JournalState.requests`` value with
+    an intact admit record) -> a live :class:`FleetRequest`.
+
+    The admit record's wall-clock stamp maps back onto this process's
+    perf_counter timeline, so the rebuilt request keeps its ORIGINAL
+    deadline: time burned before the crash stays burned.  A journaled
+    decode-phase request comes back with its phase preserved but
+    ``kv=None`` — the payload bytes died with the old router's memory;
+    reconciliation either lets the claiming decode replica finish it or
+    flips it back to the prefill phase (re-extract/re-prefill, the
+    PR-17 fault-back shape)."""
+    rec = view["rec"]
+    req = FleetRequest(rec["prompt"], rec["max_new_tokens"],
+                       eos_token=rec.get("eos_token"),
+                       request_id=view["id"],
+                       deadline_s=rec.get("deadline_s"),
+                       priority=rec.get("priority") or "interactive")
+    admit_wall = rec.get("admit_wall")
+    if admit_wall is not None:
+        req.admit_wall = float(admit_wall)
+        req.submit_t = _journal.resume_submit_t(
+            admit_wall, now_wall=now_wall, now_perf=now_perf)
+    req.retries = int(view.get("retries") or 0)
+    req.phase = view.get("phase")
+    if req.phase == "decode":
+        req.first_token = view.get("first_token")
+        req.prefill_replica = view.get("prefill_replica")
+        req.kv = None
+        req.kv_bytes = 0
+    return req
+
+
 class _ReplicaGone(RuntimeError):
     """Internal: this replica just failed (crash/EOF/heartbeat miss) —
     unwind to the driver loop's incident handler."""
@@ -274,6 +335,10 @@ class _Replica:
         self.listener = listener           # lives across incarnations
         self.port = listener.getsockname()[1]
         self.worker = None                 # launch.spawn_worker handle
+        # a worker the PREVIOUS router generation spawned and this one
+        # re-adopted from the journal: liveness via signal 0, stop via
+        # os.kill — there is no waitable Popen handle for it
+        self.adopted_pid = None
         self.conn = None
         self.state = "starting"    # starting | healthy | dead | removed
         self.incarnation = 0
@@ -293,7 +358,9 @@ class _Replica:
 
     @property
     def pid(self):
-        return self.worker["proc"].pid if self.worker else None
+        if self.worker is not None:
+            return self.worker["proc"].pid
+        return self.adopted_pid
 
 
 class ServingFleet:
@@ -322,7 +389,7 @@ class ServingFleet:
                  spawn_timeout_s=None, steps_per_rpc=4,
                  dispatch_queue_depth=None, worker_argv=None,
                  drain_timeout_s=None, interactive_weight=None,
-                 roles=None):
+                 roles=None, journal_dir=None):
         self.model_spec = dict(model_spec or {})
         # spec keys the built engine could not honor would otherwise
         # surface as a fleet-wide boot crash or hello contract mismatch
@@ -474,6 +541,23 @@ class ServingFleet:
         # sustained-traffic router must not grow without limit
         self.done_retention = _env_int("PADDLE_FLEET_DONE_RETENTION",
                                        4096)
+        # write-ahead request journal (ISSUE 18): None keeps the exact
+        # historical behavior — no journal, zero overhead.  With a dir,
+        # every control-plane event is journaled and a RESTARTED router
+        # pointed at the same dir replays the pending table and
+        # re-adopts the still-live workers instead of spawning anew.
+        self.journal_dir = journal_dir \
+            or self.env_base.get("PADDLE_FLEET_JOURNAL_DIR") or None
+        self._readopt_timeout_s = _env_float(
+            "PADDLE_FLEET_READOPT_TIMEOUT_S", 60.0)
+        self._journal = None
+        self._recovering = False
+        self._recover_t0 = None
+        self.router_recovery_s = None
+        self._awaiting_readopt = set()
+        self.readopt_events = []
+        self._g_router_recovery = metrics.gauge(
+            "fleet.router_recovery_s")
         # prefix-aware routing (ISSUE 17): replicas roll their pager's
         # chain digests into every step-stats reply; the router indexes
         # digest -> replica and holds prefix-sharing dispatches for the
@@ -553,11 +637,65 @@ class ServingFleet:
 
         self._replicas = []
         self._threads = []
+        # resume path: an existing journal with a replica registry means
+        # a previous router generation died here — its fleet SHAPE (role
+        # plan, replica ids, ports, live worker pids) overrides the
+        # constructor's, because the orphaned workers already embody it
+        jstate = None
+        if self.journal_dir:
+            jstate = _journal.replay(self.journal_dir)
+            if jstate.meta is not None:
+                want = json.dumps(self.model_spec, sort_keys=True)
+                got = jstate.meta.get("model_spec")
+                if got is not None and got != want:
+                    raise ValueError(
+                        f"journal_dir {self.journal_dir!r} was written "
+                        "for a DIFFERENT model_spec — resuming it would "
+                        "replay requests onto a fleet with different "
+                        "numerics; point a new fleet at a fresh dir")
+        resume = bool(jstate is not None and jstate.replicas)
+        if resume:
+            plan = sorted(jstate.replicas.values(),
+                          key=lambda v: v["rid"])
+            self._role_plan = [v["role"] or "unified" for v in plan]
+            self.nreplicas = len(plan)
+            self.disaggregated = any(
+                x != "unified" for x in self._role_plan)
+            self._g_configured.set(self.nreplicas)
+            self._g_target.set(self.nreplicas)
         try:
-            for role in self._role_plan:
-                self._replicas.append(self._new_replica(role))
+            if resume:
+                for v in plan:
+                    self._replicas.append(self._adopt_replica(v))
+            else:
+                for role in self._role_plan:
+                    self._replicas.append(self._new_replica(role))
+            if self.journal_dir:
+                self._journal = _journal.JournalWriter(self.journal_dir)
+                if resume:
+                    self._recovering = True
+                    self._recover_t0 = time.monotonic()
+                    self._journal.append(
+                        {"t": "resume", "wall": time.time(),
+                         "replicas": sorted(jstate.replicas)})
+                    self._apply_journal_state(jstate)
+                else:
+                    self._journal.append(
+                        {"t": "meta", "wall": time.time(),
+                         "model_spec": json.dumps(self.model_spec,
+                                                  sort_keys=True),
+                         "role_plan": list(self._role_plan)})
             for r in self._replicas:
-                self._spawn(r)
+                if r.adopted_pid is not None:
+                    # live orphan: no spawn — wait for its reconnect
+                    # (readopt hello), bounded like a slow boot
+                    r.state = "starting"
+                    r.spawn_deadline = time.monotonic() \
+                        + self._readopt_timeout_s + 5.0
+                    self._awaiting_readopt.add(r.id)
+                    self._journal_replica(r)
+                else:
+                    self._spawn(r)
         except Exception:
             # a mid-fleet spawn failure (EMFILE, log_dir perms, ...) must
             # not leak the replicas already started — they would sit in
@@ -568,6 +706,8 @@ class ServingFleet:
                     r.worker["proc"].kill()
                     _launch.close_worker_log(r.worker)
                 r.listener.close()
+            if self._journal is not None:
+                self._journal.close()
             raise
         for r in self._replicas:
             self._start_driver(r)
@@ -596,13 +736,49 @@ class ServingFleet:
             raise ValueError("roles names zero replicas")
         return plan
 
-    def _new_replica(self, role="unified"):
+    def _new_replica(self, role="unified", rid=None, port=0):
+        """Mint a replica on a fresh ephemeral port — or, on the resume
+        path, re-bind the journal-RECORDED (rid, port) so the orphaned
+        worker's reconnect loop finds its router where it left it."""
         lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        lst.bind(("127.0.0.1", 0))
-        lst.listen(1)
-        r = _Replica(self._next_rid, lst, role=role)
-        self._next_rid += 1
+        try:
+            lst.bind(("127.0.0.1", int(port)))
+            lst.listen(1)
+        except OSError:
+            lst.close()
+            raise
+        if rid is None:
+            r = _Replica(self._next_rid, lst, role=role)
+            self._next_rid += 1
+        else:
+            # resume: keep the journaled id; fresh mints stay above it
+            # (replica ids are never reused, even across router deaths)
+            r = _Replica(int(rid), lst, role=role)
+            self._next_rid = max(self._next_rid, int(rid) + 1)
+        return r
+
+    def _adopt_replica(self, v):
+        """One journal replica-registry entry -> a replica slot.  A
+        still-live recorded pid is ADOPTED (recorded port re-bound, no
+        spawn — the worker re-hellos through its reconnect loop); a
+        dead pid, or a recorded port some other process took meanwhile,
+        degrades to a normal fresh spawn on a fresh port."""
+        role = v.get("role") or "unified"
+        pid = int(v.get("pid") or 0)
+        alive = _pid_alive(pid)
+        if alive:
+            try:
+                r = self._new_replica(role, rid=v["rid"],
+                                      port=v["port"])
+            except OSError:
+                r = self._new_replica(role, rid=v["rid"])
+                alive = False
+        else:
+            r = self._new_replica(role, rid=v["rid"])
+        r.incarnation = int(v.get("incarnation") or 0)
+        if alive:
+            r.adopted_pid = pid
         return r
 
     def _start_driver(self, r):
@@ -611,6 +787,188 @@ class ServingFleet:
                                     daemon=True)
         r.thread.start()
         self._threads.append(r.thread)
+
+    # ------------------------------------------------- journal plumbing
+    def _jrec(self, rec):
+        """Append one WAL record; a no-op without a journal (the
+        ``journal_dir=None`` fleet pays nothing).  Callers may hold the
+        fleet lock — the journal's own lock nests strictly inside it."""
+        j = self._journal
+        if j is not None:
+            j.append(rec)
+
+    def _journal_replica(self, r):
+        self._jrec({"t": "replica", "rid": r.id, "port": r.port,
+                    "pid": r.pid or 0, "role": r.role,
+                    "incarnation": r.incarnation})
+
+    @staticmethod
+    def _admit_rec(req):
+        return {"t": "admit", "id": req.id, "prompt": req.prompt,
+                "max_new_tokens": req.max_new_tokens,
+                "eos_token": req.eos_token,
+                "deadline_s": req.deadline_s,
+                "priority": req.priority,
+                "phase": "prefill" if req.phase is not None else None,
+                "admit_wall": req.admit_wall}
+
+    def _journal_snapshot(self):
+        """Full live state as a record list — the compaction
+        checkpoint.  Takes (and releases) the fleet lock itself; the
+        caller must NOT hold it, so the lock order stays one-way
+        (fleet -> journal, never back).  Finished ids come from the
+        ALREADY-BOUNDED _done/_failed tables, so compaction drops acked
+        ids past PADDLE_FLEET_DONE_RETENTION and the journal cannot
+        grow without bound under sustained traffic."""
+        recs = [{"t": "meta", "wall": time.time(),
+                 "model_spec": json.dumps(self.model_spec,
+                                          sort_keys=True),
+                 "role_plan": list(self._role_plan)}]
+        with self._lock:
+            for r in self._replicas:
+                if r.state == "removed":
+                    continue
+                # a dead process (mid-backoff, or a closing fleet)
+                # checkpoints with pid 0: the slot survives — a
+                # resuming router keeps the fleet SHAPE and spawns a
+                # fresh child instead of adopting a corpse
+                alive = self._proc_rc(r) is None
+                recs.append(
+                    {"t": "replica", "rid": r.id, "port": r.port,
+                     "pid": (r.pid or 0) if alive else 0,
+                     "role": r.role, "incarnation": r.incarnation})
+            for req in self._pending.values():
+                recs.append(self._admit_rec(req))
+                if req.retries:
+                    recs.append({"t": "requeue", "id": req.id,
+                                 "retries": req.retries})
+                if req.phase == "decode":
+                    recs.append(
+                        {"t": "flip", "id": req.id,
+                         "first_token": req.first_token,
+                         "kv_bytes": req.kv_bytes, "kv_hash": None,
+                         "prefill_replica": req.prefill_replica})
+            for req in self._done.values():
+                recs.append(self._admit_rec(req))
+                recs.append({"t": "done", "id": req.id,
+                             "tokens": req.tokens,
+                             "finish_reason": req.finish_reason})
+            for req in self._failed.values():
+                recs.append(self._admit_rec(req))
+                recs.append({"t": "fail", "id": req.id,
+                             "reason": req.error})
+        return recs
+
+    def _journal_maintain(self):
+        """Driver-loop journal upkeep: compaction when the live segment
+        outgrew its bound, then the batched fsync.  Both run with the
+        fleet lock RELEASED (snapshot takes it internally)."""
+        j = self._journal
+        if j is None:
+            return
+        if j.compaction_due():
+            j.compact(self._journal_snapshot())
+        j.maybe_sync()
+
+    def _apply_journal_state(self, st):
+        """Replay a :class:`journal.JournalState` into the live tables
+        (construction time, drivers not running yet).  Pending requests
+        re-queue with their ORIGINAL deadlines; finished ids rebuild
+        the dedupe/result tables; ids whose admit record was lost to
+        corruption fail NAMED (``router_recovery``) — never silently."""
+        now_wall, now_perf = time.time(), time.perf_counter()
+        with self._lock:
+            for rid in st.order:
+                v = st.requests[rid]
+                if v.get("rec") is None:
+                    if v["status"] == "done" and v.get("tokens") \
+                            is not None:
+                        # admit lost but the completion survived: the
+                        # RESULT is intact — rebuild it for client
+                        # polls/dedupe under a stub prompt
+                        req = FleetRequest([0], 1, request_id=rid)
+                        req.tokens = [int(t) for t in v["tokens"]]
+                        req.finish_reason = v.get("finish_reason")
+                        req.done = True
+                        req.finish_t = now_perf
+                        self._done[rid] = req
+                        self._evict_locked(self._done)
+                        continue
+                    # irrecoverable: no prompt to re-serve from
+                    req = FleetRequest([0], 1, request_id=rid)
+                    req.failed = True
+                    req.error = ("router_recovery: admit record lost "
+                                 "to journal corruption")
+                    req.finish_t = now_perf
+                    self._failed[rid] = req
+                    self._evict_locked(self._failed)
+                    self._inc("recovery_failures")
+                    continue
+                if v["status"] == "done":
+                    req = rebuild_request(v, now_wall, now_perf)
+                    req.tokens = [int(t) for t in v.get("tokens") or []]
+                    req.finish_reason = v.get("finish_reason")
+                    req.done = True
+                    req.finish_t = now_perf
+                    self._done[rid] = req
+                    self._evict_locked(self._done)
+                elif v["status"] == "failed":
+                    req = rebuild_request(v, now_wall, now_perf)
+                    req.failed = True
+                    req.error = v.get("error") or "unknown"
+                    req.finish_t = now_perf
+                    self._failed[rid] = req
+                    self._evict_locked(self._failed)
+                else:
+                    req = rebuild_request(v, now_wall, now_perf)
+                    if not self.disaggregated \
+                            and req.phase == "prefill":
+                        req.phase = None   # unified fleets are phaseless
+                    if self.prefix_sticky:
+                        chain = [short_digest(k)
+                                 for k in prompt_chain_keys(
+                                     req.prompt, self._spec_page_size,
+                                     self._hash_salt)]
+                        chain = [d for d in chain if d]
+                        req.prefix_chain = tuple(reversed(chain))
+                        req.prefix_digest = chain[0] if chain else None
+                    self._pending[req.id] = req
+                    self._ready_queue_of(req).append(req)
+                    self._inc("recovery_requeues")
+            self._g_pending.set(len(self._pending))
+
+    def _readopt_done(self, rid):
+        """One awaited worker resolved (readopt hello landed, or its
+        incident respawned it fresh).  When the LAST one resolves the
+        recovery window closes: unclaimed decode-phase requests whose
+        payload died with the old router flip back to the prefill
+        phase (re-extract/re-prefill — recovery_rehandoffs), and
+        ``router_recovery_s`` is stamped."""
+        done = False
+        with self._lock:
+            self._awaiting_readopt.discard(rid)
+            if self._recovering and not self._awaiting_readopt:
+                self._recovering = False
+                done = True
+                claimed = set()
+                for x in self._replicas:
+                    claimed.update(x.inflight)
+                for req in self._pending.values():
+                    if req.phase == "decode" and req.kv is None \
+                            and req.id not in claimed:
+                        req.phase = "prefill" if self.disaggregated \
+                            else None
+                        req.first_token = None
+                        req.migrate_from = req.migrate_to = None
+                        self._inc("recovery_rehandoffs")
+                self.router_recovery_s = round(
+                    time.monotonic() - self._recover_t0, 3)
+                self._g_router_recovery.set(self.router_recovery_s)
+                self._inc("router_recoveries")
+        if done:
+            timeline.emit({"event": "fleet_router_recovery",
+                           "recovery_s": self.router_recovery_s,
+                           "readopts": len(self.readopt_events)})
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt, max_new_tokens=16, eos_token=None,
@@ -661,6 +1019,7 @@ class ServingFleet:
              else self._ready_lo).append(req)
             self._inc("requests_admitted")
             self._g_pending.set(len(self._pending))
+            self._jrec(self._admit_rec(req))
         return req
 
     def _shed_batch_victim_locked(self, for_id):
@@ -742,6 +1101,11 @@ class ServingFleet:
                 self.telemetry_dir)
         # a worker is ONE engine process, never a jax.distributed member
         env.pop("PADDLE_MASTER", None)
+        if self.journal_dir:
+            # journaled fleets survive router death: workers hold a
+            # bounded reconnect window instead of exiting on EOF
+            env["PADDLE_FLEET_READOPT_TIMEOUT_S"] = str(
+                self._readopt_timeout_s)
         return env
 
     def _spawn(self, r):
@@ -749,14 +1113,29 @@ class ServingFleet:
         if self.log_dir:
             log_path = os.path.join(self.log_dir,
                                     f"replica{r.id}.log")
+        r.adopted_pid = None          # a fresh child replaces any orphan
         r.worker = _launch.spawn_worker(
             self.worker_argv, self._worker_env(r), log_path=log_path)
         r.state = "starting"
         r.spawn_deadline = time.monotonic() + self.spawn_timeout_s
+        self._journal_replica(r)
+
+    def _proc_rc(self, r):
+        """The replica process's exit code if it is DEAD, else None.
+        Spawned children report through their Popen handle; adopted
+        orphans (no handle) probe with signal 0 — their synthetic
+        ``rc=-1`` only marks death, the real code died with the old
+        router."""
+        if r.worker is not None:
+            return r.worker["proc"].poll()
+        if r.adopted_pid:
+            return None if _pid_alive(r.adopted_pid) else -1
+        return -1
 
     def _await_hello(self, r):
-        """Accept the (re)spawned worker's connection + hello.  Bounded
-        by spawn_timeout_s; a worker dying while starting is an
+        """Accept the (re)spawned worker's connection + hello — or, on
+        the resume path, the adopted orphan's RE-hello (``readopt``).
+        Bounded by spawn_timeout_s; a worker dying while starting is an
         incident like any other."""
         r.listener.settimeout(0.25)
         while not self._stop.is_set():
@@ -766,9 +1145,9 @@ class ServingFleet:
             self._sweep_queued_deadlines()
             if r.draining:
                 return             # being removed while starting: bail
-            if r.worker["proc"].poll() is not None:
+            if self._proc_rc(r) is not None:
                 raise _ReplicaGone(
-                    f"worker exited rc={r.worker['proc'].poll()} "
+                    f"worker exited rc={self._proc_rc(r)} "
                     "before hello")
             if time.monotonic() > r.spawn_deadline:
                 raise _ReplicaGone(
@@ -810,6 +1189,13 @@ class ServingFleet:
             r.state = "healthy"
             self._g_up.inc(1)
             compile_att = hello.get("compile") or {}
+            if hello.get("readopt"):
+                self._handle_readopt(r, hello, compile_att)
+            elif r.id in self._awaiting_readopt:
+                # an awaited orphan resolved through a normal hello
+                # (respawned fresh): the recovery window must not wait
+                # on it any longer
+                self._readopt_done(r.id)
             if r.scale_ev is not None:
                 # close the open scale-up record: the bench's
                 # warm-scale-up attestation reads these
@@ -839,6 +1225,47 @@ class ServingFleet:
                     })
             return
 
+    def _handle_readopt(self, r, hello, compile_att):
+        """Reconcile a surviving worker's RE-hello: every in-flight id
+        it claims moves from the replayed ready queue back onto this
+        replica's in-flight table (the work keeps running — never
+        re-dispatched, never double-served); ids nobody claims stay
+        queued and re-dispatch normally.  The finished backlog needs no
+        special casing: it re-sends on the next step reply and the
+        at-least-once dedupe absorbs duplicates."""
+        r.adopted_pid = int(hello.get("pid") or 0) or r.adopted_pid
+        claims, stale = [], []
+        with self._lock:
+            for cid in hello.get("inflight") or []:
+                cid = str(cid)
+                req = self._pending.get(cid)
+                if req is None or req.done or req.failed:
+                    stale.append(cid)     # finished pre-crash: cancel
+                    continue
+                if any(cid in x.inflight for x in self._replicas):
+                    continue              # first claimant keeps it
+                try:
+                    self._ready_queue_of(req).remove(req)
+                except ValueError:
+                    continue              # not queued: dispatched already
+                req.replica = r.id
+                if r.id not in req.replicas_tried:
+                    req.replicas_tried.append(r.id)
+                r.inflight[cid] = req
+                claims.append(cid)
+            r.pending_cancel.extend(stale)
+        self._inc("readopts")
+        ev = {"replica": r.id, "incarnation": r.incarnation,
+              "claims": len(claims), "stale_claims": len(stale),
+              "xla_compiles": compile_att.get("xla_compiles"),
+              "warm_cache_misses": (hello.get("persistent_cache")
+                                    or {}).get("misses")}
+        with self._lock:
+            self.readopt_events.append(ev)
+        self._journal_replica(r)
+        timeline.emit({"event": "fleet_readopt", **ev})
+        self._readopt_done(r.id)
+
     def _incident(self, r, reason):
         """Exactly-once per incarnation (driver thread is the sole
         owner): record, kill whatever is left, re-queue the in-flight
@@ -850,7 +1277,17 @@ class ServingFleet:
                 proc.wait(timeout=10)
             except Exception:                              # noqa: BLE001
                 pass
+        elif proc is None and r.adopted_pid \
+                and _pid_alive(r.adopted_pid):
+            # an adopted orphan gone suspect (readopt window expired,
+            # heartbeat miss, refused re-hello): same rule — kill it,
+            # the relaunch spawns a proper child in its place
+            try:
+                os.kill(r.adopted_pid, signal.SIGKILL)
+            except OSError:
+                pass
         rc = proc.poll() if proc is not None else None
+        r.adopted_pid = None
         if r.conn is not None:
             try:
                 r.conn.close()
@@ -879,6 +1316,9 @@ class ServingFleet:
         timeline.emit({"event": "fleet_incident", "replica": r.id,
                        "incarnation": r.incarnation, "reason": reason,
                        "exit_code": rc, "requeued": len(victims)})
+        # a recovery window must not wait forever on a replica that
+        # just died instead of re-helloing
+        self._readopt_done(r.id)
         r.next_spawn_t = time.monotonic() + _launch.backoff_delay(
             self.restart_backoff_s, r.restarts_used)
 
@@ -916,9 +1356,9 @@ class ServingFleet:
         failure — dead process, EOF, oversize/undecodable frame, or a
         reply missing past the heartbeat deadline — raises
         _ReplicaGone."""
-        if r.worker["proc"].poll() is not None:
-            raise _ReplicaGone(
-                f"process exited rc={r.worker['proc'].poll()}")
+        rc = self._proc_rc(r)
+        if rc is not None:
+            raise _ReplicaGone(f"process exited rc={rc}")
         try:
             send_msg(r.conn, msg)
             return recv_msg(r.conn)
@@ -1134,12 +1574,32 @@ class ServingFleet:
                         and self._sticky_defers_locked(req, r, now)):
                     skipped.append(req)         # the chain's owner's work
                     continue
+                if req.phase == "decode" and req.kv is None:
+                    # journal replay: this request's handoff payload
+                    # died with the old router.  While the re-adoption
+                    # window is open its claimant may still appear —
+                    # hold; after the window _readopt_done flipped the
+                    # stragglers, so this late safety net flips too
+                    # and re-examines the (now prefill-phase) request
+                    if self._recovering:
+                        skipped.append(req)
+                        continue
+                    req.phase = "prefill" if self.disaggregated \
+                        else None
+                    req.first_token = None
+                    req.migrate_from = req.migrate_to = None
+                    self._inc("recovery_rehandoffs")
+                    if not self._phase_ok(req, r):
+                        skipped.append(req)
+                        continue
                 if req.retries:
                     self._inc("retries")
                 req.replica = r.id
                 req.replicas_tried.append(r.id)
                 r.inflight[req.id] = req
                 batch.append(req)
+                self._jrec({"t": "dispatch", "id": req.id,
+                            "rep": r.id})
             # restore skipped work at the HEAD in reverse pop order —
             # queue order is preserved exactly, so a handed-off request
             # _handoff put at the front (mid-flight work) keeps its
@@ -1239,6 +1699,15 @@ class ServingFleet:
                  req.priority))
             self._inc("kv_handoffs")
             self._inc("kv_handoff_bytes", req.kv_bytes)
+            # journal the payload's content hash + owner, NOT its
+            # bytes: recovery re-extracts or re-prefills (PR-17
+            # fault-back), it never replays KV from disk
+            self._jrec({"t": "flip", "id": req.id,
+                        "first_token": req.first_token,
+                        "kv_bytes": req.kv_bytes,
+                        "kv_hash": (_journal.payload_hash(req.kv)
+                                    if req.kv is not None else None),
+                        "prefill_replica": r.id})
             if req.migrate_to is not None:
                 # a hot-prefix migration's extract leg just landed: the
                 # parked pages are the chain COPY headed for the cold
@@ -1267,6 +1736,10 @@ class ServingFleet:
             self._done[rid] = req
             self._evict_locked(self._done)
             self._inc("requests_completed")
+            # tokens ride the ack record: a post-restart client poll
+            # still finds results completed before the crash
+            self._jrec({"t": "done", "id": rid, "tokens": req.tokens,
+                        "finish_reason": req.finish_reason})
             lat = req.finish_t - req.submit_t
             self._h_latency.observe(lat)
             self._latencies.append(lat)
@@ -1307,6 +1780,8 @@ class ServingFleet:
             req.not_before = time.perf_counter() + self.retry_backoff_s \
                 * (2 ** (req.retries - 1))
         req.replica = None
+        self._jrec({"t": "requeue", "id": req.id,
+                    "retries": req.retries})
         # re-queued work jumps the line: it has already waited longest
         self._ready_queue_of(req).appendleft(req)
 
@@ -1322,6 +1797,7 @@ class ServingFleet:
         self._evict_locked(self._failed)
         self._inc("requests_failed")
         self._g_pending.set(len(self._pending))
+        self._jrec({"t": "fail", "id": req.id, "reason": reason})
 
     def _sweep_deadlines(self, r):
         now = time.perf_counter()
@@ -1428,6 +1904,7 @@ class ServingFleet:
                 resp = self._rpc(r, msg)
                 self._handle_step_resp(r, resp)
                 self._publish_telemetry()
+                self._journal_maintain()
                 if not busy:
                     self._stop.wait(self.heartbeat_idle_s)
             except _ReplicaGone as e:
@@ -1551,6 +2028,33 @@ class ServingFleet:
                 raise TimeoutError(
                     f"replica {rid} did not drain within the wait")
 
+    def _stop_replica_proc(self, r, grace=2.0):
+        """Stop whatever process backs this replica: spawned children
+        through the launch hooks, adopted orphans (no Popen handle) via
+        SIGTERM-then-SIGKILL."""
+        if r.worker is not None:
+            try:
+                _launch.stop_worker(r.worker, term_grace=grace)
+            except Exception:                              # noqa: BLE001
+                pass
+            _launch.close_worker_log(r.worker)
+            return
+        pid = r.adopted_pid
+        if not pid or not _pid_alive(pid):
+            return
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            return
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and _pid_alive(pid):
+            time.sleep(0.05)
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
     def _retire(self, r):
         """Finalize a scale-down (driver thread only): re-queue whatever
         the drain could not finish, politely stop the worker (the final
@@ -1575,12 +2079,7 @@ class ServingFleet:
             except OSError:
                 pass
             r.conn = None
-        if r.worker is not None:
-            try:
-                _launch.stop_worker(r.worker, term_grace=2.0)
-            except Exception:                              # noqa: BLE001
-                pass
-            _launch.close_worker_log(r.worker)
+        self._stop_replica_proc(r)
         try:
             r.listener.close()
         except OSError:
@@ -1588,6 +2087,8 @@ class ServingFleet:
         if r.state == "healthy":
             self._g_up.inc(-1)
         r.state = "removed"
+        self._jrec({"t": "replica", "rid": r.id, "port": r.port,
+                    "state": "removed"})
         with self._lock:
             if r in self._replicas:
                 self._replicas.remove(r)
@@ -1661,6 +2162,28 @@ class ServingFleet:
         now = time.perf_counter()
         want_phase = {"prefill": "prefill", "decode": "decode"}.get(role)
         with self._lock:
+            if self._recovering:
+                # a router mid-recovery (workers re-helloing, replayed
+                # backlog not yet reconciled) reads as a traffic spike
+                # it is not: hand the autoscaler a QUIESCENT snapshot —
+                # hold, don't thrash — instead of raising or scaling on
+                # ghosts (ISSUE 18 satellite; extends the PR-11 tick
+                # isolation law)
+                reps = [r for r in self._replicas if not r.draining
+                        and (role is None or r.role == role)]
+                return {
+                    "role": role, "recovering": True,
+                    "backlog": 0, "pending": 0,
+                    "pending_fraction": 0.0,
+                    "configured": len(reps),
+                    "healthy": sum(1 for r in reps
+                                   if r.state == "healthy"),
+                    "occupancy": 0.0, "p99_s": None, "p50_s": None,
+                    "window_n": 0,
+                    "sheds": self._counts.get("sheds", 0),
+                    "accepted_tokens_per_step": 0.0,
+                    "spill_pressure": 0.0,
+                }
             if want_phase is None:
                 backlog = len(self._ready_hi) + len(self._ready_lo)
             else:
@@ -1703,7 +2226,7 @@ class ServingFleet:
             sheds = self._counts.get("sheds", 0)
             configured = len(reps)
         return {
-            "role": role,
+            "role": role, "recovering": False,
             "backlog": backlog, "pending": pending,
             "pending_fraction": pending / max(self.max_pending, 1),
             "configured": configured, "healthy": healthy,
@@ -1756,6 +2279,39 @@ class ServingFleet:
         with self._lock:
             return len(self._pending)
 
+    def results(self):
+        """Snapshot of every finished request: ``(done, failed,
+        pending_count)`` where ``done`` maps id -> tokens +
+        finish_reason and ``failed`` maps id -> the NAMED error.  The
+        supervisor's poll RPC (and tests) read this — a wire-safe copy,
+        never live Request objects."""
+        with self._lock:
+            done = {rid: {"tokens": [int(t) for t in r.tokens],
+                          "finish_reason": r.finish_reason}
+                    for rid, r in self._done.items()}
+            failed = {rid: str(r.error) for rid, r in
+                      self._failed.items()}
+            return done, failed, len(self._pending)
+
+    def replica_pids(self):
+        """id -> live worker pid (spawned child or adopted orphan;
+        None while starting/dead).  The chaos bench asserts these are
+        UNCHANGED across a router kill — warm re-adoption, not replica
+        restarts."""
+        with self._lock:
+            return {r.id: r.pid for r in self._replicas
+                    if not r.draining}
+
+    def replica_compile_counts(self):
+        """id -> the worker's CUMULATIVE backend-compile count, from
+        its latest stats report.  Because re-adoption keeps the same
+        worker processes (same cumulative counters), before-kill ==
+        after-drain is exactly the 'zero XLA compiles during
+        re-adoption' attestation."""
+        with self._lock:
+            return {r.id: (r.last_stats or {}).get("xla_compiles")
+                    for r in self._replicas if not r.draining}
+
     def drain(self, timeout=None, poll=0.02):
         """Block until every admitted request completed or failed.
         Returns (done, failed) dicts by id.  Raises TimeoutError with
@@ -1805,7 +2361,15 @@ class ServingFleet:
                                         for r in self._replicas})},
                 incidents_detail=list(self.incidents),
                 recoveries=list(self.recoveries),
-                scale_events=[dict(e) for e in self.scale_events])
+                scale_events=[dict(e) for e in self.scale_events],
+                journaled=self._journal is not None,
+                journal_size_bytes=(self._journal.size_bytes()
+                                    if self._journal is not None
+                                    else 0),
+                recovering=self._recovering,
+                router_recovery_s=self.router_recovery_s,
+                readopt_events=[dict(e)
+                                for e in self.readopt_events])
         # THIS fleet's window, not the shared registry histogram — a
         # coexisting fleet's traffic must not shape these percentiles
         with self._lock:
@@ -1824,6 +2388,36 @@ class ServingFleet:
             if not self.recoveries:
                 return None
             return self.recoveries[-1]["recovery_s"]
+
+    def _crash(self):
+        """TEST/BENCH ONLY: die the way a SIGKILL'd router does —
+        drop every connection and listener mid-conversation, abandon
+        the journal WITHOUT its close-time fsync, kill nothing, fail
+        nothing, tell the workers nothing.  The workers see EOF and
+        (on a journaled fleet) enter their re-adoption window; a new
+        ``ServingFleet(journal_dir=...)`` in the same or another
+        process then exercises the real recovery path in-process."""
+        self._stop.set()
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=self.heartbeat_s + 5)
+        with self._lock:
+            reps = list(self._replicas)
+        for r in reps:
+            if r.conn is not None:
+                try:
+                    r.conn.close()
+                except OSError:
+                    pass
+                r.conn = None
+            try:
+                r.listener.close()
+            except OSError:
+                pass
+        if self._journal is not None:
+            self._journal.abandon()
+            self._journal = None
 
     def close(self):
         """Tear the fleet down: stop driver threads, best-effort
@@ -1852,12 +2446,7 @@ class ServingFleet:
                     r.conn.close()
                 except OSError:
                     pass
-            if r.worker is not None:
-                try:
-                    _launch.stop_worker(r.worker, term_grace=2.0)
-                except Exception:                          # noqa: BLE001
-                    pass
-                _launch.close_worker_log(r.worker)
+            self._stop_replica_proc(r)
             try:
                 r.listener.close()
             except OSError:
@@ -1868,6 +2457,13 @@ class ServingFleet:
         with self._lock:
             for req in list(self._pending.values()):
                 self._fail_locked(req, "fleet_shutdown")
+        if self._journal is not None:
+            # a CLEAN shutdown leaves no live state behind: compact to
+            # the (now pending-free) checkpoint so a later fleet on the
+            # same dir resumes results/dedupe, not ghost replicas
+            self._journal.compact(self._journal_snapshot())
+            self._journal.close()
+            self._journal = None
 
     # the production name for the same teardown; tests assert it
     # returns promptly even mid-restart-backoff

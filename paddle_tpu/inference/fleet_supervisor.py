@@ -1,0 +1,272 @@
+"""Supervised router entry point (ISSUE 18): run the
+:class:`~paddle_tpu.inference.fleet.ServingFleet` router as a
+SUBPROCESS under the PR-3 launch hooks, so the control plane is as
+killable as any replica.
+
+Three pieces, all stdlib-only (the router process never imports jax):
+
+* :func:`router_main` — the subprocess body.  Builds a fleet from env
+  (``PADDLE_FLEET_MODEL`` spec, ``PADDLE_FLEET_JOURNAL_DIR``,
+  ``PADDLE_FLEET_ROLES``/``PADDLE_FLEET_REPLICAS``) and serves a tiny
+  length-prefixed JSON control RPC on ``PADDLE_FLEET_CONTROL_PORT``
+  (ops: ``submit`` / ``poll`` / ``stats`` / ``kill_replica`` /
+  ``shutdown``).  Submits dedupe on request id, so a client retrying
+  across a router death is idempotent.
+
+* :func:`supervise_router` — the supervision loop (reuses
+  ``distributed/launch.py``'s spawn/incident/backoff hooks): relaunch
+  the router on any non-zero exit against the SAME env — same journal
+  dir, same control port.  Workers are children of router generation 1;
+  a SIGKILL orphans them ALIVE, and the relaunched router re-adopts
+  them through the journal + their readopt re-hellos.
+
+* :class:`FleetClient` — the caller side: reconnect-retry RPC wrapper
+  that rides through a router death (connection refused/reset while the
+  supervisor relaunches) without surfacing an error to the caller.
+
+bench.py's ``routerchaos`` phase drives exactly this triangle: submit
+traffic through a FleetClient, SIGKILL the router pid mid-stream, and
+assert zero admitted requests lost + token parity + warm re-adoption.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import socket
+import sys
+import time
+
+from .fleet import FleetOverloaded, ServingFleet, recv_msg, send_msg
+
+# spelled out through importlib: paddle_tpu.distributed exports a
+# launch() FUNCTION that shadows the submodule attribute
+_launch = importlib.import_module("paddle_tpu.distributed.launch")
+
+ROUTER_ARGV = ["-m", "paddle_tpu.inference.fleet_supervisor", "--router"]
+
+
+# --------------------------------------------------------------- router
+def _fleet_from_env():
+    spec = json.loads(os.environ.get("PADDLE_FLEET_MODEL") or "{}")
+    if not spec:
+        raise SystemExit("fleet_supervisor: no PADDLE_FLEET_MODEL spec")
+    roles_raw = os.environ.get("PADDLE_FLEET_ROLES")
+    return ServingFleet(
+        spec,
+        roles=json.loads(roles_raw) if roles_raw else None,
+        journal_dir=os.environ.get("PADDLE_FLEET_JOURNAL_DIR") or None,
+        log_dir=os.environ.get("PADDLE_FLEET_LOG_DIR") or None)
+
+
+def _op_submit(fleet, msg):
+    accepted, rejected = [], []
+    for item in msg.get("requests") or []:
+        try:
+            fleet.submit(item["prompt"],
+                         item.get("max_new_tokens", 16),
+                         eos_token=item.get("eos_token"),
+                         request_id=item["id"],
+                         deadline_s=item.get("deadline_s"),
+                         priority=item.get("priority", "interactive"))
+            accepted.append(item["id"])
+        except FleetOverloaded as e:
+            rejected.append({"id": item["id"], "err": str(e),
+                             "permanent": False})
+        except Exception as e:                             # noqa: BLE001
+            rejected.append({"id": item["id"],
+                             "err": f"{type(e).__name__}: {e}",
+                             "permanent": True})
+    return {"accepted": accepted, "rejected": rejected}
+
+
+def _op_poll(fleet):
+    done, failed, pending = fleet.results()
+    return {"done": done, "failed": failed, "pending": pending,
+            "pid": os.getpid(), "replica_pids": fleet.replica_pids(),
+            "replica_compiles": fleet.replica_compile_counts(),
+            "stats": fleet.stats()}
+
+
+def router_main():
+    """The router subprocess: fleet + control RPC until ``shutdown``.
+    Exit 0 is the ONLY clean exit — anything else (SIGKILL above all)
+    makes :func:`supervise_router` relaunch against the same journal."""
+    port = int(os.environ.get("PADDLE_FLEET_CONTROL_PORT", "0") or 0)
+    if not port:
+        raise SystemExit(
+            "fleet_supervisor: no PADDLE_FLEET_CONTROL_PORT")
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    # the relaunched generation must rebind the SAME port while the
+    # dead one's sockets sit in TIME_WAIT
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(4)
+    fleet = _fleet_from_env()
+    print(f"# fleet_supervisor: router pid={os.getpid()} serving "
+          f"control rpc on 127.0.0.1:{port} "
+          f"(journal={fleet.journal_dir or 'off'})", flush=True)
+    try:
+        while True:
+            conn, _ = srv.accept()
+            try:
+                while True:
+                    msg = recv_msg(conn)
+                    op = str(msg.get("op", ""))
+                    resp = {"ok": True, "seq": msg.get("seq")}
+                    if op == "submit":
+                        resp.update(_op_submit(fleet, msg))
+                    elif op in ("poll", "stats"):
+                        resp.update(_op_poll(fleet))
+                    elif op == "kill_replica":
+                        fleet.kill_replica(int(msg["rid"]))
+                    elif op == "shutdown":
+                        try:
+                            send_msg(conn, resp)
+                        except OSError:
+                            pass
+                        return 0
+                    else:
+                        resp.update(ok=False, err=f"unknown op {op!r}")
+                    send_msg(conn, resp)
+            except (OSError, ValueError, ConnectionError):
+                pass               # client went away: await the next one
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+    finally:
+        fleet.close()
+        srv.close()
+
+
+# ----------------------------------------------------------- supervisor
+def supervise_router(env=None, max_restarts=8, backoff=0.5,
+                     log_dir=None, stop_event=None):
+    """Spawn-and-relaunch loop for the router subprocess.  Returns the
+    incident list once the router exits 0 (client-requested shutdown)
+    or ``stop_event`` fires; raises after ``max_restarts`` consecutive
+    relaunches (a crash-looping CONTROL PLANE is a config error, not
+    weather).  Every generation gets the identical env: same journal
+    dir, same control port, same model spec — re-adoption depends on
+    it."""
+    env = dict(env if env is not None else os.environ)
+    incidents = []
+    incarnation = 0
+    t0 = time.time()
+    while True:
+        env["PADDLE_RESTART_COUNT"] = str(incarnation)
+        log_path = (os.path.join(log_dir, f"router-{incarnation}.log")
+                    if log_dir else None)
+        worker = _launch.spawn_worker(ROUTER_ARGV, env,
+                                      log_path=log_path)
+        proc = worker["proc"]
+        while proc.poll() is None:
+            if stop_event is not None and stop_event.is_set():
+                _launch.stop_worker(worker)
+                _launch.close_worker_log(worker)
+                return incidents
+            time.sleep(0.1)
+        rc = proc.poll()
+        _launch.close_worker_log(worker)
+        if rc == 0:
+            return incidents
+        rec = _launch.incident_record("router", rc, incarnation,
+                                      log_path=worker.get("log_path"),
+                                      t0=t0)
+        rec["role"] = "router"
+        incidents.append(rec)
+        print(f"# fleet_supervisor: router died rc={rc} "
+              f"({_launch.signal_name(rc)}), incarnation "
+              f"{incarnation} -> relaunching against the same journal",
+              file=sys.stderr, flush=True)
+        if incarnation >= max_restarts:
+            raise RuntimeError(
+                f"router crash-looped past max_restarts="
+                f"{max_restarts}: {rec}")
+        time.sleep(_launch.backoff_delay(backoff, incarnation,
+                                         cap=10.0))
+        incarnation += 1
+
+
+# --------------------------------------------------------------- client
+class FleetClient:
+    """Reconnect-retry client for the router control RPC.  Every call
+    retries through connection refused/reset for ``retry_window_s`` —
+    long enough for the supervisor's backoff + the relaunched router's
+    journal replay.  Submits are idempotent (ids dedupe server-side),
+    so blind retry is safe."""
+
+    def __init__(self, port, host="127.0.0.1", retry_window_s=120.0):
+        self.host, self.port = host, int(port)
+        self.retry_window_s = float(retry_window_s)
+        self._sock = None
+        self._seq = 0
+
+    def _close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _rpc(self, msg, retry=True):
+        deadline = time.monotonic() + self.retry_window_s
+        msg = dict(msg)
+        self._seq += 1
+        msg["seq"] = self._seq
+        while True:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=5)
+                    self._sock.settimeout(30)
+                send_msg(self._sock, msg)
+                return recv_msg(self._sock)
+            except (OSError, ValueError, ConnectionError):
+                self._close()
+                if not retry or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def submit(self, requests):
+        return self._rpc({"op": "submit", "requests": list(requests)})
+
+    def poll(self):
+        return self._rpc({"op": "poll"})
+
+    def stats(self):
+        return self._rpc({"op": "stats"})
+
+    def kill_replica(self, rid):
+        return self._rpc({"op": "kill_replica", "rid": int(rid)})
+
+    def shutdown(self):
+        try:
+            return self._rpc({"op": "shutdown"}, retry=False)
+        except (OSError, ValueError, ConnectionError):
+            return {"ok": True}    # died mid-goodbye: already down
+        finally:
+            self._close()
+
+    def close(self):
+        self._close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("paddle_tpu.inference.fleet_supervisor")
+    ap.add_argument("--router", action="store_true",
+                    help="run the router subprocess body (supervisor "
+                         "internal; env-driven)")
+    args = ap.parse_args(argv)
+    if args.router:
+        return router_main()
+    ap.error("--router is the only entry (the supervision loop is "
+             "library API: supervise_router)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,17 +240,24 @@ class _Publisher:
             pass
 
 
-def serve(sock, engine, replica, incarnation, role="unified"):
-    """The single-threaded RPC loop.  Returns on shutdown / router
-    disconnect / injected rpc_drop."""
-    finished = {}          # id -> result, until the router acks
+def serve(sock, engine, replica, incarnation, role="unified",
+          finished=None):
+    """The single-threaded RPC loop.  Returns 0 on shutdown / injected
+    rpc_drop, or the string ``"gone"`` when the router side of the
+    connection vanished — the caller decides whether that means exit
+    (unjournaled fleet) or a bounded reconnect-and-readopt wait
+    (journaled fleet, ISSUE 18).  ``finished`` is the un-acked
+    completion buffer; the caller owns it so a backlog survives the
+    reconnect and re-sends to the relaunched router (at-least-once,
+    deduped by id)."""
+    finished = {} if finished is None else finished
     publisher = _Publisher()
     role_extra = {"role": role}
     while True:
         try:
             msg = recv_msg(sock)
         except (ConnectionError, OSError):
-            return 0                       # router went away: exit clean
+            return "gone"                  # router went away
         op = str(msg.get("op", ""))
         if _faults.active() and _faults.rpc_entry(op):
             # rpc_drop: vanish without replying — the router must treat
@@ -357,8 +364,56 @@ def serve(sock, engine, replica, incarnation, role="unified"):
         try:
             send_msg(sock, resp)
         except OSError:
-            return 0
+            return "gone"
         publisher.maybe(step=engine.stats()["decode_steps"])
+
+
+def _await_new_router(host, port):
+    """The router vanished mid-conversation.  A journaled fleet sets
+    ``PADDLE_FLEET_READOPT_TIMEOUT_S`` in every worker's env: keep the
+    engine (and its in-flight work) ALIVE and retry the router port for
+    that window — the relaunched router rebinds the journaled port and
+    this worker re-hellos with a readopt claim.  Unset/zero (no
+    journal) preserves the old contract exactly: exit clean, the router
+    relaunches a fresh replica.  Returns a connected socket or None."""
+    try:
+        window = float(
+            os.environ.get("PADDLE_FLEET_READOPT_TIMEOUT_S", "0"))
+    except ValueError:
+        window = 0.0
+    if window <= 0:
+        return None
+    if _faults.active() and _faults.readopt_refused():
+        # injected readopt_timeout: this worker never comes back — the
+        # router's recovery window must expire and re-queue its work
+        print("# faults: readopt refused, exiting instead of "
+              "reconnecting", file=sys.stderr, flush=True)
+        return None
+    deadline = time.monotonic() + window
+    print(f"# fleet_worker: router connection lost, retrying "
+          f"{host}:{port} for {window:.0f}s", file=sys.stderr,
+          flush=True)
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection((host, port), timeout=2)
+            sock.settimeout(None)
+            return sock
+        except OSError:
+            time.sleep(0.2)
+    return None
+
+
+def _readopt_hello(sock, engine, replica, incarnation, role):
+    """The surviving worker's RE-hello: same attestations as a boot
+    hello (the relaunched router re-checks the numeric contract) plus
+    ``readopt`` and the in-flight id claims."""
+    send_msg(sock, {"op": "hello", "readopt": True,
+                    "replica": replica, "pid": os.getpid(),
+                    "incarnation": incarnation,
+                    "inflight": engine.active_request_ids(),
+                    "persistent_cache": _cache_counters(),
+                    "compile": _compile_counters(),
+                    "stats": _stats(engine, {"role": role})})
 
 
 def main(argv=None):
@@ -407,7 +462,39 @@ def main(argv=None):
                    "warmup_prefill_compiles": warm,
                    "persistent_cache": _cache_counters(),
                    "compile": _compile_counters()})
-    return serve(sock, engine, args.replica, incarnation, role)
+    finished = {}          # un-acked completions, ACROSS reconnects
+    while True:
+        rc = serve(sock, engine, args.replica, incarnation, role,
+                   finished=finished)
+        if rc != "gone":
+            return rc
+        try:
+            sock.close()
+        except OSError:
+            pass
+        while True:
+            sock = _await_new_router(args.host, args.port)
+            if sock is None:
+                return 0               # no journaled router coming back
+            try:
+                _readopt_hello(sock, engine, args.replica, incarnation,
+                               role)
+                break
+            except OSError as e:
+                # the connect can land an instant before the relaunched
+                # router dies too, or race its teardown RST: one failed
+                # hello must not burn the whole window — back into a
+                # fresh reconnect wait
+                print(f"# fleet_worker: readopt hello failed ({e}), "
+                      "retrying", file=sys.stderr, flush=True)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        timeline.emit({"event": "fleet_replica_readopt",
+                       "replica": args.replica,
+                       "incarnation": incarnation, "role": role,
+                       "inflight": len(engine.active_request_ids())})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,475 @@
+"""Write-ahead request journal for the serving-fleet router (ISSUE 18).
+
+Every zero-lost guarantee the fleet earned so far (requeue on replica
+death, drain-then-stop scale-downs, handoff re-ship, host-tier
+fault-back) assumed the ``ServingFleet`` router itself survives: its
+pending table, parked disagg KV payloads and completion-dedupe tables
+were plain in-memory dicts.  This module makes that state
+reconstructible — the router appends one small record per control-plane
+event and a restarted router replays them into an equivalent pending
+table, then re-adopts the still-live workers (see ``fleet.py``).
+
+Wire format — one record::
+
+    u32 big-endian body length | 8-byte blake2b digest of body | body
+
+where ``body`` is canonical JSON (sorted keys, no whitespace).  The
+digest makes corruption DETECTABLE (a flipped byte skips one record and
+counts ``journal.corrupt_records``, it never replays garbage); the
+length prefix makes a torn tail TOLERABLE (a record cut short by a
+crash mid-write is discarded and counted ``journal.torn_tails`` — never
+a crashed recovery, because an un-acked record's request is simply
+re-queued or failed NAMED by reconciliation).
+
+Record kinds (the ``"t"`` field)::
+
+    meta     model spec + role plan, written once per journal
+    replica  rid/port/pid/role/incarnation — the adoption registry
+    admit    request admission (prompt, budget, ORIGINAL wall-clock
+             admit stamp so deadlines survive recovery)
+    dispatch request -> replica assignment
+    flip     prefill->decode phase flip; stamps the handoff payload's
+             content hash + byte count + owning prefill replica — NOT
+             the bytes.  Recovery re-extracts or re-prefills via the
+             PR-17 fault-back path.
+    requeue  preemption/displacement/incident return to the ready queue
+             (carries the retry budget already burned)
+    done     completion ack — tokens + finish_reason journaled so an
+             at-least-once duplicate after restart still dedupes AND a
+             supervised client can poll results across a router death
+    fail     terminal failure with its NAMED reason
+    resume   a new router generation took over this journal
+    ckpt     checkpoint marker opening a compacted segment
+
+Durability model: appends go through an UNBUFFERED file handle — every
+record reaches the OS page cache immediately, so a SIGKILL of the
+router process loses nothing (the kernel keeps written pages).  fsync
+is batched (``PADDLE_FLEET_JOURNAL_SYNC_MS``) and only matters for
+whole-host crashes; it is a justified host sync on the router control
+path, never on a traced path.
+
+Compaction: when the live segment outgrows
+``PADDLE_FLEET_JOURNAL_SEGMENT_KB`` the owner (the fleet driver loop)
+takes a snapshot of live state UNDER ITS OWN LOCK, releases it, and
+calls :meth:`JournalWriter.compact` — which writes the snapshot into a
+fresh checkpoint segment and unlinks every older segment.  Acked ids
+past ``PADDLE_FLEET_DONE_RETENTION`` are dropped from the snapshot, so
+the journal is bounded under sustained traffic (``journal.size_bytes``
+gauge).  The one-direction call order (fleet lock -> journal lock,
+never the reverse) keeps the lock graph acyclic.
+
+Strictly stdlib (+ the stdlib-only metrics/faults modules): the router
+never imports jax, and neither may its journal.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+import time
+
+from ..observability import metrics
+from ..testing import faults as _faults
+
+_LEN = struct.Struct(">I")
+_DIGEST_BYTES = 8
+_HEADER = 4 + _DIGEST_BYTES
+_SEG_FMT = "seg-%08d.log"
+_SEG_GLOB_PREFIX = "seg-"
+_SEG_SUFFIX = ".log"
+
+# a single record larger than this is a bug, not a payload (handoff
+# bytes are deliberately NOT journaled)
+MAX_RECORD = 8 * 1024 * 1024
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name)
+    if raw is None or not str(raw).strip():
+        return int(default)
+    try:
+        return int(str(raw).strip())
+    except ValueError:
+        return int(default)
+
+
+def _stats_family():
+    return metrics.stats_family("journal", {
+        "appends": 0, "syncs": 0, "compactions": 0,
+        "replays": 0, "replayed_records": 0,
+        "corrupt_records": 0, "torn_tails": 0})
+
+
+def journal_stats():
+    """The process-global ``journal.*`` counter family."""
+    return dict(_stats_family())
+
+
+def encode_record(rec):
+    """``rec`` (a JSON-able dict) -> framed bytes."""
+    body = json.dumps(rec, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_RECORD:
+        raise ValueError(f"journal record too large: {len(body)} bytes")
+    digest = hashlib.blake2b(body, digest_size=_DIGEST_BYTES).digest()
+    return _LEN.pack(len(body)) + digest + body
+
+
+def payload_hash(payload):
+    """Content hash of a disagg handoff payload (the wire-format dict
+    of base64 arrays).  Journaled INSTEAD of the bytes: recovery only
+    needs to know a payload existed and who owned it."""
+    body = json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return hashlib.blake2b(body, digest_size=16).hexdigest()
+
+
+def resume_submit_t(admit_wall, now_wall=None, now_perf=None):
+    """Map a journaled wall-clock admit stamp back onto THIS process's
+    ``perf_counter`` timeline, so a replayed request keeps its ORIGINAL
+    deadline budget: time already burned before the crash stays burned
+    (a near-deadline request fails ``deadline_exceeded`` after
+    recovery, it does not silently restart its clock)."""
+    now_wall = time.time() if now_wall is None else now_wall
+    now_perf = time.perf_counter() if now_perf is None else now_perf
+    return now_perf - max(0.0, now_wall - float(admit_wall))
+
+
+def _iter_records(path, fam):
+    """Yield intact records from one segment.  A digest mismatch skips
+    that record (framing is intact, so later records still parse); a
+    short read at EOF is a torn tail — discard and stop."""
+    with open(path, "rb") as f:
+        data = f.read()
+    off, n = 0, len(data)
+    while off < n:
+        if off + _HEADER > n:
+            fam.inc("torn_tails")
+            return
+        (blen,) = _LEN.unpack_from(data, off)
+        if blen > MAX_RECORD:
+            # a corrupted length prefix would send the frame pointer
+            # into garbage — treat the rest of the segment as torn
+            fam.inc("torn_tails")
+            return
+        end = off + _HEADER + blen
+        if end > n:
+            fam.inc("torn_tails")
+            return
+        digest = data[off + 4:off + _HEADER]
+        body = data[off + _HEADER:end]
+        off = end
+        if hashlib.blake2b(
+                body, digest_size=_DIGEST_BYTES).digest() != digest:
+            fam.inc("corrupt_records")
+            continue
+        try:
+            yield json.loads(body.decode("utf-8"))
+        except ValueError:
+            fam.inc("corrupt_records")
+
+
+def segment_paths(dirpath):
+    """Existing journal segments, oldest first."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    segs = sorted(n for n in names
+                  if n.startswith(_SEG_GLOB_PREFIX)
+                  and n.endswith(_SEG_SUFFIX))
+    return [os.path.join(dirpath, n) for n in segs]
+
+
+class JournalState:
+    """Replayed view of a journal: the merged request table, the
+    replica adoption registry, and the corruption tallies."""
+
+    def __init__(self):
+        self.meta = None
+        self.replicas = {}      # rid -> {port, pid, role, incarnation}
+        self.requests = {}      # id -> merged lifecycle dict
+        self.order = []         # admission order
+        self.records = 0
+        self.resumes = 0
+
+    def live_requests(self):
+        """Admitted-but-unfinished ids, in admission order."""
+        return [self.requests[i] for i in self.order
+                if self.requests[i]["status"] == "pending"]
+
+    def lost_ids(self):
+        """Ids referenced by lifecycle records whose ADMIT record was
+        lost to corruption and that never completed — reconciliation
+        fails these NAMED (``router_recovery``), never silently.  (A
+        lost admit whose ``done`` record survived is NOT lost: the
+        result is intact and recovers into the done table.)"""
+        return [i for i in self.order
+                if self.requests[i]["rec"] is None
+                and self.requests[i]["status"] != "done"]
+
+    def _skeleton(self, rid):
+        return {"id": rid, "status": "pending", "rec": None,
+                "phase": None, "retries": 0, "replica": None,
+                "first_token": None, "kv_hash": None, "kv_bytes": 0,
+                "prefill_replica": None, "tokens": None,
+                "finish_reason": None, "error": None}
+
+    def _req(self, rid):
+        r = self.requests.get(rid)
+        if r is None:
+            # a lifecycle record without its admit: the admit was lost
+            # to corruption — keep a skeleton so later records (a
+            # surviving completion especially) still merge
+            r = self._skeleton(rid)
+            self.requests[rid] = r
+            self.order.append(rid)
+        return r
+
+    def apply(self, rec):
+        self.records += 1
+        t = rec.get("t")
+        if t == "meta":
+            self.meta = rec
+        elif t == "resume":
+            self.resumes += 1
+        elif t == "replica":
+            rid = int(rec["rid"])
+            if rec.get("state") == "removed":
+                self.replicas.pop(rid, None)
+            else:
+                self.replicas[rid] = {
+                    "rid": rid, "port": int(rec["port"]),
+                    "pid": int(rec.get("pid") or 0),
+                    "role": rec.get("role"),
+                    "incarnation": int(rec.get("incarnation", 0))}
+        elif t == "admit":
+            rid = rec["id"]
+            r = self.requests.get(rid)
+            if r is None:
+                r = self._skeleton(rid)
+                self.requests[rid] = r
+                self.order.append(rid)
+            # merge, don't replace: a checkpoint's admit may follow a
+            # skeleton minted by an earlier orphan record
+            r["rec"] = rec
+            if r["phase"] is None:
+                r["phase"] = rec.get("phase")
+        elif t == "dispatch":
+            r = self._req(rec["id"])
+            if r is not None:
+                r["replica"] = rec.get("rep")
+        elif t == "flip":
+            r = self._req(rec["id"])
+            if r is not None:
+                r["phase"] = "decode"
+                r["first_token"] = rec.get("first_token")
+                r["kv_hash"] = rec.get("kv_hash")
+                r["kv_bytes"] = int(rec.get("kv_bytes", 0))
+                r["prefill_replica"] = rec.get("prefill_replica")
+                r["replica"] = None
+        elif t == "requeue":
+            r = self._req(rec["id"])
+            if r is not None:
+                r["retries"] = int(rec.get("retries", 0))
+                r["replica"] = None
+        elif t == "done":
+            r = self._req(rec["id"])
+            if r is not None:
+                r["status"] = "done"
+                r["tokens"] = rec.get("tokens")
+                r["finish_reason"] = rec.get("finish_reason", "length")
+        elif t == "fail":
+            r = self._req(rec["id"])
+            if r is not None:
+                r["status"] = "failed"
+                r["error"] = rec.get("reason", "unknown")
+        # unknown kinds are forward-compatible no-ops
+
+
+def replay(dirpath):
+    """Read every segment into a :class:`JournalState`.  Corruption is
+    counted, skipped, and NEVER raises: a torn tail or flipped byte
+    yields a smaller-but-consistent state, and reconciliation handles
+    the difference by re-queueing or failing named."""
+    fam = _stats_family()
+    st = JournalState()
+    for path in segment_paths(dirpath):
+        for rec in _iter_records(path, fam):
+            st.apply(rec)
+    fam.inc("replays")
+    fam.inc("replayed_records", st.records)
+    return st
+
+
+class JournalWriter:
+    """Append-only writer with batched fsync and checkpoint compaction.
+
+    Thread-safe; the owner calls :meth:`append` from any driver thread
+    (typically already holding the fleet lock — the journal lock nests
+    strictly INSIDE it), and :meth:`maybe_sync` / :meth:`compact` from
+    its main drive loop with the fleet lock RELEASED."""
+
+    def __init__(self, dirpath, sync_ms=None, segment_bytes=None):
+        os.makedirs(dirpath, exist_ok=True)
+        self.dir = dirpath
+        self.sync_ms = (_env_int("PADDLE_FLEET_JOURNAL_SYNC_MS", 50)
+                        if sync_ms is None else int(sync_ms))
+        self.segment_bytes = (
+            _env_int("PADDLE_FLEET_JOURNAL_SEGMENT_KB", 512) * 1024
+            if segment_bytes is None else int(segment_bytes))
+        self._fam = _stats_family()
+        self._g_size = metrics.gauge("journal.size_bytes")
+        self._lock = threading.Lock()
+        existing = segment_paths(dirpath)
+        if existing:
+            last = os.path.basename(existing[-1])
+            seq = int(last[len(_SEG_GLOB_PREFIX):-len(_SEG_SUFFIX)])
+            self._seq = seq  # keep appending to the newest segment
+            self._total = sum(self._size_of(p) for p in existing[:-1])
+        else:
+            self._seq = 0
+            self._total = 0
+        self._f = None
+        self._size = 0
+        self._open_segment()
+        self._unsynced = 0
+        self._last_sync = time.monotonic()
+        self._events = 0
+
+    @staticmethod
+    def _size_of(path):
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+
+    def _seg_path(self, seq):
+        return os.path.join(self.dir, _SEG_FMT % seq)
+
+    def _open_segment(self):
+        path = self._seg_path(self._seq)
+        # buffering=0: every append is an OS write, so a SIGKILL'd
+        # router loses nothing from the page cache
+        self._f = open(path, "ab", buffering=0)
+        self._size = self._size_of(path)
+
+    # ------------------------------------------------------------ write
+    def append(self, rec):
+        """Frame + write one record.  Injectable faults:
+        ``journal_corrupt_record`` flips a body byte AFTER the digest
+        was stamped; ``journal_torn_write`` writes half the frame and
+        hard-exits (a crash mid-write); ``router_kill:event=K``
+        SIGKILLs the process after the K-th journal event."""
+        buf = encode_record(rec)
+        with self._lock:
+            if self._f is None:
+                return
+            self._events += 1
+            ev = self._events
+            if _faults.active():
+                if _faults.journal_corrupt_check():
+                    # flip one byte inside the body: digest mismatch,
+                    # replay must skip exactly this record
+                    mid = _HEADER + max(0, (len(buf) - _HEADER) // 2)
+                    buf = (buf[:mid] + bytes([buf[mid] ^ 0xFF])
+                           + buf[mid + 1:])
+                torn = _faults.journal_torn_write()
+                if torn is not None:
+                    self._f.write(buf[:max(1, len(buf) // 2)])
+                    os._exit(torn)
+            self._f.write(buf)
+            self._size += len(buf)
+            self._total += len(buf)
+            self._unsynced += 1
+            self._fam.inc("appends")
+            self._g_size.set(self._total)
+        if _faults.active():
+            _faults.router_kill_check(ev)
+
+    def maybe_sync(self, now=None):
+        """Batched durability point — fsync at most once per
+        ``sync_ms``.  Called from the owner's drive loop."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if (self._f is None or not self._unsynced
+                    or (now - self._last_sync) * 1000.0 < self.sync_ms):
+                return False
+            self._fsync_locked(now)
+            return True
+
+    def sync(self):
+        with self._lock:
+            if self._f is not None and self._unsynced:
+                self._fsync_locked(time.monotonic())
+
+    def _fsync_locked(self, now):
+        self._f.flush()
+        # batched host-durability point for the router WAL; not on a
+        # traced path (the router never imports jax)
+        os.fsync(self._f.fileno())
+        self._unsynced = 0
+        self._last_sync = now
+        self._fam.inc("syncs")
+
+    # ---------------------------------------------------------- compact
+    def compaction_due(self):
+        with self._lock:
+            return (self._f is not None
+                    and self._size > self.segment_bytes)
+
+    def compact(self, snapshot_records):
+        """Write ``snapshot_records`` (the owner's full live state,
+        taken under ITS lock, which is already released) into a fresh
+        checkpoint segment, then unlink every older segment.  The
+        journal's on-disk size collapses to the live state — acked ids
+        past the owner's retention window are simply absent from the
+        snapshot, so the dedupe-table footprint is bounded."""
+        with self._lock:
+            if self._f is None:
+                return
+            old = segment_paths(self.dir)
+            self._seq += 1
+            self._f.close()
+            self._open_segment()
+            self._f.write(encode_record(
+                {"t": "ckpt", "n": len(snapshot_records)}))
+            for rec in snapshot_records:
+                self._f.write(encode_record(rec))
+            self._size = self._size_of(self._seg_path(self._seq))
+            self._fsync_locked(time.monotonic())
+            for p in old:
+                if p != self._seg_path(self._seq):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            self._total = self._size
+            self._fam.inc("compactions")
+            self._g_size.set(self._total)
+
+    def size_bytes(self):
+        with self._lock:
+            return self._total
+
+    def close(self):
+        with self._lock:
+            if self._f is None:
+                return
+            if self._unsynced:
+                self._fsync_locked(time.monotonic())
+            self._f.close()
+            self._f = None
+
+    def abandon(self):
+        """Close the fd WITHOUT the close-time fsync: the crashed-router
+        simulation (``ServingFleet._crash``).  Appends already sit in the
+        OS page cache (the segment is opened unbuffered), so a SIGKILLed
+        process loses nothing — only a host crash could, which is what
+        the batched fsync bounds."""
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

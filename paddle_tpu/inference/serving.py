@@ -802,6 +802,19 @@ class ServingEngine:
         out, self._aborted = self._aborted, []
         return out
 
+    def active_request_ids(self):
+        """Ids this engine still OWNS (queued, mid-admission, or
+        holding a decode slot) — the fleet worker's readopt re-hello
+        claims exactly these after a router restart.  Parked abort
+        victims are excluded on purpose: they need a re-queue, not a
+        claim, and the relaunched router's journal replay re-queues
+        every unclaimed id anyway."""
+        ids = [str(r.id) for r in self._queue]
+        ids += [str(r.id) for r in self._admitting if not r.done]
+        ids += [str(r.id) for r in self._slot_req if r is not None]
+        seen = set()
+        return [i for i in ids if not (i in seen or seen.add(i))]
+
     def cancel(self, request_id):
         """Remove a QUEUED request by id (deadline/cancel path); returns
         the Request or None.  An in-flight request runs to completion —
@@ -2381,6 +2394,16 @@ class PagedServingEngine(ServingEngine):
                 self._inc("requests_cancelled")
                 return req
         return None
+
+    def active_request_ids(self):
+        """Base ids plus the injection queue (handed-off requests whose
+        pages landed but haven't been admitted yet are still owned
+        here — a relaunched router must not re-ship them)."""
+        ids = super().active_request_ids()
+        seen = set(ids)
+        ids += [str(r.id) for r in self._inject_queue
+                if str(r.id) not in seen]
+        return ids
 
     # -------------------------------------------------------------- warmup
     def _warmup_wave_len(self, lo, s, mnt):

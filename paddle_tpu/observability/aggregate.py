@@ -193,10 +193,17 @@ def merge(snapshots, straggler_gap_s=None, step_lag=None, warn=False):
         # published by the CLI under rank 1001) only the findings_*
         # counters qualify — files_scanned/suppressed/baseline_size are
         # gauges a CLEAN run reports nonzero, not incidents
+        # "journal" (ISSUE 18) likewise filtered: appends/syncs/
+        # compactions are routine WAL traffic; only the DAMAGE counters
+        # (torn tails, corrupt records) are incidents
         for fam in ("faults", "watchdog", "launch", "checkpoint",
-                    "bootstrap", "fleet", "autoscale", "analysis"):
+                    "bootstrap", "fleet", "autoscale", "analysis",
+                    "journal"):
             for k, v in (fams.get(fam) or {}).items():
                 if fam == "analysis" and not k.startswith("findings_"):
+                    continue
+                if fam == "journal" and k not in ("corrupt_records",
+                                                  "torn_tails"):
                     continue
                 if v:
                     faults[f"{fam}.{k}"] = v

@@ -97,6 +97,30 @@ Spec grammar (``;``-separated faults, each ``kind:key=val,key=val``)::
         the donated decode dispatch behind the copy — the stall lands
         in the deferred spill-drain stage, decode latency stays flat.
 
+    Router control-plane faults (consumed by inference/journal.py and
+    fleet_worker.py; ISSUE 18)::
+
+    router_kill:event=K
+        SIGKILL the fleet ROUTER process right after its K-th journal
+        event (WAL append) — the control-plane death that the
+        write-ahead journal + supervisor relaunch + worker re-adoption
+        must absorb with zero admitted requests lost.
+    journal_torn_write:nth=K[,code=47]
+        the router's K-th journal append writes only HALF the framed
+        record and hard-exits — a crash mid-write.  Recovery must
+        discard the torn tail (journal.torn_tails) and replay every
+        intact prior record; never a crashed recovery.
+    journal_corrupt_record:nth=K
+        flip one body byte of the K-th journal append AFTER its digest
+        was stamped — bit rot on disk.  Replay must skip exactly that
+        record (journal.corrupt_records) and reconciliation must fail
+        any id whose admit record was lost NAMED (``router_recovery``),
+        never silently.
+    readopt_timeout:[rank=R]
+        the matching WORKER refuses to re-adopt after a router restart
+        (exits instead of reconnecting) — the new router must treat it
+        as a dead replica: incident, respawn, re-queue its claims.
+
 Every fault fires at most once (add ``repeat=1`` to re-arm after each
 fire); ``nth`` counts only calls whose other filters matched, so the Nth
 occurrence is deterministic run to run.  ``rank``/``restart`` filters
@@ -191,7 +215,7 @@ def _want_int(fault, key):
     return None if v is None else int(v)
 
 
-def take(kind, step=None, op=None, request=None):
+def take(kind, step=None, op=None, request=None, event=None):
     """The matching armed fault for this call site, or None.  A matching
     call advances the fault's occurrence counter; the fault fires (and
     disarms, unless ``repeat``) when the counter reaches ``nth``
@@ -219,6 +243,10 @@ def take(kind, step=None, op=None, request=None):
         if _want_int(fault, "request") is not None \
                 and _want_int(fault, "request") != request:
             # same contract as step= for request-count-scoped faults
+            continue
+        if _want_int(fault, "event") is not None \
+                and _want_int(fault, "event") != event:
+            # same contract again for journal-event-scoped faults
             continue
         want_op = fault.get("op") or fault.get("file")
         if want_op and want_op not in str(op or ""):
@@ -380,6 +408,48 @@ def spill_stall():
     if fault is None:
         return None
     return float(fault.get("seconds", 0.2))
+
+
+# -------------------------------------------------- control-plane faults
+def router_kill_check(event):
+    """The router's journal writer calls this once per appended WAL
+    record; a matching ``router_kill`` fault SIGKILLs the router
+    process at journal event K — no atexit, no cleanup, workers
+    orphaned alive.  The supervisor + journal replay + worker
+    re-adoption must recover with zero admitted requests lost."""
+    fault = take("router_kill", event=event)
+    if fault is not None:
+        print(f"# faults: router SIGKILL at journal event {event}",
+              file=sys.stderr, flush=True)
+        os.kill(os.getpid(), 9)
+
+
+def journal_torn_write():
+    """Called by the journal writer per append; returns the hard-exit
+    code when a matching ``journal_torn_write`` fault fires, else None
+    — the writer must emit HALF the framed record then ``os._exit``
+    (a crash mid-write, leaving a torn tail for replay to discard)."""
+    fault = take("journal_torn_write")
+    if fault is None:
+        return None
+    return int(fault.get("code", 47))
+
+
+def journal_corrupt_check():
+    """Called by the journal writer per append; returns True when a
+    matching ``journal_corrupt_record`` fault fires — the writer flips
+    one body byte AFTER the digest stamp, so replay's digest check must
+    skip exactly that record and count ``journal.corrupt_records``."""
+    return take("journal_corrupt_record") is not None
+
+
+def readopt_refused():
+    """Called by a fleet worker when its router connection dies and a
+    re-adoption window is configured; returns True when a matching
+    ``readopt_timeout`` fault fires — the worker exits instead of
+    reconnecting, and the restarted router must treat it as dead
+    (incident -> respawn -> re-queue its claimed requests)."""
+    return take("readopt_timeout") is not None
 
 
 def engine_step_error(step):

@@ -1,0 +1,256 @@
+"""Write-ahead journal unit tests (ISSUE 18): wire-format round trip,
+batched fsync, checkpoint compaction bounding the on-disk footprint,
+torn-tail and corrupt-record tolerance (fixture logs AND the injected
+faults), the resume-time deadline math, and the replayed-state
+semantics reconciliation depends on (a lost admit with a surviving
+completion is a recovered result, not a lost request).
+
+All stdlib-speed — no jax, no subprocesses.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.inference import journal as J
+from paddle_tpu.observability import metrics
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _stats():
+    return dict(J.journal_stats())
+
+
+def _write_segment(dirpath, records, seq=0):
+    """A fixture segment written byte-for-byte like the writer does."""
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, "seg-%08d.log" % seq)
+    with open(path, "wb") as f:
+        for rec in records:
+            f.write(J.encode_record(rec))
+    return path
+
+
+ADMIT = {"t": "admit", "id": "a", "prompt": [1, 2, 3],
+         "max_new_tokens": 4, "eos_token": None, "deadline_s": None,
+         "priority": "interactive", "phase": None, "admit_wall": 100.0}
+
+
+# --------------------------------------------------------- round trip ----
+
+class TestRoundTrip:
+    def test_writer_replay_round_trip(self, tmp_path):
+        d = str(tmp_path / "wal")
+        w = J.JournalWriter(d, sync_ms=0)
+        w.append({"t": "meta", "model_spec": "{}", "role_plan": ["u"]})
+        w.append(dict(ADMIT))
+        w.append({"t": "dispatch", "id": "a", "rep": 1})
+        w.append(dict(ADMIT, id="b"))
+        w.append({"t": "done", "id": "b", "tokens": [7, 8],
+                  "finish_reason": "length"})
+        w.close()
+        st = J.replay(d)
+        assert st.records == 5
+        assert st.meta["role_plan"] == ["u"]
+        assert st.requests["a"]["status"] == "pending"
+        assert st.requests["a"]["replica"] == 1
+        assert st.requests["b"]["status"] == "done"
+        assert st.requests["b"]["tokens"] == [7, 8]
+        assert [v["id"] for v in st.live_requests()] == ["a"]
+        assert st.lost_ids() == []
+
+    def test_replay_missing_dir_is_empty(self, tmp_path):
+        st = J.replay(str(tmp_path / "nope"))
+        assert st.records == 0 and st.requests == {}
+
+    def test_payload_hash_canonical(self):
+        a = J.payload_hash({"arrays": [{"shape": [1], "data": "xx"}]})
+        b = J.payload_hash({"arrays": [{"data": "xx", "shape": [1]}]})
+        assert a == b and len(a) == 32
+        assert a != J.payload_hash({"arrays": []})
+
+
+# --------------------------------------------------------- durability ----
+
+class TestDurability:
+    def test_fsync_is_batched(self, tmp_path):
+        w = J.JournalWriter(str(tmp_path / "wal"), sync_ms=60_000)
+        before = _stats()["syncs"]
+        for i in range(5):
+            w.append(dict(ADMIT, id=f"r{i}"))
+        assert w.maybe_sync() is False          # inside the batch window
+        assert _stats()["syncs"] == before
+        w.sync()                                 # explicit point syncs
+        assert _stats()["syncs"] == before + 1
+        assert w.maybe_sync() is False           # nothing unsynced
+        w.close()
+
+    def test_abandoned_appends_survive_replay(self, tmp_path):
+        """The crashed-router simulation: abandon() skips the
+        close-time fsync, but the unbuffered appends already reached
+        the OS — replay sees every record."""
+        d = str(tmp_path / "wal")
+        w = J.JournalWriter(d, sync_ms=60_000)
+        w.append(dict(ADMIT))
+        w.append({"t": "dispatch", "id": "a", "rep": 0})
+        w.abandon()
+        st = J.replay(d)
+        assert st.records == 2
+        assert st.requests["a"]["replica"] == 0
+
+
+# --------------------------------------------------------- compaction ----
+
+class TestCompaction:
+    def test_compact_bounds_footprint(self, tmp_path):
+        d = str(tmp_path / "wal")
+        w = J.JournalWriter(d, sync_ms=0, segment_bytes=512)
+        for i in range(64):
+            w.append(dict(ADMIT, id=f"r{i}"))
+            w.append({"t": "done", "id": f"r{i}", "tokens": [1],
+                      "finish_reason": "length"})
+        assert w.compaction_due()
+        grown = w.size_bytes()
+        # the owner's snapshot retains only live state — here, nothing
+        snapshot = [dict(ADMIT, id="live")]
+        w.compact(snapshot)
+        assert len(J.segment_paths(d)) == 1      # old segments unlinked
+        assert w.size_bytes() < grown / 4
+        # the size gauge tracks the compacted total
+        assert metrics.gauge("journal.size_bytes").value \
+            == w.size_bytes()
+        st = J.replay(d)
+        assert list(st.requests) == ["live"]     # acked ids dropped
+        # appends keep working in the new segment
+        w.append(dict(ADMIT, id="after"))
+        w.close()
+        assert set(J.replay(d).requests) == {"live", "after"}
+
+
+# ------------------------------------------- torn tails + corruption ----
+
+class TestTornTail:
+    def test_truncated_final_record_discarded(self, tmp_path):
+        d = str(tmp_path / "wal")
+        path = _write_segment(d, [dict(ADMIT, id=f"r{i}")
+                                  for i in range(3)])
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 7)                 # tear the last record
+        before = _stats()
+        st = J.replay(d)
+        assert set(st.requests) == {"r0", "r1"}  # every intact record
+        after = _stats()
+        assert after["torn_tails"] == before["torn_tails"] + 1
+        assert after["corrupt_records"] == before["corrupt_records"]
+
+    def test_corrupt_length_prefix_stops_segment(self, tmp_path):
+        d = str(tmp_path / "wal")
+        path = _write_segment(d, [dict(ADMIT, id="r0"),
+                                  dict(ADMIT, id="r1")])
+        first = len(J.encode_record(dict(ADMIT, id="r0")))
+        with open(path, "r+b") as f:
+            f.seek(first)
+            f.write(b"\xff\xff\xff\xff")         # length > MAX_RECORD
+        before = _stats()["torn_tails"]
+        st = J.replay(d)
+        assert set(st.requests) == {"r0"}
+        assert _stats()["torn_tails"] == before + 1
+
+    def test_injected_torn_write_spec_parses(self):
+        faults.install("journal_torn_write:nth=3,code=9")
+        assert faults.journal_torn_write() is None   # 1st append
+        assert faults.journal_torn_write() is None   # 2nd
+        assert faults.journal_torn_write() == 9      # fires on the 3rd
+
+
+class TestCorruptRecord:
+    def test_flipped_body_byte_skips_one_record(self, tmp_path):
+        d = str(tmp_path / "wal")
+        recs = [dict(ADMIT, id=f"r{i}") for i in range(3)]
+        path = _write_segment(d, recs)
+        first = len(J.encode_record(recs[0]))
+        # flip one byte inside record 1's BODY (past its header)
+        with open(path, "r+b") as f:
+            f.seek(first + 12 + 5)
+            b = f.read(1)
+            f.seek(first + 12 + 5)
+            f.write(bytes([b[0] ^ 0xFF]))
+        before = _stats()
+        st = J.replay(d)
+        assert set(st.requests) == {"r0", "r2"}  # later records intact
+        after = _stats()
+        assert after["corrupt_records"] \
+            == before["corrupt_records"] + 1
+        assert after["torn_tails"] == before["torn_tails"]
+
+    def test_injected_corruption_detected_on_replay(self, tmp_path):
+        """The writer-side fault flips a byte AFTER the digest stamp —
+        replay must skip exactly that record and keep the rest."""
+        d = str(tmp_path / "wal")
+        faults.install("journal_corrupt_record:nth=2")
+        w = J.JournalWriter(d, sync_ms=0)
+        for i in range(3):
+            w.append(dict(ADMIT, id=f"r{i}"))
+        w.close()
+        before = _stats()["corrupt_records"]
+        st = J.replay(d)
+        assert set(st.requests) == {"r0", "r2"}
+        assert _stats()["corrupt_records"] == before + 1
+
+
+# --------------------------------------------------- resume-time math ----
+
+class TestResumeSubmitT:
+    def test_burned_budget_stays_burned(self):
+        # admitted 3s before the crash: the rebuilt submit_t sits 3s in
+        # this process's past, so a 4s deadline has ~1s left
+        t = J.resume_submit_t(97.0, now_wall=100.0, now_perf=50.0)
+        assert t == pytest.approx(47.0)
+
+    def test_future_stamp_clamps_to_now(self):
+        # clock skew must never mint EXTRA budget
+        t = J.resume_submit_t(105.0, now_wall=100.0, now_perf=50.0)
+        assert t == pytest.approx(50.0)
+
+
+# ------------------------------------------------- state semantics ----
+
+class TestStateSemantics:
+    def test_orphan_done_recovers_result_not_lost(self):
+        st = J.JournalState()
+        st.apply({"t": "done", "id": "x", "tokens": [1, 2],
+                  "finish_reason": "eos"})
+        assert st.requests["x"]["status"] == "done"
+        assert st.lost_ids() == []               # the RESULT survived
+
+    def test_orphan_lifecycle_without_admit_is_lost(self):
+        st = J.JournalState()
+        st.apply({"t": "dispatch", "id": "y", "rep": 0})
+        assert st.lost_ids() == ["y"]            # nothing to re-serve
+
+    def test_flip_preserves_handoff_stamp_not_bytes(self):
+        st = J.JournalState()
+        st.apply(dict(ADMIT, phase="prefill"))
+        st.apply({"t": "flip", "id": "a", "first_token": 9,
+                  "kv_bytes": 4096, "kv_hash": "h" * 32,
+                  "prefill_replica": 0})
+        v = st.requests["a"]
+        assert v["phase"] == "decode" and v["first_token"] == 9
+        assert v["kv_hash"] == "h" * 32 and v["kv_bytes"] == 4096
+        assert "kv" not in v                     # bytes never journaled
+
+    def test_admit_merges_into_orphan_skeleton(self):
+        st = J.JournalState()
+        st.apply({"t": "done", "id": "a", "tokens": [3],
+                  "finish_reason": "length"})
+        st.apply(dict(ADMIT))                    # checkpoint order quirk
+        v = st.requests["a"]
+        assert v["status"] == "done" and v["rec"] is not None
+        assert len(st.order) == 1

@@ -1,0 +1,322 @@
+"""Router crash-restart recovery (ISSUE 18 tentpole): rebuilt requests
+keep their original deadlines, the autoscaler holds quiescently across
+a router generation swap, and ONE in-process crash-then-resume e2e —
+``fleet._crash()`` (the SIGKILL simulation: connections dropped,
+journal abandoned un-fsynced, workers told nothing), then a second
+``ServingFleet`` on the same journal dir that re-adopts the SAME
+worker process and drains everything with zero lost requests.
+
+The subprocess SIGKILL variant (supervised router, real signal 9) runs
+in bench.py's routerchaos phase / tools/routerchaos_smoke.sh — this
+file keeps tier-1 to one worker boot.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import journal as J
+from paddle_tpu.inference.autoscale import Autoscaler
+from paddle_tpu.inference.fleet import rebuild_request
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.env import clean_cpu_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_CFG = {"vocab_size": 256, "hidden_size": 32, "num_layers": 2,
+            "num_heads": 2, "max_seq_len": 128, "dtype": "float32",
+            "use_flash": False, "remat": False}
+SPEC = {"cfg": TINY_CFG, "seed": 0, "slots": 2, "max_len": 96,
+        "seq_buckets": [8], "batch_buckets": [1, 2]}
+
+
+def _fleet(tmp_path, tag, replicas=1, fault_spec=None, **kw):
+    from paddle_tpu.inference.fleet import ServingFleet
+    env = clean_cpu_env(REPO, device_count=1)
+    env.pop("PADDLE_FAULTS", None)
+    if fault_spec:
+        env["PADDLE_FAULTS"] = fault_spec
+    kw.setdefault("heartbeat_s", 20)
+    kw.setdefault("restart_backoff_s", 0.2)
+    return ServingFleet(SPEC, replicas=replicas, env_base=env,
+                        log_dir=str(tmp_path / tag / "logs"), **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _admit(rid, *, deadline_s=None, admit_wall=None, prompt=(1, 2, 3)):
+    return {"t": "admit", "id": rid, "prompt": list(prompt),
+            "max_new_tokens": 4, "eos_token": None,
+            "deadline_s": deadline_s, "priority": "interactive",
+            "phase": None,
+            "admit_wall": time.time() if admit_wall is None
+            else admit_wall}
+
+
+# ----------------------------------------------- rebuilt requests ----
+
+class TestRebuildRequest:
+    def test_deadline_budget_survives_as_burned_time(self):
+        view = {"id": "a", "rec": _admit("a", deadline_s=10.0,
+                                         admit_wall=time.time() - 3.0),
+                "status": "pending", "phase": None}
+        req = rebuild_request(view)
+        # 3s burned before the crash: submit_t sits ~3s in the past
+        age = time.perf_counter() - req.submit_t
+        assert 2.5 < age < 4.0
+        assert req.deadline_s == 10.0
+        assert not req.expired()
+        # and an already-blown deadline reads as expired immediately
+        stale = {"id": "b", "rec": _admit("b", deadline_s=2.0,
+                                          admit_wall=time.time() - 60),
+                 "status": "pending", "phase": None}
+        assert rebuild_request(stale).expired()
+
+    def test_decode_phase_keeps_stamp_drops_bytes(self):
+        view = {"id": "c", "rec": _admit("c"), "status": "pending",
+                "phase": "decode", "first_token": 7,
+                "prefill_replica": 0, "retries": 1}
+        req = rebuild_request(view)
+        assert req.phase == "decode" and req.first_token == 7
+        assert req.prefill_replica == 0 and req.retries == 1
+        assert req.kv is None and req.kv_bytes == 0
+
+
+# ------------------------------------- autoscaler quiescence law ----
+
+class _SwapFleet:
+    """autoscale_signals raises ONCE (the generation swap), then
+    reports one recovering tick, then normal quiet signals."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def autoscale_signals(self, window_s, role=None):
+        self.calls += 1
+        if self.calls == 1:
+            raise RuntimeError("fleet torn down under the tick")
+        base = {"role": role, "backlog": 0, "pending": 0,
+                "pending_fraction": 0.0, "configured": 1, "healthy": 1,
+                "occupancy": 0.0, "p99_s": None, "p50_s": None,
+                "window_n": 0, "sheds": 0,
+                "accepted_tokens_per_step": 0.0, "spill_pressure": 0.0}
+        base["recovering"] = self.calls == 2
+        return base
+
+
+class TestAutoscalerQuiescence:
+    def test_generation_swap_holds_quiescently(self):
+        a = Autoscaler(_SwapFleet(), min_replicas=1, max_replicas=4,
+                       up_ticks=1)
+        a._up_streak = 1                       # a stale pre-swap streak
+        assert a.tick() is None                # raise -> quiescent hold
+        assert a._counts["ticks_quiescent"] == 1
+        assert a._counts["tick_errors"] == 0   # NOT a control-law error
+        assert a._up_streak == 0               # streaks reset
+        assert a.tick() is None                # recovering -> hold too
+        assert a._counts["ticks_quiescent"] == 2
+        assert a._counts["tick_errors"] == 0
+        # the loop is alive: the next tick reads normal signals
+        assert a.tick() is None
+        assert a._counts["ticks_quiescent"] == 2
+        assert a._counts["ticks"] == 3
+
+
+# --------------------------------------- crash-resume e2e (1 boot) ----
+
+class TestCrashResume:
+    def test_crash_resume_readopts_worker_and_keeps_deadlines(
+            self, tmp_path):
+        """The whole tentpole in one worker boot: gen-1 journaled fleet
+        completes a request and crashes SIGKILL-style; two more admits
+        land in the journal (one with a long-blown deadline); gen-2 on
+        the same dir re-adopts the SAME worker process (pid unchanged,
+        no respawn), re-queues the journaled backlog, fails the expired
+        request NAMED, serves the fresh one, and still answers polls
+        for the pre-crash result."""
+        jd = str(tmp_path / "wal")
+        f1 = _fleet(tmp_path, "gen1", journal_dir=jd)
+        pre = f1.submit(np.arange(1, 6, dtype=np.int32), 4,
+                        request_id="pre-crash")
+        done1, failed1 = f1.drain(timeout=180)
+        assert not failed1 and "pre-crash" in done1
+        pids_before = f1.replica_pids()
+        assert list(pids_before.values()) != [None]
+        f1._crash()
+
+        # requests the dead router admitted but never served: appended
+        # to the same journal the way its own admit records land
+        w = J.JournalWriter(jd)
+        w.append(_admit("expired", deadline_s=2.0,
+                        admit_wall=time.time() - 60.0))
+        w.append(_admit("fresh", prompt=(2, 3, 4, 5, 6)))
+        w.close()
+
+        f2 = _fleet(tmp_path, "gen2", journal_dir=jd)
+        try:
+            done2, failed2 = f2.drain(timeout=180)
+            # zero lost: every journaled id resolved, by NAME
+            assert failed2.keys() == {"expired"}
+            assert failed2["expired"].error == "deadline_exceeded"
+            assert "fresh" in done2
+            assert len(done2["fresh"].tokens) == 4
+            # the pre-crash RESULT survived the crash (poll dedupe)
+            assert done2["pre-crash"].tokens == pre.tokens
+            # warm re-adoption: same worker process, no respawn
+            assert f2.replica_pids() == pids_before
+            st = f2.stats()
+            assert st["readopts"] == 1
+            assert st["replica_restarts"] == 0
+            assert st["recovery_requeues"] == 2
+            assert st["router_recoveries"] == 1
+            assert f2.router_recovery_s is not None
+            assert f2.router_recovery_s >= 0
+            assert not st["recovering"]
+        finally:
+            f2.close()
+            f1.close()     # reaps the (now-dead) child's zombie
+        # clean shutdown compacted the journal to a checkpoint: no
+        # live requests left behind, finished statuses preserved for
+        # a later generation's poll dedupe
+        st3 = J.replay(jd)
+        assert st3.live_requests() == []
+        assert st3.requests["fresh"]["status"] == "done"
+        assert st3.requests["expired"]["status"] == "failed"
+
+
+# -------------------------------------- bounded dedupe footprint ----
+
+class TestDoneRetention:
+    def test_evict_keeps_newest_within_retention(self):
+        """The _done/_failed tables (and with them every journal
+        checkpoint) stay inside PADDLE_FLEET_DONE_RETENTION — oldest
+        ids evicted first, insertion order."""
+        from paddle_tpu.inference.fleet import ServingFleet
+
+        class _Cfg:
+            done_retention = 3
+
+        table = {f"r{i}": i for i in range(10)}
+        ServingFleet._evict_locked(_Cfg(), table)
+        assert list(table) == ["r7", "r8", "r9"]
+
+
+# ------------------------------- chaos faults (subprocess, slow) ----
+
+@pytest.mark.slow
+class TestRouterKillFault:
+    def test_event_deterministic_router_kill_recovers(self, tmp_path):
+        """router_kill:event=K — the SUPERVISED router SIGKILLs itself
+        right after its K-th journal append.  The supervisor relaunches
+        it against the same journal; every admitted request completes;
+        the client rides through the death."""
+        import json
+        import socket as _socket
+        import threading
+
+        from paddle_tpu.inference.fleet_supervisor import (
+            FleetClient, supervise_router)
+
+        spec = dict(SPEC)
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        env = clean_cpu_env(REPO, device_count=1)
+        env.pop("PADDLE_FAULTS", None)
+        renv = dict(env)
+        renv.update(
+            PADDLE_FLEET_MODEL=json.dumps(spec),
+            PADDLE_FLEET_CONTROL_PORT=str(port),
+            PADDLE_FLEET_JOURNAL_DIR=str(tmp_path / "wal"),
+            PADDLE_FLEET_LOG_DIR=str(tmp_path / "logs"),
+            PADDLE_FLEET_HEARTBEAT_S="30",
+            # fires once, in generation 0 only (restart=0): the
+            # relaunched router appends the same records again and must
+            # NOT re-die
+            PADDLE_FAULTS="router_kill:event=6,restart=0")
+        stop = threading.Event()
+        out = {}
+
+        def sup():
+            try:
+                out["incidents"] = supervise_router(
+                    renv, backoff=0.2, log_dir=str(tmp_path),
+                    stop_event=stop)
+            except Exception as e:                         # noqa: BLE001
+                out["error"] = f"{type(e).__name__}: {e}"
+
+        th = threading.Thread(target=sup, daemon=True)
+        th.start()
+        client = FleetClient(port, retry_window_s=120.0)
+        try:
+            reqs = [{"id": f"k{i}", "prompt": [1 + i, 2, 3],
+                     "max_new_tokens": 4} for i in range(4)]
+            resp = client.submit(reqs)
+            assert not resp["rejected"], resp
+            deadline = time.time() + 150
+            p = None
+            while time.time() < deadline:
+                p = client.poll()
+                if p["pending"] == 0 \
+                        and len(p["done"]) + len(p["failed"]) >= 4:
+                    break
+                time.sleep(0.05)
+            assert p is not None and p["pending"] == 0
+            assert not p["failed"], p["failed"]
+            assert len(p["done"]) == 4
+        finally:
+            client.shutdown()
+            stop.set()
+            th.join(timeout=30)
+        assert "error" not in out, out
+        # the fault killed generation 0 exactly once
+        assert len(out["incidents"]) == 1
+        assert out["incidents"][0]["role"] == "router"
+
+
+@pytest.mark.slow
+class TestReadoptTimeout:
+    def test_refused_readopt_expires_window_and_respawns(
+            self, tmp_path, monkeypatch):
+        """The readopt_timeout fault: the worker refuses to reconnect
+        after the crash (exits instead).  The resumed router's recovery
+        window must expire — incident, fresh spawn, journaled backlog
+        re-served — zero lost, no wedge."""
+        monkeypatch.setenv("PADDLE_FLEET_READOPT_TIMEOUT_S", "3")
+        jd = str(tmp_path / "wal")
+        f1 = _fleet(tmp_path, "gen1", journal_dir=jd,
+                    fault_spec="readopt_timeout")
+        f1.submit(np.arange(1, 6, dtype=np.int32), 4,
+                  request_id="pre-crash")
+        done1, failed1 = f1.drain(timeout=180)
+        assert not failed1
+        pid_before = list(f1.replica_pids().values())[0]
+        f1._crash()
+
+        w = J.JournalWriter(jd)
+        w.append(_admit("queued", prompt=(2, 3, 4, 5)))
+        w.close()
+
+        f2 = _fleet(tmp_path, "gen2", journal_dir=jd)
+        try:
+            done2, failed2 = f2.drain(timeout=180)
+            assert not failed2
+            assert "queued" in done2
+            assert len(done2["queued"].tokens) == 4
+            st = f2.stats()
+            # the worker never came back: a FRESH child served it
+            assert st["readopts"] == 0
+            assert st["replica_restarts"] >= 1
+            assert st["router_recoveries"] == 1
+            assert list(f2.replica_pids().values())[0] != pid_before
+            assert f2.router_recovery_s is not None
+        finally:
+            f2.close()
+            f1.close()

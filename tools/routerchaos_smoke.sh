@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Router fault-tolerance smoke (ISSUE 18, CPU): run the bench's
+# routerchaos phase — a journaled 1-prefill + 1-decode fleet under the
+# supervised router, SIGKILL the router mid-traffic with in-flight and
+# crossed-handoff work, relaunch against the same journal — and grep
+# the attestations that make the control plane crash-safe:
+#   - the fleet_router_recovery_s JSON metric line parses
+#   - lost_requests == 0            (zero admitted requests lost)
+#   - readopts == 2                 (both workers re-adopted, warm)
+#   - replica_restarts == 0         (re-adoption, not restarts)
+#   - "0 lost, token-exact" / "re-adopted (pids unchanged, 0 compiles)"
+# BENCH_RC_OVERHEAD=0 skips the in-process overhead run (the full
+# bench measures it); keeps this inside the 120s budget.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+WORK=$(mktemp -d "${TMPDIR:-/tmp}/paddle_tpu_rc_smoke.XXXXXX")
+trap 'rm -rf "$WORK"' EXIT
+LOG="$WORK/smoke.log"
+
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    BENCH_FLEET_PHASES=routerchaos BENCH_RC_OVERHEAD=0 \
+    python -u bench.py --fleet --cpu-mesh 1 >"$LOG" 2>&1
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    cat "$LOG" >&2
+    echo "FAIL: routerchaos phase exited rc=$rc" >&2
+    exit 1
+fi
+cat "$LOG"
+
+grep -q '"metric": "fleet_router_recovery_s"' "$LOG" \
+    || { echo "FAIL: no fleet_router_recovery_s metric line" >&2; exit 1; }
+python - "$LOG" <<'PY' || exit 1
+import json
+import sys
+
+rec = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if cand.get("metric") == "fleet_router_recovery_s":
+            rec = cand
+if rec is None:
+    print("FAIL: metric line did not parse", file=sys.stderr)
+    raise SystemExit(1)
+assert rec["lost_requests"] == 0, rec
+assert rec["readopts"] == 2, rec
+assert rec["replica_restarts"] == 0, rec
+assert rec["value"] >= 0, rec
+assert rec["killed_at"]["pending"] >= 1, rec
+assert rec["killed_at"]["kv_handoffs"] >= 1, rec
+print(f"parsed: recovery {rec['value']}s, killed holding "
+      f"{rec['killed_at']['pending']} in-flight "
+      f"({rec['killed_at']['kv_handoffs']} handoffs), "
+      f"{rec['readopts']} readopts, 0 restarts, 0 lost")
+PY
+grep -q "0 lost, token-exact" "$LOG" \
+    || { echo "FAIL: no zero-lost/token-parity attestation" >&2; exit 1; }
+grep -q "re-adopted (pids unchanged, 0 compiles)" "$LOG" \
+    || { echo "FAIL: no re-adoption attestation" >&2; exit 1; }
+echo "OK: router fault tolerance — SIGKILLed router relaunched from" \
+     "its journal, workers re-adopted warm, zero requests lost," \
+     "token-exact"

@@ -53,6 +53,16 @@ if ! tools/kvtier_smoke.sh; then
     exit 1
 fi
 
+# router fault-tolerance smoke (~60s): SIGKILL the journaled router
+# mid-traffic, relaunch against the same journal, re-adopt the
+# surviving workers — zero lost, token-exact, zero replica restarts,
+# zero re-adoption compiles — the ISSUE-18 control-plane contract
+if ! tools/routerchaos_smoke.sh; then
+    echo "tier1_guard: FAIL — router chaos smoke" \
+         "(tools/routerchaos_smoke.sh; see above)" >&2
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
