@@ -38,6 +38,7 @@ outputs), so fetching the final loss bounds the whole region.  MFU is
 sanity-asserted to (0, 1].
 """
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1823,6 +1824,14 @@ def fleet_bench():
       within BENCH_RC_MIN_RATIO of the unjournaled tokens/s.  Emits
       fleet_router_recovery_s + fleet_journal_overhead JSON metrics.
 
+    * ``trace`` — distributed-tracing overhead (ISSUE 19): tracing-on
+      serving throughput within BENCH_TRACE_OVERHEAD (0.95x) of
+      tracing-off on one in-process engine, interleaved A/B medians.
+      The disagg and kvtier phases additionally run their fleets with
+      PADDLE_TRACE=1 and assert on the assembled lifecycles (full hop
+      chain, zero negative spans, phase p99s summing to the e2e p99
+      within BENCH_TRACE_SUM_TOL).  Emits serving_trace_overhead.
+
     Replicas are clean re-execed CPU-backend interpreters (same dance as
     --faults), so this runs under the orchestrator or standalone —
     ``--cpu-mesh N`` recommended off-TPU.  Knobs: BENCH_FLEET_REPLICAS
@@ -1844,7 +1853,7 @@ def fleet_bench():
     env.pop("PADDLE_AOT_CACHE_DIR", None)
     phases = [p.strip() for p in os.environ.get(
         "BENCH_FLEET_PHASES",
-        "chaos,autoscale,aot,disagg,kvtier,routerchaos").split(",")
+        "chaos,autoscale,aot,disagg,trace,kvtier,routerchaos").split(",")
         if p.strip()]
     try:
         if "chaos" in phases:
@@ -1855,6 +1864,8 @@ def fleet_bench():
             _fleet_aot_phase(work, env)
         if "disagg" in phases:
             _fleet_disagg_phase(work, env)
+        if "trace" in phases:
+            _fleet_trace_phase(work, env)
         if "kvtier" in phases:
             _fleet_kvtier_phase(work, env)
         if "routerchaos" in phases:
@@ -2334,11 +2345,20 @@ def _fleet_disagg_phase(work, env):
     comparison (BENCH_DISAGG_UNIFIED=0 skips it — the smoke's budget):
     there the long prefills share executors with short decodes, so the
     shorts' end-to-end p99 degrades — the number the JSON reports next
-    to the flat disaggregated one.  Emits fleet_disagg_decode_p99_s."""
+    to the flat disaggregated one.  Emits fleet_disagg_decode_p99_s.
+
+    The disaggregated fleet runs with PADDLE_TRACE=1 (ISSUE 19): every
+    short request's assembled lifecycle must carry the full hop chain
+    (admit -> dispatch -> park -> ship -> inject -> completion -> ack)
+    with ZERO negative spans after clock-skew correction, and the
+    per-phase p99 attribution must SUM to within BENCH_TRACE_SUM_TOL
+    (10%) of the measured e2e p99 — the telescoping-boundary contract.
+    The rollup is embedded as the JSON line's "trace" block."""
     import threading
 
     import numpy as np
     from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.observability import aggregate, timeline
     from paddle_tpu.observability.metrics import nearest_rank_percentile
 
     n_short = int(os.environ.get("BENCH_DISAGG_SHORT", 16))
@@ -2429,9 +2449,17 @@ def _fleet_disagg_phase(work, env):
                        else r.latency()) for r in reqs)
         return nearest_rank_percentile(lats, pctl)
 
-    # ---- disaggregated fleet: quiet then loaded, one boot ----
+    # ---- disaggregated fleet: quiet then loaded, one boot, traced ----
+    tel = os.path.join(work, "disagg", "telemetry")
+    # replicas inherit the trace knobs via env; the router IS this
+    # process, so it gets them through os.environ + configure — both
+    # restored before the untraced unified comparison boots
+    trace_prev = os.environ.get("PADDLE_TRACE")
+    os.environ["PADDLE_TRACE"] = "1"
+    timeline.configure(tel)
     fleet = ServingFleet(
-        spec, roles=["prefill", "decode"], env_base=env,
+        spec, roles=["prefill", "decode"],
+        env_base=dict(env, PADDLE_TELEMETRY_DIR=tel, PADDLE_TRACE="1"),
         jit_cache_dir=cache,
         log_dir=os.path.join(work, "disagg", "logs"),
         heartbeat_s=30, restart_backoff_s=0.2)
@@ -2442,6 +2470,11 @@ def _fleet_disagg_phase(work, env):
         st = fleet.stats()
     finally:
         fleet.close()
+        if trace_prev is None:
+            os.environ.pop("PADDLE_TRACE", None)
+        else:
+            os.environ["PADDLE_TRACE"] = trace_prev
+        timeline.configure(None)
     assert n_longs > 0, "the hammer never submitted a long prompt"
     assert st["kv_handoffs"] > 0, st
     assert st["replicas_by_role"] == {"decode": 1, "prefill": 1}, st
@@ -2458,6 +2491,57 @@ def _fleet_disagg_phase(work, env):
         "the decode pool")
     e2e_quiet_d = p99_of(quiet_shorts, "e2e")
     e2e_loaded_d = p99_of(loaded_shorts, "e2e")
+
+    # ---- trace assembly over the disaggregated run (ISSUE 19) ----
+    sum_tol = float(os.environ.get("BENCH_TRACE_SUM_TOL", 0.10))
+    lifecycles = aggregate.assemble_traces(tel)
+    shorts_lc = [lc for lc in lifecycles
+                 if (lc.get("priority") or "") == "interactive"]
+    assert len(shorts_lc) == 2 * n_short, (
+        f"expected {2 * n_short} short lifecycles (quiet + loaded), "
+        f"assembled {len(shorts_lc)} of {len(lifecycles)} total")
+    hop_chain = ("admit", "dispatch", "park", "ship", "inject",
+                 "completion", "ack")
+    for lc in shorts_lc:
+        hops = lc["hops"]
+        idx = []
+        for h in hop_chain:
+            assert h in hops, (lc["request_id"], h, hops)
+            idx.append(hops.index(h))
+        assert idx == sorted(idx), (
+            f"{lc['request_id']}: hops out of causal order: {hops}")
+        assert lc["negative_spans"] == 0, lc
+    attr = aggregate.trace_attribution(shorts_lc)
+    assert attr["negative_spans"] == 0, attr
+    # the telescoping contract is PER LIFECYCLE: the p99-rank request's
+    # phase decomposition must sum to its measured e2e latency (its
+    # e2e IS the rollup's nearest-rank e2e p99).  Summing each phase's
+    # independent p99 would mix different requests' worst cases and is
+    # NOT expected to telescope.
+    by_e2e = sorted(shorts_lc, key=lambda lc: lc["e2e_s"])
+    p99_lc = by_e2e[max(1, math.ceil(0.99 * len(by_e2e))) - 1]
+    e2e_p99_t = p99_lc["e2e_s"]
+    assert abs(e2e_p99_t - attr["e2e"]["p99"]) < 1e-6, (
+        e2e_p99_t, attr["e2e"])
+    phase_sum_p99 = sum(p99_lc["phases"].values())
+    drift = abs(phase_sum_p99 - e2e_p99_t) / max(e2e_p99_t, 1e-9)
+    assert drift <= sum_tol, (
+        f"p99-rank lifecycle {p99_lc['request_id']}: phase attribution "
+        f"sums to {phase_sum_p99:.4f}s vs its measured e2e "
+        f"{e2e_p99_t:.4f}s ({drift:.1%} apart; tolerance "
+        f"{sum_tol:.0%}) — the phase boundaries no longer telescope")
+    trace_block = {
+        "lifecycles": len(shorts_lc),
+        "negative_spans": 0,
+        "dominant_phase": attr.get("dominant_phase"),
+        "phases_p99_s": {ph: attr["phases"][ph]["p99"]
+                         for ph in attr["phases"]},
+        "p99_request": p99_lc["request_id"],
+        "p99_breakdown_s": p99_lc["phases"],
+        "phase_sum_p99_s": round(phase_sum_p99, 4),
+        "e2e_p99_s": round(e2e_p99_t, 4),
+        "sum_drift": round(drift, 4),
+    }
 
     # ---- unified comparison: same waves, 2 unified replicas ----
     unified = None
@@ -2498,6 +2582,7 @@ def _fleet_disagg_phase(work, env):
         "handoff_reships": st["handoff_reships"],
         "roles": {"prefill": 1, "decode": 1},
         "unified_baseline": unified,
+        "trace": trace_block,
     }), flush=True)
     print(f"# disagg: decode p{pctl:g} {p99_quiet * 1e3:.0f}ms quiet -> "
           f"{p99_loaded * 1e3:.0f}ms under {n_longs} long-prompt "
@@ -2508,6 +2593,102 @@ def _fleet_disagg_phase(work, env):
              f" -> {unified['p99_loaded_s'] * 1e3:.0f}ms "
              f"({unified['degradation']:.2f}x)" if unified else ""),
           file=sys.stderr)
+    print(f"# disagg-trace: {len(shorts_lc)} lifecycles assembled, full "
+          f"hop chain, 0 negative spans; phase p99 sum "
+          f"{phase_sum_p99 * 1e3:.0f}ms vs e2e p99 "
+          f"{e2e_p99_t * 1e3:.0f}ms ({drift:.1%} <= {sum_tol:.0%}), "
+          f"dominant phase {attr.get('dominant_phase')}",
+          file=sys.stderr)
+
+
+def _fleet_trace_phase(work, env):
+    """ISSUE 19: full trace capture must be cheap enough to leave on —
+    tracing-on serving throughput within BENCH_TRACE_OVERHEAD (0.95x)
+    of tracing-off on the SAME engine.
+
+    One in-process paged engine serves identical waves with the
+    telemetry dir active in BOTH arms (serving_step JSONL is the PR-4
+    baseline cost); only PADDLE_TRACE flips.  Arms interleave
+    off/on/off/on for BENCH_TRACE_ROUNDS rounds and compare MEDIANS, so
+    box weather (the 1.5x day-to-day CPU swing) hits both equally.
+    Also asserts the traced arm actually captured span events — a
+    "free" tracer that emitted nothing would pass the ratio trivially.
+    Emits the serving_trace_overhead JSON metric line."""
+    import numpy as np
+
+    import jax
+    from paddle_tpu.inference.serving import PagedServingEngine
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.observability import aggregate, timeline
+
+    floor = float(os.environ.get("BENCH_TRACE_OVERHEAD", 0.95))
+    rounds = int(os.environ.get("BENCH_TRACE_ROUNDS", 5))
+    n_req = int(os.environ.get("BENCH_TRACE_REQUESTS", 24))
+    gen = int(os.environ.get("BENCH_TRACE_TOKENS", 32))
+
+    cfg = G.GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                      num_heads=2, max_seq_len=128, dtype="float32",
+                      use_flash=False, remat=False)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedServingEngine((params, cfg), slots=4, max_len=64,
+                             page_size=8, seq_buckets=(16,),
+                             batch_buckets=(1, 2))
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 256, int(rng.randint(6, 14)))
+               for _ in range(n_req)]
+    tel = os.path.join(work, "trace_overhead")
+
+    def wave():
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, gen) for p in prompts]
+        while any(not (r.done or r.failed) for r in reqs):
+            eng.step()
+        assert all(r.done for r in reqs)
+        return sum(len(r.tokens) for r in reqs) \
+            / (time.perf_counter() - t0)
+
+    trace_prev = os.environ.get("PADDLE_TRACE")
+    timeline.configure(tel)
+    tps_off, tps_on = [], []
+    try:
+        os.environ["PADDLE_TRACE"] = "0"
+        wave()                        # prime every executable first
+        for r in range(rounds):
+            # alternate arm order: within-process drift (allocator
+            # growth, page-cache warmth) must not always land on the
+            # same arm
+            arms = ("0", "1") if r % 2 == 0 else ("1", "0")
+            for arm in arms:
+                os.environ["PADDLE_TRACE"] = arm
+                (tps_on if arm == "1" else tps_off).append(wave())
+    finally:
+        if trace_prev is None:
+            os.environ.pop("PADDLE_TRACE", None)
+        else:
+            os.environ["PADDLE_TRACE"] = trace_prev
+        timeline.configure(None)
+    off = sorted(tps_off)[len(tps_off) // 2]
+    on = sorted(tps_on)[len(tps_on) // 2]
+    ratio = on / off
+    n_span = len(aggregate.trace_events_from_dir(tel))
+    assert n_span > 0, "traced arm captured zero span events"
+    assert ratio >= floor, (
+        f"tracing-on throughput {on:.0f} tok/s is {ratio:.3f}x of "
+        f"tracing-off {off:.0f} tok/s (floor {floor}x) — full capture "
+        "is no longer cheap enough to leave on")
+    print(json.dumps({
+        "metric": "serving_trace_overhead",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        "floor": floor,
+        "tps_off": round(off, 1),
+        "tps_on": round(on, 1),
+        "rounds": rounds,
+        "trace_events": n_span,
+    }), flush=True)
+    print(f"# trace: tracing-on {on:.0f} tok/s vs off {off:.0f} tok/s "
+          f"({ratio:.3f}x >= {floor}x floor) with {n_span} span events "
+          "captured", file=sys.stderr)
 
 
 def _fleet_kvtier_phase(work, env):
@@ -2529,9 +2710,14 @@ def _fleet_kvtier_phase(work, env):
     two runs (greedy determinism — a corrupt spill or misrouted chain
     would break parity); decode_compiles == 1 and zero steady-state
     compiles on every replica; zero lost requests.  Emits the
-    fleet_prefix_hit_rate JSON metric line."""
+    fleet_prefix_hit_rate JSON metric line.
+
+    The fleet run (not the giant baseline) is traced (ISSUE 19):
+    unified lifecycles must assemble with zero negative spans, and the
+    one-line trace posture rides the JSON as its "trace" block."""
     import numpy as np
     from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.observability import aggregate, timeline
     from paddle_tpu.testing import traffic as T
 
     ratio_bound = float(os.environ.get("BENCH_KVTIER_RATIO", 1.3))
@@ -2564,9 +2750,10 @@ def _fleet_kvtier_phase(work, env):
     crng = np.random.RandomState(91)
     churn = [crng.randint(1, 256, 14) for _ in range(n_churn)]
 
-    def run(tag, spec, replicas):
+    def run(tag, spec, replicas, env_run=None):
         fleet = ServingFleet(
-            spec, replicas=replicas, env_base=env, jit_cache_dir=cache,
+            spec, replicas=replicas, env_base=env_run or env,
+            jit_cache_dir=cache,
             log_dir=os.path.join(work, tag, "logs"),
             heartbeat_s=30, restart_backoff_s=0.2)
         try:
@@ -2633,8 +2820,28 @@ def _fleet_kvtier_phase(work, env):
         toks = {rid: list(r.tokens) for rid, r in done.items()}
         return hits / max(hits + misses, 1), agg, fstats, toks
 
-    fleet_rate, agg, fstats, fleet_toks = run("kvtier", spec, 2)
+    # the fleet run is traced end to end; restore before the giant
+    # baseline so its boot stays an untraced control
+    tel = os.path.join(work, "kvtier", "telemetry")
+    trace_prev = os.environ.get("PADDLE_TRACE")
+    os.environ["PADDLE_TRACE"] = "1"
+    timeline.configure(tel)
+    try:
+        fleet_rate, agg, fstats, fleet_toks = run(
+            "kvtier", spec, 2,
+            env_run=dict(env, PADDLE_TELEMETRY_DIR=tel,
+                         PADDLE_TRACE="1"))
+    finally:
+        if trace_prev is None:
+            os.environ.pop("PADDLE_TRACE", None)
+        else:
+            os.environ["PADDLE_TRACE"] = trace_prev
+        timeline.configure(None)
     giant_rate, _g_agg, _g_fs, giant_toks = run("giant", giant, 1)
+    tsum = aggregate.trace_summary(tel)
+    assert tsum["traces"] >= len(fleet_toks), (
+        "kvtier lifecycles missing from trace assembly", tsum)
+    assert tsum["negative_spans"] == 0, tsum
 
     # token-exact parity across the two runs: same params + greedy =>
     # any served-from-tier byte corruption or misroute breaks this.
@@ -2680,6 +2887,7 @@ def _fleet_kvtier_phase(work, env):
         "prefix_migrations": fstats["prefix_migrations"],
         "requests": len(fleet_toks),
         "lost_requests": 0,
+        "trace": tsum,
     }), flush=True)
     print(f"# kvtier: sticky routing held {fstats['prefix_routed']} "
           f"dispatches for their prefix owner "

@@ -47,7 +47,7 @@ import collections
 import threading
 import time
 
-from ..observability import metrics, timeline
+from ..observability import metrics, timeline, tracing
 from ..testing import faults as _faults
 from .fleet import _env_float, _env_int
 
@@ -303,12 +303,16 @@ class Autoscaler:
             self._inc("scale_downs")
         self._cool_until = now + self.cooldown_s
         self._up_streak = self._down_streak = 0
+        # coherent per-process clock (ISSUE 19): tracing.now() is a wall
+        # anchor + monotonic deltas, so an NTP step mid-run can never
+        # reorder decisions; decisions also cite the dominant latency
+        # phase so a scale-up names WHAT it is scaling for
         rec = {"action": f"scale_{direction}", "replica": rid,
                "role": self.role,
-               "reasons": list(reasons), "t": time.time(),
+               "reasons": list(reasons), "t": tracing.now(),
                "signals": {k: sig.get(k) for k in (
                    "backlog", "pending_fraction", "occupancy", "p99_s",
-                   "configured", "healthy",
+                   "configured", "healthy", "dominant_phase",
                    "accepted_tokens_per_step", "spill_pressure")}}
         self.decisions.append(rec)
         self._g_target.set(target + (1 if direction == "up" else -1))

@@ -76,7 +76,7 @@ import uuid
 
 import numpy as np
 
-from ..observability import metrics, timeline
+from ..observability import metrics, timeline, tracing
 # pure numpy/hashlib helpers (kv_pager never imports jax): the router
 # computes the IDENTICAL sticky-routing digest a replica's pager does
 from .kv_pager import prompt_chain_keys, short_digest
@@ -256,6 +256,15 @@ class FleetRequest:
         # (perf_counter timelines don't survive the process)
         self.admit_wall = time.time()
         self.finish_t = None
+        # distributed tracing (ISSUE 19): the router mints trace_id at
+        # admission and threads it through every hop; the *_t stamps
+        # are tracing-clock phase boundaries (queue/prefill/parked/
+        # decode attribution — the autoscaler's dominant_phase signal)
+        self.trace_id = None
+        self.admit_t = None
+        self.dispatch_t = None        # FIRST dispatch (retries keep it)
+        self.park_t = None
+        self.ship_t = None
 
     def latency(self):
         return (self.finish_t - self.submit_t) \
@@ -314,6 +323,10 @@ def rebuild_request(view, now_wall=None, now_perf=None):
         req.submit_t = _journal.resume_submit_t(
             admit_wall, now_wall=now_wall, now_perf=now_perf)
     req.retries = int(view.get("retries") or 0)
+    # the trace id survives the crash with the admit record, so a
+    # post-recovery completion stitches into the SAME lifecycle the
+    # pre-crash hops started
+    req.trace_id = rec.get("trace")
     req.phase = view.get("phase")
     if req.phase == "decode":
         req.first_token = view.get("first_token")
@@ -494,6 +507,15 @@ class ServingFleet:
             or self.env_base.get("PADDLE_AOT_CACHE_DIR")
         self.telemetry_dir = telemetry_dir \
             or self.env_base.get("PADDLE_TELEMETRY_DIR")
+        # distributed tracing (ISSUE 19): the router is the trace root —
+        # it mints trace ids and owns the reference clock the assembler
+        # skew-corrects replicas against.  Its timeline events move to
+        # the utility rank's file (events_rank1000.jsonl) so they never
+        # interleave with — or race the rotation of — replica 0's file
+        # when both share the telemetry dir.
+        tracing.set_role("router")
+        if self.telemetry_dir:
+            timeline.set_rank_override(ROUTER_RANK)
         self.heartbeat_s = heartbeat_s if heartbeat_s is not None \
             else _env_float("PADDLE_FLEET_HEARTBEAT_S", 10.0)
         self.heartbeat_idle_s = heartbeat_idle_s
@@ -612,6 +634,11 @@ class ServingFleet:
         # completion — each pool's autoscaler reads ITS OWN signal
         self._lat_prefill_recent = collections.deque(maxlen=4096)
         self._lat_decode_recent = collections.deque(maxlen=4096)
+        # (finish-perf-t, {phase: seconds}, priority) per completion:
+        # the per-request latency decomposition behind autoscale's
+        # dominant_phase signal (all stamps one router clock, so the
+        # phases telescope to the end-to-end latency exactly)
+        self._phase_recent = collections.deque(maxlen=4096)
         self._g_configured.set(self.nreplicas)
         self._g_target.set(self.nreplicas)
 
@@ -810,7 +837,8 @@ class ServingFleet:
                 "deadline_s": req.deadline_s,
                 "priority": req.priority,
                 "phase": "prefill" if req.phase is not None else None,
-                "admit_wall": req.admit_wall}
+                "admit_wall": req.admit_wall,
+                "trace": req.trace_id}
 
     def _journal_snapshot(self):
         """Full live state as a record list — the compaction
@@ -936,6 +964,15 @@ class ServingFleet:
                     self._ready_queue_of(req).append(req)
                     self._inc("recovery_requeues")
             self._g_pending.set(len(self._pending))
+            replayed = sorted(self._pending)
+        # the restarted router files the crash postmortem its SIGKILLed
+        # predecessor could not: every in-flight id it replayed, before
+        # any of them redispatches (force: recovery is never coalesced
+        # away by the rate limiter)
+        tracing.dump("router_recovery", inflight=replayed,
+                     extra={"recovery_requeues": len(replayed),
+                            "journal_dir": self.journal_dir},
+                     force=True)
 
     def _readopt_done(self, rid):
         """One awaited worker resolved (readopt hello landed, or its
@@ -1001,6 +1038,11 @@ class ServingFleet:
                 else:
                     self._inc("sheds")
                     self._inc(f"sheds_{req.priority}")
+                    tracing.dump(
+                        "shed", inflight=[req.id],
+                        extra={"pending": len(self._pending),
+                               "max_pending": self.max_pending,
+                               "priority": req.priority})
                     raise FleetOverloaded(
                         f"pending table at max_pending "
                         f"{self.max_pending} "
@@ -1019,7 +1061,12 @@ class ServingFleet:
              else self._ready_lo).append(req)
             self._inc("requests_admitted")
             self._g_pending.set(len(self._pending))
+            req.trace_id = tracing.mint()
             self._jrec(self._admit_rec(req))
+            req.admit_t = tracing.event(
+                "admit", trace_id=req.trace_id, request_id=req.id,
+                priority=req.priority, phase=req.phase,
+                prompt_len=len(req.prompt))["t"]
         return req
 
     def _shed_batch_victim_locked(self, for_id):
@@ -1253,6 +1300,9 @@ class ServingFleet:
                     req.replicas_tried.append(r.id)
                 r.inflight[cid] = req
                 claims.append(cid)
+                tracing.event("readopt_claim", trace_id=req.trace_id,
+                              request_id=cid, replica=r.id,
+                              incarnation=r.incarnation)
             r.pending_cancel.extend(stale)
         self._inc("readopts")
         ev = {"replica": r.id, "incarnation": r.incarnation,
@@ -1316,6 +1366,13 @@ class ServingFleet:
         timeline.emit({"event": "fleet_incident", "replica": r.id,
                        "incarnation": r.incarnation, "reason": reason,
                        "exit_code": rc, "requeued": len(victims)})
+        # flight recorder: the postmortem names the in-flight ids and
+        # their last hop, not just a requeue counter bump
+        tracing.dump("replica_incident",
+                     inflight=[q.id for q in victims],
+                     extra={"replica": r.id, "reason": str(reason),
+                            "exit_code": rc,
+                            "incarnation": r.incarnation})
         # a recovery window must not wait forever on a replica that
         # just died instead of re-helloing
         self._readopt_done(r.id)
@@ -1359,9 +1416,20 @@ class ServingFleet:
         rc = self._proc_rc(r)
         if rc is not None:
             raise _ReplicaGone(f"process exited rc={rc}")
+        # clock-skew pairs: every traced RPC carries the sender's
+        # tracing-clock stamp ("ts"); the receiver's rpc_recv echo of it
+        # is what trace assembly bounds per-process offsets with
+        traced = tracing.enabled()
+        if traced:
+            msg["ts"] = tracing.now()
         try:
             send_msg(r.conn, msg)
-            return recv_msg(r.conn)
+            resp = recv_msg(r.conn)
+            if traced and resp.get("ts") is not None:
+                tracing.event("rpc_recv", peer_sent=resp["ts"],
+                              peer_pid=resp.get("pid"), replica=r.id,
+                              op=msg.get("op"))
+            return resp
         except socket.timeout as e:
             self._inc("heartbeat_misses")
             raise _ReplicaGone(
@@ -1600,6 +1668,12 @@ class ServingFleet:
                 batch.append(req)
                 self._jrec({"t": "dispatch", "id": req.id,
                             "rep": r.id})
+                rec = tracing.event(
+                    "dispatch", trace_id=req.trace_id,
+                    request_id=req.id, replica=r.id, phase=req.phase,
+                    retry=req.retries, priority=req.priority)
+                if req.dispatch_t is None:
+                    req.dispatch_t = rec["t"]
             # restore skipped work at the HEAD in reverse pop order —
             # queue order is preserved exactly, so a handed-off request
             # _handoff put at the front (mid-flight work) keeps its
@@ -1625,6 +1699,14 @@ class ServingFleet:
                         # the same payload crossing again: a decode
                         # replica died/dropped it — zero-lost re-ships
                         self._inc("handoff_reships")
+                    rec = tracing.event(
+                        "ship", trace_id=q.trace_id, request_id=q.id,
+                        replica=r.id, kv_bytes=q.kv_bytes,
+                        reship=q.kv_ships > 1, priority=q.priority)
+                    if q.ship_t is None:
+                        q.ship_t = rec["t"]
+            if q.trace_id is not None:
+                item["trace"] = q.trace_id
             items.append(item)
         resp = self._rpc(r, {"op": "submit", "requests": items})
         rejected = resp.get("rejected") or []
@@ -1653,6 +1735,9 @@ class ServingFleet:
                         req.kv_ships -= 1
                     req.not_before = time.perf_counter() + 0.05
                     self._ready_queue_of(req).append(req)
+                    tracing.event("requeue", trace_id=req.trace_id,
+                                  request_id=req.id, replica=r.id,
+                                  reason="backpressure")
 
     def _handle_step_resp(self, r, resp):
         for fin in resp.get("finished") or []:
@@ -1715,6 +1800,10 @@ class ServingFleet:
                 self._inc("prefix_migrations")
                 self._inc("migration_bytes", req.kv_bytes)
             self._ready_queue_of(req).appendleft(req)
+            req.park_t = tracing.event(
+                "park", trace_id=req.trace_id, request_id=req.id,
+                replica=r.id, kv_bytes=req.kv_bytes,
+                priority=req.priority)["t"]
         return True
 
     def _complete(self, fin, r):
@@ -1748,8 +1837,40 @@ class ServingFleet:
                 self._lat_decode_recent.append(
                     (req.finish_t, req.finish_t - req.decode_t0,
                      req.priority))
+            ack_t = tracing.event(
+                "ack", trace_id=req.trace_id, request_id=req.id,
+                replica=r.id, tokens=len(req.tokens),
+                finish_reason=req.finish_reason, priority=req.priority,
+                latency_s=round(lat, 6))["t"]
+            phases = self._phase_split(req, ack_t)
+            if phases:
+                self._phase_recent.append(
+                    (req.finish_t, phases, req.priority))
             self._g_pending.set(len(self._pending))
         return True
+
+    @staticmethod
+    def _phase_split(req, ack_t):
+        """Router-side per-request latency decomposition from the
+        tracing-clock boundary stamps.  Every boundary is used exactly
+        once, so the phases TELESCOPE: their sum equals ack - admit —
+        the attribution is exact, not sampled.  The decode phase here
+        is the router's view (ship -> ack: inject + decode + reply
+        hop); the assembled trace splits it finer with replica-side
+        events."""
+        if req.admit_t is None or req.dispatch_t is None:
+            return None
+        ph = {"queue": req.dispatch_t - req.admit_t}
+        if req.park_t is not None:
+            ph["prefill"] = req.park_t - req.dispatch_t
+            if req.ship_t is not None:
+                ph["parked"] = req.ship_t - req.park_t
+                ph["decode"] = ack_t - req.ship_t
+            else:
+                ph["decode"] = ack_t - req.park_t
+        else:
+            ph["service"] = ack_t - req.dispatch_t
+        return {k: round(max(v, 0.0), 6) for k, v in ph.items()}
 
     def _evict_locked(self, table):
         """Keep a finished-request table inside done_retention (dicts
@@ -1782,6 +1903,9 @@ class ServingFleet:
         req.replica = None
         self._jrec({"t": "requeue", "id": req.id,
                     "retries": req.retries})
+        tracing.event("requeue", trace_id=req.trace_id,
+                      request_id=req.id, retries=req.retries,
+                      reason=str(reason)[:160])
         # re-queued work jumps the line: it has already waited longest
         self._ready_queue_of(req).appendleft(req)
 
@@ -1798,6 +1922,14 @@ class ServingFleet:
         self._inc("requests_failed")
         self._g_pending.set(len(self._pending))
         self._jrec({"t": "fail", "id": req.id, "reason": reason})
+        tracing.event("fail", trace_id=req.trace_id,
+                      request_id=req.id, reason=str(reason)[:160],
+                      priority=req.priority)
+        if str(reason).startswith("shed_overload"):
+            # the shed postmortem names its victim (rate-limited: a
+            # shed storm is one dump, the counters carry the volume)
+            tracing.dump("shed", inflight=[req.id],
+                         extra={"reason": str(reason)[:200]})
 
     def _sweep_deadlines(self, r):
         now = time.perf_counter()
@@ -1950,8 +2082,11 @@ class ServingFleet:
             if self._stop.is_set():
                 raise RuntimeError("fleet is closed")
             r = self._new_replica(role)
+            # stamped from the tracing clock (one wall anchor +
+            # monotonic deltas): an NTP step mid-run can never reorder
+            # scale records against the trace events they sit between
             ev = {"action": "scale_up", "replica": r.id, "role": role,
-                  "t": time.time()}
+                  "t": tracing.now()}
             self.scale_events.append(ev)
             r.scale_ev = ev
             self._replicas.append(r)
@@ -2015,7 +2150,7 @@ class ServingFleet:
                 self._inc("scale_downs")
                 self.scale_events.append(
                     {"action": "scale_down", "replica": r.id,
-                     "role": r.role, "t": time.time()})
+                     "role": r.role, "t": tracing.now()})
                 timeline.emit({"event": "fleet_scale_down",
                                "replica": r.id,
                                "inflight_at_drain": len(r.inflight)})
@@ -2183,6 +2318,7 @@ class ServingFleet:
                     "sheds": self._counts.get("sheds", 0),
                     "accepted_tokens_per_step": 0.0,
                     "spill_pressure": 0.0,
+                    "dominant_phase": None,
                 }
             if want_phase is None:
                 backlog = len(self._ready_hi) + len(self._ready_lo)
@@ -2225,6 +2361,16 @@ class ServingFleet:
                           if now - t <= window_s)
             sheds = self._counts.get("sheds", 0)
             configured = len(reps)
+            # per-phase attribution (ISSUE 19): which lifecycle phase
+            # dominates recent completions — the scale decision record
+            # cites it, so "scaled up on p99" also says WHY p99 rose
+            phase_sums = {}
+            for (t, ph, _p) in self._phase_recent:
+                if now - t <= window_s:
+                    for k, v in ph.items():
+                        phase_sums[k] = phase_sums.get(k, 0.0) + v
+        dominant = max(phase_sums, key=phase_sums.get) \
+            if phase_sums else None
         return {
             "role": role, "recovering": False,
             "backlog": backlog, "pending": pending,
@@ -2238,6 +2384,7 @@ class ServingFleet:
                 round(sum(accepted) / len(accepted), 4)
                 if accepted else 0.0),
             "spill_pressure": max(spill) if spill else 0.0,
+            "dominant_phase": dominant,
         }
 
     # ------------------------------------------------------------- public
@@ -2464,6 +2611,10 @@ class ServingFleet:
             self._journal.compact(self._journal_snapshot())
             self._journal.close()
             self._journal = None
+        if self.telemetry_dir:
+            # release the router's claim on the utility-rank event file
+            # (a later engine in THIS process writes at its own rank)
+            timeline.set_rank_override(None)
 
     # the production name for the same teardown; tests assert it
     # returns promptly even mid-restart-backoff

@@ -38,6 +38,7 @@ import socket
 import sys
 import time
 
+from ..observability import tracing
 from .fleet import FleetOverloaded, ServingFleet, recv_msg, send_msg
 
 # spelled out through importlib: paddle_tpu.distributed exports a
@@ -178,6 +179,17 @@ def supervise_router(env=None, max_restarts=8, backoff=0.5,
                                       t0=t0)
         rec["role"] = "router"
         incidents.append(rec)
+        # incident trail (ISSUE 19): the supervisor is the only witness
+        # to a SIGKILLed router, so IT files the death event + flight
+        # dump; the relaunched router's journal replay files the
+        # companion "router_recovery" dump naming the in-flight ids
+        tracing.event("router_death", rc=rc,
+                      signal=_launch.signal_name(rc),
+                      incarnation=incarnation)
+        tracing.dump("router_kill",
+                     extra={"rc": rc, "incarnation": incarnation,
+                            "signal": _launch.signal_name(rc),
+                            "log": worker.get("log_path")})
         print(f"# fleet_supervisor: router died rc={rc} "
               f"({_launch.signal_name(rc)}), incarnation "
               f"{incarnation} -> relaunching against the same journal",

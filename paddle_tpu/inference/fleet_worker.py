@@ -55,7 +55,7 @@ import socket
 import sys
 import time
 
-from ..observability import metrics, timeline
+from ..observability import metrics, timeline, tracing
 from ..testing import faults as _faults
 from .fleet import recv_msg, send_msg
 
@@ -259,6 +259,11 @@ def serve(sock, engine, replica, incarnation, role="unified",
         except (ConnectionError, OSError):
             return "gone"                  # router went away
         op = str(msg.get("op", ""))
+        if tracing.enabled() and msg.get("ts") is not None:
+            # the receive half of the clock-skew pair: this replica's
+            # clock reading of the router's send stamp bounds the
+            # assembler's per-process offset from below
+            tracing.event("rpc_recv", peer_sent=msg["ts"], op=op)
         if _faults.active() and _faults.rpc_entry(op):
             # rpc_drop: vanish without replying — the router must treat
             # us as unhealthy and re-deliver elsewhere
@@ -278,6 +283,11 @@ def serve(sock, engine, replica, incarnation, role="unified",
                                   item.get("max_new_tokens", 16),
                                   eos_token=item.get("eos_token"),
                                   request_id=item["id"])
+                    # the router's trace id rides every dispatch: the
+                    # engine's span events (queue_wait, prefill_chunk,
+                    # extract, inject, decode, completion) stitch into
+                    # the same lifecycle
+                    req.trace_id = item.get("trace")
                     phase = item.get("phase")
                     if phase == "decode":
                         # the disaggregation handoff: the router ships
@@ -361,6 +371,11 @@ def serve(sock, engine, replica, incarnation, role="unified",
         # cancels ride every message, not just "cancel" ops
         for rid in msg.get("cancel") or []:
             engine.cancel(rid)
+        if tracing.enabled():
+            # the reply half of the skew pair (bounds the offset from
+            # above) + the pid trace assembly groups this clock under
+            resp["ts"] = tracing.now()
+            resp["pid"] = os.getpid()
         try:
             send_msg(sock, resp)
         except OSError:
@@ -407,13 +422,16 @@ def _readopt_hello(sock, engine, replica, incarnation, role):
     """The surviving worker's RE-hello: same attestations as a boot
     hello (the relaunched router re-checks the numeric contract) plus
     ``readopt`` and the in-flight id claims."""
+    claims = engine.active_request_ids()
     send_msg(sock, {"op": "hello", "readopt": True,
                     "replica": replica, "pid": os.getpid(),
                     "incarnation": incarnation,
-                    "inflight": engine.active_request_ids(),
+                    "inflight": claims,
                     "persistent_cache": _cache_counters(),
                     "compile": _compile_counters(),
                     "stats": _stats(engine, {"role": role})})
+    tracing.event("readopt_hello", replica=replica,
+                  incarnation=incarnation, claims=len(claims))
 
 
 def main(argv=None):
@@ -440,6 +458,7 @@ def main(argv=None):
     _faults.slow_start_check()
 
     t0 = time.perf_counter()
+    tracing.set_role("replica", args.replica)
     # the compile hook must be live BEFORE the engine builds so the
     # hello's xla_compiles attestation covers every boot compile
     timeline.install_compile_hook()

@@ -70,7 +70,7 @@ import struct
 import threading
 import time
 
-from ..observability import metrics
+from ..observability import metrics, tracing
 from ..testing import faults as _faults
 
 _LEN = struct.Struct(">I")
@@ -295,12 +295,24 @@ def replay(dirpath):
     yields a smaller-but-consistent state, and reconciliation handles
     the difference by re-queueing or failing named."""
     fam = _stats_family()
+    corrupt0 = fam["corrupt_records"]
+    torn0 = fam["torn_tails"]
     st = JournalState()
     for path in segment_paths(dirpath):
         for rec in _iter_records(path, fam):
             st.apply(rec)
     fam.inc("replays")
     fam.inc("replayed_records", st.records)
+    # incident hook (ISSUE 19): journal damage files a flight dump —
+    # the postmortem names the last trace hops, not just a counter
+    corrupt = fam["corrupt_records"] - corrupt0
+    torn = fam["torn_tails"] - torn0
+    if corrupt or torn:
+        tracing.dump("journal_damage",
+                     extra={"dir": str(dirpath),
+                            "corrupt_records": corrupt,
+                            "torn_tails": torn,
+                            "replayed_records": st.records})
     return st
 
 

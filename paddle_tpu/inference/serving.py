@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import itertools
 import os
 import time
 import uuid
@@ -49,10 +50,15 @@ import numpy as np
 from ..framework import compile_cache as _cc
 from ..framework import jax_compat
 from ..models import gpt
-from ..observability import metrics, timeline
+from ..observability import metrics, timeline, tracing
 from ..testing import faults as _faults
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4)
+
+# per-process engine instance ids: serving_step / request_complete
+# events stamp "engine" so multi-engine processes (tests, spec decode's
+# draft+target pair) stay distinguishable in one rank's JSONL
+_ENGINE_IDS = itertools.count()
 
 
 class ServingQueueFull(RuntimeError):
@@ -183,6 +189,13 @@ class Request:
         self.finish_reason = None   # "length" | "eos"
         self.submit_t = time.perf_counter()
         self.finish_t = None
+        # distributed tracing (ISSUE 19): the router mints this at
+        # admission and ships it on every RPC hop; engine-side span
+        # events carry it so cross-process assembly stitches one
+        # lifecycle.  Direct (non-fleet) engine use mints its own when
+        # tracing is on — a fleet worker overwrites it with the
+        # router's id before any span event fires.
+        self.trace_id = tracing.mint() if tracing.enabled() else None
 
     @property
     def output(self):
@@ -349,6 +362,7 @@ class ServingEngine:
         # (PADDLE_FLEET_REPLICA, set by the router) so per-replica
         # latency joins across the fleet's merged telemetry
         self._replica = os.environ.get("PADDLE_FLEET_REPLICA")
+        self._engine_id = next(_ENGINE_IDS)
         self._h_req = metrics.histogram(
             "serving.request_latency_s",
             **({"replica": self._replica} if self._replica else {}))
@@ -618,6 +632,13 @@ class ServingEngine:
             # but are not in _slot_req yet — a prefill failure must mark
             # them re-queueable too, not silently lose them
             self._admitting = group
+            if tracing.enabled() and not self._warming:
+                for req in group:
+                    tracing.event(
+                        "queue_wait", trace_id=req.trace_id,
+                        request_id=req.id, batch=bbucket, seq=sbucket,
+                        wait_s=round(
+                            time.perf_counter() - req.submit_t, 6))
             donate = self._donate()
             operands = (self.params, self._cache_k, self._cache_v,
                         jnp.asarray(toks), jnp.asarray(lens),
@@ -724,10 +745,23 @@ class ServingEngine:
                 timeline.emit({"event": "request_complete",
                                "request_id": str(req.id),
                                "replica": self._replica,
+                               # per-process total order + emitter id
+                               # (ISSUE 19): trace assembly sorts on seq
+                               # at equal timestamps
+                               "seq": tracing.seq(),
+                               "engine": self._engine_id,
                                "latency_s": round(
                                    req.finish_t - req.submit_t, 6),
                                "tokens": len(req.tokens),
                                "finish_reason": reason})
+            # distinct names per phase outcome: trace assembly uses the
+            # FIRST "completion" as the decode-end boundary, so the
+            # disagg prefill leg's finish must not shadow it
+            tracing.event("prefill_done" if reason == "prefill_done"
+                          else "completion",
+                          trace_id=req.trace_id, request_id=req.id,
+                          finish_reason=reason, tokens=len(req.tokens),
+                          engine=self._engine_id)
         if req.slot is not None:
             s = req.slot
             self._active[s] = False
@@ -792,6 +826,12 @@ class ServingEngine:
             self._inc("step_aborts")
             self._inc("requests_aborted", len(aborted))
             self._aborted.extend(aborted)
+            # incident flight dump: last-hop ring + the victims' ids —
+            # the postmortem names who was in flight, not just a counter
+            tracing.dump("engine_abort",
+                         inflight=[r.id for r in aborted],
+                         extra={"error": detail[:400],
+                                "engine": self._engine_id})
         return aborted
 
     def take_aborted(self):
@@ -888,8 +928,18 @@ class ServingEngine:
                            "queue": len(self._queue),
                            "decode_s": round(dt, 6),
                            "finished": len(finished),
+                           # per-process total order + emitter (ISSUE 19)
+                           "seq": tracing.seq(),
+                           "engine": self._engine_id,
+                           "replica": self._replica,
                            # stable ids: telemetry joins across replicas
                            "finished_ids": [str(r.id) for r in finished]})
+        if tracing.enabled() and not self._warming:
+            for r in finished:
+                tracing.event("decode_iter", trace_id=r.trace_id,
+                              request_id=r.id, iters=len(r.tokens),
+                              decode_s=round(dt, 6),
+                              engine=self._engine_id)
 
     def _tps_value(self):
         """Tokens/s over THIS engine's recent-sample window (0.0 until
@@ -1531,6 +1581,13 @@ class PagedServingEngine(ServingEngine):
         self._inc("prefix_page_misses", fresh)
         # visible to _abort_inflight, same contract as the base engine
         self._admitting = group
+        if tracing.enabled() and not self._warming:
+            for req in group:
+                tracing.event(
+                    "queue_wait", trace_id=req.trace_id,
+                    request_id=req.id, batch=bbucket, seq=sbucket,
+                    wait_s=round(
+                        time.perf_counter() - req.submit_t, 6))
         donate = self._donate()
         operands = (self.params, *self._cache_operands(),
                     jnp.asarray(toks), jnp.asarray(lens),
@@ -1711,6 +1768,11 @@ class PagedServingEngine(ServingEngine):
                   if self.capture_logits else None)
         self._inc("prefill_chunks")
         self._count_quant_matmuls()
+        if tracing.enabled() and not self._warming:
+            tracing.event("prefill_chunk", trace_id=req.trace_id,
+                          request_id=req.id, pos=pos, take=take,
+                          chunk_s=round(time.perf_counter() - t0, 6),
+                          engine=self._engine_id)
         req._chunk_pos = pos + take
         # the prefill histogram records the WHOLE admission's work, so
         # accumulate per-chunk durations and observe once at the end
@@ -1880,8 +1942,12 @@ class PagedServingEngine(ServingEngine):
         s = req.slot
         n_pages = len(self._pager.tables[s])
         req.kv_payload = self._extract_slot_kv(s, n_pages)
-        self._inc("kv_handoff_bytes",
-                  sum(int(a.nbytes) for a in req.kv_payload))
+        kv_bytes = sum(int(a.nbytes) for a in req.kv_payload)
+        self._inc("kv_handoff_bytes", kv_bytes)
+        if tracing.enabled():
+            tracing.event("extract", trace_id=req.trace_id,
+                          request_id=req.id, pages=n_pages,
+                          kv_bytes=kv_bytes, engine=self._engine_id)
         self._finish(req, "prefill_done")
 
     def submit_prefilled(self, req, first_token, kv_arrays):
@@ -2021,6 +2087,10 @@ class PagedServingEngine(ServingEngine):
             self._inc("prefix_page_hits", hits)
             self._inc("prefix_page_misses", n_pages - hits)
             self._inc("kv_injects")
+            if tracing.enabled() and not self._warming:
+                tracing.event("inject", trace_id=req.trace_id,
+                              request_id=req.id, pages=n_pages,
+                              prefix_hits=hits, engine=self._engine_id)
             self._tables_np[slot] = pages_row
             self._lens[slot] = len(req.prompt)
             self._active[slot] = True
@@ -2197,6 +2267,11 @@ class PagedServingEngine(ServingEngine):
                                "request_id": str(req.id),
                                "pages": len(miss),
                                "device_hits": n_pages - len(miss)})
+            if not self._warming:
+                tracing.event("fault_back", trace_id=req.trace_id,
+                              request_id=req.id, pages=len(miss),
+                              device_hits=n_pages - len(miss),
+                              engine=self._engine_id)
             if _faults.active() and not self._warming:
                 _faults.replica_kill_check(
                     request=self._counts["requests_admitted"])
@@ -2244,6 +2319,11 @@ class PagedServingEngine(ServingEngine):
             timeline.emit({"event": "page_exhaustion",
                            "request_id": str(req.id),
                            "action": "preempted", "reason": why})
+        if not self._warming:
+            tracing.event("preemption", trace_id=req.trace_id,
+                          request_id=req.id, reason=str(why)[:160],
+                          preemptions=req.preemptions,
+                          engine=self._engine_id)
 
     def _ensure_decode_pages(self):
         """Give every active slot a writable position for this step's
@@ -2355,7 +2435,17 @@ class PagedServingEngine(ServingEngine):
                                self._counts.get("pages_faulted_back", 0),
                            "chain_digests":
                                self._pager.stats()["chain_digest_count"],
+                           # per-process total order + emitter (ISSUE 19)
+                           "seq": tracing.seq(),
+                           "engine": self._engine_id,
+                           "replica": self._replica,
                            "finished_ids": [str(r.id) for r in finished]})
+        if tracing.enabled() and not self._warming:
+            for r in finished:
+                tracing.event("decode_iter", trace_id=r.trace_id,
+                              request_id=r.id, iters=len(r.tokens),
+                              decode_s=round(dt, 6),
+                              engine=self._engine_id)
 
     def _build_decode(self):
         jax, jnp = self._jax, self._jnp

@@ -73,7 +73,7 @@ import numpy as np
 
 from ..framework import compile_cache as _cc
 from ..models import gpt
-from ..observability import metrics, timeline
+from ..observability import metrics, timeline, tracing
 from ..testing import faults as _faults
 from .serving import PagedServingEngine, _donation_enabled
 
@@ -616,12 +616,25 @@ class SpeculativeServingEngine(PagedServingEngine):
                            "finished": len(finished),
                            "pages_in_use": self._pager.pages_in_use(),
                            "finished_ids": [str(r.id) for r in finished],
+                           # per-process total order + emitter (ISSUE 19)
+                           "seq": tracing.seq(),
+                           "engine": self._engine_id,
+                           "replica": self._replica,
                            "spec_mode": self._spec_mode_val,
                            "drafted": k * rows,
                            "accepted": committed - rows,
                            "committed": committed,
                            "accepted_tokens_per_step": round(
                                committed / max(1, rows), 4)})
+        if tracing.enabled() and not self._warming:
+            for r in finished:
+                tracing.event("decode_iter", trace_id=r.trace_id,
+                              request_id=r.id, iters=len(r.tokens),
+                              decode_s=round(dt, 6), drafted=k * rows,
+                              accepted=committed - rows,
+                              accepted_tokens_per_step=round(
+                                  committed / max(1, rows), 4),
+                              engine=self._engine_id)
 
     # --------------------------------------------------------------- views
     def accepted_tokens_per_step(self):

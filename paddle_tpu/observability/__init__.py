@@ -10,6 +10,11 @@ the step-timeline tracer, and cross-rank aggregation.
 * :mod:`.aggregate` — per-rank snapshot publish through the KV store /
   telemetry dir, and the group-wide merge with straggler detection
   (``tools/telemetry_report.py`` renders it).
+* :mod:`.tracing` — fleet-wide distributed request tracing (ISSUE 19):
+  router-minted trace ids, per-hop span events riding the timeline
+  JSONL, the in-memory incident flight recorder, and the coherent
+  per-process clock; ``aggregate`` stitches the per-rank events into
+  causally-ordered lifecycles (``tools/trace_report.py`` renders them).
 
 Registered families include the training fast paths (``dispatch_cache``,
 ``fused_step``, ``reducer``, ``prefetch``, ``faults``) and the inference
@@ -24,5 +29,6 @@ fault registry, the bootstrap) can register families; ``timeline`` and
 """
 from . import metrics        # noqa: F401
 from . import timeline       # noqa: F401
+from . import tracing        # noqa: F401
 from . import aggregate      # noqa: F401
 from .timeline import StepTimer, span  # noqa: F401

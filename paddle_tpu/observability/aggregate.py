@@ -382,6 +382,277 @@ def merge_from_dir(directory, straggler_gap_s=None, step_lag=None,
     return report
 
 
+# --------------------------------------------------------------------------
+# distributed-trace assembly (ISSUE 19)
+# --------------------------------------------------------------------------
+
+# which pool owns each lifecycle phase (the attribution rollup is "per
+# priority class and role"; the phase → role map IS the role axis)
+PHASE_ROLES = {"queue": "router", "prefill": "prefill",
+               "parked": "router", "inject": "decode",
+               "decode": "decode", "ack": "router",
+               "service": "unified"}
+PHASE_ORDER = ("queue", "prefill", "parked", "inject", "decode",
+               "service", "ack")
+
+
+def trace_events_from_dir(directory):
+    """Every ``trace`` record across the per-rank JSONL files (rotated
+    generation first), unsorted.  Unparseable lines are skipped — a
+    torn tail from a SIGKILLed writer must not sink the postmortem."""
+    events = []
+    for path in sorted(glob.glob(
+            os.path.join(directory, "events_rank*.jsonl"))):
+        for p in (path + ".1", path):
+            if not os.path.exists(p):
+                continue
+            with open(p, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "trace":
+                        events.append(rec)
+    return events
+
+
+def trace_clock_offsets(events):
+    """Per-pid clock offset (seconds to ADD to that process's ``t`` to
+    land on the router's clock), recovered from the RPC send/recv pairs:
+    every ``rpc_recv`` event carries the sender's ``peer_sent`` stamp.
+
+    For a router→replica message, causality says
+    ``router_send <= replica_recv + o``, bounding ``o`` from below; for
+    a replica→router reply, ``replica_send + o <= router_recv`` bounds
+    it from above.  The midpoint of the feasible interval is the
+    estimate (tightest when traffic flows both ways); with only one
+    bound we sit ON it — the zero-network-delay choice that keeps every
+    OBSERVED cross-process span non-negative.  Router pids are the
+    reference (offset 0)."""
+    lo, hi = {}, {}
+    for ev in events:
+        if ev.get("name") != "rpc_recv":
+            continue
+        sent = ev.get("peer_sent")
+        if sent is None or ev.get("t") is None:
+            continue
+        if ev.get("role") == "router":
+            peer = ev.get("peer_pid")
+            if peer is None:
+                continue
+            b = ev["t"] - sent
+            hi[peer] = b if peer not in hi else min(hi[peer], b)
+        else:
+            pid = ev.get("pid")
+            if pid is None:
+                continue
+            b = sent - ev["t"]
+            lo[pid] = b if pid not in lo else max(lo[pid], b)
+    offsets = {}
+    for pid in set(lo) | set(hi):
+        l, h = lo.get(pid), hi.get(pid)
+        if l is not None and h is not None:
+            offsets[pid] = (l + h) / 2.0 if l <= h else l
+        elif l is not None:
+            offsets[pid] = l
+        else:
+            offsets[pid] = h
+    return offsets
+
+
+def _first(evs, name):
+    for ev in evs:
+        if ev.get("name") == name:
+            return ev
+    return None
+
+
+def _trace_phases(evs):
+    """Per-request latency decomposition from one lifecycle's ordered
+    events.  Boundaries telescope — queue + prefill + parked + inject +
+    decode + ack == ack.t - admit.t exactly (disagg), so the rollup's
+    phases SUM to the end-to-end latency instead of approximating it.
+    Returns (phases dict, negative_span_count)."""
+    t = {}
+    for name in ("admit", "dispatch", "park", "ship", "inject",
+                 "completion", "ack"):
+        ev = _first(evs, name)
+        if ev is not None:
+            t[name] = ev.get("t_corrected", ev.get("t"))
+    phases = {}
+
+    def _span(label, a, b):
+        if a in t and b in t:
+            phases[label] = round(t[b] - t[a], 6)
+
+    _span("queue", "admit", "dispatch")
+    if "park" in t:                      # disaggregated lifecycle
+        _span("prefill", "dispatch", "park")
+        _span("parked", "park", "ship")
+        if "inject" in t:
+            _span("inject", "ship", "inject")
+            _span("decode", "inject", "completion")
+        else:
+            _span("decode", "ship", "completion")
+    else:                                # unified: one service phase
+        _span("service", "dispatch", "completion")
+    _span("ack", "completion", "ack")
+    negatives = sum(1 for v in phases.values() if v < -1e-6)
+    return phases, negatives
+
+
+def assemble_traces(directory=None, events=None):
+    """Stitch per-rank trace events into causally-ordered lifecycles.
+
+    Groups by ``trace_id``, applies per-pid clock-skew offsets from
+    :func:`trace_clock_offsets`, orders each lifecycle by corrected
+    time (per-process ``seq`` as the same-timestamp tiebreak), and
+    decomposes it into phases.  Returns lifecycles sorted by start
+    time, each::
+
+        {"trace_id", "request_id", "priority", "hops": [names...],
+         "events": [...], "phases": {...}, "e2e_s", "negative_spans",
+         "t0"}
+    """
+    if events is None:
+        events = trace_events_from_dir(directory)
+    offsets = trace_clock_offsets(events)
+    by_tid = {}
+    for ev in events:
+        tid = ev.get("trace_id")
+        if not tid or ev.get("t") is None:
+            continue
+        off = 0.0 if ev.get("role") == "router" \
+            else offsets.get(ev.get("pid"), 0.0)
+        ev = dict(ev)
+        ev["t_corrected"] = round(ev["t"] + off, 6)
+        by_tid.setdefault(tid, []).append(ev)
+    lifecycles = []
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: (e["t_corrected"], e.get("pid") or 0,
+                                e.get("seq") or 0))
+        phases, negatives = _trace_phases(evs)
+        admit = _first(evs, "admit")
+        ack = _first(evs, "ack")
+        t0 = (admit or evs[0])["t_corrected"]
+        t1 = (ack or evs[-1])["t_corrected"]
+        lifecycles.append({
+            "trace_id": tid,
+            "request_id": next((e.get("request_id") for e in evs
+                                if e.get("request_id")), None),
+            "priority": next((e.get("priority") for e in evs
+                              if e.get("priority")), None),
+            "hops": [e["name"] for e in evs],
+            "events": evs,
+            "phases": phases,
+            "e2e_s": round(t1 - t0, 6),
+            "negative_spans": negatives,
+            "t0": t0,
+        })
+    lifecycles.sort(key=lambda lc: lc["t0"])
+    return lifecycles
+
+
+def trace_attribution(lifecycles):
+    """Per-phase latency rollup over assembled lifecycles: p50/p95/p99
+    (+ mean, n, owning role) per phase, per priority class and overall,
+    plus the dominant phase (largest mean contribution) and the total
+    negative-span count (0 is the acceptance bar)."""
+    def _rollup(group):
+        series = {}
+        e2e = []
+        for lc in group:
+            for ph, v in lc["phases"].items():
+                series.setdefault(ph, []).append(v)
+            if lc["e2e_s"] is not None:
+                e2e.append(lc["e2e_s"])
+
+        def _stats(vals):
+            data = sorted(vals)
+            return {"n": len(data),
+                    "mean": round(sum(data) / len(data), 6),
+                    "p50": round(metrics.nearest_rank_percentile(
+                        data, 50), 6),
+                    "p95": round(metrics.nearest_rank_percentile(
+                        data, 95), 6),
+                    "p99": round(metrics.nearest_rank_percentile(
+                        data, 99), 6)}
+
+        phases = {}
+        for ph in PHASE_ORDER:
+            if series.get(ph):
+                phases[ph] = dict(_stats(series[ph]),
+                                  role=PHASE_ROLES.get(ph, "?"))
+        out = {"n": len(group), "phases": phases,
+               "e2e": _stats(e2e) if e2e else None}
+        if phases:
+            out["dominant_phase"] = max(
+                phases, key=lambda p: phases[p]["mean"])
+        return out
+
+    report = {"n": len(lifecycles),
+              "negative_spans": sum(lc["negative_spans"]
+                                    for lc in lifecycles)}
+    if lifecycles:
+        report.update(_rollup(lifecycles))
+        by_prio = {}
+        for lc in lifecycles:
+            by_prio.setdefault(lc.get("priority") or "default",
+                               []).append(lc)
+        report["by_priority"] = {p: _rollup(g)
+                                 for p, g in sorted(by_prio.items())}
+    return report
+
+
+def trace_summary(directory):
+    """One-line trace posture for a telemetry dir (the report tool's
+    ``--traces`` column): lifecycle count, event count, negative spans,
+    dominant phase, and how many flight-recorder dumps landed."""
+    events = trace_events_from_dir(directory)
+    lifecycles = assemble_traces(events=events)
+    attr = trace_attribution(lifecycles)
+    return {"traces": len(lifecycles), "trace_events": len(events),
+            "negative_spans": attr.get("negative_spans", 0),
+            "dominant_phase": attr.get("dominant_phase"),
+            "flight_dumps": len(glob.glob(
+                os.path.join(directory, "flight_*.json")))}
+
+
+def format_trace_report(attr):
+    """Text rendering of a :func:`trace_attribution` rollup."""
+    lines = ["== paddle_tpu trace attribution =="]
+    lines.append(f"lifecycles: {attr.get('n', 0)}   "
+                 f"negative spans: {attr.get('negative_spans', 0)}   "
+                 f"dominant phase: {attr.get('dominant_phase', '-')}")
+
+    def _fmt(v):
+        return f"{v * 1e3:8.1f}ms" if v is not None else "       -"
+
+    def _block(label, roll):
+        e2e = roll.get("e2e")
+        if e2e:
+            lines.append(f"  [{label}] n={roll['n']} e2e "
+                         f"p50={_fmt(e2e['p50'])} p95={_fmt(e2e['p95'])} "
+                         f"p99={_fmt(e2e['p99'])}")
+        for ph in PHASE_ORDER:
+            st = (roll.get("phases") or {}).get(ph)
+            if not st:
+                continue
+            lines.append(f"    {ph:<8} ({st['role']:<7}) n={st['n']:<5} "
+                         f"mean={_fmt(st['mean'])} p50={_fmt(st['p50'])} "
+                         f"p95={_fmt(st['p95'])} p99={_fmt(st['p99'])}")
+
+    if attr.get("phases"):
+        _block("all", attr)
+    for prio, roll in sorted((attr.get("by_priority") or {}).items()):
+        _block(prio, roll)
+    return "\n".join(lines)
+
+
 def format_report(report):
     """Human-readable text rendering of a merged report."""
     lines = ["== paddle_tpu telemetry report =="]

@@ -40,7 +40,26 @@ _ENV_MAX_MB = "PADDLE_TELEMETRY_MAX_MB"
 _ENV_INTERVAL = "PADDLE_TELEMETRY_INTERVAL"
 
 
+_rank_override = [None]
+
+
+def set_rank_override(rank):
+    """Pin this process's event-log rank (file name + stamped ``rank``).
+    The fleet router calls this with its utility rank (1000) so its
+    events land in ``events_rank1000.jsonl`` instead of colliding with
+    replica 0's file when both share a telemetry dir — two processes
+    appending and rotating one JSONL is how lines get torn.  ``None``
+    reverts to the env knob."""
+    with _writer_lock:
+        _rank_override[0] = rank
+        if _writer["file"] is not None:
+            _writer["file"].close()
+        _writer.update(dir=None, path=None, file=None, bytes=0)
+
+
 def _rank():
+    if _rank_override[0] is not None:
+        return int(_rank_override[0])
     try:
         return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     except ValueError:

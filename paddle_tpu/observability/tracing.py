@@ -1,0 +1,298 @@
+"""Fleet-wide distributed request tracing + the incident flight recorder.
+
+Dapper-style request tracing for the serving fleet (Sigelman et al.,
+2010): the router mints a ``trace_id`` at admission and threads it
+through every RPC hop — dispatch, step stats, handoff park/ship, KV
+inject, retry/re-queue, readopt claims — and both the router and the
+replica engines append span events for the hops they own (queue_wait,
+prefill_chunk, extract, park, ship, inject, decode_iter, completion,
+ack, preemption, fault_back).  Events ride the existing PR-4 timeline
+JSONL machinery (``events_rank<R>.jsonl`` under ``PADDLE_TELEMETRY_DIR``)
+so one artifact carries steps, spans, serving records AND traces;
+``observability.aggregate.assemble_traces`` stitches the per-rank files
+back into causally-ordered lifecycles, clock-skew-corrected via the RPC
+send/recv pairs each hop records.
+
+Three cost tiers, cheapest first:
+
+* **off (default)** — every :func:`event` call increments the ``trace.*``
+  counter family and appends the record to the in-memory flight-recorder
+  ring.  No JSON, no I/O.  Per-step hot paths additionally gate on
+  :func:`enabled` so the off path there is one env read.
+* **``PADDLE_TRACE=1``** — events are also emitted to the timeline JSONL
+  log (subject to ``PADDLE_TRACE_SAMPLE``, a deterministic per-trace
+  keep fraction), which is what trace assembly and
+  ``tools/trace_report.py`` read.
+* **incident** — :func:`dump` snapshots the ring (last
+  ``PADDLE_TRACE_RING`` events, default 4096) plus the caller's
+  in-flight request ids into ``flight_<reason>_*.json`` in the telemetry
+  dir.  Called on engine abort, replica SIGKILL detection, router crash
+  recovery, load shed, and journal damage — a chaos postmortem names the
+  requests that were in flight and their last hop instead of reading a
+  bare counter bump.
+
+Clock discipline (the negative-span fix): every record is stamped with
+``t`` from :func:`now` — ONE wall anchor plus ``time.monotonic`` deltas,
+captured at process start — so a mid-run NTP step never reorders events
+within a process.  Cross-process offsets are recovered at assembly time
+from the ``rpc_recv`` events' ``peer_sent`` echoes (see
+``aggregate.trace_clock_offsets``).
+
+Everything here is stdlib + the in-process metrics registry: no jax, no
+numpy — the router, the journal, and the worker bootstrap all import it
+before any framework state exists.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+
+from . import metrics, timeline
+
+_ENV_TRACE = "PADDLE_TRACE"
+_ENV_RING = "PADDLE_TRACE_RING"
+_ENV_SAMPLE = "PADDLE_TRACE_SAMPLE"
+
+_DEFAULT_RING = 4096
+_DUMP_MIN_INTERVAL_S = 2.0      # per-reason; a shed storm is one dump
+
+# --------------------------------------------------------------------------
+# one coherent clock per process (wall anchor + monotonic deltas)
+# --------------------------------------------------------------------------
+
+_WALL_ANCHOR = time.time()
+_MONO_ANCHOR = time.monotonic()
+_PID = os.getpid()
+
+
+def now():
+    """Coherent wall-clock seconds: one ``time.time()`` anchor captured
+    at import plus ``time.monotonic()`` deltas.  Immune to NTP steps —
+    two calls in one process NEVER go backwards, so within-process spans
+    are non-negative by construction."""
+    return _WALL_ANCHOR + (time.monotonic() - _MONO_ANCHOR)
+
+
+# --------------------------------------------------------------------------
+# identity: who is emitting (role/replica), total order (seq)
+# --------------------------------------------------------------------------
+
+_seq = itertools.count(1)
+_ident = {"role": "engine",
+          "replica": os.environ.get("PADDLE_FLEET_REPLICA")}
+
+
+def seq():
+    """Next per-process monotonic sequence number (shared with the
+    ``serving_step`` / ``request_complete`` stamps so one process's
+    events are totally ordered even at equal timestamps)."""
+    return next(_seq)
+
+
+def set_role(role, replica=None):
+    """Label this process's trace events (``router`` / ``replica`` /
+    ``supervisor``...).  Workers inherit their replica id from
+    ``PADDLE_FLEET_REPLICA``; the router calls this explicitly."""
+    _ident["role"] = str(role)
+    if replica is not None:
+        _ident["replica"] = str(replica)
+
+
+def role():
+    return _ident["role"]
+
+
+# --------------------------------------------------------------------------
+# knobs
+# --------------------------------------------------------------------------
+
+def enabled():
+    """Full span capture on?  (``PADDLE_TRACE=1``; the off path is
+    counters + flight-recorder ring only.)"""
+    return os.environ.get(_ENV_TRACE, "0") not in ("", "0", "false", "no")
+
+
+def ring_size():
+    try:
+        return max(0, int(os.environ.get(_ENV_RING, str(_DEFAULT_RING))))
+    except ValueError:
+        return _DEFAULT_RING
+
+
+def sample_rate():
+    try:
+        return min(1.0, max(0.0, float(
+            os.environ.get(_ENV_SAMPLE, "1.0"))))
+    except ValueError:
+        return 1.0
+
+
+def mint():
+    """A fresh 16-hex trace id (router calls this once per admission)."""
+    import uuid
+    return uuid.uuid4().hex[:16]
+
+
+def sampled(trace_id):
+    """Deterministic keep decision: every process (router AND replicas)
+    answers identically for the same trace_id, so a sampled lifecycle is
+    either complete across all hops or absent — never half-stitched."""
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        frac = int(str(trace_id)[:8], 16) / float(0xFFFFFFFF)
+    except ValueError:
+        frac = (hash(trace_id) & 0xFFFFFFFF) / float(0xFFFFFFFF)
+    return frac < rate
+
+
+# --------------------------------------------------------------------------
+# flight-recorder ring (lock-free-ish: deque appends are atomic; dump
+# retries the snapshot if a concurrent append trips the iterator)
+# --------------------------------------------------------------------------
+
+_ring_state = {"size": None, "ring": collections.deque(maxlen=_DEFAULT_RING)}
+_ring_lock = threading.Lock()
+_last_dump = {}                 # reason -> monotonic time of last dump
+_dump_lock = threading.Lock()
+
+
+def _ring():
+    n = ring_size()
+    if n != _ring_state["size"]:
+        with _ring_lock:
+            if n != _ring_state["size"]:
+                old = _ring_state["ring"]
+                _ring_state["ring"] = collections.deque(
+                    old, maxlen=n) if n else collections.deque(maxlen=0)
+                _ring_state["size"] = n
+    return _ring_state["ring"]
+
+
+def ring_snapshot():
+    """A list copy of the ring (oldest first).  Safe under concurrent
+    appends: retries the iteration a few times, then falls back to a
+    best-effort locked copy."""
+    ring = _ring_state["ring"]
+    for _ in range(4):
+        try:
+            return list(ring)
+        except RuntimeError:    # deque mutated during iteration
+            continue
+    with _ring_lock:
+        return list(ring)
+
+
+def _stats_family():
+    return metrics.stats_family("trace", {
+        "events": 0, "events_emitted": 0, "events_dropped": 0,
+        "flight_dumps": 0, "dump_errors": 0})
+
+
+def stats():
+    return dict(_stats_family())
+
+
+# --------------------------------------------------------------------------
+# the event primitive
+# --------------------------------------------------------------------------
+
+def event(name, trace_id=None, request_id=None, **attrs):
+    """Record one trace span event.
+
+    Always: bumps ``trace.events`` and appends to the flight-recorder
+    ring (no I/O — this is the off-by-default cost).  With
+    ``PADDLE_TRACE=1`` and a telemetry dir, also emits the record onto
+    the timeline JSONL log (sampled per trace id).  Returns the record.
+    Exception-safe: tracing must never take down a serving loop."""
+    fam = _stats_family()
+    fam.inc("events")
+    rec = {"event": "trace", "name": str(name),
+           "t": round(now(), 6), "seq": next(_seq),
+           "pid": _PID, "role": _ident["role"]}
+    if _ident["replica"] is not None:
+        rec["replica"] = _ident["replica"]
+    if trace_id is not None:
+        rec["trace_id"] = str(trace_id)
+    if request_id is not None:
+        rec["request_id"] = str(request_id)
+    if attrs:
+        rec.update(attrs)
+    try:
+        _ring().append(rec)
+    except Exception:                                      # noqa: BLE001
+        pass
+    if enabled() and timeline.telemetry_dir() is not None:
+        if trace_id is None or sampled(trace_id):
+            try:
+                timeline.emit(rec)
+                fam.inc("events_emitted")
+            except Exception:                              # noqa: BLE001
+                fam.inc("events_dropped")
+    return rec
+
+
+# --------------------------------------------------------------------------
+# incident flight dumps
+# --------------------------------------------------------------------------
+
+def dump(reason, inflight=None, extra=None, force=False):
+    """Dump the flight recorder to ``flight_<reason>_<pid>_<n>.json`` in
+    the telemetry dir: the ring (last-hop evidence), the caller's
+    in-flight request ids, and any extra context.  Rate-limited to one
+    dump per reason per ~2s unless ``force`` — a shed storm produces one
+    postmortem, not thousands.  Returns the path, or None (telemetry
+    off / rate-limited / write failed).  Never raises."""
+    fam = _stats_family()
+    d = timeline.telemetry_dir()
+    if not d:
+        return None
+    key = str(reason)
+    with _dump_lock:
+        t = time.monotonic()
+        last = _last_dump.get(key)
+        if not force and last is not None \
+                and t - last < _DUMP_MIN_INTERVAL_S:
+            return None
+        _last_dump[key] = t
+    try:
+        import json
+        payload = {
+            "reason": key,
+            "t": round(now(), 6),
+            "pid": _PID,
+            "role": _ident["role"],
+            "replica": _ident["replica"],
+            "inflight": sorted(str(i) for i in (inflight or [])),
+            "extra": extra or {},
+            "ring": ring_snapshot(),
+        }
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, "flight_%s_%d_%d.json" % (
+                "".join(c if (c.isalnum() or c in "-_") else "_"
+                        for c in key), _PID, next(_seq)))
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)   # atomic: rotation/readers never see a torn dump
+        fam.inc("flight_dumps")
+        return path
+    except Exception:                                      # noqa: BLE001
+        fam.inc("dump_errors")
+        return None
+
+
+def reset_for_tests():
+    """Clear ring + dump rate limits (test isolation)."""
+    with _ring_lock:
+        _ring_state["ring"].clear()
+    with _dump_lock:
+        _last_dump.clear()

@@ -259,6 +259,17 @@ def take(kind, step=None, op=None, request=None, event=None):
         else:
             fault["_matches"] = 0
         _stats["faults_fired"] += 1
+        # chaos visibility (ISSUE 19): every firing leaves a trace
+        # event, so an assembled lifecycle shows WHICH injected fault
+        # bent it.  Lazy import: the registry must stay consultable
+        # before the observability package finishes importing.
+        try:
+            from ..observability import tracing as _tracing
+            _tracing.event("fault_fired", kind=kind, op=op, step=step,
+                           request=request, jevent=event,
+                           nth=_want_int(fault, "nth") or 1)
+        except Exception:                                  # noqa: BLE001
+            pass
         return fault
     return None
 

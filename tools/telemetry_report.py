@@ -62,6 +62,10 @@ def main(argv=None):
                              "PADDLE_TELEMETRY_STEP_LAG or 2)")
     parser.add_argument("--fail-on-straggler", action="store_true",
                         help="exit 2 when any straggler is flagged")
+    parser.add_argument("--traces", action="store_true",
+                        help="append the distributed-trace summary "
+                             "(lifecycles, negative spans, dominant "
+                             "phase, flight dumps) from the same dir")
     args = parser.parse_args(argv)
 
     if not os.path.isdir(args.telemetry_dir):
@@ -74,7 +78,12 @@ def main(argv=None):
     report = aggregate.merge_from_dir(
         args.telemetry_dir, straggler_gap_s=args.straggler_gap,
         step_lag=args.step_lag)
-    if not report["nranks_seen"]:
+    if args.traces:
+        report["traces"] = aggregate.trace_summary(args.telemetry_dir)
+    if not report["nranks_seen"] and not (
+            args.traces and report["traces"]["trace_events"]):
+        # a serving-only dir has no step/snapshot records; with
+        # --traces it is still a renderable artifact
         print(f"telemetry_report: no events_rank*.jsonl or "
               f"snapshot_rank*.json under {args.telemetry_dir}",
               file=sys.stderr)
@@ -83,6 +92,15 @@ def main(argv=None):
         print(json.dumps(report, indent=1, sort_keys=True))
     else:
         print(aggregate.format_report(report))
+        if args.traces:
+            t = report["traces"]
+            print(f"traces: {t['traces']} lifecycles / "
+                  f"{t['trace_events']} events, "
+                  f"negative spans: {t['negative_spans']}, "
+                  f"dominant phase: {t['dominant_phase'] or '-'}, "
+                  f"flight dumps: {t['flight_dumps']}"
+                  + ("" if t["traces"] else
+                     "  (none assembled; trace with PADDLE_TRACE=1)"))
     if args.fail_on_straggler and report["stragglers"]:
         return 2
     return 0

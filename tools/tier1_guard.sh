@@ -53,6 +53,16 @@ if ! tools/kvtier_smoke.sh; then
     exit 1
 fi
 
+# distributed-tracing smoke (~15s): traced 2-replica disagg fleet —
+# lifecycles assemble causally ordered across router/prefill/decode
+# with zero negative spans, and an injected router kill leaves a
+# flight dump naming every in-flight request — the ISSUE-19 contract
+if ! tools/trace_smoke.sh; then
+    echo "tier1_guard: FAIL — distributed tracing smoke" \
+         "(tools/trace_smoke.sh; see above)" >&2
+    exit 1
+fi
+
 # router fault-tolerance smoke (~60s): SIGKILL the journaled router
 # mid-traffic, relaunch against the same journal, re-adopt the
 # surviving workers — zero lost, token-exact, zero replica restarts,
