@@ -810,7 +810,12 @@ def serving_bench():
     it is self-contained, so ``BENCH_SERVING_PHASES=spec`` runs it alone
     (tools/spec_smoke.sh's budget) — the base/paged/quant trio is
     monolithic (each phase is the next one's byte-budget baseline) and
-    runs whenever the knob includes ``base``.  Knobs:
+    runs whenever the knob includes ``base``.  The ``tp`` phase
+    (ISSUE 15, :func:`_serving_tp_phase`) serves past one device on a
+    tensor-parallel mesh and now carries the tp x int8 composition pass
+    (ISSUE 20); the ``pp`` phase (ISSUE 20, :func:`_serving_pp_phase`)
+    serves past one HOST on a 2x2 pp x tp mesh — both self-contained
+    and mesh-re-execing like spec.  Knobs:
     BENCH_SERVING_REQUESTS (default 24), BENCH_SERVING_SLOTS (default 4)."""
     import numpy as np
     import jax
@@ -822,20 +827,23 @@ def serving_bench():
     from paddle_tpu.observability import metrics as obs_metrics
 
     phases = {p.strip() for p in os.environ.get(
-        "BENCH_SERVING_PHASES", "base,spec,tp").split(",") if p.strip()}
-    unknown = phases - {"base", "spec", "tp"}
+        "BENCH_SERVING_PHASES", "base,spec,tp,pp").split(",") if p.strip()}
+    unknown = phases - {"base", "spec", "tp", "pp"}
     if unknown:
         # a typo'd phase list must not read as a green bench that
         # measured nothing ("base" covers the monolithic
         # base/paged/quant trio; "spec" the speculation phase; "tp"
-        # the tensor-parallel phase, ISSUE 15)
+        # the tensor-parallel phase, ISSUE 15; "pp" the
+        # pipeline-stage phase, ISSUE 20)
         sys.exit(f"BENCH_SERVING_PHASES: unknown phase(s) "
-                 f"{sorted(unknown)} — valid: base, spec, tp")
+                 f"{sorted(unknown)} — valid: base, spec, tp, pp")
     if "base" not in phases:
         if "spec" in phases:
             _serving_spec_phase()
         if "tp" in phases:
             _serving_tp_phase()
+        if "pp" in phases:
+            _serving_pp_phase()
         return
 
     slots = int(os.environ.get("BENCH_SERVING_SLOTS", 4))
@@ -1167,6 +1175,9 @@ def serving_bench():
     # ---- tensor-parallel phase (ISSUE 15): serve past one device ----
     if "tp" in phases:
         _serving_tp_phase()
+    # ---- pipeline-stage phase (ISSUE 20): serve past one HOST ----
+    if "pp" in phases:
+        _serving_pp_phase()
 
 
 def _serving_tp_phase():
@@ -1183,6 +1194,14 @@ def _serving_tp_phase():
       a churned mixed-length wave (chunked prefill included),
     * token-exact greedy parity vs the single-device
       ``models.gpt.generate`` reference on every request.
+
+    A second COMPOSITION pass (ISSUE 20) re-runs the same trace through
+    ``PagedServingEngine(tp=2, quant="int8", kv_dtype="int8")`` — the
+    combination the tp=1-only quant guard used to refuse — at the fp32
+    tp engine's exact KV byte budget, and asserts greedy tokens still
+    match the single-device fp32 reference, per-token logit rows within
+    BENCH_QUANT_LOGIT_BUDGET (default 0.05) of the fp32 tp engine, and
+    ``kv_bytes_per_token <= 0.5x`` the tp fp32 paged number.
 
     Needs >= 2 devices: on a single-device backend the phase re-execs
     itself as a ``--cpu-mesh 2`` child running only this phase, so
@@ -1234,7 +1253,7 @@ def _serving_tp_phase():
     engine = PagedServingEngine(
         (params, cfg), tp=tp, slots=4, max_len=96, page_size=8,
         seq_buckets=(8, 16, 32), batch_buckets=(1, 2), prefill_chunk=16,
-        max_queue=max(n_requests, 32))
+        max_queue=max(n_requests, 32), capture_logits=True)
     per_dev = engine.param_bytes_per_device()
     assert per_dev <= budget, (
         f"sharded params still exceed the per-device budget: "
@@ -1243,17 +1262,42 @@ def _serving_tp_phase():
     engine.reset_occupancy_peak()
     compiles0 = obs_metrics.counter("compile.count").value
 
-    rng = np.random.RandomState(5)
+    class KVSampler:
+        """Per-step KV accounting: time-averaged bytes reserved per
+        token actually held (same estimator as the base trio's)."""
+
+        def __init__(self):
+            self.bytes_sum = 0
+            self.tok_sum = 0
+
+        def sample(self, st):
+            if st["kv_tokens_held"]:
+                self.bytes_sum += st["kv_bytes_reserved"]
+                self.tok_sum += st["kv_tokens_held"]
+
+        def bytes_per_token(self):
+            return self.bytes_sum / max(1, self.tok_sum)
+
+    def make_requests():
+        # identical trace for the fp32 and int8 passes
+        r = np.random.RandomState(5)
+        out = []
+        for _ in range(n_requests):
+            # lengths span the ladder AND the chunked path (> chunk)
+            p = r.randint(1, cfg.vocab_size,
+                          r.randint(3, 30)).astype(np.int32)
+            out.append((p, int(r.randint(4, 14))))
+        return out
+
+    kv_fp32 = KVSampler()
     reqs = []
     t0 = time.perf_counter()
-    for _ in range(n_requests):
-        # lengths span the ladder AND the chunked path (> prefill_chunk)
-        p = rng.randint(1, cfg.vocab_size,
-                        rng.randint(3, 30)).astype(np.int32)
-        reqs.append(engine.submit(p, int(rng.randint(4, 14))))
+    for p, m in make_requests():
+        reqs.append(engine.submit(p, m))
     done = []
     while engine._busy():
         done.extend(engine.step())
+        kv_fp32.sample(engine.stats())
     dt = time.perf_counter() - t0
     st = engine.stats()
     new_compiles = obs_metrics.counter("compile.count").value - compiles0
@@ -1266,11 +1310,13 @@ def _serving_tp_phase():
     # token-exact greedy parity vs the SINGLE-DEVICE reference (the
     # renegotiation-free invariant: sharding must change the clock,
     # never the tokens) — after the compile assert, generate compiles
+    wants = []
     for req in reqs:
         want = np.asarray(G.generate(params, cfg,
                                      jnp.asarray(req.prompt)[None],
                                      req.max_new_tokens))[0,
                                                           len(req.prompt):]
+        wants.append(want)
         assert (want == np.asarray(req.tokens)).all(), (
             f"tp engine lost token parity on {req.id}: "
             f"{list(want)} vs {req.tokens}")
@@ -1299,6 +1345,230 @@ def _serving_tp_phase():
           f"{total_tokens / dt:.1f} tok/s, decode_compiles=1, "
           f"0 steady-state compiles, token-exact vs single-device",
           file=sys.stderr)
+
+    # ---- tp x int8 composition pass (ISSUE 20): the pair the old
+    # guard refused.  Same trace, the fp32 tp engine's exact KV byte
+    # budget, weights AND KV quantized — sharding plus quantization
+    # must still change only the clock, never the tokens.
+    logit_budget = float(os.environ.get("BENCH_QUANT_LOGIT_BUDGET",
+                                        0.05))
+    budget_bytes = st["kv_bytes_total"]
+    # bytes per page in the int8 pool: 2 pools of 1-byte elements plus
+    # 2 fp32 per-position-per-head scale rows, per layer
+    q_page_bytes = 2 * cfg.num_layers * (
+        8 * cfg.num_heads * cfg.head_dim + 8 * cfg.num_heads * 4)
+    quant = PagedServingEngine(
+        (params, cfg), tp=tp, quant="int8", kv_dtype="int8", slots=4,
+        max_len=96, page_size=8, num_pages=budget_bytes // q_page_bytes,
+        seq_buckets=(8, 16, 32), batch_buckets=(1, 2), prefill_chunk=16,
+        max_queue=max(n_requests, 32), capture_logits=True)
+    qtotal = quant.stats()["kv_bytes_total"]
+    assert qtotal <= budget_bytes, (qtotal, budget_bytes)
+    quant.warmup()
+    quant.reset_occupancy_peak()
+    compiles1 = obs_metrics.counter("compile.count").value
+    kv_int8 = KVSampler()
+    qreqs = []
+    t1 = time.perf_counter()
+    for p, m in make_requests():                  # the SAME mixed trace
+        qreqs.append(quant.submit(p, m))
+    qdone = []
+    while quant._busy():
+        qdone.extend(quant.step())
+        kv_int8.sample(quant.stats())
+    dt_q = time.perf_counter() - t1
+    qst = quant.stats()
+    q_new = obs_metrics.counter("compile.count").value - compiles1
+    assert len(qdone) == n_requests, (len(qdone), n_requests)
+    assert qst["decode_compiles"] == 1, qst
+    assert q_new == 0, (
+        f"tp x int8 steady state retraced: {q_new} new XLA compiles")
+    # greedy tokens vs the SINGLE-DEVICE FP32 reference (not merely the
+    # fp32 tp engine): quantization noise must stay under the argmax
+    max_err = 0.0
+    for want, fr, qr in zip(wants, reqs, qreqs):
+        assert (want == np.asarray(qr.tokens)).all(), (
+            f"tp x int8 greedy tokens diverged from the fp32 "
+            f"single-device reference on {qr.id}: "
+            f"{list(want)} vs {qr.tokens}")
+        for frow, qrow in zip(fr.logits, qr.logits):
+            max_err = max(max_err, float(np.abs(frow - qrow).max()))
+    assert max_err <= logit_budget, (
+        f"tp x int8 max logit error {max_err:.4f} exceeds the declared "
+        f"budget {logit_budget}")
+    bpt_fp32 = kv_fp32.bytes_per_token()
+    bpt_int8 = kv_int8.bytes_per_token()
+    q_ratio = bpt_int8 / bpt_fp32
+    assert q_ratio <= 0.5, (
+        f"tp x int8 kv_bytes_per_token {bpt_int8:.0f} is "
+        f"{q_ratio:.2f}x the tp fp32 paged number {bpt_fp32:.0f} "
+        "(need <= 0.5x)")
+    q_tokens = sum(len(r.tokens) for r in qdone)
+    print(json.dumps({
+        "metric": "serving_tp_int8_tokens_per_sec",
+        "value": round(q_tokens / dt_q, 2),
+        "unit": "tokens/s",
+        "tp": tp,
+        "quant": "int8",
+        "kv_dtype": "int8",
+        "kv_bytes_per_token_fp32": round(bpt_fp32, 1),
+        "kv_bytes_per_token_int8": round(bpt_int8, 1),
+        "kv_bytes_ratio": round(q_ratio, 3),
+        "max_logit_err": round(max_err, 6),
+        "logit_budget": logit_budget,
+        "decode_compiles": qst["decode_compiles"],
+        "steady_state_compiles": q_new,
+        "token_parity": True,
+    }), flush=True)
+    print(f"# serving/tp+int8: {q_tokens / dt_q:.1f} tok/s at tp={tp}, "
+          f"kv bytes/token {bpt_int8:.0f} vs fp32 {bpt_fp32:.0f} "
+          f"({q_ratio:.2f}x <= 0.5x), logit_err={max_err:.2e} <= "
+          f"{logit_budget}, greedy tokens exact vs single-device fp32",
+          file=sys.stderr)
+
+
+def _serving_pp_phase():
+    """Pipeline-stage serving phase (ISSUE 20 tentpole): a gpt config
+    whose fp32 weights EXCEED the combined byte budget of an entire
+    tp=2 tier (2 devices x BENCH_PP_DEVICE_BUDGET_MB, default 8MB each)
+    serves on a 2x2 ('pp','tp') mesh — depth split into pp stage rows
+    running the 1F1B microbatch loop inside ONE donated decode
+    executable, width split over tp within each stage — and asserts:
+
+    * full fp32 param bytes > tp_degree x budget (tensor parallelism
+      ALONE cannot place this model on one tier: the pp axis is doing
+      real memory work),
+    * every stage row's per-device bytes (params + stage-local KV
+      pool, :meth:`stage_bytes`) fit under the budget,
+    * decode_compiles == 1 — ONE stage-loop executable spans all
+      stages; there is no per-stage program to drift — and ZERO
+      steady-state XLA compiles through a churned mixed-length wave,
+    * token-exact greedy parity vs the single-device
+      ``models.gpt.generate`` reference on every request.
+
+    Needs >= 4 devices: on a smaller backend the phase re-execs itself
+    as a ``--cpu-mesh 4`` child running only this phase, so
+    ``bench.py --serving`` always emits the serving_pp_tokens_per_sec
+    metric line.  Knobs: BENCH_PP_STAGES (default 2), BENCH_TP_DEGREE
+    (2), BENCH_PP_DEVICE_BUDGET_MB (8), BENCH_PP_REQUESTS (12)."""
+    import jax
+    pp = int(os.environ.get("BENCH_PP_STAGES", 2))
+    tp = int(os.environ.get("BENCH_TP_DEGREE", 2))
+    if jax.device_count() < pp * tp:
+        env = dict(os.environ)
+        env["BENCH_SERVING_PHASES"] = "pp"
+        env.pop("BENCH_CPU_MESH_CHILD", None)
+        print(f"# serving/pp: {jax.device_count()} device(s) visible — "
+              f"re-running the pp phase on a --cpu-mesh {pp * tp} "
+              "child", file=sys.stderr)
+        rc = subprocess.call(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "--serving", "--cpu-mesh", str(pp * tp)], env=env)
+        if rc != 0:
+            sys.exit(f"serving pp phase failed in the cpu-mesh child "
+                     f"(rc={rc})")
+        return
+
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.distributed.auto import rules
+    from paddle_tpu.inference.serving import PagedServingEngine
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    budget = int(float(os.environ.get("BENCH_PP_DEVICE_BUDGET_MB", 8))
+                 * 2**20)
+    n_requests = int(os.environ.get("BENCH_PP_REQUESTS", 12))
+    # ~21MB of fp32 weights: over a 2-device tier's 16MB combined
+    # budget, ~5.3MB/device on the 2x2 pp x tp grid
+    cfg = G.GPTConfig(
+        vocab_size=int(os.environ.get("BENCH_PP_VOCAB", 1024)),
+        hidden_size=int(os.environ.get("BENCH_PP_HIDDEN", 320)),
+        num_layers=int(os.environ.get("BENCH_PP_LAYERS", 4)),
+        num_heads=int(os.environ.get("BENCH_PP_HEADS", 4)),
+        max_seq_len=128, dtype="float32", use_flash=False, remat=False)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    full_bytes = rules.bytes_per_device(params)
+    assert full_bytes > tp * budget, (
+        f"pp phase config fits a tp={tp} tier ({full_bytes} <= "
+        f"{tp * budget} bytes) — it would prove nothing about the pp "
+        "axis; raise the model or lower BENCH_PP_DEVICE_BUDGET_MB")
+
+    # slots % pp == 0 so decode runs pp microbatches (real 1F1B
+    # overlap, no bubble-only schedule); no prefill_chunk — pp
+    # prefills whole buckets through the stage ring
+    engine = PagedServingEngine(
+        (params, cfg), tp=tp, pp=pp, slots=4, max_len=96, page_size=8,
+        seq_buckets=(8, 16, 32), batch_buckets=(1, 2),
+        max_queue=max(n_requests, 32))
+    stages = engine.stage_bytes()
+    assert len(stages) == pp, stages
+    for s, row in enumerate(stages):
+        got = row["params"] + row["kv"]
+        assert got <= budget, (
+            f"stage {s} exceeds the per-device budget: params "
+            f"{row['params']} + kv {row['kv']} = {got} > {budget}")
+    engine.warmup()
+    engine.reset_occupancy_peak()
+    compiles0 = obs_metrics.counter("compile.count").value
+
+    rng = np.random.RandomState(7)
+    reqs = []
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        p = rng.randint(1, cfg.vocab_size,
+                        rng.randint(3, 30)).astype(np.int32)
+        reqs.append(engine.submit(p, int(rng.randint(4, 14))))
+    done = []
+    while engine._busy():
+        done.extend(engine.step())
+    dt = time.perf_counter() - t0
+    st = engine.stats()
+    new_compiles = obs_metrics.counter("compile.count").value - compiles0
+
+    assert len(done) == n_requests, (len(done), n_requests)
+    assert st["decode_compiles"] == 1, st
+    assert new_compiles == 0, (
+        f"pp steady state retraced: {new_compiles} new XLA compiles")
+    assert st["pp"] == pp and st["tp"] == tp, st
+    # token-exact greedy parity vs the SINGLE-DEVICE reference — the
+    # 1F1B schedule and the psum('tp') partial sums must change the
+    # clock, never the tokens
+    for req in reqs:
+        want = np.asarray(G.generate(params, cfg,
+                                     jnp.asarray(req.prompt)[None],
+                                     req.max_new_tokens))[0,
+                                                          len(req.prompt):]
+        assert (want == np.asarray(req.tokens)).all(), (
+            f"pp engine lost token parity on {req.id}: "
+            f"{list(want)} vs {req.tokens}")
+
+    total_tokens = sum(len(r.tokens) for r in done)
+    print(json.dumps({
+        "metric": "serving_pp_tokens_per_sec",
+        "value": round(total_tokens / dt, 2),
+        "unit": "tokens/s",
+        "pp": pp,
+        "tp": tp,
+        "devices": jax.device_count(),
+        "param_bytes_full": int(full_bytes),
+        "stage_bytes": [{k: int(v) for k, v in row.items()}
+                        for row in stages],
+        "device_budget_bytes": budget,
+        "fits_one_tier": False,
+        "per_stage_under_budget": True,
+        "requests": n_requests,
+        "decode_compiles": st["decode_compiles"],
+        "steady_state_compiles": new_compiles,
+        "token_parity": True,
+    }), flush=True)
+    worst = max(r["params"] + r["kv"] for r in stages)
+    print(f"# serving/pp: {full_bytes / 2**20:.1f}MB fp32 model (> "
+          f"{tp * budget / 2**20:.0f}MB tp={tp} tier budget) served on "
+          f"a {pp}x{tp} pp x tp mesh at {worst / 2**20:.1f}MB/device "
+          f"worst stage, {total_tokens / dt:.1f} tok/s, "
+          f"decode_compiles=1 across all {pp} stages, 0 steady-state "
+          f"compiles, token-exact vs single-device", file=sys.stderr)
 
 
 def _serving_spec_phase():

@@ -154,6 +154,59 @@ def pipeline_forward(stage_fn, x_global, n_microbatch, axis_name="pp"):
     return outputs.reshape(B, *outputs.shape[2:])
 
 
+def pipeline_stage_loop(stage_fn, micro, carry, axis_name="pp"):
+    """The 1F1B tick loop with stage-local CARRY — the serving variant
+    (ISSUE 20).  :func:`pipeline_forward` assumes a stateless stage;
+    the serving decode/prefill stages thread their paged KV pools
+    through every tick (each microbatch APPENDS to the stage's pool),
+    and bubble ticks must be able to mask that side effect.
+
+    ``micro``: [M, mb, ...] pp-replicated stacked stage-0 feeds (e.g.
+    embedded microbatch activations).  ``stage_fn(x, carry, m, valid)
+    -> (y, carry)`` applies this stage's layer range to the in-flight
+    activation ``x`` [mb, ...]: ``m`` is the (traced, already clipped
+    into [0, M)) microbatch index this tick nominally processes and
+    ``valid`` a traced bool that is False in fill/drain bubble ticks —
+    the stage gathers its per-microbatch side operands at ``m`` and
+    aims writes at scratch when not ``valid``.  Activation shape is
+    preserved (y.shape == x.shape, the transformer block contract).
+
+    Returns ``(outputs [M, mb, ...], carry)``: the LAST stage's
+    per-microbatch outputs fanned out to every stage via masked psum
+    (same fan-out as pipeline_forward), plus the threaded carry."""
+    idx = jax.lax.axis_index(axis_name)
+    size = _axis_size(axis_name)
+    M = micro.shape[0]
+    sched = Schedule(M, size)
+
+    state = jnp.zeros_like(micro[0])
+    outputs = jnp.zeros_like(micro)
+
+    def tick(t, tc):
+        state, carry, outputs = tc
+        m = t - idx
+        valid = (m >= 0) & (m < M)
+        msafe = jnp.clip(m, 0, M - 1)
+        feed = micro[jnp.minimum(t, M - 1)]
+        x = jnp.where(idx == 0,
+                      jnp.where(t < M, feed, state), state)
+        y, carry = stage_fn(x, carry, msafe, valid)
+        write = (idx == size - 1) & valid
+        outputs = jax.lax.cond(
+            write, lambda o: o.at[msafe].set(y), lambda o: o, outputs)
+        perm = [(j, (j + 1) % size) for j in range(size)]
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return state, carry, outputs
+
+    state, carry, outputs = jax.lax.fori_loop(
+        0, sched.n_ticks, tick, (state, carry, outputs))
+    if size > 1:
+        outputs = jax.lax.psum(
+            jnp.where(idx == size - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+    return outputs, carry
+
+
 def make_pipelined(mesh, stage_fn, n_microbatch, axis_name="pp"):
     """Standalone pipelined forward over GLOBAL stacked params (for tests
     and single-purpose inference): ``stage_fn(stage_params, x) -> y``

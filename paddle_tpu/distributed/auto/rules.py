@@ -94,6 +94,33 @@ def _is_spec(x):
     return isinstance(x, partition_spec_class())
 
 
+def quantized_like(specs, params):
+    """Mirror ``specs`` onto a possibly weight-quantized param tree:
+    wherever ``params`` holds a quantized ``{"qw"/"qw_dyn", "scale"}``
+    dict leaf (models/gpt.py::quantize_params) at a position the rules
+    carry a plain weight spec, expand the spec into a matching dict —
+    the int8/fp8 payload keeps the fp weight's column/row split (same
+    shape, same axes), while the per-output-channel scale keeps every
+    placement EXCEPT the contraction axis (its dim collapsed to 1 in
+    the absmax reduction, so a row split there would not divide; and
+    because per-output scales distribute over the contraction-axis
+    partial sums, replicating them is numerically exact, not an
+    approximation).  Non-quantized leaves pass through untouched, so
+    the result structurally mirrors ``params`` and feeds straight into
+    :func:`validate` / :func:`place`."""
+    def expand(spec, leaf):
+        if not (isinstance(leaf, dict) and "scale" in leaf
+                and ("qw" in leaf or "qw_dyn" in leaf)):
+            return spec
+        parts = tuple(spec)
+        sparts = (parts[:1] + (None,) + parts[2:]
+                  if len(parts) > 1 else parts)
+        qkey = "qw" if "qw" in leaf else "qw_dyn"
+        return {qkey: P(*parts), "scale": P(*sparts)}
+
+    return jax.tree_util.tree_map(expand, specs, params, is_leaf=_is_spec)
+
+
 def prune_to_mesh(specs, mesh):
     """Drop axis names the mesh doesn't carry (or carries at size 1) from
     every leaf spec, so one rule set serves any dp/tp/pp slice: a tp-only

@@ -102,10 +102,14 @@ def make_key(*parts, donate=(), mesh=None):
 
     ``mesh`` (ISSUE 15) is the device-mesh topology of a SHARDED
     executable (any hashable — engines pass ``("tp", degree, platform,
-    ndevices)``): a tensor-parallel build partitions its program over
-    the mesh, so the same abstract signature on a different topology is
+    ndevices)``, or ``("pp", stages, "tp", degree, platform,
+    ndevices)`` on a pipeline-staged ('pp','tp') mesh, ISSUE 20): a
+    tensor-parallel build partitions its program over the mesh and a
+    pipeline-staged one additionally bakes the 1F1B stage decomposition
+    in, so the same abstract signature on a different topology is
     a different executable.  ``None`` (single-device) keys exactly as
-    before, so every pre-TP call site is unchanged."""
+    before, so every pre-TP call site is unchanged — and a pp==1 mesh
+    keys identically to its pre-pp tp-only form."""
     key = tuple(parts) + (("donate", tuple(donate)),)
     if mesh is not None:
         key += (("mesh", mesh),)
@@ -206,9 +210,12 @@ class ArtifactStore:
         counts and degrades.
 
         ``topology`` (ISSUE 15) names the device mesh a SHARDED
-        executable was compiled for (e.g. ``"tp/2/cpu/2"``); it lands in
-        the artifact header and loads verify it, so a TP-sharded binary
-        is never deserialized onto a mismatched mesh.  ``None`` marks a
+        executable was compiled for (e.g. ``"tp/2/cpu/2"``, or
+        ``"pp/2/tp/2/cpu/4"`` for a pipeline-staged build, ISSUE 20);
+        it lands in the artifact header and loads verify it, so a
+        sharded binary is never deserialized onto a mismatched mesh —
+        a pp x tp stage-loop executable on a tp-only mesh reads back
+        ``"stale"``, never a wrong-program dispatch.  ``None`` marks a
         single-device executable — artifacts written before the field
         existed read back as ``None`` too, so they stay valid."""
         from . import jax_compat

@@ -83,6 +83,16 @@ def psum_scatter(x, axis_name, scatter_dimension=0, tiled=True):
                                 tiled=tiled)
 
 
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """``jax.lax.all_gather`` (the inverse manual-collective of
+    :func:`psum_scatter`) — wrapped for the same reason: serving's
+    vocab-parallel LM head concatenates per-rank logit shards with it
+    (models/gpt_hybrid.py's make_forward idiom, reused by the
+    pipeline-stage serving step)."""
+    import jax
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
 def axis_size(axis_name):
     """``jax.lax.axis_size`` (new) — older jax spells it ``psum(1, axis)``,
     which constant-folds to a python int inside mapped code."""

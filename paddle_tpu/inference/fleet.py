@@ -460,6 +460,15 @@ class ServingFleet:
         if tp is not None and (not isinstance(tp, int) or tp < 1):
             raise ValueError(
                 f"model_spec tp must be an int >= 1, got {tp!r}")
+        pp = self.model_spec.get("pp")
+        if pp is not None and (not isinstance(pp, int) or pp < 1):
+            raise ValueError(
+                f"model_spec pp must be an int >= 1, got {pp!r}")
+        if pp is not None and pp > 1 and not self.model_spec.get("paged"):
+            raise ValueError(
+                "model_spec has pp > 1 but not paged: true — the 1F1B "
+                "stage step exists only on the paged engine (same "
+                "fail-here contract as spec_mode/kv_handoff)")
         # replica roles (ISSUE 15): None -> all unified; a list of role
         # strings (one per replica) or a {"prefill": n, "decode": m}
         # count dict -> a disaggregated fleet.  Coherence is validated
@@ -1226,7 +1235,7 @@ class ServingFleet:
                 r.restarts_used = self.max_restarts
                 raise _ReplicaGone(
                     f"numeric contract mismatch: replica hello reports "
-                    f"(quant, kv_dtype, spec_mode, tp, role)="
+                    f"(quant, kv_dtype, spec_mode, tp, pp, role)="
                     f"{mismatch[0]} but the fleet assigned "
                     f"{mismatch[1]} — config error, replica will not "
                     "be relaunched")
@@ -1442,8 +1451,8 @@ class ServingFleet:
 
     def _contract_mismatch(self, stats, role="unified"):
         """None when the replica's reported numeric/behavior contract
-        (quant mode, kv_dtype, spec_mode, tp degree, role — echoed in
-        every engine ``stats()`` / worker reply) matches the fleet
+        (quant mode, kv_dtype, spec_mode, tp degree, pp stages, role —
+        echoed in every engine ``stats()`` / worker reply) matches the fleet
         spec's; else ``(got, want)`` for the incident record.  Requests
         re-queued across replicas assume identical numerics — a
         mixed-contract fleet would silently break the token-exact retry
@@ -1455,14 +1464,20 @@ class ServingFleet:
         different reduction orders (greedy ties can flip between
         retries), and a replica serving the wrong ROLE would either
         decode work it was never handed KV for or silently prefill on
-        the decode pool — both refuse at hello like mixed int8/fp32."""
+        the decode pool — both refuse at hello like mixed int8/fp32.
+        It grew pp in ISSUE 20 for the same reduction-order reason: the
+        stage step's psum('tp')-per-block partial sums depend on the
+        (pp, tp) decomposition, so a mixed-pp fleet is a mixed-numerics
+        fleet and refuses at hello exactly like mixed-tp."""
         want = (self.model_spec.get("quant"),
                 self.model_spec.get("kv_dtype"),
                 self.model_spec.get("spec_mode"),
                 int(self.model_spec.get("tp") or 1),
+                int(self.model_spec.get("pp") or 1),
                 role or "unified")
         got = (stats.get("quant"), stats.get("kv_dtype"),
                stats.get("spec_mode"), int(stats.get("tp") or 1),
+               int(stats.get("pp") or 1),
                stats.get("role") or "unified")
         return None if got == want else (got, want)
 

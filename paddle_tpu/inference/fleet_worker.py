@@ -146,6 +146,12 @@ def _build_engine(spec, role="unified"):
         # spec so every (re)launched replica shards identically; the
         # hello's stats echo it back for the contract attestation
         kw["tp"] = int(spec["tp"])
+    if spec.get("pp") is not None:
+        # pipeline-stage serving (ISSUE 20): same travel-in-the-spec /
+        # echo-in-the-hello contract as tp — a mixed-pp fleet refuses
+        # at hello (different stage decomposition, different partial-
+        # sum order)
+        kw["pp"] = int(spec["pp"])
     cls = ServingEngine
     if spec.get("paged"):
         cls = PagedServingEngine

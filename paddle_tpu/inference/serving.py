@@ -255,7 +255,7 @@ class ServingEngine:
     def __init__(self, model, *, slots=4, max_len=None, seq_buckets=None,
                  batch_buckets=DEFAULT_BATCH_BUCKETS, max_queue=None,
                  capture_logits=False, cache_dtype=None, quant=None,
-                 tp=None):
+                 tp=None, pp=None):
         import jax
         import jax.numpy as jnp
         self._jax, self._jnp = jax, jnp
@@ -289,21 +289,46 @@ class ServingEngine:
         self._tp = int(tp)
         if self._tp < 1:
             raise ValueError(f"tp must be >= 1, got {self._tp}")
+        # pipeline-stage serving (ISSUE 20): ``pp`` (env fallback
+        # PADDLE_SERVE_PP) adds a leading 'pp' mesh axis — the stacked
+        # layer axis of every block param AND of the KV pools splits
+        # across stages, and the paged executables run the 1F1B
+        # microbatch schedule (distributed/auto/pipeline.py) inside the
+        # one donated step, handing activations between stages with
+        # ppermute.
+        if pp is None:
+            pp = os.environ.get("PADDLE_SERVE_PP") or 1
+        self._pp = int(pp)
+        if self._pp < 1:
+            raise ValueError(f"pp must be >= 1, got {self._pp}")
+        if self._pp > 1 and type(self) is ServingEngine:
+            # the 1F1B stage loop lives in the paged builders only; the
+            # slot engine has no pp path (and silently ignoring the
+            # knob would void the per-stage memory claim)
+            raise ValueError("pp > 1 needs the paged engine — "
+                             "use PagedServingEngine(pp=...)")
         self._mesh = None
         self._param_specs = None
-        if self._tp > 1:
-            if quant is not None:
-                raise ValueError(
-                    "tp > 1 composes with full-precision serving only — "
-                    "the quantized {'qw','scale'} leaves have no "
-                    "sharding rules; drop quant= or tp=")
-            if cfg.num_heads % self._tp:
+        if self._tp > 1 or self._pp > 1:
+            if self._tp > 1 and cfg.num_heads % self._tp:
                 raise ValueError(
                     f"num_heads {cfg.num_heads} must divide by tp "
                     f"{self._tp} — the KV pool shards on the head axis")
-            self._mesh = gpt.serving_mesh(self._tp)
+            if (self._tp > 1 and getattr(cfg, "moe_experts", 0)
+                    and cfg.moe_experts % self._tp):
+                raise ValueError(
+                    f"moe_experts {cfg.moe_experts} must divide by tp "
+                    f"{self._tp} — expert MLPs shard WHOLE over the tp "
+                    "axis (expert parallelism)")
+            if self._pp > 1 and cfg.num_layers % self._pp:
+                raise ValueError(
+                    f"num_layers {cfg.num_layers} must divide by pp "
+                    f"{self._pp} — stages take contiguous equal layer "
+                    "ranges (distributed/auto/pipeline.py)")
+            self._mesh = gpt.serving_mesh(self._tp, pp=self._pp)
             params, self._param_specs = gpt.shard_params_for_serving(
                 params, cfg, self._mesh)
+        self._kv_spec = gpt.kv_pool_spec(self._mesh)
         self.params = params
 
         self.slots = int(slots)
@@ -481,7 +506,8 @@ class ServingEngine:
         return (f"cfg[{cfgs}]/quant={self.quant}/kv={self._kv_dtype}"
                 f"/cap={int(self.capture_logits)}/slots={self.slots}"
                 f"/max_len={self.max_len}/cdt={self._cache_dtype}"
-                f"/donate={int(_donation_enabled())}/tp={self._tp}")
+                f"/donate={int(_donation_enabled())}/tp={self._tp}"
+                f"/pp={self._pp}")
 
     def _aot_key(self, kind, **extra):
         ex = "".join(f"/{k}={v}" for k, v in sorted(extra.items()))
@@ -495,6 +521,11 @@ class ServingEngine:
         if self._mesh is None:
             return None
         devs = self._mesh.devices.reshape(-1)
+        if self._pp > 1:
+            return ("pp", self._pp, "tp", self._tp,
+                    devs[0].platform, len(devs))
+        # pp == 1 keys stay byte-identical to the pre-pp era so
+        # yesterday's tp artifacts survive the field's introduction
         return ("tp", self._tp, devs[0].platform, len(devs))
 
     def _topology(self):
@@ -515,13 +546,56 @@ class ServingEngine:
         if self._mesh is None:
             return tuple(arrs)
         return tuple(jax_compat.with_sharding_constraint(
-            a, self._mesh, gpt.KV_POOL_SPEC) for a in arrs)
+            a, self._mesh, self._kv_spec) for a in arrs)
 
     def param_bytes_per_device(self):
         """Bytes of the (possibly tp-sharded) param pytree each device
         actually pins — the bench's serves-past-one-device proof."""
         from ..distributed.auto import rules
         return rules.bytes_per_device(self.params)
+
+    def _cache_operands(self):
+        """The KV pool arrays in executable-operand order (the paged
+        subclass overrides with the page pool, + scales on int8)."""
+        return (self._cache_k, self._cache_v)
+
+    @staticmethod
+    def _bytes_on(dev, tree):
+        """Bytes of ``tree`` pinned on ONE device: the shard that lives
+        there for sharded leaves, the full copy for replicated ones."""
+        import jax
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                for sh in shards:
+                    if sh.device == dev:
+                        total += (sh.data.size
+                                  * np.dtype(sh.data.dtype).itemsize)
+            else:
+                total += leaf.size * np.dtype(leaf.dtype).itemsize
+        return total
+
+    def stage_bytes(self):
+        """Per-pipeline-stage memory proof: what ONE device of each
+        stage row actually pins — params + KV pool (the int8 scale
+        arrays ride both: weight scales in the param tree, KV scales in
+        the cache operands) — so the over-budget bench assertion is
+        honest about what each device holds.  A pp==1 engine reports
+        one stage covering everything."""
+        from ..distributed.auto import rules
+        if self._mesh is None or self._pp == 1:
+            return [{"params": rules.bytes_per_device(self.params),
+                     "kv": rules.bytes_per_device(
+                         list(self._cache_operands()))}]
+        grid = self._mesh.devices        # [pp, tp]
+        out = []
+        for s in range(self._pp):
+            dev = grid[s].reshape(-1)[0]
+            out.append({
+                "params": self._bytes_on(dev, self.params),
+                "kv": self._bytes_on(dev, list(self._cache_operands()))})
+        return out
 
     def _build_prefill(self, b, s):
         """One prefill executable per (batch, seq) bucket: runs the causal
@@ -1152,6 +1226,9 @@ class ServingEngine:
         out["kv_dtype"] = self._kv_dtype
         out["spec_mode"] = self.spec_mode
         out["tp"] = self._tp
+        out["pp"] = self._pp
+        if self._pp > 1:
+            out["stage_bytes"] = self.stage_bytes()
         out.update(self._kv_accounting())
         return out
 
@@ -1397,6 +1474,32 @@ class PagedServingEngine(ServingEngine):
                     f"{self.max_len} (a clamped chunk write would "
                     "corrupt earlier positions)")
             self._prefill_chunk = c
+        if self._pp > 1:
+            # the 1F1B stage step (models/gpt_pp.py) runs explicit
+            # collectives over full-precision dense weights and
+            # whole-bucket prefill waves; name each missing composition
+            # instead of producing silently-wrong numerics
+            if self.quant is not None:
+                raise ValueError(
+                    "pp > 1 does not compose with quant= yet — the "
+                    "stage step has no dequant-matmul path for "
+                    "{'qw','scale'} leaves (tp x quant works: "
+                    "ServingEngine(tp=N, quant=...))")
+            if self._kv_quant:
+                raise ValueError(
+                    "pp > 1 does not compose with kv_dtype='int8' yet "
+                    "— the stage-local pools store the compute dtype")
+            if self._prefill_chunk is not None:
+                raise ValueError(
+                    "pp > 1 prefills whole buckets through the stage "
+                    "ring — drop prefill_chunk")
+            from ..models import gpt_pp
+            gpt_pp.check_pp_config(self.cfg, self._pp)
+            # decode microbatching: slots split into pp groups when they
+            # divide evenly (keeps every stage busy outside the bubble);
+            # otherwise one group — correct, just bubble-bound
+            self._pp_microbatch = (self._pp if self.slots % self._pp == 0
+                                   else 1)
 
     # ------------------------------------------------------------ plumbing
     def _aot_sig(self):
@@ -1654,6 +1757,27 @@ class PagedServingEngine(ServingEngine):
         pr = s // ps
         cap = self.capture_logits
         kvq = self._kv_quant
+
+        if self._pp > 1:
+            # stage-partitioned wave: one shard_map over the ('pp','tp')
+            # mesh runs the 1F1B fill, each stage scattering its OWN
+            # layer range's pages (models/gpt_pp.py).  Same operand
+            # order and outputs as the GSPMD path below.
+            from ..models import gpt_pp
+            pre = gpt_pp.make_prefill_step(
+                cfg, self._mesh, self._param_specs, s=s, b=b,
+                page_size=ps)
+
+            def prefill_pp(params, cache_k, cache_v, tokens, lens, ptab):
+                ck, cv, first_tok, last = pre(
+                    params, cache_k, cache_v, tokens, lens, ptab)
+                out_cache = self._constrain_cache((ck, cv))
+                if cap:
+                    return (*out_cache, first_tok, last)
+                return (*out_cache, first_tok)
+
+            donate = ((1, 2) if _donation_enabled() else ())
+            return jax.jit(prefill_pp, donate_argnums=donate)
 
         def prefill(params, *args):
             if kvq:
@@ -2452,6 +2576,28 @@ class PagedServingEngine(ServingEngine):
         cfg = self.cfg
         cap = self.capture_logits
         kvq = self._kv_quant
+
+        if self._pp > 1:
+            # stage-partitioned decode: the 1F1B microbatch tick loop
+            # inside ONE shard_map (models/gpt_pp.py) — page table,
+            # write coordinates and lengths stay traced operands, so
+            # this is still the one decode executable forever
+            from ..models import gpt_pp
+            step_pp = gpt_pp.make_decode_step(
+                cfg, self._mesh, self._param_specs, self._pp_microbatch)
+
+            def decode_pp(params, cache_k, cache_v, page_table, wpages,
+                          woffs, lens, toks):
+                logits, ck, cv = step_pp(params, toks, cache_k, cache_v,
+                                         page_table, wpages, woffs, lens)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                cache = self._constrain_cache((ck, cv))
+                if cap:
+                    return (*cache, nxt, logits)
+                return (*cache, nxt)
+
+            donate = ((1, 2) if _donation_enabled() else ())
+            return jax.jit(decode_pp, donate_argnums=donate)
 
         def decode(params, *args):
             n = 4 if kvq else 2

@@ -212,6 +212,11 @@ class SpeculativeServingEngine(PagedServingEngine):
         self._commit_sum = 0            # committed tokens over live traffic
         self._rowstep_sum = 0           # active rows x verify steps
         super().__init__(model, **kw)
+        if self._pp > 1:
+            raise ValueError(
+                "pp > 1 does not compose with speculative decoding yet "
+                "— the verify executable is the GSPMD paged step, not "
+                "the 1F1B stage loop (use PagedServingEngine(pp=...))")
         self.spec_mode = mode           # the contract attestation fields
         self.spec_k = k
         self._g_accept = metrics.gauge("serving.accepted_tokens_per_step")

@@ -54,12 +54,24 @@ class GPTConfig:
     # FLOPs); "dots": save matmul outputs, recompute elementwise/norms only
     # (the TPU sweet spot — matmul results are what's expensive to redo)
     remat_policy: str = "full"
+    # mixture-of-experts FFN (ISSUE 20): >0 replaces every block's dense
+    # FFN with ``moe_experts`` expert MLPs behind a top-1 softmax gate.
+    # Routing is capacity-factor dispatch traced IN-GRAPH — the mix of
+    # experts a batch hits is data flowing through one executable, never
+    # a shape (the serving zero-recompile contract).  Per forward call
+    # each expert accepts at most ceil(tokens/experts * capacity_factor)
+    # tokens per batch row; overflow tokens pass through on the residual
+    # only (the standard Switch-style drop).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.ffn_size == 0:
             self.ffn_size = 4 * self.hidden_size
         assert self.hidden_size % self.num_heads == 0
         assert self.remat_policy in ("full", "dots"), self.remat_policy
+        assert self.moe_experts >= 0, self.moe_experts
+        assert self.moe_capacity_factor > 0, self.moe_capacity_factor
 
     @property
     def head_dim(self):
@@ -109,21 +121,36 @@ def init_params(cfg: GPTConfig, key):
 
     # residual-path projections scaled by 1/sqrt(2L) (GPT-2 init)
     res_std = std / math.sqrt(2.0 * L)
-    return {
-        "wte": nrm(ks[0], (cfg.vocab_size, H)),
-        "wpe": nrm(ks[1], (cfg.max_seq_len, H)),
-        "blocks": {
-            "ln1_g": jnp.ones((L, H), pd), "ln1_b": jnp.zeros((L, H), pd),
-            "qkv_w": nrm(ks[2], (L, H, 3, H)),
-            "qkv_b": jnp.zeros((L, 3, H), pd),
-            "proj_w": nrm(ks[3], (L, H, H), res_std),
-            "proj_b": jnp.zeros((L, H), pd),
-            "ln2_g": jnp.ones((L, H), pd), "ln2_b": jnp.zeros((L, H), pd),
+    blocks = {
+        "ln1_g": jnp.ones((L, H), pd), "ln1_b": jnp.zeros((L, H), pd),
+        "qkv_w": nrm(ks[2], (L, H, 3, H)),
+        "qkv_b": jnp.zeros((L, 3, H), pd),
+        "proj_w": nrm(ks[3], (L, H, H), res_std),
+        "proj_b": jnp.zeros((L, H), pd),
+        "ln2_g": jnp.ones((L, H), pd), "ln2_b": jnp.zeros((L, H), pd),
+    }
+    if cfg.moe_experts > 0:
+        # expert-parallel FFN: every dense fc leaf gains a leading [E]
+        # expert axis (after [L]) — the axis the serving mesh shards
+        E = cfg.moe_experts
+        blocks.update({
+            "moe_gate_w": nrm(ks[6], (L, H, E)),
+            "moe_w1": nrm(ks[4], (L, E, H, F)),
+            "moe_b1": jnp.zeros((L, E, F), pd),
+            "moe_w2": nrm(ks[5], (L, E, F, H), res_std),
+            "moe_b2": jnp.zeros((L, E, H), pd),
+        })
+    else:
+        blocks.update({
             "fc1_w": nrm(ks[4], (L, H, F)),
             "fc1_b": jnp.zeros((L, F), pd),
             "fc2_w": nrm(ks[5], (L, F, H), res_std),
             "fc2_b": jnp.zeros((L, H), pd),
-        },
+        })
+    return {
+        "wte": nrm(ks[0], (cfg.vocab_size, H)),
+        "wpe": nrm(ks[1], (cfg.max_seq_len, H)),
+        "blocks": blocks,
         "lnf_g": jnp.ones((H,), pd), "lnf_b": jnp.zeros((H,), pd),
     }
 
@@ -194,22 +221,40 @@ def sharding_rules(cfg: GPTConfig = None):
 # [L, S, max_len, nh, hd] slots, and [L, P, ps, nh] int8 scales alike)
 KV_POOL_SPEC = (None, None, None, "tp")
 
+# stage-local pools on a ('pp','tp') serving mesh: the stacked layer
+# axis splits over 'pp' (each stage pages ONLY its own layers' K/V —
+# the per-shard page-byte contract becomes per-stage-per-shard) and the
+# head axis still splits over 'tp'.  Works unchanged for the int8 scale
+# arrays ([L, P, ps, nh]: L over pp, nh over tp).
+KV_POOL_SPEC_PP = ("pp", None, None, "tp")
 
-def serving_mesh(tp):
-    """A 1-D ``('tp',)`` mesh over the first ``tp`` local devices — the
-    serving engine's tensor-parallel topology (built through
-    framework/jax_compat.py like every mesh in this repo)."""
+
+def serving_mesh(tp, pp=1):
+    """The serving mesh over the first ``pp * tp`` local devices (built
+    through framework/jax_compat.py like every mesh in this repo): a
+    1-D ``('tp',)`` mesh for plain tensor-parallel serving, or a 2-D
+    ``('pp', 'tp')`` mesh when ``pp > 1`` — pipeline stages over the
+    leading mesh axis, tensor shards within each stage."""
     import numpy as _np
     from ..framework import jax_compat
-    tp = int(tp)
-    if tp < 2:
+    tp, pp = int(tp), int(pp)
+    if pp < 1:
+        raise ValueError(f"serving_mesh wants pp >= 1, got {pp}")
+    if pp == 1 and tp < 2:
         raise ValueError(f"serving_mesh wants tp >= 2, got {tp} "
                          "(tp=1 is the plain single-device engine)")
+    if pp > 1 and tp < 1:
+        raise ValueError(f"serving_mesh wants tp >= 1, got {tp}")
+    need = pp * tp
     devs = jax.devices()
-    if len(devs) < tp:
+    if len(devs) < need:
         raise ValueError(
-            f"tp={tp} needs {tp} devices but only {len(devs)} are "
-            "visible (CPU runs: --xla_force_host_platform_device_count)")
+            f"pp={pp} x tp={tp} needs {need} devices but only "
+            f"{len(devs)} are visible (CPU runs: "
+            "--xla_force_host_platform_device_count)")
+    if pp > 1:
+        grid = _np.array(devs[:need]).reshape(pp, tp)
+        return jax_compat.make_mesh(grid, ("pp", "tp"))
     return jax_compat.make_mesh(_np.array(devs[:tp]), ("tp",))
 
 
@@ -222,6 +267,11 @@ def shard_params_for_serving(params, cfg, mesh):
     replicated leaf would void the fits-past-one-device claim."""
     from ..distributed.auto import rules
     specs = rules.prune_to_mesh(rules.rules_for("gpt", cfg), mesh)
+    # weight-quantized trees ({'qw','scale'} dict leaves) get matching
+    # dict specs: int8 payload keeps the fp column/row split, scales
+    # keep everything but the collapsed contraction axis (rules.py::
+    # quantized_like) — this is what lets tp=N compose with quant=
+    specs = rules.quantized_like(specs, params)
     shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
     bad = rules.validate(specs, shapes, mesh)
     if bad:
@@ -241,9 +291,18 @@ def replicate_on_mesh(tree, mesh):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
 
+def kv_pool_spec(mesh):
+    """The KV pool PartitionSpec for ``mesh``: stage-local pools
+    (:data:`KV_POOL_SPEC_PP`) when the mesh carries a 'pp' axis,
+    head-sharded (:data:`KV_POOL_SPEC`) otherwise."""
+    if mesh is not None and "pp" in getattr(mesh, "axis_names", ()):
+        return KV_POOL_SPEC_PP
+    return KV_POOL_SPEC
+
+
 def _kv_pool_sharding(mesh):
     from ..framework import jax_compat
-    return jax_compat.named_sharding(mesh, KV_POOL_SPEC)
+    return jax_compat.named_sharding(mesh, kv_pool_spec(mesh))
 
 
 QUANT_MODES = ("int8", "int8_dynamic", "fp8")
@@ -290,6 +349,11 @@ def quantize_params(params, quant="int8"):
                 "use quant='int8'")
     key = "qw_dyn" if quant == "int8_dynamic" else "qw"
     blocks = dict(params["blocks"])
+    if "moe_w1" in blocks:
+        raise ValueError(
+            "MoE expert weights have no quantized serving path yet — "
+            "quant= needs a dense-FFN model (moe_experts=0); expert "
+            "bytes scale down by sharding the expert axis instead")
     for name in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
         w = jnp.asarray(blocks[name], jnp.float32)
         if fp8 is not None:
@@ -356,6 +420,56 @@ def _attention(q, k, v, cfg):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _moe_ffn(cfg: GPTConfig, x, blk):
+    """Top-1 capacity-factor expert FFN over the ln2 output ``x``
+    [B, N, H] (ISSUE 20).  Everything about the routing MIX is traced
+    data — gate logits, argmax expert ids, capacity slots — so two
+    traffic mixes run through the SAME executable; only N (the bucket)
+    shapes the graph, via the static per-row capacity
+    ``C = max(1, ceil(N/E * capacity_factor))``.
+
+    Per batch row: softmax gate over ``moe_gate_w`` picks each token's
+    expert (fp32, like attention's softmax), tokens claim capacity
+    slots in position order (onehot cumsum), overflow tokens are
+    dropped (they ride the residual), kept tokens are scattered into an
+    [E, C, H] dispatch buffer, both expert matmuls run as one batched
+    einsum over the expert axis — the axis GSPMD shards when the expert
+    weights carry an 'tp'-axis NamedSharding (expert-parallel serving)
+    — and outputs gather back gate-scaled.  Decode (N == 1) has C == 1
+    and a row's single token always claims slot 0: no drop, which keeps
+    paged decode token-exact with the full forward."""
+    cd = jnp.dtype(cfg.dtype)
+    E = cfg.moe_experts
+    B, N, H = x.shape
+    C = max(1, int(math.ceil(N / E * cfg.moe_capacity_factor)))
+    gate_w = blk["moe_gate_w"].astype(jnp.float32)
+    w1 = blk["moe_w1"].astype(cd)
+    b1 = blk["moe_b1"].astype(cd)
+    w2 = blk["moe_w2"].astype(cd)
+    b2 = blk["moe_b2"].astype(cd)
+
+    def route_row(h):                                     # h: [N, H]
+        gl = h.astype(jnp.float32) @ gate_w               # [N, E]
+        probs = jax.nn.softmax(gl, -1)
+        eidx = jnp.argmax(gl, -1)                         # [N]
+        gate = jnp.take_along_axis(probs, eidx[:, None], -1)[:, 0]
+        onehot = jax.nn.one_hot(eidx, E, dtype=jnp.int32)
+        # capacity slot: this token's rank among earlier tokens routed
+        # to the same expert (deterministic position-order claim)
+        cidx = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, -1) - 1
+        keep = cidx < C
+        csafe = jnp.clip(cidx, 0, C - 1)
+        buf = jnp.zeros((E, C, H), cd).at[eidx, csafe].add(
+            jnp.where(keep[:, None], h, 0))               # dropped: +0
+        hid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", buf, w1)
+                          + b1[:, None], approximate=True)
+        out = jnp.einsum("ecf,efh->ech", hid, w2) + b2[:, None]
+        y = out[eidx, csafe] * gate[:, None].astype(cd)
+        return jnp.where(keep[:, None], y, 0)
+
+    return jax.vmap(route_row)(x)
+
+
 def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
     """One transformer block.  x: [B, N, H]; blk: per-layer param dict
     (no leading L axis).  ``attn_fn(q, k, v) -> ([B,N,nh,hd], aux)`` swaps
@@ -387,7 +501,9 @@ def block_apply(cfg: GPTConfig, x, blk, attn_fn=None):
     x = x + a
 
     h = ln(x, blk["ln2_g"], blk["ln2_b"], cfg.layer_norm_eps)
-    if _is_qweight(blk["fc1_w"]):
+    if "moe_w1" in blk:
+        h = _moe_ffn(cfg, h, blk)
+    elif _is_qweight(blk["fc1_w"]):
         # quantized FFN goes through the fused dequant matmul — the
         # fused_ffn kernel only knows float weights
         h = jax.nn.gelu(_q_matmul(h, blk["fc1_w"], cd)

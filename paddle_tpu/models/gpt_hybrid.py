@@ -50,7 +50,8 @@ MESH_AXES = ("dp", "pp", "tp", "sp")
 # init_params gets its decay policy decided here, nowhere else
 NO_DECAY = frozenset({"wpe", "lnf_g", "lnf_b"})
 LN_NAMES = frozenset({"ln1_g", "ln1_b", "ln2_g", "ln2_b",
-                      "proj_b", "qkv_b", "fc1_b", "fc2_b"})
+                      "proj_b", "qkv_b", "fc1_b", "fc2_b",
+                      "moe_b1", "moe_b2"})
 
 
 # --------------------------------------------------------------------------
@@ -59,21 +60,36 @@ LN_NAMES = frozenset({"ln1_g", "ln1_b", "ln2_g", "ln2_b",
 
 def param_specs(cfg: GPTConfig):
     """PartitionSpec pytree matching init_params' structure."""
-    return {
-        "wte": P("tp"),                      # vocab-sharded
-        "wpe": P(),
-        "blocks": {
-            "ln1_g": P("pp"), "ln1_b": P("pp"),
-            "qkv_w": P("pp", None, None, "tp"),
-            "qkv_b": P("pp", None, "tp"),
-            "proj_w": P("pp", "tp"),
-            "proj_b": P("pp"),
-            "ln2_g": P("pp"), "ln2_b": P("pp"),
+    blocks = {
+        "ln1_g": P("pp"), "ln1_b": P("pp"),
+        "qkv_w": P("pp", None, None, "tp"),
+        "qkv_b": P("pp", None, "tp"),
+        "proj_w": P("pp", "tp"),
+        "proj_b": P("pp"),
+        "ln2_g": P("pp"), "ln2_b": P("pp"),
+    }
+    if getattr(cfg, "moe_experts", 0):
+        # expert-parallel: the [E] axis (after [L]) shards over 'tp' —
+        # each rank holds E/tp whole expert MLPs; the gate is tiny and
+        # replicated (parallel/moe.py's layout, stacked on [L])
+        blocks.update({
+            "moe_gate_w": P("pp"),
+            "moe_w1": P("pp", "tp"),
+            "moe_b1": P("pp", "tp"),
+            "moe_w2": P("pp", "tp"),
+            "moe_b2": P("pp", "tp"),
+        })
+    else:
+        blocks.update({
             "fc1_w": P("pp", None, "tp"),
             "fc1_b": P("pp", "tp"),
             "fc2_w": P("pp", "tp"),
             "fc2_b": P("pp"),
-        },
+        })
+    return {
+        "wte": P("tp"),                      # vocab-sharded
+        "wpe": P(),
+        "blocks": blocks,
         "lnf_g": P(), "lnf_b": P(),
     }
 

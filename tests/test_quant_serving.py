@@ -389,9 +389,9 @@ class TestFleetQuantContract:
         ok = {"quant": "int8", "kv_dtype": "int8"}
         assert fleet._contract_mismatch(ok) is None
         bad = fleet._contract_mismatch({"quant": None, "kv_dtype": None})
-        # the attestation tuple grew tp + role in ISSUE 15
-        assert bad == ((None, None, None, 1, "unified"),
-                       ("int8", "int8", None, 1, "unified"))
+        # the attestation tuple grew tp + role in ISSUE 15, pp in 20
+        assert bad == ((None, None, None, 1, 1, "unified"),
+                       ("int8", "int8", None, 1, 1, "unified"))
         # fp32 fleet rejects a quantized replica too
         fp = self._fleet_stub({"paged": True})
         assert fp._contract_mismatch({"quant": None,

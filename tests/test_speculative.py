@@ -504,9 +504,9 @@ class TestFleetSpecContract:
         assert fleet._contract_mismatch(ok) is None
         bad = fleet._contract_mismatch(
             {"quant": None, "kv_dtype": None, "spec_mode": None})
-        # the attestation tuple grew tp + role in ISSUE 15
-        assert bad == ((None, None, None, 1, "unified"),
-                       (None, None, "ngram", 1, "unified"))
+        # the attestation tuple grew tp + role in ISSUE 15, pp in 20
+        assert bad == ((None, None, None, 1, 1, "unified"),
+                       (None, None, "ngram", 1, 1, "unified"))
         # differing spec MODES refuse each other too
         assert fleet._contract_mismatch(
             {"quant": None, "kv_dtype": None,

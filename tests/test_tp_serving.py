@@ -9,7 +9,10 @@ single-device reference through churn / chunked prefill / preemption
 retry, per-shard page-byte determinism, mesh-aware compile-cache keys
 and artifact topology attestation, KV handoff (prefill-only extraction
 -> injection) with the ``handoff_drop`` fault's re-ship path, and the
-fleet contract tuple grown to (quant, kv_dtype, spec_mode, tp, role).
+fleet contract tuple grown to (quant, kv_dtype, spec_mode, tp, role)
+— and, since ISSUE 20, to the 6-wide
+(quant, kv_dtype, spec_mode, tp, pp, role) with the pipeline-stage
+axis riding along.
 """
 import os
 
@@ -160,12 +163,56 @@ class TestTPEngine:
         with pytest.raises(ValueError, match="num_heads"):
             PagedServingEngine((params, cfg), tp=4, slots=2, max_len=32,
                                page_size=8)       # 2 heads % 4 != 0
-        with pytest.raises(ValueError, match="quant"):
-            PagedServingEngine((params, cfg), tp=2, quant="int8",
-                               slots=2, max_len=32, page_size=8)
         with pytest.raises(ValueError, match="devices"):
             from paddle_tpu.models import gpt as G
             G.serving_mesh(64)
+
+    def test_tp_composes_with_quant(self, tiny_model):
+        """ISSUE 20 (flipped from "raises"): tp=2 + quant='int8' used
+        to be guarded off; now the {'qw','scale'} dict leaves get
+        megatron specs via rules.quantized_like and the engine
+        constructs sharded.  (Token-exact serving parity is the slow
+        suite's test_tp_int8_parity — this stays compile-free.)"""
+        eng = _tp_engine(tiny_model, quant="int8")
+        assert eng.stats()["tp"] == 2 and eng.quant == "int8"
+        # the int8 qw really shards: each device holds out-cols/2
+        qw = eng.params["blocks"]["fc1_w"]["qw"]
+        shards = qw.addressable_shards
+        assert len(shards) == 2
+        assert shards[0].data.shape[-1] == qw.shape[-1] // 2
+        # scale mirrors the weight's spec with its collapsed axis-1
+        # part replicated — the same rank owns matching scale columns
+        sc = eng.params["blocks"]["fc1_w"]["scale"]
+        assert sc.addressable_shards[0].data.shape[-1] \
+            == sc.shape[-1] // 2
+        # qkv: weight parts on the last axis, scale mirrors
+        qkv_s = eng.params["blocks"]["qkv_w"]["scale"]
+        assert qkv_s.addressable_shards[0].data.shape[-1] \
+            == qkv_s.shape[-1] // 2
+
+    def test_quantized_like_rule(self, tiny_model):
+        """The spec-expansion rule itself: fp leaves keep their spec,
+        {'qw','scale'} leaves get (weight spec, weight spec with the
+        collapsed contraction axis replicated)."""
+        from paddle_tpu.distributed.auto import rules
+        from paddle_tpu.models import gpt as G
+        from paddle_tpu.models import gpt_hybrid
+        import jax.tree_util as jtu
+        params, cfg = tiny_model
+        qparams = G.quantize_params(params, "int8")
+        specs = gpt_hybrid.param_specs(cfg)
+        out = rules.quantized_like(specs, qparams)
+        fc1 = out["blocks"]["fc1_w"]
+        assert tuple(fc1["qw"]) == tuple(specs["blocks"]["fc1_w"])
+        # axis 1 (the dim quantization collapsed to 1) must not part
+        assert fc1["scale"][1] is None
+        assert tuple(fc1["scale"][2:]) == tuple(fc1["qw"][2:])
+        # fp leaves pass through untouched
+        assert out["wte"] == specs["wte"]
+        # and the spec tree stays zippable with the quantized params
+        jtu.tree_map(lambda s, p: None, out, qparams,
+                     is_leaf=lambda x: isinstance(
+                         x, type(specs["wte"])))
 
     def test_env_knob(self, tiny_model, monkeypatch):
         monkeypatch.setenv("PADDLE_SERVE_TP", "2")
@@ -220,6 +267,70 @@ class TestMeshKeysAndTopology:
         assert eng2._mesh_key() == ("tp", 2, "cpu", 2)
         assert eng1._topology() is None
         assert eng2._topology() == "tp/2/cpu/2"
+
+    def test_engine_keys_separate_by_pp(self, tiny_model):
+        """pp joins the mesh key/topology (ISSUE 20); pp==1 keys stay
+        byte-identical to the pre-pp era so yesterday's tp artifacts
+        survive the field's introduction."""
+        eng_tp = _tp_engine(tiny_model)                       # pp == 1
+        eng_pp = _tp_engine(tiny_model, pp=2)                 # 2x2 mesh
+        assert eng_tp._mesh_key() == ("tp", 2, "cpu", 2)
+        assert eng_pp._mesh_key() == ("pp", 2, "tp", 2, "cpu", 4)
+        assert eng_pp._topology() == "pp/2/tp/2/cpu/4"
+        assert eng_tp._aot_key("decode") != eng_pp._aot_key("decode")
+        assert "/pp=2" in eng_pp._aot_sig()
+        assert eng_pp.stats()["pp"] == 2
+        # per-stage accounting: one entry per stage, params + kv split
+        sb = eng_pp.stats()["stage_bytes"]
+        assert len(sb) == 2
+        for st in sb:
+            assert st["params"] > 0 and st["kv"] > 0
+
+    def test_pp_artifact_rejected_on_tp_only_mesh(self, tmp_path):
+        """A ('pp','tp')-mesh artifact deserialized onto a tp-only mesh
+        is stale -> rebuilt, never loaded (the satellite's attestation:
+        stage-partitioned executables can only revive on the exact
+        (pp, tp) grid that built them)."""
+        import jax
+        from paddle_tpu.framework import compile_cache as cc
+        if not cc.aot_available():
+            pytest.skip("no serialize_executable in this jax")
+        store = cc.ArtifactStore(str(tmp_path))
+        compiled = jax.jit(lambda x: x + 1).lower(1.0).compile()
+        store.save("pp_decode", compiled, topology="pp/2/tp/2/cpu/4")
+        ok, reason = store.validate("pp_decode", topology="pp/2/tp/2/cpu/4")
+        assert ok, reason
+        for wrong in ("tp/2/cpu/2", "pp/4/tp/1/cpu/4", None):
+            ok, reason = store.validate("pp_decode", topology=wrong)
+            assert not ok and reason == "stale", (wrong, reason)
+        fn, reason = store.load("pp_decode", topology="tp/2/cpu/2")
+        assert fn is None and reason == "stale"
+
+    def test_pp_knob_validation(self, tiny_model):
+        from paddle_tpu.inference.serving import (PagedServingEngine,
+                                                  ServingEngine)
+        params, cfg = tiny_model
+        with pytest.raises(ValueError, match="paged"):
+            ServingEngine((params, cfg), pp=2, slots=2, max_len=32)
+        with pytest.raises(ValueError, match="num_layers"):
+            # 2 layers % 3 stages != 0
+            PagedServingEngine((params, cfg), pp=3, tp=1, slots=3,
+                               max_len=32, page_size=8)
+        with pytest.raises(ValueError, match="quant"):
+            PagedServingEngine((params, cfg), pp=2, quant="int8",
+                               slots=2, max_len=32, page_size=8)
+        with pytest.raises(ValueError, match="kv_dtype"):
+            PagedServingEngine((params, cfg), pp=2, kv_dtype="int8",
+                               slots=2, max_len=32, page_size=8)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            PagedServingEngine((params, cfg), pp=2, prefill_chunk=8,
+                               slots=2, max_len=32, page_size=8)
+
+    def test_pp_env_knob(self, tiny_model, monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVE_PP", "2")
+        eng = _tp_engine(tiny_model, pp=None)
+        assert eng.stats()["pp"] == 2
+        assert eng._mesh_key()[:2] == ("pp", 2)
 
 
 class TestKVHandoff:
@@ -372,8 +483,8 @@ class TestFleetContractAndRoles:
         assert fleet._contract_mismatch(ok) is None
         # mixed tp refuses like mixed int8/fp32
         bad = fleet._contract_mismatch(dict(ok, tp=1))
-        assert bad == ((None, None, None, 1, "unified"),
-                       (None, None, None, 2, "unified"))
+        assert bad == ((None, None, None, 1, 1, "unified"),
+                       (None, None, None, 2, 1, "unified"))
         # wrong role refuses too
         assert fleet._contract_mismatch(dict(ok, role="prefill")) \
             is not None
@@ -382,9 +493,31 @@ class TestFleetContractAndRoles:
         # a tp-less fleet refuses a sharded replica
         plain = self._fleet_stub({"paged": True})
         assert plain._contract_mismatch(ok) is not None
-        # absent tp/role keys normalize to (1, "unified")
+        # absent tp/pp/role keys normalize to (1, 1, "unified")
         assert plain._contract_mismatch(
             {"quant": None, "kv_dtype": None, "spec_mode": None}) is None
+
+    def test_contract_tuple_grew_pp(self):
+        """ISSUE 20: mixed-pp hellos refuse like mixed-tp — a replica
+        running a different stage decomposition computes different
+        partial-sum orders, so it can never absorb re-queued work."""
+        from paddle_tpu.inference.fleet import ServingFleet
+        fleet = self._fleet_stub({"paged": True, "tp": 2, "pp": 2})
+        ok = {"quant": None, "kv_dtype": None, "spec_mode": None,
+              "tp": 2, "pp": 2, "role": "unified"}
+        assert fleet._contract_mismatch(ok) is None
+        bad = fleet._contract_mismatch(dict(ok, pp=1))
+        assert bad == ((None, None, None, 2, 1, "unified"),
+                       (None, None, None, 2, 2, "unified"))
+        # a pp-less fleet refuses a staged replica, and vice versa
+        plain = self._fleet_stub({"paged": True, "tp": 2})
+        assert plain._contract_mismatch(ok) is not None
+        assert fleet._contract_mismatch(dict(ok, pp=1)) is not None
+        # model_spec validation: pp must be a positive int, on paged
+        with pytest.raises(ValueError, match="pp must be an int"):
+            ServingFleet({"paged": True, "pp": 0}, replicas=1)
+        with pytest.raises(ValueError, match="paged"):
+            ServingFleet({"pp": 2}, replicas=1)
 
     def test_role_plan_validation(self):
         from paddle_tpu.inference.fleet import ServingFleet

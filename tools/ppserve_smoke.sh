@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Pipeline-stage serving smoke: the ISSUE-20 tentpole on a 2x2 pp x tp
+# CPU mesh, inside a hard 60s budget — CI's proof that a gpt config too
+# big for an entire tp=2 tier still serves token-exact when depth
+# splits into 1F1B stage rows INSIDE the one donated decode executable.
+#
+# Runs bench.py --serving with only the pp phase (--cpu-mesh 4 re-execs
+# with a clean forced-CPU env, same dance as tests/conftest.py).  The
+# phase itself asserts full fp32 bytes > the 2-device tier budget,
+# every stage row under the per-device budget, decode_compiles == 1
+# across all stages, zero steady-state compiles, and greedy parity vs
+# models.gpt.generate; this smoke additionally greps the parsed
+# serving_pp_tokens_per_sec metric line and the per-stage attestation.
+#
+# Usage: tools/ppserve_smoke.sh
+# Exit:  bench exit status, or 1 if the metric line / attestation is
+#        missing.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+
+LOG=$(mktemp /tmp/ppserve_smoke.XXXXXX.log)
+timeout -k 10 60 env JAX_PLATFORMS=cpu BENCH_SERVING_PHASES=pp \
+    python bench.py --serving --cpu-mesh 4 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+if [ "$rc" -ne 0 ]; then
+    echo "ppserve_smoke: FAIL (rc=$rc)" >&2
+    exit "$rc"
+fi
+if ! grep -q '"metric": "serving_pp_tokens_per_sec"' "$LOG"; then
+    echo "ppserve_smoke: FAIL — run finished but emitted no parsed" \
+         "serving_pp_tokens_per_sec metric line" >&2
+    exit 1
+fi
+if ! grep -q 'decode_compiles=1 across all 2 stages' "$LOG"; then
+    echo "ppserve_smoke: FAIL — no per-stage compile attestation" >&2
+    exit 1
+fi
+if ! grep -q 'token-exact vs single-device' "$LOG"; then
+    echo "ppserve_smoke: FAIL — no token-parity attestation" >&2
+    exit 1
+fi
+echo "ppserve_smoke: OK"

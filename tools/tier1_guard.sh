@@ -73,6 +73,16 @@ if ! tools/routerchaos_smoke.sh; then
     exit 1
 fi
 
+# pipeline-stage serving smoke (~35s): a model too big for a whole
+# tp=2 tier serves token-exact on the 2x2 pp x tp mesh, one decode
+# executable across stages, zero steady-state compiles — the ISSUE-20
+# tentpole contract
+if ! tools/ppserve_smoke.sh; then
+    echo "tier1_guard: FAIL — pipeline-stage serving smoke" \
+         "(tools/ppserve_smoke.sh; see above)" >&2
+    exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
